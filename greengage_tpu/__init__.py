@@ -1,0 +1,29 @@
+"""greengage_tpu — a TPU-native MPP analytical query engine.
+
+A brand-new framework with the capabilities of GreengageDB (Greenplum-lineage
+PostgreSQL MPP data warehouse), redesigned TPU-first:
+
+- segments -> chips of a ``jax.sharding.Mesh`` (axis "seg")
+- slice/Motion execution -> whole-plan compilation under ``shard_map`` where
+  Redistribute Motion = ``lax.all_to_all``, Broadcast Motion = ``all_gather``,
+  Gather Motion = device->host gather (reference: src/backend/cdb/motion/)
+- volcano tuple-at-a-time -> vectorized columnar batch operators with
+  validity + selection masks (reference: src/backend/executor/)
+- AOCS column store -> per-column compressed block files with checksums and
+  manifest-based MVCC commit (reference: src/backend/access/aocs/aocsam.c)
+- locus-based motion planning (reference: src/backend/cdb/cdbpathlocus.c,
+  cdbpath.c:922 cdbpath_motion_for_join)
+
+See SURVEY.md for the full structural map of the reference.
+"""
+
+import jax
+
+# Decimals are stored/computed as scaled int64 for SQL exactness (the
+# reference relies on PostgreSQL numeric); int64 on TPU is emulated with
+# int32 pairs which is acceptable for the bandwidth-bound analytical ops.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from greengage_tpu.api import Database, connect  # noqa: E402,F401

@@ -1,0 +1,16 @@
+"""Public API: connect() / Database — the libpq+psql analog surface.
+
+Grows with the engine; the full query path lands in exec/session.py and is
+re-exported here.
+"""
+
+from greengage_tpu.exec.session import Database  # noqa: F401
+
+
+def connect(path: str | None = None, numsegments: int | None = None) -> "Database":
+    """Open (or create) a database.
+
+    path=None gives an in-memory single-host cluster; numsegments defaults to
+    the number of visible JAX devices (each segment binds to one chip).
+    """
+    return Database(path=path, numsegments=numsegments)

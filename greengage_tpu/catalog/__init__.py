@@ -1,0 +1,8 @@
+from greengage_tpu.catalog.schema import (  # noqa: F401
+    Column,
+    DistPolicy,
+    PolicyKind,
+    TableSchema,
+)
+from greengage_tpu.catalog.catalog import Catalog  # noqa: F401
+from greengage_tpu.catalog.segments import SegmentConfig, SegmentRole, SegmentStatus  # noqa: F401

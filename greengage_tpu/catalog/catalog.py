@@ -1,0 +1,78 @@
+"""The catalog: table schemas + cluster topology, persisted as JSON.
+
+Reference parity: the master-only system catalog (src/backend/catalog) that
+the QD consults for planning and dispatch. We keep it deliberately small: a
+dict of TableSchema plus the SegmentConfig, durably stored in the cluster
+directory and versioned via the storage manifest (MVCC commits live in
+storage.manifest, not here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from greengage_tpu.catalog.schema import TableSchema
+from greengage_tpu.catalog.segments import SegmentConfig
+
+
+class Catalog:
+    def __init__(self, numsegments: int, path: str | None = None):
+        self.tables: dict[str, TableSchema] = {}
+        self.segments = SegmentConfig.create(numsegments)
+        self.path = path  # cluster dir; None = in-memory only
+
+    # ---- table DDL -----------------------------------------------------
+    def create_table(self, schema: TableSchema, if_not_exists: bool = False) -> None:
+        if schema.name in self.tables:
+            if if_not_exists:
+                return
+            raise ValueError(f'table "{schema.name}" already exists')
+        if schema.policy.numsegments == 0:
+            schema.policy = type(schema.policy)(
+                schema.policy.kind, schema.policy.keys, self.segments.numsegments
+            )
+        self.tables[schema.name] = schema
+        self._save()
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        if name not in self.tables:
+            if if_exists:
+                return
+            raise ValueError(f'table "{name}" does not exist')
+        del self.tables[name]
+        self._save()
+
+    def get(self, name: str) -> TableSchema:
+        if name not in self.tables:
+            raise ValueError(f'relation "{name}" does not exist')
+        return self.tables[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.tables
+
+    # ---- persistence ---------------------------------------------------
+    def _save(self) -> None:
+        if self.path is None:
+            return
+        data = {
+            "numsegments": self.segments.numsegments,
+            "tables": {n: t.to_dict() for n, t in self.tables.items()},
+        }
+        os.makedirs(self.path, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".catalog")
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, "catalog.json"))
+
+    @staticmethod
+    def load(path: str) -> "Catalog":
+        with open(os.path.join(path, "catalog.json")) as f:
+            data = json.load(f)
+        cat = Catalog(data["numsegments"], path=path)
+        for n, t in data["tables"].items():
+            cat.tables[n] = TableSchema.from_dict(t)
+        return cat

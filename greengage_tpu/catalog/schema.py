@@ -1,0 +1,103 @@
+"""Table schemas and distribution policies.
+
+Reference parity: gp_distribution_policy (src/include/catalog/gp_policy.h) —
+every table carries a policy {HASH(cols), RANDOM, REPLICATED} plus
+``numsegments`` (the table's width, which may lag the cluster width during
+expansion, gp_policy.h:35). We reproduce exactly that model.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from greengage_tpu import types as T
+
+
+class PolicyKind(enum.Enum):
+    HASH = "hash"          # DISTRIBUTED BY (cols): rows placed by key hash
+    RANDOM = "random"      # DISTRIBUTED RANDOMLY: round-robin, locus Strewn
+    REPLICATED = "replicated"  # DISTRIBUTED REPLICATED: full copy per segment
+
+
+@dataclass(frozen=True)
+class DistPolicy:
+    kind: PolicyKind
+    keys: tuple[str, ...] = ()      # distribution key column names (HASH only)
+    numsegments: int = 0            # table width; 0 = cluster width at create
+
+    def __post_init__(self):
+        if self.kind is PolicyKind.HASH and not self.keys:
+            raise ValueError("HASH policy requires keys")
+        if self.kind is not PolicyKind.HASH and self.keys:
+            raise ValueError("keys only valid for HASH policy")
+
+    def describe(self) -> str:
+        if self.kind is PolicyKind.HASH:
+            return f"DISTRIBUTED BY ({', '.join(self.keys)})"
+        if self.kind is PolicyKind.RANDOM:
+            return "DISTRIBUTED RANDOMLY"
+        return "DISTRIBUTED REPLICATED"
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    type: T.SqlType
+    nullable: bool = True
+
+
+@dataclass
+class TableSchema:
+    name: str
+    columns: list[Column]
+    policy: DistPolicy
+    options: dict = field(default_factory=dict)  # e.g. compresstype, blocksize
+
+    def __post_init__(self):
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column in {self.name}")
+        for k in self.policy.keys:
+            if k not in names:
+                raise ValueError(f"distribution key {k} not a column of {self.name}")
+
+    def column(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"{self.name}.{name}")
+
+    @property
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "columns": [
+                {
+                    "name": c.name,
+                    "kind": c.type.kind.value,
+                    "scale": c.type.scale,
+                    "nullable": c.nullable,
+                }
+                for c in self.columns
+            ],
+            "policy": {
+                "kind": self.policy.kind.value,
+                "keys": list(self.policy.keys),
+                "numsegments": self.policy.numsegments,
+            },
+            "options": self.options,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TableSchema":
+        cols = [
+            Column(c["name"], T.SqlType(T.Kind(c["kind"]), c.get("scale", 0)), c.get("nullable", True))
+            for c in d["columns"]
+        ]
+        p = d["policy"]
+        policy = DistPolicy(PolicyKind(p["kind"]), tuple(p.get("keys", ())), p.get("numsegments", 0))
+        return TableSchema(d["name"], cols, policy, d.get("options", {}))

@@ -1,0 +1,94 @@
+"""Segment (chip) configuration — the gp_segment_configuration analog.
+
+Reference parity: src/include/catalog/gp_segment_config.h. Each content id
+(segment) maps to a device of the JAX mesh; role/status drive FTS-lite
+failover decisions (src/backend/fts/fts.c). A monotonically increasing
+``version`` invalidates cached dispatch topology, mirroring how the
+dispatcher consumes the FTS version (src/backend/cdb/dispatcher/README.md).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+
+
+class SegmentRole(enum.Enum):
+    PRIMARY = "p"
+    MIRROR = "m"
+
+
+class SegmentStatus(enum.Enum):
+    UP = "u"
+    DOWN = "d"
+
+
+@dataclass
+class SegmentEntry:
+    content: int                 # segment index (-1 = coordinator, like GP)
+    role: SegmentRole
+    preferred_role: SegmentRole
+    status: SegmentStatus = SegmentStatus.UP
+    mode_synced: bool = True     # mirror caught up (gp_stat_replication analog)
+    host: str = "localhost"
+    device_index: int | None = None  # index into mesh devices (primaries only)
+
+
+@dataclass
+class SegmentConfig:
+    """Cluster topology: content -> primary/mirror entries."""
+
+    numsegments: int
+    entries: list[SegmentEntry] = field(default_factory=list)
+    version: int = 0  # bumped on any change (FTS version analog)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @staticmethod
+    def create(numsegments: int, with_mirrors: bool = False) -> "SegmentConfig":
+        cfg = SegmentConfig(numsegments=numsegments)
+        cfg.entries.append(
+            SegmentEntry(-1, SegmentRole.PRIMARY, SegmentRole.PRIMARY, device_index=None)
+        )
+        for c in range(numsegments):
+            cfg.entries.append(
+                SegmentEntry(c, SegmentRole.PRIMARY, SegmentRole.PRIMARY, device_index=c)
+            )
+            if with_mirrors:
+                cfg.entries.append(SegmentEntry(c, SegmentRole.MIRROR, SegmentRole.MIRROR))
+        return cfg
+
+    def primaries(self) -> list[SegmentEntry]:
+        return sorted(
+            (e for e in self.entries if e.role is SegmentRole.PRIMARY and e.content >= 0),
+            key=lambda e: e.content,
+        )
+
+    def entry(self, content: int, role: SegmentRole) -> SegmentEntry:
+        for e in self.entries:
+            if e.content == content and e.role is role:
+                return e
+        raise KeyError((content, role))
+
+    def mark_down(self, content: int) -> None:
+        """FTS verdict: primary is dead; promote its mirror if in sync."""
+        with self._lock:
+            primary = self.entry(content, SegmentRole.PRIMARY)
+            primary.status = SegmentStatus.DOWN
+            try:
+                mirror = self.entry(content, SegmentRole.MIRROR)
+            except KeyError:
+                mirror = None
+            if mirror is not None and mirror.mode_synced:
+                # promotion: swap roles (ftsmessagehandler.c analog)
+                primary.role = SegmentRole.MIRROR
+                mirror.role = SegmentRole.PRIMARY
+                mirror.status = SegmentStatus.UP
+                mirror.device_index = primary.device_index
+                primary.device_index = None
+            self.version += 1
+
+    def all_up(self) -> bool:
+        return all(
+            e.status is SegmentStatus.UP for e in self.entries if e.role is SegmentRole.PRIMARY
+        )

@@ -1,0 +1,6 @@
+"""Placeholder; full Database facade lands with the executor."""
+
+
+class Database:
+    def __init__(self, path=None, numsegments=None):
+        raise NotImplementedError("executor not built yet")

@@ -1,0 +1,2 @@
+from greengage_tpu.storage.table_store import TableStore  # noqa: F401
+from greengage_tpu.storage.manifest import Manifest  # noqa: F401

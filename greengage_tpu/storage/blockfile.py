@@ -1,0 +1,92 @@
+"""Column block files (.ggb) — the AOCS datum-stream analog.
+
+Reference parity: one segment file set per column with block-level
+compression and checksummed headers (src/backend/access/aocs/aocsam.c,
+src/backend/cdb/cdbappendonlystorageformat.c). Layout:
+
+    [frame]* [footer-json] [u64 footer_len] [u32 magic "GGBF"]
+
+Each frame is ggcodec's checksummed block (native.block_encode). The footer
+records per-block (offset, nrows) so scans can do block-level skipping
+(block directory analog) and projection reads only touch requested columns'
+files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from greengage_tpu.storage import native
+
+FOOTER_MAGIC = 0x47474246  # "GGBF"
+DEFAULT_BLOCK_ROWS = 1 << 16
+
+_COMP_BY_NAME = {"none": native.COMP_NONE, "zlib": native.COMP_ZLIB, "zstd": native.COMP_ZSTD}
+
+
+def write_column_file(path: str, values: np.ndarray, compresstype: str = "zlib",
+                      complevel: int = 1, block_rows: int = DEFAULT_BLOCK_ROWS) -> dict:
+    """Write a 1-D numpy array as a block file; returns footer metadata."""
+    comp = _COMP_BY_NAME[compresstype]
+    values = np.ascontiguousarray(values)
+    blocks = []
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        off = 0
+        for start in range(0, len(values), block_rows):
+            chunk = values[start : start + block_rows]
+            frame = native.block_encode(chunk.tobytes(), len(chunk), comp, complevel)
+            f.write(frame)
+            blocks.append({"offset": off, "nrows": len(chunk), "bytes": len(frame)})
+            off += len(frame)
+        footer = {
+            "dtype": values.dtype.str,
+            "nrows": int(len(values)),
+            "blocks": blocks,
+        }
+        fj = json.dumps(footer).encode()
+        f.write(fj)
+        f.write(len(fj).to_bytes(8, "little"))
+        f.write(FOOTER_MAGIC.to_bytes(4, "little"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return footer
+
+
+def read_footer(path: str) -> dict:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size - 12)
+        tail = f.read(12)
+        if int.from_bytes(tail[8:12], "little") != FOOTER_MAGIC:
+            raise IOError(f"{path}: bad footer magic")
+        flen = int.from_bytes(tail[:8], "little")
+        f.seek(size - 12 - flen)
+        return json.loads(f.read(flen))
+
+
+def read_column_file(path: str, block_indices: list[int] | None = None) -> np.ndarray:
+    """Read all (or selected) blocks back into one numpy array."""
+    footer = read_footer(path)
+    dtype = np.dtype(footer["dtype"])
+    blocks = footer["blocks"]
+    if block_indices is not None:
+        blocks = [blocks[i] for i in block_indices]
+    parts = []
+    with open(path, "rb") as f:
+        for b in blocks:
+            f.seek(b["offset"])
+            frame = f.read(b["bytes"])
+            raw, nrows, _ = native.block_decode(frame)
+            arr = np.frombuffer(raw, dtype=dtype)
+            if len(arr) != nrows:
+                raise IOError(f"{path}: block row count mismatch")
+            parts.append(arr)
+    if not parts:
+        return np.empty(0, dtype=dtype)
+    return np.concatenate(parts)

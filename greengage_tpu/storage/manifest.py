@@ -1,0 +1,93 @@
+"""Manifest-based MVCC commit — the distributed-visibility analog.
+
+The reference achieves cluster-wide atomic visibility with 2PC + the
+distributed log (src/backend/cdb/cdbtm.c, access/transam/distributedlog.c).
+Our storage is append-only (no in-place update), so a transaction's writes
+are invisible staged files until a single atomic manifest swap publishes
+them — the manifest version is the distributed commit record. The DTM-lite
+layer (runtime/dtm.py) drives prepare/commit over this API:
+
+  prepare(tx): durably stage the next manifest as manifest.<v>.prepared
+  commit(tx):  atomically rename it over manifest.json  (commit point)
+  abort(tx):   delete the staged manifest + orphaned segfiles
+
+Readers snapshot manifest.json once per query, so concurrent loads never
+tear a scan (snapshot isolation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+class Manifest:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, "manifest.json")
+
+    # ---- snapshots -----------------------------------------------------
+    def snapshot(self) -> dict:
+        if not os.path.exists(self.path):
+            return {"version": 0, "tables": {}}
+        with open(self.path) as f:
+            return json.load(f)
+
+    # ---- transactions --------------------------------------------------
+    def begin(self) -> dict:
+        """Start a write tx from the current snapshot; mutate tx['tables']."""
+        snap = self.snapshot()
+        return {"base_version": snap["version"], "tables": snap["tables"]}
+
+    def _staged_path(self, version: int) -> str:
+        return os.path.join(self.root, f"manifest.{version}.prepared")
+
+    def prepare(self, tx: dict) -> int:
+        """Phase 1: durably stage the new manifest. Returns new version."""
+        current = self.snapshot()
+        if current["version"] != tx["base_version"]:
+            raise RuntimeError(
+                f"write-write conflict: base v{tx['base_version']} != current v{current['version']}"
+            )
+        version = tx["base_version"] + 1
+        data = {"version": version, "tables": tx["tables"]}
+        staged = self._staged_path(version)
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".manifest")
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, staged)
+        return version
+
+    def commit(self, version: int) -> None:
+        """Phase 2: the atomic commit point."""
+        staged = self._staged_path(version)
+        if not os.path.exists(staged):
+            raise RuntimeError(f"no prepared manifest v{version}")
+        os.replace(staged, self.path)
+
+    def abort(self, version: int) -> None:
+        staged = self._staged_path(version)
+        if os.path.exists(staged):
+            os.remove(staged)
+
+    def recover(self) -> list[int]:
+        """In-doubt resolution (cdbdtxrecovery.c analog): roll back any
+        prepared-but-uncommitted manifests found after a crash."""
+        rolled = []
+        for fn in os.listdir(self.root):
+            if fn.startswith("manifest.") and fn.endswith(".prepared"):
+                v = int(fn.split(".")[1])
+                os.remove(os.path.join(self.root, fn))
+                rolled.append(v)
+        return rolled
+
+    def commit_tx(self, tx: dict) -> int:
+        """One-phase convenience (single-writer fast path, like GP's
+        one-phase commit optimization for single-gang xacts)."""
+        v = self.prepare(tx)
+        self.commit(v)
+        return v

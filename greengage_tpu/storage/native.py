@@ -1,0 +1,204 @@
+"""ctypes bindings for the native ggcodec library, with numpy fallbacks.
+
+The native library (native/ggcodec.cpp) is the host-side performance path for
+distribution hashing and block encode/decode — the role the reference fills
+with C (src/backend/cdb/cdbhash.c, cdbappendonlystorageformat.c). If the .so
+is missing we build it with make; if that fails (no toolchain) the numpy
+fallbacks are bit-identical but slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import zlib
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_NATIVE_DIR, "libggcodec.so")
+
+HASH_INIT = np.uint32(0x9E3779B9)
+COMBINE_MUL = np.uint32(0x01000193)
+BLOCK_MAGIC = 0x47474231
+HDR_LEN = 32
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True, capture_output=True)
+        except Exception:
+            pass
+    if os.path.exists(_SO):
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.gg_hash_i64_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32, ctypes.c_void_p]
+            lib.gg_hash_combine_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+            lib.gg_hash_bytes.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+            lib.gg_hash_bytes.restype = ctypes.c_uint32
+            lib.gg_block_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64]
+            lib.gg_block_encode.restype = ctypes.c_int64
+            lib.gg_block_decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            lib.gg_block_decode.restype = ctypes.c_int64
+            _lib = lib
+            return lib
+        except OSError:
+            pass
+    _lib = False
+    return False
+
+
+def have_native() -> bool:
+    return bool(_load())
+
+
+# ---------------------------------------------------------------------------
+# Hashing — numpy reference implementation (spec source of truth shared with
+# greengage_tpu/ops/hashing.py, which mirrors it in JAX for on-device motion)
+# ---------------------------------------------------------------------------
+
+def _fmix32(h: np.ndarray) -> np.ndarray:
+    h = h.astype(np.uint32, copy=True)
+    with np.errstate(over="ignore"):
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+    return h
+
+
+def hash_i64(vals: np.ndarray, seed: int = 0) -> np.ndarray:
+    """uint32 hash of an int64 array (spec: fmix32 over lo then hi halves)."""
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    lib = _load()
+    if lib:
+        out = np.empty(len(vals), dtype=np.uint32)
+        lib.gg_hash_i64_batch(vals.ctypes.data, len(vals), ctypes.c_uint32(seed & 0xFFFFFFFF),
+                              out.ctypes.data)
+        return out
+    u = vals.view(np.uint64)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    h = np.uint32(seed & 0xFFFFFFFF) ^ HASH_INIT
+    h = _fmix32(np.uint32(h) ^ lo)
+    h = _fmix32(h ^ hi)
+    return h
+
+
+def hash_combine(acc: np.ndarray, h: np.ndarray) -> np.ndarray:
+    acc = np.ascontiguousarray(acc, dtype=np.uint32)
+    h = np.ascontiguousarray(h, dtype=np.uint32)
+    lib = _load()
+    if lib:
+        out = acc.copy()
+        lib.gg_hash_combine_batch(out.ctypes.data, h.ctypes.data, len(acc))
+        return out
+    with np.errstate(over="ignore"):
+        return _fmix32(acc * COMBINE_MUL ^ h)
+
+
+def hash_bytes(data: bytes, seed: int = 0) -> int:
+    """uint32 hash of a byte string (8-byte LE chunk folding + length)."""
+    lib = _load()
+    if lib:
+        return int(lib.gg_hash_bytes(data, len(data), ctypes.c_uint32(seed & 0xFFFFFFFF)))
+    acc = np.uint32(seed & 0xFFFFFFFF) ^ HASH_INIT
+    acc_arr = np.array([acc], dtype=np.uint32)
+    for i in range(0, len(data), 8):
+        chunk = int.from_bytes(data[i : i + 8].ljust(8, b"\0"), "little")
+        hv = hash_i64(np.array([np.uint64(chunk).astype(np.int64)], dtype=np.int64).view(np.int64))
+        acc_arr = hash_combine(acc_arr, hv)
+    acc_arr = hash_combine(acc_arr, hash_i64(np.array([len(data)], dtype=np.int64)))
+    return int(acc_arr[0])
+
+
+# ---------------------------------------------------------------------------
+# Block frame codec
+# ---------------------------------------------------------------------------
+
+COMP_NONE, COMP_ZLIB, COMP_ZSTD = 0, 1, 2
+
+
+def block_encode(raw: bytes | np.ndarray, nrows: int, compression: int = COMP_ZLIB,
+                 level: int = 1) -> bytes:
+    raw = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, (bytes, bytearray)) else np.ascontiguousarray(raw).view(np.uint8).ravel()
+    lib = _load()
+    if lib and compression in (COMP_NONE, COMP_ZLIB):
+        # capacity covers zlib's worst case (compressBound ~ raw + raw/1000 + 64)
+        # plus header; the C side stores raw on any compress failure.
+        cap = HDR_LEN + len(raw) + len(raw) // 1000 + 4096
+        dst = np.empty(cap, dtype=np.uint8)
+        n = lib.gg_block_encode(raw.ctypes.data, len(raw), ctypes.c_uint32(nrows),
+                                compression, level, dst.ctypes.data, cap)
+        if n < 0:
+            raise IOError("block encode failed")
+        return dst[:n].tobytes()
+    payload = raw.tobytes()
+    comp = compression
+    if compression == COMP_ZLIB:
+        c = zlib.compress(payload, level)
+        if len(c) < len(payload):
+            payload = c
+        else:
+            comp = COMP_NONE
+    elif compression == COMP_ZSTD:
+        import zstandard
+
+        c = zstandard.ZstdCompressor(level=level).compress(payload)
+        if len(c) < len(payload):
+            payload = c
+        else:
+            comp = COMP_NONE
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    hdr = (BLOCK_MAGIC.to_bytes(4, "little") + int(nrows).to_bytes(4, "little")
+           + bytes([comp, 0]) + b"\0\0" + len(raw).to_bytes(8, "little")
+           + len(payload).to_bytes(8, "little") + crc.to_bytes(4, "little"))
+    return hdr + payload
+
+
+def block_decode(frame: bytes) -> tuple[bytes, int, int]:
+    """-> (raw bytes, nrows, frame length consumed). Verifies checksum."""
+    if len(frame) < HDR_LEN or int.from_bytes(frame[:4], "little") != BLOCK_MAGIC:
+        raise IOError("bad block magic")
+    nrows = int.from_bytes(frame[4:8], "little")
+    comp = frame[8]
+    raw_len = int.from_bytes(frame[12:20], "little")
+    comp_len = int.from_bytes(frame[20:28], "little")
+    want_crc = int.from_bytes(frame[28:32], "little")
+    total = HDR_LEN + comp_len
+    lib = _load()
+    if lib and comp in (COMP_NONE, COMP_ZLIB):
+        src = np.frombuffer(frame[:total], dtype=np.uint8)
+        dst = np.empty(max(raw_len, 1), dtype=np.uint8)
+        nrows_out = ctypes.c_uint32()
+        n = lib.gg_block_decode(src.ctypes.data, len(src), dst.ctypes.data, len(dst),
+                                ctypes.byref(nrows_out))
+        if n == -2:
+            raise IOError("block checksum mismatch")
+        if n < 0:
+            raise IOError(f"block decode failed ({n})")
+        return dst[:n].tobytes(), nrows_out.value, total
+    payload = frame[HDR_LEN:total]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != want_crc:
+        raise IOError("block checksum mismatch")
+    if comp == COMP_ZLIB:
+        raw = zlib.decompress(payload)
+    elif comp == COMP_ZSTD:
+        import zstandard
+
+        raw = zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len)
+    else:
+        raw = bytes(payload)
+    return raw, nrows, total

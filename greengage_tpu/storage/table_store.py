@@ -1,0 +1,236 @@
+"""TableStore: hash-distributed columnar tables on disk.
+
+Reference parity: the AOCS access method + cdbhash placement + appendonly
+writer (src/backend/access/aocs/aocsam.c, src/backend/cdb/cdbhash.c,
+appendonlywriter.c). Each table is stored as per-segment, per-column block
+files; every INSERT/COPY appends new segment files and publishes them with
+one manifest commit (snapshot-isolated, see manifest.py).
+
+Placement spec (must match ops/hashing.py on device):
+  col_hash = fmix32-based hash of the 64-bit value (NULL -> 0)
+  row_hash = col_hash[0], then combine(acc, col_hash[i]) for the rest
+  segment  = row_hash % numsegments     (RANDOM: round-robin; REPLICATED: all)
+TEXT columns hash their utf-8 bytes (via the dictionary hash LUT), never the
+dictionary code, so placement is stable across dictionary growth.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import numpy as np
+
+from greengage_tpu import types as T
+from greengage_tpu.catalog import Catalog, PolicyKind, TableSchema
+from greengage_tpu.storage import native
+from greengage_tpu.storage.blockfile import read_column_file, write_column_file
+from greengage_tpu.storage.dictionary import Dictionary
+from greengage_tpu.storage.manifest import Manifest
+
+
+def _as_i64(arr: np.ndarray) -> np.ndarray:
+    """Reinterpret a column's device dtype as int64 for hashing.
+
+    float64 keys are canonicalized first (-0.0 -> 0.0, all NaNs -> one NaN
+    bit pattern) so SQL-equal values co-locate — the hashfloat8 parity rule.
+    """
+    if arr.dtype == np.float64:
+        arr = np.where(arr == 0.0, 0.0, arr)
+        arr = np.where(np.isnan(arr), np.nan, arr)
+        return arr.view(np.int64)
+    return arr.astype(np.int64)
+
+
+class TableStore:
+    def __init__(self, root: str, catalog: Catalog):
+        self.root = root
+        self.catalog = catalog
+        self.manifest = Manifest(root)
+        self._dicts: dict[tuple[str, str], Dictionary] = {}
+
+    # ---- dictionaries --------------------------------------------------
+    def dictionary(self, table: str, col: str) -> Dictionary:
+        key = (table, col)
+        if key not in self._dicts:
+            self._dicts[key] = Dictionary.load(self._dict_path(table, col))
+        return self._dicts[key]
+
+    def _dict_path(self, table: str, col: str) -> str:
+        return os.path.join(self.root, "data", table, f"dict_{col}.json")
+
+    # ---- placement -----------------------------------------------------
+    def row_hashes(self, schema: TableSchema, cols: dict[str, np.ndarray],
+                   valids: dict[str, np.ndarray | None], keys: tuple[str, ...]) -> np.ndarray:
+        acc = None
+        for k in keys:
+            c = schema.column(k)
+            arr = cols[k]
+            if c.type.kind is T.Kind.TEXT:
+                lut = self.dictionary(schema.name, k).hashes()
+                h = lut[arr] if len(lut) else np.zeros(len(arr), dtype=np.uint32)
+            else:
+                h = native.hash_i64(_as_i64(arr))
+            v = valids.get(k)
+            if v is not None:
+                h = np.where(v, h, np.uint32(0))
+            acc = h if acc is None else native.hash_combine(acc, h)
+        return acc
+
+    def _placement(self, schema: TableSchema, cols, valids, nrows: int, row_offset: int) -> np.ndarray:
+        pol = schema.policy
+        nseg = pol.numsegments
+        if pol.kind is PolicyKind.HASH:
+            rh = self.row_hashes(schema, cols, valids, pol.keys)
+            return (rh % np.uint32(nseg)).astype(np.int32)
+        if pol.kind is PolicyKind.RANDOM:
+            return ((np.arange(nrows, dtype=np.int64) + row_offset) % nseg).astype(np.int32)
+        raise AssertionError("REPLICATED handled by caller")
+
+    # ---- write path ----------------------------------------------------
+    def insert(self, table: str, columns: dict[str, list | np.ndarray],
+               valids: dict[str, np.ndarray] | None = None, tx: dict | None = None) -> int:
+        """Append rows; returns row count. Encodes TEXT, places rows onto
+        segments, writes per-segment column files, commits the manifest
+        (or stages into an open tx for DTM-lite two-phase commit)."""
+        schema = self.catalog.get(table)
+        valids = dict(valids or {})
+        nrows = None
+        enc: dict[str, np.ndarray] = {}
+        for c in schema.columns:
+            if c.name not in columns:
+                raise ValueError(f"missing column {c.name}")
+            raw = columns[c.name]
+            if c.type.kind is T.Kind.TEXT:
+                d = self.dictionary(table, c.name)
+                vmask = valids.get(c.name)
+                if vmask is None:
+                    arr = d.encode(list(raw))
+                else:
+                    strs = ["" if not ok else s for s, ok in zip(raw, vmask)]
+                    arr = d.encode(strs)
+            elif c.type.kind is T.Kind.DECIMAL and not isinstance(raw, np.ndarray):
+                arr = np.array([T.decimal_to_int(v, c.type.scale) for v in raw], dtype=np.int64)
+            elif c.type.kind is T.Kind.DATE and not isinstance(raw, np.ndarray):
+                arr = np.array([T.date_to_days(v) for v in raw], dtype=np.int32)
+            else:
+                arr = np.asarray(raw, dtype=c.type.np_dtype)
+            enc[c.name] = arr
+            nrows = len(arr) if nrows is None else nrows
+            if len(arr) != nrows:
+                raise ValueError("ragged insert")
+
+        own_tx = tx is None
+        if own_tx:
+            tx = self.manifest.begin()
+        tmeta = tx["tables"].setdefault(table, {"segfiles": {}, "nrows": {}})
+        # tx-unique file id: concurrent writers can never clobber each other's
+        # staged files; the losing writer's orphans are unreachable via the
+        # manifest (appendonlywriter segfile-concurrency analog).
+        fileno = uuid.uuid4().hex[:12]
+
+        nseg = schema.policy.numsegments
+        total_existing = sum(tmeta["nrows"].get(str(s), 0) for s in range(nseg))
+        if schema.policy.kind is PolicyKind.REPLICATED:
+            seg_rows = [np.arange(nrows)] * nseg
+        else:
+            seg_of = self._placement(schema, enc, valids, nrows, total_existing)
+            seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
+
+        compresstype = schema.options.get("compresstype", "zlib")
+        complevel = int(schema.options.get("compresslevel", 1))
+        for s in range(nseg):
+            idx = seg_rows[s]
+            if len(idx) == 0:
+                continue
+            segdir = os.path.join(self.root, "data", table, f"seg{s}")
+            os.makedirs(segdir, exist_ok=True)
+            files = tmeta["segfiles"].setdefault(str(s), [])
+            for c in schema.columns:
+                fn = f"{c.name}.{fileno}.ggb"
+                write_column_file(os.path.join(segdir, fn), enc[c.name][idx],
+                                  compresstype, complevel)
+                files.append(os.path.join(f"seg{s}", fn))
+                v = valids.get(c.name)
+                if v is not None:
+                    vfn = f"{c.name}.{fileno}.valid.ggb"
+                    write_column_file(os.path.join(segdir, vfn),
+                                      np.asarray(v, dtype=np.uint8)[idx], compresstype, complevel)
+                    files.append(os.path.join(f"seg{s}", vfn))
+            tmeta["nrows"][str(s)] = tmeta["nrows"].get(str(s), 0) + int(len(idx))
+
+        if own_tx:
+            # Ordering: stage files -> prepare (version CAS = the write lock)
+            # -> persist dictionaries (fsynced; superset-safe) -> commit. A
+            # losing concurrent writer fails at prepare() before its in-memory
+            # dictionary extensions ever reach disk.
+            try:
+                v = self.manifest.prepare(tx)
+            except RuntimeError:
+                self._invalidate_dicts(table)
+                raise
+            self.flush_dicts(table)
+            self.manifest.commit(v)
+        else:
+            # DTM-managed tx: the caller drives prepare/commit and must call
+            # flush_dicts(table) between those phases (see runtime/dtm.py).
+            pass
+        return nrows
+
+    def flush_dicts(self, table: str) -> None:
+        schema = self.catalog.get(table)
+        for c in schema.columns:
+            if c.type.kind is T.Kind.TEXT and (table, c.name) in self._dicts:
+                os.makedirs(os.path.join(self.root, "data", table), exist_ok=True)
+                self._dicts[(table, c.name)].save(self._dict_path(table, c.name))
+
+    def _invalidate_dicts(self, table: str) -> None:
+        for key in [k for k in self._dicts if k[0] == table]:
+            del self._dicts[key]
+
+    # ---- read path -----------------------------------------------------
+    def read_segment(self, table: str, seg: int, columns: list[str] | None = None,
+                     snapshot: dict | None = None):
+        """-> (cols: {name: np.ndarray}, valids: {name: np.ndarray|None}, nrows)."""
+        schema = self.catalog.get(table)
+        snap = snapshot or self.manifest.snapshot()
+        tmeta = snap["tables"].get(table, {"segfiles": {}, "nrows": {}})
+        files = tmeta["segfiles"].get(str(seg), [])
+        want = columns if columns is not None else schema.column_names
+        cols: dict[str, np.ndarray] = {}
+        valids: dict[str, np.ndarray | None] = {}
+        nrows = tmeta["nrows"].get(str(seg), 0)
+        base = os.path.join(self.root, "data", table)
+        for name in want:
+            c = schema.column(name)
+            data_parts, valid_parts = [], []
+            for rel in files:
+                fn = os.path.basename(rel)
+                if fn.startswith(name + ".") and fn.endswith(".ggb"):
+                    arr = read_column_file(os.path.join(base, rel))
+                    if fn.endswith(".valid.ggb"):
+                        valid_parts.append((rel, arr))
+                    else:
+                        data_parts.append((rel, arr))
+            if data_parts:
+                cols[name] = np.concatenate([a for _, a in data_parts])
+            else:
+                cols[name] = np.empty(0, dtype=c.type.np_dtype)
+            if valid_parts:
+                # files without a .valid sibling are all-valid
+                vmap = {r.replace(".valid.ggb", ".ggb"): a for r, a in valid_parts}
+                vs = []
+                for r, a in data_parts:
+                    vs.append(vmap.get(r, np.ones(len(a), dtype=np.uint8)))
+                valids[name] = np.concatenate(vs).astype(bool)
+            else:
+                valids[name] = None
+            if len(cols[name]) != nrows:
+                raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
+        return cols, valids, nrows
+
+    def segment_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
+        schema = self.catalog.get(table)
+        snap = snapshot or self.manifest.snapshot()
+        tmeta = snap["tables"].get(table, {"nrows": {}})
+        return [tmeta["nrows"].get(str(s), 0) for s in range(schema.policy.numsegments)]

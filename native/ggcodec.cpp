@@ -1,0 +1,159 @@
+// ggcodec — native host-side codec for greengage_tpu.
+//
+// Role parity with the reference's native storage/hash path:
+//   - distribution hashing          ≙ src/backend/cdb/cdbhash.c
+//   - block checksum + frame codec  ≙ src/backend/cdb/cdbappendonlystorageformat.c
+//
+// The hash spec here MUST stay bit-identical to greengage_tpu/ops/hashing.py
+// (the JAX device implementation): murmur3 fmix32 finalizer over the 32-bit
+// halves of each 64-bit value, FNV-style combine across columns, placement =
+// hash % numsegments. All arithmetic is wrapping uint32.
+//
+// Build: make -C native  (produces libggcodec.so, loaded via ctypes)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Hashing (cdbhash.c analog)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+static const uint32_t GG_HASH_INIT = 0x9e3779b9u;
+static const uint32_t GG_COMBINE_MUL = 0x01000193u;  // FNV prime
+
+uint32_t gg_hash_i64(int64_t v, uint32_t seed) {
+  uint32_t lo = (uint32_t)((uint64_t)v & 0xffffffffu);
+  uint32_t hi = (uint32_t)(((uint64_t)v >> 32) & 0xffffffffu);
+  uint32_t h = seed ^ GG_HASH_INIT;
+  h = fmix32(h ^ lo);
+  h = fmix32(h ^ hi);
+  return h;
+}
+
+uint32_t gg_hash_combine(uint32_t acc, uint32_t h) {
+  return fmix32(acc * GG_COMBINE_MUL ^ h);
+}
+
+// Batch: hash one int64 column into out (uint32), with seed.
+void gg_hash_i64_batch(const int64_t* vals, int64_t n, uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = gg_hash_i64(vals[i], seed);
+}
+
+// Batch combine: acc[i] = combine(acc[i], h[i])
+void gg_hash_combine_batch(uint32_t* acc, const uint32_t* h, int64_t n) {
+  for (int64_t i = 0; i < n; i++) acc[i] = gg_hash_combine(acc[i], h[i]);
+}
+
+// Hash a byte string by folding 8-byte little-endian chunks (zero padded)
+// through hash_i64 + combine. Used for TEXT placement hashes.
+uint32_t gg_hash_bytes(const uint8_t* data, int64_t len, uint32_t seed) {
+  uint32_t acc = seed ^ GG_HASH_INIT;
+  int64_t i = 0;
+  while (i < len) {
+    uint64_t chunk = 0;
+    int64_t take = len - i < 8 ? len - i : 8;
+    memcpy(&chunk, data + i, (size_t)take);
+    acc = gg_hash_combine(acc, gg_hash_i64((int64_t)chunk, 0));
+    i += 8;
+  }
+  acc = gg_hash_combine(acc, gg_hash_i64(len, 0));
+  return acc;
+}
+
+// ---------------------------------------------------------------------------
+// Block frame codec (cdbappendonlystorageformat.c analog)
+//
+// Frame layout (little endian):
+//   u32 magic 0x47474231 ("GGB1")  u32 nrows  u8 compression  u8 encoding
+//   u16 reserved  u64 raw_len  u64 comp_len  u32 crc32(payload)
+// followed by comp_len payload bytes. compression: 0=none 1=zlib. encoding:
+// 0=plain. (zstd frames are produced on the Python side; the native path
+// covers the zlib fast path for bulk ingest.)
+// ---------------------------------------------------------------------------
+
+static const uint32_t GG_BLOCK_MAGIC = 0x47474231u;
+static const int64_t GG_HDR_LEN = 4 + 4 + 1 + 1 + 2 + 8 + 8 + 4;
+
+int64_t gg_block_header_len(void) { return GG_HDR_LEN; }
+
+// Encode src[0..raw_len) into dst (capacity dstcap). Returns total frame
+// bytes written, or -1 on error / insufficient capacity.
+int64_t gg_block_encode(const uint8_t* src, int64_t raw_len, uint32_t nrows,
+                        int32_t compression, int32_t level,
+                        uint8_t* dst, int64_t dstcap) {
+  uint8_t* payload = dst + GG_HDR_LEN;
+  int64_t comp_len;
+  if (compression == 1) {
+    uLongf out_len = (uLongf)(dstcap - GG_HDR_LEN);
+    int zrc = compress2(payload, &out_len, src, (uLong)raw_len, level);
+    comp_len = (zrc == Z_OK) ? (int64_t)out_len : raw_len;
+    if (zrc != Z_OK || comp_len >= raw_len) {  // incompressible or no room: store raw
+      compression = 0;
+      if (dstcap - GG_HDR_LEN < raw_len) return -1;
+      memcpy(payload, src, (size_t)raw_len);
+      comp_len = raw_len;
+    }
+  } else {
+    if (dstcap - GG_HDR_LEN < raw_len) return -1;
+    memcpy(payload, src, (size_t)raw_len);
+    comp_len = raw_len;
+  }
+  uint32_t crc = (uint32_t)crc32(0L, payload, (uInt)comp_len);
+  uint8_t* p = dst;
+  memcpy(p, &GG_BLOCK_MAGIC, 4); p += 4;
+  memcpy(p, &nrows, 4); p += 4;
+  *p++ = (uint8_t)compression;
+  *p++ = 0;  // encoding = plain
+  uint16_t rsv = 0; memcpy(p, &rsv, 2); p += 2;
+  memcpy(p, &raw_len, 8); p += 8;
+  memcpy(p, &comp_len, 8); p += 8;
+  memcpy(p, &crc, 4);
+  return GG_HDR_LEN + comp_len;
+}
+
+// Decode one frame at src into dst (capacity dstcap, must be >= raw_len).
+// Returns raw_len, or -1 bad magic, -2 checksum mismatch, -3 error.
+int64_t gg_block_decode(const uint8_t* src, int64_t srclen, uint8_t* dst,
+                        int64_t dstcap, uint32_t* nrows_out) {
+  if (srclen < GG_HDR_LEN) return -1;
+  uint32_t magic; memcpy(&magic, src, 4);
+  if (magic != GG_BLOCK_MAGIC) return -1;
+  uint32_t nrows; memcpy(&nrows, src + 4, 4);
+  uint8_t compression = src[8];
+  int64_t raw_len, comp_len;
+  memcpy(&raw_len, src + 12, 8);
+  memcpy(&comp_len, src + 20, 8);
+  if (srclen < GG_HDR_LEN + comp_len || dstcap < raw_len) return -3;
+  const uint8_t* payload = src + GG_HDR_LEN;
+  uint32_t crc = (uint32_t)crc32(0L, payload, (uInt)comp_len);
+  uint32_t want; memcpy(&want, src + 28, 4);
+  if (crc != want) return -2;
+  if (compression == 1) {
+    uLongf out_len = (uLongf)dstcap;
+    if (uncompress(dst, &out_len, payload, (uLong)comp_len) != Z_OK) return -3;
+    if ((int64_t)out_len != raw_len) return -3;
+  } else {
+    memcpy(dst, payload, (size_t)raw_len);
+  }
+  if (nrows_out) *nrows_out = nrows;
+  return raw_len;
+}
+
+uint32_t gg_crc32(const uint8_t* data, int64_t len) {
+  return (uint32_t)crc32(0L, data, (uInt)len);
+}
+
+}  // extern "C"

@@ -1,0 +1,25 @@
+"""Test fixture: an 8-device virtual CPU mesh — the demo-cluster analog.
+
+The reference tests multi-node behavior on a single host via
+``make create-demo-cluster`` (gpAux/gpdemo/demo_cluster.sh); we do the same
+with XLA's host-platform device-count override so every sharding/collective
+path runs under pytest without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
