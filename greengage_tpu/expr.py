@@ -1,0 +1,158 @@
+"""Expression IR shared by the binder, planner, and device evaluator.
+
+The reference evaluates expression trees per tuple (ExecQual/ExecProject,
+src/backend/executor/execQual.c); we carry a small typed IR that the device
+evaluator (ops/expr_eval.py) turns into whole-column JAX computations with
+three-valued NULL logic.
+
+String handling: TEXT columns are dictionary codes on device. The binder
+lowers every string operation into either a code comparison (equality against
+a literal present in the dictionary) or a ``Lut`` node — a host-computed
+per-dictionary-entry table (bool for predicates like LIKE, int32 rank for
+ORDER BY, int32 code translation for cross-table equality) gathered on
+device. This keeps arbitrary string semantics off the TPU hot path at
+O(dict_size) host cost per query.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from greengage_tpu import types as T
+
+
+@dataclass(frozen=True)
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class ColRef(Expr):
+    name: str          # unique id assigned by the binder
+    type: T.SqlType
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object      # python scalar in storage representation (DECIMAL: scaled int)
+    type: T.SqlType
+
+    @staticmethod
+    def null(type_: T.SqlType) -> "Literal":
+        return Literal(None, type_)
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str            # + - * / %
+    left: Expr
+    right: Expr
+    type: T.SqlType
+
+
+@dataclass(frozen=True)
+class Cmp(Expr):
+    op: str            # = <> < <= > >=
+    left: Expr
+    right: Expr
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class BoolOp(Expr):
+    op: str            # and | or  (Kleene 3VL)
+    args: tuple[Expr, ...]
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    arg: Expr
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    arg: Expr
+    negate: bool = False
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    whens: tuple[tuple[Expr, Expr], ...]
+    else_: Expr | None
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class Lut(Expr):
+    """table[codes] gather; table is a host numpy array of len(dictionary).
+
+    An out-of-dictionary sentinel row is appended by the builder so code -1
+    (absent literal) can be represented as index len(table)-1.
+    """
+
+    arg: Expr
+    table_id: str       # key into the plan's constant pool
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    arg: Expr
+    values: tuple       # storage-representation scalars
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class Agg(Expr):
+    func: str           # count | count_star | sum | min | max | avg
+    arg: Expr | None
+    distinct: bool
+    type: T.SqlType
+
+
+def agg_result_type(func: str, arg_type: T.SqlType | None) -> T.SqlType:
+    if func in ("count", "count_star"):
+        return T.INT64
+    if func == "avg":
+        # PG returns numeric for int/decimal avg; we use float64 (documented
+        # deviation: avg is inexact, sums remain exact)
+        return T.FLOAT64
+    if func in ("min", "max"):
+        return arg_type
+    if func == "sum":
+        if arg_type.kind is T.Kind.DECIMAL:
+            return arg_type
+        if arg_type.is_integer:
+            return T.INT64
+        return T.FLOAT64
+    raise ValueError(f"unknown aggregate {func}")
+
+
+def walk(e: Expr):
+    yield e
+    for f in (
+        getattr(e, "left", None), getattr(e, "right", None), getattr(e, "arg", None),
+        getattr(e, "else_", None),
+    ):
+        if isinstance(f, Expr):
+            yield from walk(f)
+    for a in getattr(e, "args", ()):
+        yield from walk(a)
+    for c, v in getattr(e, "whens", ()):
+        yield from walk(c)
+        yield from walk(v)
+
+
+def columns_used(e: Expr) -> set[str]:
+    return {n.name for n in walk(e) if isinstance(n, ColRef)}

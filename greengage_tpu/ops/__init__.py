@@ -1,0 +1,1 @@
+from greengage_tpu.ops.batch import Batch  # noqa: F401

@@ -1,0 +1,223 @@
+"""Vectorized hash aggregation — the execHHashagg.c analog, TPU-first.
+
+Instead of a per-tuple spillable hash table (reference:
+src/backend/executor/execHHashagg.c) we build a static power-of-two slot
+table wholly on device:
+
+  1. rows hash their group keys (ops/hashing spec) to a start slot
+  2. P unrolled linear-probe rounds; each round, unresolved rows bid for
+     their current slot with a scatter-min of row index, winners write their
+     actual key values into the table, and every row resolves by *exact*
+     key comparison against the table (null-safe) — no fingerprints, so no
+     collision false-merges, ever
+  3. aggregates reduce with segment_sum/min/max over resolved slots — MXU/
+     VPU-friendly one-pass reductions
+
+Rows that fail to resolve within P probes (table too small / pathological
+clustering) raise an ``overflow`` flag; the executor re-runs at the next
+table-size tier (the recompilation-tier strategy from SURVEY.md §7 "hard
+parts" — the workfile-spill analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from greengage_tpu.ops import hashing
+
+BIG = jnp.iinfo(jnp.int32).max
+
+
+@dataclass
+class KeySpec:
+    values: jnp.ndarray
+    valid: jnp.ndarray | None
+    type: object            # T.SqlType
+    hash_lut: jnp.ndarray | None = None  # TEXT: per-dict-entry hashes
+
+
+@dataclass
+class AggSpec:
+    name: str
+    func: str               # count_star | count | sum | min | max | avg
+    values: jnp.ndarray | None
+    valid: jnp.ndarray | None
+    # DECIMAL inputs are scaled int64; avg must descale its float64 result
+    # by 10^scale (sum/min/max stay in scaled-int domain, declared DECIMAL).
+    decimal_scale: int = 0
+
+
+def _null_eq(a, av, b, bv):
+    """Grouping equality: NULL == NULL (SQL GROUP BY semantics)."""
+    eq = a == b
+    if av is None and bv is None:
+        return eq
+    av_ = av if av is not None else jnp.ones_like(eq)
+    bv_ = bv if bv is not None else jnp.ones_like(eq)
+    return (av_ & bv_ & eq) | (~av_ & ~bv_)
+
+
+def build_slot_table(keys: list[KeySpec], sel, table_size: int, num_probes: int):
+    """Assign each selected row a slot; rows with equal keys share a slot.
+
+    Returns (final_slot int32 [n] with ``table_size`` for dead/unresolved
+    rows, table_keys, table_key_valids, used bool[M], overflow bool scalar).
+    """
+    M = table_size
+    assert M & (M - 1) == 0, "table size must be a power of two"
+    n = sel.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+
+    col_hashes = [
+        hashing.column_hash(k.values, k.valid, k.type, text_lut=k.hash_lut) for k in keys
+    ]
+    h = hashing.row_hash(col_hashes)
+    slot, step = probe_sequence(h, M)
+
+    active = sel
+    final_slot = jnp.full((n,), M, dtype=jnp.int32)
+    used = jnp.zeros((M,), dtype=bool)
+    tkeys = [jnp.zeros((M,), dtype=k.values.dtype) for k in keys]
+    tvalids = [None if k.valid is None else jnp.zeros((M,), dtype=bool) for k in keys]
+
+    for _ in range(num_probes):
+        bids = jnp.full((M,), BIG, dtype=jnp.int32).at[slot].min(
+            jnp.where(active, row_idx, BIG)
+        )
+        newly = (~used) & (bids < BIG)
+        winner = jnp.clip(bids, 0, n - 1)
+        for i, k in enumerate(keys):
+            tkeys[i] = jnp.where(newly, k.values[winner], tkeys[i])
+            if tvalids[i] is not None:
+                tvalids[i] = jnp.where(newly, k.valid[winner], tvalids[i])
+        used = used | newly
+        # exact match against table contents at my current slot
+        match = active & used[slot]
+        for i, k in enumerate(keys):
+            tv = tvalids[i][slot] if tvalids[i] is not None else None
+            match = match & _null_eq(k.values, k.valid, tkeys[i][slot], tv)
+        final_slot = jnp.where(match, slot, final_slot)
+        active = active & ~match
+        slot = (slot + step) & (M - 1)
+
+    return final_slot, tkeys, tvalids, used, jnp.any(active)
+
+
+def probe_sequence(h, M: int):
+    """Double hashing: start slot from h, odd step from a derived second
+    hash (odd steps visit every slot of a power-of-two table). Keeps probe
+    chains ≈ 1/(1-load) instead of linear probing's clustered runs."""
+    from greengage_tpu.ops.hashing import _fmix32
+
+    slot = (h & jnp.uint32(M - 1)).astype(jnp.int32)
+    h2 = _fmix32(h ^ jnp.uint32(0x85EBCA6B))
+    step = ((h2 & jnp.uint32(M - 1)) | jnp.uint32(1)).astype(jnp.int32)
+    return slot, step
+
+
+def _seg_sum(vals, slots, M):
+    return jnp.zeros((M + 1,), dtype=vals.dtype).at[slots].add(vals)[:M]
+
+
+def aggregate(slots, M: int, aggs: list[AggSpec], sel):
+    """Compute aggregates per slot. Returns ({name: values}, {name: valid})."""
+    out_vals: dict[str, jnp.ndarray] = {}
+    out_valid: dict[str, jnp.ndarray] = {}
+    # memoize per-group live counts per distinct valid mask (shared by
+    # count/sum-validity/avg/min/max for the same column's mask)
+    counts_cache: dict[int, jnp.ndarray] = {}
+
+    def live_valid(spec):
+        v = sel
+        if spec.valid is not None:
+            v = v & spec.valid
+        return v
+
+    def live_count(spec):
+        key = None if spec is None or spec.valid is None else id(spec.valid)
+        if key not in counts_cache:
+            lv = sel if spec is None else live_valid(spec)
+            counts_cache[key] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M)
+        return counts_cache[key]
+
+    group_count = live_count(None)
+
+    for spec in aggs:
+        if spec.func == "count_star":
+            out_vals[spec.name] = group_count
+            out_valid[spec.name] = None
+            continue
+        lv = live_valid(spec)
+        if spec.func == "count":
+            out_vals[spec.name] = live_count(spec)
+            out_valid[spec.name] = None
+            continue
+        vals = spec.values
+        if spec.func in ("sum", "avg"):
+            acc_dtype = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
+            s = _seg_sum(jnp.where(lv, vals.astype(acc_dtype), acc_dtype(0)), slots, M)
+            cnt = live_count(spec)
+            if spec.func == "sum":
+                out_vals[spec.name] = s
+                out_valid[spec.name] = cnt > 0   # SQL: sum of no rows is NULL
+            else:
+                denom = jnp.where(cnt == 0, jnp.int64(1), cnt).astype(jnp.float64)
+                avg = s.astype(jnp.float64) / denom
+                if spec.decimal_scale:
+                    avg = avg / (10.0 ** spec.decimal_scale)
+                out_vals[spec.name] = avg
+                out_valid[spec.name] = cnt > 0
+            continue
+        if spec.func in ("min", "max"):
+            if vals.dtype.kind == "f":
+                ident = jnp.array(jnp.inf if spec.func == "min" else -jnp.inf, vals.dtype)
+            else:
+                info = jnp.iinfo(vals.dtype)
+                ident = jnp.array(info.max if spec.func == "min" else info.min, vals.dtype)
+            filled = jnp.where(lv, vals, ident)
+            tbl = jnp.full((M + 1,), ident, dtype=vals.dtype)
+            tbl = tbl.at[slots].min(filled) if spec.func == "min" else tbl.at[slots].max(filled)
+            out_vals[spec.name] = tbl[:M]
+            out_valid[spec.name] = live_count(spec) > 0
+            continue
+        raise NotImplementedError(spec.func)
+    return out_vals, out_valid
+
+
+def merge_partial(slots, M, partial_vals, partial_valids, funcs, sel):
+    """Final phase of two-phase aggregation: combine partial states that were
+    redistributed by group key (cdbgroup.c two-stage agg analog).
+
+    partial state per original agg: count -> sum of counts; sum -> sum of
+    sums; min/max -> min/max of partials; avg carries (sum, count) pairs —
+    handled by the compiler as two partial columns.
+    """
+    out_vals, out_valid = {}, {}
+    for name, func in funcs.items():
+        vals = partial_vals[name]
+        pv = partial_valids.get(name)
+        lv = sel if pv is None else sel & pv
+        if func in ("count", "count_star", "sum"):
+            acc_dtype = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
+            s = _seg_sum(jnp.where(lv, vals.astype(acc_dtype), acc_dtype(0)), slots, M)
+            out_vals[name] = s if func != "count" and func != "count_star" else s.astype(jnp.int64)
+            if func == "sum":
+                out_valid[name] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M) > 0
+            else:
+                out_valid[name] = None
+        elif func in ("min", "max"):
+            if vals.dtype.kind == "f":
+                ident = jnp.array(jnp.inf if func == "min" else -jnp.inf, vals.dtype)
+            else:
+                info = jnp.iinfo(vals.dtype)
+                ident = jnp.array(info.max if func == "min" else info.min, vals.dtype)
+            filled = jnp.where(lv, vals, ident)
+            tbl = jnp.full((M + 1,), ident, dtype=vals.dtype)
+            tbl = tbl.at[slots].min(filled) if func == "min" else tbl.at[slots].max(filled)
+            out_vals[name] = tbl[:M]
+            out_valid[name] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M) > 0
+        else:
+            raise NotImplementedError(func)
+    return out_vals, out_valid

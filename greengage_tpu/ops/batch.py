@@ -1,0 +1,78 @@
+"""Batch: the columnar unit flowing between operators (TupleTableSlot analog).
+
+Unlike the reference's per-tuple slots (src/include/executor/tuptable.h) a
+Batch is a fixed-capacity set of whole columns plus
+
+- ``valids``: per-column NULL masks (absent = all valid)
+- ``sel``: the selection mask — rows logically alive. Filters narrow ``sel``
+  instead of compacting, keeping shapes static for XLA (the vectorized
+  ExecQual). Operators that must materialize cardinality (agg, join, motion)
+  consume ``sel`` directly.
+
+Registered as a JAX pytree so whole plans trace through jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Batch:
+    cols: dict[str, jax.Array]
+    valids: dict[str, jax.Array] = field(default_factory=dict)
+    sel: jax.Array | None = None   # bool[capacity]; None = all rows live
+
+    @property
+    def capacity(self) -> int:
+        for a in self.cols.values():
+            return int(a.shape[0])
+        return 0
+
+    def selection(self) -> jax.Array:
+        if self.sel is None:
+            return jnp.ones((self.capacity,), dtype=bool)
+        return self.sel
+
+    def valid(self, name: str) -> jax.Array:
+        v = self.valids.get(name)
+        if v is None:
+            return jnp.ones((self.capacity,), dtype=bool)
+        return v
+
+    def column(self, name: str) -> jax.Array:
+        return self.cols[name]
+
+    def with_sel(self, sel: jax.Array) -> "Batch":
+        return Batch(dict(self.cols), dict(self.valids), sel)
+
+    def project(self, names: list[str]) -> "Batch":
+        return Batch(
+            {n: self.cols[n] for n in names},
+            {n: self.valids[n] for n in names if n in self.valids},
+            self.sel,
+        )
+
+    def num_live(self) -> jax.Array:
+        return jnp.sum(self.selection())
+
+
+def _flatten(b: Batch):
+    ck = sorted(b.cols)
+    vk = sorted(b.valids)
+    children = [b.cols[k] for k in ck] + [b.valids[k] for k in vk] + [b.sel]
+    return children, (tuple(ck), tuple(vk))
+
+
+def _unflatten(aux, children):
+    ck, vk = aux
+    cols = dict(zip(ck, children[: len(ck)]))
+    valids = dict(zip(vk, children[len(ck) : len(ck) + len(vk)]))
+    sel = children[len(ck) + len(vk)]
+    return Batch(cols, valids, sel)
+
+
+jax.tree_util.register_pytree_node(Batch, _flatten, _unflatten)

@@ -1,0 +1,310 @@
+"""Device expression evaluator: typed Expr IR -> whole-column JAX ops.
+
+The vectorized ExecQual/ExecProject (reference: src/backend/executor/
+execQual.c). Every node evaluates to ``(values, valid|None)`` where valid is
+the SQL NULL mask; comparisons/boolean ops follow Kleene three-valued logic.
+DECIMAL arithmetic is exact scaled-int64: +/- align scales, * adds scales,
+/ computes in float64 and rounds half-up back to the result scale.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.ops.batch import Batch
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _pow10(k: int):
+    return jnp.int64(10 ** k)
+
+
+def _rescale(vals, from_scale: int, to_scale: int):
+    if from_scale == to_scale:
+        return vals
+    if to_scale > from_scale:
+        return vals * _pow10(to_scale - from_scale)
+    # round half away from zero (PG numeric rounding)
+    p = _pow10(from_scale - to_scale)
+    half = p // 2
+    return jnp.where(vals >= 0, (vals + half) // p, -((-vals + half) // p))
+
+
+def _lit_array(lit: E.Literal, n: int):
+    t = lit.type
+    if lit.value is None:
+        return jnp.zeros((n,), dtype=t.np_dtype), jnp.zeros((n,), dtype=bool)
+    v = lit.value
+    return jnp.full((n,), v, dtype=t.np_dtype), None
+
+
+def _num_align(lt: T.SqlType, lv, rt: T.SqlType, rv, out: T.SqlType):
+    """Align two numeric operands for + - * / under the result type."""
+    if out.kind is T.Kind.FLOAT64:
+        def to_f(t, v):
+            if t.kind is T.Kind.DECIMAL:
+                return v.astype(jnp.float64) / (10.0 ** t.scale)
+            return v.astype(jnp.float64)
+        return to_f(lt, lv), to_f(rt, rv)
+    if out.kind is T.Kind.DECIMAL:
+        def to_d(t, v):
+            s = t.scale if t.kind is T.Kind.DECIMAL else 0
+            return v.astype(jnp.int64), s
+        return to_d(lt, lv), to_d(rt, rv)
+    return lv.astype(out.np_dtype), rv.astype(out.np_dtype)
+
+
+class Evaluator:
+    """Evaluates Expr trees over a Batch. ``consts`` is the plan's constant
+    pool: host numpy arrays (LUTs) placed on device by the compiler."""
+
+    def __init__(self, batch: Batch, consts: dict[str, jnp.ndarray] | None = None):
+        self.batch = batch
+        self.consts = consts or {}
+        self.n = batch.capacity
+
+    # ---- public --------------------------------------------------------
+    def value(self, e: E.Expr):
+        """-> (values, valid|None)"""
+        m = getattr(self, "_eval_" + type(e).__name__.lower(), None)
+        if m is None:
+            raise NotImplementedError(f"eval {type(e).__name__}")
+        return m(e)
+
+    def predicate(self, e: E.Expr):
+        """WHERE semantics: NULL -> false. Returns bool array."""
+        v, valid = self.value(e)
+        v = v.astype(bool)
+        if valid is not None:
+            v = v & valid
+        return v
+
+    # ---- leaves --------------------------------------------------------
+    def _eval_colref(self, e: E.ColRef):
+        return self.batch.cols[e.name], self.batch.valids.get(e.name)
+
+    def _eval_literal(self, e: E.Literal):
+        return _lit_array(e, self.n)
+
+    # ---- arithmetic ----------------------------------------------------
+    def _eval_binop(self, e: E.BinOp):
+        lv, lval = self.value(e.left)
+        rv, rval = self.value(e.right)
+        lt = _expr_type(e.left)
+        rt = _expr_type(e.right)
+        out = e.type
+        valid = _and_valid(lval, rval)
+
+        # date arithmetic
+        if lt.kind is T.Kind.DATE and rt.kind is T.Kind.DATE and e.op == "-":
+            return (lv.astype(jnp.int32) - rv.astype(jnp.int32)), valid
+        if lt.kind is T.Kind.DATE:
+            r = rv.astype(jnp.int32)
+            return (lv + r if e.op == "+" else lv - r), valid
+
+        if out.kind is T.Kind.DECIMAL:
+            (la, ls), (ra, rs) = _num_align(lt, lv, rt, rv, out)
+            if e.op in ("+", "-"):
+                s = max(ls, rs)
+                la, ra = _rescale(la, ls, s), _rescale(ra, rs, s)
+                res = la + ra if e.op == "+" else la - ra
+                return _rescale(res, s, out.scale), valid
+            if e.op == "*":
+                res = la * ra  # scale ls+rs
+                return _rescale(res, ls + rs, out.scale), valid
+            if e.op == "/":
+                q = (la.astype(jnp.float64) / (10.0 ** ls)) / jnp.where(
+                    ra == 0, jnp.float64(1), ra.astype(jnp.float64) / (10.0 ** rs))
+                res = jnp.round(q * (10.0 ** out.scale)).astype(jnp.int64)
+                if valid is None:
+                    valid = ra != 0
+                else:
+                    valid = valid & (ra != 0)
+                return res, valid
+            raise NotImplementedError(e.op)
+
+        la, ra = _num_align(lt, lv, rt, rv, out)
+        if e.op == "+":
+            return la + ra, valid
+        if e.op == "-":
+            return la - ra, valid
+        if e.op == "*":
+            return la * ra, valid
+        if e.op == "/":
+            if out.kind is T.Kind.FLOAT64:
+                res = la / jnp.where(ra == 0.0, 1.0, ra)
+            else:  # integer division truncating toward zero (PG)
+                safe = jnp.where(ra == 0, 1, ra)
+                q = jnp.abs(la) // jnp.abs(safe)
+                res = (jnp.where((la < 0) ^ (safe < 0), -q, q)).astype(out.np_dtype)
+            zero = ra == 0
+            valid = zero_invalid(valid, zero)
+            return res, valid
+        if e.op == "%":
+            safe = jnp.where(ra == 0, 1, ra)
+            res = la - (jnp.abs(la) // jnp.abs(safe)) * jnp.sign(la) * jnp.abs(safe)
+            valid = zero_invalid(valid, ra == 0)
+            return res.astype(out.np_dtype), valid
+        raise NotImplementedError(e.op)
+
+    # ---- comparison ----------------------------------------------------
+    def _eval_cmp(self, e: E.Cmp):
+        lv, lval = self.value(e.left)
+        rv, rval = self.value(e.right)
+        lt, rt = _expr_type(e.left), _expr_type(e.right)
+        la, ra = _cmp_align(lt, lv, rt, rv)
+        res = {
+            "=": lambda: la == ra,
+            "<>": lambda: la != ra,
+            "<": lambda: la < ra,
+            "<=": lambda: la <= ra,
+            ">": lambda: la > ra,
+            ">=": lambda: la >= ra,
+        }[e.op]()
+        return res, _and_valid(lval, rval)
+
+    # ---- boolean (Kleene 3VL) -----------------------------------------
+    def _eval_boolop(self, e: E.BoolOp):
+        vals, valids = [], []
+        for a in e.args:
+            v, val = self.value(a)
+            vals.append(v.astype(bool))
+            valids.append(val)
+        if e.op == "and":
+            # false if any false; null if no false but some null
+            acc_v, acc_val = vals[0], valids[0]
+            for v, val in zip(vals[1:], valids[1:]):
+                known_false = (~v & _or_true(val)) | (~acc_v & _or_true(acc_val))
+                both_valid = _and_valid(acc_val, val)
+                acc_v = acc_v & v
+                acc_val = known_false | both_valid if both_valid is not None else None
+                if both_valid is None:
+                    acc_val = None
+            return acc_v, acc_val
+        else:
+            acc_v, acc_val = vals[0], valids[0]
+            for v, val in zip(vals[1:], valids[1:]):
+                known_true = (v & _or_true(val)) | (acc_v & _or_true(acc_val))
+                both_valid = _and_valid(acc_val, val)
+                acc_v = acc_v | v
+                acc_val = known_true | both_valid if both_valid is not None else None
+                if both_valid is None:
+                    acc_val = None
+            return acc_v, acc_val
+
+    def _eval_not(self, e: E.Not):
+        v, val = self.value(e.arg)
+        return ~v.astype(bool), val
+
+    def _eval_isnull(self, e: E.IsNull):
+        _, val = self.value(e.arg)
+        if val is None:
+            res = jnp.zeros((self.n,), dtype=bool)
+        else:
+            res = ~val
+        if e.negate:
+            res = ~res
+        return res, None
+
+    def _eval_case(self, e: E.Case):
+        n = self.n
+        out_t = e.type
+        res = jnp.zeros((n,), dtype=out_t.np_dtype)
+        res_valid = jnp.zeros((n,), dtype=bool)
+        decided = jnp.zeros((n,), dtype=bool)
+        for cond, val in e.whens:
+            c = Evaluator.predicate(self, cond)
+            take = c & ~decided
+            v, vval = self.value(val)
+            v = _cast_to(v, _expr_type(val), out_t)
+            res = jnp.where(take, v, res)
+            res_valid = jnp.where(take, jnp.ones((n,), bool) if vval is None else vval, res_valid)
+            decided = decided | c
+        if e.else_ is not None:
+            v, vval = self.value(e.else_)
+            v = _cast_to(v, _expr_type(e.else_), out_t)
+            res = jnp.where(decided, res, v)
+            res_valid = jnp.where(decided, res_valid,
+                                  jnp.ones((n,), bool) if vval is None else vval)
+        return res, res_valid
+
+    def _eval_cast(self, e: E.Cast):
+        v, val = self.value(e.arg)
+        return _cast_to(v, _expr_type(e.arg), e.type), val
+
+    def _eval_lut(self, e: E.Lut):
+        codes, val = self.value(e.arg)
+        table = self.consts[e.table_id]
+        # code -1 (literal absent from dictionary) indexes the sentinel row
+        idx = jnp.where(codes < 0, table.shape[0] - 1, codes)
+        return table[idx], val
+
+    def _eval_inlist(self, e: E.InList):
+        v, val = self.value(e.arg)
+        res = jnp.zeros((self.n,), dtype=bool)
+        for c in e.values:
+            res = res | (v == c)
+        return res, val
+
+
+def _or_true(valid):
+    return valid if valid is not None else True
+
+
+def zero_invalid(valid, zero):
+    """Division by zero yields NULL (deviation: PG raises; MPP-friendly NULL
+    keeps the kernel branch-free — the session layer can check and raise)."""
+    nz = ~zero
+    return nz if valid is None else valid & nz
+
+
+def _expr_type(e: E.Expr) -> T.SqlType:
+    return e.type
+
+
+def _cmp_align(lt, lv, rt, rv):
+    if lt.kind is T.Kind.TEXT and rt.kind is T.Kind.TEXT:
+        return lv, rv  # code equality only; binder guarantees same dictionary
+    if lt.kind is T.Kind.DECIMAL or rt.kind is T.Kind.DECIMAL:
+        ls = lt.scale if lt.kind is T.Kind.DECIMAL else 0
+        rs = rt.scale if rt.kind is T.Kind.DECIMAL else 0
+        s = max(ls, rs)
+        la = _rescale(lv.astype(jnp.int64), ls, s)
+        ra = _rescale(rv.astype(jnp.int64), rs, s)
+        return la, ra
+    if lt.kind is T.Kind.FLOAT64 or rt.kind is T.Kind.FLOAT64:
+        return lv.astype(jnp.float64), rv.astype(jnp.float64)
+    return lv, rv
+
+
+def _cast_to(v, from_t: T.SqlType, to_t: T.SqlType):
+    if from_t == to_t:
+        return v
+    if to_t.kind is T.Kind.DECIMAL:
+        if from_t.kind is T.Kind.DECIMAL:
+            return _rescale(v.astype(jnp.int64), from_t.scale, to_t.scale)
+        if from_t.kind is T.Kind.FLOAT64:
+            return jnp.round(v * (10.0 ** to_t.scale)).astype(jnp.int64)
+        return v.astype(jnp.int64) * _pow10(to_t.scale)
+    if to_t.kind is T.Kind.FLOAT64:
+        if from_t.kind is T.Kind.DECIMAL:
+            return v.astype(jnp.float64) / (10.0 ** from_t.scale)
+        return v.astype(jnp.float64)
+    if to_t.is_integer:
+        if from_t.kind is T.Kind.DECIMAL:
+            return _rescale(v, from_t.scale, 0).astype(to_t.np_dtype)
+        return v.astype(to_t.np_dtype)
+    if to_t.kind is T.Kind.BOOL:
+        return v.astype(bool)
+    if to_t.kind is T.Kind.DATE and from_t.is_integer:
+        return v.astype(jnp.int32)
+    raise NotImplementedError(f"cast {from_t} -> {to_t}")

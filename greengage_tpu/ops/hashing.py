@@ -1,0 +1,83 @@
+"""On-device distribution/group hashing — JAX mirror of native/ggcodec.cpp.
+
+MUST remain bit-identical to greengage_tpu/storage/native.py (the spec's
+reference implementation, itself mirrored by the C++ codec): fmix32 over the
+32-bit halves of each 64-bit value, FNV-combine across columns, NULL column
+contributes hash 0, placement = row_hash % numsegments. Tested against the
+host implementation in tests/test_ops.py.
+
+Reference parity: src/backend/cdb/cdbhash.c (makeCdbHash/cdbhash/
+cdbhashreduce). We use modulo reduction everywhere (the reference's
+"legacy mod" mode, cdblegacyhash.c) because jump-consistent-hash's
+data-dependent loop is hostile to XLA; expansion therefore redistributes
+fully (ALTER TABLE EXPAND TABLE analog always rewrites).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from greengage_tpu import types as T
+
+HASH_INIT = 0x9E3779B9
+COMBINE_MUL = 0x01000193
+
+
+def _fmix32(h):
+    h = h.astype(jnp.uint32)
+    h ^= h >> 16
+    h = h * jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h = h * jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def hash_i64(vals, seed: int = 0):
+    """uint32 hash of an int64-representable array."""
+    u = vals.astype(jnp.int64).view(jnp.uint64)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+    h = jnp.uint32(seed) ^ jnp.uint32(HASH_INIT)
+    h = _fmix32(h ^ lo)
+    h = _fmix32(h ^ hi)
+    return h
+
+
+def hash_combine(acc, h):
+    return _fmix32(acc.astype(jnp.uint32) * jnp.uint32(COMBINE_MUL) ^ h.astype(jnp.uint32))
+
+
+def _canon_f64(arr):
+    """hashfloat8 parity: -0.0 -> 0.0, all NaNs -> one pattern."""
+    arr = jnp.where(arr == 0.0, 0.0, arr)
+    arr = jnp.where(jnp.isnan(arr), jnp.float64(jnp.nan), arr)
+    return arr
+
+
+def column_hash(arr, valid, type_: T.SqlType, seed: int = 0, text_lut=None):
+    """Per-column uint32 hash; NULL rows -> 0. TEXT uses the dictionary
+    hash LUT (host-precomputed, one extra sentinel row for code -1)."""
+    if type_.kind is T.Kind.TEXT:
+        if text_lut is None:
+            raise ValueError("TEXT hashing requires the dictionary hash LUT")
+        h = text_lut[arr]
+    elif type_.kind is T.Kind.FLOAT64:
+        h = hash_i64(_canon_f64(arr).view(jnp.int64), seed)
+    else:
+        h = hash_i64(arr, seed)
+    if valid is not None:
+        h = jnp.where(valid, h, jnp.uint32(0))
+    return h
+
+
+def row_hash(col_hashes) -> jnp.ndarray:
+    """Combine per-column hashes: acc = h0; acc = combine(acc, hi)."""
+    acc = col_hashes[0]
+    for h in col_hashes[1:]:
+        acc = hash_combine(acc, h)
+    return acc
+
+
+def segment_of(rowhash, numsegments: int):
+    return (rowhash % jnp.uint32(numsegments)).astype(jnp.int32)

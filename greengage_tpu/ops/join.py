@@ -1,0 +1,138 @@
+"""Vectorized hash join — nodeHashjoin.c reimagined for static shapes.
+
+Build side inserts into the same exact-key slot table as ops/agg.py; probe
+side walks the identical probe sequence and matches by exact key equality.
+Output keeps the probe side's capacity: each probe row gains a ``matched``
+flag and a gathered build-row index, so inner/left/semi/anti joins are all
+selection-mask updates plus gathers — no dynamic-size compaction.
+
+Duplicate build keys resolve to the same slot; the winner's row index is
+stored and every non-winner build row reports ``dup`` (duplicate flag). The
+planner only routes unique-key builds here (PK-FK joins, the dominant case);
+duplicate builds use broadcast nested-loop fallback until a multi-match
+kernel lands. Unresolved build rows (> num_probes chain) raise ``overflow``
+for the executor's table-size retry tier.
+
+SQL NULL semantics: a NULL join key equals nothing, so NULL-keyed rows on
+either side simply never match (unlike GROUP BY's null-merging equality).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from greengage_tpu.ops import hashing
+from greengage_tpu.ops.agg import BIG, KeySpec
+from greengage_tpu.ops.agg import probe_sequence as agg_probe_sequence
+
+
+@dataclass
+class BuildTable:
+    slot_keys: list[jnp.ndarray]
+    slot_key_valids: list[jnp.ndarray | None]
+    slot_row: jnp.ndarray      # build row index per slot
+    used: jnp.ndarray
+    overflow: jnp.ndarray      # bool scalar
+    dup: jnp.ndarray           # bool scalar: build had duplicate keys
+    size: int
+
+
+def _key_hash(keys: list[KeySpec]):
+    return hashing.row_hash(
+        [hashing.column_hash(k.values, k.valid, k.type, text_lut=k.hash_lut) for k in keys]
+    )
+
+
+def _strict_eq(a, av, b, bv):
+    """Join equality: NULL matches nothing."""
+    eq = a == b
+    if av is not None:
+        eq = eq & av
+    if bv is not None:
+        eq = eq & bv
+    return eq
+
+
+def build(keys: list[KeySpec], sel, table_size: int, num_probes: int) -> BuildTable:
+    M = table_size
+    assert M & (M - 1) == 0
+    n = sel.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    # NULL keys never participate (strict equality): drop them from the build
+    for k in keys:
+        if k.valid is not None:
+            sel = sel & k.valid
+    h = _key_hash(keys)
+    slot, step = agg_probe_sequence(h, M)
+
+    active = sel
+    used = jnp.zeros((M,), dtype=bool)
+    slot_row = jnp.zeros((M,), dtype=jnp.int32)
+    tkeys = [jnp.zeros((M,), dtype=k.values.dtype) for k in keys]
+    dup = jnp.zeros((), dtype=bool)
+
+    for _ in range(num_probes):
+        bids = jnp.full((M,), BIG, dtype=jnp.int32).at[slot].min(
+            jnp.where(active, row_idx, BIG)
+        )
+        newly = (~used) & (bids < BIG)
+        winner = jnp.clip(bids, 0, n - 1)
+        for i, k in enumerate(keys):
+            tkeys[i] = jnp.where(newly, k.values[winner], tkeys[i])
+        slot_row = jnp.where(newly, winner, slot_row)
+        used = used | newly
+        match = active & used[slot]
+        for i, k in enumerate(keys):
+            match = match & (k.values == tkeys[i][slot])
+        # a build row matching a slot stored for a *different* row = duplicate key
+        dup = dup | jnp.any(match & (slot_row[slot] != row_idx))
+        active = active & ~match
+        slot = (slot + step) & (M - 1)
+
+    return BuildTable(
+        slot_keys=tkeys,
+        slot_key_valids=[None] * len(keys),
+        slot_row=slot_row,
+        used=used,
+        overflow=jnp.any(active),
+        dup=dup,
+        size=M,
+    )
+
+
+def probe(table: BuildTable, keys: list[KeySpec], sel, num_probes: int):
+    """-> (matched bool[n], build_row int32[n]) over the probe batch."""
+    M = table.size
+    strict_sel = sel
+    for k in keys:
+        if k.valid is not None:
+            strict_sel = strict_sel & k.valid
+    h = _key_hash(keys)
+    slot, step = agg_probe_sequence(h, M)
+
+    matched = jnp.zeros_like(sel)
+    build_row = jnp.zeros(sel.shape, dtype=jnp.int32)
+    active = strict_sel
+    for _ in range(num_probes):
+        hit = active & table.used[slot]
+        for i, k in enumerate(keys):
+            hit = hit & (k.values == table.slot_keys[i][slot])
+        matched = matched | hit
+        build_row = jnp.where(hit, table.slot_row[slot], build_row)
+        active = active & ~hit
+        slot = (slot + step) & (M - 1)
+    return matched, build_row
+
+
+def gather_build_columns(build_cols: dict, build_valids: dict, build_row, matched):
+    """Pull build-side columns across to probe-side capacity. Unmatched rows
+    get valid=False (supports LEFT OUTER null-extension for free)."""
+    out_cols, out_valids = {}, {}
+    for name, arr in build_cols.items():
+        out_cols[name] = arr[build_row]
+        v = build_valids.get(name)
+        gv = v[build_row] if v is not None else jnp.ones_like(matched)
+        out_valids[name] = gv & matched
+    return out_cols, out_valids

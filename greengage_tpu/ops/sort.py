@@ -1,0 +1,94 @@
+"""Multi-key sort via lexicographic lax.sort — the tuplesort analog.
+
+Every ORDER BY key is encoded into an order-preserving uint64:
+
+  int-like  : x XOR sign-bit                         (two's complement flip)
+  float64   : IEEE trick (negatives bit-inverted)
+  text      : dictionary-rank LUT gather (int32 rank), host-precomputed —
+              code order is first-seen order, NOT collation order, so the
+              binder always routes text keys through a rank Lut
+  DESC      : bitwise NOT of the encoding
+  NULLs     : a separate leading uint8 operand per nullable key orders the
+              null group before/after values without sacrificing key bits
+              (PG defaults: NULLS LAST for ASC, NULLS FIRST for DESC)
+
+Dead rows (sel = false) sort to the end via a leading liveness key, so the
+output batch keeps static capacity with survivors compacted to the front —
+which is what LIMIT slicing and host gather want.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from greengage_tpu import types as T
+
+
+@dataclass
+class SortKey:
+    values: jnp.ndarray
+    valid: jnp.ndarray | None
+    type: object                      # T.SqlType
+    desc: bool = False
+    nulls_first: bool | None = None   # None = PG default by direction
+    rank_lut: jnp.ndarray | None = None  # TEXT collation ranks
+
+
+def _order_encode(k: SortKey) -> list[jnp.ndarray]:
+    """-> sort operands for this key: [null_order?, encoded_values]."""
+    t: T.SqlType = k.type
+    v = k.values
+    if t.kind is T.Kind.TEXT:
+        if k.rank_lut is None:
+            raise ValueError("text sort key requires rank LUT")
+        idx = jnp.where(v < 0, k.rank_lut.shape[0] - 1, v)
+        v = k.rank_lut[idx]
+    if t.kind is T.Kind.FLOAT64:
+        bits = v.view(jnp.uint64)
+        sign = bits >> jnp.uint64(63)
+        enc = jnp.where(sign == 1, ~bits, bits | jnp.uint64(1) << jnp.uint64(63))
+    else:
+        enc = v.astype(jnp.int64).view(jnp.uint64) ^ (jnp.uint64(1) << jnp.uint64(63))
+    if k.desc:
+        enc = ~enc
+    ops = [enc]
+    if k.valid is not None:
+        nulls_first = k.nulls_first if k.nulls_first is not None else k.desc
+        null_pos = jnp.uint8(0) if nulls_first else jnp.uint8(1)
+        ops.insert(0, jnp.where(k.valid, jnp.uint8(1) - null_pos, null_pos))
+        # neutralize the value operand for null rows so ties are deterministic
+        ops[1] = jnp.where(k.valid, enc, jnp.uint64(0))
+    return ops
+
+
+def sort_batch(keys: list[SortKey], sel, capacity: int):
+    """-> (perm int32[capacity], sel_sorted bool[capacity]).
+
+    perm is the gather permutation: out_col = col[perm]. Stable on ties
+    (row index is the final operand).
+    """
+    dead = (~sel).astype(jnp.uint8)        # live rows first
+    operands = [dead]
+    for k in keys:
+        operands.extend(_order_encode(k))
+    operands.append(jnp.arange(capacity, dtype=jnp.int32))
+    sorted_ops = lax.sort(tuple(operands), num_keys=len(operands))
+    perm = sorted_ops[-1]
+    sel_sorted = sorted_ops[0] == 0
+    return perm, sel_sorted
+
+
+def apply_perm(cols: dict, valids: dict, perm):
+    out_c = {n: a[perm] for n, a in cols.items()}
+    out_v = {n: (a[perm] if a is not None else None) for n, a in valids.items()}
+    return out_c, out_v
+
+
+def limit(cols: dict, valids: dict, sel, k: int):
+    """Static LIMIT after a sort (rows already compacted to the front)."""
+    out_c = {n: a[:k] for n, a in cols.items()}
+    out_v = {n: (a[:k] if a is not None else None) for n, a in valids.items()}
+    return out_c, out_v, sel[:k]

@@ -1,0 +1,1 @@
+from greengage_tpu.parallel.mesh import SEG_AXIS, make_mesh  # noqa: F401

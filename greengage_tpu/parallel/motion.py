@@ -1,0 +1,82 @@
+"""Motion — exchange operators as XLA collectives (the data plane).
+
+Reference parity (src/backend/cdb/motion/, nodeMotion.c, cdbmutate.c:396):
+
+  Redistribute Motion  -> lax.all_to_all over the "seg" axis
+  Broadcast Motion     -> lax.all_gather (tiled)
+  Gather Motion        -> device->host gather outside the compiled program
+
+Where the reference streams tuples over reliable-UDP with its own flow
+control (ic_udpifc.c), we exchange fixed-capacity row buckets over ICI and
+let XLA schedule/overlap the collective. Static shapes demand a per-
+destination capacity; skew beyond it sets an ``overflow`` flag and the
+executor re-runs at a bigger capacity tier (the flow-control analog).
+
+These functions run INSIDE shard_map: every array argument is the local
+segment's shard.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from greengage_tpu.parallel.mesh import SEG_AXIS
+
+
+def _bucketize(arrs: dict, present, dest, nseg: int, capacity: int):
+    """Pack rows into per-destination buckets [nseg * capacity].
+
+    Rows are ranked within their destination via a stable sort by dest;
+    bucket index = dest * capacity + rank. Returns (buckets dict,
+    present_buckets, overflow flag).
+    """
+    n = present.shape[0]
+    dest = jnp.where(present, dest, nseg)  # dead rows -> overflow bucket
+    counts = jnp.zeros((nseg + 1,), dtype=jnp.int32).at[dest].add(1)
+    overflow = jnp.any(counts[:nseg] > capacity)
+    start = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    dsorted = dest[order]
+    rank = jnp.arange(n, dtype=jnp.int32) - start[dsorted]
+    # clamp ranks so skewed rows drop instead of corrupting other buckets
+    pos = jnp.where(
+        (dsorted < nseg) & (rank < capacity),
+        dsorted * capacity + rank,
+        nseg * capacity,
+    )
+    size = nseg * capacity
+    out = {}
+    for name, a in arrs.items():
+        buf = jnp.zeros((size + 1,) + a.shape[1:], dtype=a.dtype)
+        out[name] = buf.at[pos].set(a[order])[:size]
+    pbuf = jnp.zeros((size + 1,), dtype=bool).at[pos].set(dsorted < nseg)[:size]
+    return out, pbuf, overflow
+
+
+def redistribute(arrs: dict, present, dest, nseg: int, capacity: int):
+    """All-to-all exchange by per-row destination segment.
+
+    -> (received arrs [nseg*capacity], received present, overflow scalar).
+    The received layout: chunk j holds rows sent by segment j.
+    """
+    buckets, pbuf, overflow = _bucketize(arrs, present, dest, nseg, capacity)
+    recv = {
+        name: lax.all_to_all(a, SEG_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        for name, a in buckets.items()
+    }
+    precv = lax.all_to_all(pbuf, SEG_AXIS, split_axis=0, concat_axis=0, tiled=True)
+    # surface every segment's overflow everywhere (dispatcher error check)
+    overflow = lax.pmax(overflow.astype(jnp.int32), SEG_AXIS) > 0
+    return recv, precv, overflow
+
+
+def broadcast(arrs: dict, present):
+    """Broadcast Motion: every segment receives every row (tiled all_gather)."""
+    recv = {n: lax.all_gather(a, SEG_AXIS, tiled=True) for n, a in arrs.items()}
+    precv = lax.all_gather(present, SEG_AXIS, tiled=True)
+    return recv, precv
+
+
+def my_segment():
+    return lax.axis_index(SEG_AXIS)

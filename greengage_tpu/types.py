@@ -137,9 +137,10 @@ def arith_result(op: str, a: SqlType, b: SqlType) -> SqlType:
             # quotient computed in float64 then rescaled; keep 6 frac digits
             return decimal(max(sa, 6))
         raise TypeError(op)
+    # PG semantics: integer / integer = integer (truncating)
     if a.kind is Kind.INT64 or b.kind is Kind.INT64:
-        return FLOAT64 if op == "/" else INT64
-    return FLOAT64 if op == "/" else INT32
+        return INT64
+    return INT32
 
 
 def literal_type(v) -> SqlType:

@@ -1,0 +1,272 @@
+"""Device operator tests on the virtual CPU mesh, checked against
+numpy/pandas oracles (the pg_regress analog at the operator level)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.ops import agg as agg_ops
+from greengage_tpu.ops import hashing as dev_hash
+from greengage_tpu.ops import join as join_ops
+from greengage_tpu.ops import sort as sort_ops
+from greengage_tpu.ops.batch import Batch
+from greengage_tpu.ops.expr_eval import Evaluator
+from greengage_tpu.storage import native as host_hash
+
+
+# ---------------------------------------------------------------------------
+# hashing: device must match host spec bit-for-bit
+# ---------------------------------------------------------------------------
+
+def test_device_hash_matches_host():
+    vals = np.array([0, 1, -1, 2**40, -(2**40), 987654321, 2**63 - 1], dtype=np.int64)
+    host = host_hash.hash_i64(vals)
+    dev = np.asarray(dev_hash.hash_i64(jnp.asarray(vals)))
+    assert np.array_equal(host, dev)
+    hc = host_hash.hash_combine(host, host[::-1].copy())
+    dc = np.asarray(dev_hash.hash_combine(jnp.asarray(host), jnp.asarray(host[::-1].copy())))
+    assert np.array_equal(hc, dc)
+
+
+def test_device_placement_matches_storage():
+    vals = np.random.default_rng(0).integers(-(2**60), 2**60, 5000).astype(np.int64)
+    host_seg = host_hash.hash_i64(vals) % np.uint32(8)
+    dev_seg = np.asarray(dev_hash.segment_of(dev_hash.hash_i64(jnp.asarray(vals)), 8))
+    assert np.array_equal(host_seg.astype(np.int32), dev_seg)
+
+
+# ---------------------------------------------------------------------------
+# expression evaluation
+# ---------------------------------------------------------------------------
+
+def _batch(**cols):
+    arrs = {}
+    valids = {}
+    for k, v in cols.items():
+        if isinstance(v, tuple):
+            arrs[k] = jnp.asarray(v[0])
+            valids[k] = jnp.asarray(v[1])
+        else:
+            arrs[k] = jnp.asarray(v)
+    return Batch(arrs, valids)
+
+
+def test_expr_arith_and_decimal():
+    # price decimal(2), disc decimal(2): price * (1 - disc) — the Q1 kernel
+    price = np.array([10050, 200], dtype=np.int64)     # 100.50, 2.00
+    disc = np.array([10, 50], dtype=np.int64)          # 0.10, 0.50
+    b = _batch(p=price, d=disc)
+    dec2 = T.decimal(2)
+    e = E.BinOp("*", E.ColRef("p", dec2),
+                E.BinOp("-", E.Literal(100, dec2), E.ColRef("d", dec2), dec2),
+                T.arith_result("*", dec2, dec2))
+    v, valid = Evaluator(b).value(e)
+    assert e.type.scale == 4
+    # 100.50*0.90 = 90.45 -> 904500 at scale 4 ; 2.00*0.50=1.00 -> 10000
+    assert list(np.asarray(v)) == [904500, 10000]
+    assert valid is None
+
+
+def test_expr_int_division_truncates():
+    b = _batch(x=np.array([7, -7, 7], dtype=np.int32), y=np.array([2, 2, 0], dtype=np.int32))
+    e = E.BinOp("/", E.ColRef("x", T.INT32), E.ColRef("y", T.INT32),
+                T.arith_result("/", T.INT32, T.INT32))
+    v, valid = Evaluator(b).value(e)
+    assert list(np.asarray(v)[:2]) == [3, -3]
+    assert not bool(np.asarray(valid)[2])  # div by zero -> NULL
+
+
+def test_expr_3vl():
+    x = (np.array([1, 0, 0], dtype=np.int32), np.array([True, True, False]))
+    b = _batch(x=x)
+    gt = E.Cmp(">", E.ColRef("x", T.INT32), E.Literal(0, T.INT32))
+    # x > 0 AND false -> false even for NULL x? (false AND null = false)
+    e = E.BoolOp("and", (gt, E.Literal(False, T.BOOL)))
+    v, valid = Evaluator(b).value(e)
+    res = np.asarray(v)
+    assert not res.any()
+    assert valid is None or np.asarray(valid).all()
+    # NULL OR true = true
+    e2 = E.BoolOp("or", (gt, E.Literal(True, T.BOOL)))
+    v2, valid2 = Evaluator(b).value(e2)
+    assert np.asarray(v2).all()
+    assert valid2 is None or np.asarray(valid2).all()
+    # IS NULL
+    v3, _ = Evaluator(b).value(E.IsNull(E.ColRef("x", T.INT32)))
+    assert list(np.asarray(v3)) == [False, False, True]
+
+
+def test_expr_case_and_inlist():
+    b = _batch(x=np.array([1, 2, 3], dtype=np.int32))
+    e = E.Case(
+        whens=((E.Cmp("=", E.ColRef("x", T.INT32), E.Literal(1, T.INT32)),
+                E.Literal(10, T.INT32)),),
+        else_=E.Literal(0, T.INT32), type=T.INT32)
+    v, _ = Evaluator(b).value(e)
+    assert list(np.asarray(v)) == [10, 0, 0]
+    v2, _ = Evaluator(b).value(E.InList(E.ColRef("x", T.INT32), (1, 3)))
+    assert list(np.asarray(v2)) == [True, False, True]
+
+
+# ---------------------------------------------------------------------------
+# hash aggregation vs pandas
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,groups", [(1000, 7), (5000, 230)])
+def test_groupby_matches_pandas(n, groups):
+    rng = np.random.default_rng(3)
+    k1 = rng.integers(0, groups, n).astype(np.int64)
+    k2 = rng.integers(0, 3, n).astype(np.int32)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    sel = rng.random(n) < 0.8
+
+    # executor sizing policy: M >= 4x estimated group count (load <= 0.25)
+    M = 4096
+    slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
+        [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
+         agg_ops.KeySpec(jnp.asarray(k2), None, T.INT32)],
+        jnp.asarray(sel), M, num_probes=8)
+    assert not bool(overflow)
+    vals, valids = agg_ops.aggregate(
+        slots, M,
+        [agg_ops.AggSpec("cnt", "count_star", None, None),
+         agg_ops.AggSpec("s", "sum", jnp.asarray(v), None),
+         agg_ops.AggSpec("mn", "min", jnp.asarray(v), None),
+         agg_ops.AggSpec("av", "avg", jnp.asarray(v), None)],
+        jnp.asarray(sel))
+
+    used_np = np.asarray(used)
+    got = pd.DataFrame({
+        "k1": np.asarray(tkeys[0])[used_np],
+        "k2": np.asarray(tkeys[1])[used_np],
+        "cnt": np.asarray(vals["cnt"])[used_np],
+        "s": np.asarray(vals["s"])[used_np],
+        "mn": np.asarray(vals["mn"])[used_np],
+        "av": np.asarray(vals["av"])[used_np],
+    }).sort_values(["k1", "k2"]).reset_index(drop=True)
+
+    df = pd.DataFrame({"k1": k1[sel], "k2": k2[sel], "v": v[sel]})
+    want = df.groupby(["k1", "k2"], as_index=False).agg(
+        cnt=("v", "size"), s=("v", "sum"), mn=("v", "min"), av=("v", "mean")
+    ).sort_values(["k1", "k2"]).reset_index(drop=True)
+
+    assert len(got) == len(want)
+    assert np.array_equal(got["k1"], want["k1"])
+    assert np.array_equal(got["cnt"], want["cnt"])
+    assert np.array_equal(got["s"], want["s"])
+    assert np.array_equal(got["mn"], want["mn"])
+    assert np.allclose(got["av"], want["av"])
+
+
+def test_groupby_null_keys_merge():
+    k = np.array([1, 1, 2, 0, 0], dtype=np.int64)
+    kv = np.array([True, True, True, False, False])
+    sel = np.ones(5, dtype=bool)
+    M = 8
+    slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
+        [agg_ops.KeySpec(jnp.asarray(k), jnp.asarray(kv), T.INT64)],
+        jnp.asarray(sel), M, 4)
+    assert not bool(overflow)
+    assert int(np.asarray(used).sum()) == 3  # groups: 1, 2, NULL
+    vals, _ = agg_ops.aggregate(
+        slots, M, [agg_ops.AggSpec("c", "count_star", None, None)], jnp.asarray(sel))
+    cnts = sorted(np.asarray(vals["c"])[np.asarray(used)].tolist())
+    assert cnts == [1, 2, 2]
+
+
+def test_groupby_overflow_flag():
+    # 64 distinct keys into an 8-slot table: must flag, not corrupt
+    k = np.arange(64, dtype=np.int64)
+    slots, _, _, _, overflow = agg_ops.build_slot_table(
+        [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)],
+        jnp.ones(64, dtype=bool), 8, 4)
+    assert bool(overflow)
+
+
+# ---------------------------------------------------------------------------
+# hash join vs pandas
+# ---------------------------------------------------------------------------
+
+def test_hash_join_pk_fk():
+    rng = np.random.default_rng(5)
+    nb, np_ = 300, 2000
+    bkey = rng.permutation(1000)[:nb].astype(np.int64)   # unique build keys
+    bval = rng.integers(0, 50, nb).astype(np.int64)
+    pkey = rng.integers(0, 1000, np_).astype(np.int64)
+    psel = rng.random(np_) < 0.9
+
+    table = join_ops.build(
+        [agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)],
+        jnp.ones(nb, dtype=bool), 1024, 8)
+    assert not bool(table.overflow) and not bool(table.dup)
+    matched, brow = join_ops.probe(
+        table, [agg_ops.KeySpec(jnp.asarray(pkey), None, T.INT64)],
+        jnp.asarray(psel), 8)
+
+    bcols, bvalids = join_ops.gather_build_columns(
+        {"bval": jnp.asarray(bval)}, {}, brow, matched)
+
+    df = pd.merge(
+        pd.DataFrame({"pkey": pkey[psel]}),
+        pd.DataFrame({"bkey": bkey, "bval": bval}),
+        left_on="pkey", right_on="bkey", how="inner")
+    m = np.asarray(matched)
+    assert m.sum() == len(df)
+    got = np.sort(np.asarray(bcols["bval"])[m])
+    assert np.array_equal(got, np.sort(df["bval"].to_numpy()))
+
+
+def test_hash_join_duplicate_build_detected():
+    bkey = np.array([1, 2, 2, 3], dtype=np.int64)
+    table = join_ops.build(
+        [agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)],
+        jnp.ones(4, dtype=bool), 16, 4)
+    assert bool(table.dup)
+
+
+def test_hash_join_null_keys_never_match():
+    bkey = np.array([1, 2], dtype=np.int64)
+    table = join_ops.build([agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)],
+                           jnp.ones(2, dtype=bool), 8, 4)
+    pkey = np.array([1, 0], dtype=np.int64)
+    pvalid = np.array([True, False])
+    matched, _ = join_ops.probe(
+        table, [agg_ops.KeySpec(jnp.asarray(pkey), jnp.asarray(pvalid), T.INT64)],
+        jnp.ones(2, dtype=bool), 4)
+    assert list(np.asarray(matched)) == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def test_sort_multi_key_desc_nulls():
+    a = np.array([3, 1, 2, 1, 9], dtype=np.int64)
+    av = np.array([True, True, True, True, False])
+    bcol = np.array([1.5, -2.0, 0.0, 7.0, 0.0])
+    sel = np.array([True, True, True, True, True])
+    keys = [
+        sort_ops.SortKey(jnp.asarray(a), jnp.asarray(av), T.INT64, desc=False),
+        sort_ops.SortKey(jnp.asarray(bcol), None, T.FLOAT64, desc=True),
+    ]
+    perm, sel_sorted = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
+    order = np.asarray(perm)
+    # asc on a (nulls last), desc on b: (1,7.0),(1,-2.0),(2,0.0),(3,1.5),(null)
+    assert list(a[order][:4]) == [1, 1, 2, 3]
+    assert list(bcol[order][:2]) == [7.0, -2.0]
+    assert not av[order][4]
+
+
+def test_sort_dead_rows_pushed_back_and_limit():
+    x = np.array([5, 4, 3, 2, 1], dtype=np.int64)
+    sel = np.array([True, False, True, False, True])
+    keys = [sort_ops.SortKey(jnp.asarray(x), None, T.INT64)]
+    perm, sel_sorted = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
+    assert list(np.asarray(sel_sorted)) == [True, True, True, False, False]
+    assert list(x[np.asarray(perm)][:3]) == [1, 3, 5]
+    cols, valids, s = sort_ops.limit({"x": jnp.asarray(x)[np.asarray(perm)]}, {}, sel_sorted, 2)
+    assert list(np.asarray(cols["x"])) == [1, 3]
