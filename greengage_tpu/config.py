@@ -1,0 +1,47 @@
+"""Engine configuration — the GUC system analog (guc.c / guc_gp.c).
+
+A small typed settings registry with per-session overrides; the Database
+facade exposes SET/SHOW. Names loosely mirror the reference's GUCs
+(gp_interconnect_queue_depth etc. -> motion capacity slack here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Settings:
+    # hash table sizing (execHHashagg spill analog: retry tiers instead)
+    hash_table_load: float = 0.25       # target load factor for slot tables
+    hash_num_probes: int = 16           # probe rounds before overflow
+    hash_table_min: int = 256
+    hash_table_max: int = 1 << 22
+    # motion (gp_interconnect_queue_depth analog)
+    motion_capacity_slack: float = 1.6  # per-destination bucket headroom
+    motion_retry_tiers: int = 3         # capacity x4 per retry on overflow
+    # execution
+    optimizer: bool = True              # motion-aware planner on/off (GUC 'optimizer')
+    explain_verbose: bool = False
+    # storage
+    default_compresstype: str = "zlib"
+    default_compresslevel: int = 1
+
+    _overrides: dict = field(default_factory=dict)
+
+    def set(self, name: str, value) -> None:
+        if not hasattr(self, name) or name.startswith("_"):
+            raise ValueError(f'unrecognized configuration parameter "{name}"')
+        cur = getattr(self, name)
+        if isinstance(cur, bool):
+            value = str(value).lower() in ("1", "true", "on", "yes")
+        elif isinstance(cur, int):
+            value = int(value)
+        elif isinstance(cur, float):
+            value = float(value)
+        setattr(self, name, value)
+
+    def show(self, name: str):
+        if not hasattr(self, name) or name.startswith("_"):
+            raise ValueError(f'unrecognized configuration parameter "{name}"')
+        return getattr(self, name)

@@ -1,0 +1,533 @@
+"""Physical compiler: planned tree -> one jitted SPMD program per query.
+
+Where the reference interprets plans tuple-at-a-time per slice process
+(ExecutorRun/ExecProcNode, src/backend/executor/execMain.c:1020), we compile
+the ENTIRE plan below the top Gather Motion into a single function traced
+under shard_map over the segment mesh: scans are padded device arrays,
+Motions are collectives (parallel/motion.py), operators are the vectorized
+kernels in ops/. XLA fuses across operator boundaries — the slice model
+survives logically (Motion = slice boundary) but costs no process hop.
+
+Static-shape policy (SURVEY.md §7 "hard parts"): all capacities derive from
+storage manifests + planner estimates; kernels report overflow flags
+(hash-table or motion-bucket exhaustion) and the executor re-compiles at the
+next size tier — the spill/flow-control analog.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.config import Settings
+from greengage_tpu.ops import agg as agg_ops
+from greengage_tpu.ops import hashing
+from greengage_tpu.ops import join as join_ops
+from greengage_tpu.ops import sort as sort_ops
+from greengage_tpu.ops.batch import Batch
+from greengage_tpu.ops.expr_eval import Evaluator
+from greengage_tpu.parallel import SEG_AXIS
+from greengage_tpu.parallel import motion as motion_ops
+from greengage_tpu.planner.locus import LocusKind
+from greengage_tpu.planner.logical import (
+    Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan, Sort,
+)
+
+VALID_PREFIX = "@v:"
+
+
+def _pow2(n: float) -> int:
+    m = 1
+    while m < n:
+        m <<= 1
+    return m
+
+
+@dataclass
+class CompileResult:
+    device_fn: object                  # jitted shard_map program
+    input_spec: list                   # [(table, [storage cols], cap)]
+    out_cols: list                     # ColInfo list of gather output
+    flag_names: list[str]
+    gather_child_locus: object
+    merge_keys: list | None
+    host_limit: tuple | None           # (limit, offset)
+    capacity: int                      # below-gather output capacity
+
+
+class Compiler:
+    def __init__(self, catalog, store, mesh, nseg: int, consts: dict,
+                 settings: Settings, tier: int = 0):
+        self.catalog = catalog
+        self.store = store
+        self.mesh = mesh
+        self.nseg = nseg
+        self.consts = consts
+        self.s = settings
+        self.tier = tier
+        self.flags: list[str] = []
+        self.scan_caps: dict[str, int] = {}
+        self.scan_cols: dict[str, set] = {}
+
+    # ------------------------------------------------------------------
+    def compile(self, plan: Motion) -> CompileResult:
+        assert isinstance(plan, Motion) and plan.kind is MotionKind.GATHER
+        below = plan.child
+        self._dict_refs: dict[str, tuple] = {}
+        _collect_dict_refs(plan, self._dict_refs)
+        # host-side limit/merge bookkeeping
+        host_limit = None
+        node = below
+        if isinstance(node, Limit):
+            host_limit = (node.limit, node.offset)
+
+        self._collect_scans(below)
+        input_spec = []
+        for t in sorted(self.scan_caps):
+            cols = []
+            for c in sorted(self.scan_cols[t]):
+                cols.append(c)
+                if self.store.has_nulls(t, c):
+                    cols.append(VALID_PREFIX + c)
+            input_spec.append((t, cols, self.scan_caps[t]))
+
+        compiled = self._compile_node(below)   # closure: ctx -> Batch
+        out_cols = below.out_cols()
+        flag_names = list(self.flags)
+        nseg = self.nseg
+
+        def seg_fn(*flat):
+            ctx = {"tables": {}, "flags": []}
+            i = 0
+            for tname, cols, cap in input_spec:
+                entry = {}
+                for c in cols:
+                    entry[c] = flat[i]
+                    i += 1
+                entry["@present"] = flat[i]
+                i += 1
+                ctx["tables"][tname] = entry
+            batch = compiled(ctx)
+            sel = batch.selection()
+            outs = []
+            for c in out_cols:
+                outs.append(batch.cols[c.id])
+                v = batch.valids.get(c.id)
+                outs.append(jnp.ones_like(sel) if v is None else v)
+            outs.append(sel)
+            for _, f in ctx["flags"]:
+                outs.append(jnp.broadcast_to(f.astype(jnp.int32), (1,)))
+            return tuple(outs)
+
+        nouts = 2 * len(out_cols) + 1 + len(flag_names)
+        fn = jax.jit(
+            jax.shard_map(
+                seg_fn,
+                mesh=self.mesh,
+                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _ in input_spec))),
+                out_specs=tuple(P(SEG_AXIS) for _ in range(nouts)),
+                check_vma=False,
+            )
+        )
+        return CompileResult(
+            device_fn=fn,
+            input_spec=input_spec,
+            out_cols=out_cols,
+            flag_names=flag_names,
+            gather_child_locus=below.locus,
+            merge_keys=plan.merge_keys,
+            host_limit=host_limit,
+            capacity=self._capacity_of(below),
+        )
+
+    # ------------------------------------------------------------------
+    # capacities
+    # ------------------------------------------------------------------
+    def _collect_scans(self, plan: Plan):
+        if isinstance(plan, Scan):
+            counts = self.store.segment_rowcounts(plan.table)
+            cap = max(max(counts, default=0), 1)
+            self.scan_caps[plan.table] = max(self.scan_caps.get(plan.table, 0), cap)
+            self.scan_cols.setdefault(plan.table, set()).update(c.name for c in plan.cols)
+        for c in plan.children:
+            self._collect_scans(c)
+
+    def _capacity_of(self, plan: Plan) -> int:
+        """Static per-segment row capacity of a node's output batch."""
+        if isinstance(plan, Scan):
+            counts = self.store.segment_rowcounts(plan.table)
+            return max(max(counts, default=0), 1)
+        if isinstance(plan, (Filter, Project, Sort)):
+            return self._capacity_of(plan.child)
+        if isinstance(plan, Limit):
+            cap = self._capacity_of(plan.child)
+            if plan.limit is not None:
+                return min(cap, plan.limit + plan.offset)
+            return cap
+        if isinstance(plan, Join):
+            return self._capacity_of(plan.left)
+        if isinstance(plan, Aggregate):
+            if not plan.group_keys and plan.phase in ("single", "final"):
+                return 1
+            return self._agg_table_size(plan)
+        if isinstance(plan, Motion):
+            child_cap = self._capacity_of(plan.child)
+            if plan.kind is MotionKind.BROADCAST:
+                return child_cap * self.nseg
+            if plan.kind is MotionKind.REDISTRIBUTE:
+                return self.nseg * self._motion_bucket(child_cap)
+            return child_cap
+        raise NotImplementedError(type(plan).__name__)
+
+    def _motion_bucket(self, child_cap: int) -> int:
+        c = int(child_cap * self.s.motion_capacity_slack / self.nseg) + 64
+        c *= 4 ** self.tier
+        return min(c, child_cap)
+
+    def _agg_table_size(self, plan: Aggregate) -> int:
+        est = max(plan.est_rows, 16.0) / max(self.s.hash_table_load, 0.05)
+        m = _pow2(est) * (4 ** self.tier)
+        return max(self.s.hash_table_min, min(m, self.s.hash_table_max))
+
+    def _join_table_size(self, build_cap: int) -> int:
+        return max(self.s.hash_table_min, min(_pow2(build_cap * 2), self.s.hash_table_max))
+
+    # ------------------------------------------------------------------
+    # node compilation (returns closures ctx -> Batch)
+    # ------------------------------------------------------------------
+    def _compile_node(self, plan: Plan):
+        return getattr(self, "_c_" + type(plan).__name__.lower())(plan)
+
+    def _c_scan(self, plan: Scan):
+        table = plan.table
+        id_by_store = [(c.id, c.name) for c in plan.cols]
+
+        def run(ctx):
+            t = ctx["tables"][table]
+            cols = {cid: t[sname] for cid, sname in id_by_store}
+            valids = {
+                cid: t[VALID_PREFIX + sname]
+                for cid, sname in id_by_store
+                if VALID_PREFIX + sname in t
+            }
+            return Batch(cols, valids, t["@present"])
+
+        return run
+
+    def _c_filter(self, plan: Filter):
+        child = self._compile_node(plan.child)
+        pred = plan.predicate
+
+        def run(ctx):
+            b = child(ctx)
+            mask = Evaluator(b, self.consts).predicate(pred)
+            return b.with_sel(b.selection() & mask)
+
+        return run
+
+    def _c_project(self, plan: Project):
+        child = self._compile_node(plan.child)
+        exprs = plan.exprs
+
+        def run(ctx):
+            b = child(ctx)
+            ev = Evaluator(b, self.consts)
+            cols, valids = {}, {}
+            for ci, e in exprs:
+                v, valid = ev.value(e)
+                cols[ci.id] = v
+                if valid is not None:
+                    valids[ci.id] = valid
+            return Batch(cols, valids, b.sel)
+
+        return run
+
+    # ---- joins ---------------------------------------------------------
+    def _key_specs(self, batch: Batch, exprs):
+        ev = Evaluator(batch, self.consts)
+        specs = []
+        for e in exprs:
+            v, valid = ev.value(e)
+            lut = None
+            if e.type.kind is T.Kind.TEXT:
+                d = getattr(e, "_dict_ref", None)
+                if d is None and isinstance(e, E.ColRef):
+                    d = self._dict_for_col(e.name)
+                if d is not None:
+                    lut = jnp.asarray(self.store.dictionary(*d).hashes())
+            specs.append(agg_ops.KeySpec(v, valid, e.type, hash_lut=lut))
+        return specs
+
+    def _dict_for_col(self, col_id: str):
+        return self._dict_refs.get(col_id)
+
+    def _c_join(self, plan: Join):
+        if plan.kind == "cross":
+            raise NotImplementedError("cross join execution")
+        left_fn = self._compile_node(plan.left)
+        right_fn = self._compile_node(plan.right)
+        build_cap = self._capacity_of(plan.right)
+        M = self._join_table_size(build_cap)
+        probes = self.s.hash_num_probes
+        lkeys, rkeys = plan.left_keys, plan.right_keys
+        kind = plan.kind
+        residual = plan.residual
+        fid_ov = f"join_overflow_{len(self.flags)}"
+        self.flags.append(fid_ov)
+        fid_dup = f"join_dup_{len(self.flags)}"
+        self.flags.append(fid_dup)
+        right_cols = [c for c in plan.right.out_cols()]
+
+        def run(ctx):
+            lb = left_fn(ctx)
+            rb = right_fn(ctx)
+            table = join_ops.build(self._key_specs(rb, rkeys), rb.selection(), M, probes)
+            ctx["flags"].append((fid_ov, table.overflow))
+            ctx["flags"].append((fid_dup, table.dup))
+            matched, brow = join_ops.probe(table, self._key_specs(lb, lkeys),
+                                           lb.selection(), probes)
+            cols = dict(lb.cols)
+            valids = dict(lb.valids)
+            sel = lb.selection()
+            if kind == "inner":
+                sel = sel & matched
+            elif kind == "semi":
+                sel = sel & matched
+            elif kind == "anti":
+                sel = sel & ~matched
+            if kind in ("inner", "left"):
+                bcols = {c.id: rb.cols[c.id] for c in right_cols}
+                bvalids = {c.id: rb.valids.get(c.id) for c in right_cols}
+                g_cols, g_valids = join_ops.gather_build_columns(bcols, bvalids, brow, matched)
+                cols.update(g_cols)
+                valids.update(g_valids)
+            out = Batch(cols, valids, sel)
+            if residual is not None:
+                mask = Evaluator(out, self.consts).predicate(residual)
+                if kind == "left":
+                    # residual only disqualifies the match, not the row
+                    newm = matched & mask
+                    for c in right_cols:
+                        out.valids[c.id] = out.valids[c.id] & newm
+                else:
+                    out = out.with_sel(out.selection() & mask)
+            return out
+
+        return run
+
+    # ---- aggregation ---------------------------------------------------
+    def _c_aggregate(self, plan: Aggregate):
+        child_fn = self._compile_node(plan.child)
+        M = self._agg_table_size(plan) if plan.group_keys else 1
+        probes = self.s.hash_num_probes
+        fid = f"agg_overflow_{len(self.flags)}"
+        if plan.group_keys:
+            self.flags.append(fid)
+        keys = plan.group_keys
+        aggs = plan.aggs
+        phase = plan.phase
+
+        def run(ctx):
+            b = child_fn(ctx)
+            sel = b.selection()
+            if keys:
+                kspecs = self._key_specs(b, [e for _, e in keys])
+                slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
+                    kspecs, sel, M, probes)
+                ctx["flags"].append((fid, overflow))
+            else:
+                slots = jnp.where(sel, 0, 1)
+                used = jnp.ones((1,), dtype=bool)
+                tkeys, tvalids = [], []
+
+            Mx = M
+            ev = Evaluator(b, self.consts)
+            cols, valids = {}, {}
+            for (ci, _), tk, tv in zip(keys, tkeys, tvalids):
+                cols[ci.id] = tk
+                if tv is not None:
+                    valids[ci.id] = tv
+
+            if phase in ("single", "partial"):
+                specs = []
+                post = []   # (out id, kind, ...) finalization steps
+                for ci, a in aggs:
+                    arg_v, arg_valid, scale = None, None, 0
+                    if a.arg is not None:
+                        arg_v, arg_valid = ev.value(a.arg)
+                        if a.arg.type.kind is T.Kind.DECIMAL:
+                            scale = a.arg.type.scale
+                    if phase == "single":
+                        specs.append(agg_ops.AggSpec(ci.id, a.func, arg_v, arg_valid, scale))
+                    else:
+                        if a.func in ("count", "count_star"):
+                            specs.append(agg_ops.AggSpec(ci.id + "@c", a.func, arg_v, arg_valid))
+                        elif a.func == "sum":
+                            specs.append(agg_ops.AggSpec(ci.id + "@s", "sum", arg_v, arg_valid))
+                        elif a.func == "avg":
+                            specs.append(agg_ops.AggSpec(ci.id + "@s", "sum", arg_v, arg_valid))
+                            specs.append(agg_ops.AggSpec(ci.id + "@c", "count", arg_v, arg_valid))
+                        elif a.func in ("min", "max"):
+                            specs.append(agg_ops.AggSpec(ci.id + "@m", a.func, arg_v, arg_valid))
+                vals, avalids = agg_ops.aggregate(slots, Mx, specs, sel)
+                for name, v in vals.items():
+                    cols[name] = v
+                    if avalids.get(name) is not None:
+                        valids[name] = avalids[name]
+            else:  # final: merge partial states arriving in b
+                specs = []
+                finals = []
+                for ci, a in aggs:
+                    if a.func in ("count", "count_star"):
+                        specs.append(agg_ops.AggSpec(
+                            ci.id, "sum", b.cols[ci.id + "@c"], b.valids.get(ci.id + "@c")))
+                        finals.append((ci, "count"))
+                    elif a.func == "sum":
+                        specs.append(agg_ops.AggSpec(
+                            ci.id, "sum", b.cols[ci.id + "@s"], b.valids.get(ci.id + "@s")))
+                        finals.append((ci, "sum"))
+                    elif a.func == "avg":
+                        specs.append(agg_ops.AggSpec(
+                            ci.id + "@s", "sum", b.cols[ci.id + "@s"], b.valids.get(ci.id + "@s")))
+                        specs.append(agg_ops.AggSpec(
+                            ci.id + "@c", "sum", b.cols[ci.id + "@c"], b.valids.get(ci.id + "@c")))
+                        scale = a.arg.type.scale if (a.arg is not None and
+                                                     a.arg.type.kind is T.Kind.DECIMAL) else 0
+                        finals.append((ci, "avg", scale))
+                    elif a.func in ("min", "max"):
+                        specs.append(agg_ops.AggSpec(
+                            ci.id, a.func, b.cols[ci.id + "@m"], b.valids.get(ci.id + "@m")))
+                        finals.append((ci, a.func))
+                vals, avalids = agg_ops.aggregate(slots, Mx, specs, sel)
+                for f in finals:
+                    ci = f[0]
+                    if f[1] == "avg":
+                        s = vals[ci.id + "@s"].astype(jnp.float64)
+                        c = vals[ci.id + "@c"].astype(jnp.float64)
+                        res = s / jnp.where(c == 0, 1.0, c)
+                        if f[2]:
+                            res = res / (10.0 ** f[2])
+                        cols[ci.id] = res
+                        valids[ci.id] = vals[ci.id + "@c"] > 0
+                    elif f[1] == "count":
+                        cols[ci.id] = vals[ci.id].astype(jnp.int64)
+                    else:
+                        cols[ci.id] = vals[ci.id]
+                        if avalids.get(ci.id) is not None:
+                            valids[ci.id] = avalids[ci.id]
+            return Batch(cols, valids, used)
+
+        return run
+
+    # ---- motion --------------------------------------------------------
+    def _c_motion(self, plan: Motion):
+        child_fn = self._compile_node(plan.child)
+        if plan.kind is MotionKind.GATHER:
+            raise AssertionError("nested gather")
+        nseg = self.nseg
+        if plan.kind is MotionKind.BROADCAST:
+            def run(ctx):
+                b = child_fn(ctx)
+                arrs = dict(b.cols)
+                for name, v in b.valids.items():
+                    arrs[VALID_PREFIX + name] = v
+                recv, precv = motion_ops.broadcast(arrs, b.selection())
+                cols = {k: v for k, v in recv.items() if not k.startswith(VALID_PREFIX)}
+                valids = {k[len(VALID_PREFIX):]: v for k, v in recv.items()
+                          if k.startswith(VALID_PREFIX)}
+                return Batch(cols, valids, precv)
+
+            return run
+
+        # REDISTRIBUTE
+        child_cap = self._capacity_of(plan.child)
+        C = self._motion_bucket(child_cap)
+        hash_exprs = plan.hash_exprs
+        fid = f"motion_overflow_{len(self.flags)}"
+        self.flags.append(fid)
+
+        def run(ctx):
+            b = child_fn(ctx)
+            specs = self._key_specs(b, hash_exprs)
+            h = hashing.row_hash([
+                hashing.column_hash(s.values, s.valid, s.type, text_lut=s.hash_lut)
+                for s in specs
+            ])
+            dest = hashing.segment_of(h, nseg)
+            arrs = dict(b.cols)
+            for name, v in b.valids.items():
+                arrs[VALID_PREFIX + name] = v
+            recv, precv, overflow = motion_ops.redistribute(
+                arrs, b.selection(), dest, nseg, C)
+            ctx["flags"].append((fid, overflow))
+            cols = {k: v for k, v in recv.items() if not k.startswith(VALID_PREFIX)}
+            valids = {k[len(VALID_PREFIX):]: v for k, v in recv.items()
+                      if k.startswith(VALID_PREFIX)}
+            return Batch(cols, valids, precv)
+
+        return run
+
+    # ---- sort / limit --------------------------------------------------
+    def _sort_keys(self, batch: Batch, keys):
+        ev = Evaluator(batch, self.consts)
+        out = []
+        for e, desc, nf in keys:
+            v, valid = ev.value(e)
+            lut = None
+            if e.type.kind is T.Kind.TEXT:
+                d = getattr(e, "_dict_ref", None)
+                if d is None and isinstance(e, E.ColRef):
+                    d = self._dict_for_col(e.name)
+                if d is not None:
+                    dic = self.store.dictionary(*d)
+                    order = np.argsort(np.argsort(dic.values, kind="stable"), kind="stable")
+                    lut = jnp.asarray(
+                        np.concatenate([order.astype(np.int32), [np.int32(-1)]]))
+            out.append(sort_ops.SortKey(v, valid, e.type, desc, nf, rank_lut=lut))
+        return out
+
+    def _c_sort(self, plan: Sort):
+        child_fn = self._compile_node(plan.child)
+        keys = plan.keys
+        cap = self._capacity_of(plan.child)
+
+        def run(ctx):
+            b = child_fn(ctx)
+            sk = self._sort_keys(b, keys)
+            perm, sel_sorted = sort_ops.sort_batch(sk, b.selection(), cap)
+            cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
+            return Batch(cols, valids, sel_sorted)
+
+        return run
+
+    def _c_limit(self, plan: Limit):
+        child_fn = self._compile_node(plan.child)
+        cap = self._capacity_of(plan.child)
+        k = min(cap, (plan.limit or cap) + plan.offset)
+        compacted = isinstance(plan.child, Sort)
+
+        def run(ctx):
+            b = child_fn(ctx)
+            if not compacted:
+                perm, sel_sorted = sort_ops.sort_batch([], b.selection(), cap)
+                cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
+                b = Batch(cols, valids, sel_sorted)
+            cols, valids, sel = sort_ops.limit(b.cols, b.valids, b.selection(), k)
+            return Batch(cols, valids, sel)
+
+        return run
+
+
+def _collect_dict_refs(plan: Plan, out: dict):
+    for c in plan.out_cols():
+        if c.dict_ref is not None:
+            out[c.id] = c.dict_ref
+    for ch in plan.children:
+        _collect_dict_refs(ch, out)

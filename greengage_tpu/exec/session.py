@@ -1,6 +1,239 @@
-"""Placeholder; full Database facade lands with the executor."""
+"""Database session facade — the tcop/postgres.c + psql surface.
+
+One object owns the catalog, storage, mesh, settings, and executor; .sql()
+is exec_simple_query (reference: src/backend/tcop/postgres.c:1622): parse ->
+bind -> parallelize -> compile -> dispatch -> gather. DDL/DML/utility
+statements route to their handlers, mirroring ProcessUtility.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+
+import numpy as np
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.catalog import Catalog, Column, DistPolicy, PolicyKind, TableSchema
+from greengage_tpu.config import Settings
+from greengage_tpu.exec.executor import Executor, QueryError, Result
+from greengage_tpu.parallel import make_mesh
+from greengage_tpu.planner import plan_query
+from greengage_tpu.planner.logical import describe
+from greengage_tpu.sql import ast as A
+from greengage_tpu.sql.binder import Binder, type_from_name
+from greengage_tpu.sql.parser import SqlError, parse
+from greengage_tpu.storage import TableStore
 
 
 class Database:
-    def __init__(self, path=None, numsegments=None):
-        raise NotImplementedError("executor not built yet")
+    def __init__(self, path: str | None = None, numsegments: int | None = None,
+                 devices=None):
+        import jax
+
+        devs = list(devices) if devices is not None else jax.devices()
+        if path is not None and os.path.exists(os.path.join(path, "catalog.json")):
+            self.catalog = Catalog.load(path)
+            if numsegments is None:
+                numsegments = self.catalog.segments.numsegments
+            elif self.catalog.segments.numsegments != numsegments:
+                raise ValueError(
+                    f"cluster width mismatch: on-disk {self.catalog.segments.numsegments}, "
+                    f"requested {numsegments} (run gpexpand-style redistribution)")
+        else:
+            if numsegments is None:
+                numsegments = len(devs)
+            self.catalog = Catalog(numsegments, path=path)
+        self.numsegments = numsegments
+        if path is None:
+            import tempfile
+
+            path = tempfile.mkdtemp(prefix="ggtpu_")
+            self.catalog.path = path
+        self.path = path
+        self.store = TableStore(path, self.catalog)
+        self.store.manifest.recover()   # in-doubt resolution on startup
+        self.settings = Settings()
+        self.mesh = make_mesh(numsegments, devs)
+        self.executor = Executor(self.catalog, self.store, self.mesh,
+                                 numsegments, self.settings)
+
+    # ------------------------------------------------------------------
+    def sql(self, text: str):
+        """Execute one or more statements; returns the last statement's
+        Result (or a status string for DDL/DML)."""
+        out = None
+        for stmt in parse(text):
+            out = self._execute(stmt)
+        return out
+
+    def _execute(self, stmt):
+        if isinstance(stmt, A.SelectStmt):
+            return self._select(stmt)
+        if isinstance(stmt, A.ExplainStmt):
+            return self._explain(stmt)
+        if isinstance(stmt, A.CreateTableStmt):
+            return self._create_table(stmt)
+        if isinstance(stmt, A.DropTableStmt):
+            existed = stmt.name in self.catalog
+            self.catalog.drop_table(stmt.name, stmt.if_exists)
+            if existed:
+                # drop storage too: manifest commit removes the table's
+                # segfiles from visibility; data dir cleanup is best-effort
+                tx = self.store.manifest.begin()
+                if stmt.name in tx["tables"]:
+                    del tx["tables"][stmt.name]
+                    self.store.manifest.commit_tx(tx)
+                self.store._invalidate_dicts(stmt.name)
+                import shutil
+
+                shutil.rmtree(os.path.join(self.path, "data", stmt.name),
+                              ignore_errors=True)
+            return "DROP TABLE"
+        if isinstance(stmt, A.InsertStmt):
+            return self._insert(stmt)
+        if isinstance(stmt, A.CopyStmt):
+            return self._copy(stmt)
+        if isinstance(stmt, A.ShowStmt):
+            return str(self.settings.show(stmt.what))
+        raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+    def _plan(self, stmt: A.SelectStmt):
+        binder = Binder(self.catalog, self.store)
+        logical, outs = binder.bind_select(stmt)
+        planned = plan_query(logical, self.catalog, self.store, self.numsegments)
+        return planned, binder.consts, outs
+
+    def _select(self, stmt: A.SelectStmt) -> Result:
+        planned, consts, outs = self._plan(stmt)
+        return self.executor.run(planned, consts, outs)
+
+    def _explain(self, stmt: A.ExplainStmt):
+        if not isinstance(stmt.query, A.SelectStmt):
+            raise SqlError("EXPLAIN supports SELECT only")
+        planned, consts, outs = self._plan(stmt.query)
+        text = describe(planned)
+        if stmt.analyze:
+            res = self.executor.run(planned, consts, outs)
+            text += f"\n Execution time: {res.wall_ms:.2f} ms, rows: {len(res)}"
+        r = Result(columns=["QUERY PLAN"],
+                   cols={"p": np.array(text.split("\n"), dtype=object)},
+                   valids={}, _order=["p"])
+        r.plan_text = text
+        return r
+
+    # ------------------------------------------------------------------
+    def _create_table(self, stmt: A.CreateTableStmt):
+        cols = [
+            Column(c.name, type_from_name(c.type_name, c.typmod), not c.not_null)
+            for c in stmt.columns
+        ]
+        kind = {"hash": PolicyKind.HASH, "random": PolicyKind.RANDOM,
+                "replicated": PolicyKind.REPLICATED}[stmt.dist_kind]
+        policy = DistPolicy(kind, tuple(stmt.dist_keys) if kind is PolicyKind.HASH else (),
+                            self.numsegments)
+        options = dict(stmt.options)
+        options.setdefault("compresstype", self.settings.default_compresstype)
+        options.setdefault("compresslevel", self.settings.default_compresslevel)
+        self.catalog.create_table(TableSchema(stmt.name, cols, policy, options),
+                                  stmt.if_not_exists)
+        return "CREATE TABLE"
+
+    def _insert(self, stmt: A.InsertStmt):
+        schema = self.catalog.get(stmt.table)
+        names = stmt.columns or schema.column_names
+        if set(names) != set(schema.column_names):
+            raise SqlError("INSERT must provide all columns")
+        cols: dict[str, list] = {n: [] for n in names}
+        valids: dict[str, list] = {n: [] for n in names}
+        binder = Binder(self.catalog, self.store)
+        scope = _EmptyScope()
+        for row in stmt.rows:
+            if len(row) != len(names):
+                raise SqlError("INSERT row arity mismatch")
+            for n, v in zip(names, row):
+                col = schema.column(n)
+                lit = binder._expr(v, scope)
+                if not isinstance(lit, E.Literal):
+                    raise SqlError("INSERT values must be literals")
+                lit = binder._coerce_literal(lit, col.type)
+                if lit.value is None:
+                    valids[n].append(False)
+                    cols[n].append(_zero_for(col.type))
+                else:
+                    valids[n].append(True)
+                    cols[n].append(lit.value)
+        enc_cols = {}
+        enc_valids = {}
+        for n in names:
+            col = schema.column(n)
+            if col.type.kind is T.Kind.TEXT:
+                enc_cols[n] = cols[n]
+            else:
+                enc_cols[n] = np.array(cols[n], dtype=col.type.np_dtype)
+            va = np.array(valids[n], dtype=bool)
+            if not va.all():
+                enc_valids[n] = va
+        n = self.store.insert(stmt.table, enc_cols, enc_valids)
+        return f"INSERT 0 {n}"
+
+    def load_table(self, table: str, columns: dict, valids: dict | None = None):
+        """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
+        n = self.store.insert(table, columns, valids)
+        return n
+
+    def _copy(self, stmt: A.CopyStmt):
+        schema = self.catalog.get(stmt.table)
+        delim = stmt.options.get("delimiter", ",")
+        header = str(stmt.options.get("header", "false")).lower() in ("true", "1")
+        null_s = stmt.options.get("null", "")
+        cols: dict[str, list] = {c.name: [] for c in schema.columns}
+        valids: dict[str, list] = {c.name: [] for c in schema.columns}
+        with open(stmt.path, newline="") as f:
+            rd = _csv.reader(f, delimiter=delim)
+            for i, row in enumerate(rd):
+                if header and i == 0:
+                    continue
+                if len(row) != len(schema.columns):
+                    raise SqlError(f"COPY row {i}: arity mismatch")
+                for c, v in zip(schema.columns, row):
+                    if v == null_s:
+                        valids[c.name].append(False)
+                        cols[c.name].append(_zero_for(c.type))
+                        continue
+                    valids[c.name].append(True)
+                    cols[c.name].append(T.from_string(v, c.type))
+        enc_cols = {}
+        enc_valids = {}
+        for c in schema.columns:
+            va = np.array(valids[c.name], dtype=bool)
+            if c.type.kind is T.Kind.TEXT:
+                enc_cols[c.name] = cols[c.name]
+            else:
+                enc_cols[c.name] = np.array(cols[c.name], dtype=c.type.np_dtype)
+            if not va.all():
+                enc_valids[c.name] = va
+        n = self.store.insert(stmt.table, enc_cols, enc_valids)
+        return f"COPY {n}"
+
+    # ------------------------------------------------------------------
+    def set(self, name: str, value):
+        self.settings.set(name, value)
+
+    def close(self):
+        pass
+
+
+class _EmptyScope:
+    tables: list = []
+
+    def resolve(self, parts):
+        raise SqlError(f'column "{".".join(parts)}" does not exist')
+
+
+def _zero_for(t: T.SqlType):
+    if t.kind is T.Kind.TEXT:
+        return ""
+    return 0

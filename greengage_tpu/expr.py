@@ -114,6 +114,15 @@ class InList(Expr):
 
 
 @dataclass(frozen=True)
+class Func(Expr):
+    """Scalar function call evaluated on device (registry in expr_eval)."""
+
+    name: str           # extract_year | extract_month | extract_day | abs | ...
+    args: tuple[Expr, ...] = ()
+    type: T.SqlType = T.INT32
+
+
+@dataclass(frozen=True)
 class Agg(Expr):
     func: str           # count | count_star | sum | min | max | avg
     arg: Expr | None
@@ -147,7 +156,7 @@ def walk(e: Expr):
     ):
         if isinstance(f, Expr):
             yield from walk(f)
-    for a in getattr(e, "args", ()):
+    for a in getattr(e, "args", ()) or ():
         yield from walk(a)
     for c, v in getattr(e, "whens", ()):
         yield from walk(c)

@@ -39,6 +39,17 @@ def _rescale(vals, from_scale: int, to_scale: int):
     return jnp.where(vals >= 0, (vals + half) // p, -((-vals + half) // p))
 
 
+def _rescale_host(v: int, from_scale: int, to_scale: int) -> int:
+    """Host-side scalar version of _rescale (literal coercion)."""
+    if from_scale == to_scale:
+        return v
+    if to_scale > from_scale:
+        return v * 10 ** (to_scale - from_scale)
+    p = 10 ** (from_scale - to_scale)
+    half = p // 2
+    return (v + half) // p if v >= 0 else -((-v + half) // p)
+
+
 def _lit_array(lit: E.Literal, n: int):
     t = lit.type
     if lit.value is None:
@@ -243,7 +254,7 @@ class Evaluator:
 
     def _eval_lut(self, e: E.Lut):
         codes, val = self.value(e.arg)
-        table = self.consts[e.table_id]
+        table = jnp.asarray(self.consts[e.table_id])
         # code -1 (literal absent from dictionary) indexes the sentinel row
         idx = jnp.where(codes < 0, table.shape[0] - 1, codes)
         return table[idx], val
@@ -254,6 +265,45 @@ class Evaluator:
         for c in e.values:
             res = res | (v == c)
         return res, val
+
+    def _eval_func(self, e: E.Func):
+        args = [self.value(a) for a in e.args]
+        valid = None
+        for _, av in args:
+            valid = _and_valid(valid, av)
+        vals = [a for a, _ in args]
+        fn = _FUNCS.get(e.name)
+        if fn is None:
+            raise NotImplementedError(f"function {e.name}")
+        return fn(*vals), valid
+
+
+# --------------------------------------------------------------------------
+# scalar function registry (device implementations)
+# --------------------------------------------------------------------------
+
+def _civil_from_days(z):
+    """days-since-1970 -> (year, month, day), branchless integer math
+    (Howard Hinnant's civil_from_days; valid for the SQL date range)."""
+    z = z.astype(jnp.int64) + 719468
+    era = z // 146097   # // already floors (Hinnant's C version must adjust)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+_FUNCS = {
+    "extract_year": lambda d: _civil_from_days(d)[0],
+    "extract_month": lambda d: _civil_from_days(d)[1],
+    "extract_day": lambda d: _civil_from_days(d)[2],
+    "abs": jnp.abs,
+}
 
 
 def _or_true(valid):

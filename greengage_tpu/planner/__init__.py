@@ -1,0 +1,5 @@
+from greengage_tpu.planner.logical import (  # noqa: F401
+    Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan, Sort,
+)
+from greengage_tpu.planner.locus import Locus, LocusKind  # noqa: F401
+from greengage_tpu.planner.planner import plan_query  # noqa: F401

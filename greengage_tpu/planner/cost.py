@@ -1,0 +1,53 @@
+"""TPU-oriented cost model — the libgpdbcost analog, radically smaller.
+
+On TPU the dominant costs are HBM bytes touched and ICI bytes moved;
+per-row CPU work (the reference's cpu_tuple_cost world) is nearly free
+under vectorization. So costs are byte counts:
+
+  redistribute(R)  ~ bytes(R)            (each row crosses ICI once)
+  broadcast(R)     ~ bytes(R) * nseg     (all_gather replicates everywhere)
+  local op(R)      ~ bytes(R)            (one HBM pass)
+
+Row estimates come from storage manifests (exact for scans) and the usual
+selectivity guesses elsewhere (clauselist_selectivity analog).
+"""
+
+from __future__ import annotations
+
+from greengage_tpu import expr as E
+
+DEFAULT_FILTER_SELECTIVITY = 0.25
+EQ_SELECTIVITY = 0.05
+
+
+def filter_selectivity(pred: E.Expr) -> float:
+    if isinstance(pred, E.Cmp) and pred.op == "=":
+        return EQ_SELECTIVITY
+    if isinstance(pred, E.BoolOp) and pred.op == "and":
+        s = 1.0
+        for a in pred.args:
+            s *= filter_selectivity(a)
+        return max(s, 1e-4)
+    if isinstance(pred, E.BoolOp) and pred.op == "or":
+        s = 0.0
+        for a in pred.args:
+            s += filter_selectivity(a)
+        return min(s, 1.0)
+    return DEFAULT_FILTER_SELECTIVITY
+
+
+def row_width(cols) -> float:
+    return 8.0 * max(len(cols), 1)
+
+
+def est_groups(rows: float) -> float:
+    """Group-count guess without statistics: sqrt heuristic, capped."""
+    import math
+
+    return min(max(math.sqrt(max(rows, 1.0)) * 4, 16.0), 1 << 20)
+
+
+def motion_cost(kind: str, rows: float, width: float, nseg: int) -> float:
+    if kind == "broadcast":
+        return rows * width * nseg
+    return rows * width

@@ -1,0 +1,172 @@
+"""Logical/physical plan nodes.
+
+The binder emits a motion-free tree; the planner (planner.py) annotates each
+node with a Locus and inserts Motion nodes (the cdbparallelize/apply_motion
+analog, src/backend/cdb/cdbllize.c:132, cdbmutate.c:396). The physical
+compiler (exec/compile.py) walks the final tree.
+
+Column identity: the binder assigns every column a unique id string; nodes
+carry (output id -> type) schemas. TEXT columns additionally carry the
+(table, column) of the dictionary that encodes them.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.planner.locus import Locus
+
+
+@dataclass
+class ColInfo:
+    id: str
+    type: T.SqlType
+    name: str                      # user-facing output name
+    dict_ref: tuple[str, str] | None = None   # (table, column) for TEXT
+
+
+@dataclass
+class Plan:
+    locus: Locus | None = field(default=None, init=False)
+    est_rows: float = field(default=0.0, init=False)
+
+    @property
+    def children(self) -> list["Plan"]:
+        out = []
+        for a in ("child", "left", "right"):
+            c = getattr(self, a, None)
+            if c is not None:
+                out.append(c)
+        return out
+
+    # output schema
+    def out_cols(self) -> list[ColInfo]:
+        raise NotImplementedError
+
+
+@dataclass
+class Scan(Plan):
+    table: str
+    cols: list[ColInfo]            # id = unique, name = storage column name
+
+    def out_cols(self):
+        return self.cols
+
+
+@dataclass
+class Filter(Plan):
+    child: Plan
+    predicate: E.Expr
+
+    def out_cols(self):
+        return self.child.out_cols()
+
+
+@dataclass
+class Project(Plan):
+    child: Plan
+    exprs: list[tuple[ColInfo, E.Expr]]
+
+    def out_cols(self):
+        return [c for c, _ in self.exprs]
+
+
+@dataclass
+class Join(Plan):
+    kind: str                      # inner | left | semi | anti | cross
+    left: Plan
+    right: Plan                    # build side
+    left_keys: list[E.Expr]
+    right_keys: list[E.Expr]
+    residual: E.Expr | None = None
+
+    def out_cols(self):
+        if self.kind in ("semi", "anti"):
+            return self.left.out_cols()
+        return self.left.out_cols() + self.right.out_cols()
+
+
+@dataclass
+class Aggregate(Plan):
+    child: Plan
+    group_keys: list[tuple[ColInfo, E.Expr]]
+    aggs: list[tuple[ColInfo, E.Agg]]
+    phase: str = "single"          # single | partial | final
+    partial_state: list | None = None  # set on final nodes by the planner
+
+    def out_cols(self):
+        return [c for c, _ in self.group_keys] + [c for c, _ in self.aggs]
+
+
+@dataclass
+class Sort(Plan):
+    child: Plan
+    keys: list[tuple[E.Expr, bool, bool | None]]   # expr, desc, nulls_first
+
+    def out_cols(self):
+        return self.child.out_cols()
+
+
+@dataclass
+class Limit(Plan):
+    child: Plan
+    limit: int | None
+    offset: int = 0
+
+    def out_cols(self):
+        return self.child.out_cols()
+
+
+class MotionKind(enum.Enum):
+    REDISTRIBUTE = "Redistribute"
+    BROADCAST = "Broadcast"
+    GATHER = "Gather"              # to the coordinator (Entry)
+
+
+@dataclass
+class Motion(Plan):
+    kind: MotionKind
+    child: Plan
+    hash_exprs: list[E.Expr] = field(default_factory=list)  # REDISTRIBUTE only
+    merge_keys: list | None = None  # GATHER: preserve this sort order
+
+    def out_cols(self):
+        return self.child.out_cols()
+
+
+def describe(plan: Plan, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering (explain.c analog)."""
+    pad = "  " * indent
+    name = type(plan).__name__
+    extra = ""
+    if isinstance(plan, Scan):
+        extra = f" {plan.table}"
+    elif isinstance(plan, Join):
+        extra = f" {plan.kind}"
+    elif isinstance(plan, Motion):
+        extra = f" {plan.kind.value}"
+        if plan.hash_exprs:
+            extra += f" by ({', '.join(_expr_str(e) for e in plan.hash_exprs)})"
+    elif isinstance(plan, Aggregate):
+        extra = f" {plan.phase} keys=({', '.join(c.name for c, _ in plan.group_keys)})"
+    elif isinstance(plan, Limit):
+        extra = f" {plan.limit}"
+    locus = f"  [{plan.locus.describe()}]" if plan.locus else ""
+    rows = f" rows={int(plan.est_rows)}" if plan.est_rows else ""
+    lines = [f"{pad}{name}{extra}{locus}{rows}"]
+    for c in plan.children:
+        lines.append(describe(c, indent + 1))
+    return "\n".join(lines)
+
+
+def _expr_str(e: E.Expr) -> str:
+    if isinstance(e, E.ColRef):
+        return e.name
+    if isinstance(e, E.Literal):
+        return repr(e.value)
+    if isinstance(e, E.BinOp) or isinstance(e, E.Cmp):
+        return f"({_expr_str(e.left)} {e.op} {_expr_str(e.right)})"
+    return type(e).__name__

@@ -1,0 +1,336 @@
+"""The parallelizer: locus propagation + Motion insertion.
+
+Reference parity: cdbparallelize/apply_motion walking the plan and cutting
+it at Motions (src/backend/cdb/cdbllize.c:132, cdbmutate.c:396), with the
+join motion decision following cdbpath_motion_for_join
+(src/backend/cdb/cdbpath.c:922): colocated -> no motion; one side already
+hashed on its join keys -> redistribute the other; replicated side -> no
+motion; otherwise min-cost of (redistribute both, broadcast one).
+
+Aggregates follow the two/three-stage logic of cdbgroup.c:678: grouped by
+the distribution key -> one phase; otherwise partial agg -> Redistribute by
+group keys -> final merge; no group keys -> partial -> Gather -> final on
+the coordinator (Entry locus).
+"""
+
+from __future__ import annotations
+
+from greengage_tpu import expr as E
+from greengage_tpu.catalog import PolicyKind
+from greengage_tpu.planner import cost as C
+from greengage_tpu.planner.locus import Locus, LocusKind
+from greengage_tpu.planner.logical import (
+    Aggregate, ColInfo, Filter, Join, Limit, Motion, MotionKind, Plan, Project,
+    Scan, Sort,
+)
+
+
+class Planner:
+    def __init__(self, catalog, store, numsegments: int):
+        self.catalog = catalog
+        self.store = store
+        self.nseg = numsegments
+
+    # ------------------------------------------------------------------
+    def plan(self, node: Plan) -> Plan:
+        node = self._rec(node)
+        # top: deliver to the coordinator
+        if node.locus.kind is not LocusKind.ENTRY:
+            node = self._gather(node)
+        return node
+
+    def _rec(self, node: Plan) -> Plan:
+        m = getattr(self, "_plan_" + type(node).__name__.lower())
+        return m(node)
+
+    # ------------------------------------------------------------------
+    def _plan_scan(self, node: Scan) -> Plan:
+        schema = self.catalog.get(node.table)
+        pol = schema.policy
+        nseg = pol.numsegments
+        rows = sum(self.store.segment_rowcounts(node.table))
+        node.est_rows = float(rows)
+        if pol.kind is PolicyKind.HASH:
+            by_name = {c.name: c.id for c in node.cols}
+            try:
+                ids = tuple(by_name[k] for k in pol.keys)
+                node.locus = Locus.hashed(ids, nseg)
+            except KeyError:
+                # distribution key not scanned: still partitioned, key unknown
+                node.locus = Locus.strewn(nseg)
+        elif pol.kind is PolicyKind.REPLICATED:
+            node.locus = Locus.segment_general(nseg)
+        else:
+            node.locus = Locus.strewn(nseg)
+        return node
+
+    def _plan_filter(self, node: Filter) -> Plan:
+        node.child = self._rec(node.child)
+        node.locus = node.child.locus
+        node.est_rows = node.child.est_rows * C.filter_selectivity(node.predicate)
+        return node
+
+    def _plan_project(self, node: Project) -> Plan:
+        node.child = self._rec(node.child)
+        child_locus = node.child.locus
+        node.est_rows = node.child.est_rows
+        if child_locus.kind is LocusKind.HASHED:
+            # keep Hashed only if every distribution key passes through intact
+            passthrough = {
+                e.name for _, e in node.exprs if isinstance(e, E.ColRef)
+            }
+            if set(child_locus.keys) <= passthrough:
+                # rename locus keys to projected ids
+                rename = {
+                    e.name: c.id for c, e in node.exprs if isinstance(e, E.ColRef)
+                }
+                node.locus = Locus.hashed(
+                    tuple(rename[k] for k in child_locus.keys), child_locus.numsegments
+                )
+            else:
+                node.locus = Locus.strewn(child_locus.numsegments)
+        else:
+            node.locus = child_locus
+        return node
+
+    # ------------------------------------------------------------------
+    def _plan_join(self, node: Join) -> Plan:
+        node.left = self._rec(node.left)
+        node.right = self._rec(node.right)
+        left, right = node.left, node.right
+        nseg = self.nseg
+
+        # Build side choice: the hash-join kernel requires unique build keys
+        # (ops/join.py), so prefer the side whose join keys cover its scan's
+        # distribution keys (PK-shaped: TPC-H dimension tables are
+        # distributed by their primary key); among candidates pick the
+        # smaller. Inner joins may swap freely (outputs are selected by id).
+        if node.kind == "inner":
+            lu = _keys_look_unique(left, node.left_keys)
+            ru = _keys_look_unique(right, node.right_keys)
+            swap = False
+            if lu and not ru:
+                swap = True
+            elif lu == ru and left.est_rows < right.est_rows:
+                swap = True
+            if swap:
+                node.left, node.right = right, left
+                node.left_keys, node.right_keys = node.right_keys, node.left_keys
+                left, right = node.left, node.right
+
+        pairs = [
+            (lk.name if isinstance(lk, E.ColRef) else None,
+             rk.name if isinstance(rk, E.ColRef) else None)
+            for lk, rk in zip(node.left_keys, node.right_keys)
+        ]
+        l2r = {l: r for l, r in pairs if l and r}
+        r2l = {r: l for l, r in pairs if l and r}
+
+        def colocated() -> bool:
+            ll, rl = left.locus, right.locus
+            if not (ll.kind is LocusKind.HASHED and rl.kind is LocusKind.HASHED):
+                return False
+            if ll.numsegments != rl.numsegments or len(ll.keys) != len(rl.keys):
+                return False
+            return all(l2r.get(a) == b for a, b in zip(ll.keys, rl.keys))
+
+        def hashed_on_join_keys(locus: Locus, side_map: dict) -> bool:
+            return (locus.kind is LocusKind.HASHED
+                    and all(k in side_map for k in locus.keys))
+
+        if node.kind == "cross":
+            # broadcast the (smaller) right side
+            if right.locus.kind is not LocusKind.SEGMENT_GENERAL:
+                node.right = self._broadcast(right)
+            node.locus = left.locus
+        elif right.locus.kind in (LocusKind.SEGMENT_GENERAL, LocusKind.GENERAL):
+            node.locus = left.locus
+        elif left.locus.kind in (LocusKind.SEGMENT_GENERAL, LocusKind.GENERAL):
+            node.locus = right.locus if node.kind == "inner" else left.locus
+            if node.kind != "inner":
+                # outer/semi probe side replicated: broadcast build instead
+                node.right = self._broadcast(right)
+                node.locus = left.locus
+        elif colocated():
+            node.locus = left.locus
+        elif hashed_on_join_keys(left.locus, l2r):
+            # move build side to match probe's existing distribution
+            exprs = [node.right_keys[[l for l, _ in pairs].index(k)]
+                     for k in left.locus.keys]
+            node.right = self._redistribute(right, exprs,
+                                            tuple(l2r[k] for k in left.locus.keys))
+            node.locus = left.locus
+        elif hashed_on_join_keys(right.locus, r2l) and node.kind == "inner":
+            exprs = [node.left_keys[[r for _, r in pairs].index(k)]
+                     for k in right.locus.keys]
+            node.left = self._redistribute(left, exprs,
+                                           tuple(r2l[k] for k in right.locus.keys))
+            node.locus = right.locus
+        else:
+            # neither side usable: redistribute both vs broadcast build side
+            lw = C.row_width(left.out_cols())
+            rw = C.row_width(right.out_cols())
+            redist = C.motion_cost("redistribute", left.est_rows, lw, nseg) + \
+                C.motion_cost("redistribute", right.est_rows, rw, nseg)
+            bcast = C.motion_cost("broadcast", right.est_rows, rw, nseg)
+            if bcast < redist:
+                node.right = self._broadcast(right)
+                node.locus = left.locus
+            else:
+                lids = tuple(l for l, _ in pairs)
+                rids = tuple(r for _, r in pairs)
+                node.left = self._redistribute(left, list(node.left_keys), lids)
+                node.right = self._redistribute(right, list(node.right_keys), rids)
+                node.locus = node.left.locus
+        node.est_rows = max(left.est_rows, right.est_rows)
+        if node.kind in ("semi", "anti"):
+            node.est_rows = left.est_rows * 0.5
+        return node
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, node: Aggregate) -> Plan:
+        node.child = self._rec(node.child)
+        child = node.child
+        key_ids = tuple(
+            e.name for _, e in node.group_keys if isinstance(e, E.ColRef)
+        )
+        groups = C.est_groups(child.est_rows)
+
+        if not node.group_keys:
+            # scalar aggregate: partial everywhere -> broadcast the (tiny)
+            # partial states -> identical final merge on every segment
+            # (SEGMENT_GENERAL result; Gather later reads one segment).
+            # Keeps HAVING/projections above it on-device with no host path.
+            if child.locus.kind in (LocusKind.ENTRY, LocusKind.SINGLE_QE,
+                                    LocusKind.SEGMENT_GENERAL):
+                node.phase = "single"
+                node.locus = child.locus
+                node.est_rows = 1
+                return node
+            partial = self._make_partial(node)
+            moved = self._broadcast(partial)
+            final = self._make_final(node, partial, moved)
+            final.est_rows = 1
+            final.locus = Locus.segment_general(self.nseg)
+            return final
+
+        if (child.locus.kind is LocusKind.HASHED and child.locus.hashed_on(key_ids)) \
+                or child.locus.kind in (LocusKind.ENTRY, LocusKind.SINGLE_QE,
+                                        LocusKind.SEGMENT_GENERAL):
+            node.phase = "single"
+            node.locus = child.locus
+            node.est_rows = groups
+            return node
+
+        # two-phase: partial local -> redistribute by group keys -> final
+        partial = self._make_partial(node)
+        key_exprs = [E.ColRef(c.id, c.type) for c, _ in partial.group_keys]
+        moved = self._redistribute(
+            partial, key_exprs, tuple(c.id for c, _ in partial.group_keys))
+        final = self._make_final(node, partial, moved)
+        final.locus = moved.locus
+        final.est_rows = groups
+        return final
+
+    def _make_partial(self, node: Aggregate) -> Aggregate:
+        partial = Aggregate(
+            child=node.child, group_keys=node.group_keys, aggs=node.aggs,
+            phase="partial")
+        partial.locus = node.child.locus
+        partial.est_rows = min(
+            node.child.est_rows,
+            C.est_groups(node.child.est_rows) * max(self.nseg, 1))
+        return partial
+
+    def _make_final(self, node: Aggregate, partial: Aggregate, moved: Plan) -> Aggregate:
+        final = Aggregate(
+            child=moved, group_keys=node.group_keys, aggs=node.aggs, phase="final")
+        return final
+
+    # ------------------------------------------------------------------
+    def _plan_sort(self, node: Sort) -> Plan:
+        node.child = self._rec(node.child)
+        node.locus = node.child.locus
+        node.est_rows = node.child.est_rows
+        return node
+
+    def _plan_limit(self, node: Limit) -> Plan:
+        node.child = self._rec(node.child)
+        node.locus = node.child.locus
+        if node.limit is not None:
+            node.est_rows = min(node.child.est_rows, node.limit + node.offset)
+        else:
+            node.est_rows = node.child.est_rows
+        return node
+
+    # ------------------------------------------------------------------
+    def _redistribute(self, child: Plan, exprs: list, key_ids: tuple) -> Motion:
+        m = Motion(MotionKind.REDISTRIBUTE, child, hash_exprs=list(exprs))
+        m.locus = Locus.hashed(key_ids, self.nseg) if all(key_ids) else Locus.strewn(self.nseg)
+        m.est_rows = child.est_rows
+        return m
+
+    def _broadcast(self, child: Plan) -> Motion:
+        m = Motion(MotionKind.BROADCAST, child)
+        m.locus = Locus.segment_general(self.nseg)
+        m.est_rows = child.est_rows * self.nseg
+        return m
+
+    def _gather(self, child: Plan) -> Motion:
+        merge_keys = None
+        if isinstance(child, Sort):
+            merge_keys = child.keys
+        elif isinstance(child, Limit) and isinstance(child.child, Sort):
+            merge_keys = child.child.keys
+        m = Motion(MotionKind.GATHER, child, merge_keys=merge_keys)
+        m.locus = Locus.entry()
+        m.est_rows = child.est_rows
+        return m
+
+
+def _keys_look_unique(plan: Plan, key_exprs) -> bool:
+    """Heuristic uniqueness: the join keys include a column set that is some
+    underlying Scan's full hash-distribution key (tables are conventionally
+    distributed by primary key). Pass-through nodes are traversed; joins
+    against a unique side preserve the probe side's keys."""
+    ids = {e.name for e in key_exprs if isinstance(e, E.ColRef)}
+    if not ids:
+        return False
+    return _scan_covers(plan, ids)
+
+
+def _scan_covers(plan: Plan, ids: set) -> bool:
+    if isinstance(plan, Scan):
+        by_id = {c.id: c.name for c in plan.cols}
+        names = {by_id[i] for i in ids if i in by_id}
+        pol = plan.locus
+        from greengage_tpu.planner.locus import LocusKind as LK
+
+        if pol is not None and pol.kind is LK.HASHED:
+            key_names = set()
+            for c in plan.cols:
+                if c.id in pol.keys:
+                    key_names.add(c.name)
+            return bool(key_names) and key_names <= names
+        return False
+    if isinstance(plan, (Filter, Motion, Limit, Sort)):
+        return _scan_covers(plan.children[0], ids)
+    if isinstance(plan, Project):
+        # translate projected ids back to child ids for pass-through refs
+        back = {c.id: e.name for c, e in plan.exprs if isinstance(e, E.ColRef)}
+        child_ids = {back.get(i) for i in ids}
+        if None in child_ids:
+            return False
+        return _scan_covers(plan.child, child_ids)
+    if isinstance(plan, Aggregate):
+        # grouped output is unique on its full group key set
+        key_ids = {c.id for c, _ in plan.group_keys}
+        return bool(key_ids) and key_ids <= ids
+    if isinstance(plan, Join):
+        # unique(left) x unique-matched build keeps left keys unique
+        return _scan_covers(plan.left, ids)
+    return False
+
+
+def plan_query(root: Plan, catalog, store, numsegments: int) -> Plan:
+    return Planner(catalog, store, numsegments).plan(root)

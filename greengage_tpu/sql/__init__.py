@@ -1,0 +1,1 @@
+from greengage_tpu.sql.parser import parse  # noqa: F401

@@ -1,0 +1,231 @@
+"""SQL abstract syntax — output of the parser, input to the binder.
+
+Covers the analytical core of the reference's PostgreSQL 9.4 grammar
+(src/backend/parser/gram.y): SELECT with joins/grouping/ordering, DDL with
+Greenplum DISTRIBUTED clauses (exttablecmds/gram.y GP extensions), INSERT,
+COPY, EXPLAIN.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# ---- expressions ----------------------------------------------------------
+
+@dataclass
+class ANode:
+    pass
+
+
+@dataclass
+class Name(ANode):
+    parts: tuple[str, ...]        # possibly qualified: (alias, col) or (col,)
+
+
+@dataclass
+class Num(ANode):
+    text: str
+
+
+@dataclass
+class Str(ANode):
+    value: str
+
+
+@dataclass
+class DateLit(ANode):
+    value: str
+
+
+@dataclass
+class IntervalLit(ANode):
+    value: str
+    unit: str                     # day | month | year
+
+
+@dataclass
+class Null(ANode):
+    pass
+
+
+@dataclass
+class Bool(ANode):
+    value: bool
+
+
+@dataclass
+class Star(ANode):
+    table: str | None = None      # t.* or *
+
+
+@dataclass
+class Bin(ANode):
+    op: str
+    left: ANode
+    right: ANode
+
+
+@dataclass
+class Unary(ANode):
+    op: str                       # - | not
+    arg: ANode
+
+
+@dataclass
+class IsNullTest(ANode):
+    arg: ANode
+    negate: bool
+
+
+@dataclass
+class Between(ANode):
+    arg: ANode
+    lo: ANode
+    hi: ANode
+    negate: bool = False
+
+
+@dataclass
+class InExpr(ANode):
+    arg: ANode
+    values: list[ANode]
+    negate: bool = False
+
+
+@dataclass
+class LikeExpr(ANode):
+    arg: ANode
+    pattern: str
+    negate: bool = False
+
+
+@dataclass
+class CaseExpr(ANode):
+    whens: list[tuple[ANode, ANode]]
+    else_: ANode | None
+
+
+@dataclass
+class CastExpr(ANode):
+    arg: ANode
+    type_name: str
+    typmod: tuple[int, ...] = ()
+
+
+@dataclass
+class FuncCall(ANode):
+    name: str
+    args: list[ANode]
+    star: bool = False            # count(*)
+    distinct: bool = False
+
+
+@dataclass
+class ExtractExpr(ANode):
+    field: str                    # year | month | day
+    arg: ANode
+
+
+# ---- query structure ------------------------------------------------------
+
+@dataclass
+class TableRef(ANode):
+    pass
+
+
+@dataclass
+class BaseTable(TableRef):
+    name: str
+    alias: str | None = None
+
+
+@dataclass
+class SubqueryRef(TableRef):
+    query: "SelectStmt"
+    alias: str = ""
+
+
+@dataclass
+class JoinRef(TableRef):
+    kind: str                     # inner | left | cross
+    left: TableRef
+    right: TableRef
+    on: ANode | None = None
+
+
+@dataclass
+class SelectItem(ANode):
+    expr: ANode
+    alias: str | None = None
+
+
+@dataclass
+class OrderItem(ANode):
+    expr: ANode
+    desc: bool = False
+    nulls_first: bool | None = None
+
+
+@dataclass
+class SelectStmt(ANode):
+    items: list[SelectItem] = field(default_factory=list)
+    from_: list[TableRef] = field(default_factory=list)
+    where: ANode | None = None
+    group_by: list[ANode] = field(default_factory=list)
+    having: ANode | None = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int = 0
+    distinct: bool = False
+
+
+# ---- DDL / DML / utility --------------------------------------------------
+
+@dataclass
+class ColumnDef(ANode):
+    name: str
+    type_name: str
+    typmod: tuple[int, ...] = ()
+    not_null: bool = False
+
+
+@dataclass
+class CreateTableStmt(ANode):
+    name: str
+    columns: list[ColumnDef]
+    dist_kind: str = "hash"       # hash | random | replicated
+    dist_keys: list[str] = field(default_factory=list)
+    options: dict = field(default_factory=dict)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt(ANode):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertStmt(ANode):
+    table: str
+    columns: list[str]
+    rows: list[list[ANode]]
+
+
+@dataclass
+class CopyStmt(ANode):
+    table: str
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class ExplainStmt(ANode):
+    query: ANode
+    analyze: bool = False
+
+
+@dataclass
+class ShowStmt(ANode):
+    what: str

@@ -1,0 +1,868 @@
+"""Binder / semantic analyzer: AST -> typed logical plan.
+
+The parse_analyze + subquery_planner front half of the reference
+(src/backend/parser/analyze.c, optimizer/plan/planner.c) collapsed into one
+pass: name resolution, type checking/coercion, aggregate extraction,
+predicate pushdown, greedy equi-join ordering for comma-FROM, and the
+string-dictionary lowering described in greengage_tpu/expr.py (literals ->
+codes, LIKE -> LUTs, cross-dictionary equality -> translation LUTs).
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+
+import numpy as np
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.planner.logical import (
+    Aggregate, ColInfo, Filter, Join, Limit, Plan, Project, Scan, Sort,
+)
+from greengage_tpu.sql import ast as A
+from greengage_tpu.sql.parser import SqlError
+
+_TYPE_MAP = {
+    "int": T.INT32, "integer": T.INT32, "int4": T.INT32, "smallint": T.INT32,
+    "bigint": T.INT64, "int8": T.INT64,
+    "double precision": T.FLOAT64, "float8": T.FLOAT64, "float": T.FLOAT64,
+    "real": T.FLOAT64,
+    "date": T.DATE,
+    "bool": T.BOOL, "boolean": T.BOOL,
+    "text": T.TEXT, "varchar": T.TEXT, "char": T.TEXT, "character": T.TEXT,
+    "bpchar": T.TEXT,
+}
+
+
+def type_from_name(name: str, typmod: tuple[int, ...]) -> T.SqlType:
+    name = name.lower()
+    if name in ("decimal", "numeric"):
+        scale = typmod[1] if len(typmod) > 1 else 0
+        return T.decimal(scale)
+    if name in _TYPE_MAP:
+        return _TYPE_MAP[name]
+    raise SqlError(f"unknown type {name}")
+
+
+class Scope:
+    """Visible columns: list of (alias, {colname: ColInfo})."""
+
+    def __init__(self):
+        self.tables: list[tuple[str, dict[str, ColInfo]]] = []
+
+    def add(self, alias: str, cols: dict[str, ColInfo]):
+        if any(a == alias for a, _ in self.tables):
+            raise SqlError(f'duplicate table alias "{alias}"')
+        self.tables.append((alias, cols))
+
+    def merged(self, other: "Scope") -> "Scope":
+        s = Scope()
+        s.tables = self.tables + other.tables
+        return s
+
+    def resolve(self, parts: tuple[str, ...]) -> ColInfo:
+        if len(parts) == 2:
+            for a, cols in self.tables:
+                if a == parts[0]:
+                    if parts[1] not in cols:
+                        raise SqlError(f'column "{parts[0]}.{parts[1]}" does not exist')
+                    return cols[parts[1]]
+            raise SqlError(f'missing FROM-clause entry for table "{parts[0]}"')
+        hits = [cols[parts[0]] for _, cols in self.tables if parts[0] in cols]
+        if not hits:
+            raise SqlError(f'column "{parts[0]}" does not exist')
+        if len(hits) > 1:
+            raise SqlError(f'column reference "{parts[0]}" is ambiguous')
+        return hits[0]
+
+    def all_cols(self) -> list[ColInfo]:
+        return [c for _, cols in self.tables for c in cols.values()]
+
+    def table_cols(self, alias: str) -> list[ColInfo]:
+        for a, cols in self.tables:
+            if a == alias:
+                return list(cols.values())
+        raise SqlError(f'unknown table "{alias}"')
+
+
+class Binder:
+    def __init__(self, catalog, store):
+        self.catalog = catalog
+        self.store = store
+        self._uid = itertools.count()
+        self.consts: dict[str, np.ndarray] = {}   # LUT pool shipped to device
+
+    def new_id(self, hint: str) -> str:
+        return f"{hint}#{next(self._uid)}"
+
+    # ------------------------------------------------------------------
+    # entry
+    # ------------------------------------------------------------------
+    def bind_select(self, stmt: A.SelectStmt) -> tuple[Plan, list[ColInfo]]:
+        plan, outs = self._bind_select(stmt)
+        needed = set()
+        _collect_needed(plan, needed)
+        _prune_scans(plan, needed)
+        return plan, outs
+
+    # ------------------------------------------------------------------
+    def _bind_select(self, stmt: A.SelectStmt) -> tuple[Plan, list[ColInfo]]:
+        plan, scope, leftover = self._bind_from(stmt.from_, stmt.where)
+        if leftover is not None:
+            f = Filter(plan, self._predicate(leftover, scope))
+            plan = f
+
+        # aggregate detection
+        has_aggs = any(
+            _contains_agg(it.expr) for it in stmt.items
+        ) or (stmt.having is not None and _contains_agg(stmt.having)) or stmt.group_by
+
+        if has_aggs:
+            plan, agg_scope, rewrites = self._bind_aggregate(stmt, plan, scope)
+            out_scope, sel_exprs = self._bind_select_items(stmt, agg_scope, rewrites)
+        else:
+            if stmt.having is not None:
+                raise SqlError("HAVING requires GROUP BY or aggregates")
+            out_scope, sel_exprs = self._bind_select_items(stmt, scope, {})
+
+        proj_cols = [c for c, _ in sel_exprs]
+        plan = Project(plan, sel_exprs)
+
+        if stmt.distinct:
+            keys = [(c, E.ColRef(c.id, c.type)) for c in proj_cols]
+            plan = Aggregate(plan, keys, [])
+
+        if stmt.order_by:
+            keys = []
+            for oi in stmt.order_by:
+                e = self._bind_order_expr(oi.expr, proj_cols, out_scope)
+                keys.append((e, oi.desc, oi.nulls_first))
+            plan = Sort(plan, keys)
+        if stmt.limit is not None or stmt.offset:
+            plan = Limit(plan, stmt.limit, stmt.offset)
+        return plan, proj_cols
+
+    # ------------------------------------------------------------------
+    # FROM binding with pushdown + greedy join ordering
+    # ------------------------------------------------------------------
+    def _bind_from(self, from_, where):
+        if not from_:
+            raise SqlError("SELECT without FROM is not supported")
+        items = [self._bind_table_ref(t) for t in from_]
+
+        conjuncts = _split_and(where) if where is not None else []
+
+        if len(items) == 1:
+            plan, scope = items[0]
+            plan = self._push_filters(plan, scope, conjuncts)
+            return plan, scope, None
+
+        # comma-FROM: greedy equi-join ordering (join-order search analog,
+        # CJoinOrderGreedy in ORCA / make_rel_from_joinlist in the planner)
+        remaining = list(items)
+        conds = list(conjuncts)
+        # push single-table predicates first
+        for i, (p, s) in enumerate(remaining):
+            p2, conds = self._push_single_table(p, s, conds)
+            remaining[i] = (p2, s)
+
+        plan, scope = remaining.pop(0)
+        while remaining:
+            picked = None
+            for i, (rp, rs) in enumerate(remaining):
+                eq, rest = _extract_equi(conds, scope, rs)
+                if eq:
+                    picked = (i, rp, rs, eq, rest)
+                    break
+            if picked is None:  # no equi edge: cross join the next one
+                rp, rs = remaining.pop(0)
+                join = Join("cross", plan, rp, [], [])
+                scope = scope.merged(rs)
+                plan = join
+                continue
+            i, rp, rs, eq, conds = picked
+            remaining.pop(i)
+            lkeys = [self._expr(lhs, scope) for lhs, _ in eq]
+            rkeys = [self._expr(rhs, rs) for _, rhs in eq]
+            lkeys, rkeys = self._align_join_keys(lkeys, rkeys)
+            plan = Join("inner", plan, rp, lkeys, rkeys)
+            scope = scope.merged(rs)
+        leftover = _join_and(conds)
+        return plan, scope, leftover
+
+    def _bind_table_ref(self, t: A.TableRef):
+        if isinstance(t, A.BaseTable):
+            schema = self.catalog.get(t.name)
+            cols = {}
+            out = []
+            for c in schema.columns:
+                ci = ColInfo(
+                    self.new_id(c.name), c.type, c.name,
+                    dict_ref=(t.name, c.name) if c.type.kind is T.Kind.TEXT else None,
+                )
+                cols[c.name] = ci
+                out.append(ci)
+            scan = Scan(t.name, out)
+            scope = Scope()
+            scope.add(t.alias or t.name, cols)
+            return scan, scope
+        if isinstance(t, A.SubqueryRef):
+            plan, outs = self._bind_select(t.query)
+            scope = Scope()
+            scope.add(t.alias, {c.name: c for c in outs})
+            return plan, scope
+        if isinstance(t, A.JoinRef):
+            lp, ls = self._bind_table_ref(t.left)
+            rp, rs = self._bind_table_ref(t.right)
+            merged = ls.merged(rs)
+            if t.kind == "cross":
+                return Join("cross", lp, rp, [], []), merged
+            conjuncts = _split_and(t.on)
+            eq, rest = _extract_equi(conjuncts, ls, rs)
+            if not eq:
+                raise SqlError("join requires at least one equality condition")
+            lkeys = [self._expr(l, ls) for l, _ in eq]
+            rkeys = [self._expr(r, rs) for _, r in eq]
+            lkeys, rkeys = self._align_join_keys(lkeys, rkeys)
+            residual = _join_and(rest)
+            join = Join(t.kind, lp, rp, lkeys, rkeys,
+                        residual=self._predicate(residual, merged) if residual else None)
+            return join, merged
+        raise SqlError(f"unsupported FROM item {type(t).__name__}")
+
+    def _align_join_keys(self, lkeys, rkeys):
+        """Type-align join key pairs; TEXT pairs from different dictionaries
+        get a translation LUT on the right side."""
+        out_l, out_r = [], []
+        for lk, rk in zip(lkeys, rkeys):
+            lt, rt = lk.type, rk.type
+            if lt.kind is T.Kind.TEXT and rt.kind is T.Kind.TEXT:
+                ld = _dict_ref_of(lk)
+                rd = _dict_ref_of(rk)
+                if ld != rd and ld is not None and rd is not None:
+                    left_dict = self.store.dictionary(*ld)
+                    right_dict = self.store.dictionary(*rd)
+                    lut = np.array(
+                        [left_dict.lookup(v) for v in right_dict.values] + [-1],
+                        dtype=np.int32,
+                    )
+                    tid = self._const(lut)
+                    rk = E.Lut(rk, tid, type=T.TEXT)
+            elif lt != rt:
+                common = T.promote(lt, rt)
+                if lt != common:
+                    lk = E.Cast(lk, common)
+                if rt != common:
+                    rk = E.Cast(rk, common)
+            out_l.append(lk)
+            out_r.append(rk)
+        return out_l, out_r
+
+    def _push_filters(self, plan, scope, conjuncts):
+        if conjuncts:
+            pred = self._predicate(_join_and(conjuncts), scope)
+            plan = Filter(plan, pred)
+        return plan
+
+    def _push_single_table(self, plan, scope, conds):
+        mine, rest = [], []
+        names = {c.name for c in scope.all_cols()} | {
+            f"{a}.{n}" for a, cols in scope.tables for n in cols
+        }
+        for c in conds:
+            refs = _name_refs(c)
+            if refs and all(self._resolvable(r, scope) for r in refs):
+                mine.append(c)
+            else:
+                rest.append(c)
+        if mine:
+            plan = Filter(plan, self._predicate(_join_and(mine), scope))
+        return plan, rest
+
+    def _resolvable(self, parts, scope) -> bool:
+        try:
+            scope.resolve(parts)
+            return True
+        except SqlError:
+            return False
+
+    # ------------------------------------------------------------------
+    # aggregation
+    # ------------------------------------------------------------------
+    def _bind_aggregate(self, stmt, plan, scope):
+        # 1. bind group key exprs
+        group_exprs = []
+        for g in stmt.group_by:
+            if isinstance(g, A.Num):   # ordinal
+                idx = int(g.text) - 1
+                g = stmt.items[idx].expr
+            group_exprs.append((g, self._expr(g, scope)))
+
+        # 2. collect aggregate calls across select/having/order
+        agg_nodes: list[A.FuncCall] = []
+
+        def collect(n):
+            if isinstance(n, A.FuncCall) and n.name in ("count", "sum", "avg", "min", "max"):
+                agg_nodes.append(n)
+                return
+            for ch in _ast_children(n):
+                collect(ch)
+
+        for it in stmt.items:
+            collect(it.expr)
+        if stmt.having is not None:
+            collect(stmt.having)
+        for oi in stmt.order_by:
+            collect(oi.expr)
+
+        # 3. build input projection: group keys + agg args
+        proj: list[tuple[ColInfo, E.Expr]] = []
+        key_cols: list[tuple[ColInfo, E.Expr]] = []
+        for gast, ge in group_exprs:
+            ci = ColInfo(self.new_id("g"), ge.type, _ast_name(gast), _dict_ref_of(ge))
+            proj.append((ci, ge))
+            key_cols.append((ci, E.ColRef(ci.id, ci.type)))
+
+        aggs: list[tuple[ColInfo, E.Agg]] = []
+        agg_map: dict[int, ColInfo] = {}
+        for fc in agg_nodes:
+            if fc.star:
+                arg = None
+                arg_ref = None
+                atype = None
+            else:
+                ae = self._expr(fc.args[0], scope)
+                atype = ae.type
+                ci_in = ColInfo(self.new_id("a_in"), ae.type, "arg", _dict_ref_of(ae))
+                proj.append((ci_in, ae))
+                arg_ref = E.ColRef(ci_in.id, ci_in.type)
+            func = "count_star" if fc.star else fc.name
+            rtype = E.agg_result_type(func, atype)
+            agg = E.Agg(func, arg_ref, fc.distinct, rtype)
+            ci = ColInfo(self.new_id(func), rtype, func)
+            aggs.append((ci, agg))
+            agg_map[id(fc)] = ci
+
+        plan = Project(plan, proj)
+        plan = Aggregate(plan, key_cols, aggs)
+
+        # 4. scope over agg outputs; rewrites: ast node -> ColInfo
+        out_scope = Scope()
+        cols = {}
+        rewrites: dict = {}
+        for (gast, _), (ci, _) in zip(group_exprs, key_cols):
+            rewrites[_ast_key(gast)] = ci
+            cols[ci.name] = ci
+        for fc in agg_nodes:
+            rewrites[id(fc)] = agg_map[id(fc)]
+        out_scope.add("", cols)
+
+        if stmt.having is not None:
+            pred = self._rewritten_predicate(stmt.having, rewrites, scope)
+            plan = Filter(plan, pred)
+        return plan, out_scope, rewrites
+
+    def _bind_select_items(self, stmt, scope, rewrites):
+        sel_exprs: list[tuple[ColInfo, E.Expr]] = []
+        for it in stmt.items:
+            if isinstance(it.expr, A.Star):
+                if rewrites:
+                    raise SqlError("* not allowed with GROUP BY")
+                cols = (scope.table_cols(it.expr.table) if it.expr.table
+                        else scope.all_cols())
+                for c in cols:
+                    ci = ColInfo(self.new_id(c.name), c.type, c.name, c.dict_ref)
+                    sel_exprs.append((ci, E.ColRef(c.id, c.type)))
+                continue
+            e = self._rewritten_expr(it.expr, rewrites, scope)
+            name = it.alias or _ast_name(it.expr)
+            ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e))
+            sel_exprs.append((ci, e))
+        return scope, sel_exprs
+
+    def _bind_order_expr(self, ast, proj_cols, scope):
+        if isinstance(ast, A.Num) and re.fullmatch(r"\d+", ast.text):
+            idx = int(ast.text) - 1
+            if not 0 <= idx < len(proj_cols):
+                raise SqlError(f"ORDER BY position {idx+1} out of range")
+            c = proj_cols[idx]
+            return _colref(c)
+        if isinstance(ast, A.Name):
+            # match output alias; qualified names fall back to the bare
+            # column name (the projection renamed it on the way out)
+            for c in proj_cols:
+                if c.name == ast.parts[-1]:
+                    return _colref(c)
+        # expression over output columns
+        s = Scope()
+        s.add("", {c.name: c for c in proj_cols})
+        try:
+            return self._expr(ast, s)
+        except SqlError:
+            raise SqlError("ORDER BY must reference output columns")
+
+    # ------------------------------------------------------------------
+    # expression binding
+    # ------------------------------------------------------------------
+    def _predicate(self, ast, scope) -> E.Expr:
+        e = self._expr(ast, scope)
+        if e.type.kind is not T.Kind.BOOL:
+            raise SqlError("predicate must be boolean")
+        return e
+
+    def _rewritten_expr(self, ast, rewrites, scope) -> E.Expr:
+        if rewrites:
+            hit = rewrites.get(id(ast)) or rewrites.get(_ast_key(ast))
+            if hit is not None:
+                return _colref(hit)
+            if isinstance(ast, A.FuncCall) and ast.name in ("count", "sum", "avg", "min", "max"):
+                raise SqlError("unmatched aggregate")  # should be in rewrites
+            if isinstance(ast, A.Name):
+                raise SqlError(
+                    f'column "{".".join(ast.parts)}" must appear in GROUP BY')
+            if isinstance(ast, (A.Num, A.Str, A.Null, A.Bool, A.DateLit)):
+                return self._expr(ast, scope)
+            clone = _ast_rebind(ast, lambda ch: self._rewritten_expr(ch, rewrites, scope))
+            if clone is not None:
+                return clone
+            return self._expr(ast, scope)
+        return self._expr(ast, scope)
+
+    def _rewritten_predicate(self, ast, rewrites, scope) -> E.Expr:
+        e = self._rewritten_expr(ast, rewrites, scope)
+        if e.type.kind is not T.Kind.BOOL:
+            raise SqlError("HAVING must be boolean")
+        return e
+
+    def _expr(self, ast, scope) -> E.Expr:
+        if isinstance(ast, A.Name):
+            c = scope.resolve(ast.parts)
+            return _colref(c)
+        if isinstance(ast, A.Num):
+            if "." in ast.text:
+                frac = len(ast.text.split(".")[1])
+                return E.Literal(T.decimal_to_int(ast.text, frac), T.decimal(frac))
+            v = int(ast.text)
+            return E.Literal(v, T.literal_type(v))
+        if isinstance(ast, A.Str):
+            return E.Literal(ast.value, T.TEXT)  # coerced by context
+        if isinstance(ast, A.Null):
+            return E.Literal(None, T.INT32)
+        if isinstance(ast, A.Bool):
+            return E.Literal(ast.value, T.BOOL)
+        if isinstance(ast, A.DateLit):
+            return E.Literal(T.date_to_days(ast.value), T.DATE)
+        if isinstance(ast, A.IntervalLit):
+            raise SqlError("interval is only supported in date +/- interval")
+        if isinstance(ast, A.Unary):
+            if ast.op == "not":
+                return E.Not(self._predicate(ast.arg, scope))
+            a = self._expr(ast.arg, scope)
+            if isinstance(a, E.Literal) and a.value is not None:
+                return E.Literal(-a.value, a.type)
+            return E.BinOp("-", E.Literal(0, a.type), a, a.type)
+        if isinstance(ast, A.Bin):
+            if ast.op in ("and", "or"):
+                return E.BoolOp(ast.op, (self._predicate(ast.left, scope),
+                                         self._predicate(ast.right, scope)))
+            if ast.op in ("=", "<>", "<", "<=", ">", ">="):
+                return self._bind_cmp(ast, scope)
+            return self._bind_arith(ast, scope)
+        if isinstance(ast, A.IsNullTest):
+            return E.IsNull(self._expr(ast.arg, scope), ast.negate)
+        if isinstance(ast, A.Between):
+            arg = ast.arg
+            lo = A.Bin(">=", arg, ast.lo)
+            hi = A.Bin("<=", arg, ast.hi)
+            e = E.BoolOp("and", (self._bind_cmp(lo, scope), self._bind_cmp(hi, scope)))
+            return E.Not(e) if ast.negate else e
+        if isinstance(ast, A.InExpr):
+            arg = self._expr(ast.arg, scope)
+            d = _dict_ref_of(arg) if arg.type.kind is T.Kind.TEXT else None
+            dictionary = self.store.dictionary(*d) if d else None
+            vals = []
+            for v in ast.values:
+                lit = self._expr(v, scope)
+                if not isinstance(lit, E.Literal):
+                    raise SqlError("IN list must be literals")
+                if dictionary is not None:
+                    vals.append(dictionary.lookup(lit.value))  # -1 = matches nothing
+                else:
+                    vals.append(self._coerce_literal(lit, arg.type).value)
+            e = E.InList(arg, tuple(vals))
+            return E.Not(e) if ast.negate else e
+        if isinstance(ast, A.LikeExpr):
+            arg = self._expr(ast.arg, scope)
+            if arg.type.kind is not T.Kind.TEXT:
+                raise SqlError("LIKE requires a text column")
+            d = _dict_ref_of(arg)
+            if d is None:
+                raise SqlError("LIKE requires a dictionary-backed column")
+            dictionary = self.store.dictionary(*d)
+            rx = _like_to_regex(ast.pattern)
+            lut = np.array([bool(rx.fullmatch(v)) for v in dictionary.values] + [False])
+            e = E.Lut(arg, self._const(lut), type=T.BOOL)
+            return E.Not(e) if ast.negate else e
+        if isinstance(ast, A.CaseExpr):
+            whens = []
+            vals = []
+            for c, v in ast.whens:
+                whens.append(self._predicate(c, scope))
+                vals.append(self._expr(v, scope))
+            else_e = self._expr(ast.else_, scope) if ast.else_ is not None else None
+            out_t = vals[0].type
+            for v in vals[1:]:
+                out_t = T.promote(out_t, v.type)
+            if else_e is not None and else_e.type != out_t:
+                out_t = T.promote(out_t, else_e.type)
+            return E.Case(tuple(zip(whens, vals)), else_e, out_t)
+        if isinstance(ast, A.CastExpr):
+            a = self._expr(ast.arg, scope)
+            target = type_from_name(ast.type_name, ast.typmod)
+            if isinstance(a, E.Literal):
+                return self._coerce_literal(a, target)
+            return E.Cast(a, target)
+        if isinstance(ast, A.ExtractExpr):
+            a = self._expr(ast.arg, scope)
+            if a.type.kind is not T.Kind.DATE:
+                raise SqlError("extract() requires a date")
+            f = ast.field.lower()
+            if f not in ("year", "month", "day"):
+                raise SqlError(f"extract({f}) unsupported")
+            return E.Func(f"extract_{f}", (a,), T.INT32)
+        if isinstance(ast, A.FuncCall):
+            if ast.name in ("count", "sum", "avg", "min", "max"):
+                raise SqlError(f"aggregate {ast.name}() not allowed here")
+            if ast.name == "abs":
+                a = self._expr(ast.args[0], scope)
+                return E.Func("abs", (a,), a.type)
+            raise SqlError(f"unknown function {ast.name}")
+        raise SqlError(f"cannot bind {type(ast).__name__}")
+
+    # ---- comparisons with literal coercion ----------------------------
+    def _bind_cmp(self, ast: A.Bin, scope) -> E.Expr:
+        le = self._expr(ast.left, scope)
+        re_ = self._expr(ast.right, scope)
+        le, re_ = self._coerce_pair(le, re_)
+        return E.Cmp(ast.op, le, re_)
+
+    def _coerce_pair(self, le: E.Expr, re_: E.Expr):
+        lt, rt = le.type, re_.type
+        # unknown string literal adopts the other side's type
+        if isinstance(re_, E.Literal) and rt.kind is T.Kind.TEXT and lt.kind is not T.Kind.TEXT:
+            re_ = self._coerce_literal(re_, lt)
+            rt = re_.type
+        if isinstance(le, E.Literal) and lt.kind is T.Kind.TEXT and rt.kind is not T.Kind.TEXT:
+            le = self._coerce_literal(le, rt)
+            lt = le.type
+        if lt.kind is T.Kind.TEXT and rt.kind is T.Kind.TEXT:
+            # literal vs column: dictionary code; col vs col: translate dicts
+            if isinstance(re_, E.Literal):
+                d = _dict_ref_of(le)
+                code = self.store.dictionary(*d).lookup(re_.value) if d else -1
+                return le, E.Literal(code, T.TEXT)
+            if isinstance(le, E.Literal):
+                d = _dict_ref_of(re_)
+                code = self.store.dictionary(*d).lookup(le.value) if d else -1
+                return E.Literal(code, T.TEXT), re_
+            ld, rd = _dict_ref_of(le), _dict_ref_of(re_)
+            if ld != rd and ld is not None and rd is not None:
+                left_dict = self.store.dictionary(*ld)
+                right_dict = self.store.dictionary(*rd)
+                lut = np.array(
+                    [left_dict.lookup(v) for v in right_dict.values] + [-1],
+                    dtype=np.int32)
+                re_ = E.Lut(re_, self._const(lut), type=T.TEXT)
+            return le, re_
+        if lt == rt:
+            return le, re_
+        common = T.promote(lt, rt)
+        if isinstance(le, E.Literal):
+            le = self._coerce_literal(le, common)
+        elif lt != common:
+            le = E.Cast(le, common)
+        if isinstance(re_, E.Literal):
+            re_ = self._coerce_literal(re_, common)
+        elif rt != common:
+            re_ = E.Cast(re_, common)
+        return le, re_
+
+    def _coerce_literal(self, lit: E.Literal, target: T.SqlType) -> E.Literal:
+        if lit.value is None:
+            return E.Literal(None, target)
+        if lit.type == target:
+            return lit
+        v = lit.value
+        k = target.kind
+        if lit.type.kind is T.Kind.TEXT:
+            if k is T.Kind.TEXT:
+                return lit
+            try:
+                return E.Literal(T.from_string(v, target), target)
+            except ValueError as ex:
+                raise SqlError(f"cannot coerce string literal to {target}: {ex}")
+        if k is T.Kind.DECIMAL:
+            if lit.type.kind is T.Kind.DECIMAL:
+                from greengage_tpu.ops.expr_eval import _rescale_host
+                return E.Literal(_rescale_host(v, lit.type.scale, target.scale), target)
+            return E.Literal(int(v) * 10 ** target.scale, target)
+        if k is T.Kind.FLOAT64:
+            if lit.type.kind is T.Kind.DECIMAL:
+                return E.Literal(v / 10 ** lit.type.scale, target)
+            return E.Literal(float(v), target)
+        if k in (T.Kind.INT32, T.Kind.INT64):
+            return E.Literal(int(v), target)
+        raise SqlError(f"cannot coerce {lit.type} literal to {target}")
+
+    # ---- date +/- interval constant folding ---------------------------
+    def _bind_arith(self, ast: A.Bin, scope) -> E.Expr:
+        # date +/- interval folds at bind time (calendar math on host)
+        if isinstance(ast.right, A.IntervalLit) and ast.op in ("+", "-"):
+            base = self._expr(ast.left, scope)
+            if base.type.kind is not T.Kind.DATE or not isinstance(base, E.Literal):
+                raise SqlError("interval arithmetic requires a date literal")
+            days = _apply_interval(base.value, ast.right, ast.op)
+            return E.Literal(days, T.DATE)
+        le = self._expr(ast.left, scope)
+        re_ = self._expr(ast.right, scope)
+        # unknown literal coercion mirrors comparison
+        if isinstance(re_, E.Literal) and re_.type.kind is T.Kind.TEXT:
+            re_ = self._coerce_literal(re_, le.type)
+        if isinstance(le, E.Literal) and le.type.kind is T.Kind.TEXT:
+            le = self._coerce_literal(le, re_.type)
+        rtype = T.arith_result(ast.op, le.type, re_.type)
+        return E.BinOp(ast.op, le, re_, rtype)
+
+    def _const(self, arr: np.ndarray) -> str:
+        tid = f"lut{len(self.consts)}"
+        self.consts[tid] = arr
+        return tid
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+def _colref(c: ColInfo) -> E.ColRef:
+    e = E.ColRef(c.id, c.type)
+    if c.dict_ref is not None:
+        object.__setattr__(e, "_dict_ref", c.dict_ref)
+    return e
+
+
+def _dict_ref_of(e: E.Expr):
+    return getattr(e, "_dict_ref", None)
+
+
+def _contains_agg(ast) -> bool:
+    if isinstance(ast, A.FuncCall) and ast.name in ("count", "sum", "avg", "min", "max"):
+        return True
+    return any(_contains_agg(c) for c in _ast_children(ast))
+
+
+def _ast_children(ast):
+    for f in ("left", "right", "arg", "lo", "hi", "else_", "query"):
+        v = getattr(ast, f, None)
+        if isinstance(v, A.ANode):
+            yield v
+    for v in getattr(ast, "args", []) or []:
+        yield v
+    for v in getattr(ast, "values", []) or []:
+        if isinstance(v, A.ANode):
+            yield v
+    for c, v in getattr(ast, "whens", []) or []:
+        yield c
+        yield v
+
+
+def _ast_key(ast) -> str:
+    """Structural key for GROUP BY expression matching."""
+    if isinstance(ast, A.Name):
+        return "n:" + ".".join(ast.parts)
+    if isinstance(ast, A.Num):
+        return "#" + ast.text
+    if isinstance(ast, A.Str):
+        return "s:" + ast.value
+    parts = [type(ast).__name__, getattr(ast, "op", ""), getattr(ast, "name", ""),
+             getattr(ast, "field", "")]
+    for c in _ast_children(ast):
+        parts.append(_ast_key(c))
+    return "(" + " ".join(parts) + ")"
+
+
+def _ast_rebind(ast, rec):
+    """Rebuild scalar AST nodes whose children may contain agg/key refs."""
+    if isinstance(ast, A.Bin):
+        l = rec(ast.left)
+        r = rec(ast.right)
+        if ast.op in ("and", "or"):
+            return E.BoolOp(ast.op, (l, r))
+        if ast.op in ("=", "<>", "<", "<=", ">", ">="):
+            lt, rt = l.type, r.type
+            if lt != rt:
+                common = T.promote(lt, rt)
+                if lt != common:
+                    l = E.Cast(l, common)
+                if rt != common:
+                    r = E.Cast(r, common)
+            return E.Cmp(ast.op, l, r)
+        return E.BinOp(ast.op, l, r, T.arith_result(ast.op, l.type, r.type))
+    if isinstance(ast, A.Unary) and ast.op == "-":
+        a = rec(ast.arg)
+        return E.BinOp("-", E.Literal(0, a.type), a, a.type)
+    if isinstance(ast, A.IsNullTest):
+        return E.IsNull(rec(ast.arg), ast.negate)
+    return None
+
+
+def _name_refs(ast) -> list[tuple[str, ...]]:
+    out = []
+    if isinstance(ast, A.Name):
+        out.append(ast.parts)
+    for c in _ast_children(ast):
+        out.extend(_name_refs(c))
+    return out
+
+
+def _split_and(ast) -> list:
+    if ast is None:
+        return []
+    if isinstance(ast, A.Bin) and ast.op == "and":
+        return _split_and(ast.left) + _split_and(ast.right)
+    return [ast]
+
+
+def _join_and(conjuncts: list):
+    if not conjuncts:
+        return None
+    e = conjuncts[0]
+    for c in conjuncts[1:]:
+        e = A.Bin("and", e, c)
+    return e
+
+
+def _extract_equi(conjuncts, lscope, rscope):
+    """Partition conjuncts into equi-join pairs (lhs from lscope, rhs from
+    rscope) and the rest."""
+    eq, rest = [], []
+
+    def side(parts):
+        inl = _in_scope(parts, lscope)
+        inr = _in_scope(parts, rscope)
+        if inl and not inr:
+            return "l"
+        if inr and not inl:
+            return "r"
+        return None
+
+    for c in conjuncts:
+        if isinstance(c, A.Bin) and c.op == "=":
+            lrefs = _name_refs(c.left)
+            rrefs = _name_refs(c.right)
+            if lrefs and rrefs:
+                lsides = {side(p) for p in lrefs}
+                rsides = {side(p) for p in rrefs}
+                if lsides == {"l"} and rsides == {"r"}:
+                    eq.append((c.left, c.right))
+                    continue
+                if lsides == {"r"} and rsides == {"l"}:
+                    eq.append((c.right, c.left))
+                    continue
+        rest.append(c)
+    return eq, rest
+
+
+def _in_scope(parts, scope) -> bool:
+    try:
+        scope.resolve(parts)
+        return True
+    except SqlError:
+        return False
+
+
+def _ast_name(ast) -> str:
+    if isinstance(ast, A.Name):
+        return ast.parts[-1]
+    if isinstance(ast, A.FuncCall):
+        return ast.name
+    if isinstance(ast, A.ExtractExpr):
+        return ast.field
+    return "?column?"
+
+
+def _like_to_regex(pattern: str) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out), re.DOTALL)
+
+
+def _apply_interval(days: int, iv: A.IntervalLit, op: str) -> int:
+    n = int(iv.value)
+    if op == "-":
+        n = -n
+    d = np.datetime64("1970-01-01", "D") + np.timedelta64(days, "D")
+    if iv.unit.startswith("day"):
+        d = d + np.timedelta64(n, "D")
+    elif iv.unit.startswith("month"):
+        m = d.astype("datetime64[M]") + np.timedelta64(n, "M")
+        dom = (d - d.astype("datetime64[M]")).astype(int)
+        d = m + np.timedelta64(dom, "D")
+    elif iv.unit.startswith("year"):
+        m = d.astype("datetime64[M]") + np.timedelta64(12 * n, "M")
+        dom = (d - d.astype("datetime64[M]")).astype(int)
+        d = m + np.timedelta64(dom, "D")
+    else:
+        raise SqlError(f"interval unit {iv.unit} unsupported")
+    return int((d - np.datetime64("1970-01-01", "D")).astype(int))
+
+
+# --------------------------------------------------------------------------
+# scan pruning (projection pushdown to storage)
+# --------------------------------------------------------------------------
+
+def _collect_needed(plan: Plan, needed: set):
+    from greengage_tpu.planner.logical import Motion
+
+    if isinstance(plan, Project):
+        for _, e in plan.exprs:
+            needed.update(E.columns_used(e))
+    elif isinstance(plan, Filter):
+        needed.update(E.columns_used(plan.predicate))
+    elif isinstance(plan, Join):
+        for e in plan.left_keys + plan.right_keys:
+            needed.update(E.columns_used(e))
+        if plan.residual is not None:
+            needed.update(E.columns_used(plan.residual))
+        if plan.kind in ("inner", "left", "cross"):
+            pass
+    elif isinstance(plan, Aggregate):
+        for _, e in plan.group_keys:
+            needed.update(E.columns_used(e))
+        for _, a in plan.aggs:
+            if a.arg is not None:
+                needed.update(E.columns_used(a.arg))
+    elif isinstance(plan, Sort):
+        for e, _, _ in plan.keys:
+            needed.update(E.columns_used(e))
+    elif isinstance(plan, Motion):
+        for e in plan.hash_exprs:
+            needed.update(E.columns_used(e))
+    for c in plan.children:
+        _collect_needed(c, needed)
+
+
+def _prune_scans(plan: Plan, needed: set):
+    for c in plan.children:
+        _prune_scans(c, needed)
+    if isinstance(plan, Scan):
+        kept = [c for c in plan.cols if c.id in needed]
+        if not kept:
+            kept = plan.cols[:1]   # keep one column for row counting
+        plan.cols = kept

@@ -1,0 +1,552 @@
+"""Hand-written SQL lexer + recursive-descent parser.
+
+Grammar subset of the reference's PostgreSQL 9.4 bison grammar
+(src/backend/parser/gram.y + scan.l) chosen to cover the analytical
+workloads (TPC-H/TPC-DS class queries), GP DDL (DISTRIBUTED BY), INSERT,
+COPY, EXPLAIN. Precedence follows PG: OR < AND < NOT < comparison/IS/IN/
+BETWEEN/LIKE < additive < multiplicative < unary minus.
+"""
+
+from __future__ import annotations
+
+import re
+
+from greengage_tpu.sql import ast as A
+
+
+class SqlError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"[^"]+")
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.;=<>\[\]])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "null", "true", "false", "is",
+    "in", "between", "like", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "outer", "cross", "on", "distinct",
+    "asc", "desc", "nulls", "first", "last", "create", "table", "drop",
+    "insert", "into", "values", "copy", "explain", "analyze", "date",
+    "interval", "extract", "distributed", "randomly", "replicated", "with",
+    "exists", "if", "show", "union", "all", "substring", "for",
+}
+
+
+class Lexer:
+    def __init__(self, text: str):
+        self.tokens: list[tuple[str, str]] = []
+        pos = 0
+        while pos < len(text):
+            m = _TOKEN_RE.match(text, pos)
+            if not m:
+                raise SqlError(f"lex error at {text[pos:pos+20]!r}")
+            pos = m.end()
+            if m.lastgroup == "ws":
+                continue
+            kind = m.lastgroup
+            val = m.group()
+            if kind == "ident":
+                if val.startswith('"'):
+                    self.tokens.append(("name", val[1:-1]))
+                elif val.lower() in KEYWORDS:
+                    self.tokens.append(("kw", val.lower()))
+                else:
+                    self.tokens.append(("name", val.lower()))
+            elif kind == "str":
+                self.tokens.append(("str", val[1:-1].replace("''", "'")))
+            elif kind == "num":
+                self.tokens.append(("num", val))
+            else:
+                self.tokens.append(("op", val))
+        self.tokens.append(("eof", ""))
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.toks = Lexer(text).tokens
+        self.i = 0
+
+    # ---- token helpers -------------------------------------------------
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind, val=None):
+        t = self.peek()
+        if t[0] == kind and (val is None or t[1] == val):
+            self.i += 1
+            return t
+        return None
+
+    def expect(self, kind, val=None):
+        t = self.accept(kind, val)
+        if t is None:
+            raise SqlError(f"expected {val or kind}, got {self.peek()[1]!r}")
+        return t
+
+    def at_kw(self, *kws):
+        t = self.peek()
+        return t[0] == "kw" and t[1] in kws
+
+    # ---- statements ----------------------------------------------------
+    def parse(self) -> list[A.ANode]:
+        stmts = []
+        while self.peek()[0] != "eof":
+            stmts.append(self.statement())
+            while self.accept("op", ";"):
+                pass
+        return stmts
+
+    def statement(self) -> A.ANode:
+        if self.at_kw("select"):
+            return self.select_stmt()
+        if self.at_kw("create"):
+            return self.create_table()
+        if self.at_kw("drop"):
+            return self.drop_table()
+        if self.at_kw("insert"):
+            return self.insert_stmt()
+        if self.at_kw("copy"):
+            return self.copy_stmt()
+        if self.at_kw("explain"):
+            self.next()
+            analyze = bool(self.accept("kw", "analyze"))
+            return A.ExplainStmt(self.statement(), analyze)
+        if self.at_kw("show"):
+            self.next()
+            return A.ShowStmt(self.next()[1])
+        raise SqlError(f"unexpected {self.peek()[1]!r}")
+
+    # ---- SELECT --------------------------------------------------------
+    def select_stmt(self) -> A.SelectStmt:
+        self.expect("kw", "select")
+        s = A.SelectStmt()
+        s.distinct = bool(self.accept("kw", "distinct"))
+        s.items.append(self.select_item())
+        while self.accept("op", ","):
+            s.items.append(self.select_item())
+        if self.accept("kw", "from"):
+            s.from_.append(self.table_ref())
+            while self.accept("op", ","):
+                s.from_.append(self.table_ref())
+        if self.accept("kw", "where"):
+            s.where = self.expr()
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            s.group_by.append(self.expr())
+            while self.accept("op", ","):
+                s.group_by.append(self.expr())
+        if self.accept("kw", "having"):
+            s.having = self.expr()
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            s.order_by.append(self.order_item())
+            while self.accept("op", ","):
+                s.order_by.append(self.order_item())
+        if self.accept("kw", "limit"):
+            s.limit = int(self.expect("num")[1])
+        if self.accept("kw", "offset"):
+            s.offset = int(self.expect("num")[1])
+        return s
+
+    def select_item(self) -> A.SelectItem:
+        if self.peek() == ("op", "*"):
+            self.next()
+            return A.SelectItem(A.Star())
+        if (self.peek()[0] == "name" and self.peek(1) == ("op", ".")
+                and self.peek(2) == ("op", "*")):
+            t = self.next()[1]
+            self.next()
+            self.next()
+            return A.SelectItem(A.Star(table=t))
+        e = self.expr()
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.next()[1]
+        elif self.peek()[0] == "name":
+            alias = self.next()[1]
+        return A.SelectItem(e, alias)
+
+    def order_item(self) -> A.OrderItem:
+        e = self.expr()
+        desc = False
+        if self.accept("kw", "desc"):
+            desc = True
+        else:
+            self.accept("kw", "asc")
+        nulls_first = None
+        if self.accept("kw", "nulls"):
+            if self.accept("kw", "first"):
+                nulls_first = True
+            else:
+                self.expect("kw", "last")
+                nulls_first = False
+        return A.OrderItem(e, desc, nulls_first)
+
+    # ---- FROM ----------------------------------------------------------
+    def table_ref(self) -> A.TableRef:
+        left = self.table_primary()
+        while True:
+            if self.at_kw("join", "inner", "left", "cross", "right"):
+                kind = "inner"
+                if self.accept("kw", "left"):
+                    self.accept("kw", "outer")
+                    kind = "left"
+                elif self.accept("kw", "right"):
+                    self.accept("kw", "outer")
+                    kind = "right"
+                elif self.accept("kw", "cross"):
+                    kind = "cross"
+                else:
+                    self.accept("kw", "inner")
+                self.expect("kw", "join")
+                right = self.table_primary()
+                on = None
+                if kind != "cross":
+                    self.expect("kw", "on")
+                    on = self.expr()
+                if kind == "right":  # normalize: a RIGHT JOIN b == b LEFT JOIN a
+                    left = A.JoinRef("left", right, left, on)
+                else:
+                    left = A.JoinRef(kind, left, right, on)
+            else:
+                return left
+
+    def table_primary(self) -> A.TableRef:
+        if self.accept("op", "("):
+            q = self.select_stmt()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            alias = self.expect("name")[1]
+            return A.SubqueryRef(q, alias)
+        name = self.expect("name")[1]
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.expect("name")[1]
+        elif self.peek()[0] == "name":
+            alias = self.next()[1]
+        return A.BaseTable(name, alias)
+
+    # ---- expressions (precedence climbing) ----------------------------
+    def expr(self) -> A.ANode:
+        return self.or_expr()
+
+    def or_expr(self) -> A.ANode:
+        e = self.and_expr()
+        while self.accept("kw", "or"):
+            e = A.Bin("or", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> A.ANode:
+        e = self.not_expr()
+        while self.accept("kw", "and"):
+            e = A.Bin("and", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> A.ANode:
+        if self.accept("kw", "not"):
+            return A.Unary("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self) -> A.ANode:
+        e = self.add_expr()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                self.next()
+                op = "<>" if t[1] == "!=" else t[1]
+                e = A.Bin(op, e, self.add_expr())
+            elif self.at_kw("is"):
+                self.next()
+                negate = bool(self.accept("kw", "not"))
+                self.expect("kw", "null")
+                e = A.IsNullTest(e, negate)
+            elif self.at_kw("between"):
+                self.next()
+                lo = self.add_expr()
+                self.expect("kw", "and")
+                hi = self.add_expr()
+                e = A.Between(e, lo, hi)
+            elif self.at_kw("in"):
+                self.next()
+                self.expect("op", "(")
+                vals = [self.expr()]
+                while self.accept("op", ","):
+                    vals.append(self.expr())
+                self.expect("op", ")")
+                e = A.InExpr(e, vals)
+            elif self.at_kw("like"):
+                self.next()
+                e = A.LikeExpr(e, self.expect("str")[1])
+            elif self.at_kw("not") and self.peek(1)[0] == "kw" and \
+                    self.peek(1)[1] in ("between", "in", "like"):
+                self.next()
+                kw = self.next()[1]
+                if kw == "between":
+                    lo = self.add_expr()
+                    self.expect("kw", "and")
+                    hi = self.add_expr()
+                    e = A.Between(e, lo, hi, negate=True)
+                elif kw == "in":
+                    self.expect("op", "(")
+                    vals = [self.expr()]
+                    while self.accept("op", ","):
+                        vals.append(self.expr())
+                    self.expect("op", ")")
+                    e = A.InExpr(e, vals, negate=True)
+                else:
+                    e = A.LikeExpr(e, self.expect("str")[1], negate=True)
+            else:
+                return e
+
+    def add_expr(self) -> A.ANode:
+        e = self.mul_expr()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("+", "-"):
+                self.next()
+                e = A.Bin(t[1], e, self.mul_expr())
+            else:
+                return e
+
+    def mul_expr(self) -> A.ANode:
+        e = self.unary_expr()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("*", "/", "%"):
+                self.next()
+                e = A.Bin(t[1], e, self.unary_expr())
+            else:
+                return e
+
+    def unary_expr(self) -> A.ANode:
+        if self.accept("op", "-"):
+            return A.Unary("-", self.unary_expr())
+        if self.accept("op", "+"):
+            return self.unary_expr()
+        return self.primary()
+
+    def primary(self) -> A.ANode:
+        t = self.peek()
+        if t == ("op", "("):
+            self.next()
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if t[0] == "num":
+            self.next()
+            return A.Num(t[1])
+        if t[0] == "str":
+            self.next()
+            return A.Str(t[1])
+        if self.at_kw("null"):
+            self.next()
+            return A.Null()
+        if self.at_kw("true"):
+            self.next()
+            return A.Bool(True)
+        if self.at_kw("false"):
+            self.next()
+            return A.Bool(False)
+        if self.at_kw("date"):
+            self.next()
+            return A.DateLit(self.expect("str")[1])
+        if self.at_kw("interval"):
+            self.next()
+            v = self.expect("str")[1]
+            unit = self.expect("name")[1].rstrip("s") \
+                if self.peek()[0] == "name" else "day"
+            return A.IntervalLit(v, unit)
+        if self.at_kw("case"):
+            return self.case_expr()
+        if self.at_kw("cast"):
+            self.next()
+            self.expect("op", "(")
+            arg = self.expr()
+            self.expect("kw", "as")
+            tname, typmod = self.type_name()
+            self.expect("op", ")")
+            return A.CastExpr(arg, tname, typmod)
+        if self.at_kw("extract"):
+            self.next()
+            self.expect("op", "(")
+            field = self.next()[1]
+            self.expect("kw", "from")
+            arg = self.expr()
+            self.expect("op", ")")
+            return A.ExtractExpr(field, arg)
+        if t[0] == "name":
+            # function call or (qualified) column
+            if self.peek(1) == ("op", "("):
+                fname = self.next()[1]
+                self.next()
+                if self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return A.FuncCall(fname, [], star=True)
+                distinct = bool(self.accept("kw", "distinct"))
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.expr())
+                    while self.accept("op", ","):
+                        args.append(self.expr())
+                self.expect("op", ")")
+                return A.FuncCall(fname, args, distinct=distinct)
+            parts = [self.next()[1]]
+            while self.peek() == ("op", ".") and self.peek(1)[0] == "name":
+                self.next()
+                parts.append(self.next()[1])
+            return A.Name(tuple(parts))
+        raise SqlError(f"unexpected {t[1]!r} in expression")
+
+    def case_expr(self) -> A.ANode:
+        self.expect("kw", "case")
+        whens = []
+        while self.accept("kw", "when"):
+            c = self.expr()
+            self.expect("kw", "then")
+            v = self.expr()
+            whens.append((c, v))
+        else_ = None
+        if self.accept("kw", "else"):
+            else_ = self.expr()
+        self.expect("kw", "end")
+        return A.CaseExpr(whens, else_)
+
+    # ---- DDL / DML -----------------------------------------------------
+    def type_name(self) -> tuple[str, tuple[int, ...]]:
+        name = self.next()[1]
+        if name == "double":
+            self.accept("name", "precision")
+            name = "double precision"
+        typmod = ()
+        if self.accept("op", "("):
+            mods = [int(self.expect("num")[1])]
+            while self.accept("op", ","):
+                mods.append(int(self.expect("num")[1]))
+            self.expect("op", ")")
+            typmod = tuple(mods)
+        return name, typmod
+
+    def create_table(self) -> A.CreateTableStmt:
+        self.expect("kw", "create")
+        self.expect("kw", "table")
+        ine = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "not")
+            self.expect("kw", "exists")
+            ine = True
+        name = self.expect("name")[1]
+        self.expect("op", "(")
+        cols = [self.column_def()]
+        while self.accept("op", ","):
+            cols.append(self.column_def())
+        self.expect("op", ")")
+        options = {}
+        if self.accept("kw", "with"):
+            self.expect("op", "(")
+            while True:
+                k = self.expect("name")[1]
+                self.expect("op", "=")
+                v = self.next()[1]
+                options[k] = v
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        dist_kind, dist_keys = "hash", []
+        if self.accept("kw", "distributed"):
+            if self.accept("kw", "randomly"):
+                dist_kind = "random"
+            elif self.accept("kw", "replicated"):
+                dist_kind = "replicated"
+            else:
+                self.expect("kw", "by")
+                self.expect("op", "(")
+                dist_keys.append(self.expect("name")[1])
+                while self.accept("op", ","):
+                    dist_keys.append(self.expect("name")[1])
+                self.expect("op", ")")
+        elif cols:
+            dist_keys = [cols[0].name]  # GP default: first column
+        return A.CreateTableStmt(name, cols, dist_kind, dist_keys, options, ine)
+
+    def column_def(self) -> A.ColumnDef:
+        name = self.expect("name")[1]
+        tname, typmod = self.type_name()
+        not_null = False
+        if self.accept("kw", "not"):
+            self.expect("kw", "null")
+            not_null = True
+        return A.ColumnDef(name, tname, typmod, not_null)
+
+    def drop_table(self) -> A.DropTableStmt:
+        self.expect("kw", "drop")
+        self.expect("kw", "table")
+        ie = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "exists")
+            ie = True
+        return A.DropTableStmt(self.expect("name")[1], ie)
+
+    def insert_stmt(self) -> A.InsertStmt:
+        self.expect("kw", "insert")
+        self.expect("kw", "into")
+        table = self.expect("name")[1]
+        columns = []
+        if self.accept("op", "("):
+            columns.append(self.expect("name")[1])
+            while self.accept("op", ","):
+                columns.append(self.expect("name")[1])
+            self.expect("op", ")")
+        self.expect("kw", "values")
+        rows = []
+        while True:
+            self.expect("op", "(")
+            row = [self.expr()]
+            while self.accept("op", ","):
+                row.append(self.expr())
+            self.expect("op", ")")
+            rows.append(row)
+            if not self.accept("op", ","):
+                break
+        return A.InsertStmt(table, columns, rows)
+
+    def copy_stmt(self) -> A.CopyStmt:
+        self.expect("kw", "copy")
+        table = self.expect("name")[1]
+        self.expect("kw", "from")
+        path = self.expect("str")[1]
+        options = {}
+        if self.accept("kw", "with"):
+            self.expect("op", "(")
+            while True:
+                k = self.next()[1]
+                v = self.next()[1] if self.peek()[0] in ("name", "str", "num") else "true"
+                options[k] = v
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return A.CopyStmt(table, path, options)
+
+
+def parse(text: str) -> list[A.ANode]:
+    return Parser(text).parse()
+
+
+def parse_one(text: str) -> A.ANode:
+    stmts = parse(text)
+    if len(stmts) != 1:
+        raise SqlError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]

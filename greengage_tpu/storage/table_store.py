@@ -95,6 +95,11 @@ class TableStore:
         (or stages into an open tx for DTM-lite two-phase commit)."""
         schema = self.catalog.get(table)
         valids = dict(valids or {})
+        for c in schema.columns:
+            v = valids.get(c.name)
+            if not c.nullable and v is not None and not np.all(v):
+                raise ValueError(
+                    f'null value in column "{c.name}" violates not-null constraint')
         nrows = None
         enc: dict[str, np.ndarray] = {}
         for c in schema.columns:
@@ -228,6 +233,19 @@ class TableStore:
             if len(cols[name]) != nrows:
                 raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
         return cols, valids, nrows
+
+    def has_nulls(self, table: str, col: str, snapshot: dict | None = None) -> bool:
+        """True if any committed segfile of this column has a validity file
+        (compile-time schema for the executor's input staging)."""
+        snap = snapshot or self.manifest.snapshot()
+        tmeta = snap["tables"].get(table, {"segfiles": {}})
+        marker = f"{col}."
+        for files in tmeta["segfiles"].values():
+            for rel in files:
+                fn = os.path.basename(rel)
+                if fn.startswith(marker) and fn.endswith(".valid.ggb"):
+                    return True
+        return False
 
     def segment_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
         schema = self.catalog.get(table)

@@ -170,6 +170,28 @@ def days_to_date(days: int) -> str:
     return str(_EPOCH + np.timedelta64(int(days), "D"))
 
 
+def from_string(v: str, t: SqlType):
+    """Parse a string into a type's storage representation — the single
+    coercion registry shared by COPY, INSERT literal binding, and loaders."""
+    k = t.kind
+    if k is Kind.TEXT:
+        return v
+    if k is Kind.DATE:
+        return date_to_days(v)
+    if k is Kind.DECIMAL:
+        return decimal_to_int(v, t.scale)
+    if k is Kind.FLOAT64:
+        return float(v)
+    if k is Kind.BOOL:
+        s = v.strip().lower()
+        if s in ("t", "true", "1", "yes", "on"):
+            return True
+        if s in ("f", "false", "0", "no", "off"):
+            return False
+        raise ValueError(f"invalid boolean {v!r}")
+    return int(v)
+
+
 def decimal_to_int(value, scale: int) -> int:
     """Parse a decimal literal (str/float/int) to scaled int64, half-up."""
     from decimal import Decimal, ROUND_HALF_UP
