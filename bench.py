@@ -1,0 +1,136 @@
+"""Benchmark: TPC-H Q1 pricing summary on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value       = rows/sec/chip through the full engine (SQL -> plan -> jitted
+              SPMD program -> gather), steady state (plan + staging cached),
+              best of N runs.
+vs_baseline = speedup over a CPU columnar baseline executing the same Q1
+              aggregation with numpy/pandas on this host (the reference
+              publishes no absolute numbers — BASELINE.md — so the recorded
+              baseline is the measured CPU path, standing in for a
+              CPU-segment executor on identical data).
+
+Env: GGTPU_BENCH_SF (default 0.5), GGTPU_BENCH_RUNS (default 5).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SF = float(os.environ.get("GGTPU_BENCH_SF", "0.5"))
+RUNS = int(os.environ.get("GGTPU_BENCH_RUNS", "5"))
+
+Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+def cpu_baseline(data: dict) -> tuple[float, list]:
+    """Columnar numpy execution of Q1 (vectorized CPU segment stand-in)."""
+    li = data["lineitem"]
+    cutoff = (np.datetime64("1998-12-01") - np.timedelta64(90, "D")
+              - np.datetime64("1970-01-01")).astype(np.int32)
+    qty = li["l_quantity"]
+    price = li["l_extendedprice"]
+    disc = li["l_discount"]
+    tax = li["l_tax"]
+    ship = li["l_shipdate"]
+    rf = np.asarray(li["l_returnflag"])
+    ls = np.asarray(li["l_linestatus"])
+
+    def run():
+        m = ship <= cutoff
+        # group id over the 3x2 flag/status domain
+        rf_c = np.searchsorted(np.array(["A", "N", "R"]), rf)
+        ls_c = np.searchsorted(np.array(["F", "O"]), ls)
+        gid = np.where(m, rf_c * 2 + ls_c, 6)
+        disc_price = price * (100 - disc)            # scaled 1e4
+        charge = disc_price * (100 + tax)            # scaled 1e6
+        out = []
+        for g in range(6):
+            mask = gid == g
+            cnt = int(mask.sum())
+            out.append((
+                np.sum(qty, where=mask), np.sum(price, where=mask),
+                np.sum(disc_price, where=mask), np.sum(charge, where=mask),
+                np.sum(qty, where=mask) / max(cnt, 1),
+                np.sum(price, where=mask) / max(cnt, 1),
+                np.sum(disc, where=mask) / max(cnt, 1), cnt,
+            ))
+        return out
+
+    run()  # warm cache
+    best = float("inf")
+    rows = None
+    for _ in range(3):
+        t0 = time.monotonic()
+        rows = run()
+        best = min(best, time.monotonic() - t0)
+    return best, rows
+
+
+def main():
+    import jax
+
+    import greengage_tpu
+    from greengage_tpu.utils import tpch
+
+    t_setup = time.monotonic()
+    data = tpch.generate(SF)
+    n_rows = len(data["lineitem"]["l_orderkey"])
+
+    dev = jax.devices()[0]
+    db = greengage_tpu.connect(
+        path=tempfile.mkdtemp(prefix="ggtpu_bench_"), numsegments=1)
+    db.sql(tpch.DDL)
+    db.load_table("lineitem", data["lineitem"])
+    setup_s = time.monotonic() - t_setup
+
+    # device path: first run compiles + stages, then steady state
+    t0 = time.monotonic()
+    db.sql(Q1)
+    compile_s = time.monotonic() - t0
+    best = float("inf")
+    for _ in range(RUNS):
+        t0 = time.monotonic()
+        r = db.sql(Q1)
+        best = min(best, time.monotonic() - t0)
+    assert len(r) == 6, f"Q1 expected 6 groups, got {len(r)}"
+
+    cpu_s, _ = cpu_baseline(data)
+
+    value = n_rows / best
+    baseline = n_rows / cpu_s
+    result = {
+        "metric": "tpch_q1_rows_per_sec_per_chip",
+        "value": round(value),
+        "unit": "rows/s",
+        "vs_baseline": round(value / baseline, 3),
+    }
+    print(json.dumps(result))
+    print(f"# sf={SF} rows={n_rows} device={dev.device_kind} "
+          f"best={best*1e3:.1f}ms cpu_numpy={cpu_s*1e3:.1f}ms "
+          f"compile={compile_s:.1f}s setup={setup_s:.1f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,9 @@ class Settings:
     hash_num_probes: int = 16           # probe rounds before overflow
     hash_table_min: int = 256
     hash_table_max: int = 1 << 22
+    # dense group-by path: used when the product of group-key domains
+    # (dictionary sizes / bool) is at most this (scatter-free aggregation)
+    dense_group_limit: int = 512
     # motion (gp_interconnect_queue_depth analog)
     motion_capacity_slack: float = 1.6  # per-destination bucket headroom
     motion_retry_tiers: int = 3         # capacity x4 per retry on overflow

@@ -174,8 +174,14 @@ class Compiler:
         if isinstance(plan, Join):
             return self._capacity_of(plan.left)
         if isinstance(plan, Aggregate):
-            if not plan.group_keys and plan.phase in ("single", "final"):
+            if not plan.group_keys:
                 return 1
+            dense = self._dense_domains(plan)
+            if dense is not None:
+                d = 1
+                for dom in dense:
+                    d *= dom
+                return d
             return self._agg_table_size(plan)
         if isinstance(plan, Motion):
             child_cap = self._capacity_of(plan.child)
@@ -195,6 +201,30 @@ class Compiler:
         est = max(plan.est_rows, 16.0) / max(self.s.hash_table_load, 0.05)
         m = _pow2(est) * (4 ** self.tier)
         return max(self.s.hash_table_min, min(m, self.s.hash_table_max))
+
+    def _dense_domains(self, plan: Aggregate) -> list[int] | None:
+        """Per-key dense domains (|dict|+1 / bool 3) when every group key has
+        a known finite domain and the product fits the dense limit."""
+        if not plan.group_keys:
+            return None
+        domains = []
+        prod = 1
+        for ci, e in plan.group_keys:
+            if ci.type.kind is T.Kind.TEXT:
+                d = getattr(e, "_dict_ref", None) or ci.dict_ref
+                if d is None and isinstance(e, E.ColRef):
+                    d = self._dict_refs.get(e.name)
+                if d is None:
+                    return None
+                domains.append(len(self.store.dictionary(*d)) + 1)
+            elif ci.type.kind is T.Kind.BOOL:
+                domains.append(3)
+            else:
+                return None
+            prod *= domains[-1]
+            if prod > self.s.dense_group_limit:
+                return None
+        return domains
 
     def _join_table_size(self, build_cap: int) -> int:
         return max(self.s.hash_table_min, min(_pow2(build_cap * 2), self.s.hash_table_max))
@@ -325,10 +355,16 @@ class Compiler:
     # ---- aggregation ---------------------------------------------------
     def _c_aggregate(self, plan: Aggregate):
         child_fn = self._compile_node(plan.child)
-        M = self._agg_table_size(plan) if plan.group_keys else 1
+        dense = self._dense_domains(plan) if plan.group_keys else None
+        if dense is not None:
+            M = 1
+            for dom in dense:
+                M *= dom
+        else:
+            M = self._agg_table_size(plan) if plan.group_keys else 1
         probes = self.s.hash_num_probes
         fid = f"agg_overflow_{len(self.flags)}"
-        if plan.group_keys:
+        if plan.group_keys and dense is None:
             self.flags.append(fid)
         keys = plan.group_keys
         aggs = plan.aggs
@@ -337,7 +373,18 @@ class Compiler:
         def run(ctx):
             b = child_fn(ctx)
             sel = b.selection()
-            if keys:
+            gid = None
+            if keys and dense is not None:
+                kspecs = self._key_specs(b, [e for _, e in keys])
+                gid, _ = agg_ops.dense_gid(kspecs, dense, sel)
+                slots = gid
+                decoded = agg_ops.dense_decode_keys(kspecs, dense, M)
+                tkeys = [code for code, _ in decoded]
+                tvalids = [valid for _, valid in decoded]
+                used = jnp.any(
+                    sel[:, None] & (gid[:, None] == jnp.arange(M, dtype=jnp.int32)[None, :]),
+                    axis=0)
+            elif keys:
                 kspecs = self._key_specs(b, [e for _, e in keys])
                 slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
                     kspecs, sel, M, probes)
@@ -354,6 +401,11 @@ class Compiler:
                 cols[ci.id] = tk
                 if tv is not None:
                     valids[ci.id] = tv
+
+            def do_agg(specs):
+                if gid is not None:
+                    return agg_ops.dense_aggregate(gid, Mx, specs, sel)
+                return agg_ops.aggregate(slots, Mx, specs, sel)
 
             if phase in ("single", "partial"):
                 specs = []
@@ -376,7 +428,7 @@ class Compiler:
                             specs.append(agg_ops.AggSpec(ci.id + "@c", "count", arg_v, arg_valid))
                         elif a.func in ("min", "max"):
                             specs.append(agg_ops.AggSpec(ci.id + "@m", a.func, arg_v, arg_valid))
-                vals, avalids = agg_ops.aggregate(slots, Mx, specs, sel)
+                vals, avalids = do_agg(specs)
                 for name, v in vals.items():
                     cols[name] = v
                     if avalids.get(name) is not None:
@@ -405,7 +457,7 @@ class Compiler:
                         specs.append(agg_ops.AggSpec(
                             ci.id, a.func, b.cols[ci.id + "@m"], b.valids.get(ci.id + "@m")))
                         finals.append((ci, a.func))
-                vals, avalids = agg_ops.aggregate(slots, Mx, specs, sel)
+                vals, avalids = do_agg(specs)
                 for f in finals:
                     ci = f[0]
                     if f[1] == "avg":

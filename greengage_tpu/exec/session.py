@@ -55,6 +55,7 @@ class Database:
         self.store = TableStore(path, self.catalog)
         self.store.manifest.recover()   # in-doubt resolution on startup
         self.settings = Settings()
+        self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings)
@@ -107,8 +108,21 @@ class Database:
         return planned, binder.consts, outs
 
     def _select(self, stmt: A.SelectStmt) -> Result:
-        planned, consts, outs = self._plan(stmt)
-        return self.executor.run(planned, consts, outs)
+        # plan cache key: structural statement identity (dataclass repr is
+        # deep + deterministic) + manifest version (bound plans embed
+        # dictionary codes/LUTs, which can grow with new data)
+        stmt_key = repr(stmt)
+        key = (stmt_key, self.store.manifest.snapshot().get("version", 0))
+        cached = self._select_cache.get(key)
+        if cached is None:
+            cached = self._plan(stmt)
+            self._select_cache[key] = cached
+            if len(self._select_cache) > 256:
+                self._select_cache.pop(next(iter(self._select_cache)))
+        planned, consts, outs = cached
+        # executor adds the manifest version itself; passing the bare
+        # statement identity lets it evict compiled programs of old versions
+        return self.executor.run(planned, consts, outs, cache_key=stmt_key)
 
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, A.SelectStmt):

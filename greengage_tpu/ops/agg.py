@@ -105,6 +105,114 @@ def build_slot_table(keys: list[KeySpec], sel, table_size: int, num_probes: int)
     return final_slot, tkeys, tvalids, used, jnp.any(active)
 
 
+# ---------------------------------------------------------------------------
+# Dense path: small known key domains (TEXT dictionaries / BOOL)
+#
+# TPU scatters serialize on colliding indices, so the generic slot table
+# costs ~70ns/row. When every group key has a finite known domain we skip
+# hashing/probing entirely: gid = mixed-radix index over (code+1) digits
+# (0 = NULL), and every aggregate is a fused masked reduction over a
+# [rows, D] broadcast — one HBM pass, VPU-only, no scatter/gather.
+# This is the Q1-class fast path; high-cardinality keys use the slot table.
+# ---------------------------------------------------------------------------
+
+
+def dense_gid(keys: list[KeySpec], domains: list[int], sel):
+    """-> (gid int32[n] in [0, D), D). domains[i] = |dict_i| + 1 (NULL)."""
+    gid = None
+    for k, dom in zip(keys, domains):
+        idx = k.values.astype(jnp.int32) + 1
+        if k.valid is not None:
+            idx = jnp.where(k.valid, idx, 0)
+        gid = idx if gid is None else gid * jnp.int32(dom) + idx
+    D = 1
+    for dom in domains:
+        D *= dom
+    return jnp.where(sel, gid, jnp.int32(0)), D
+
+
+def dense_decode_keys(keys: list[KeySpec], domains: list[int], D: int):
+    """Reconstruct per-group key code arrays [D] (and NULL masks) from gid
+    arithmetic — no gathers."""
+    iota = jnp.arange(D, dtype=jnp.int32)
+    out = []
+    strides = []
+    s = 1
+    for dom in reversed(domains):
+        strides.append(s)
+        s *= dom
+    strides = list(reversed(strides))
+    for k, dom, st in zip(keys, domains, strides):
+        idx = (iota // jnp.int32(st)) % jnp.int32(dom)
+        code = (idx - 1).astype(k.values.dtype)
+        valid = idx > 0
+        out.append((code, valid))
+    return out
+
+
+def _masked_reduce(op, vals, gid, D, mask, ident):
+    """One fused pass: reduce vals into D groups via broadcast-compare.
+    XLA fuses the [n, D] compare+select into the reduction tiles."""
+    sel2 = mask[:, None] & (gid[:, None] == jnp.arange(D, dtype=jnp.int32)[None, :])
+    filled = jnp.where(sel2, vals[:, None], ident)
+    return op(filled, axis=0)
+
+
+def dense_aggregate(gid, D: int, aggs: list[AggSpec], sel):
+    """aggregate() semantics over dense group ids (see aggregate)."""
+    out_vals: dict[str, jnp.ndarray] = {}
+    out_valid: dict[str, jnp.ndarray] = {}
+    counts_cache: dict = {}
+    iotaD = jnp.arange(D, dtype=jnp.int32)
+
+    def live_count(spec):
+        key = None if spec is None or spec.valid is None else id(spec.valid)
+        if key not in counts_cache:
+            lv = sel if spec is None or spec.valid is None else (sel & spec.valid)
+            onehot = lv[:, None] & (gid[:, None] == iotaD[None, :])
+            counts_cache[key] = jnp.sum(onehot.astype(jnp.int64), axis=0)
+        return counts_cache[key]
+
+    group_count = live_count(None)
+    for spec in aggs:
+        if spec.func == "count_star":
+            out_vals[spec.name] = group_count
+            out_valid[spec.name] = None
+            continue
+        lv = sel if spec.valid is None else sel & spec.valid
+        if spec.func == "count":
+            out_vals[spec.name] = live_count(spec)
+            out_valid[spec.name] = None
+            continue
+        vals = spec.values
+        if spec.func in ("sum", "avg"):
+            acc = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
+            s = _masked_reduce(jnp.sum, vals.astype(acc), gid, D, lv, acc(0))
+            cnt = live_count(spec)
+            if spec.func == "sum":
+                out_vals[spec.name] = s
+                out_valid[spec.name] = cnt > 0
+            else:
+                denom = jnp.where(cnt == 0, jnp.int64(1), cnt).astype(jnp.float64)
+                avg = s.astype(jnp.float64) / denom
+                if spec.decimal_scale:
+                    avg = avg / (10.0 ** spec.decimal_scale)
+                out_vals[spec.name] = avg
+                out_valid[spec.name] = cnt > 0
+        elif spec.func in ("min", "max"):
+            if vals.dtype.kind == "f":
+                ident = jnp.array(jnp.inf if spec.func == "min" else -jnp.inf, vals.dtype)
+            else:
+                info = jnp.iinfo(vals.dtype)
+                ident = jnp.array(info.max if spec.func == "min" else info.min, vals.dtype)
+            op = jnp.min if spec.func == "min" else jnp.max
+            out_vals[spec.name] = _masked_reduce(op, vals, gid, D, lv, ident)
+            out_valid[spec.name] = live_count(spec) > 0
+        else:
+            raise NotImplementedError(spec.func)
+    return out_vals, out_valid
+
+
 def probe_sequence(h, M: int):
     """Double hashing: start slot from h, odd step from a derived second
     hash (odd steps visit every slot of a power-of-two table). Keeps probe

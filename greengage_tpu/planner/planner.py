@@ -194,7 +194,8 @@ class Planner:
         key_ids = tuple(
             e.name for _, e in node.group_keys if isinstance(e, E.ColRef)
         )
-        groups = C.est_groups(child.est_rows)
+        groups = min(C.est_groups(child.est_rows),
+                     self._group_domain_bound(node.group_keys))
 
         if not node.group_keys:
             # scalar aggregate: partial everywhere -> broadcast the (tiny)
@@ -232,14 +233,33 @@ class Planner:
         final.est_rows = groups
         return final
 
+    def _group_domain_bound(self, group_keys) -> float:
+        """Hard upper bound on distinct groups when every key has a known
+        finite domain: TEXT keys can't exceed their dictionary size, BOOL
+        keys can't exceed 2 (+NULL). Exact for TPC-H flag/status columns —
+        keeps slot tables and result transfers at true size."""
+        from greengage_tpu import types as T
+
+        prod = 1.0
+        for ci, e in group_keys:
+            if ci.type.kind is T.Kind.TEXT and ci.dict_ref is not None:
+                prod *= max(len(self.store.dictionary(*ci.dict_ref)), 1) + 1
+            elif ci.type.kind is T.Kind.BOOL:
+                prod *= 3
+            else:
+                return float("inf")
+            if prod > 1e12:
+                return float("inf")
+        return prod
+
     def _make_partial(self, node: Aggregate) -> Aggregate:
         partial = Aggregate(
             child=node.child, group_keys=node.group_keys, aggs=node.aggs,
             phase="partial")
         partial.locus = node.child.locus
-        partial.est_rows = min(
-            node.child.est_rows,
-            C.est_groups(node.child.est_rows) * max(self.nseg, 1))
+        groups = min(C.est_groups(node.child.est_rows),
+                     self._group_domain_bound(node.group_keys))
+        partial.est_rows = min(node.child.est_rows, groups * max(self.nseg, 1))
         return partial
 
     def _make_final(self, node: Aggregate, partial: Aggregate, moved: Plan) -> Aggregate:
