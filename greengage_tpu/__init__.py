@@ -17,12 +17,22 @@ PostgreSQL MPP data warehouse), redesigned TPU-first:
 See SURVEY.md for the full structural map of the reference.
 """
 
+import os
+
 import jax
 
 # Decimals are stored/computed as scaled int64 for SQL exactness (the
 # reference relies on PostgreSQL numeric); int64 on TPU is emulated with
 # int32 pairs which is acceptable for the bandwidth-bound analytical ops.
 jax.config.update("jax_enable_x64", True)
+
+# Some TPU environments force their platform at interpreter start
+# (sitecustomize), overriding JAX_PLATFORMS. GGTPU_PLATFORM wins if set —
+# e.g. GGTPU_PLATFORM=cpu with
+# XLA_FLAGS=--xla_force_host_platform_device_count=8 gives the virtual
+# demo cluster regardless of plugin defaults.
+if os.environ.get("GGTPU_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["GGTPU_PLATFORM"])
 
 __version__ = "0.1.0"
 

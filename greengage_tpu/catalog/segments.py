@@ -58,6 +58,21 @@ class SegmentConfig:
                 cfg.entries.append(SegmentEntry(c, SegmentRole.MIRROR, SegmentRole.MIRROR))
         return cfg
 
+    def expand(self, new_numsegments: int) -> None:
+        """Add segments for cluster expansion, PRESERVING existing entries
+        (down markers, promoted mirrors, mirror pairs survive — gpexpand
+        never resets FTS state)."""
+        if new_numsegments <= self.numsegments:
+            raise ValueError("expansion must increase the segment count")
+        has_mirrors = any(e.role is SegmentRole.MIRROR for e in self.entries)
+        for c in range(self.numsegments, new_numsegments):
+            self.entries.append(
+                SegmentEntry(c, SegmentRole.PRIMARY, SegmentRole.PRIMARY, device_index=c))
+            if has_mirrors:
+                self.entries.append(SegmentEntry(c, SegmentRole.MIRROR, SegmentRole.MIRROR))
+        self.numsegments = new_numsegments
+        self.version += 1
+
     def primaries(self) -> list[SegmentEntry]:
         return sorted(
             (e for e in self.entries if e.role is SegmentRole.PRIMARY and e.content >= 0),

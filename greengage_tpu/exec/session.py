@@ -33,6 +33,7 @@ class Database:
         import jax
 
         devs = list(devices) if devices is not None else jax.devices()
+        self._devices = devs
         if path is not None and os.path.exists(os.path.join(path, "catalog.json")):
             self.catalog = Catalog.load(path)
             if numsegments is None:
@@ -52,13 +53,20 @@ class Database:
             path = tempfile.mkdtemp(prefix="ggtpu_")
             self.catalog.path = path
         self.path = path
+        self.catalog._save()   # persist width even before the first table
         self.store = TableStore(path, self.catalog)
         self.store.manifest.recover()   # in-doubt resolution on startup
+        self.store.reconcile_widths()   # expansion crash recovery
         self.settings = Settings()
         self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings)
+        from greengage_tpu.runtime.dtm import DtmSession
+        from greengage_tpu.runtime.fts import FtsProber
+
+        self.dtm = DtmSession(self.store)
+        self.fts = FtsProber(self.catalog.segments, self.mesh)
 
     # ------------------------------------------------------------------
     def sql(self, text: str):
@@ -98,6 +106,18 @@ class Database:
             return self._copy(stmt)
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
+        if isinstance(stmt, A.SetStmt):
+            self.settings.set(stmt.name, stmt.value)
+            return "SET"
+        if isinstance(stmt, A.TxStmt):
+            if stmt.action == "begin":
+                self.dtm.begin()
+                return "BEGIN"
+            if stmt.action == "commit":
+                self.dtm.commit()
+                return "COMMIT"
+            self.dtm.abort()
+            return "ROLLBACK"
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
@@ -190,13 +210,21 @@ class Database:
             va = np.array(valids[n], dtype=bool)
             if not va.all():
                 enc_valids[n] = va
-        n = self.store.insert(stmt.table, enc_cols, enc_valids)
+        n = self._write_rows(stmt.table, enc_cols, enc_valids)
         return f"INSERT 0 {n}"
+
+    def _write_rows(self, table: str, columns, valids) -> int:
+        """All write paths (INSERT/COPY/load_table) stage into the open
+        transaction if one is active; published at COMMIT. (Reads inside the
+        tx still see the committed snapshot only.)"""
+        tx = self.dtm.current
+        if tx is not None and tx.state == "active":
+            return tx.insert(table, columns, valids)
+        return self.store.insert(table, columns, valids)
 
     def load_table(self, table: str, columns: dict, valids: dict | None = None):
         """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
-        n = self.store.insert(table, columns, valids)
-        return n
+        return self._write_rows(table, columns, valids)
 
     def _copy(self, stmt: A.CopyStmt):
         schema = self.catalog.get(stmt.table)
@@ -229,10 +257,41 @@ class Database:
                 enc_cols[c.name] = np.array(cols[c.name], dtype=c.type.np_dtype)
             if not va.all():
                 enc_valids[c.name] = va
-        n = self.store.insert(stmt.table, enc_cols, enc_valids)
+        n = self._write_rows(stmt.table, enc_cols, enc_valids)
         return f"COPY {n}"
 
     # ------------------------------------------------------------------
+    def expand(self, new_numsegments: int) -> dict:
+        """gpexpand analog: widen the cluster and redistribute every table.
+
+        Phase 1 adds segments to the topology; phase 2 rewrites each table
+        at the new width (ALTER TABLE ... EXPAND TABLE). Tables stay
+        readable between phases because plans honor per-table numsegments
+        (mixed-width, gp_policy.h:35 semantics)."""
+        if self.dtm.current is not None and self.dtm.current.state == "active":
+            raise SqlError("cannot expand inside a transaction")
+        devs = self._devices
+        if new_numsegments > len(devs):
+            raise ValueError(
+                f"cannot expand to {new_numsegments}: only {len(devs)} devices")
+        if new_numsegments <= self.numsegments:
+            raise ValueError("expansion must increase the segment count")
+        # phase 1: new topology (existing entries, incl. FTS state, preserved)
+        self.catalog.segments.expand(new_numsegments)
+        self.numsegments = new_numsegments
+        self.catalog._save()
+        self.mesh = make_mesh(new_numsegments, devs)
+        self.executor = Executor(self.catalog, self.store, self.mesh,
+                                 new_numsegments, self.settings)
+        self._select_cache.clear()
+        self.fts.config = self.catalog.segments
+        self.fts.mesh = self.mesh
+        # phase 2: redistribute each table
+        moved = {}
+        for name in list(self.catalog.tables):
+            moved[name] = self.store.rewrite_table(name, new_numsegments)
+        return moved
+
     def set(self, name: str, value):
         self.settings.set(name, value)
 

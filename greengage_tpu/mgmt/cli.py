@@ -1,0 +1,177 @@
+"""gg — the cluster management CLI (gpMgmt/bin analog).
+
+Subcommands mirror the reference's operator tools:
+
+  gg init     -d DIR -n NSEG      gpinitsystem: create a cluster
+  gg state    -d DIR [--probe]    gpstate: topology + table inventory
+  gg sql      -d DIR "SELECT..."  psql: run statements, print results
+  gg expand   -d DIR -n NEWN      gpexpand: widen + redistribute
+  gg recover  -d DIR              gprecoverseg: roll back in-doubt 2PC,
+                                  rebalance roles to preferred
+  gg checkcat -d DIR              gpcheckcat: catalog/storage consistency
+
+Run as: python -m greengage_tpu.mgmt.cli <cmd> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _open(path, numsegments=None):
+    import greengage_tpu
+
+    return greengage_tpu.connect(path=path, numsegments=numsegments)
+
+
+def cmd_init(args):
+    if os.path.exists(os.path.join(args.dir, "catalog.json")):
+        print(f"error: cluster already exists at {args.dir}", file=sys.stderr)
+        return 1
+    db = _open(args.dir, args.numsegments)
+    print(f"cluster initialized at {args.dir}: {db.numsegments} segments "
+          f"on {len(list(db.mesh.devices.flat))} devices")
+    return 0
+
+
+def cmd_state(args):
+    from greengage_tpu.runtime.fts import cluster_state, needs_rebalance
+
+    db = _open(args.dir)
+    if args.probe:
+        results = db.fts.probe_once()
+        print("probe:", json.dumps(results))
+    print(f"cluster: {args.dir}  width: {db.numsegments}  "
+          f"config version: {db.catalog.segments.version}")
+    print(f"{'content':>8} {'role':>5} {'pref':>5} {'status':>7} {'device':>7}")
+    for row in cluster_state(db.catalog.segments):
+        print(f"{row['content']:>8} {row['role']:>5} {row['preferred_role']:>5} "
+              f"{row['status']:>7} {str(row['device']):>7}")
+    if needs_rebalance(db.catalog.segments):
+        print("NOTE: segments are not on their preferred roles (run gg recover)")
+    print("tables:")
+    for name, schema in sorted(db.catalog.tables.items()):
+        counts = db.store.segment_rowcounts(name)
+        print(f"  {name}: {sum(counts)} rows over {schema.policy.numsegments} segments "
+              f"({schema.policy.describe()})")
+    return 0
+
+
+def cmd_sql(args):
+    db = _open(args.dir)
+    out = db.sql(args.query)
+    if isinstance(out, str):
+        print(out)
+        return 0
+    if hasattr(out, "columns"):
+        print("\t".join(out.columns))
+        for row in out.rows():
+            print("\t".join("" if v is None else str(v) for v in row))
+        print(f"({len(out)} rows)")
+    return 0
+
+
+def cmd_expand(args):
+    db = _open(args.dir)
+    moved = db.expand(args.numsegments)
+    for t, n in moved.items():
+        print(f"  {t}: {n} rows redistributed")
+    print(f"cluster expanded to {args.numsegments} segments")
+    return 0
+
+
+def cmd_recover(args):
+    db = _open(args.dir)
+    rolled = db.store.manifest.recover()
+    if rolled:
+        print(f"rolled back in-doubt transactions: versions {rolled}")
+    # rebalance: put segments back on preferred roles (gprecoverseg -r)
+    cfg = db.catalog.segments
+    changed = 0
+    for e in cfg.entries:
+        if e.role is not e.preferred_role:
+            e.role = e.preferred_role
+            changed += 1
+    if changed:
+        cfg.version += 1
+        print(f"rebalanced {changed} segments to preferred roles")
+    print("recovery complete")
+    return 0
+
+
+def cmd_checkcat(args):
+    db = _open(args.dir)
+    problems = []
+    snap = db.store.manifest.snapshot()
+    # orphaned manifest entries (table gone from catalog)
+    for t in snap["tables"]:
+        if t not in db.catalog:
+            problems.append(f"manifest table {t} missing from catalog")
+    for name, schema in db.catalog.tables.items():
+        tmeta = snap["tables"].get(name)
+        if tmeta is None:
+            continue
+        for seg, files in tmeta["segfiles"].items():
+            if int(seg) >= schema.policy.numsegments:
+                problems.append(f"{name}: segfiles on seg {seg} beyond width")
+            for rel in files:
+                p = os.path.join(args.dir, "data", name, rel)
+                if not os.path.exists(p):
+                    problems.append(f"{name}: missing file {rel}")
+        # row counts readable + placement verified per segment
+        try:
+            total = sum(db.store.segment_rowcounts(name))
+            declared = sum(int(v) for v in tmeta["nrows"].values())
+            if total != declared:
+                problems.append(f"{name}: rowcount mismatch {total} != {declared}")
+        except Exception as e:
+            problems.append(f"{name}: unreadable ({e})")
+    if problems:
+        for p in problems:
+            print("PROBLEM:", p)
+        return 1
+    print("catalog and storage are consistent")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="gg")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("init")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-n", "--numsegments", type=int, default=None)
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("state")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--probe", action="store_true")
+    p.set_defaults(fn=cmd_state)
+
+    p = sub.add_parser("sql")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("query")
+    p.set_defaults(fn=cmd_sql)
+
+    p = sub.add_parser("expand")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-n", "--numsegments", type=int, required=True)
+    p.set_defaults(fn=cmd_expand)
+
+    p = sub.add_parser("recover")
+    p.add_argument("-d", "--dir", required=True)
+    p.set_defaults(fn=cmd_recover)
+
+    p = sub.add_parser("checkcat")
+    p.add_argument("-d", "--dir", required=True)
+    p.set_defaults(fn=cmd_checkcat)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

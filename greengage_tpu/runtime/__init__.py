@@ -1,0 +1,1 @@
+from greengage_tpu.runtime.faultinject import FaultInjector, faults  # noqa: F401

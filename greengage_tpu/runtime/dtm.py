@@ -1,0 +1,87 @@
+"""DTM-lite: session transactions over the manifest's two-phase commit.
+
+Reference parity: the distributed transaction manager (src/backend/cdb/
+cdbtm.c — doPrepareTransaction:418, doNotifyingCommitPrepared:566) with the
+manifest version swap as the distributed commit record (see
+storage/manifest.py). A transaction batches any number of writes; commit
+runs prepare (durably stage) -> flush dictionaries -> atomic swap, with
+fault points at each phase so tests can kill the coordinator mid-2PC and
+assert recovery (crash_recovery_dtm.sql analog). One-phase optimization:
+a read-only transaction commits without touching the manifest.
+"""
+
+from __future__ import annotations
+
+from greengage_tpu.runtime.faultinject import faults
+
+
+class TransactionError(RuntimeError):
+    pass
+
+
+class Transaction:
+    def __init__(self, store):
+        self.store = store
+        self.tx = store.manifest.begin()
+        self.tables_written: set[str] = set()
+        self.state = "active"     # active | prepared | committed | aborted
+
+    def insert(self, table: str, columns, valids=None) -> int:
+        if self.state != "active":
+            raise TransactionError(f"transaction is {self.state}")
+        n = self.store.insert(table, columns, valids, tx=self.tx)
+        self.tables_written.add(table)
+        return n
+
+    def commit(self) -> None:
+        if self.state != "active":
+            raise TransactionError(f"transaction is {self.state}")
+        if not self.tables_written:     # one-phase: nothing to publish
+            self.state = "committed"
+            return
+        faults.check("dtx_before_prepare")
+        try:
+            version = self.store.manifest.prepare(self.tx)
+        except RuntimeError as e:
+            self.state = "aborted"
+            raise TransactionError(str(e))
+        self.state = "prepared"
+        faults.check("dtx_after_prepare")       # crash here -> recover() rolls back
+        for t in self.tables_written:
+            self.store.flush_dicts(t)
+        faults.check("dtx_before_commit")
+        self.store.manifest.commit(version)
+        self.state = "committed"
+
+    def abort(self) -> None:
+        if self.state in ("committed",):
+            raise TransactionError("already committed")
+        self.state = "aborted"
+        for t in self.tables_written:
+            self.store._invalidate_dicts(t)
+
+
+class DtmSession:
+    """Per-Database transaction bookkeeping (MyTmGxact analog)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.current: Transaction | None = None
+
+    def begin(self) -> Transaction:
+        if self.current is not None and self.current.state == "active":
+            raise TransactionError("transaction already in progress")
+        self.current = Transaction(self.store)
+        return self.current
+
+    def commit(self) -> None:
+        if self.current is None or self.current.state != "active":
+            raise TransactionError("no transaction in progress")
+        self.current.commit()
+        self.current = None
+
+    def abort(self) -> None:
+        if self.current is None:
+            raise TransactionError("no transaction in progress")
+        self.current.abort()
+        self.current = None

@@ -1,0 +1,99 @@
+"""Named fault-point injection registry.
+
+Reference parity: src/backend/utils/misc/faultinjector.c (shmem registry of
+named points, types skip/error/sleep/panic/suspend, per-point hit counts)
+exposed to SQL via gpcontrib/gp_inject_fault. Ours is a process-local
+registry with the same point/type/occurrence model; tests and the FTS/DTM
+loops consult it at the same structural spots the reference instruments
+(probe send, commit phases, motion send, storage read).
+
+Usage:
+    faults.inject("fts_probe", "error", segment=2, occurrences=1)
+    ...
+    faults.check("fts_probe", segment=2)   # raises FaultError once
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class FaultError(RuntimeError):
+    pass
+
+
+@dataclass
+class _Fault:
+    name: str
+    type: str                 # skip | error | sleep | panic | suspend
+    segment: int | None       # None = any segment
+    occurrences: int          # remaining triggers; -1 = unlimited
+    sleep_s: float = 0.0
+    hits: int = 0
+
+
+@dataclass
+class FaultInjector:
+    _faults: dict[str, list[_Fault]] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inject(self, name: str, type: str = "error", segment: int | None = None,
+               occurrences: int = 1, sleep_s: float = 0.1) -> None:
+        if type not in ("skip", "error", "sleep", "panic", "suspend"):
+            raise ValueError(f"unknown fault type {type}")
+        with self._lock:
+            self._faults.setdefault(name, []).append(
+                _Fault(name, type, segment, occurrences, sleep_s))
+
+    def reset(self, name: str | None = None) -> None:
+        with self._lock:
+            if name is None:
+                self._faults.clear()
+            else:
+                self._faults.pop(name, None)
+
+    def check(self, name: str, segment: int | None = None) -> bool:
+        """Evaluate a fault point. Returns True if a 'skip' fired (caller
+        should skip its action); raises FaultError for 'error'/'panic';
+        sleeps for 'sleep'; blocks for 'suspend' until reset."""
+        with self._lock:
+            entries = self._faults.get(name, [])
+            fired = None
+            for f in entries:
+                if f.segment is not None and segment is not None and f.segment != segment:
+                    continue
+                if f.occurrences == 0:
+                    continue
+                if f.occurrences > 0:
+                    f.occurrences -= 1
+                f.hits += 1
+                fired = f
+                break
+        if fired is None:
+            return False
+        if fired.type == "skip":
+            return True
+        if fired.type == "sleep":
+            time.sleep(fired.sleep_s)
+            return False
+        if fired.type == "suspend":
+            while True:
+                time.sleep(0.01)
+                with self._lock:
+                    if fired.name not in self._faults:
+                        return False
+        raise FaultError(f"fault injected: {name}"
+                         + (f" (segment {segment})" if segment is not None else ""))
+
+    def status(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"name": f.name, "type": f.type, "segment": f.segment,
+                 "remaining": f.occurrences, "hits": f.hits}
+                for fs in self._faults.values() for f in fs
+            ]
+
+
+faults = FaultInjector()   # process-global registry (shmem analog)

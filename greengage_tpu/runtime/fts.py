@@ -1,0 +1,107 @@
+"""FTS-lite: probe-based failure detection + mirror promotion.
+
+Reference parity: the FTS bgworker on the master (src/backend/fts/fts.c:123)
+polls every primary through a connect/poll/send/receive FSM
+(ftsprobe.c:294), marks dead primaries down in gp_segment_configuration,
+promotes in-sync mirrors (ftsmessagehandler.c), and bumps an FTS version
+that invalidates the dispatcher's topology snapshot.
+
+Here a probe is a tiny device round-trip on the segment's chip (the health
+check that matters for a TPU cluster: can the device still execute?) plus a
+fault-injection point named "fts_probe" so tests can force failures
+(isolation2 fts_errors.sql analog). The prober can run one-shot (tests,
+CLI `gg state --probe`) or as a background thread with an interval.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from greengage_tpu.catalog.segments import SegmentConfig, SegmentRole, SegmentStatus
+from greengage_tpu.runtime.faultinject import FaultError, faults
+
+
+class FtsProber:
+    def __init__(self, config: SegmentConfig, mesh=None, interval_s: float = 5.0):
+        self.config = config
+        self.mesh = mesh
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.probe_count = 0
+
+    # ---- probe FSM (one cycle over all primaries) ----------------------
+    def probe_once(self) -> dict[int, bool]:
+        """Probe every primary; returns {content: alive}. Dead primaries
+        with an in-sync mirror are promoted (config.mark_down)."""
+        results: dict[int, bool] = {}
+        for entry in self.config.primaries():
+            alive = self._probe_segment(entry)
+            results[entry.content] = alive
+            if not alive and entry.status is SegmentStatus.UP:
+                self.config.mark_down(entry.content)
+        self.probe_count += 1
+        return results
+
+    def _probe_segment(self, entry) -> bool:
+        try:
+            if faults.check("fts_probe", segment=entry.content):
+                return True  # 'skip' = skip the probe, assume alive
+            if self.mesh is not None and entry.device_index is not None:
+                devices = list(self.mesh.devices.flat)
+                if entry.device_index < len(devices):
+                    import jax
+
+                    dev = devices[entry.device_index]
+                    # minimal execute round-trip on the segment's chip
+                    x = jax.device_put(np.ones((1,), np.float32), dev)
+                    float(np.asarray(x + 1)[0])
+            return True
+        except FaultError:
+            return False
+        except Exception:
+            return False
+
+    # ---- background worker (bgworker analog) ---------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.probe_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="fts-prober", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def cluster_state(config: SegmentConfig) -> list[dict]:
+    """gpstate-style rows for every segment entry."""
+    out = []
+    for e in sorted(config.entries, key=lambda e: (e.content, e.role.value)):
+        out.append({
+            "content": e.content,
+            "role": e.role.value,
+            "preferred_role": e.preferred_role.value,
+            "status": e.status.value,
+            "synced": e.mode_synced,
+            "device": e.device_index,
+        })
+    return out
+
+
+def needs_rebalance(config: SegmentConfig) -> bool:
+    return any(e.role is not e.preferred_role for e in config.entries)

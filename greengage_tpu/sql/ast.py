@@ -229,3 +229,14 @@ class ExplainStmt(ANode):
 @dataclass
 class ShowStmt(ANode):
     what: str
+
+
+@dataclass
+class SetStmt(ANode):
+    name: str
+    value: object
+
+
+@dataclass
+class TxStmt(ANode):
+    action: str        # begin | commit | abort

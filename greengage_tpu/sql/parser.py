@@ -38,6 +38,7 @@ KEYWORDS = {
     "insert", "into", "values", "copy", "explain", "analyze", "date",
     "interval", "extract", "distributed", "randomly", "replicated", "with",
     "exists", "if", "show", "union", "all", "substring", "for",
+    "begin", "commit", "rollback", "abort", "set", "to", "transaction", "work",
 }
 
 
@@ -128,6 +129,25 @@ class Parser:
         if self.at_kw("show"):
             self.next()
             return A.ShowStmt(self.next()[1])
+        if self.at_kw("set"):
+            self.next()
+            name = self.next()[1]
+            if not self.accept("op", "="):
+                self.expect("kw", "to")
+            value = self.next()[1]
+            return A.SetStmt(name, value)
+        if self.at_kw("begin"):
+            self.next()
+            self.accept("kw", "transaction") or self.accept("kw", "work")
+            return A.TxStmt("begin")
+        if self.at_kw("commit"):
+            self.next()
+            self.accept("kw", "transaction") or self.accept("kw", "work")
+            return A.TxStmt("commit")
+        if self.at_kw("rollback") or self.at_kw("abort"):
+            self.next()
+            self.accept("kw", "transaction") or self.accept("kw", "work")
+            return A.TxStmt("abort")
         raise SqlError(f"unexpected {self.peek()[1]!r}")
 
     # ---- SELECT --------------------------------------------------------

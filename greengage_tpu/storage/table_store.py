@@ -142,27 +142,7 @@ class TableStore:
             seg_of = self._placement(schema, enc, valids, nrows, total_existing)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
 
-        compresstype = schema.options.get("compresstype", "zlib")
-        complevel = int(schema.options.get("compresslevel", 1))
-        for s in range(nseg):
-            idx = seg_rows[s]
-            if len(idx) == 0:
-                continue
-            segdir = os.path.join(self.root, "data", table, f"seg{s}")
-            os.makedirs(segdir, exist_ok=True)
-            files = tmeta["segfiles"].setdefault(str(s), [])
-            for c in schema.columns:
-                fn = f"{c.name}.{fileno}.ggb"
-                write_column_file(os.path.join(segdir, fn), enc[c.name][idx],
-                                  compresstype, complevel)
-                files.append(os.path.join(f"seg{s}", fn))
-                v = valids.get(c.name)
-                if v is not None:
-                    vfn = f"{c.name}.{fileno}.valid.ggb"
-                    write_column_file(os.path.join(segdir, vfn),
-                                      np.asarray(v, dtype=np.uint8)[idx], compresstype, complevel)
-                    files.append(os.path.join(f"seg{s}", vfn))
-            tmeta["nrows"][str(s)] = tmeta["nrows"].get(str(s), 0) + int(len(idx))
+        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, fileno)
 
         if own_tx:
             # Ordering: stage files -> prepare (version CAS = the write lock)
@@ -233,6 +213,119 @@ class TableStore:
             if len(cols[name]) != nrows:
                 raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
         return cols, valids, nrows
+
+    def rewrite_table(self, table: str, new_numsegments: int) -> int:
+        """ALTER TABLE ... EXPAND TABLE analog (tablecmds.c:4067): re-place
+        every row at the new cluster width and publish atomically. Works on
+        already-encoded columns (TEXT codes kept; placement hashes go through
+        the dictionary LUT so string placement stays bytes-based)."""
+        from greengage_tpu.catalog.schema import DistPolicy, PolicyKind
+
+        schema = self.catalog.get(table)
+        old_nseg = schema.policy.numsegments
+        # gather all rows from the old layout
+        parts_cols: dict[str, list] = {c.name: [] for c in schema.columns}
+        parts_valids: dict[str, list] = {c.name: [] for c in schema.columns}
+        any_valid = {c.name: False for c in schema.columns}
+        snap = self.manifest.snapshot()
+        total = 0
+        read_segs = 1 if schema.policy.kind is PolicyKind.REPLICATED else old_nseg
+        for seg in range(read_segs):
+            cols, valids, n = self.read_segment(table, seg, snapshot=snap)
+            total += n
+            for c in schema.columns:
+                parts_cols[c.name].append(cols[c.name])
+                v = valids[c.name]
+                if v is not None:
+                    any_valid[c.name] = True
+                parts_valids[c.name].append(
+                    v if v is not None else np.ones(n, dtype=bool))
+        enc = {c.name: np.concatenate(parts_cols[c.name]) if parts_cols[c.name]
+               else np.empty(0, dtype=c.type.np_dtype) for c in schema.columns}
+        valids = {
+            c.name: np.concatenate(parts_valids[c.name])
+            for c in schema.columns
+            if any_valid[c.name] and parts_valids[c.name]
+        }
+
+        new_policy = DistPolicy(schema.policy.kind, schema.policy.keys, new_numsegments)
+        old_files = [
+            rel for files in snap["tables"].get(table, {"segfiles": {}})["segfiles"].values()
+            for rel in files
+        ]
+        tx = self.manifest.begin()
+        # the manifest carries the table width so layout + width publish in
+        # ONE atomic commit; the catalog copy is reconciled from it on open
+        tx["tables"][table] = {"segfiles": {}, "nrows": {},
+                               "numsegments": new_numsegments}
+        tmeta = tx["tables"][table]
+        nrows = len(next(iter(enc.values()))) if enc else 0
+        if new_policy.kind is PolicyKind.REPLICATED:
+            seg_rows = [np.arange(nrows)] * new_numsegments
+        elif new_policy.kind is PolicyKind.HASH:
+            rh = self.row_hashes(schema, enc, valids, new_policy.keys)
+            seg_of = (rh % np.uint32(new_numsegments)).astype(np.int32)
+            seg_rows = [np.nonzero(seg_of == s)[0] for s in range(new_numsegments)]
+        else:
+            seg_of = (np.arange(nrows) % new_numsegments).astype(np.int32)
+            seg_rows = [np.nonzero(seg_of == s)[0] for s in range(new_numsegments)]
+        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
+        v = self.manifest.prepare(tx)
+        self.manifest.commit(v)
+        # catalog: table now spans the new width (manifest is authoritative
+        # if we crash before this save — see reconcile_widths)
+        schema.policy = new_policy
+        self.catalog._save()
+        # GC the old layout's files (unreachable from the new manifest)
+        base = os.path.join(self.root, "data", table)
+        for rel in old_files:
+            try:
+                os.remove(os.path.join(base, rel))
+            except OSError:
+                pass
+        return nrows
+
+    def reconcile_widths(self) -> None:
+        """Crash recovery for expansion: the manifest's per-table width is
+        the commit record; if the catalog copy lags (crash between manifest
+        commit and catalog save in rewrite_table), adopt the manifest's."""
+        from greengage_tpu.catalog.schema import DistPolicy
+
+        snap = self.manifest.snapshot()
+        changed = False
+        for name, tmeta in snap["tables"].items():
+            width = tmeta.get("numsegments")
+            if width is None or name not in self.catalog:
+                continue
+            schema = self.catalog.get(name)
+            if schema.policy.numsegments != width:
+                schema.policy = DistPolicy(schema.policy.kind, schema.policy.keys, width)
+                changed = True
+        if changed:
+            self.catalog._save()
+
+    def _write_segfiles(self, schema, tmeta, enc, valids, seg_rows, fileno) -> None:
+        compresstype = schema.options.get("compresstype", "zlib")
+        complevel = int(schema.options.get("compresslevel", 1))
+        for s, idx in enumerate(seg_rows):
+            if len(idx) == 0:
+                continue
+            segdir = os.path.join(self.root, "data", schema.name, f"seg{s}")
+            os.makedirs(segdir, exist_ok=True)
+            files = tmeta["segfiles"].setdefault(str(s), [])
+            for c in schema.columns:
+                fn = f"{c.name}.{fileno}.ggb"
+                write_column_file(os.path.join(segdir, fn), enc[c.name][idx],
+                                  compresstype, complevel)
+                files.append(os.path.join(f"seg{s}", fn))
+                v = valids.get(c.name)
+                if v is not None:
+                    vfn = f"{c.name}.{fileno}.valid.ggb"
+                    write_column_file(os.path.join(segdir, vfn),
+                                      np.asarray(v, dtype=np.uint8)[idx],
+                                      compresstype, complevel)
+                    files.append(os.path.join(f"seg{s}", vfn))
+            tmeta["nrows"][str(s)] = tmeta["nrows"].get(str(s), 0) + int(len(idx))
 
     def has_nulls(self, table: str, col: str, snapshot: dict | None = None) -> bool:
         """True if any committed segfile of this column has a validity file
