@@ -60,11 +60,16 @@ class CompileResult:
     merge_keys: list | None
     host_limit: tuple | None           # (limit, offset)
     capacity: int                      # below-gather output capacity
+    metric_names: list[str] = field(default_factory=list)
+    # overflow flag -> (plan node id, metric name): lets the executor size
+    # the retry capacity from the exact cardinality the device reported
+    flag_caps: dict = field(default_factory=dict)
 
 
 class Compiler:
     def __init__(self, catalog, store, mesh, nseg: int, consts: dict,
-                 settings: Settings, tier: int = 0):
+                 settings: Settings, tier: int = 0,
+                 cap_overrides: dict | None = None):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -72,7 +77,10 @@ class Compiler:
         self.consts = consts
         self.s = settings
         self.tier = tier
+        self.cap_overrides = cap_overrides or {}   # plan node id -> capacity
         self.flags: list[str] = []
+        self.metrics: list[str] = []
+        self.flag_caps: dict = {}
         self.scan_caps: dict[str, int] = {}
         self.scan_cols: dict[str, set] = {}
 
@@ -114,6 +122,7 @@ class Compiler:
                 entry["@present"] = flat[i]
                 i += 1
                 ctx["tables"][tname] = entry
+            ctx["metrics"] = []
             batch = compiled(ctx)
             sel = batch.selection()
             outs = []
@@ -124,9 +133,12 @@ class Compiler:
             outs.append(sel)
             for _, f in ctx["flags"]:
                 outs.append(jnp.broadcast_to(f.astype(jnp.int32), (1,)))
+            for _, m in ctx["metrics"]:
+                outs.append(jnp.broadcast_to(m.astype(jnp.int64), (1,)))
             return tuple(outs)
 
-        nouts = 2 * len(out_cols) + 1 + len(flag_names)
+        metric_names = list(self.metrics)
+        nouts = 2 * len(out_cols) + 1 + len(flag_names) + len(metric_names)
         fn = jax.jit(
             jax.shard_map(
                 seg_fn,
@@ -145,6 +157,8 @@ class Compiler:
             merge_keys=plan.merge_keys,
             host_limit=host_limit,
             capacity=self._capacity_of(below),
+            metric_names=metric_names,
+            flag_caps=dict(self.flag_caps),
         )
 
     # ------------------------------------------------------------------
@@ -172,7 +186,15 @@ class Compiler:
                 return min(cap, plan.limit + plan.offset)
             return cap
         if isinstance(plan, Join):
-            return self._capacity_of(plan.left)
+            probe_cap = self._capacity_of(plan.left)
+            if getattr(plan, "multi", False):
+                if id(plan) in self.cap_overrides:
+                    # exact cardinality reported by the overflowed run
+                    return max(int(self.cap_overrides[id(plan)]), 64)
+                # CSR expansion output capacity; exponential tier growth as
+                # a fallback when no exact report is available
+                return int(probe_cap * 1.5 * (16 ** self.tier)) + 64
+            return probe_cap
         if isinstance(plan, Aggregate):
             if not plan.group_keys:
                 return 1
@@ -301,6 +323,8 @@ class Compiler:
     def _c_join(self, plan: Join):
         if plan.kind == "cross":
             raise NotImplementedError("cross join execution")
+        if getattr(plan, "multi", False):
+            return self._c_join_multi(plan)
         left_fn = self._compile_node(plan.left)
         right_fn = self._compile_node(plan.right)
         build_cap = self._capacity_of(plan.right)
@@ -311,8 +335,11 @@ class Compiler:
         residual = plan.residual
         fid_ov = f"join_overflow_{len(self.flags)}"
         self.flags.append(fid_ov)
-        fid_dup = f"join_dup_{len(self.flags)}"
-        self.flags.append(fid_dup)
+        fid_dup = None
+        if kind in ("inner", "left"):
+            # semi/anti only need existence: duplicate build keys are fine
+            fid_dup = f"join_dup_{len(self.flags)}"
+            self.flags.append(fid_dup)
         right_cols = [c for c in plan.right.out_cols()]
 
         def run(ctx):
@@ -320,7 +347,8 @@ class Compiler:
             rb = right_fn(ctx)
             table = join_ops.build(self._key_specs(rb, rkeys), rb.selection(), M, probes)
             ctx["flags"].append((fid_ov, table.overflow))
-            ctx["flags"].append((fid_dup, table.dup))
+            if fid_dup is not None:
+                ctx["flags"].append((fid_dup, table.dup))
             matched, brow = join_ops.probe(table, self._key_specs(lb, lkeys),
                                            lb.selection(), probes)
             cols = dict(lb.cols)
@@ -343,6 +371,68 @@ class Compiler:
                 mask = Evaluator(out, self.consts).predicate(residual)
                 if kind == "left":
                     # residual only disqualifies the match, not the row
+                    newm = matched & mask
+                    for c in right_cols:
+                        out.valids[c.id] = out.valids[c.id] & newm
+                else:
+                    out = out.with_sel(out.selection() & mask)
+            return out
+
+        return run
+
+    def _c_join_multi(self, plan: Join):
+        """Duplicate-capable inner/left join via CSR expansion."""
+        if plan.kind == "left" and plan.residual is not None:
+            raise NotImplementedError(
+                "LEFT JOIN with a non-equality ON condition over a "
+                "duplicate-key build side is not supported yet")
+        left_fn = self._compile_node(plan.left)
+        right_fn = self._compile_node(plan.right)
+        build_cap = self._capacity_of(plan.right)
+        M = self._join_table_size(build_cap)
+        out_cap = self._capacity_of(plan)
+        probes = self.s.hash_num_probes
+        lkeys, rkeys = plan.left_keys, plan.right_keys
+        kind = plan.kind
+        residual = plan.residual
+        fid_ov = f"join_overflow_{len(self.flags)}"
+        self.flags.append(fid_ov)
+        fid_exp = f"join_expand_overflow_{len(self.flags)}"
+        self.flags.append(fid_exp)
+        mid_total = f"join_expand_total_{len(self.metrics)}"
+        self.metrics.append(mid_total)
+        # overflow retry can size from the exact reported cardinality
+        self.flag_caps[fid_exp] = (id(plan), mid_total)
+        left_cols = [c for c in plan.left.out_cols()]
+        right_cols = [c for c in plan.right.out_cols()]
+
+        def run(ctx):
+            lb = left_fn(ctx)
+            rb = right_fn(ctx)
+            table = join_ops.build_multi(
+                self._key_specs(rb, rkeys), rb.selection(), M, probes)
+            ctx["flags"].append((fid_ov, table.base.overflow))
+            present, prow, brow, matched, expand_ov, total = join_ops.probe_multi(
+                table, self._key_specs(lb, lkeys), lb.selection(), probes,
+                out_cap, left_outer=(kind == "left"))
+            ctx["flags"].append((fid_exp, expand_ov))
+            ctx["metrics"].append((mid_total, total))
+            cols, valids = {}, {}
+            for c in left_cols:
+                cols[c.id] = lb.cols[c.id][prow]
+                v = lb.valids.get(c.id)
+                if v is not None:
+                    valids[c.id] = v[prow]
+            for c in right_cols:
+                cols[c.id] = rb.cols[c.id][brow]
+                v = rb.valids.get(c.id)
+                gv = v[brow] if v is not None else jnp.ones_like(matched)
+                valids[c.id] = gv & matched
+            sel = present if kind == "left" else (present & matched)
+            out = Batch(cols, valids, sel)
+            if residual is not None:
+                mask = Evaluator(out, self.consts).predicate(residual)
+                if kind == "left":
                     newm = matched & mask
                     for c in right_cols:
                         out.valids[c.id] = out.valids[c.id] & newm

@@ -93,13 +93,16 @@ class Executor:
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
         last_err = None
+        cap_overrides: dict = {}
         for tier in range(self.settings.motion_retry_tiers):
-            ck = (cache_key, version, tier) if cache_key is not None else None
+            ck = ((cache_key, version, tier) if cache_key is not None
+                  and not cap_overrides else None)
             if ck is not None and ck in self._plan_cache:
                 comp = self._plan_cache[ck]
             else:
                 comp = Compiler(self.catalog, self.store, self.mesh, self.nseg,
-                                consts, self.settings, tier=tier).compile(plan)
+                                consts, self.settings, tier=tier,
+                                cap_overrides=cap_overrides).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -117,8 +120,11 @@ class Executor:
             # through tunneled/remote device paths dwarfs per-byte cost)
             flat = jax.device_get(list(flat))
             ncols = len(comp.out_cols)
+            nflags = len(comp.flag_names)
             flags = dict(zip(comp.flag_names,
-                             flat[2 * ncols + 1:]))
+                             flat[2 * ncols + 1: 2 * ncols + 1 + nflags]))
+            metrics = dict(zip(comp.metric_names,
+                               flat[2 * ncols + 1 + nflags:]))
             dup = [k for k, v in flags.items() if k.startswith("join_dup") and v.any()]
             if dup:
                 raise QueryError(
@@ -130,6 +136,14 @@ class Executor:
                 res = self._finalize(comp, flat, snapshot)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
                 return res
+            # size the retry from exact cardinalities where the device
+            # reported them (join expansion totals)
+            for fname in overflow:
+                hint = comp.flag_caps.get(fname)
+                if hint is not None:
+                    plan_id, metric = hint
+                    need = int(np.max(metrics[metric]))
+                    cap_overrides[plan_id] = need + max(need // 16, 64)
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
 

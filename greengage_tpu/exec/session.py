@@ -135,14 +135,29 @@ class Database:
         key = (stmt_key, self.store.manifest.snapshot().get("version", 0))
         cached = self._select_cache.get(key)
         if cached is None:
-            cached = self._plan(stmt)
+            cached = (*self._plan(stmt), stmt_key)
             self._select_cache[key] = cached
             if len(self._select_cache) > 256:
                 self._select_cache.pop(next(iter(self._select_cache)))
-        planned, consts, outs = cached
-        # executor adds the manifest version itself; passing the bare
-        # statement identity lets it evict compiled programs of old versions
-        return self.executor.run(planned, consts, outs, cache_key=stmt_key)
+        planned, consts, outs, exec_key = cached
+        try:
+            # executor adds the manifest version; the bare statement identity
+            # lets it evict compiled programs of old versions
+            return self.executor.run(planned, consts, outs, cache_key=exec_key)
+        except QueryError as e:
+            if "duplicate keys" not in str(e):
+                raise
+            # the uniqueness heuristic was wrong at runtime: re-plan with the
+            # CSR multi-match join forced everywhere; cache the multi plan
+            # (with its own executor key) so repeats skip the failing program
+            binder = Binder(self.catalog, self.store)
+            logical, outs = binder.bind_select(stmt)
+            planned = plan_query(logical, self.catalog, self.store,
+                                 self.numsegments, force_multi_join=True)
+            self._select_cache[key] = (planned, binder.consts, outs,
+                                       stmt_key + "#multi")
+            return self.executor.run(planned, binder.consts, outs,
+                                     cache_key=stmt_key + "#multi")
 
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, A.SelectStmt):

@@ -55,22 +55,28 @@ def _strict_eq(a, av, b, bv):
     return eq
 
 
-def build(keys: list[KeySpec], sel, table_size: int, num_probes: int) -> BuildTable:
+def _claim(keys: list[KeySpec], sel, table_size: int, num_probes: int):
+    """Shared open-addressing claim/resolve loop (build side).
+
+    -> (tkeys, slot_row, used, overflow, dup, final_slot): every
+    strictly-selected build row resolves to the slot holding its key;
+    final_slot == table_size marks dead/unresolved rows."""
     M = table_size
     assert M & (M - 1) == 0
     n = sel.shape[0]
     row_idx = jnp.arange(n, dtype=jnp.int32)
-    # NULL keys never participate (strict equality): drop them from the build
+    strict = sel
     for k in keys:
         if k.valid is not None:
-            sel = sel & k.valid
+            strict = strict & k.valid   # NULL keys never participate
     h = _key_hash(keys)
     slot, step = agg_probe_sequence(h, M)
 
-    active = sel
+    active = strict
     used = jnp.zeros((M,), dtype=bool)
     slot_row = jnp.zeros((M,), dtype=jnp.int32)
     tkeys = [jnp.zeros((M,), dtype=k.values.dtype) for k in keys]
+    final_slot = jnp.full((n,), M, dtype=jnp.int32)
     dup = jnp.zeros((), dtype=bool)
 
     for _ in range(num_probes):
@@ -86,44 +92,125 @@ def build(keys: list[KeySpec], sel, table_size: int, num_probes: int) -> BuildTa
         match = active & used[slot]
         for i, k in enumerate(keys):
             match = match & (k.values == tkeys[i][slot])
-        # a build row matching a slot stored for a *different* row = duplicate key
+        # a row matching a slot stored for a *different* row = duplicate key
         dup = dup | jnp.any(match & (slot_row[slot] != row_idx))
+        final_slot = jnp.where(match, slot, final_slot)
         active = active & ~match
         slot = (slot + step) & (M - 1)
 
+    return tkeys, slot_row, used, jnp.any(active), dup, final_slot, strict
+
+
+def _walk(used, slot_keys, M, keys: list[KeySpec], sel, num_probes: int):
+    """Shared probe walk. -> (matched, slot_of) per probe row."""
+    strict = sel
+    for k in keys:
+        if k.valid is not None:
+            strict = strict & k.valid
+    h = _key_hash(keys)
+    slot, step = agg_probe_sequence(h, M)
+    matched = jnp.zeros_like(sel)
+    slot_of = jnp.zeros(sel.shape, dtype=jnp.int32)
+    active = strict
+    for _ in range(num_probes):
+        hit = active & used[slot]
+        for i, k in enumerate(keys):
+            hit = hit & (k.values == slot_keys[i][slot])
+        matched = matched | hit
+        slot_of = jnp.where(hit, slot, slot_of)
+        active = active & ~hit
+        slot = (slot + step) & (M - 1)
+    return matched, slot_of
+
+
+def build(keys: list[KeySpec], sel, table_size: int, num_probes: int) -> BuildTable:
+    tkeys, slot_row, used, overflow, dup, _, _ = _claim(keys, sel, table_size, num_probes)
     return BuildTable(
         slot_keys=tkeys,
         slot_key_valids=[None] * len(keys),
         slot_row=slot_row,
         used=used,
-        overflow=jnp.any(active),
+        overflow=overflow,
         dup=dup,
-        size=M,
+        size=table_size,
     )
 
 
 def probe(table: BuildTable, keys: list[KeySpec], sel, num_probes: int):
     """-> (matched bool[n], build_row int32[n]) over the probe batch."""
-    M = table.size
-    strict_sel = sel
-    for k in keys:
-        if k.valid is not None:
-            strict_sel = strict_sel & k.valid
-    h = _key_hash(keys)
-    slot, step = agg_probe_sequence(h, M)
+    matched, slot_of = _walk(table.used, table.slot_keys, table.size, keys, sel,
+                             num_probes)
+    return matched, jnp.where(matched, table.slot_row[slot_of], 0)
 
-    matched = jnp.zeros_like(sel)
-    build_row = jnp.zeros(sel.shape, dtype=jnp.int32)
-    active = strict_sel
-    for _ in range(num_probes):
-        hit = active & table.used[slot]
-        for i, k in enumerate(keys):
-            hit = hit & (k.values == table.slot_keys[i][slot])
-        matched = matched | hit
-        build_row = jnp.where(hit, table.slot_row[slot], build_row)
-        active = active & ~hit
-        slot = (slot + step) & (M - 1)
-    return matched, build_row
+
+# ---------------------------------------------------------------------------
+# Multi-match join: duplicate build keys via CSR expansion
+#
+# Build groups rows by key into the slot table (winner row stored), then
+# lays all build rows out in slot order (CSR): counts[slot], starts[slot],
+# rows_sorted[]. Probe rows find their slot (exact key match), read the
+# match count, and the output expands via prefix sums + searchsorted —
+# output row j maps to (probe_row[j], build_row[j]). Static output capacity
+# with an overflow flag feeds the executor's tier retry, standing in for
+# nodeHashjoin's dynamic batching (reference: src/backend/executor/
+# nodeHashjoin.c) under XLA's static shapes.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MultiTable:
+    base: BuildTable
+    counts: jnp.ndarray        # matches per slot [M]
+    starts: jnp.ndarray        # CSR offsets [M]
+    rows_sorted: jnp.ndarray   # build row indices grouped by slot [n_build]
+
+
+def build_multi(keys: list[KeySpec], sel, table_size: int, num_probes: int) -> MultiTable:
+    M = table_size
+    tkeys, slot_row, used, overflow, dup, final_slot, strict = _claim(
+        keys, sel, M, num_probes)
+    counts = jnp.zeros((M + 1,), dtype=jnp.int32).at[final_slot].add(
+        jnp.where(strict, 1, 0))[:M]
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    order = jnp.argsort(final_slot, stable=True).astype(jnp.int32)
+    base = BuildTable(tkeys, [None] * len(keys), slot_row, used, overflow,
+                      dup, M)
+    return MultiTable(base, counts, starts, order)
+
+
+def probe_multi(table: MultiTable, keys: list[KeySpec], sel, num_probes: int,
+                out_cap: int, left_outer: bool = False):
+    """-> (present[K], probe_row[K], build_row[K], matched[K], overflow,
+    total) where total is the exact output cardinality — the executor uses
+    it to size the retry capacity when overflow fires.
+
+    left_outer: unmatched probe rows still emit one output row with
+    matched=False (NULL-extended build side downstream)."""
+    matched, slot_of = _probe_slots(table, keys, sel, num_probes)
+    count = jnp.where(matched, table.counts[slot_of], 0)
+    if left_outer:
+        count = jnp.where(sel & ~matched, 1, count)
+    cum = jnp.cumsum(count.astype(jnp.int64))
+    total = cum[-1] if count.shape[0] else jnp.int64(0)
+    overflow = total > out_cap
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    probe_row = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    pr = jnp.clip(probe_row, 0, count.shape[0] - 1)
+    prev = jnp.where(pr > 0, cum[pr - 1], 0)
+    ordinal = (j - prev).astype(jnp.int32)
+    present = j < total
+    m_at = matched[pr]
+    slot_at = slot_of[pr]
+    build_row = table.rows_sorted[
+        jnp.clip(table.starts[slot_at] + ordinal, 0, table.rows_sorted.shape[0] - 1)]
+    build_row = jnp.where(m_at, build_row, 0)
+    return present, pr, build_row, m_at & present, overflow, total
+
+
+def _probe_slots(table: MultiTable, keys: list[KeySpec], sel, num_probes: int):
+    return _walk(table.base.used, table.base.slot_keys, table.base.size, keys,
+                 sel, num_probes)
 
 
 def gather_build_columns(build_cols: dict, build_valids: dict, build_row, matched):

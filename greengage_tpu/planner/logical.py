@@ -82,6 +82,7 @@ class Join(Plan):
     left_keys: list[E.Expr]
     right_keys: list[E.Expr]
     residual: E.Expr | None = None
+    multi: bool = False            # build side may have duplicate keys (CSR join)
 
     def out_cols(self):
         if self.kind in ("semi", "anti"):

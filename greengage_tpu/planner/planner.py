@@ -26,10 +26,11 @@ from greengage_tpu.planner.logical import (
 
 
 class Planner:
-    def __init__(self, catalog, store, numsegments: int):
+    def __init__(self, catalog, store, numsegments: int, force_multi_join: bool = False):
         self.catalog = catalog
         self.store = store
         self.nseg = numsegments
+        self.force_multi_join = force_multi_join
 
     # ------------------------------------------------------------------
     def plan(self, node: Plan) -> Plan:
@@ -185,6 +186,19 @@ class Planner:
         node.est_rows = max(left.est_rows, right.est_rows)
         if node.kind in ("semi", "anti"):
             node.est_rows = left.est_rows * 0.5
+        # build-side duplicate keys force the CSR multi-match kernel for
+        # inner/left (semi/anti only need existence, the plain table is
+        # fine). LEFT JOIN with a residual stays on the unique-build path:
+        # the multi kernel can't express per-match residual disqualification
+        # yet, and the unique path is correct whenever the dup flag stays
+        # clear at runtime.
+        if node.kind == "inner" or (node.kind == "left" and node.residual is None):
+            if self.force_multi_join or not _keys_look_unique(
+                    node.right, node.right_keys):
+                node.multi = True
+                # duplicate fanout multiplies output rows; nudge the
+                # estimate so operators above size their tables for it
+                node.est_rows = max(node.est_rows, left.est_rows * 2.0)
         return node
 
     # ------------------------------------------------------------------
@@ -352,5 +366,6 @@ def _scan_covers(plan: Plan, ids: set) -> bool:
     return False
 
 
-def plan_query(root: Plan, catalog, store, numsegments: int) -> Plan:
-    return Planner(catalog, store, numsegments).plan(root)
+def plan_query(root: Plan, catalog, store, numsegments: int,
+               force_multi_join: bool = False) -> Plan:
+    return Planner(catalog, store, numsegments, force_multi_join).plan(root)

@@ -237,12 +237,48 @@ def test_left_join_nulls(db):
     assert r.rows() == [(1, 100), (2, None), (3, 300)]
 
 
-def test_duplicate_build_key_raises(db):
+def test_duplicate_build_keys_multi_match(db):
     db.sql("create table dup_b (k int, v int) distributed by (k);"
            "insert into dup_b values (1, 1), (1, 2), (2, 3), (3, 4), (4, 5), "
            "(5, 6), (6, 7), (7, 8)")
-    with pytest.raises(QueryError, match="duplicate"):
-        db.sql("select a.v from dup_b a join dup_b b on a.v = b.k")
+    # self-join on a duplicated key: k=1 appears twice on the build side
+    r = db.sql("select a.v av, b.v bv from dup_b a join dup_b b on a.k = b.k "
+               "order by av, bv")
+    df = pd.DataFrame({"k": [1, 1, 2, 3, 4, 5, 6, 7],
+                       "v": [1, 2, 3, 4, 5, 6, 7, 8]})
+    want = df.merge(df, on="k").sort_values(["v_x", "v_y"])
+    got = r.to_pandas()
+    assert len(got) == len(want) == 10  # k=1 expands 2x2, six other keys 1x1
+    assert list(got.av) == list(want.v_x)
+    assert list(got.bv) == list(want.v_y)
+    # dist key == join key, so the planner chose the unique path first; the
+    # runtime dup flag must have forced the multi re-plan (retry pinned)
+    assert any(k[0].endswith("#multi") for k in db.executor._plan_cache)
+    # repeat must hit the cached multi plan, not re-fail on the stale program
+    r2 = db.sql("select a.v av, b.v bv from dup_b a join dup_b b on a.k = b.k "
+                "order by av, bv")
+    assert len(r2) == 10
+
+
+def test_fk_fk_join_planned_multi_directly(db, oracle):
+    # join on a non-key column both sides (c_nationkey = s_nationkey):
+    # neither side looks unique at plan time -> multi-match CSR join chosen
+    # directly (no runtime retry involved)
+    r = db.sql("select count(*) from customer, supplier "
+               "where c_nationkey = s_nationkey")
+    c, s = oracle["customer"], oracle["supplier"]
+    want = len(c.merge(s, left_on="c_nationkey", right_on="s_nationkey"))
+    assert r.rows()[0][0] == want
+
+
+def test_left_join_duplicate_build(db):
+    db.sql("create table ml_a (k int, v int) distributed by (k);"
+           "create table ml_b (k int, w int) distributed by (k);"
+           "insert into ml_a values (1, 10), (2, 20), (3, 30);"
+           "insert into ml_b values (1, 100), (1, 101), (3, 300)")
+    r = db.sql("select a.k, w from ml_a a left join ml_b b on a.k = b.k "
+               "order by a.k, w nulls last")
+    assert r.rows() == [(1, 100), (1, 101), (2, None), (3, 300)]
 
 
 def test_having(db, oracle):
