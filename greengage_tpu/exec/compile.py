@@ -37,7 +37,8 @@ from greengage_tpu.parallel import SEG_AXIS
 from greengage_tpu.parallel import motion as motion_ops
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.planner.logical import (
-    Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan, Sort,
+    Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan,
+    Sort, Union,
 )
 
 VALID_PREFIX = "@v:"
@@ -205,6 +206,8 @@ class Compiler:
                     d *= dom
                 return d
             return self._agg_table_size(plan)
+        if isinstance(plan, Union):
+            return sum(self._capacity_of(c) for c in plan.inputs)
         if isinstance(plan, Motion):
             child_cap = self._capacity_of(plan.child)
             if plan.kind is MotionKind.BROADCAST:
@@ -342,15 +345,20 @@ class Compiler:
             self.flags.append(fid_dup)
         right_cols = [c for c in plan.right.out_cols()]
 
+        null_aware = getattr(plan, "null_aware", False)
+
         def run(ctx):
+            from jax import lax
+
             lb = left_fn(ctx)
             rb = right_fn(ctx)
-            table = join_ops.build(self._key_specs(rb, rkeys), rb.selection(), M, probes)
+            rspecs = self._key_specs(rb, rkeys)
+            lspecs = self._key_specs(lb, lkeys)
+            table = join_ops.build(rspecs, rb.selection(), M, probes)
             ctx["flags"].append((fid_ov, table.overflow))
             if fid_dup is not None:
                 ctx["flags"].append((fid_dup, table.dup))
-            matched, brow = join_ops.probe(table, self._key_specs(lb, lkeys),
-                                           lb.selection(), probes)
+            matched, brow = join_ops.probe(table, lspecs, lb.selection(), probes)
             cols = dict(lb.cols)
             valids = dict(lb.valids)
             sel = lb.selection()
@@ -358,6 +366,25 @@ class Compiler:
                 sel = sel & matched
             elif kind == "semi":
                 sel = sel & matched
+            elif kind == "anti" and null_aware:
+                # NOT IN semantics: empty subquery -> everything qualifies;
+                # otherwise NULL probe keys and any NULL subquery key
+                # disqualify (result NULL -> filtered)
+                rsel = rb.selection()
+                def _gmax(b):
+                    return lax.pmax(jnp.any(b).astype(jnp.int32), SEG_AXIS) > 0
+                s_nonempty = _gmax(rsel)
+                s_has_null = jnp.zeros((), bool)
+                x_null = jnp.zeros_like(sel)
+                for sp in rspecs:
+                    if sp.valid is not None:
+                        s_has_null = s_has_null | _gmax(rsel & ~sp.valid)
+                for sp in lspecs:
+                    if sp.valid is not None:
+                        x_null = x_null | ~sp.valid
+                qualify = jnp.where(s_nonempty,
+                                    ~x_null & ~matched & ~s_has_null, True)
+                sel = sel & qualify
             elif kind == "anti":
                 sel = sel & ~matched
             if kind in ("inner", "left"):
@@ -613,6 +640,38 @@ class Compiler:
             valids = {k[len(VALID_PREFIX):]: v for k, v in recv.items()
                       if k.startswith(VALID_PREFIX)}
             return Batch(cols, valids, precv)
+
+        return run
+
+    # ---- union ---------------------------------------------------------
+    def _c_union(self, plan: Union):
+        fns = [self._compile_node(c) for c in plan.inputs]
+        branch_ids = plan.branch_ids
+        loci = [c.locus for c in plan.inputs]
+
+        def run(ctx):
+            from jax import lax
+
+            parts_c = {uc.id: [] for uc in plan.cols}
+            parts_v = {uc.id: [] for uc in plan.cols}
+            parts_sel = []
+            for fn, ids, locus in zip(fns, branch_ids, loci):
+                b = fn(ctx)
+                sel = b.selection()
+                if locus is not None and locus.kind in (
+                        LocusKind.SEGMENT_GENERAL, LocusKind.GENERAL):
+                    # replicated branch: keep one segment's copy
+                    sel = sel & (lax.axis_index(SEG_AXIS) == 0)
+                parts_sel.append(sel)
+                for uc, bid in zip(plan.cols, ids):
+                    parts_c[uc.id].append(b.cols[bid])
+                    v = b.valids.get(bid)
+                    parts_v[uc.id].append(
+                        v if v is not None else jnp.ones_like(sel))
+            cols = {k: jnp.concatenate(v) for k, v in parts_c.items()}
+            valids = {k: jnp.concatenate(v) for k, v in parts_v.items()}
+            sel = jnp.concatenate(parts_sel)
+            return Batch(cols, valids, sel)
 
         return run
 

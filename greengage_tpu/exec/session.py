@@ -78,7 +78,7 @@ class Database:
         return out
 
     def _execute(self, stmt):
-        if isinstance(stmt, A.SelectStmt):
+        if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
             return self._select(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._explain(stmt)
@@ -121,11 +121,43 @@ class Database:
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
-    def _plan(self, stmt: A.SelectStmt):
-        binder = Binder(self.catalog, self.store)
+    def _plan(self, stmt, force_multi_join: bool = False):
+        binder = Binder(self.catalog, self.store,
+                        subquery_executor=self._scalar_subquery)
         logical, outs = binder.bind_select(stmt)
-        planned = plan_query(logical, self.catalog, self.store, self.numsegments)
+        planned = plan_query(logical, self.catalog, self.store, self.numsegments,
+                             force_multi_join=force_multi_join)
         return planned, binder.consts, outs
+
+    def _scalar_subquery(self, stmt):
+        """Run an uncorrelated scalar subquery at bind time (InitPlan
+        analog): the value is inlined as a literal into the outer plan."""
+        planned, consts, outs = self._plan(stmt)
+        if len(outs) != 1:
+            raise SqlError("scalar subquery must return one column")
+        res = self.executor.run(planned, consts, outs)
+        if len(res) > 1:
+            raise SqlError("more than one row returned by a scalar subquery")
+        t = outs[0].type
+        if len(res) == 0:
+            return None, t
+        v = res.cols[outs[0].id][0]
+        valid = res.valids.get(outs[0].id)
+        if valid is not None and not valid[0]:
+            return None, t
+        # convert the presentation value back to storage representation
+        if t.kind is T.Kind.DECIMAL:
+            return T.decimal_to_int(float(v), t.scale), t
+        if t.kind is T.Kind.DATE:
+            return int((np.datetime64(v, "D")
+                        - np.datetime64("1970-01-01", "D")).astype(int)), t
+        if t.kind is T.Kind.TEXT:
+            return str(v), T.TEXT
+        if t.kind is T.Kind.FLOAT64:
+            return float(v), t
+        if t.kind is T.Kind.BOOL:
+            return bool(v), t
+        return int(v), t
 
     def _select(self, stmt: A.SelectStmt) -> Result:
         # plan cache key: structural statement identity (dataclass repr is
@@ -150,17 +182,14 @@ class Database:
             # the uniqueness heuristic was wrong at runtime: re-plan with the
             # CSR multi-match join forced everywhere; cache the multi plan
             # (with its own executor key) so repeats skip the failing program
-            binder = Binder(self.catalog, self.store)
-            logical, outs = binder.bind_select(stmt)
-            planned = plan_query(logical, self.catalog, self.store,
-                                 self.numsegments, force_multi_join=True)
-            self._select_cache[key] = (planned, binder.consts, outs,
+            planned, consts, outs = self._plan(stmt, force_multi_join=True)
+            self._select_cache[key] = (planned, consts, outs,
                                        stmt_key + "#multi")
-            return self.executor.run(planned, binder.consts, outs,
+            return self.executor.run(planned, consts, outs,
                                      cache_key=stmt_key + "#multi")
 
     def _explain(self, stmt: A.ExplainStmt):
-        if not isinstance(stmt.query, A.SelectStmt):
+        if not isinstance(stmt.query, (A.SelectStmt, A.UnionStmt)):
             raise SqlError("EXPLAIN supports SELECT only")
         planned, consts, outs = self._plan(stmt.query)
         text = describe(planned)

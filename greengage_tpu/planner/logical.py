@@ -83,6 +83,10 @@ class Join(Plan):
     right_keys: list[E.Expr]
     residual: E.Expr | None = None
     multi: bool = False            # build side may have duplicate keys (CSR join)
+    # NOT IN semantics (nodeSubplan's hashed-NOT-IN analog): result is empty
+    # if the subquery produced any NULL key; NULL probe keys never qualify;
+    # an empty subquery qualifies every probe row.
+    null_aware: bool = False
 
     def out_cols(self):
         if self.kind in ("semi", "anti"):
@@ -119,6 +123,20 @@ class Limit(Plan):
 
     def out_cols(self):
         return self.child.out_cols()
+
+
+@dataclass
+class Union(Plan):
+    inputs: list[Plan]             # branch outputs map positionally to cols
+    cols: list[ColInfo]
+    distinct: bool = False         # handled by an Aggregate the binder adds
+
+    @property
+    def children(self) -> list["Plan"]:
+        return list(self.inputs)
+
+    def out_cols(self):
+        return self.cols
 
 
 class MotionKind(enum.Enum):

@@ -21,7 +21,7 @@ from greengage_tpu.planner import cost as C
 from greengage_tpu.planner.locus import Locus, LocusKind
 from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Motion, MotionKind, Plan, Project,
-    Scan, Sort,
+    Scan, Sort, Union,
 )
 
 
@@ -282,6 +282,14 @@ class Planner:
         return final
 
     # ------------------------------------------------------------------
+    def _plan_union(self, node: Union) -> Plan:
+        node.inputs = [self._rec(c) for c in node.inputs]
+        # branches concatenate per segment (replicated branches are masked
+        # to one segment by the compiler to avoid row duplication)
+        node.locus = Locus.strewn(self.nseg)
+        node.est_rows = sum(c.est_rows for c in node.inputs)
+        return node
+
     def _plan_sort(self, node: Sort) -> Plan:
         node.child = self._rec(node.child)
         node.locus = node.child.locus

@@ -101,6 +101,24 @@ class LikeExpr(ANode):
 
 
 @dataclass
+class InSubquery(ANode):
+    arg: ANode
+    query: "SelectStmt"
+    negate: bool = False
+
+
+@dataclass
+class ExistsExpr(ANode):
+    query: "SelectStmt"
+    negate: bool = False
+
+
+@dataclass
+class ScalarSubquery(ANode):
+    query: "SelectStmt"
+
+
+@dataclass
 class CaseExpr(ANode):
     whens: list[tuple[ANode, ANode]]
     else_: ANode | None
@@ -165,6 +183,15 @@ class OrderItem(ANode):
     expr: ANode
     desc: bool = False
     nulls_first: bool | None = None
+
+
+@dataclass
+class UnionStmt(ANode):
+    selects: list = field(default_factory=list)   # SelectStmt branches
+    all: bool = True
+    order_by: list = field(default_factory=list)  # OrderItem over branch-1 names
+    limit: int | None = None
+    offset: int = 0
 
 
 @dataclass

@@ -87,11 +87,14 @@ class Scope:
 
 
 class Binder:
-    def __init__(self, catalog, store):
+    def __init__(self, catalog, store, subquery_executor=None):
         self.catalog = catalog
         self.store = store
         self._uid = itertools.count()
         self.consts: dict[str, np.ndarray] = {}   # LUT pool shipped to device
+        # callable(SelectStmt) -> (python scalar | None, SqlType): runs an
+        # uncorrelated scalar subquery at bind time (InitPlan analog)
+        self.subquery_executor = subquery_executor
 
     def new_id(self, hint: str) -> str:
         return f"{hint}#{next(self._uid)}"
@@ -99,8 +102,11 @@ class Binder:
     # ------------------------------------------------------------------
     # entry
     # ------------------------------------------------------------------
-    def bind_select(self, stmt: A.SelectStmt) -> tuple[Plan, list[ColInfo]]:
-        plan, outs = self._bind_select(stmt)
+    def bind_select(self, stmt) -> tuple[Plan, list[ColInfo]]:
+        if isinstance(stmt, A.UnionStmt):
+            plan, outs = self._bind_union(stmt)
+        else:
+            plan, outs = self._bind_select(stmt)
         needed = set()
         _collect_needed(plan, needed)
         _prune_scans(plan, needed)
@@ -108,10 +114,28 @@ class Binder:
 
     # ------------------------------------------------------------------
     def _bind_select(self, stmt: A.SelectStmt) -> tuple[Plan, list[ColInfo]]:
-        plan, scope, leftover = self._bind_from(stmt.from_, stmt.where)
+        # peel subquery predicates (IN/EXISTS) off the WHERE — they become
+        # semi/anti joins around the FROM plan (cdbsubselect.c pull-up)
+        conjs = _split_and(stmt.where)
+        normal, subq = [], []
+        for c in conjs:
+            negate = False
+            inner = c
+            while isinstance(inner, A.Unary) and inner.op == "not":
+                negate = not negate
+                inner = inner.arg
+            if isinstance(inner, (A.InSubquery, A.ExistsExpr)):
+                subq.append((inner, negate != getattr(inner, "negate", False)))
+            else:
+                normal.append(c)
+        where = _join_and(normal)
+
+        plan, scope, leftover = self._bind_from(stmt.from_, where)
         if leftover is not None:
             f = Filter(plan, self._predicate(leftover, scope))
             plan = f
+        for node, negate in subq:
+            plan = self._bind_subquery_pred(node, negate, plan, scope)
 
         # aggregate detection
         has_aggs = any(
@@ -142,6 +166,142 @@ class Binder:
         if stmt.limit is not None or stmt.offset:
             plan = Limit(plan, stmt.limit, stmt.offset)
         return plan, proj_cols
+
+    # ------------------------------------------------------------------
+    # subquery predicates -> semi/anti joins (cdbsubselect.c pull-up analog)
+    # ------------------------------------------------------------------
+    def _bind_subquery_pred(self, node, negate: bool, plan: Plan, scope) -> Plan:
+        from greengage_tpu.planner.logical import Join
+
+        if isinstance(node, A.InSubquery):
+            arg = self._expr(node.arg, scope)
+            subplan, subouts = self._bind_select(node.query)
+            if len(subouts) != 1:
+                raise SqlError("subquery for IN must return one column")
+            skey = _colref(subouts[0])
+            lks, rks = self._align_join_keys([arg], [skey])
+            kind = "anti" if negate else "semi"
+            return Join(kind, plan, subplan, lks, rks, null_aware=negate)
+
+        # EXISTS: correlation via equality predicates against the outer scope
+        q = node.query
+        if q.group_by or q.having:
+            raise SqlError("GROUP BY/HAVING inside EXISTS is not supported")
+        if q.offset:
+            raise SqlError("OFFSET inside EXISTS is not supported")
+        if q.limit == 0 or (q.items and any(_contains_agg(it.expr) for it in q.items)):
+            # LIMIT 0: subquery is empty, EXISTS constant-false. Ungrouped
+            # aggregate select list: exactly one row always, constant-true.
+            const_true = q.limit != 0
+            exists_val = const_true != negate
+            if exists_val:
+                return plan
+            return Filter(plan, E.Literal(False, T.BOOL))
+        # (any other LIMIT >= 1 can't change existence — ignored)
+
+        subplan, sub_scope, _ = self._bind_from(q.from_, None)
+        sub_conjs = _split_and(q.where)
+        inner_only, corr_pairs, outer_only = [], [], []
+        for c in sub_conjs:
+            refs = _name_refs(c)
+            if not refs or all(_in_scope(p, sub_scope) for p in refs):
+                inner_only.append(c)   # constants filter inner rows uniformly
+                continue
+            if refs and all(_in_scope(p, scope) for p in refs):
+                outer_only.append(c)   # exists(P_outer AND Q) = P_outer AND exists(Q)
+                continue
+            if isinstance(c, A.Bin) and c.op == "=":
+                lrefs, rrefs = _name_refs(c.left), _name_refs(c.right)
+                l_outer = lrefs and all(_in_scope(p, scope) for p in lrefs)
+                r_inner = rrefs and all(_in_scope(p, sub_scope) for p in rrefs)
+                if l_outer and r_inner:
+                    corr_pairs.append((c.left, c.right))
+                    continue
+                r_outer = rrefs and all(_in_scope(p, scope) for p in rrefs)
+                l_inner = lrefs and all(_in_scope(p, sub_scope) for p in lrefs)
+                if r_outer and l_inner:
+                    corr_pairs.append((c.right, c.left))
+                    continue
+            raise SqlError(
+                "only equality correlation with the outer query is supported "
+                "in EXISTS subqueries")
+        if outer_only and negate:
+            # not exists(P_outer AND Q) = NOT P_outer OR NOT exists(Q):
+            # not expressible as a filter + anti join; bail honestly
+            raise SqlError(
+                "outer-only predicates inside NOT EXISTS are not supported")
+        if inner_only:
+            subplan = Filter(subplan, self._predicate(_join_and(inner_only), sub_scope))
+        kind = "anti" if negate else "semi"
+        if corr_pairs:
+            lks = [self._expr(o, scope) for o, _ in corr_pairs]
+            rks = [self._expr(i, sub_scope) for _, i in corr_pairs]
+            lks, rks = self._align_join_keys(lks, rks)
+            joined = Join(kind, plan, subplan, lks, rks)
+        else:
+            # uncorrelated EXISTS: constant-key semi join (matched iff sub
+            # produced any row; duplicate constant keys are fine)
+            one = E.Literal(1, T.INT32)
+            joined = Join(kind, plan, subplan, [one], [one])
+        if outer_only:
+            joined = Filter(joined, self._predicate(_join_and(outer_only), scope))
+        return joined
+
+    # ------------------------------------------------------------------
+    # UNION
+    # ------------------------------------------------------------------
+    def _bind_union(self, stmt: A.UnionStmt):
+        from greengage_tpu.planner.logical import Aggregate, Limit, Sort, Union
+
+        branches = [self._bind_select(s) for s in stmt.selects]
+        arity = len(branches[0][1])
+        for _, outs in branches[1:]:
+            if len(outs) != arity:
+                raise SqlError("UNION branches must have the same column count")
+        # per-position result types (+ TEXT dictionary compatibility)
+        union_cols = []
+        for i in range(arity):
+            t = branches[0][1][i].type
+            dref = branches[0][1][i].dict_ref
+            for _, outs in branches[1:]:
+                ot = outs[i].type
+                if ot.kind is T.Kind.TEXT and t.kind is T.Kind.TEXT:
+                    if outs[i].dict_ref != dref:
+                        raise SqlError(
+                            "UNION over text columns from different "
+                            "dictionaries is not supported yet")
+                elif ot != t:
+                    t = T.promote(t, ot)
+            union_cols.append(ColInfo(self.new_id(branches[0][1][i].name), t,
+                                      branches[0][1][i].name, dref))
+        # cast branches to the union types where needed
+        inputs = []
+        for plan, outs in branches:
+            exprs = []
+            for uc, oc in zip(union_cols, outs):
+                e = _colref(oc)
+                if oc.type != uc.type:
+                    e = E.Cast(e, uc.type)
+                exprs.append((ColInfo(self.new_id(uc.name), uc.type, uc.name,
+                                      oc.dict_ref), e))
+            inputs.append(Project(plan, exprs))
+        plan = Union(inputs, union_cols)
+        # positional wiring: Union's cols adopt each branch's projected ids
+        plan.branch_ids = [[c.id for c, _ in p.exprs] for p in inputs]
+        outs = union_cols
+        if not stmt.all:
+            keys = [(c, E.ColRef(c.id, c.type)) for c in union_cols]
+            plan = Aggregate(plan, keys, [])
+            outs = [c for c, _ in keys]
+        if stmt.order_by:
+            keys = []
+            for oi in stmt.order_by:
+                e = self._bind_order_expr(oi.expr, outs, None)
+                keys.append((e, oi.desc, oi.nulls_first))
+            plan = Sort(plan, keys)
+        if stmt.limit is not None or stmt.offset:
+            plan = Limit(plan, stmt.limit, stmt.offset)
+        return plan, outs
 
     # ------------------------------------------------------------------
     # FROM binding with pushdown + greedy join ordering
@@ -455,6 +615,15 @@ class Binder:
             return E.Literal(T.date_to_days(ast.value), T.DATE)
         if isinstance(ast, A.IntervalLit):
             raise SqlError("interval is only supported in date +/- interval")
+        if isinstance(ast, A.ScalarSubquery):
+            if self.subquery_executor is None:
+                raise SqlError("scalar subqueries are not available here")
+            value, t = self.subquery_executor(ast.query)
+            return E.Literal(value, t)
+        if isinstance(ast, A.ExistsExpr) or isinstance(ast, A.InSubquery):
+            raise SqlError(
+                "IN/EXISTS subqueries are only supported as top-level WHERE "
+                "conjuncts")
         if isinstance(ast, A.Unary):
             if ast.op == "not":
                 return E.Not(self._predicate(ast.arg, scope))

@@ -113,7 +113,7 @@ class Parser:
 
     def statement(self) -> A.ANode:
         if self.at_kw("select"):
-            return self.select_stmt()
+            return self.select_or_union()
         if self.at_kw("create"):
             return self.create_table()
         if self.at_kw("drop"):
@@ -151,7 +151,46 @@ class Parser:
         raise SqlError(f"unexpected {self.peek()[1]!r}")
 
     # ---- SELECT --------------------------------------------------------
-    def select_stmt(self) -> A.SelectStmt:
+    def select_or_union(self) -> A.ANode:
+        first = self.select_stmt(stop_at_setops=True)
+        if not self.at_kw("union"):
+            # trailing ORDER BY/LIMIT belong to the single select
+            self._select_tail(first)
+            return first
+        u = A.UnionStmt(selects=[first], all=True)
+        is_all = None
+        while self.accept("kw", "union"):
+            branch_all = bool(self.accept("kw", "all"))
+            if is_all is None:
+                is_all = branch_all
+            elif is_all != branch_all:
+                raise SqlError("mixed UNION / UNION ALL is not supported")
+            u.selects.append(self.select_stmt(stop_at_setops=True))
+        u.all = bool(is_all)
+        # ORDER BY / LIMIT after the last branch apply to the union
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            u.order_by.append(self.order_item())
+            while self.accept("op", ","):
+                u.order_by.append(self.order_item())
+        if self.accept("kw", "limit"):
+            u.limit = int(self.expect("num")[1])
+        if self.accept("kw", "offset"):
+            u.offset = int(self.expect("num")[1])
+        return u
+
+    def _select_tail(self, s: A.SelectStmt) -> None:
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            s.order_by.append(self.order_item())
+            while self.accept("op", ","):
+                s.order_by.append(self.order_item())
+        if self.accept("kw", "limit"):
+            s.limit = int(self.expect("num")[1])
+        if self.accept("kw", "offset"):
+            s.offset = int(self.expect("num")[1])
+
+    def select_stmt(self, stop_at_setops: bool = False) -> A.SelectStmt:
         self.expect("kw", "select")
         s = A.SelectStmt()
         s.distinct = bool(self.accept("kw", "distinct"))
@@ -171,15 +210,8 @@ class Parser:
                 s.group_by.append(self.expr())
         if self.accept("kw", "having"):
             s.having = self.expr()
-        if self.accept("kw", "order"):
-            self.expect("kw", "by")
-            s.order_by.append(self.order_item())
-            while self.accept("op", ","):
-                s.order_by.append(self.order_item())
-        if self.accept("kw", "limit"):
-            s.limit = int(self.expect("num")[1])
-        if self.accept("kw", "offset"):
-            s.offset = int(self.expect("num")[1])
+        if not stop_at_setops:
+            self._select_tail(s)
         return s
 
     def select_item(self) -> A.SelectItem:
@@ -303,6 +335,11 @@ class Parser:
             elif self.at_kw("in"):
                 self.next()
                 self.expect("op", "(")
+                if self.at_kw("select"):
+                    q = self.select_stmt()
+                    self.expect("op", ")")
+                    e = A.InSubquery(e, q)
+                    continue
                 vals = [self.expr()]
                 while self.accept("op", ","):
                     vals.append(self.expr())
@@ -322,6 +359,11 @@ class Parser:
                     e = A.Between(e, lo, hi, negate=True)
                 elif kw == "in":
                     self.expect("op", "(")
+                    if self.at_kw("select"):
+                        q = self.select_stmt()
+                        self.expect("op", ")")
+                        e = A.InSubquery(e, q, negate=True)
+                        continue
                     vals = [self.expr()]
                     while self.accept("op", ","):
                         vals.append(self.expr())
@@ -363,9 +405,19 @@ class Parser:
         t = self.peek()
         if t == ("op", "("):
             self.next()
+            if self.at_kw("select"):
+                q = self.select_stmt()
+                self.expect("op", ")")
+                return A.ScalarSubquery(q)
             e = self.expr()
             self.expect("op", ")")
             return e
+        if self.at_kw("exists"):
+            self.next()
+            self.expect("op", "(")
+            q = self.select_stmt()
+            self.expect("op", ")")
+            return A.ExistsExpr(q)
         if t[0] == "num":
             self.next()
             return A.Num(t[1])

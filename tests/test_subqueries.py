@@ -1,0 +1,127 @@
+"""Subquery + set-operation regression tests (pg_regress subselect analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.sql.parser import SqlError
+from greengage_tpu.utils import tpch
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    tpch.load(d, sf=0.002)
+    d.sql("create table sq_a (k int, v int) distributed by (k);"
+          "create table sq_b (k int, w int) distributed by (k);"
+          "insert into sq_a values (1, 10), (2, 20), (3, 30), (4, null);"
+          "insert into sq_b values (1, 100), (3, 300), (5, 500)")
+    return d
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return tpch.to_pandas(tpch.generate(0.002))
+
+
+def test_in_subquery_semi_join(db):
+    r = db.sql("select k from sq_a where k in (select k from sq_b) order by k")
+    assert [x[0] for x in r.rows()] == [1, 3]
+
+
+def test_not_in_subquery(db):
+    r = db.sql("select k from sq_a where k not in (select k from sq_b) order by k")
+    assert [x[0] for x in r.rows()] == [2, 4]
+
+
+def test_not_in_with_null_in_subquery(db):
+    # v contains NULL -> NOT IN yields no rows (PG three-valued semantics)
+    db.sql("create table sq_n (x int) distributed by (x);"
+           "insert into sq_n values (10), (999)")
+    r = db.sql("select k from sq_a where k not in (select v from sq_a)")
+    assert len(r) == 0
+    # without nulls it behaves normally
+    r = db.sql("select x from sq_n where x not in (select w from sq_b) order by x")
+    assert [x[0] for x in r.rows()] == [10, 999]
+
+
+def test_not_in_empty_subquery(db):
+    r = db.sql("select count(*) from sq_a where k not in (select k from sq_b where k > 1000)")
+    assert r.rows()[0][0] == 4   # empty subquery: everything qualifies
+
+
+def test_exists_correlated(db):
+    r = db.sql("select k from sq_a a where exists "
+               "(select 1 from sq_b b where b.k = a.k) order by k")
+    assert [x[0] for x in r.rows()] == [1, 3]
+    r = db.sql("select k from sq_a a where not exists "
+               "(select 1 from sq_b b where b.k = a.k) order by k")
+    assert [x[0] for x in r.rows()] == [2, 4]
+
+
+def test_exists_uncorrelated(db):
+    assert db.sql("select count(*) from sq_a where exists (select 1 from sq_b)"
+                  ).rows()[0][0] == 4
+    assert db.sql("select count(*) from sq_a where exists "
+                  "(select 1 from sq_b where k > 1000)").rows()[0][0] == 0
+
+
+def test_scalar_subquery(db, oracle):
+    li = oracle["lineitem"]
+    want = int((li.l_quantity > li.l_quantity.mean()).sum())
+    r = db.sql("select count(*) from lineitem "
+               "where l_quantity > (select avg(l_quantity) from lineitem)")
+    assert r.rows()[0][0] == want
+
+
+def test_tpch_q4_order_priority(db, oracle):
+    r = db.sql("""
+      select o_orderpriority, count(*) as order_count
+      from orders
+      where o_orderdate >= date '1993-07-01'
+        and o_orderdate < date '1993-07-01' + interval '3' month
+        and exists (
+          select 1 from lineitem
+          where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+      group by o_orderpriority
+      order by o_orderpriority
+    """)
+    o, li = oracle["orders"], oracle["lineitem"]
+    lo = (np.datetime64("1993-07-01") - np.datetime64("1970-01-01")).astype(int)
+    hi = (np.datetime64("1993-10-01") - np.datetime64("1970-01-01")).astype(int)
+    ok_orders = set(li[li.l_commitdate < li.l_receiptdate].l_orderkey)
+    f = o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)
+          & o.o_orderkey.isin(ok_orders)]
+    want = f.groupby("o_orderpriority").size().sort_index()
+    got = r.to_pandas()
+    assert list(got.o_orderpriority) == list(want.index)
+    assert list(got.order_count) == list(want.values)
+
+
+def test_union_all_and_distinct(db):
+    r = db.sql("select k from sq_a union all select k from sq_b order by k")
+    assert [x[0] for x in r.rows()] == [1, 1, 2, 3, 3, 4, 5]
+    r = db.sql("select k from sq_a union select k from sq_b order by k")
+    assert [x[0] for x in r.rows()] == [1, 2, 3, 4, 5]
+
+
+def test_union_type_promotion(db):
+    r = db.sql("select v from sq_a union all select cast(w as bigint) from sq_b "
+               "order by v nulls last")
+    vals = [x[0] for x in r.rows()]
+    assert vals[:6] == [10, 20, 30, 100, 300, 500] and vals[6] is None
+
+
+def test_union_replicated_branch_no_duplication(db):
+    db.sql("create table sq_r (x int) distributed replicated;"
+           "insert into sq_r values (7), (8)")
+    r = db.sql("select x from sq_r union all select k from sq_b order by x")
+    assert [x[0] for x in r.rows()] == [1, 3, 5, 7, 8]
+
+
+def test_subquery_error_paths(db):
+    with pytest.raises(SqlError, match="one column"):
+        db.sql("select k from sq_a where k in (select k, w from sq_b)")
+    with pytest.raises(SqlError, match="more than one row"):
+        db.sql("select k from sq_a where k > (select k from sq_b)")
