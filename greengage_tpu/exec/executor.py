@@ -40,6 +40,8 @@ class Result:
     _order: list[str]
     wall_ms: float = 0.0
     plan_text: str = ""
+    # per-query instrumentation (cdbexplain_recvExecStats analog)
+    stats: dict = None
 
     def __len__(self):
         for c in self._order:
@@ -88,7 +90,9 @@ class Executor:
         self._plan_cache: dict = {}   # (cache_key, version, tier) -> CompileResult
 
     # ------------------------------------------------------------------
-    def run(self, plan, consts: dict, out_cols, cache_key=None) -> Result:
+    def run(self, plan, consts: dict, out_cols, cache_key=None,
+            raw: bool = False) -> Result:
+        self._raw = raw
         t0 = time.monotonic()
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
@@ -97,7 +101,8 @@ class Executor:
         for tier in range(self.settings.motion_retry_tiers):
             ck = ((cache_key, version, tier) if cache_key is not None
                   and not cap_overrides else None)
-            if ck is not None and ck in self._plan_cache:
+            was_cached = ck is not None and ck in self._plan_cache
+            if was_cached:
                 comp = self._plan_cache[ck]
             else:
                 comp = Compiler(self.catalog, self.store, self.mesh, self.nseg,
@@ -135,6 +140,15 @@ class Executor:
             if not overflow:
                 res = self._finalize(comp, flat, snapshot)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
+                res.stats = {
+                    "tiers_used": tier + 1,
+                    "compiled": not was_cached,
+                    "segments": self.nseg,
+                    "scan_tables": [t for t, _, _ in comp.input_spec],
+                    "below_gather_capacity": comp.capacity,
+                    "rows_out": len(res),
+                    "metrics": {k: int(np.max(v)) for k, v in metrics.items()},
+                }
                 return res
             # size the retry from exact cardinalities where the device
             # reported them (join expansion totals)
@@ -222,12 +236,17 @@ class Executor:
                 cols_np[k] = cols_np[k][offset:end]
                 valids_np[k] = valids_np[k][offset:end]
 
-        # decode TEXT + decimals for presentation
+        # decode TEXT + decimals for presentation (raw mode keeps storage
+        # representation for DML republish paths)
         out_cols = {}
         out_valids = {}
         for c in comp.out_cols:
             data = cols_np[c.id]
             valid = valids_np[c.id]
+            if getattr(self, "_raw", False):
+                out_cols[c.id] = data
+                out_valids[c.id] = None if valid.all() else valid
+                continue
             if c.type.kind is T.Kind.TEXT and c.dict_ref is not None:
                 d = self.store.dictionary(*c.dict_ref)
                 vals = np.array(

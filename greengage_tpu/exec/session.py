@@ -67,6 +67,7 @@ class Database:
 
         self.dtm = DtmSession(self.store)
         self.fts = FtsProber(self.catalog.segments, self.mesh)
+        self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
 
     # ------------------------------------------------------------------
     def sql(self, text: str):
@@ -104,6 +105,10 @@ class Database:
             return self._insert(stmt)
         if isinstance(stmt, A.CopyStmt):
             return self._copy(stmt)
+        if isinstance(stmt, A.DeleteStmt):
+            return self._delete(stmt)
+        if isinstance(stmt, A.UpdateStmt):
+            return self._update(stmt)
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
         if isinstance(stmt, A.SetStmt):
@@ -175,7 +180,9 @@ class Database:
         try:
             # executor adds the manifest version; the bare statement identity
             # lets it evict compiled programs of old versions
-            return self.executor.run(planned, consts, outs, cache_key=exec_key)
+            res = self.executor.run(planned, consts, outs, cache_key=exec_key)
+            self._record_stats(res)
+            return res
         except QueryError as e:
             if "duplicate keys" not in str(e):
                 raise
@@ -185,8 +192,22 @@ class Database:
             planned, consts, outs = self._plan(stmt, force_multi_join=True)
             self._select_cache[key] = (planned, consts, outs,
                                        stmt_key + "#multi")
-            return self.executor.run(planned, consts, outs,
-                                     cache_key=stmt_key + "#multi")
+            res = self.executor.run(planned, consts, outs,
+                                    cache_key=stmt_key + "#multi")
+            self._record_stats(res)
+            return res
+
+    def _record_stats(self, res) -> None:
+        import time as _time
+
+        self.stat_activity.append({
+            "ts": _time.time(),
+            "wall_ms": res.wall_ms,
+            "rows": len(res),
+            **(res.stats or {}),
+        })
+        if len(self.stat_activity) > 200:
+            del self.stat_activity[0]
 
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, (A.SelectStmt, A.UnionStmt)):
@@ -195,7 +216,15 @@ class Database:
         text = describe(planned)
         if stmt.analyze:
             res = self.executor.run(planned, consts, outs)
-            text += f"\n Execution time: {res.wall_ms:.2f} ms, rows: {len(res)}"
+            s = res.stats or {}
+            text += (
+                f"\n Execution time: {res.wall_ms:.2f} ms, rows: {len(res)}"
+                f"\n Segments: {s.get('segments')}, capacity tiers used: "
+                f"{s.get('tiers_used')}, result capacity/segment: "
+                f"{s.get('below_gather_capacity')}"
+                f"\n Tables scanned: {', '.join(s.get('scan_tables', []))}")
+            for k, v in (s.get("metrics") or {}).items():
+                text += f"\n {k}: {v}"
         r = Result(columns=["QUERY PLAN"],
                    cols={"p": np.array(text.split("\n"), dtype=object)},
                    valids={}, _order=["p"])
@@ -305,6 +334,129 @@ class Database:
         return f"COPY {n}"
 
     # ------------------------------------------------------------------
+    # DELETE / UPDATE: append-only storage rewrites the surviving rows and
+    # republishes in one manifest commit (the visimap/SplitUpdate roles,
+    # reference: src/backend/access/appendonly visimap + nodeSplitUpdate.c)
+    # ------------------------------------------------------------------
+    def _check_no_tx(self, what: str):
+        if self.dtm.current is not None and self.dtm.current.state == "active":
+            raise SqlError(f"{what} inside a transaction is not supported yet")
+
+    def _run_raw(self, sel_stmt):
+        planned, consts, outs = self._plan(sel_stmt)
+        res = self.executor.run(planned, consts, outs, raw=True)
+        return res, outs
+
+    def _delete(self, stmt: A.DeleteStmt):
+        self._check_no_tx("DELETE")
+        _reject_dml_subqueries(stmt.where)
+        schema = self.catalog.get(stmt.table)
+        total = sum(self.store.segment_rowcounts(stmt.table))
+        if stmt.where is None:
+            self.store.replace_contents(
+                stmt.table,
+                {c.name: np.empty(0, dtype=c.type.np_dtype) for c in schema.columns},
+                {})
+            return f"DELETE {total}"
+        # survivors: predicate false OR NULL
+        survive = A.Bin("or", A.Unary("not", stmt.where), A.IsNullTest(stmt.where, False))
+        sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
+                           from_=[A.BaseTable(stmt.table)], where=survive)
+        res, outs = self._run_raw(sel)
+        enc = {}
+        valids = {}
+        for c, o in zip(schema.columns, outs):
+            enc[c.name] = np.ascontiguousarray(res.cols[o.id], dtype=c.type.np_dtype)
+            v = res.valids.get(o.id)
+            if v is not None:
+                valids[c.name] = v
+        self.store.replace_contents(stmt.table, enc, valids)
+        return f"DELETE {total - len(res)}"
+
+    def _update(self, stmt: A.UpdateStmt):
+        self._check_no_tx("UPDATE")
+        _reject_dml_subqueries(stmt.where)
+        schema = self.catalog.get(stmt.table)
+        seen = set()
+        for cname, _ in stmt.sets:
+            if cname not in schema.column_names:
+                raise SqlError(f'column "{cname}" of relation '
+                               f'"{stmt.table}" does not exist')
+            if cname in seen:
+                raise SqlError(f'multiple assignments to column "{cname}"')
+            seen.add(cname)
+        # one raw pass: all columns + new-value expressions + update flag.
+        # Outputs are tracked POSITIONALLY (star cols, then one slot per
+        # device-evaluated SET, then the flag) — user column names can never
+        # collide with internals.
+        items = [A.SelectItem(A.Star())]
+        text_literals = {}
+        device_slots: dict[str, int] = {}   # colname -> index into outs
+        ncols = len(schema.columns)
+        next_slot = ncols
+        dict_dirty = False
+        for cname, e in stmt.sets:
+            col = schema.column(cname)
+            if col.type.kind is T.Kind.TEXT:
+                if isinstance(e, A.Str):
+                    code = self.store.dictionary(stmt.table, cname).encode([e.value])[0]
+                    dict_dirty = True
+                    text_literals[cname] = np.int32(code)
+                    continue
+                if isinstance(e, A.Null):
+                    text_literals[cname] = None
+                    continue
+                items.append(A.SelectItem(e, alias=f"__new_{cname}"))
+            else:
+                tname, typmod = _sql_type_name(col.type)
+                items.append(A.SelectItem(A.CastExpr(e, tname, typmod),
+                                          alias=f"__new_{cname}"))
+            device_slots[cname] = next_slot
+            next_slot += 1
+        if dict_dirty:
+            self.store.flush_dicts(stmt.table)
+        flag = stmt.where if stmt.where is not None else A.Bool(True)
+        items.append(A.SelectItem(flag, alias="__upd"))
+        flag_slot = next_slot
+        sel = A.SelectStmt(items=items, from_=[A.BaseTable(stmt.table)])
+        res, outs = self._run_raw(sel)
+        fo = outs[flag_slot]
+        fval = res.cols[fo.id].astype(bool)
+        fv = res.valids.get(fo.id)
+        mask = fval if fv is None else (fval & fv)   # NULL predicate -> no update
+        enc, valids = {}, {}
+        for c, o in zip(schema.columns, outs[:ncols]):
+            old = np.ascontiguousarray(res.cols[o.id], dtype=c.type.np_dtype)
+            oldv = res.valids.get(o.id)
+            oldv = np.ones(len(old), bool) if oldv is None else oldv
+            if c.name in text_literals:
+                lit = text_literals[c.name]
+                if lit is None:
+                    new = old
+                    newv = np.zeros(len(old), bool)
+                else:
+                    new = np.full(len(old), lit, dtype=np.int32)
+                    newv = np.ones(len(old), bool)
+            elif c.name in device_slots:
+                no = outs[device_slots[c.name]]
+                if (c.type.kind is T.Kind.TEXT and no.dict_ref is not None
+                        and no.dict_ref != (stmt.table, c.name)):
+                    raise SqlError(
+                        "text UPDATE from a different dictionary is not supported")
+                new = np.ascontiguousarray(res.cols[no.id], dtype=c.type.np_dtype)
+                nv = res.valids.get(no.id)
+                newv = np.ones(len(new), bool) if nv is None else nv
+            else:
+                new, newv = old, oldv
+            merged = np.where(mask, new, old)
+            mergedv = np.where(mask, newv, oldv)
+            enc[c.name] = merged.astype(c.type.np_dtype)
+            if not mergedv.all():
+                valids[c.name] = mergedv
+        self.store.replace_contents(stmt.table, enc, valids)
+        return f"UPDATE {int(mask.sum())}"
+
+    # ------------------------------------------------------------------
     def expand(self, new_numsegments: int) -> dict:
         """gpexpand analog: widen the cluster and redistribute every table.
 
@@ -354,3 +506,44 @@ def _zero_for(t: T.SqlType):
     if t.kind is T.Kind.TEXT:
         return ""
     return 0
+
+
+def _reject_dml_subqueries(where) -> None:
+    """IN/EXISTS in DML WHERE need dedicated survivor-semantics handling
+    (x IN S being NULL must *survive* a DELETE); until then, fail clearly."""
+    if where is None:
+        return
+    stack = [where]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (A.InSubquery, A.ExistsExpr)):
+            raise SqlError(
+                "IN/EXISTS subqueries in DELETE/UPDATE WHERE are not "
+                "supported yet")
+        for f in ("left", "right", "arg", "lo", "hi", "else_"):
+            v = getattr(n, f, None)
+            if isinstance(v, A.ANode):
+                stack.append(v)
+        for v in getattr(n, "args", []) or []:
+            stack.append(v)
+        for v in getattr(n, "values", []) or []:
+            if isinstance(v, A.ANode):
+                stack.append(v)
+        for cond, val in getattr(n, "whens", []) or []:
+            stack.append(cond)
+            stack.append(val)
+
+
+def _sql_type_name(t: T.SqlType) -> tuple[str, tuple[int, ...]]:
+    """SqlType -> (type name, typmod) for constructing CAST ASTs."""
+    k = t.kind
+    if k is T.Kind.DECIMAL:
+        return "numeric", (38, t.scale)
+    return {
+        T.Kind.INT32: ("int", ()),
+        T.Kind.INT64: ("bigint", ()),
+        T.Kind.FLOAT64: ("double precision", ()),
+        T.Kind.DATE: ("date", ()),
+        T.Kind.BOOL: ("bool", ()),
+        T.Kind.TEXT: ("text", ()),
+    }[k]

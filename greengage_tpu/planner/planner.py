@@ -16,6 +16,7 @@ the coordinator (Entry locus).
 from __future__ import annotations
 
 from greengage_tpu import expr as E
+from greengage_tpu import types as T
 from greengage_tpu.catalog import PolicyKind
 from greengage_tpu.planner import cost as C
 from greengage_tpu.planner.locus import Locus, LocusKind
@@ -34,6 +35,14 @@ class Planner:
 
     # ------------------------------------------------------------------
     def plan(self, node: Plan) -> Plan:
+        # LIMIT directly under the top Gather is handled per-segment + host
+        # re-limit; any deeper LIMIT needs single-segment execution (marked
+        # here, enforced in _plan_limit)
+        self._root_limits = set()
+        top = node
+        while isinstance(top, Limit):
+            self._root_limits.add(id(top))
+            top = top.child
         node = self._rec(node)
         # top: deliver to the coordinator
         if node.locus.kind is not LocusKind.ENTRY:
@@ -298,11 +307,31 @@ class Planner:
 
     def _plan_limit(self, node: Limit) -> Plan:
         node.child = self._rec(node.child)
-        node.locus = node.child.locus
+        child = node.child
+        # a LIMIT buried inside the plan (subquery) must be GLOBAL: move all
+        # rows to one segment first (SingleQE locus via constant-key
+        # redistribute). The top-of-plan LIMIT keeps the cheaper per-segment
+        # truncation + host re-limit. SEGMENT_GENERAL children are already
+        # identical everywhere, so per-segment truncation is globally right.
+        if id(node) not in self._root_limits and child.locus.is_partitioned:
+            const = E.Literal(0, T.INT64)
+            if isinstance(child, Sort):
+                m = Motion(MotionKind.REDISTRIBUTE, child.child, hash_exprs=[const])
+                m.locus = Locus(LocusKind.SINGLE_QE, (), self.nseg)
+                m.est_rows = child.child.est_rows
+                child.child = m
+                child.locus = m.locus
+            else:
+                m = Motion(MotionKind.REDISTRIBUTE, child, hash_exprs=[const])
+                m.locus = Locus(LocusKind.SINGLE_QE, (), self.nseg)
+                m.est_rows = child.est_rows
+                node.child = m
+                child = m
+        node.locus = child.locus
         if node.limit is not None:
-            node.est_rows = min(node.child.est_rows, node.limit + node.offset)
+            node.est_rows = min(child.est_rows, node.limit + node.offset)
         else:
-            node.est_rows = node.child.est_rows
+            node.est_rows = child.est_rows
         return node
 
     # ------------------------------------------------------------------

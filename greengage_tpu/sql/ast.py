@@ -241,6 +241,19 @@ class InsertStmt(ANode):
 
 
 @dataclass
+class DeleteStmt(ANode):
+    table: str
+    where: ANode | None = None
+
+
+@dataclass
+class UpdateStmt(ANode):
+    table: str
+    sets: list = field(default_factory=list)   # [(colname, expr)]
+    where: ANode | None = None
+
+
+@dataclass
 class CopyStmt(ANode):
     table: str
     path: str

@@ -39,6 +39,7 @@ KEYWORDS = {
     "interval", "extract", "distributed", "randomly", "replicated", "with",
     "exists", "if", "show", "union", "all", "substring", "for",
     "begin", "commit", "rollback", "abort", "set", "to", "transaction", "work",
+    "delete", "update",
 }
 
 
@@ -122,6 +123,25 @@ class Parser:
             return self.insert_stmt()
         if self.at_kw("copy"):
             return self.copy_stmt()
+        if self.at_kw("delete"):
+            self.next()
+            self.expect("kw", "from")
+            table = self.expect("name")[1]
+            where = self.expr() if self.accept("kw", "where") else None
+            return A.DeleteStmt(table, where)
+        if self.at_kw("update"):
+            self.next()
+            table = self.expect("name")[1]
+            self.expect("kw", "set")
+            sets = []
+            while True:
+                col = self.expect("name")[1]
+                self.expect("op", "=")
+                sets.append((col, self.expr()))
+                if not self.accept("op", ","):
+                    break
+            where = self.expr() if self.accept("kw", "where") else None
+            return A.UpdateStmt(table, sets, where)
         if self.at_kw("explain"):
             self.next()
             analyze = bool(self.accept("kw", "analyze"))

@@ -285,6 +285,50 @@ class TableStore:
                 pass
         return nrows
 
+    def replace_contents(self, table: str, enc: dict, valids: dict) -> None:
+        """Atomically replace a table's rows (DELETE/UPDATE republish).
+        ``enc`` holds storage-representation arrays (TEXT = dictionary
+        codes); placement is recomputed, so updated distribution keys move
+        rows to their new owner segments (SplitUpdate's explicit
+        redistribution analog, src/backend/executor/nodeSplitUpdate.c)."""
+        from greengage_tpu.catalog.schema import PolicyKind
+
+        schema = self.catalog.get(table)
+        for c in schema.columns:
+            v = valids.get(c.name)
+            if not c.nullable and v is not None and not np.all(v):
+                raise ValueError(
+                    f'null value in column "{c.name}" violates not-null constraint')
+        nseg = schema.policy.numsegments
+        snap = self.manifest.snapshot()
+        old_files = [
+            rel for files in snap["tables"].get(table, {"segfiles": {}})["segfiles"].values()
+            for rel in files
+        ]
+        nrows = len(next(iter(enc.values()))) if enc else 0
+        tx = self.manifest.begin()
+        tx["tables"][table] = {"segfiles": {}, "nrows": {},
+                               "numsegments": nseg}
+        tmeta = tx["tables"][table]
+        if schema.policy.kind is PolicyKind.REPLICATED:
+            seg_rows = [np.arange(nrows)] * nseg
+        elif schema.policy.kind is PolicyKind.HASH:
+            rh = self.row_hashes(schema, enc, valids, schema.policy.keys)
+            seg_of = (rh % np.uint32(nseg)).astype(np.int32)
+            seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
+        else:
+            seg_of = (np.arange(nrows) % nseg).astype(np.int32)
+            seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
+        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
+        v = self.manifest.prepare(tx)
+        self.manifest.commit(v)
+        base = os.path.join(self.root, "data", table)
+        for rel in old_files:
+            try:
+                os.remove(os.path.join(base, rel))
+            except OSError:
+                pass
+
     def reconcile_widths(self) -> None:
         """Crash recovery for expansion: the manifest's per-table width is
         the commit record; if the catalog copy lags (crash between manifest
