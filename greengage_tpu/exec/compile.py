@@ -38,7 +38,7 @@ from greengage_tpu.parallel import motion as motion_ops
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.planner.logical import (
     Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan,
-    Sort, Union,
+    Sort, Union, Window,
 )
 
 VALID_PREFIX = "@v:"
@@ -179,7 +179,7 @@ class Compiler:
         if isinstance(plan, Scan):
             counts = self.store.segment_rowcounts(plan.table)
             return max(max(counts, default=0), 1)
-        if isinstance(plan, (Filter, Project, Sort)):
+        if isinstance(plan, (Filter, Project, Sort, Window)):
             return self._capacity_of(plan.child)
         if isinstance(plan, Limit):
             cap = self._capacity_of(plan.child)
@@ -640,6 +640,63 @@ class Compiler:
             valids = {k[len(VALID_PREFIX):]: v for k, v in recv.items()
                       if k.startswith(VALID_PREFIX)}
             return Batch(cols, valids, precv)
+
+        return run
+
+    # ---- window --------------------------------------------------------
+    def _c_window(self, plan: Window):
+        from greengage_tpu.ops import window as win_ops
+
+        child_fn = self._compile_node(plan.child)
+        cap = self._capacity_of(plan.child)
+        pkeys = plan.partition_keys
+        okeys = plan.order_keys
+        wfuncs = plan.wfuncs
+
+        def run(ctx):
+            b = child_fn(ctx)
+            # sort by (partition, order); dead rows go to the end
+            skeys = self._sort_keys(
+                b, [(e, False, None) for e in pkeys] + list(okeys))
+            perm, sel_sorted = sort_ops.sort_batch(skeys, b.selection(), cap)
+            cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
+            sb = Batch(cols, valids, sel_sorted)
+            ev = Evaluator(sb, self.consts)
+
+            def eq_prev(exprs):
+                eq = jnp.ones((cap,), dtype=bool)
+                for e in exprs:
+                    v, valid = ev.value(e)
+                    same = v[1:] == v[:-1]
+                    if valid is not None:
+                        same = (same & valid[1:] & valid[:-1]) | (
+                            ~valid[1:] & ~valid[:-1])
+                    eq = eq & jnp.concatenate(
+                        [jnp.zeros((1,), bool), same])
+                return eq
+
+            part_eq = eq_prev(pkeys) if pkeys else jnp.concatenate(
+                [jnp.zeros((1,), bool), jnp.ones((cap - 1,), bool)])
+            peer_eq = part_eq & (eq_prev([e for e, _, _ in okeys])
+                                 if okeys else jnp.ones((cap,), bool))
+
+            funcs = []
+            for ci, fname, arg, ordered in wfuncs:
+                vals, valid, scale = None, None, 0
+                if arg is not None:
+                    vals, valid = ev.value(arg)
+                    if arg.type.kind is T.Kind.DECIMAL:
+                        scale = arg.type.scale
+                funcs.append(win_ops.WinFunc(ci.id, fname, vals, valid,
+                                             scale, ordered))
+            wvals, wvalids = win_ops.compute(part_eq, peer_eq, sel_sorted, funcs)
+            out_c = dict(sb.cols)
+            out_v = dict(sb.valids)
+            for ci, _, _, _ in wfuncs:
+                out_c[ci.id] = wvals[ci.id]
+                if wvalids.get(ci.id) is not None:
+                    out_v[ci.id] = wvalids[ci.id]
+            return Batch(out_c, out_v, sel_sorted)
 
         return run
 

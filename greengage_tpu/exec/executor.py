@@ -243,7 +243,7 @@ class Executor:
         for c in comp.out_cols:
             data = cols_np[c.id]
             valid = valids_np[c.id]
-            if getattr(self, "_raw", False):
+            if getattr(self, "_raw", False) or getattr(c, "hidden", False):
                 out_cols[c.id] = data
                 out_valids[c.id] = None if valid.all() else valid
                 continue
@@ -260,11 +260,12 @@ class Executor:
             else:
                 out_cols[c.id] = data
             out_valids[c.id] = None if valid.all() else valid
+        visible = [c for c in comp.out_cols if not getattr(c, "hidden", False)]
         return Result(
-            columns=[c.name for c in comp.out_cols],
+            columns=[c.name for c in visible],
             cols=out_cols,
             valids=out_valids,
-            _order=[c.id for c in comp.out_cols],
+            _order=[c.id for c in visible],
         )
 
 

@@ -1,0 +1,142 @@
+"""Window functions — nodeWindowAgg.c as sort + segmented scans.
+
+Rows are sorted by (partition keys, order keys); partition and peer-group
+boundaries become monotone index arrays via cummax, and every window value
+is then pure vectorized arithmetic:
+
+  row_number  = position - partition_start + 1
+  rank        = peer_start - partition_start + 1
+  dense_rank  = segmented count of peer boundaries
+  sum/count/avg (ORDER BY present)  = running-to-last-peer via cumsum diffs
+                (PG's default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW)
+  sum/count/avg (no ORDER BY)       = whole-partition via cumsum diffs
+  min/max     = segmented scan (associative op with partition reset)
+
+The planner guarantees each partition is wholly on one segment
+(redistribute by partition keys; no PARTITION BY -> single-segment motion),
+so everything here is segment-local.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass
+class WinFunc:
+    name: str              # output column id
+    func: str              # row_number | rank | dense_rank | sum | count | avg | min | max
+    values: jnp.ndarray | None
+    valid: jnp.ndarray | None
+    decimal_scale: int = 0
+    ordered: bool = False  # window had ORDER BY -> running (peer) frame
+
+
+def _starts(boundary, idx):
+    """Monotone start-index array: for each row, the index of the first row
+    of its group (boundary True marks group firsts)."""
+    return lax.cummax(jnp.where(boundary, idx, 0))
+
+
+def _ends(starts, n):
+    """Last index of each group: starts is non-decreasing, so the group end
+    is the last position holding the same start."""
+    return (jnp.searchsorted(starts, starts, side="right") - 1).astype(jnp.int32)
+
+
+def _seg_scan_minmax(v, boundary, op):
+    """Segmented running min/max: associative scan with reset at boundaries."""
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, out = lax.associative_scan(combine, (boundary, v))
+    return out
+
+
+def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
+    """Window values over the SORTED batch.
+
+    partition_eq_prev[i]: row i has the same partition keys as row i-1
+    peer_eq_prev[i]: same partition AND same order keys as row i-1
+    (both False at i=0 and for dead rows — dead rows sit at the end).
+    -> {name: values}, {name: valid}
+    """
+    n = sel_sorted.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    p_bound = ~partition_eq_prev
+    peer_bound = ~peer_eq_prev
+    p_start = _starts(p_bound, idx)
+    peer_start = _starts(peer_bound, idx)
+    peer_end = _ends(peer_start, n)
+    p_end = _ends(p_start, n)
+
+    out_vals, out_valid = {}, {}
+    for f in funcs:
+        if f.func == "row_number":
+            out_vals[f.name] = (idx - p_start + 1).astype(jnp.int64)
+            out_valid[f.name] = None
+            continue
+        if f.func == "rank":
+            out_vals[f.name] = (peer_start - p_start + 1).astype(jnp.int64)
+            out_valid[f.name] = None
+            continue
+        if f.func == "dense_rank":
+            cb = jnp.cumsum(peer_bound.astype(jnp.int64))
+            out_vals[f.name] = cb - cb[jnp.clip(p_start, 0, n - 1)] + 1
+            out_valid[f.name] = None
+            continue
+
+        has_order = f.ordered
+        lv = sel_sorted if f.valid is None else (sel_sorted & f.valid)
+        end = peer_end if has_order else p_end
+        if f.func in ("sum", "count", "avg"):
+            if f.func == "count" and f.values is None:
+                vals = jnp.ones((n,), dtype=jnp.int64)
+            else:
+                vals = f.values
+            acc = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
+            cs = jnp.cumsum(jnp.where(lv, vals.astype(acc), acc(0)))
+            cnt = jnp.cumsum(jnp.where(lv, jnp.int64(1), jnp.int64(0)))
+            base = jnp.where(p_start > 0, cs[jnp.clip(p_start - 1, 0, n - 1)], acc(0))
+            cbase = jnp.where(p_start > 0, cnt[jnp.clip(p_start - 1, 0, n - 1)], 0)
+            s = cs[end] - base
+            c = cnt[end] - cbase
+            if f.func == "count":
+                out_vals[f.name] = c
+                out_valid[f.name] = None
+            elif f.func == "sum":
+                out_vals[f.name] = s
+                out_valid[f.name] = c > 0
+            else:
+                avg = s.astype(jnp.float64) / jnp.where(c == 0, 1, c).astype(jnp.float64)
+                if f.decimal_scale:
+                    avg = avg / (10.0 ** f.decimal_scale)
+                out_vals[f.name] = avg
+                out_valid[f.name] = c > 0
+            continue
+        if f.func in ("min", "max"):
+            vals = f.values
+            if vals.dtype.kind == "f":
+                ident = jnp.array(jnp.inf if f.func == "min" else -jnp.inf, vals.dtype)
+            else:
+                info = jnp.iinfo(vals.dtype)
+                ident = jnp.array(info.max if f.func == "min" else info.min, vals.dtype)
+            filled = jnp.where(lv, vals, ident)
+            op = jnp.minimum if f.func == "min" else jnp.maximum
+            run = _seg_scan_minmax(filled, p_bound, op)
+            cnt = jnp.cumsum(jnp.where(lv, jnp.int64(1), jnp.int64(0)))
+            cbase = jnp.where(p_start > 0, cnt[jnp.clip(p_start - 1, 0, n - 1)], 0)
+            if has_order:
+                out_vals[f.name] = run[peer_end]
+                out_valid[f.name] = (cnt[peer_end] - cbase) > 0
+            else:
+                out_vals[f.name] = run[p_end]
+                out_valid[f.name] = (cnt[p_end] - cbase) > 0
+            continue
+        raise NotImplementedError(f.func)
+    return out_vals, out_valid

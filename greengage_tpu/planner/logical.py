@@ -26,6 +26,7 @@ class ColInfo:
     type: T.SqlType
     name: str                      # user-facing output name
     dict_ref: tuple[str, str] | None = None   # (table, column) for TEXT
+    hidden: bool = False           # ORDER BY pass-through, not in the result
 
 
 @dataclass
@@ -123,6 +124,20 @@ class Limit(Plan):
 
     def out_cols(self):
         return self.child.out_cols()
+
+
+@dataclass
+class Window(Plan):
+    """WindowAgg: per-partition functions over sorted rows (nodeWindowAgg.c).
+    Each wfunc: (out ColInfo, func name, arg Expr|None, ordered)."""
+
+    child: Plan
+    partition_keys: list[E.Expr]
+    order_keys: list          # (expr, desc, nulls_first)
+    wfuncs: list
+
+    def out_cols(self):
+        return self.child.out_cols() + [c for c, _, _, _ in self.wfuncs]
 
 
 @dataclass

@@ -22,7 +22,7 @@ from greengage_tpu.planner import cost as C
 from greengage_tpu.planner.locus import Locus, LocusKind
 from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Motion, MotionKind, Plan, Project,
-    Scan, Sort, Union,
+    Scan, Sort, Union, Window,
 )
 
 
@@ -297,6 +297,28 @@ class Planner:
         # to one segment by the compiler to avoid row duplication)
         node.locus = Locus.strewn(self.nseg)
         node.est_rows = sum(c.est_rows for c in node.inputs)
+        return node
+
+    def _plan_window(self, node: Window) -> Plan:
+        node.child = self._rec(node.child)
+        child = node.child
+        key_ids = tuple(e.name for e in node.partition_keys
+                        if isinstance(e, E.ColRef))
+        if not node.partition_keys:
+            # one global window: all rows to a single segment
+            if child.locus.is_partitioned:
+                const = E.Literal(0, T.INT64)
+                m = Motion(MotionKind.REDISTRIBUTE, child, hash_exprs=[const])
+                m.locus = Locus(LocusKind.SINGLE_QE, (), self.nseg)
+                m.est_rows = child.est_rows
+                node.child = m
+        elif child.locus.kind is LocusKind.HASHED and child.locus.hashed_on(key_ids):
+            pass   # partitions already whole per segment
+        elif child.locus.is_partitioned:
+            m = self._redistribute(child, list(node.partition_keys), key_ids)
+            node.child = m
+        node.locus = node.child.locus
+        node.est_rows = child.est_rows
         return node
 
     def _plan_sort(self, node: Sort) -> Plan:

@@ -132,11 +132,18 @@ class CastExpr(ANode):
 
 
 @dataclass
+class WindowSpec(ANode):
+    partition_by: list = field(default_factory=list)
+    order_by: list = field(default_factory=list)   # OrderItem
+
+
+@dataclass
 class FuncCall(ANode):
     name: str
     args: list[ANode]
     star: bool = False            # count(*)
     distinct: bool = False
+    over: "WindowSpec | None" = None
 
 
 @dataclass

@@ -137,32 +137,79 @@ class Binder:
         for node, negate in subq:
             plan = self._bind_subquery_pred(node, negate, plan, scope)
 
-        # aggregate detection
+        # aggregate / window detection
         has_aggs = any(
             _contains_agg(it.expr) for it in stmt.items
         ) or (stmt.having is not None and _contains_agg(stmt.having)) or stmt.group_by
+        has_windows = any(_contains_window(it.expr) for it in stmt.items)
+        if has_aggs and has_windows:
+            raise SqlError(
+                "window functions over grouped aggregates are not supported yet")
+        if stmt.having is not None and _contains_window(stmt.having):
+            raise SqlError("window functions are not allowed in HAVING")
+        if any(_contains_window(oi.expr) for oi in stmt.order_by):
+            raise SqlError(
+                "window functions in ORDER BY are not supported; use a "
+                "select-list alias")
 
         if has_aggs:
             plan, agg_scope, rewrites = self._bind_aggregate(stmt, plan, scope)
             out_scope, sel_exprs = self._bind_select_items(stmt, agg_scope, rewrites)
+        elif has_windows:
+            if stmt.having is not None:
+                raise SqlError("HAVING requires GROUP BY or aggregates")
+            plan, win_rewrites = self._bind_windows(stmt, plan, scope)
+            out_scope, sel_exprs = self._bind_select_items(
+                stmt, scope, win_rewrites, allow_plain=True)
         else:
             if stmt.having is not None:
                 raise SqlError("HAVING requires GROUP BY or aggregates")
             out_scope, sel_exprs = self._bind_select_items(stmt, scope, {})
 
         proj_cols = [c for c, _ in sel_exprs]
+
+        # ORDER BY may reference non-projected expressions: aggregates/group
+        # keys resolve through the rewrite map; plain input columns (PG
+        # allows them for ungrouped queries) ride as hidden pass-throughs
+        agg_rewrites = rewrites if has_aggs else {}
+        src_to_out = {e.name: ci for ci, e in sel_exprs if isinstance(e, E.ColRef)}
+        order_keys = []
+        if stmt.order_by:
+            for oi in stmt.order_by:
+                e = None
+                if agg_rewrites:
+                    hit = (agg_rewrites.get(id(oi.expr))
+                           or agg_rewrites.get(_ast_key(oi.expr)))
+                    if hit is not None:
+                        out_ci = src_to_out.get(hit.id)
+                        if out_ci is not None:
+                            e = _colref(out_ci)
+                        else:
+                            ci = ColInfo(self.new_id("ord"), hit.type, "?order?",
+                                         hit.dict_ref, hidden=True)
+                            sel_exprs.append((ci, _colref(hit)))
+                            e = _colref(ci)
+                if e is None:
+                    try:
+                        e = self._bind_order_expr(oi.expr, proj_cols, out_scope)
+                    except SqlError:
+                        if stmt.distinct or has_aggs:
+                            raise
+                        e = self._expr(oi.expr, scope)
+                        ci = ColInfo(self.new_id("ord"), e.type, "?order?",
+                                     _dict_ref_of(e), hidden=True)
+                        sel_exprs.append((ci, e))
+                        e = _colref(ci)
+                order_keys.append((e, oi.desc, oi.nulls_first))
+
         plan = Project(plan, sel_exprs)
 
         if stmt.distinct:
             keys = [(c, E.ColRef(c.id, c.type)) for c in proj_cols]
             plan = Aggregate(plan, keys, [])
 
-        if stmt.order_by:
-            keys = []
-            for oi in stmt.order_by:
-                e = self._bind_order_expr(oi.expr, proj_cols, out_scope)
-                keys.append((e, oi.desc, oi.nulls_first))
-            plan = Sort(plan, keys)
+        if order_keys:
+            plan = Sort(plan, order_keys)
         if stmt.limit is not None or stmt.offset:
             plan = Limit(plan, stmt.limit, stmt.offset)
         return plan, proj_cols
@@ -246,6 +293,74 @@ class Binder:
         if outer_only:
             joined = Filter(joined, self._predicate(_join_and(outer_only), scope))
         return joined
+
+    # ------------------------------------------------------------------
+    # window functions
+    # ------------------------------------------------------------------
+    _WINFUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
+                 "min", "max"}
+
+    def _bind_windows(self, stmt, plan, scope):
+        from greengage_tpu.planner.logical import Window
+
+        calls: list[A.FuncCall] = []
+
+        def collect(n):
+            if isinstance(n, A.FuncCall) and n.over is not None:
+                calls.append(n)
+                return
+            for ch in _ast_children(n):
+                collect(ch)
+
+        for it in stmt.items:
+            collect(it.expr)
+
+        def spec_key(over: A.WindowSpec) -> str:
+            parts = [_ast_key(p) for p in over.partition_by]
+            parts.append("|")
+            for oi in over.order_by:
+                parts.append(f"{_ast_key(oi.expr)}:{oi.desc}:{oi.nulls_first}")
+            return " ".join(parts)
+
+        groups: dict[str, list[A.FuncCall]] = {}
+        for fc in calls:
+            groups.setdefault(spec_key(fc.over), []).append(fc)
+
+        rewrites: dict = {}
+        for fcs in groups.values():
+            spec = fcs[0].over
+            pkeys = [self._expr(p, scope) for p in spec.partition_by]
+            okeys = [(self._expr(oi.expr, scope), oi.desc, oi.nulls_first)
+                     for oi in spec.order_by]
+            wfuncs = []
+            for fc in fcs:
+                fname = fc.name
+                if fname not in self._WINFUNCS:
+                    raise SqlError(f"unknown window function {fname}")
+                if fc.distinct:
+                    raise SqlError("DISTINCT in window functions is not supported")
+                arg = None
+                if fname in ("row_number", "rank", "dense_rank"):
+                    if fc.args or fc.star:
+                        raise SqlError(f"{fname}() takes no arguments")
+                    rtype = T.INT64
+                elif fc.star or not fc.args:
+                    if fname != "count":
+                        raise SqlError(f"{fname}(*) is not valid")
+                    rtype = T.INT64
+                else:
+                    arg = self._expr(fc.args[0], scope)
+                    if arg.type.kind is T.Kind.TEXT and fname in ("min", "max",
+                                                                  "sum", "avg"):
+                        raise SqlError(
+                            f"window {fname}() over text is not supported yet")
+                    rtype = E.agg_result_type(
+                        "count" if fname == "count" else fname, arg.type)
+                ci = ColInfo(self.new_id(fname), rtype, fname)
+                wfuncs.append((ci, fname, arg, bool(spec.order_by)))
+                rewrites[id(fc)] = ci
+            plan = Window(plan, pkeys, okeys, wfuncs)
+        return plan, rewrites
 
     # ------------------------------------------------------------------
     # UNION
@@ -462,12 +577,23 @@ class Binder:
         # 2. collect aggregate calls across select/having/order
         agg_nodes: list[A.FuncCall] = []
 
+        seen_keys: dict[str, A.FuncCall] = {}
+
         def collect(n):
-            if isinstance(n, A.FuncCall) and n.name in ("count", "sum", "avg", "min", "max"):
-                agg_nodes.append(n)
+            if isinstance(n, A.FuncCall) and n.over is None and \
+                    n.name in ("count", "sum", "avg", "min", "max"):
+                # dedupe textually-identical aggregates (ORDER BY repeats)
+                k = _ast_key(n)
+                if k in seen_keys:
+                    dup_map[id(n)] = seen_keys[k]
+                else:
+                    seen_keys[k] = n
+                    agg_nodes.append(n)
                 return
             for ch in _ast_children(n):
                 collect(ch)
+
+        dup_map: dict[int, A.FuncCall] = {}
 
         for it in stmt.items:
             collect(it.expr)
@@ -486,6 +612,7 @@ class Binder:
 
         aggs: list[tuple[ColInfo, E.Agg]] = []
         agg_map: dict[int, ColInfo] = {}
+        distinct_args: list[ColInfo] = []
         for fc in agg_nodes:
             if fc.star:
                 arg = None
@@ -497,14 +624,36 @@ class Binder:
                 ci_in = ColInfo(self.new_id("a_in"), ae.type, "arg", _dict_ref_of(ae))
                 proj.append((ci_in, ae))
                 arg_ref = E.ColRef(ci_in.id, ci_in.type)
+                if _dict_ref_of(ae) is not None:
+                    object.__setattr__(arg_ref, "_dict_ref", _dict_ref_of(ae))
             func = "count_star" if fc.star else fc.name
             rtype = E.agg_result_type(func, atype)
             agg = E.Agg(func, arg_ref, fc.distinct, rtype)
             ci = ColInfo(self.new_id(func), rtype, func)
             aggs.append((ci, agg))
             agg_map[id(fc)] = ci
+            if fc.distinct:
+                if fc.star:
+                    raise SqlError("count(distinct *) is not valid")
+                distinct_args.append(
+                    ColInfo(ci_in.id, ci_in.type, ci_in.name, ci_in.dict_ref))
 
         plan = Project(plan, proj)
+        if distinct_args:
+            # DISTINCT aggregates: dedupe (group keys, arg) first, then
+            # aggregate plain over the distinct combinations (the classic
+            # two-level rewrite). Mixing DISTINCT and plain aggregates in
+            # one query would need split-and-rejoin plans — not yet.
+            if len(aggs) != 1:
+                raise SqlError(
+                    "DISTINCT aggregates cannot be combined with other "
+                    "aggregates yet")
+            dci = distinct_args[0]
+            dedupe_keys = list(key_cols) + [
+                (dci, E.ColRef(dci.id, dci.type))]
+            plan = Aggregate(plan, dedupe_keys, [])
+            ci, agg = aggs[0]
+            aggs = [(ci, E.Agg(agg.func, agg.arg, False, agg.type))]
         plan = Aggregate(plan, key_cols, aggs)
 
         # 4. scope over agg outputs; rewrites: ast node -> ColInfo
@@ -516,6 +665,8 @@ class Binder:
             cols[ci.name] = ci
         for fc in agg_nodes:
             rewrites[id(fc)] = agg_map[id(fc)]
+        for dup_id, canon in dup_map.items():
+            rewrites[dup_id] = agg_map[id(canon)]
         out_scope.add("", cols)
 
         if stmt.having is not None:
@@ -523,11 +674,11 @@ class Binder:
             plan = Filter(plan, pred)
         return plan, out_scope, rewrites
 
-    def _bind_select_items(self, stmt, scope, rewrites):
+    def _bind_select_items(self, stmt, scope, rewrites, allow_plain=False):
         sel_exprs: list[tuple[ColInfo, E.Expr]] = []
         for it in stmt.items:
             if isinstance(it.expr, A.Star):
-                if rewrites:
+                if rewrites and not allow_plain:
                     raise SqlError("* not allowed with GROUP BY")
                 cols = (scope.table_cols(it.expr.table) if it.expr.table
                         else scope.all_cols())
@@ -535,7 +686,7 @@ class Binder:
                     ci = ColInfo(self.new_id(c.name), c.type, c.name, c.dict_ref)
                     sel_exprs.append((ci, E.ColRef(c.id, c.type)))
                 continue
-            e = self._rewritten_expr(it.expr, rewrites, scope)
+            e = self._rewritten_expr(it.expr, rewrites, scope, allow_plain)
             name = it.alias or _ast_name(it.expr)
             ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e))
             sel_exprs.append((ci, e))
@@ -571,19 +722,23 @@ class Binder:
             raise SqlError("predicate must be boolean")
         return e
 
-    def _rewritten_expr(self, ast, rewrites, scope) -> E.Expr:
+    def _rewritten_expr(self, ast, rewrites, scope, allow_plain=False) -> E.Expr:
         if rewrites:
             hit = rewrites.get(id(ast)) or rewrites.get(_ast_key(ast))
             if hit is not None:
                 return _colref(hit)
-            if isinstance(ast, A.FuncCall) and ast.name in ("count", "sum", "avg", "min", "max"):
+            if isinstance(ast, A.FuncCall) and ast.over is None and \
+                    ast.name in ("count", "sum", "avg", "min", "max"):
                 raise SqlError("unmatched aggregate")  # should be in rewrites
             if isinstance(ast, A.Name):
+                if allow_plain:
+                    return self._expr(ast, scope)
                 raise SqlError(
                     f'column "{".".join(ast.parts)}" must appear in GROUP BY')
             if isinstance(ast, (A.Num, A.Str, A.Null, A.Bool, A.DateLit)):
                 return self._expr(ast, scope)
-            clone = _ast_rebind(ast, lambda ch: self._rewritten_expr(ch, rewrites, scope))
+            clone = _ast_rebind(ast, lambda ch: self._rewritten_expr(
+                ch, rewrites, scope, allow_plain))
             if clone is not None:
                 return clone
             return self._expr(ast, scope)
@@ -825,9 +980,16 @@ def _dict_ref_of(e: E.Expr):
 
 
 def _contains_agg(ast) -> bool:
-    if isinstance(ast, A.FuncCall) and ast.name in ("count", "sum", "avg", "min", "max"):
+    if isinstance(ast, A.FuncCall) and ast.over is None and \
+            ast.name in ("count", "sum", "avg", "min", "max"):
         return True
     return any(_contains_agg(c) for c in _ast_children(ast))
+
+
+def _contains_window(ast) -> bool:
+    if isinstance(ast, A.FuncCall) and ast.over is not None:
+        return True
+    return any(_contains_window(c) for c in _ast_children(ast))
 
 
 def _ast_children(ast):
@@ -997,8 +1159,16 @@ def _apply_interval(days: int, iv: A.IntervalLit, op: str) -> int:
 # --------------------------------------------------------------------------
 
 def _collect_needed(plan: Plan, needed: set):
-    from greengage_tpu.planner.logical import Motion
+    from greengage_tpu.planner.logical import Motion, Window
 
+    if isinstance(plan, Window):
+        for e in plan.partition_keys:
+            needed.update(E.columns_used(e))
+        for e, _, _ in plan.order_keys:
+            needed.update(E.columns_used(e))
+        for _, _, arg, _ in plan.wfuncs:
+            if arg is not None:
+                needed.update(E.columns_used(arg))
     if isinstance(plan, Project):
         for _, e in plan.exprs:
             needed.update(E.columns_used(e))

@@ -39,7 +39,7 @@ KEYWORDS = {
     "interval", "extract", "distributed", "randomly", "replicated", "with",
     "exists", "if", "show", "union", "all", "substring", "for",
     "begin", "commit", "rollback", "abort", "set", "to", "transaction", "work",
-    "delete", "update",
+    "delete", "update", "over", "partition",
 }
 
 
@@ -485,17 +485,35 @@ class Parser:
             if self.peek(1) == ("op", "("):
                 fname = self.next()[1]
                 self.next()
-                if self.accept("op", "*"):
-                    self.expect("op", ")")
-                    return A.FuncCall(fname, [], star=True)
-                distinct = bool(self.accept("kw", "distinct"))
+                star = False
+                distinct = False
                 args = []
-                if self.peek() != ("op", ")"):
-                    args.append(self.expr())
-                    while self.accept("op", ","):
+                if self.accept("op", "*"):
+                    star = True
+                else:
+                    distinct = bool(self.accept("kw", "distinct"))
+                    if self.peek() != ("op", ")"):
                         args.append(self.expr())
+                        while self.accept("op", ","):
+                            args.append(self.expr())
                 self.expect("op", ")")
-                return A.FuncCall(fname, args, distinct=distinct)
+                over = None
+                if self.accept("kw", "over"):
+                    self.expect("op", "(")
+                    over = A.WindowSpec()
+                    if self.accept("kw", "partition"):
+                        self.expect("kw", "by")
+                        over.partition_by.append(self.expr())
+                        while self.accept("op", ","):
+                            over.partition_by.append(self.expr())
+                    if self.accept("kw", "order"):
+                        self.expect("kw", "by")
+                        over.order_by.append(self.order_item())
+                        while self.accept("op", ","):
+                            over.order_by.append(self.order_item())
+                    self.expect("op", ")")
+                return A.FuncCall(fname, args, star=star, distinct=distinct,
+                                  over=over)
             parts = [self.next()[1]]
             while self.peek() == ("op", ".") and self.peek(1)[0] == "name":
                 self.next()

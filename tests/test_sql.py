@@ -295,3 +295,14 @@ def test_having(db, oracle):
 def test_scalar_agg_empty_result(db):
     r = db.sql("select count(*), sum(l_quantity) from lineitem where l_quantity < 0")
     assert r.rows() == [(0, None)]
+
+
+def test_distinct_aggregates(db, oracle):
+    li = oracle["lineitem"]
+    r = db.sql("select count(distinct l_suppkey) from lineitem")
+    assert r.rows()[0][0] == li.l_suppkey.nunique()
+    r = db.sql("select l_returnflag, count(distinct l_shipmode) c from lineitem "
+               "group by l_returnflag order by l_returnflag")
+    want = li.groupby("l_returnflag").l_shipmode.nunique()
+    got = r.to_pandas()
+    assert list(got.c) == list(want.values)

@@ -1,0 +1,131 @@
+"""Wider TPC-H coverage: Q10, Q12, Q14, Q19 (multi-key groups, CASE sums,
+OR-of-AND predicates, text IN-lists) vs pandas oracle."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.utils import tpch
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    tpch.load(d, sf=0.002)
+    return d
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return tpch.to_pandas(tpch.generate(0.002))
+
+
+def _days(s):
+    return (np.datetime64(s) - np.datetime64("1970-01-01")).astype(int)
+
+
+def test_q10_returned_item_reporting(db, oracle):
+    r = db.sql("""
+      select c_custkey, c_name,
+             sum(l_extendedprice * (1 - l_discount)) as revenue,
+             c_acctbal, n_name
+      from customer, orders, lineitem, nation
+      where c_custkey = o_custkey and l_orderkey = o_orderkey
+        and o_orderdate >= date '1993-10-01'
+        and o_orderdate < date '1993-10-01' + interval '3' month
+        and l_returnflag = 'R' and c_nationkey = n_nationkey
+      group by c_custkey, c_name, c_acctbal, n_name
+      order by revenue desc limit 20
+    """)
+    c, o, li, n = (oracle[t] for t in ("customer", "orders", "lineitem", "nation"))
+    j = (o[(o.o_orderdate >= _days("1993-10-01")) & (o.o_orderdate < _days("1994-01-01"))]
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(li[li.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+         .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (j.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"], as_index=False)
+            .agg(revenue=("revenue", "sum"))
+            .sort_values("revenue", ascending=False).head(20))
+    got = r.to_pandas()
+    assert len(got) == len(want)
+    assert np.allclose(got.revenue, want.revenue, rtol=1e-12)
+    assert list(got.c_custkey) == list(want.c_custkey)
+
+
+def test_q12_shipmode_priority(db, oracle):
+    r = db.sql("""
+      select l_shipmode,
+             sum(case when o_orderpriority = '1-URGENT'
+                       or o_orderpriority = '2-HIGH' then 1 else 0 end) as high_line_count,
+             sum(case when o_orderpriority <> '1-URGENT'
+                       and o_orderpriority <> '2-HIGH' then 1 else 0 end) as low_line_count
+      from orders, lineitem
+      where o_orderkey = l_orderkey
+        and l_shipmode in ('MAIL', 'SHIP')
+        and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+        and l_receiptdate >= date '1994-01-01'
+        and l_receiptdate < date '1994-01-01' + interval '1' year
+      group by l_shipmode order by l_shipmode
+    """)
+    o, li = oracle["orders"], oracle["lineitem"]
+    f = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+           & (li.l_commitdate < li.l_receiptdate) & (li.l_shipdate < li.l_commitdate)
+           & (li.l_receiptdate >= _days("1994-01-01"))
+           & (li.l_receiptdate < _days("1995-01-01"))]
+    j = f.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    j["high"] = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    want = j.groupby("l_shipmode").agg(high=("high", "sum"),
+                                       low=("high", lambda s: (1 - s).sum()))
+    got = r.to_pandas()
+    assert list(got.l_shipmode) == list(want.index)
+    assert list(got.high_line_count) == list(want.high)
+    assert list(got.low_line_count) == list(want.low)
+
+
+def test_q14_promo_effect(db, oracle):
+    r = db.sql("""
+      select 100.00 * sum(case when p_type like 'type 1%'
+                          then l_extendedprice * (1 - l_discount) else 0 end)
+             / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+      from lineitem, part
+      where l_partkey = p_partkey
+        and l_shipdate >= date '1995-09-01'
+        and l_shipdate < date '1995-09-01' + interval '1' month
+    """)
+    li, p = oracle["lineitem"], oracle["part"]
+    f = li[(li.l_shipdate >= _days("1995-09-01")) & (li.l_shipdate < _days("1995-10-01"))]
+    j = f.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev[j.p_type.str.startswith("type 1")].sum()
+    want = 100.0 * promo / rev.sum()
+    got = r.rows()[0][0]
+    # decimal division result scale is 6 fractional digits (types.arith_result)
+    assert got == pytest.approx(want, abs=5e-7)
+
+
+def test_q19_discounted_revenue(db, oracle):
+    r = db.sql("""
+      select sum(l_extendedprice * (1 - l_discount)) as revenue
+      from lineitem, part
+      where p_partkey = l_partkey
+        and ((p_brand = 'Brand#11' and l_quantity between 1 and 11
+              and p_size between 1 and 5)
+          or (p_brand = 'Brand#22' and l_quantity between 10 and 20
+              and p_size between 1 and 10)
+          or (p_brand = 'Brand#33' and l_quantity between 20 and 30
+              and p_size between 1 and 15))
+        and l_shipmode in ('AIR', 'REG AIR')
+    """)
+    li, p = oracle["lineitem"], oracle["part"]
+    j = li[li.l_shipmode.isin(["AIR", "REG AIR"])].merge(
+        p, left_on="l_partkey", right_on="p_partkey")
+    m = (((j.p_brand == "Brand#11") & j.l_quantity.between(1, 11) & j.p_size.between(1, 5))
+         | ((j.p_brand == "Brand#22") & j.l_quantity.between(10, 20) & j.p_size.between(1, 10))
+         | ((j.p_brand == "Brand#33") & j.l_quantity.between(20, 30) & j.p_size.between(1, 15)))
+    want = (j[m].l_extendedprice * (1 - j[m].l_discount)).sum()
+    got = r.rows()[0][0]
+    if want == 0:
+        assert got is None or got == 0
+    else:
+        assert got == pytest.approx(want, rel=1e-12)

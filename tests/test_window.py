@@ -1,0 +1,78 @@
+"""Window function tests (nodeWindowAgg analog) vs pandas."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    d.sql("create table w (g text, k int, v int) distributed by (k)")
+    d.sql("insert into w values "
+          "('a', 1, 10), ('a', 2, 20), ('a', 3, 20), ('a', 4, 5), "
+          "('b', 5, 7), ('b', 6, 7), ('b', 7, 1), "
+          "('c', 8, null), ('c', 9, 3)")
+    return d
+
+
+def test_row_number_and_rank(db):
+    r = db.sql("select g, v, row_number() over (partition by g order by v) rn, "
+               "rank() over (partition by g order by v) rk, "
+               "dense_rank() over (partition by g order by v) dr "
+               "from w order by g, v nulls last")
+    rows = [tuple(x) for x in r.rows()]
+    # group a: v=5,10,20,20 -> rn 1..4, rank 1,2,3,3, dense 1,2,3,3
+    assert rows[0] == ("a", 5, 1, 1, 1)
+    assert rows[1] == ("a", 10, 2, 2, 2)
+    assert rows[2][1:] == (20, 3, 3, 3)
+    assert rows[3][1:] == (20, 4, 3, 3)
+    # group b: v=1,7,7
+    assert rows[4] == ("b", 1, 1, 1, 1)
+    assert rows[5][1:] == (7, 2, 2, 2)
+    assert rows[6][1:] == (7, 3, 2, 2)
+    # group c: v=3, null (nulls last in window order)
+    assert rows[7] == ("c", 3, 1, 1, 1)
+    assert rows[8][0] == "c" and rows[8][1] is None and rows[8][2] == 2
+
+
+def test_partition_aggregate_no_order(db):
+    r = db.sql("select g, v, sum(v) over (partition by g) s, "
+               "count(v) over (partition by g) c, "
+               "max(v) over (partition by g) m "
+               "from w order by g, k")
+    df = pd.DataFrame({
+        "g": list("aaaabbbcc"),
+        "k": range(1, 10),
+        "v": [10, 20, 20, 5, 7, 7, 1, None, 3],
+    })
+    want_s = df.groupby("g").v.transform("sum")
+    want_c = df.groupby("g").v.transform("count")
+    want_m = df.groupby("g").v.transform("max")
+    got = r.to_pandas()
+    assert list(got.s) == [int(x) for x in want_s]
+    assert list(got.c) == [int(x) for x in want_c]
+    assert list(got.m) == [int(x) for x in want_m]
+
+
+def test_running_sum_with_peers(db):
+    r = db.sql("select g, v, sum(v) over (partition by g order by v) rs "
+               "from w where g = 'b' order by v")
+    # b: v=1 -> 1 ; v=7,7 are peers -> both see 15
+    assert [tuple(x) for x in r.rows()] == [("b", 1, 1), ("b", 7, 15), ("b", 7, 15)]
+
+
+def test_global_window_no_partition(db):
+    r = db.sql("select k, row_number() over (order by k desc) rn from w "
+               "order by k")
+    rows = [tuple(x) for x in r.rows()]
+    assert rows[0] == (1, 9) and rows[-1] == (9, 1)
+
+
+def test_window_count_star(db):
+    r = db.sql("select g, count(*) over (partition by g) c from w "
+               "order by g, k")
+    got = [x[1] for x in r.rows()]
+    assert got == [4, 4, 4, 4, 3, 3, 3, 2, 2]
