@@ -26,6 +26,9 @@ class Settings:
     # execution
     optimizer: bool = True              # motion-aware planner on/off (GUC 'optimizer')
     explain_verbose: bool = False
+    # memory protection (gp_vmem_protect_limit analog): estimated device
+    # bytes a single query may allocate; 0 disables the check
+    vmem_protect_limit_mb: int = 12288
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1

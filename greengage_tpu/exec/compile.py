@@ -65,6 +65,7 @@ class CompileResult:
     # overflow flag -> (plan node id, metric name): lets the executor size
     # the retry capacity from the exact cardinality the device reported
     flag_caps: dict = field(default_factory=dict)
+    est_bytes: int = 0                 # rough per-segment device allocation
 
 
 class Compiler:
@@ -160,7 +161,25 @@ class Compiler:
             capacity=self._capacity_of(below),
             metric_names=metric_names,
             flag_caps=dict(self.flag_caps),
+            est_bytes=self._estimate_bytes(below),
         )
+
+    def _estimate_bytes(self, plan: Plan) -> int:
+        """Rough per-segment device allocation for the whole program
+        (vmem_tracker admission analog): every node's batch capacity times
+        its column widths, summed over the tree."""
+        total = 0
+        stack = [plan]
+        while stack:
+            p = stack.pop()
+            try:
+                cap = self._capacity_of(p)
+            except NotImplementedError:
+                cap = 0
+            width = sum(max(c.type.np_dtype.itemsize, 1) + 1 for c in p.out_cols())
+            total += cap * width
+            stack.extend(p.children)
+        return total
 
     # ------------------------------------------------------------------
     # capacities

@@ -119,6 +119,13 @@ class Executor:
                     self._plan_cache[ck] = comp
                     if len(self._plan_cache) > 128:
                         self._plan_cache.pop(next(iter(self._plan_cache)))
+            limit = self.settings.vmem_protect_limit_mb * (1 << 20)
+            if limit and comp.est_bytes > limit:
+                raise QueryError(
+                    f"query would allocate ~{comp.est_bytes >> 20} MB per "
+                    f"segment, above vmem_protect_limit_mb="
+                    f"{self.settings.vmem_protect_limit_mb} (runaway "
+                    "protection; raise the limit or reduce the data)")
             inputs = self._stage(comp, snapshot)
             flat = comp.device_fn(*inputs)
             # ONE device->host fetch for every output (per-transfer latency

@@ -304,6 +304,16 @@ class Database:
         delim = stmt.options.get("delimiter", ",")
         header = str(stmt.options.get("header", "false")).lower() in ("true", "1")
         null_s = stmt.options.get("null", "")
+        # native fast path (fstream/gpfdist parsing analog); quoted files and
+        # custom null markers fall back to the Python csv reader below
+        try:
+            from greengage_tpu.storage.csv_native import CsvFallback, parse_file
+
+            cols_n, valids_n = parse_file(stmt.path, schema, delim, header, null_s)
+            n = self._write_rows(stmt.table, cols_n, valids_n)
+            return f"COPY {n}"
+        except CsvFallback:
+            pass
         cols: dict[str, list] = {c.name: [] for c in schema.columns}
         valids: dict[str, list] = {c.name: [] for c in schema.columns}
         with open(stmt.path, newline="") as f:

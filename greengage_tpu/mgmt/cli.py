@@ -102,6 +102,63 @@ def cmd_recover(args):
     return 0
 
 
+def cmd_backup(args):
+    """Full backup (gp_pitr/pg_basebackup analog). The manifest snapshot
+    names one committed version's files; DELETE/UPDATE/expand may GC old
+    files concurrently, so a vanished file triggers a re-snapshot retry
+    until one version copies completely."""
+    import shutil
+
+    db = _open(args.dir)
+    last_err = None
+    for _ in range(5):
+        snap = db.store.manifest.snapshot()
+        try:
+            os.makedirs(args.out, exist_ok=True)
+            shutil.copy(os.path.join(args.dir, "catalog.json"),
+                        os.path.join(args.out, "catalog.json"))
+            copied = 0
+            for tname, tmeta in snap["tables"].items():
+                src_base = os.path.join(args.dir, "data", tname)
+                dst_base = os.path.join(args.out, "data", tname)
+                if os.path.isdir(src_base):
+                    for fn in os.listdir(src_base):
+                        if fn.startswith("dict_"):
+                            os.makedirs(dst_base, exist_ok=True)
+                            shutil.copy(os.path.join(src_base, fn),
+                                        os.path.join(dst_base, fn))
+                for files in tmeta["segfiles"].values():
+                    for rel in files:
+                        dst = os.path.join(dst_base, rel)
+                        os.makedirs(os.path.dirname(dst), exist_ok=True)
+                        shutil.copy(os.path.join(src_base, rel), dst)
+                        copied += 1
+            # manifest written LAST: its presence marks a complete image
+            with open(os.path.join(args.out, "manifest.json"), "w") as f:
+                json.dump(snap, f, indent=1)
+            print(f"backup of version {snap['version']} written to {args.out} "
+                  f"({copied} segment files)")
+            return 0
+        except FileNotFoundError as e:
+            last_err = e   # concurrent writer GC'd a file: retry fresh
+    print(f"error: backup could not converge ({last_err})", file=sys.stderr)
+    return 1
+
+
+def cmd_restore(args):
+    import shutil
+
+    if os.path.exists(os.path.join(args.dir, "catalog.json")):
+        print(f"error: {args.dir} already contains a cluster", file=sys.stderr)
+        return 1
+    shutil.copytree(args.backup, args.dir, dirs_exist_ok=True)
+    db = _open(args.dir)
+    print(f"restored cluster at {args.dir}: width {db.numsegments}, "
+          f"{len(db.catalog.tables)} tables, manifest version "
+          f"{db.store.manifest.snapshot()['version']}")
+    return 0
+
+
 def cmd_checkcat(args):
     db = _open(args.dir)
     problems = []
@@ -168,6 +225,16 @@ def main(argv=None):
     p = sub.add_parser("checkcat")
     p.add_argument("-d", "--dir", required=True)
     p.set_defaults(fn=cmd_checkcat)
+
+    p = sub.add_parser("backup")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-o", "--out", required=True)
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("restore")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-b", "--backup", required=True)
+    p.set_defaults(fn=cmd_restore)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,131 @@
+"""Native CSV ingest: ctypes bindings over ggcodec's csv functions.
+
+The COPY hot path (reference: fstream + gpfdist parsing). Quoted files and
+exotic options fall back to Python's csv module in the session layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from greengage_tpu import types as T
+from greengage_tpu.storage import native
+
+
+class CsvFallback(Exception):
+    """Raised when the fast path can't handle the input (quotes, etc.)."""
+
+
+def _lib():
+    lib = native._load()
+    if not lib:
+        raise CsvFallback("native library unavailable")
+    if not hasattr(lib, "_csv_ready"):
+        lib.gg_csv_index.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint8, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.gg_csv_index.restype = ctypes.c_int64
+        for fn in (lib.gg_parse_i64, lib.gg_parse_f64, lib.gg_parse_date):
+            fn.restype = ctypes.c_int64
+        lib.gg_parse_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.gg_parse_f64.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.gg_parse_date.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib._csv_ready = True
+    return lib
+
+
+def parse_file(path: str, schema, delimiter: str = ",", header: bool = False,
+               null_marker: str = ""):
+    """Parse a CSV file natively into storage-representation columns.
+
+    -> (cols {name: np.ndarray | list[str]}, valids {name: bool array}).
+    Raises CsvFallback when the file needs the quoting-aware Python reader.
+    TEXT columns come back as Python strings (dictionary encoding happens in
+    the store); a non-empty null_marker also falls back (the fast path's
+    NULL is the empty field, PG's CSV default).
+    """
+    if len(delimiter) != 1 or null_marker not in ("",):
+        raise CsvFallback("options need the python reader")
+    lib = _lib()
+    with open(path, "rb") as f:
+        buf = np.frombuffer(f.read(), dtype=np.uint8)
+    if buf.size == 0:
+        return ({c.name: np.empty(0, dtype=c.type.np_dtype) if c.type.kind
+                 is not T.Kind.TEXT else [] for c in schema.columns}, {})
+    ncols = len(schema.columns)
+    cap = int(buf.size // 2) + ncols + 16
+    starts = np.empty(cap, dtype=np.int64)
+    lens = np.empty(cap, dtype=np.int32)
+    nf = lib.gg_csv_index(buf.ctypes.data, buf.size, ord(delimiter), cap,
+                          starts.ctypes.data, lens.ctypes.data)
+    if nf == -2:
+        raise CsvFallback("quoted fields")
+    if nf < 0:
+        raise CsvFallback("field capacity")
+    if nf % ncols != 0:
+        raise ValueError(
+            f"CSV arity mismatch: {nf} fields is not a multiple of {ncols} columns")
+    nrows = nf // ncols
+    if header:
+        starts = starts[ncols:]
+        lens = lens[ncols:]
+        nrows -= 1
+    cols: dict = {}
+    valids: dict = {}
+    raw = buf.tobytes()   # one copy, shared by all TEXT columns
+    for i, c in enumerate(schema.columns):
+        k = c.type.kind
+        if k is T.Kind.TEXT:
+            s = starts[i::ncols][:nrows]
+            ln = lens[i::ncols][:nrows]
+            cols[c.name] = [raw[a:a + b].decode("utf-8")
+                            for a, b in zip(s, ln)]
+            va = ln > 0   # empty field = NULL (PG CSV default, python path parity)
+            if not va.all():
+                valids[c.name] = np.asarray(va, dtype=bool)
+            continue
+        if k is T.Kind.BOOL:
+            raise CsvFallback("bool literals need the python reader")
+        valid = np.empty(nrows, dtype=np.uint8)
+        if k in (T.Kind.INT32, T.Kind.INT64, T.Kind.DECIMAL):
+            out = np.empty(nrows, dtype=np.int64)
+            scale = c.type.scale if k is T.Kind.DECIMAL else 0
+            rc = lib.gg_parse_i64(buf.ctypes.data, starts.ctypes.data,
+                                  lens.ctypes.data, nrows, ncols, i, scale,
+                                  out.ctypes.data, valid.ctypes.data)
+        elif k is T.Kind.FLOAT64:
+            out = np.empty(nrows, dtype=np.float64)
+            rc = lib.gg_parse_f64(buf.ctypes.data, starts.ctypes.data,
+                                  lens.ctypes.data, nrows, ncols, i,
+                                  out.ctypes.data, valid.ctypes.data)
+        elif k is T.Kind.DATE:
+            out = np.empty(nrows, dtype=np.int32)
+            rc = lib.gg_parse_date(buf.ctypes.data, starts.ctypes.data,
+                                   lens.ctypes.data, nrows, ncols, i,
+                                   out.ctypes.data, valid.ctypes.data)
+        else:
+            raise CsvFallback(f"type {c.type}")
+        if rc < 0:
+            raise ValueError(
+                f'COPY: invalid value for column "{c.name}" at row {-rc}')
+        if k is T.Kind.INT32:
+            bad = (out < -(2**31)) | (out >= 2**31)
+            if bad.any():
+                row = int(np.argmax(bad)) + 1
+                raise ValueError(
+                    f'COPY: value out of range for int column "{c.name}" '
+                    f"at row {row}")
+        cols[c.name] = out.astype(c.type.np_dtype, copy=False)
+        va = valid.astype(bool)
+        if not va.all():
+            valids[c.name] = va
+    return cols, valids

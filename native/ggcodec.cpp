@@ -157,3 +157,142 @@ uint32_t gg_crc32(const uint8_t* data, int64_t len) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CSV ingest fast path (the reference's fstream/gpfdist parsing role,
+// src/backend/utils/misc/fstream, src/bin/gpfdist). Two-phase interface:
+// index fields once, then parse columns natively by type. Quoted fields are
+// detected and reported so the caller can fall back to a full CSV reader.
+// ---------------------------------------------------------------------------
+
+// Index delimiter-separated fields. Returns number of fields written, or
+// -1 if capacity exhausted, -2 if a double-quote was seen (caller falls
+// back to the quoting-aware reader). Rows are separated by '\n' (a
+// trailing '\r' is stripped); field k of row r is entry r*ncols+k.
+int64_t gg_csv_index(const uint8_t* buf, int64_t len, uint8_t delim,
+                     int64_t cap, int64_t* starts, int32_t* lens) {
+  int64_t nf = 0;
+  int64_t field_start = 0;
+  for (int64_t i = 0; i <= len; i++) {
+    uint8_t c = (i == len) ? '\n' : buf[i];
+    if (c == '"') return -2;
+    if (c == delim || c == '\n') {
+      if (i == len && field_start == i &&
+          (len == 0 || buf[len - 1] == '\n')) break;  // file ended with newline
+      if (nf >= cap) return -1;
+      int64_t flen = i - field_start;
+      if (c == '\n' && flen > 0 && buf[i - 1] == '\r') flen--;
+      starts[nf] = field_start;
+      lens[nf] = (int32_t)flen;
+      nf++;
+      field_start = i + 1;
+    }
+  }
+  return nf;
+}
+
+// Parse int64 fields (optionally scaled decimals: scale=2 turns "12.3" into
+// 1230). Writes valid=0 for empty fields. Returns -(row+1) on a bad field.
+int64_t gg_parse_i64(const uint8_t* buf, const int64_t* starts,
+                     const int32_t* lens, int64_t n, int64_t stride,
+                     int64_t offset, int32_t scale, int64_t* out,
+                     uint8_t* valid) {
+  for (int64_t r = 0; r < n; r++) {
+    int64_t idx = r * stride + offset;
+    const uint8_t* p = buf + starts[idx];
+    int32_t l = lens[idx];
+    if (l == 0) { out[r] = 0; valid[r] = 0; continue; }
+    valid[r] = 1;
+    int64_t i = 0, sign = 1, v = 0;
+    while (i < l && p[i] == ' ') i++;                  // leading spaces
+    while (l > i && p[l - 1] == ' ') l--;              // trailing spaces
+    if (i >= l) { out[r] = 0; valid[r] = 0; continue; } // all-space = NULL
+    if (p[i] == '-') { sign = -1; i++; }
+    else if (p[i] == '+') i++;
+    int32_t frac_seen = -1;
+    int32_t frac_digits = 0;
+    int32_t ndigits = 0;
+    for (; i < l; i++) {
+      uint8_t c = p[i];
+      if (c == '.') {
+        if (frac_seen >= 0) return -(r + 1);
+        frac_seen = 0;
+        continue;
+      }
+      if (c < '0' || c > '9') return -(r + 1);
+      ndigits++;
+      if (frac_seen >= 0) {
+        if (frac_digits < scale) { v = v * 10 + (c - '0'); frac_digits++; }
+        else if (frac_digits == scale) {
+          // round half away from zero on the first extra digit
+          if (c >= '5') v += 1;
+          frac_digits++;
+        }
+      } else {
+        v = v * 10 + (c - '0');
+      }
+    }
+    if (ndigits == 0) return -(r + 1);
+    while (frac_digits < scale) { v *= 10; frac_digits++; }
+    out[r] = sign * v;
+  }
+  return 0;
+}
+
+// Parse float64 fields. Empty -> NULL.
+int64_t gg_parse_f64(const uint8_t* buf, const int64_t* starts,
+                     const int32_t* lens, int64_t n, int64_t stride,
+                     int64_t offset, double* out, uint8_t* valid) {
+  char tmp[64];
+  for (int64_t r = 0; r < n; r++) {
+    int64_t idx = r * stride + offset;
+    int32_t l = lens[idx];
+    if (l == 0) { out[r] = 0; valid[r] = 0; continue; }
+    if (l >= (int32_t)sizeof(tmp)) return -(r + 1);
+    memcpy(tmp, buf + starts[idx], l);
+    tmp[l] = 0;
+    char* end = nullptr;
+    out[r] = strtod(tmp, &end);
+    if (end != tmp + l) return -(r + 1);
+    valid[r] = 1;
+  }
+  return 0;
+}
+
+// Parse ISO dates (YYYY-MM-DD) into days since 1970-01-01. Empty -> NULL.
+static int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+  y -= m <= 2;
+  int64_t era = (y >= 0 ? y : y - 399) / 400;
+  int64_t yoe = y - era * 400;
+  int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+int64_t gg_parse_date(const uint8_t* buf, const int64_t* starts,
+                      const int32_t* lens, int64_t n, int64_t stride,
+                      int64_t offset, int32_t* out, uint8_t* valid) {
+  for (int64_t r = 0; r < n; r++) {
+    int64_t idx = r * stride + offset;
+    const uint8_t* p = buf + starts[idx];
+    int32_t l = lens[idx];
+    if (l == 0) { out[r] = 0; valid[r] = 0; continue; }
+    if (l != 10 || p[4] != '-' || p[7] != '-') return -(r + 1);
+    int64_t y = 0, m = 0, d = 0;
+    for (int i = 0; i < 4; i++) { if (p[i] < '0' || p[i] > '9') return -(r+1); y = y*10 + (p[i]-'0'); }
+    for (int i = 5; i < 7; i++) { if (p[i] < '0' || p[i] > '9') return -(r+1); m = m*10 + (p[i]-'0'); }
+    for (int i = 8; i < 10; i++) { if (p[i] < '0' || p[i] > '9') return -(r+1); d = d*10 + (p[i]-'0'); }
+    if (m < 1 || m > 12 || d < 1) return -(r + 1);
+    static const int dim[12] = {31,28,31,30,31,30,31,31,30,31,30,31};
+    int64_t maxd = dim[m - 1];
+    if (m == 2 && (y % 4 == 0 && (y % 100 != 0 || y % 400 == 0))) maxd = 29;
+    if (d > maxd) return -(r + 1);
+    out[r] = (int32_t)days_from_civil(y, m, d);
+    valid[r] = 1;
+  }
+  return 0;
+}
+
+}  // extern "C"
