@@ -117,7 +117,7 @@ class Binder:
         # peel subquery predicates (IN/EXISTS) off the WHERE — they become
         # semi/anti joins around the FROM plan (cdbsubselect.c pull-up)
         conjs = _split_and(stmt.where)
-        normal, subq = [], []
+        normal, subq, corr_scalar = [], [], []
         for c in conjs:
             negate = False
             inner = c
@@ -126,6 +126,19 @@ class Binder:
                 inner = inner.arg
             if isinstance(inner, (A.InSubquery, A.ExistsExpr)):
                 subq.append((inner, negate != getattr(inner, "negate", False)))
+            elif (isinstance(inner, A.Bin)
+                  and inner.op in ("=", "<>", "<", "<=", ">", ">=")
+                  and not negate
+                  and (isinstance(inner.left, A.ScalarSubquery)
+                       ^ isinstance(inner.right, A.ScalarSubquery))):
+                # comparison against a scalar subquery: correlated ones are
+                # decorrelated into a join; uncorrelated ones bind normally
+                # (executed as InitPlans) via the `normal` path
+                sub = inner.left if isinstance(inner.left, A.ScalarSubquery) else inner.right
+                if self._is_correlated(sub.query):
+                    corr_scalar.append(inner)
+                    continue
+                normal.append(c)
             else:
                 normal.append(c)
         where = _join_and(normal)
@@ -136,6 +149,8 @@ class Binder:
             plan = f
         for node, negate in subq:
             plan = self._bind_subquery_pred(node, negate, plan, scope)
+        for cmp_ast in corr_scalar:
+            plan = self._bind_corr_scalar(cmp_ast, plan, scope)
 
         # aggregate / window detection
         has_aggs = any(
@@ -247,28 +262,9 @@ class Binder:
         # (any other LIMIT >= 1 can't change existence — ignored)
 
         subplan, sub_scope, _ = self._bind_from(q.from_, None)
-        sub_conjs = _split_and(q.where)
-        inner_only, corr_pairs, outer_only = [], [], []
-        for c in sub_conjs:
-            refs = _name_refs(c)
-            if not refs or all(_in_scope(p, sub_scope) for p in refs):
-                inner_only.append(c)   # constants filter inner rows uniformly
-                continue
-            if refs and all(_in_scope(p, scope) for p in refs):
-                outer_only.append(c)   # exists(P_outer AND Q) = P_outer AND exists(Q)
-                continue
-            if isinstance(c, A.Bin) and c.op == "=":
-                lrefs, rrefs = _name_refs(c.left), _name_refs(c.right)
-                l_outer = lrefs and all(_in_scope(p, scope) for p in lrefs)
-                r_inner = rrefs and all(_in_scope(p, sub_scope) for p in rrefs)
-                if l_outer and r_inner:
-                    corr_pairs.append((c.left, c.right))
-                    continue
-                r_outer = rrefs and all(_in_scope(p, scope) for p in rrefs)
-                l_inner = lrefs and all(_in_scope(p, sub_scope) for p in lrefs)
-                if r_outer and l_inner:
-                    corr_pairs.append((c.right, c.left))
-                    continue
+        inner_only, corr_pairs, outer_only, bad = _split_correlation(
+            _split_and(q.where), scope, sub_scope)
+        if bad:
             raise SqlError(
                 "only equality correlation with the outer query is supported "
                 "in EXISTS subqueries")
@@ -293,6 +289,100 @@ class Binder:
         if outer_only:
             joined = Filter(joined, self._predicate(_join_and(outer_only), scope))
         return joined
+
+    # ------------------------------------------------------------------
+    # correlated scalar subqueries -> join on grouped aggregate
+    # ------------------------------------------------------------------
+    def _is_correlated(self, q: A.SelectStmt) -> bool:
+        """True if the subquery's WHERE references columns outside its own
+        FROM (cheap probe bind of the sub scope, cached for the rewrite)."""
+        try:
+            _, sub_scope, _ = self._bind_from(q.from_, None)
+        except SqlError:
+            return False
+        self._corr_probe = (id(q), sub_scope)
+        for c in _split_and(q.where):
+            for parts in _name_refs(c):
+                if not _in_scope(parts, sub_scope):
+                    return True
+        return False
+
+    def _bind_corr_scalar(self, cmp_ast: A.Bin, plan: Plan, scope) -> Plan:
+        """Decorrelate ``outer_expr <op> (SELECT agg(...) FROM s WHERE
+        s.k = outer.k ...)`` into: Aggregate(s GROUP BY k) joined to the
+        outer plan on k, then a Filter applying <op> (nodeSubplan ->
+        join+agg rewrite). A missing group means the scalar is NULL and the
+        comparison drops the row — exactly the inner join's behavior — for
+        sum/avg/min/max; a bare count() is 0 over an empty set, so it uses
+        a LEFT join with the NULL count mapped to 0."""
+        from greengage_tpu.planner.logical import Join
+
+        if isinstance(cmp_ast.left, A.ScalarSubquery):
+            sub, outer_ast, flip = cmp_ast.left, cmp_ast.right, True
+        else:
+            sub, outer_ast, flip = cmp_ast.right, cmp_ast.left, False
+        q = sub.query
+        if len(q.items) != 1 or not _contains_agg(q.items[0].expr):
+            raise SqlError(
+                "correlated scalar subqueries must compute one aggregate")
+        if q.group_by or q.having or q.limit is not None or q.offset:
+            raise SqlError(
+                "GROUP BY/HAVING/LIMIT/OFFSET in a correlated scalar "
+                "subquery is not supported")
+        item = q.items[0].expr
+        is_bare_count = (isinstance(item, A.FuncCall) and item.name == "count"
+                         and item.over is None)
+        if not is_bare_count and _contains_count(item):
+            raise SqlError(
+                "expressions over count() in correlated scalar subqueries "
+                "are not supported (count of an empty set is 0, not NULL)")
+        # classify the subquery's conjuncts against the outer scope,
+        # reusing the probe bind's scope from _is_correlated when possible
+        probe = getattr(self, "_corr_probe", None)
+        if probe is not None and probe[0] == id(q):
+            sub_scope = probe[1]
+        else:
+            _, sub_scope, _ = self._bind_from(q.from_, None)
+        inner_only, corr_pairs, outer_only, bad = _split_correlation(
+            _split_and(q.where), scope, sub_scope)
+        if bad:
+            raise SqlError(
+                "only equality correlation is supported in scalar subqueries")
+        if not corr_pairs:
+            raise SqlError("scalar subquery correlation not recognized")
+        if outer_only and is_bare_count:
+            raise SqlError(
+                "outer-only predicates in a correlated count() subquery are "
+                "not supported")
+        # grouped aggregate over the correlation keys
+        sub_stmt = A.SelectStmt(
+            items=[A.SelectItem(q.items[0].expr, alias="__sv")]
+            + [A.SelectItem(ie, alias=f"__ck{i}")
+               for i, (_, ie) in enumerate(corr_pairs)],
+            from_=q.from_,
+            where=_join_and(inner_only),
+            group_by=[ie for _, ie in corr_pairs],
+        )
+        subplan, subouts = self._bind_select(sub_stmt)
+        val_ci, key_cis = subouts[0], subouts[1:]
+        lks = [self._expr(o, scope) for o, _ in corr_pairs]
+        rks = [_colref(ci) for ci in key_cis]
+        lks, rks = self._align_join_keys(lks, rks)
+        joined = Join("left" if is_bare_count else "inner",
+                      plan, subplan, lks, rks)
+        outer_e = self._expr(outer_ast, scope)
+        sub_e = _colref(val_ci)
+        if is_bare_count:
+            # count over an empty correlated set is 0, not NULL
+            sub_e = E.Case(
+                whens=((E.IsNull(sub_e), E.Literal(0, T.INT64)),),
+                else_=sub_e, type=T.INT64)
+        le, re_ = (sub_e, outer_e) if flip else (outer_e, sub_e)
+        le, re_ = self._coerce_pair(le, re_)
+        out = Filter(joined, E.Cmp(cmp_ast.op, le, re_))
+        if outer_only:
+            out = Filter(out, self._predicate(_join_and(outer_only), scope))
+        return out
 
     # ------------------------------------------------------------------
     # window functions
@@ -984,6 +1074,45 @@ def _contains_agg(ast) -> bool:
             ast.name in ("count", "sum", "avg", "min", "max"):
         return True
     return any(_contains_agg(c) for c in _ast_children(ast))
+
+
+def _contains_count(ast) -> bool:
+    if isinstance(ast, A.FuncCall) and ast.over is None and ast.name == "count":
+        return True
+    return any(_contains_count(c) for c in _ast_children(ast))
+
+
+def _split_correlation(conjuncts, outer_scope, sub_scope):
+    """Classify a subquery's WHERE conjuncts relative to the outer scope:
+    -> (inner_only, corr_pairs [(outer_ast, inner_ast)], outer_only, bad)."""
+    inner_only, corr_pairs, outer_only, bad = [], [], [], []
+    for c in conjuncts:
+        refs = _name_refs(c)
+        # innermost scope wins (SQL scoping): anything resolvable fully
+        # inside the subquery is an inner predicate
+        if not refs or all(_in_scope(p, sub_scope) for p in refs):
+            inner_only.append(c)
+            continue
+        # equality with one side inner-resolvable and the other only
+        # outer-resolvable = correlation (checked before outer_only so
+        # tables appearing in both scopes classify as correlation)
+        if isinstance(c, A.Bin) and c.op == "=":
+            lrefs, rrefs = _name_refs(c.left), _name_refs(c.right)
+            l_inner = lrefs and all(_in_scope(p, sub_scope) for p in lrefs)
+            r_inner = rrefs and all(_in_scope(p, sub_scope) for p in rrefs)
+            l_outer = lrefs and all(_in_scope(p, outer_scope) for p in lrefs)
+            r_outer = rrefs and all(_in_scope(p, outer_scope) for p in rrefs)
+            if l_inner and not r_inner and r_outer:
+                corr_pairs.append((c.right, c.left))
+                continue
+            if r_inner and not l_inner and l_outer:
+                corr_pairs.append((c.left, c.right))
+                continue
+        if refs and all(_in_scope(p, outer_scope) for p in refs):
+            outer_only.append(c)
+            continue
+        bad.append(c)
+    return inner_only, corr_pairs, outer_only, bad
 
 
 def _contains_window(ast) -> bool:
