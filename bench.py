@@ -24,8 +24,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-SF = float(os.environ.get("GGTPU_BENCH_SF", "0.5"))
-RUNS = int(os.environ.get("GGTPU_BENCH_RUNS", "5"))
+SF = float(os.environ.get("GGTPU_BENCH_SF", "1"))
+RUNS = int(os.environ.get("GGTPU_BENCH_RUNS", "11"))  # best-of; per-call
+# latency through tunneled device transports jitters, so take more samples
 
 Q1 = """
 select l_returnflag, l_linestatus,
