@@ -13,7 +13,6 @@ from dataclasses import dataclass, field
 @dataclass
 class Settings:
     # hash table sizing (execHHashagg spill analog: retry tiers instead)
-    hash_table_load: float = 0.25       # target load factor for slot tables
     hash_num_probes: int = 16           # probe rounds before overflow
     hash_table_min: int = 256
     hash_table_max: int = 1 << 22

@@ -92,11 +92,15 @@ class Compiler:
         below = plan.child
         self._dict_refs: dict[str, tuple] = {}
         _collect_dict_refs(plan, self._dict_refs)
-        # host-side limit/merge bookkeeping
+        # host-side limit/merge bookkeeping: ONLY the Limit directly below
+        # the gather gets its OFFSET trimmed on the host; buried Limits must
+        # drop their offset prefix on device (_c_limit)
         host_limit = None
+        self._host_limit_node = None
         node = below
         if isinstance(node, Limit):
             host_limit = (node.limit, node.offset)
+            self._host_limit_node = id(node)
 
         self._collect_scans(below)
         input_spec = []
@@ -224,7 +228,14 @@ class Compiler:
                 for dom in dense:
                     d *= dom
                 return d
-            return self._agg_table_size(plan)
+            # sort-based path: output capacity = estimated group count with
+            # slack; can never exceed the child batch (groups <= rows), and
+            # an exact-count retry tightens it after overflow
+            child_cap = self._capacity_of(plan.child)
+            if id(plan) in self.cap_overrides:
+                return min(max(int(self.cap_overrides[id(plan)]), 64), child_cap)
+            est = int(max(plan.est_rows, 16.0) * 1.3) + 64
+            return min(est * (4 ** self.tier), child_cap)
         if isinstance(plan, Union):
             return sum(self._capacity_of(c) for c in plan.inputs)
         if isinstance(plan, Motion):
@@ -240,11 +251,6 @@ class Compiler:
         c = int(child_cap * self.s.motion_capacity_slack / self.nseg) + 64
         c *= 4 ** self.tier
         return min(c, child_cap)
-
-    def _agg_table_size(self, plan: Aggregate) -> int:
-        est = max(plan.est_rows, 16.0) / max(self.s.hash_table_load, 0.05)
-        m = _pow2(est) * (4 ** self.tier)
-        return max(self.s.hash_table_min, min(m, self.s.hash_table_max))
 
     def _dense_domains(self, plan: Aggregate) -> list[int] | None:
         """Per-key dense domains (|dict|+1 / bool 3) when every group key has
@@ -492,16 +498,24 @@ class Compiler:
     def _c_aggregate(self, plan: Aggregate):
         child_fn = self._compile_node(plan.child)
         dense = self._dense_domains(plan) if plan.group_keys else None
+        use_sort = bool(plan.group_keys) and dense is None
         if dense is not None:
             M = 1
             for dom in dense:
                 M *= dom
         else:
-            M = self._agg_table_size(plan) if plan.group_keys else 1
-        probes = self.s.hash_num_probes
-        fid = f"agg_overflow_{len(self.flags)}"
-        if plan.group_keys and dense is None:
+            M = 1
+        child_cap = self._capacity_of(plan.child) if use_sort else None
+        out_cap = self._capacity_of(plan) if use_sort else None
+        fid = mid = None
+        if use_sort and out_cap < child_cap:
+            # output capacity below the theoretical max: group count can
+            # overflow it; the device reports the exact count for the retry
+            fid = f"agg_overflow_{len(self.flags)}"
             self.flags.append(fid)
+            mid = f"agg_groups_{len(self.metrics)}"
+            self.metrics.append(mid)
+            self.flag_caps[fid] = (id(plan), mid)
         keys = plan.group_keys
         aggs = plan.aggs
         phase = plan.phase
@@ -510,10 +524,11 @@ class Compiler:
             b = child_fn(ctx)
             sel = b.selection()
             gid = None
+            perm = None
+            cols, valids = {}, {}
             if keys and dense is not None:
                 kspecs = self._key_specs(b, [e for _, e in keys])
                 gid, _ = agg_ops.dense_gid(kspecs, dense, sel)
-                slots = gid
                 decoded = agg_ops.dense_decode_keys(kspecs, dense, M)
                 tkeys = [code for code, _ in decoded]
                 tvalids = [valid for _, valid in decoded]
@@ -521,10 +536,18 @@ class Compiler:
                     sel[:, None] & (gid[:, None] == jnp.arange(M, dtype=jnp.int32)[None, :]),
                     axis=0)
             elif keys:
+                # sort-based high-cardinality grouping (execHHashagg spill
+                # regime analog): sort by keys, segmented reduce, boundary
+                # rows are the group representatives
                 kspecs = self._key_specs(b, [e for _, e in keys])
-                slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
-                    kspecs, sel, M, probes)
-                ctx["flags"].append((fid, overflow))
+                perm, boundary, sel_sorted = agg_ops.group_sort(kspecs, sel)
+                starts, ends = agg_ops.group_spans(boundary)
+                used = boundary
+                for (ci, _), sp in zip(keys, kspecs):
+                    cols[ci.id] = sp.values[perm]
+                    if sp.valid is not None:
+                        valids[ci.id] = sp.valid[perm]
+                tkeys, tvalids = [], []
             else:
                 slots = jnp.where(sel, 0, 1)
                 used = jnp.ones((1,), dtype=bool)
@@ -532,7 +555,6 @@ class Compiler:
 
             Mx = M
             ev = Evaluator(b, self.consts)
-            cols, valids = {}, {}
             for (ci, _), tk, tv in zip(keys, tkeys, tvalids):
                 cols[ci.id] = tk
                 if tv is not None:
@@ -541,6 +563,13 @@ class Compiler:
             def do_agg(specs):
                 if gid is not None:
                     return agg_ops.dense_aggregate(gid, Mx, specs, sel)
+                if perm is not None:
+                    ps = [agg_ops.AggSpec(
+                        s.name, s.func,
+                        None if s.values is None else s.values[perm],
+                        None if s.valid is None else s.valid[perm],
+                        s.decimal_scale) for s in specs]
+                    return agg_ops.sorted_aggregate(starts, ends, sel_sorted, ps)
                 return agg_ops.aggregate(slots, Mx, specs, sel)
 
             if phase in ("single", "partial"):
@@ -610,6 +639,16 @@ class Compiler:
                         cols[ci.id] = vals[ci.id]
                         if avalids.get(ci.id) is not None:
                             valids[ci.id] = avalids[ci.id]
+            if perm is not None and out_cap < child_cap:
+                # compact group rows to the front and trim to the estimated
+                # capacity; overflow reports the exact group count so the
+                # retry sizes itself right
+                total = jnp.sum(used.astype(jnp.int64))
+                ctx["flags"].append((fid, total > out_cap))
+                ctx["metrics"].append((mid, total))
+                perm2, sel2 = sort_ops.sort_batch([], used, child_cap)
+                cols, valids = sort_ops.apply_perm(cols, valids, perm2)
+                cols, valids, used = sort_ops.limit(cols, valids, sel2, out_cap)
             return Batch(cols, valids, used)
 
         return run
@@ -787,8 +826,14 @@ class Compiler:
     def _c_limit(self, plan: Limit):
         child_fn = self._compile_node(plan.child)
         cap = self._capacity_of(plan.child)
-        k = min(cap, (plan.limit or cap) + plan.offset)
+        # LIMIT 0 is a real limit ('or' would treat 0 as no-limit and
+        # disagree with _capacity_of's 'is not None' — advisor finding r1)
+        k = min(cap, (cap if plan.limit is None else plan.limit) + plan.offset)
         compacted = isinstance(plan.child, Sort)
+        # a buried Limit (not the host-trimmed one below the gather) must
+        # drop its OFFSET prefix itself: rows are compacted live-first, so
+        # masking the first `offset` positions removes exactly those rows
+        device_offset = plan.offset if id(plan) != self._host_limit_node else 0
 
         def run(ctx):
             b = child_fn(ctx)
@@ -797,6 +842,8 @@ class Compiler:
                 cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
                 b = Batch(cols, valids, sel_sorted)
             cols, valids, sel = sort_ops.limit(b.cols, b.valids, b.selection(), k)
+            if device_offset:
+                sel = sel & (jnp.arange(k, dtype=jnp.int32) >= device_offset)
             return Batch(cols, valids, sel)
 
         return run

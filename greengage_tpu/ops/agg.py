@@ -1,22 +1,18 @@
-"""Vectorized hash aggregation — the execHHashagg.c analog, TPU-first.
+"""Vectorized grouped aggregation — the execHHashagg.c analog, TPU-first.
 
-Instead of a per-tuple spillable hash table (reference:
-src/backend/executor/execHHashagg.c) we build a static power-of-two slot
-table wholly on device:
+Two production regimes (no scatter-heavy hash table — TPU scatters
+serialize on colliding indices):
 
-  1. rows hash their group keys (ops/hashing spec) to a start slot
-  2. P unrolled linear-probe rounds; each round, unresolved rows bid for
-     their current slot with a scatter-min of row index, winners write their
-     actual key values into the table, and every row resolves by *exact*
-     key comparison against the table (null-safe) — no fingerprints, so no
-     collision false-merges, ever
-  3. aggregates reduce with segment_sum/min/max over resolved slots — MXU/
-     VPU-friendly one-pass reductions
+  * DENSE: every group key has a known finite domain (TEXT dictionary /
+    BOOL); gid is a mixed-radix index and every aggregate is one fused
+    masked reduction (the Q1-class fast path).
+  * SORT: unbounded cardinality; rows lax.sort by key and each run reduces
+    with segmented scans. Where the reference spills its hash table to
+    workfiles (execHHashagg.c), this path cannot overflow at all — only the
+    output batch capacity can, which retries via the executor's exact-count
+    tier mechanism.
 
-Rows that fail to resolve within P probes (table too small / pathological
-clustering) raise an ``overflow`` flag; the executor re-runs at the next
-table-size tier (the recompilation-tier strategy from SURVEY.md §7 "hard
-parts" — the workfile-spill analog).
+Scalar (ungrouped) aggregates use ``aggregate`` with a single slot.
 """
 
 from __future__ import annotations
@@ -25,9 +21,7 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from greengage_tpu.ops import hashing
-
-BIG = jnp.iinfo(jnp.int32).max
+BIG = jnp.iinfo(jnp.int32).max   # scatter-min identity (used by ops/join)
 
 
 @dataclass
@@ -49,71 +43,13 @@ class AggSpec:
     decimal_scale: int = 0
 
 
-def _null_eq(a, av, b, bv):
-    """Grouping equality: NULL == NULL (SQL GROUP BY semantics)."""
-    eq = a == b
-    if av is None and bv is None:
-        return eq
-    av_ = av if av is not None else jnp.ones_like(eq)
-    bv_ = bv if bv is not None else jnp.ones_like(eq)
-    return (av_ & bv_ & eq) | (~av_ & ~bv_)
-
-
-def build_slot_table(keys: list[KeySpec], sel, table_size: int, num_probes: int):
-    """Assign each selected row a slot; rows with equal keys share a slot.
-
-    Returns (final_slot int32 [n] with ``table_size`` for dead/unresolved
-    rows, table_keys, table_key_valids, used bool[M], overflow bool scalar).
-    """
-    M = table_size
-    assert M & (M - 1) == 0, "table size must be a power of two"
-    n = sel.shape[0]
-    row_idx = jnp.arange(n, dtype=jnp.int32)
-
-    col_hashes = [
-        hashing.column_hash(k.values, k.valid, k.type, text_lut=k.hash_lut) for k in keys
-    ]
-    h = hashing.row_hash(col_hashes)
-    slot, step = probe_sequence(h, M)
-
-    active = sel
-    final_slot = jnp.full((n,), M, dtype=jnp.int32)
-    used = jnp.zeros((M,), dtype=bool)
-    tkeys = [jnp.zeros((M,), dtype=k.values.dtype) for k in keys]
-    tvalids = [None if k.valid is None else jnp.zeros((M,), dtype=bool) for k in keys]
-
-    for _ in range(num_probes):
-        bids = jnp.full((M,), BIG, dtype=jnp.int32).at[slot].min(
-            jnp.where(active, row_idx, BIG)
-        )
-        newly = (~used) & (bids < BIG)
-        winner = jnp.clip(bids, 0, n - 1)
-        for i, k in enumerate(keys):
-            tkeys[i] = jnp.where(newly, k.values[winner], tkeys[i])
-            if tvalids[i] is not None:
-                tvalids[i] = jnp.where(newly, k.valid[winner], tvalids[i])
-        used = used | newly
-        # exact match against table contents at my current slot
-        match = active & used[slot]
-        for i, k in enumerate(keys):
-            tv = tvalids[i][slot] if tvalids[i] is not None else None
-            match = match & _null_eq(k.values, k.valid, tkeys[i][slot], tv)
-        final_slot = jnp.where(match, slot, final_slot)
-        active = active & ~match
-        slot = (slot + step) & (M - 1)
-
-    return final_slot, tkeys, tvalids, used, jnp.any(active)
-
-
 # ---------------------------------------------------------------------------
 # Dense path: small known key domains (TEXT dictionaries / BOOL)
 #
-# TPU scatters serialize on colliding indices, so the generic slot table
-# costs ~70ns/row. When every group key has a finite known domain we skip
-# hashing/probing entirely: gid = mixed-radix index over (code+1) digits
-# (0 = NULL), and every aggregate is a fused masked reduction over a
-# [rows, D] broadcast — one HBM pass, VPU-only, no scatter/gather.
-# This is the Q1-class fast path; high-cardinality keys use the slot table.
+# gid = mixed-radix index over (code+1) digits (0 = NULL), and every
+# aggregate is a fused masked reduction over a [rows, D] broadcast — one
+# HBM pass, VPU-only, no scatter/gather. This is the Q1-class fast path;
+# high-cardinality keys use the sort path below.
 # ---------------------------------------------------------------------------
 
 
@@ -158,19 +94,27 @@ def _masked_reduce(op, vals, gid, D, mask, ident):
     return op(filled, axis=0)
 
 
-def dense_aggregate(gid, D: int, aggs: list[AggSpec], sel):
-    """aggregate() semantics over dense group ids (see aggregate)."""
+def _run_aggs(aggs: list[AggSpec], sel, seg_sum, seg_minmax):
+    """The per-function aggregate semantics, shared by every grouping
+    regime. The reduce primitives are injected:
+
+      seg_sum(masked_vals) -> per-group sums (inputs pre-masked to 0)
+      seg_minmax(filled_vals, func, ident) -> per-group min/max
+        (inputs pre-filled with the identity at dead/NULL rows)
+
+    Semantics kept in ONE place: count(*)/count ignore NULLs per column;
+    sum of no rows is NULL; avg = float64 sum/count descaled by the decimal
+    scale; min/max of no rows is NULL.
+    """
     out_vals: dict[str, jnp.ndarray] = {}
     out_valid: dict[str, jnp.ndarray] = {}
     counts_cache: dict = {}
-    iotaD = jnp.arange(D, dtype=jnp.int32)
 
     def live_count(spec):
         key = None if spec is None or spec.valid is None else id(spec.valid)
         if key not in counts_cache:
             lv = sel if spec is None or spec.valid is None else (sel & spec.valid)
-            onehot = lv[:, None] & (gid[:, None] == iotaD[None, :])
-            counts_cache[key] = jnp.sum(onehot.astype(jnp.int64), axis=0)
+            counts_cache[key] = seg_sum(lv.astype(jnp.int64))
         return counts_cache[key]
 
     group_count = live_count(None)
@@ -187,11 +131,11 @@ def dense_aggregate(gid, D: int, aggs: list[AggSpec], sel):
         vals = spec.values
         if spec.func in ("sum", "avg"):
             acc = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
-            s = _masked_reduce(jnp.sum, vals.astype(acc), gid, D, lv, acc(0))
+            s = seg_sum(jnp.where(lv, vals.astype(acc), acc(0)))
             cnt = live_count(spec)
             if spec.func == "sum":
                 out_vals[spec.name] = s
-                out_valid[spec.name] = cnt > 0
+                out_valid[spec.name] = cnt > 0   # SQL: sum of no rows is NULL
             else:
                 denom = jnp.where(cnt == 0, jnp.int64(1), cnt).astype(jnp.float64)
                 avg = s.astype(jnp.float64) / denom
@@ -205,12 +149,142 @@ def dense_aggregate(gid, D: int, aggs: list[AggSpec], sel):
             else:
                 info = jnp.iinfo(vals.dtype)
                 ident = jnp.array(info.max if spec.func == "min" else info.min, vals.dtype)
-            op = jnp.min if spec.func == "min" else jnp.max
-            out_vals[spec.name] = _masked_reduce(op, vals, gid, D, lv, ident)
+            filled = jnp.where(lv, vals, ident)
+            out_vals[spec.name] = seg_minmax(filled, spec.func, ident)
             out_valid[spec.name] = live_count(spec) > 0
         else:
             raise NotImplementedError(spec.func)
     return out_vals, out_valid
+
+
+def dense_aggregate(gid, D: int, aggs: list[AggSpec], sel):
+    """aggregate() semantics over dense group ids."""
+    def seg_sum(masked):
+        sel2 = gid[:, None] == jnp.arange(D, dtype=jnp.int32)[None, :]
+        return jnp.sum(jnp.where(sel2, masked[:, None], masked.dtype.type(0)), axis=0)
+
+    def seg_minmax(filled, func, ident):
+        op = jnp.min if func == "min" else jnp.max
+        return _masked_reduce(op, filled, gid, D, jnp.ones_like(sel), ident)
+
+    return _run_aggs(aggs, sel, seg_sum, seg_minmax)
+
+
+# ---------------------------------------------------------------------------
+# Sort-based grouping: the high-cardinality path.
+#
+# The reference spills its hybrid hash agg to workfiles when the table
+# overflows (src/backend/executor/execHHashagg.c); on TPU the scatter-heavy
+# slot table serializes on colliding indices, so past the dense-domain
+# regime we lax.sort rows by their group keys and reduce each run with
+# segmented cumsum-diffs and scans — O(n log n), fully vectorized, no
+# scatter, and cardinality bounded only by the batch itself (a GROUP BY can
+# never produce more groups than input rows, so nothing ever "overflows"
+# the way a hash table does; only the *output capacity* chosen for the
+# batch above can, which retries via the executor's tier mechanism).
+# ---------------------------------------------------------------------------
+
+
+def _group_encode(k: KeySpec) -> list:
+    """Equality-preserving uint64 encoding (+ null operand when nullable).
+    Grouping needs equal-keys-adjacent, not collation order, so TEXT groups
+    by dictionary code and float64 only canonicalizes -0.0/NaN."""
+    from greengage_tpu import types as T
+
+    v = k.values
+    if k.type.kind is T.Kind.FLOAT64:
+        v = jnp.where(v == 0.0, 0.0, v)
+        v = jnp.where(jnp.isnan(v), jnp.float64(jnp.nan), v)
+        enc = v.view(jnp.uint64)
+    else:
+        enc = v.astype(jnp.int64).view(jnp.uint64)
+    ops = []
+    if k.valid is not None:
+        ops.append(jnp.where(k.valid, jnp.uint8(1), jnp.uint8(0)))
+        enc = jnp.where(k.valid, enc, jnp.uint64(0))
+    ops.append(enc)
+    return ops
+
+
+def group_sort(keys: list[KeySpec], sel):
+    """Sort rows by group keys, dead rows last.
+
+    -> (perm int32[n], boundary bool[n], sel_sorted bool[n]): perm is the
+    gather permutation (sorted_col = col[perm]); boundary marks the first
+    (live) row of each equal-key run — the group's representative row.
+    """
+    from jax import lax
+
+    n = sel.shape[0]
+    dead = (~sel).astype(jnp.uint8)
+    key_ops = []
+    for k in keys:
+        key_ops.extend(_group_encode(k))
+    operands = [dead] + key_ops + [jnp.arange(n, dtype=jnp.int32)]
+    sorted_ops = lax.sort(tuple(operands), num_keys=len(operands))
+    perm = sorted_ops[-1]
+    sel_sorted = sorted_ops[0] == 0
+    if key_ops and n > 1:
+        neq = None
+        for s in sorted_ops[1:1 + len(key_ops)]:
+            d = s[1:] != s[:-1]
+            neq = d if neq is None else (neq | d)
+        first = jnp.concatenate([jnp.ones((1,), bool), neq])
+    else:
+        first = jnp.concatenate(
+            [jnp.ones((min(n, 1),), bool), jnp.zeros((max(n - 1, 0),), bool)])
+    return perm, sel_sorted & first, sel_sorted
+
+
+def group_spans(boundary):
+    """-> (starts, ends) int32[n]: for every row, the first/last index of
+    its group's run (window.py's partition machinery)."""
+    from jax import lax
+
+    n = boundary.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = lax.cummax(jnp.where(boundary, idx, 0))
+    ends = (jnp.searchsorted(starts, starts, side="right") - 1).astype(jnp.int32)
+    return starts, ends
+
+
+def sorted_aggregate(starts, ends, sel, aggs: list[AggSpec]):
+    """aggregate() semantics over key-sorted rows: each BOUNDARY row's output
+    holds its whole group's aggregate (other rows hold garbage — the caller
+    masks to boundary rows). All spec arrays must already be key-sorted.
+
+    Reductions are SEGMENTED scans (reset at group boundaries), not a
+    whole-batch cumsum + span difference: the prefix-sum form loses float64
+    precision (and risks int64 overflow for scaled decimals) proportional to
+    the whole batch's magnitude rather than the group's own."""
+    n = sel.shape[0]
+    if n > 1:
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), bool), starts[1:] != starts[:-1]])
+    else:
+        boundary = jnp.ones((n,), bool)
+
+    def seg_sum(masked):
+        return _seg_scan_reset(masked, boundary, jnp.add)[ends]
+
+    def seg_minmax(filled, func, ident):
+        op = jnp.minimum if func == "min" else jnp.maximum
+        return _seg_scan_reset(filled, boundary, op)[ends]
+
+    return _run_aggs(aggs, sel, seg_sum, seg_minmax)
+
+
+def _seg_scan_reset(v, boundary, op):
+    """Segmented running reduce: associative scan resetting at boundaries."""
+    from jax import lax
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, out = lax.associative_scan(combine, (boundary, v))
+    return out
 
 
 def probe_sequence(h, M: int):
@@ -230,102 +304,13 @@ def _seg_sum(vals, slots, M):
 
 
 def aggregate(slots, M: int, aggs: list[AggSpec], sel):
-    """Compute aggregates per slot. Returns ({name: values}, {name: valid})."""
-    out_vals: dict[str, jnp.ndarray] = {}
-    out_valid: dict[str, jnp.ndarray] = {}
-    # memoize per-group live counts per distinct valid mask (shared by
-    # count/sum-validity/avg/min/max for the same column's mask)
-    counts_cache: dict[int, jnp.ndarray] = {}
+    """aggregate() semantics per scatter slot (scalar aggregates use M=1)."""
+    def seg_sum(masked):
+        return _seg_sum(masked, slots, M)
 
-    def live_valid(spec):
-        v = sel
-        if spec.valid is not None:
-            v = v & spec.valid
-        return v
+    def seg_minmax(filled, func, ident):
+        tbl = jnp.full((M + 1,), ident, dtype=filled.dtype)
+        tbl = tbl.at[slots].min(filled) if func == "min" else tbl.at[slots].max(filled)
+        return tbl[:M]
 
-    def live_count(spec):
-        key = None if spec is None or spec.valid is None else id(spec.valid)
-        if key not in counts_cache:
-            lv = sel if spec is None else live_valid(spec)
-            counts_cache[key] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M)
-        return counts_cache[key]
-
-    group_count = live_count(None)
-
-    for spec in aggs:
-        if spec.func == "count_star":
-            out_vals[spec.name] = group_count
-            out_valid[spec.name] = None
-            continue
-        lv = live_valid(spec)
-        if spec.func == "count":
-            out_vals[spec.name] = live_count(spec)
-            out_valid[spec.name] = None
-            continue
-        vals = spec.values
-        if spec.func in ("sum", "avg"):
-            acc_dtype = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
-            s = _seg_sum(jnp.where(lv, vals.astype(acc_dtype), acc_dtype(0)), slots, M)
-            cnt = live_count(spec)
-            if spec.func == "sum":
-                out_vals[spec.name] = s
-                out_valid[spec.name] = cnt > 0   # SQL: sum of no rows is NULL
-            else:
-                denom = jnp.where(cnt == 0, jnp.int64(1), cnt).astype(jnp.float64)
-                avg = s.astype(jnp.float64) / denom
-                if spec.decimal_scale:
-                    avg = avg / (10.0 ** spec.decimal_scale)
-                out_vals[spec.name] = avg
-                out_valid[spec.name] = cnt > 0
-            continue
-        if spec.func in ("min", "max"):
-            if vals.dtype.kind == "f":
-                ident = jnp.array(jnp.inf if spec.func == "min" else -jnp.inf, vals.dtype)
-            else:
-                info = jnp.iinfo(vals.dtype)
-                ident = jnp.array(info.max if spec.func == "min" else info.min, vals.dtype)
-            filled = jnp.where(lv, vals, ident)
-            tbl = jnp.full((M + 1,), ident, dtype=vals.dtype)
-            tbl = tbl.at[slots].min(filled) if spec.func == "min" else tbl.at[slots].max(filled)
-            out_vals[spec.name] = tbl[:M]
-            out_valid[spec.name] = live_count(spec) > 0
-            continue
-        raise NotImplementedError(spec.func)
-    return out_vals, out_valid
-
-
-def merge_partial(slots, M, partial_vals, partial_valids, funcs, sel):
-    """Final phase of two-phase aggregation: combine partial states that were
-    redistributed by group key (cdbgroup.c two-stage agg analog).
-
-    partial state per original agg: count -> sum of counts; sum -> sum of
-    sums; min/max -> min/max of partials; avg carries (sum, count) pairs —
-    handled by the compiler as two partial columns.
-    """
-    out_vals, out_valid = {}, {}
-    for name, func in funcs.items():
-        vals = partial_vals[name]
-        pv = partial_valids.get(name)
-        lv = sel if pv is None else sel & pv
-        if func in ("count", "count_star", "sum"):
-            acc_dtype = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
-            s = _seg_sum(jnp.where(lv, vals.astype(acc_dtype), acc_dtype(0)), slots, M)
-            out_vals[name] = s if func != "count" and func != "count_star" else s.astype(jnp.int64)
-            if func == "sum":
-                out_valid[name] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M) > 0
-            else:
-                out_valid[name] = None
-        elif func in ("min", "max"):
-            if vals.dtype.kind == "f":
-                ident = jnp.array(jnp.inf if func == "min" else -jnp.inf, vals.dtype)
-            else:
-                info = jnp.iinfo(vals.dtype)
-                ident = jnp.array(info.max if func == "min" else info.min, vals.dtype)
-            filled = jnp.where(lv, vals, ident)
-            tbl = jnp.full((M + 1,), ident, dtype=vals.dtype)
-            tbl = tbl.at[slots].min(filled) if func == "min" else tbl.at[slots].max(filled)
-            out_vals[name] = tbl[:M]
-            out_valid[name] = _seg_sum(jnp.where(lv, jnp.int64(1), jnp.int64(0)), slots, M) > 0
-        else:
-            raise NotImplementedError(func)
-    return out_vals, out_valid
+    return _run_aggs(aggs, sel, seg_sum, seg_minmax)

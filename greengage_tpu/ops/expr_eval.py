@@ -135,7 +135,11 @@ class Evaluator:
             if e.op == "/":
                 q = (la.astype(jnp.float64) / (10.0 ** ls)) / jnp.where(
                     ra == 0, jnp.float64(1), ra.astype(jnp.float64) / (10.0 ** rs))
-                res = jnp.round(q * (10.0 ** out.scale)).astype(jnp.int64)
+                # round half AWAY from zero (PG numeric; matches _rescale),
+                # not jnp.round's half-to-even. Division by zero yields NULL
+                # (valid=False below) rather than an error.
+                scaled = q * (10.0 ** out.scale)
+                res = jnp.trunc(scaled + jnp.copysign(0.5, scaled)).astype(jnp.int64)
                 if valid is None:
                     valid = ra != 0
                 else:

@@ -225,7 +225,11 @@ class Planner:
             # partial states -> identical final merge on every segment
             # (SEGMENT_GENERAL result; Gather later reads one segment).
             # Keeps HAVING/projections above it on-device with no host path.
-            if child.locus.kind in (LocusKind.ENTRY, LocusKind.SINGLE_QE,
+            # SINGLE_QE children go through the partial path too: a
+            # single-phase scalar agg marks its output row used on EVERY
+            # segment while the data lives on one, so the gather would
+            # return one row per segment (advisor finding r1).
+            if child.locus.kind in (LocusKind.ENTRY,
                                     LocusKind.SEGMENT_GENERAL):
                 node.phase = "single"
                 node.locus = child.locus

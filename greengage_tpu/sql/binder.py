@@ -614,6 +614,10 @@ class Binder:
                     )
                     tid = self._const(lut)
                     rk = E.Lut(rk, tid, type=T.TEXT)
+                    # translated codes live in the LEFT dictionary's code
+                    # space: motion/join hashing must use the left dict's
+                    # hash LUT (code -1 = absent -> sentinel row)
+                    object.__setattr__(rk, "_dict_ref", ld)
             elif lt != rt:
                 common = T.promote(lt, rt)
                 if lt != common:

@@ -49,9 +49,13 @@ class Dictionary:
         return self._index.get(s, -1)
 
     def hashes(self, seed: int = 0) -> np.ndarray:
-        """Per-entry uint32 distribution hashes (device motion LUT)."""
+        """Per-entry uint32 distribution hashes (device motion LUT), plus
+        one sentinel row (hash 0) so translated code -1 (string absent from
+        this dictionary) negative-indexes onto the sentinel instead of
+        silently hashing as the last real entry."""
         return np.array(
-            [native.hash_bytes(v.encode("utf-8"), seed) for v in self.values],
+            [native.hash_bytes(v.encode("utf-8"), seed) for v in self.values]
+            + [0],
             dtype=np.uint32,
         )
 

@@ -124,25 +124,23 @@ def test_groupby_matches_pandas(n, groups):
     v = rng.integers(-100, 100, n).astype(np.int64)
     sel = rng.random(n) < 0.8
 
-    # executor sizing policy: M >= 4x estimated group count (load <= 0.25)
-    M = 4096
-    slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
-        [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
-         agg_ops.KeySpec(jnp.asarray(k2), None, T.INT32)],
-        jnp.asarray(sel), M, num_probes=8)
-    assert not bool(overflow)
-    vals, valids = agg_ops.aggregate(
-        slots, M,
+    keys = [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
+            agg_ops.KeySpec(jnp.asarray(k2), None, T.INT32)]
+    perm, boundary, sel_sorted = agg_ops.group_sort(keys, jnp.asarray(sel))
+    starts, ends = agg_ops.group_spans(boundary)
+    perm_np = np.asarray(perm)
+    vs = jnp.asarray(v)[perm]
+    vals, valids = agg_ops.sorted_aggregate(
+        starts, ends, sel_sorted,
         [agg_ops.AggSpec("cnt", "count_star", None, None),
-         agg_ops.AggSpec("s", "sum", jnp.asarray(v), None),
-         agg_ops.AggSpec("mn", "min", jnp.asarray(v), None),
-         agg_ops.AggSpec("av", "avg", jnp.asarray(v), None)],
-        jnp.asarray(sel))
+         agg_ops.AggSpec("s", "sum", vs, None),
+         agg_ops.AggSpec("mn", "min", vs, None),
+         agg_ops.AggSpec("av", "avg", vs, None)])
 
-    used_np = np.asarray(used)
+    used_np = np.asarray(boundary)
     got = pd.DataFrame({
-        "k1": np.asarray(tkeys[0])[used_np],
-        "k2": np.asarray(tkeys[1])[used_np],
+        "k1": k1[perm_np][used_np],
+        "k2": k2[perm_np][used_np],
         "cnt": np.asarray(vals["cnt"])[used_np],
         "s": np.asarray(vals["s"])[used_np],
         "mn": np.asarray(vals["mn"])[used_np],
@@ -166,25 +164,31 @@ def test_groupby_null_keys_merge():
     k = np.array([1, 1, 2, 0, 0], dtype=np.int64)
     kv = np.array([True, True, True, False, False])
     sel = np.ones(5, dtype=bool)
-    M = 8
-    slots, tkeys, tvalids, used, overflow = agg_ops.build_slot_table(
+    perm, boundary, sel_sorted = agg_ops.group_sort(
         [agg_ops.KeySpec(jnp.asarray(k), jnp.asarray(kv), T.INT64)],
-        jnp.asarray(sel), M, 4)
-    assert not bool(overflow)
-    assert int(np.asarray(used).sum()) == 3  # groups: 1, 2, NULL
-    vals, _ = agg_ops.aggregate(
-        slots, M, [agg_ops.AggSpec("c", "count_star", None, None)], jnp.asarray(sel))
-    cnts = sorted(np.asarray(vals["c"])[np.asarray(used)].tolist())
+        jnp.asarray(sel))
+    assert int(np.asarray(boundary).sum()) == 3  # groups: 1, 2, NULL
+    starts, ends = agg_ops.group_spans(boundary)
+    vals, _ = agg_ops.sorted_aggregate(
+        starts, ends, sel_sorted,
+        [agg_ops.AggSpec("c", "count_star", None, None)])
+    cnts = sorted(np.asarray(vals["c"])[np.asarray(boundary)].tolist())
     assert cnts == [1, 2, 2]
 
 
-def test_groupby_overflow_flag():
-    # 64 distinct keys into an 8-slot table: must flag, not corrupt
-    k = np.arange(64, dtype=np.int64)
-    slots, _, _, _, overflow = agg_ops.build_slot_table(
-        [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)],
-        jnp.ones(64, dtype=bool), 8, 4)
-    assert bool(overflow)
+def test_groupby_dead_rows_excluded():
+    # dead rows must neither form groups nor leak into neighbors' aggregates
+    k = np.array([5, 5, 7, 7, 9], dtype=np.int64)
+    sel = np.array([True, False, True, True, False])
+    perm, boundary, sel_sorted = agg_ops.group_sort(
+        [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)], jnp.asarray(sel))
+    assert int(np.asarray(boundary).sum()) == 2  # groups 5 and 7 only
+    starts, ends = agg_ops.group_spans(boundary)
+    v = jnp.asarray(np.array([1, 100, 2, 3, 100], dtype=np.int64))[perm]
+    vals, _ = agg_ops.sorted_aggregate(
+        starts, ends, sel_sorted, [agg_ops.AggSpec("s", "sum", v, None)])
+    got = sorted(np.asarray(vals["s"])[np.asarray(boundary)].tolist())
+    assert got == [1, 5]
 
 
 # ---------------------------------------------------------------------------

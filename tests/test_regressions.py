@@ -1,0 +1,129 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Each test pins a verified bug: scalar aggregate over a SINGLE_QE child,
+cross-table TEXT equi-joins, LIMIT 0, the dictionary hash sentinel row, and
+DECIMAL division rounding.
+"""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.storage.dictionary import Dictionary
+from greengage_tpu.utils import tpch
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    tpch.load(d, sf=0.002)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# high: scalar aggregate over a SINGLE_QE child (top-N-then-aggregate)
+# ---------------------------------------------------------------------------
+
+def test_scalar_agg_over_subquery_limit(db):
+    r = db.sql("select count(*) from "
+               "(select l_orderkey from lineitem order by l_orderkey limit 2) q")
+    assert r.rows() == [(2,)]
+
+
+def test_scalar_agg_over_subquery_limit_sum(db):
+    sub = db.sql("select l_orderkey from lineitem order by l_orderkey, l_linenumber limit 3")
+    want = sum(row[0] for row in sub.rows())
+    r = db.sql("select sum(l_orderkey), count(*), min(l_orderkey) from "
+               "(select l_orderkey, l_linenumber from lineitem "
+               "order by l_orderkey, l_linenumber limit 3) q")
+    assert r.rows() == [(want, 3, sub.rows()[0][0])]
+
+
+# ---------------------------------------------------------------------------
+# high: cross-table TEXT equi-join (translated codes need the left dict LUT)
+# ---------------------------------------------------------------------------
+
+def test_cross_table_text_join(db):
+    db.sql("create table txj_a (k text, v int) distributed by (k);"
+           "create table txj_b (k text, w int) distributed by (k)")
+    db.sql("insert into txj_a values ('apple', 1), ('pear', 2), ('plum', 3)")
+    # 'kiwi' is absent from txj_a's dictionary -> translated code -1
+    db.sql("insert into txj_b values ('pear', 10), ('apple', 20), ('kiwi', 30)")
+    r = db.sql("select a.k, a.v, b.w from txj_a a join txj_b b on a.k = b.k "
+               "order by a.k")
+    assert r.rows() == [("apple", 1, 20), ("pear", 2, 10)]
+    # and with the text key flowing through a redistribute motion (group by)
+    r = db.sql("select a.k, count(*) from txj_a a join txj_b b on a.k = b.k "
+               "group by a.k order by a.k")
+    assert r.rows() == [("apple", 1), ("pear", 1)]
+
+
+# ---------------------------------------------------------------------------
+# medium: LIMIT 0
+# ---------------------------------------------------------------------------
+
+def test_limit_zero_toplevel(db):
+    r = db.sql("select l_orderkey from lineitem limit 0")
+    assert len(r) == 0
+    assert r.rows() == []
+
+
+def test_limit_zero_derived(db):
+    r = db.sql("select count(*) from (select l_orderkey from lineitem limit 0) q")
+    assert r.rows() == [(0,)]
+
+
+def test_buried_limit_offset(db):
+    """A LIMIT/OFFSET inside a derived table must drop the offset prefix on
+    device (no host trim applies there) — r2 code-review finding."""
+    r = db.sql("select o_orderkey from "
+               "(select o_orderkey from orders order by o_orderkey "
+               " limit 5 offset 3) q order by o_orderkey")
+    assert [row[0] for row in r.rows()] == [4, 5, 6, 7, 8]
+    r = db.sql("select count(*) from "
+               "(select o_orderkey from orders order by o_orderkey "
+               " limit 5 offset 3) q")
+    assert r.rows() == [(5,)]
+    # offset with no limit
+    r = db.sql("select count(*) from "
+               "(select o_orderkey from orders order by o_orderkey offset 10) q")
+    total = db.sql("select count(*) from orders").rows()[0][0]
+    assert r.rows() == [(total - 10,)]
+
+
+# ---------------------------------------------------------------------------
+# low: dictionary hash LUT sentinel row for code -1
+# ---------------------------------------------------------------------------
+
+def test_dictionary_hash_sentinel():
+    d = Dictionary(["a", "b", "c"])
+    h = d.hashes()
+    assert len(h) == len(d) + 1
+    # code -1 must hit the sentinel (0), not wrap to the last real entry
+    assert h[-1] == 0
+    codes = np.array([0, 2, -1], dtype=np.int32)
+    picked = h[codes]
+    assert picked[2] == 0 and picked[1] == h[2]
+
+
+# ---------------------------------------------------------------------------
+# low: DECIMAL division rounds half away from zero (PG numeric semantics)
+# ---------------------------------------------------------------------------
+
+def test_decimal_division_rounding(db):
+    db.sql("create table decdiv (k int, q decimal(12,2)) distributed by (k)")
+    db.sql("insert into decdiv values (1, 1.00), (2, 5.00), (3, -1.00)")
+    # result scale is max(sa, 6); these quotients land EXACTLY on .5 at the
+    # 6th fractional digit in float64 (verified): 1.00/2000000*1e6 == 0.5,
+    # 5.00/2000000*1e6 == 2.5. Half-away-from-zero rounds them up;
+    # half-to-even (the old jnp.round) would give 0 and 2.
+    r = db.sql("select k, q / 2000000 from decdiv order by k")
+    got = [row[1] for row in r.rows()]
+    assert abs(got[0] - 1e-6) < 1e-12, got
+    assert abs(got[1] - 3e-6) < 1e-12, got
+    assert abs(got[2] - (-1e-6)) < 1e-12, got
+
+
+def test_decimal_division_by_zero_is_null(db):
+    r = db.sql("select k, q / 0 from decdiv order by k")
+    assert all(row[1] is None for row in r.rows())
