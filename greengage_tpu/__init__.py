@@ -34,6 +34,21 @@ jax.config.update("jax_enable_x64", True)
 if os.environ.get("GGTPU_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["GGTPU_PLATFORM"])
 
+# Persistent XLA compilation cache: query programs are compiled per
+# (plan shape, capacity tier); on TPU a single lax.sort costs ~25s to
+# compile, so re-sessions (CLI invocations, bench reruns, server restarts)
+# must reuse executables from disk — the "gang reuse across sessions"
+# analog. GGTPU_XLA_CACHE=0 disables.
+_cache = os.environ.get(
+    "GGTPU_XLA_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "ggtpu_xla"))
+if _cache and _cache != "0":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
 __version__ = "0.1.0"
 
 from greengage_tpu.api import Database, connect  # noqa: E402,F401

@@ -7,10 +7,13 @@ re-exported here.
 from greengage_tpu.exec.session import Database  # noqa: F401
 
 
-def connect(path: str | None = None, numsegments: int | None = None) -> "Database":
+def connect(path: str | None = None, numsegments: int | None = None,
+            mirrors: bool = False) -> "Database":
     """Open (or create) a database.
 
     path=None gives an in-memory single-host cluster; numsegments defaults to
     the number of visible JAX devices (each segment binds to one chip).
+    mirrors=True creates a mirror per segment (replicated on every committed
+    write; promoted by FTS on primary failure).
     """
-    return Database(path=path, numsegments=numsegments)
+    return Database(path=path, numsegments=numsegments, mirrors=mirrors)

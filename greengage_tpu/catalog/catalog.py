@@ -18,9 +18,10 @@ from greengage_tpu.catalog.segments import SegmentConfig
 
 
 class Catalog:
-    def __init__(self, numsegments: int, path: str | None = None):
+    def __init__(self, numsegments: int, path: str | None = None,
+                 mirrors: bool = False):
         self.tables: dict[str, TableSchema] = {}
-        self.segments = SegmentConfig.create(numsegments)
+        self.segments = SegmentConfig.create(numsegments, with_mirrors=mirrors)
         self.path = path  # cluster dir; None = in-memory only
 
     # ---- table DDL -----------------------------------------------------
@@ -58,6 +59,7 @@ class Catalog:
             return
         data = {
             "numsegments": self.segments.numsegments,
+            "segments": self.segments.to_dict(),
             "tables": {n: t.to_dict() for n, t in self.tables.items()},
         }
         os.makedirs(self.path, exist_ok=True)
@@ -73,6 +75,8 @@ class Catalog:
         with open(os.path.join(path, "catalog.json")) as f:
             data = json.load(f)
         cat = Catalog(data["numsegments"], path=path)
+        if "segments" in data:
+            cat.segments = SegmentConfig.from_dict(data["segments"])
         for n, t in data["tables"].items():
             cat.tables[n] = TableSchema.from_dict(t)
         return cat

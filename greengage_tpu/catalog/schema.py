@@ -53,6 +53,7 @@ class TableSchema:
     columns: list[Column]
     policy: DistPolicy
     options: dict = field(default_factory=dict)  # e.g. compresstype, blocksize
+    stats: object = None   # planner.stats.TableStats from ANALYZE (or None)
 
     def __post_init__(self):
         names = [c.name for c in self.columns]
@@ -90,6 +91,7 @@ class TableSchema:
                 "numsegments": self.policy.numsegments,
             },
             "options": self.options,
+            **({"stats": self.stats.to_dict()} if self.stats is not None else {}),
         }
 
     @staticmethod
@@ -100,4 +102,9 @@ class TableSchema:
         ]
         p = d["policy"]
         policy = DistPolicy(PolicyKind(p["kind"]), tuple(p.get("keys", ())), p.get("numsegments", 0))
-        return TableSchema(d["name"], cols, policy, d.get("options", {}))
+        schema = TableSchema(d["name"], cols, policy, d.get("options", {}))
+        if "stats" in d:
+            from greengage_tpu.planner.stats import TableStats
+
+            schema.stats = TableStats.from_dict(d["stats"])
+        return schema

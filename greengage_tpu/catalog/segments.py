@@ -55,7 +55,50 @@ class SegmentConfig:
                 SegmentEntry(c, SegmentRole.PRIMARY, SegmentRole.PRIMARY, device_index=c)
             )
             if with_mirrors:
-                cfg.entries.append(SegmentEntry(c, SegmentRole.MIRROR, SegmentRole.MIRROR))
+                # a new mirror holds no data: not in sync until the first
+                # replication pass completes (runtime/replication.py)
+                cfg.entries.append(SegmentEntry(
+                    c, SegmentRole.MIRROR, SegmentRole.MIRROR, mode_synced=False))
+        return cfg
+
+    def acting_primary(self, content: int) -> "SegmentEntry | None":
+        """The entry currently serving reads/writes for this content (may be
+        a promoted mirror)."""
+        for e in self.entries:
+            if e.content == content and e.role is SegmentRole.PRIMARY:
+                return e
+        return None
+
+    def has_mirrors(self) -> bool:
+        return any(e.content >= 0 and (e.role is SegmentRole.MIRROR or
+                                       e.preferred_role is SegmentRole.MIRROR)
+                   for e in self.entries)
+
+    # ---- persistence (part of the catalog; gp_segment_configuration is a
+    # catalog table in the reference) --------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "numsegments": self.numsegments,
+            "version": self.version,
+            "entries": [
+                {"content": e.content, "role": e.role.value,
+                 "preferred_role": e.preferred_role.value,
+                 "status": e.status.value, "synced": e.mode_synced,
+                 "host": e.host, "device_index": e.device_index}
+                for e in self.entries
+            ],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "SegmentConfig":
+        cfg = SegmentConfig(numsegments=d["numsegments"])
+        cfg.version = d.get("version", 0)
+        for e in d.get("entries", []):
+            cfg.entries.append(SegmentEntry(
+                e["content"], SegmentRole(e["role"]),
+                SegmentRole(e["preferred_role"]), SegmentStatus(e["status"]),
+                e.get("synced", True), e.get("host", "localhost"),
+                e.get("device_index")))
         return cfg
 
     def expand(self, new_numsegments: int) -> None:

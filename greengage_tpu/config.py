@@ -12,8 +12,10 @@ from dataclasses import dataclass, field
 
 @dataclass
 class Settings:
-    # hash table sizing (execHHashagg spill analog: retry tiers instead)
-    hash_num_probes: int = 16           # probe rounds before overflow
+    # hash table sizing; probe rounds are unrolled in the compiled program
+    # and each costs a full-batch gather pass (~64ms/6M rows on v5e), so
+    # rounds are few and a miss retries at a bigger/looser table tier
+    hash_num_probes: int = 8
     hash_table_min: int = 256
     hash_table_max: int = 1 << 22
     # dense group-by path: used when the product of group-key domains
@@ -28,6 +30,10 @@ class Settings:
     # memory protection (gp_vmem_protect_limit analog): estimated device
     # bytes a single query may allocate; 0 disables the check
     vmem_protect_limit_mb: int = 12288
+    # synchronous mirror replication after each committed write (the
+    # synchronous_standby_names / syncrep gate analog); off = mirrors go
+    # stale and are barred from promotion until `gg replicate`
+    mirror_sync: bool = True
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1

@@ -85,6 +85,7 @@ class Compiler:
         self.flag_caps: dict = {}
         self.scan_caps: dict[str, int] = {}
         self.scan_cols: dict[str, set] = {}
+        self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
 
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
@@ -110,7 +111,8 @@ class Compiler:
                 cols.append(c)
                 if self.store.has_nulls(t, c):
                     cols.append(VALID_PREFIX + c)
-            input_spec.append((t, cols, self.scan_caps[t]))
+            input_spec.append((t, cols, self.scan_caps[t],
+                               self.scan_direct.get(t)))
 
         compiled = self._compile_node(below)   # closure: ctx -> Batch
         out_cols = below.out_cols()
@@ -120,7 +122,7 @@ class Compiler:
         def seg_fn(*flat):
             ctx = {"tables": {}, "flags": []}
             i = 0
-            for tname, cols, cap in input_spec:
+            for tname, cols, cap, _direct in input_spec:
                 entry = {}
                 for c in cols:
                     entry[c] = flat[i]
@@ -149,7 +151,7 @@ class Compiler:
             jax.shard_map(
                 seg_fn,
                 mesh=self.mesh,
-                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _ in input_spec))),
+                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _, _ in input_spec))),
                 out_specs=tuple(P(SEG_AXIS) for _ in range(nouts)),
                 check_vma=False,
             )
@@ -191,15 +193,24 @@ class Compiler:
     def _collect_scans(self, plan: Plan):
         if isinstance(plan, Scan):
             counts = self.store.segment_rowcounts(plan.table)
-            cap = max(max(counts, default=0), 1)
+            ds = plan.direct_seg
+            if ds is not None and 0 <= ds < len(counts):
+                cap = max(counts[ds], 1)
+            else:
+                cap = max(max(counts, default=0), 1)
             self.scan_caps[plan.table] = max(self.scan_caps.get(plan.table, 0), cap)
             self.scan_cols.setdefault(plan.table, set()).update(c.name for c in plan.cols)
+            # direct dispatch only holds if EVERY scan of the table agrees
+            prev = self.scan_direct.get(plan.table, "unset")
+            self.scan_direct[plan.table] = ds if prev in ("unset", ds) else None
         for c in plan.children:
             self._collect_scans(c)
 
     def _capacity_of(self, plan: Plan) -> int:
         """Static per-segment row capacity of a node's output batch."""
         if isinstance(plan, Scan):
+            if plan.table in self.scan_caps:
+                return self.scan_caps[plan.table]
             counts = self.store.segment_rowcounts(plan.table)
             return max(max(counts, default=0), 1)
         if isinstance(plan, (Filter, Project, Sort, Window)):
@@ -537,16 +548,10 @@ class Compiler:
                     axis=0)
             elif keys:
                 # sort-based high-cardinality grouping (execHHashagg spill
-                # regime analog): sort by keys, segmented reduce, boundary
-                # rows are the group representatives
+                # regime analog): sort by keys, cumsum-span reduce into the
+                # group table; slot g's keys gather from its first row
                 kspecs = self._key_specs(b, [e for _, e in keys])
                 perm, boundary, sel_sorted = agg_ops.group_sort(kspecs, sel)
-                starts, ends = agg_ops.group_spans(boundary)
-                used = boundary
-                for (ci, _), sp in zip(keys, kspecs):
-                    cols[ci.id] = sp.values[perm]
-                    if sp.valid is not None:
-                        valids[ci.id] = sp.valid[perm]
                 tkeys, tvalids = [], []
             else:
                 slots = jnp.where(sel, 0, 1)
@@ -560,6 +565,8 @@ class Compiler:
                 if tv is not None:
                     valids[ci.id] = tv
 
+            meta = {}
+
             def do_agg(specs):
                 if gid is not None:
                     return agg_ops.dense_aggregate(gid, Mx, specs, sel)
@@ -569,7 +576,10 @@ class Compiler:
                         None if s.values is None else s.values[perm],
                         None if s.valid is None else s.valid[perm],
                         s.decimal_scale) for s in specs]
-                    return agg_ops.sorted_aggregate(starts, ends, sel_sorted, ps)
+                    vals, avalids, meta["srcpos"], meta["total"] = \
+                        agg_ops.sorted_group_aggregate(
+                            boundary, sel_sorted, ps, out_cap)
+                    return vals, avalids
                 return agg_ops.aggregate(slots, Mx, specs, sel)
 
             if phase in ("single", "partial"):
@@ -639,16 +649,20 @@ class Compiler:
                         cols[ci.id] = vals[ci.id]
                         if avalids.get(ci.id) is not None:
                             valids[ci.id] = avalids[ci.id]
-            if perm is not None and out_cap < child_cap:
-                # compact group rows to the front and trim to the estimated
-                # capacity; overflow reports the exact group count so the
-                # retry sizes itself right
-                total = jnp.sum(used.astype(jnp.int64))
-                ctx["flags"].append((fid, total > out_cap))
-                ctx["metrics"].append((mid, total))
-                perm2, sel2 = sort_ops.sort_batch([], used, child_cap)
-                cols, valids = sort_ops.apply_perm(cols, valids, perm2)
-                cols, valids, used = sort_ops.limit(cols, valids, sel2, out_cap)
+            if perm is not None:
+                # group g's key values gather from its first sorted row
+                rep = perm[meta["srcpos"]]
+                for (ci, _), sp in zip(keys, kspecs):
+                    cols[ci.id] = sp.values[rep]
+                    if sp.valid is not None:
+                        valids[ci.id] = sp.valid[rep]
+                total = meta["total"]
+                used = jnp.arange(out_cap, dtype=jnp.int32) < total
+                if fid is not None:
+                    # overflow reports the exact group count so the retry
+                    # sizes itself right
+                    ctx["flags"].append((fid, total > out_cap))
+                    ctx["metrics"].append((mid, total.astype(jnp.int64)))
             return Batch(cols, valids, used)
 
         return run
@@ -837,11 +851,14 @@ class Compiler:
 
         def run(ctx):
             b = child_fn(ctx)
-            if not compacted:
-                perm, sel_sorted = sort_ops.sort_batch([], b.selection(), cap)
-                cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
-                b = Batch(cols, valids, sel_sorted)
-            cols, valids, sel = sort_ops.limit(b.cols, b.valids, b.selection(), k)
+            if compacted:
+                cols, valids, sel = sort_ops.limit(
+                    b.cols, b.valids, b.selection(), k)
+            else:
+                # unsorted LIMIT: gather-compact live rows (order-preserving,
+                # no lax.sort) straight into the k-slot output
+                cols, valids, sel = sort_ops.compact(
+                    b.cols, b.valids, b.selection(), k)
             if device_offset:
                 sel = sel & (jnp.arange(k, dtype=jnp.int32) >= device_offset)
             return Batch(cols, valids, sel)

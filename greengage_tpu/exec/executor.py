@@ -151,7 +151,9 @@ class Executor:
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
                     "segments": self.nseg,
-                    "scan_tables": [t for t, _, _ in comp.input_spec],
+                    "scan_tables": [t for t, _, _, _ in comp.input_spec],
+                    "direct_dispatch": {t: d for t, _, _, d in comp.input_spec
+                                        if d is not None},
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
                     "metrics": {k: int(np.max(v)) for k, v in metrics.items()},
@@ -178,14 +180,20 @@ class Executor:
         version = snapshot.get("version", 0)
         for k in [k for k in self._stage_cache if k[3] != version]:
             del self._stage_cache[k]
-        for table, cols, cap in comp.input_spec:
-            key = (table, tuple(cols), cap, version)
+        for table, cols, cap, direct in comp.input_spec:
+            key = (table, tuple(cols), cap, version, direct)
             if key in self._stage_cache:
                 arrays.extend(self._stage_cache[key])
                 continue
             storage_cols = [c for c in cols if not c.startswith(VALID_PREFIX)]
             per_seg = []
             for seg in range(self.nseg):
+                if direct is not None and seg != direct:
+                    # direct dispatch: only the owning segment's storage is
+                    # read/staged (cdbtargeteddispatch.c analog)
+                    per_seg.append(({c: np.empty(0, dtype=np.int64)
+                                     for c in storage_cols}, {}, 0))
+                    continue
                 c, v, n = self.store.read_segment(table, seg, storage_cols, snapshot)
                 per_seg.append((c, v, n))
             staged = []

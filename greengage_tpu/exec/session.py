@@ -29,7 +29,7 @@ from greengage_tpu.storage import TableStore
 
 class Database:
     def __init__(self, path: str | None = None, numsegments: int | None = None,
-                 devices=None):
+                 devices=None, mirrors: bool = False):
         import jax
 
         devs = list(devices) if devices is not None else jax.devices()
@@ -45,7 +45,7 @@ class Database:
         else:
             if numsegments is None:
                 numsegments = len(devs)
-            self.catalog = Catalog(numsegments, path=path)
+            self.catalog = Catalog(numsegments, path=path, mirrors=mirrors)
         self.numsegments = numsegments
         if path is None:
             import tempfile
@@ -64,9 +64,13 @@ class Database:
                                  numsegments, self.settings)
         from greengage_tpu.runtime.dtm import DtmSession
         from greengage_tpu.runtime.fts import FtsProber
+        from greengage_tpu.runtime.replication import Replicator
 
         self.dtm = DtmSession(self.store)
-        self.fts = FtsProber(self.catalog.segments, self.mesh)
+        self.replicator = (Replicator(self.store, self.catalog.segments)
+                           if self.catalog.segments.has_mirrors() else None)
+        self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
+                             on_change=self.catalog._save)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
 
     # ------------------------------------------------------------------
@@ -102,13 +106,23 @@ class Database:
                               ignore_errors=True)
             return "DROP TABLE"
         if isinstance(stmt, A.InsertStmt):
-            return self._insert(stmt)
+            out = self._insert(stmt)
+            self._post_commit()
+            return out
         if isinstance(stmt, A.CopyStmt):
-            return self._copy(stmt)
+            out = self._copy(stmt)
+            self._post_commit()
+            return out
         if isinstance(stmt, A.DeleteStmt):
-            return self._delete(stmt)
+            out = self._delete(stmt)
+            self._post_commit()
+            return out
         if isinstance(stmt, A.UpdateStmt):
-            return self._update(stmt)
+            out = self._update(stmt)
+            self._post_commit()
+            return out
+        if isinstance(stmt, A.AnalyzeStmt):
+            return self._analyze(stmt.table)
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
         if isinstance(stmt, A.SetStmt):
@@ -120,10 +134,49 @@ class Database:
                 return "BEGIN"
             if stmt.action == "commit":
                 self.dtm.commit()
+                self._post_commit()
                 return "COMMIT"
             self.dtm.abort()
             return "ROLLBACK"
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+    def _analyze(self, table: str | None) -> str:
+        """ANALYZE [table]: collect per-column NDV/min-max/null-frac/MCV
+        into the catalog (pg_statistic analog; planner/stats.py)."""
+        from greengage_tpu.planner.stats import analyze_table
+
+        names = [table] if table else list(self.catalog.tables)
+        snap = self.store.manifest.snapshot()
+        for n in names:
+            schema = self.catalog.get(n)
+            schema.stats = analyze_table(self.store, schema, snap)
+        self.catalog._save()
+        self._select_cache.clear()   # fresh stats can change plans
+        return "ANALYZE"
+
+    # ------------------------------------------------------------------
+    def _post_commit(self) -> None:
+        """Synchronous mirror replication after a committed write (the
+        syncrep gate analog): mirrors are copied up to the new manifest
+        version before the statement returns, so FTS can always promote.
+        SET mirror_sync = off trades that away; mirrors then go stale and
+        refresh_sync_state() blocks their promotion."""
+        if self.replicator is None:
+            return
+        if self.dtm.current is not None and getattr(self.dtm.current, "state", "") == "active":
+            return   # still invisible; replicate at COMMIT
+        if self.settings.mirror_sync:
+            self.replicator.sync()
+        else:
+            self.replicator.refresh_sync_state()
+        # persist the topology only when sync state / roles actually moved
+        # (a full catalog save per INSERT would rewrite every table's stats)
+        segs = self.catalog.segments
+        sig = (segs.version, tuple(e.mode_synced for e in segs.entries))
+        if sig != getattr(self, "_cfg_sig", None):
+            self._cfg_sig = sig
+            self.catalog._save()
 
     # ------------------------------------------------------------------
     def _plan(self, stmt, force_multi_join: bool = False):
@@ -297,7 +350,9 @@ class Database:
 
     def load_table(self, table: str, columns: dict, valids: dict | None = None):
         """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
-        return self._write_rows(table, columns, valids)
+        n = self._write_rows(table, columns, valids)
+        self._post_commit()
+        return n
 
     def _copy(self, stmt: A.CopyStmt):
         schema = self.catalog.get(stmt.table)
@@ -496,6 +551,11 @@ class Database:
         moved = {}
         for name in list(self.catalog.tables):
             moved[name] = self.store.rewrite_table(name, new_numsegments)
+        if self.replicator is not None:
+            from greengage_tpu.runtime.replication import Replicator
+
+            self.replicator = Replicator(self.store, self.catalog.segments)
+        self._post_commit()
         return moved
 
     def set(self, name: str, value):

@@ -31,9 +31,40 @@ def cmd_init(args):
     if os.path.exists(os.path.join(args.dir, "catalog.json")):
         print(f"error: cluster already exists at {args.dir}", file=sys.stderr)
         return 1
-    db = _open(args.dir, args.numsegments)
+    import greengage_tpu
+
+    db = greengage_tpu.connect(path=args.dir, numsegments=args.numsegments,
+                               mirrors=getattr(args, "mirrors", False))
     print(f"cluster initialized at {args.dir}: {db.numsegments} segments "
-          f"on {len(list(db.mesh.devices.flat))} devices")
+          f"on {len(list(db.mesh.devices.flat))} devices"
+          + (" with mirrors" if getattr(args, "mirrors", False) else ""))
+    return 0
+
+
+def cmd_replicate(args):
+    """gpaddmirrors/manual sync: bring every mirror to the current manifest
+    version (normally automatic via the mirror_sync setting)."""
+    db = _open(args.dir)
+    if db.replicator is None:
+        print("cluster has no mirrors (re-init with --mirrors)", file=sys.stderr)
+        return 1
+    out = db.replicator.sync()
+    db.catalog._save()
+    for content, v in sorted(out.items()):
+        print(f"  content {content}: mirror at version {v}")
+    print("replication complete")
+    return 0
+
+
+def cmd_analyze(args):
+    """analyzedb analog: refresh planner statistics."""
+    db = _open(args.dir)
+    db.sql(f"analyze {args.table}" if args.table else "analyze")
+    names = [args.table] if args.table else sorted(db.catalog.tables)
+    for n in names:
+        ts = db.catalog.get(n).stats
+        if ts is not None:
+            print(f"  {n}: {ts.rows} rows, {len(ts.columns)} columns analyzed")
     return 0
 
 
@@ -46,10 +77,10 @@ def cmd_state(args):
         print("probe:", json.dumps(results))
     print(f"cluster: {args.dir}  width: {db.numsegments}  "
           f"config version: {db.catalog.segments.version}")
-    print(f"{'content':>8} {'role':>5} {'pref':>5} {'status':>7} {'device':>7}")
+    print(f"{'content':>8} {'role':>5} {'pref':>5} {'status':>7} {'device':>7} {'synced':>7}")
     for row in cluster_state(db.catalog.segments):
         print(f"{row['content']:>8} {row['role']:>5} {row['preferred_role']:>5} "
-              f"{row['status']:>7} {str(row['device']):>7}")
+              f"{row['status']:>7} {str(row['device']):>7} {str(row['synced']):>7}")
     if needs_rebalance(db.catalog.segments):
         print("NOTE: segments are not on their preferred roles (run gg recover)")
     print("tables:")
@@ -84,20 +115,41 @@ def cmd_expand(args):
 
 
 def cmd_recover(args):
+    from greengage_tpu.catalog.segments import SegmentRole
+
     db = _open(args.dir)
     rolled = db.store.manifest.recover()
     if rolled:
         print(f"rolled back in-doubt transactions: versions {rolled}")
-    # rebalance: put segments back on preferred roles (gprecoverseg -r)
     cfg = db.catalog.segments
+    # full recovery (gprecoverseg -F / buildMirrorSegments full rebuild):
+    # any content served by a promoted mirror gets its original primary
+    # tree rebuilt from the mirror's files before roles swap back
+    if db.replicator is not None:
+        for content in range(cfg.numsegments):
+            acting = cfg.acting_primary(content)
+            if acting is not None and acting.preferred_role is SegmentRole.MIRROR:
+                copied = db.replicator.rebuild(content)
+                print(f"  content {content}: rebuilt primary from mirror "
+                      f"({copied} files)")
+    # rebalance: put segments back on preferred roles (gprecoverseg -r)
     changed = 0
     for e in cfg.entries:
         if e.role is not e.preferred_role:
+            # restore the device binding along with the role
             e.role = e.preferred_role
             changed += 1
     if changed:
+        for e in cfg.entries:
+            if e.content >= 0:
+                if e.role is SegmentRole.PRIMARY:
+                    e.device_index = e.content
+                    e.status = type(e.status)("u")
+                else:
+                    e.device_index = None
         cfg.version += 1
         print(f"rebalanced {changed} segments to preferred roles")
+    db.catalog._save()
     print("recovery complete")
     return 0
 
@@ -175,7 +227,8 @@ def cmd_checkcat(args):
             if int(seg) >= schema.policy.numsegments:
                 problems.append(f"{name}: segfiles on seg {seg} beyond width")
             for rel in files:
-                p = os.path.join(args.dir, "data", name, rel)
+                # resolves through per-content roots (mirror failover aware)
+                p = db.store.seg_file_path(name, rel)
                 if not os.path.exists(p):
                     problems.append(f"{name}: missing file {rel}")
         # row counts readable + placement verified per segment
@@ -201,7 +254,17 @@ def main(argv=None):
     p = sub.add_parser("init")
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("-n", "--numsegments", type=int, default=None)
+    p.add_argument("--mirrors", action="store_true")
     p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("replicate")
+    p.add_argument("-d", "--dir", required=True)
+    p.set_defaults(fn=cmd_replicate)
+
+    p = sub.add_parser("analyze")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-t", "--table", default=None)
+    p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser("state")
     p.add_argument("-d", "--dir", required=True)

@@ -92,3 +92,24 @@ def limit(cols: dict, valids: dict, sel, k: int):
     out_c = {n: a[:k] for n, a in cols.items()}
     out_v = {n: (a[:k] if a is not None else None) for n, a in valids.items()}
     return out_c, out_v, sel[:k]
+
+
+def compact(cols: dict, valids: dict, sel, k: int):
+    """Gather the live rows (order preserved) into the first min(live, k)
+    slots of a k-capacity batch — WITHOUT a sort. On TPU a lax.sort costs
+    ~25s of XLA compile time per call site and hundreds of ms at runtime;
+    this is a cumsum + one binary-search gather per column instead:
+    output slot j reads the row where cumsum(sel) first reaches j+1.
+
+    -> (cols, valids, sel_out) with capacity k; rows beyond k are DROPPED
+    (callers pair this with an overflow flag on count > k).
+    """
+    n = sel.shape[0]
+    cs = jnp.cumsum(sel.astype(jnp.int32))
+    total = cs[-1] if n else jnp.int32(0)
+    src = jnp.searchsorted(cs, jnp.arange(1, k + 1, dtype=jnp.int32))
+    src = jnp.clip(src, 0, max(n - 1, 0)).astype(jnp.int32)
+    out_c = {name: a[src] for name, a in cols.items()}
+    out_v = {name: (a[src] if a is not None else None) for name, a in valids.items()}
+    sel_out = jnp.arange(k, dtype=jnp.int32) < total
+    return out_c, out_v, sel_out

@@ -8,8 +8,14 @@ under vectorization. So costs are byte counts:
   broadcast(R)     ~ bytes(R) * nseg     (all_gather replicates everywhere)
   local op(R)      ~ bytes(R)            (one HBM pass)
 
-Row estimates come from storage manifests (exact for scans) and the usual
-selectivity guesses elsewhere (clauselist_selectivity analog).
+Row estimates come from storage manifests (exact for scans) and, after
+ANALYZE, from column statistics (planner/stats.py — the
+clauselist_selectivity / ORCA statistics-calculus analog): equality uses
+MCV frequencies or 1/NDV, ranges interpolate [min, max], GROUP BY takes the
+NDV product, joins divide by the larger key NDV. Without stats the round-1
+constants remain as fallbacks. A mis-estimate here is expensive on TPU —
+each capacity-overflow retry is a full XLA recompile — so stats pay for
+themselves immediately.
 """
 
 from __future__ import annotations
@@ -18,20 +24,86 @@ from greengage_tpu import expr as E
 
 DEFAULT_FILTER_SELECTIVITY = 0.25
 EQ_SELECTIVITY = 0.05
+RANGE_SELECTIVITY = 0.33
 
 
-def filter_selectivity(pred: E.Expr) -> float:
-    if isinstance(pred, E.Cmp) and pred.op == "=":
-        return EQ_SELECTIVITY
+def _col_and_lit(pred: E.Cmp):
+    """-> (col_id, literal value, op oriented col-op-lit) or None."""
+    left, right, op = pred.left, pred.right, pred.op
+    flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
+    if isinstance(left, E.Literal) and isinstance(right, E.ColRef):
+        left, right, op = right, left, flip.get(op, op)
+    if isinstance(left, E.ColRef) and isinstance(right, E.Literal) \
+            and right.value is not None:
+        try:
+            return left.name, float(right.value), op
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def _eq_sel(cs, v: float) -> float:
+    for mval, frac in cs.mcv:
+        if mval == v:
+            return min(max(frac, 1e-6), 1.0)
+    if cs.ndv > 0:
+        return min((1.0 - cs.null_frac) / cs.ndv, 1.0)
+    return EQ_SELECTIVITY
+
+
+def _range_sel(cs, v: float, op: str) -> float:
+    if cs.min is None or cs.max is None:
+        return RANGE_SELECTIVITY
+    lo, hi = cs.min, cs.max
+    if hi <= lo:
+        return 0.5
+    frac = (v - lo) / (hi - lo)
+    if op in ("<", "<="):
+        s = frac
+    else:
+        s = 1.0 - frac
+    return float(min(max(s, 0.0), 1.0)) * (1.0 - cs.null_frac)
+
+
+def filter_selectivity(pred: E.Expr, lookup=None) -> float:
+    """Estimated fraction of rows passing ``pred``. ``lookup`` maps a
+    column id to its ColumnStats (or None) when the caller can resolve
+    column origins; without it the constant fallbacks apply."""
+    if isinstance(pred, E.Cmp):
+        info = _col_and_lit(pred) if lookup is not None else None
+        cs = lookup(info[0]) if info else None
+        if cs is not None:
+            _, v, op = info
+            if op == "=":
+                return _eq_sel(cs, v)
+            if op == "<>":
+                return max(1.0 - _eq_sel(cs, v) - cs.null_frac, 0.0)
+            return _range_sel(cs, v, op)
+        return EQ_SELECTIVITY if pred.op == "=" else RANGE_SELECTIVITY \
+            if pred.op in ("<", "<=", ">", ">=") else DEFAULT_FILTER_SELECTIVITY
+    if isinstance(pred, E.InList):
+        cs = (lookup(pred.arg.name)
+              if lookup is not None and isinstance(pred.arg, E.ColRef) else None)
+        if cs is not None and cs.ndv > 0:
+            return min(len(pred.values) * (1.0 - cs.null_frac) / cs.ndv, 1.0)
+        return min(len(pred.values) * EQ_SELECTIVITY, 1.0)
+    if isinstance(pred, E.IsNull):
+        cs = (lookup(pred.arg.name)
+              if lookup is not None and isinstance(pred.arg, E.ColRef) else None)
+        if cs is not None:
+            return (1.0 - cs.null_frac) if pred.negate else cs.null_frac
+        return 0.9 if pred.negate else 0.1
+    if isinstance(pred, E.Not):
+        return max(1.0 - filter_selectivity(pred.arg, lookup), 1e-4)
     if isinstance(pred, E.BoolOp) and pred.op == "and":
         s = 1.0
         for a in pred.args:
-            s *= filter_selectivity(a)
+            s *= filter_selectivity(a, lookup)
         return max(s, 1e-4)
     if isinstance(pred, E.BoolOp) and pred.op == "or":
         s = 0.0
         for a in pred.args:
-            s += filter_selectivity(a)
+            s += filter_selectivity(a, lookup)
         return min(s, 1.0)
     return DEFAULT_FILTER_SELECTIVITY
 
@@ -40,11 +112,34 @@ def row_width(cols) -> float:
     return 8.0 * max(len(cols), 1)
 
 
-def est_groups(rows: float) -> float:
-    """Group-count guess without statistics: sqrt heuristic, capped."""
+def est_groups(rows: float, ndvs: list[float] | None = None) -> float:
+    """Group-count estimate. With per-key NDVs (ANALYZE ran): the NDV
+    product capped at the row count — the standard independence bound.
+    Without: the round-1 sqrt heuristic."""
+    if ndvs:
+        prod = 1.0
+        for d in ndvs:
+            prod *= max(d, 1.0)
+            if prod >= rows:
+                return max(rows, 1.0)
+        return max(min(prod, rows), 1.0)
     import math
 
     return min(max(math.sqrt(max(rows, 1.0)) * 4, 16.0), 1 << 20)
+
+
+def join_rows(left_rows: float, right_rows: float,
+              key_ndvs: list[tuple[float, float]] | None) -> float | None:
+    """Equi-join output estimate: |L||R| * prod 1/max(ndv_l, ndv_r).
+    None when any key pair lacks stats (caller falls back)."""
+    if not key_ndvs:
+        return None
+    sel = 1.0
+    for nl, nr in key_ndvs:
+        if nl <= 0 or nr <= 0:
+            return None
+        sel /= max(nl, nr)
+    return max(left_rows * right_rows * sel, 1.0)
 
 
 def motion_cost(kind: str, rows: float, width: float, nseg: int) -> float:

@@ -52,6 +52,10 @@ class Plan:
 class Scan(Plan):
     table: str
     cols: list[ColInfo]            # id = unique, name = storage column name
+    # direct dispatch (cdbtargeteddispatch.c analog): a distribution-key
+    # equality pins every row of interest to ONE segment; only that
+    # segment's storage is staged to device
+    direct_seg: int | None = None
 
     def out_cols(self):
         return self.cols
@@ -178,6 +182,8 @@ def describe(plan: Plan, indent: int = 0) -> str:
     extra = ""
     if isinstance(plan, Scan):
         extra = f" {plan.table}"
+        if plan.direct_seg is not None:
+            extra += f" (direct dispatch: seg {plan.direct_seg})"
     elif isinstance(plan, Join):
         extra = f" {plan.kind}"
     elif isinstance(plan, Motion):

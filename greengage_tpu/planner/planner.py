@@ -77,8 +77,57 @@ class Planner:
     def _plan_filter(self, node: Filter) -> Plan:
         node.child = self._rec(node.child)
         node.locus = node.child.locus
-        node.est_rows = node.child.est_rows * C.filter_selectivity(node.predicate)
+        node.est_rows = node.child.est_rows * C.filter_selectivity(
+            node.predicate, self._stats_lookup(node.child))
+        self._maybe_direct_dispatch(node)
         return node
+
+    def _maybe_direct_dispatch(self, node: Filter) -> None:
+        """Point-query pruning (cdbtargeteddispatch.c analog): equality
+        literals covering a scan's full hash-distribution key pin all
+        qualifying rows to one segment — only that segment's storage gets
+        staged to device."""
+        child = node.child
+        if not isinstance(child, Scan):
+            return
+        schema = self.catalog.get(child.table)
+        if schema.policy.kind is not PolicyKind.HASH:
+            return
+        by_id = {c.id: c.name for c in child.cols}
+        found: dict[str, object] = {}
+        conjuncts = (list(node.predicate.args)
+                     if isinstance(node.predicate, E.BoolOp)
+                     and node.predicate.op == "and" else [node.predicate])
+        for c in conjuncts:
+            if not (isinstance(c, E.Cmp) and c.op == "="):
+                continue
+            lhs, rhs = c.left, c.right
+            if isinstance(rhs, E.ColRef) and isinstance(lhs, E.Literal):
+                lhs, rhs = rhs, lhs
+            if isinstance(lhs, E.ColRef) and isinstance(rhs, E.Literal) \
+                    and lhs.name in by_id:
+                found[by_id[lhs.name]] = rhs.value
+        if all(k in found for k in schema.policy.keys):
+            child.direct_seg = self.store.segment_for_values(
+                schema, {k: found[k] for k in schema.policy.keys})
+
+    # ---- statistics access (pg_statistic / ORCA stats-calculus analog) --
+    def _stats_lookup(self, plan: Plan):
+        """-> lookup(col_id) resolving a column through pass-through nodes
+        to its base-table ColumnStats (None when unresolvable/unanalyzed)."""
+        def lookup(col_id: str):
+            org = _origin(plan, col_id)
+            if org is None:
+                return None
+            try:
+                schema = self.catalog.get(org[0])
+            except Exception:
+                return None
+            ts = getattr(schema, "stats", None)
+            if ts is None:
+                return None
+            return ts.columns.get(org[1])
+        return lookup
 
     def _plan_project(self, node: Project) -> Plan:
         node.child = self._rec(node.child)
@@ -192,7 +241,20 @@ class Planner:
                 node.left = self._redistribute(left, list(node.left_keys), lids)
                 node.right = self._redistribute(right, list(node.right_keys), rids)
                 node.locus = node.left.locus
-        node.est_rows = max(left.est_rows, right.est_rows)
+        # output cardinality: with ANALYZE stats, |L||R|/max(key NDVs);
+        # fallback to the round-1 max() guess
+        llook = self._stats_lookup(left)
+        rlook = self._stats_lookup(right)
+        key_ndvs = []
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            ls = llook(lk.name) if isinstance(lk, E.ColRef) else None
+            rs = rlook(rk.name) if isinstance(rk, E.ColRef) else None
+            if ls is None or rs is None:
+                key_ndvs = None
+                break
+            key_ndvs.append((ls.ndv, rs.ndv))
+        est = C.join_rows(left.est_rows, right.est_rows, key_ndvs)
+        node.est_rows = est if est is not None else max(left.est_rows, right.est_rows)
         if node.kind in ("semi", "anti"):
             node.est_rows = left.est_rows * 0.5
         # build-side duplicate keys force the CSR multi-match kernel for
@@ -217,7 +279,7 @@ class Planner:
         key_ids = tuple(
             e.name for _, e in node.group_keys if isinstance(e, E.ColRef)
         )
-        groups = min(C.est_groups(child.est_rows),
+        groups = min(self._est_groups(node, child),
                      self._group_domain_bound(node.group_keys))
 
         if not node.group_keys:
@@ -260,6 +322,18 @@ class Planner:
         final.est_rows = groups
         return final
 
+    def _est_groups(self, node: Aggregate, child: Plan) -> float:
+        """NDV-product estimate when every group key resolves to analyzed
+        base columns; sqrt heuristic otherwise."""
+        lookup = self._stats_lookup(child)
+        ndvs = []
+        for _, e in node.group_keys:
+            cs = lookup(e.name) if isinstance(e, E.ColRef) else None
+            if cs is None or cs.ndv <= 0:
+                return C.est_groups(child.est_rows)
+            ndvs.append(cs.ndv)
+        return C.est_groups(child.est_rows, ndvs)
+
     def _group_domain_bound(self, group_keys) -> float:
         """Hard upper bound on distinct groups when every key has a known
         finite domain: TEXT keys can't exceed their dictionary size, BOOL
@@ -284,7 +358,7 @@ class Planner:
             child=node.child, group_keys=node.group_keys, aggs=node.aggs,
             phase="partial")
         partial.locus = node.child.locus
-        groups = min(C.est_groups(node.child.est_rows),
+        groups = min(self._est_groups(node, node.child),
                      self._group_domain_bound(node.group_keys))
         partial.est_rows = min(node.child.est_rows, groups * max(self.nseg, 1))
         return partial
@@ -383,6 +457,33 @@ class Planner:
         m.locus = Locus.entry()
         m.est_rows = child.est_rows
         return m
+
+
+def _origin(plan: Plan, col_id: str):
+    """Resolve a column id through pass-through nodes to its base-table
+    (table, column) origin — None for computed/derived columns. The stats
+    machinery uses this instead of threading provenance through every
+    binder expression."""
+    if isinstance(plan, Scan):
+        for c in plan.cols:
+            if c.id == col_id:
+                return (plan.table, c.name)
+        return None
+    if isinstance(plan, (Filter, Motion, Limit, Sort, Window)):
+        return _origin(plan.children[0], col_id)
+    if isinstance(plan, Project):
+        for c, e in plan.exprs:
+            if c.id == col_id:
+                return _origin(plan.child, e.name) if isinstance(e, E.ColRef) else None
+        return None
+    if isinstance(plan, Join):
+        return _origin(plan.left, col_id) or _origin(plan.right, col_id)
+    if isinstance(plan, Aggregate):
+        for c, e in plan.group_keys:
+            if c.id == col_id:
+                return _origin(plan.child, e.name) if isinstance(e, E.ColRef) else None
+        return None
+    return None
 
 
 def _keys_look_unique(plan: Plan, key_exprs) -> bool:

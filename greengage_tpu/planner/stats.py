@@ -1,0 +1,150 @@
+"""Table statistics: ANALYZE + estimators — the pg_statistic / ORCA
+statistics calculus analog.
+
+Reference parity: ANALYZE's sample-based collection (the reference gathers
+NDV/MCV/histograms into pg_statistic; ORCA consumes them through
+libnaucrates' statistics objects, src/backend/gporca/libnaucrates/src/
+statistics/). We collect, per column: exact min/max/null fraction (one
+vectorized pass) and sample-based NDV using the Haas-Stokes (Duj1)
+estimator PostgreSQL uses in analyze.c. MCVs are kept for low-cardinality
+columns so equality selectivity on skewed columns is grounded.
+
+Stats feed: filter selectivities, GROUP BY cardinality (est_groups),
+join output cardinality, and motion/agg capacity sizing — where round 1
+used constants (planner/cost.py), which cost a full XLA recompile per
+mis-estimate via the overflow-tier retry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from greengage_tpu import types as T
+
+SAMPLE_ROWS = 240_000   # ~ the reference's default_statistics_target regime
+
+
+@dataclass
+class ColumnStats:
+    ndv: float = 0.0            # estimated distinct values (excl. NULL)
+    null_frac: float = 0.0
+    min: float | None = None    # storage-encoded (dates=days, decimals=scaled)
+    max: float | None = None
+    mcv: list = field(default_factory=list)     # [(encoded value, fraction)]
+
+    def to_dict(self) -> dict:
+        return {"ndv": self.ndv, "null_frac": self.null_frac,
+                "min": self.min, "max": self.max, "mcv": self.mcv}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ColumnStats":
+        return ColumnStats(d.get("ndv", 0.0), d.get("null_frac", 0.0),
+                           d.get("min"), d.get("max"),
+                           [tuple(x) for x in d.get("mcv", [])])
+
+
+@dataclass
+class TableStats:
+    rows: int = 0
+    version: int = -1           # manifest version when analyzed
+    columns: dict = field(default_factory=dict)   # name -> ColumnStats
+
+    def to_dict(self) -> dict:
+        return {"rows": self.rows, "version": self.version,
+                "columns": {n: c.to_dict() for n, c in self.columns.items()}}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TableStats":
+        return TableStats(d.get("rows", 0), d.get("version", -1),
+                          {n: ColumnStats.from_dict(c)
+                           for n, c in d.get("columns", {}).items()})
+
+
+def _haas_stokes(n_sample: int, d_sample: int, f1: int, total_rows: int) -> float:
+    """Duj1 NDV estimator (what analyze.c uses): scale the sample's distinct
+    count by how many singletons it saw. All-distinct samples extrapolate to
+    the full table; no-singleton samples are near-complete domains."""
+    if n_sample == 0:
+        return 0.0
+    if d_sample >= n_sample:
+        return float(total_rows)
+    if f1 == 0:
+        return float(d_sample)
+    n, d = float(n_sample), float(d_sample)
+    N = float(max(total_rows, n_sample))
+    denom = n - f1 + f1 * n / N
+    est = n * d / max(denom, 1.0)
+    return float(min(max(est, d), N))
+
+
+def analyze_column(arr: np.ndarray, valid: np.ndarray | None,
+                   total_rows: int, kind: T.Kind,
+                   rng: np.random.Generator) -> ColumnStats:
+    st = ColumnStats()
+    n = len(arr)
+    if n == 0:
+        return st
+    if valid is not None:
+        st.null_frac = float(1.0 - valid.mean())
+        vals = arr[valid]
+    else:
+        vals = arr
+    if len(vals) == 0:
+        return st
+    if kind in (T.Kind.INT32, T.Kind.INT64, T.Kind.DECIMAL, T.Kind.DATE,
+                T.Kind.FLOAT64, T.Kind.BOOL):
+        st.min = float(np.min(vals))
+        st.max = float(np.max(vals))
+    # NDV + MCV from a uniform sample
+    if len(vals) > SAMPLE_ROWS:
+        sample = vals[rng.integers(0, len(vals), SAMPLE_ROWS)]
+    else:
+        sample = vals
+    uniq, counts = np.unique(sample, return_counts=True)
+    f1 = int((counts == 1).sum())
+    live_total = int(total_rows * (1.0 - st.null_frac))
+    st.ndv = _haas_stokes(len(sample), len(uniq), f1, live_total)
+    # MCVs only when the sample suggests real skew concentration
+    if len(uniq) <= 100:
+        frac = counts / counts.sum()
+        order = np.argsort(-counts)[:25]
+        st.mcv = [(float(uniq[i]), float(frac[i])) for i in order]
+    return st
+
+
+def analyze_table(store, schema, snapshot=None) -> TableStats:
+    """One ANALYZE pass over a table: full min/max/null (vectorized),
+    sampled NDV/MCV, per column."""
+    snap = snapshot or store.manifest.snapshot()
+    ts = TableStats(version=snap.get("version", 0))
+    nseg = schema.policy.numsegments
+    rng = np.random.default_rng(0xA7A1)
+    per_col: dict[str, list] = {c.name: [] for c in schema.columns}
+    per_col_valid: dict[str, list] = {c.name: [] for c in schema.columns}
+    total = 0
+    for seg in range(nseg):
+        cols, valids, n = store.read_segment(schema.name, seg, None, snap)
+        total += n
+        for c in schema.columns:
+            per_col[c.name].append(cols[c.name])
+            v = valids.get(c.name)
+            per_col_valid[c.name].append(
+                v if v is not None else np.ones(n, dtype=bool))
+    from greengage_tpu.catalog.schema import PolicyKind
+
+    if schema.policy.kind is PolicyKind.REPLICATED and nseg > 0:
+        # identical copy on every segment: one copy is the table
+        total //= nseg
+        for c in schema.columns:
+            per_col[c.name] = per_col[c.name][:1]
+            per_col_valid[c.name] = per_col_valid[c.name][:1]
+    ts.rows = total
+    for c in schema.columns:
+        arr = np.concatenate(per_col[c.name]) if per_col[c.name] else np.empty(0)
+        valid = np.concatenate(per_col_valid[c.name]) if per_col_valid[c.name] else None
+        if valid is not None and valid.all():
+            valid = None
+        ts.columns[c.name] = analyze_column(arr, valid, total, c.type.kind, rng)
+    return ts

@@ -25,10 +25,13 @@ from greengage_tpu.runtime.faultinject import FaultError, faults
 
 
 class FtsProber:
-    def __init__(self, config: SegmentConfig, mesh=None, interval_s: float = 5.0):
+    def __init__(self, config: SegmentConfig, mesh=None, interval_s: float = 5.0,
+                 store=None, on_change=None):
         self.config = config
         self.mesh = mesh
         self.interval_s = interval_s
+        self.store = store          # enables the storage-health probe
+        self.on_change = on_change  # e.g. catalog save (persist promotions)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.probe_count = 0
@@ -36,14 +39,26 @@ class FtsProber:
     # ---- probe FSM (one cycle over all primaries) ----------------------
     def probe_once(self) -> dict[int, bool]:
         """Probe every primary; returns {content: alive}. Dead primaries
-        with an in-sync mirror are promoted (config.mark_down)."""
+        with an in-sync mirror are promoted (config.mark_down). Sync state
+        is recomputed from the durable replication markers first, so a
+        stale mirror is never promoted (the gp_stat_replication check)."""
         results: dict[int, bool] = {}
+        before = self.config.version
         for entry in self.config.primaries():
             alive = self._probe_segment(entry)
             results[entry.content] = alive
             if not alive and entry.status is SegmentStatus.UP:
+                if self.store is not None:
+                    from greengage_tpu.runtime.replication import Replicator
+
+                    Replicator(self.store, self.config).refresh_sync_state()
                 self.config.mark_down(entry.content)
         self.probe_count += 1
+        if self.config.version != before and self.on_change is not None:
+            try:
+                self.on_change()
+            except Exception:
+                pass
         return results
 
     def _probe_segment(self, entry) -> bool:
@@ -59,6 +74,11 @@ class FtsProber:
                     # minimal execute round-trip on the segment's chip
                     x = jax.device_put(np.ones((1,), np.float32), dev)
                     float(np.asarray(x + 1)[0])
+            # storage health: every manifest-referenced file of this
+            # content must be present on its acting root (a lost disk is a
+            # dead segment even if the chip is fine)
+            if self.store is not None and not self.store.storage_ok(entry.content):
+                return False
             return True
         except FaultError:
             return False

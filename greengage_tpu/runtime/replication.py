@@ -1,0 +1,168 @@
+"""Physical segment replication — the gp_replication.c / walsender analog.
+
+The reference streams WAL from every primary to its mirror and gates commit
+on sync acknowledgement (src/backend/replication/gp_replication.c,
+syncrep.c); FTS only promotes an in-sync mirror. Our storage is append-only
+with immutable committed files and the manifest as the single commit
+record, so replication reduces to: after each commit, copy any manifest-
+referenced segment files of content k from the ACTING primary's tree to
+the standby tree, then durably record the replicated manifest version in
+the standby tree. "In sync" = the standby's recorded version == current
+manifest version — the WAL-flush-LSN comparison FTS does via
+gp_stat_replication.
+
+Each content has two directory trees (different disks/hosts in a real
+deployment):
+
+    primary tree:  <root>/data/<table>/seg<k>/...
+    mirror tree:   <root>/mirror/content<k>/<table>/seg<k>/...
+
+Which tree is ACTING is decided by SegmentConfig roles (a promoted mirror
+acts from the mirror tree; TableStore.data_root resolves every read/write
+through it), so replication is direction-agnostic: it always copies
+acting -> standby. After a failover, committed writes land in the mirror
+tree and flow back to the original primary's tree on the next sync — the
+original primary is only promotable again once its tree has caught up.
+Rebuild (gprecoverseg full recovery, buildMirrorSegments.py:85) is the same
+copy run to completion for a tree that lost files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+
+from greengage_tpu.catalog.segments import SegmentRole, SegmentStatus
+from greengage_tpu.storage.table_store import mirror_root
+
+
+def _tree_root(store_root: str, content: int, preferred_role) -> str:
+    """The directory tree a segment entry's files live in (fixed by its
+    PREFERRED role — promotion changes who acts, not where files live)."""
+    if preferred_role is SegmentRole.MIRROR:
+        return mirror_root(store_root, content)
+    return os.path.join(store_root, "data")
+
+
+def _marker_path(tree: str, content: int) -> str:
+    return os.path.join(tree, f".synced_content{content}")
+
+
+def tree_version(tree: str, content: int) -> int:
+    """Manifest version this tree has fully replicated (-1 = never)."""
+    try:
+        with open(_marker_path(tree, content)) as f:
+            return json.load(f)["version"]
+    except (OSError, ValueError, KeyError):
+        return -1
+
+
+def replicated_version(store_root: str, content: int) -> int:
+    """Version replicated to the MIRROR tree (convenience for tests)."""
+    return tree_version(mirror_root(store_root, content), content)
+
+
+def _write_marker(tree: str, content: int, version: int) -> None:
+    os.makedirs(tree, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=tree, prefix=".synced")
+    with os.fdopen(fd, "w") as f:
+        json.dump({"version": version}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, _marker_path(tree, content))
+
+
+class Replicator:
+    """Post-commit acting->standby file-copy replication per content."""
+
+    def __init__(self, store, config):
+        self.store = store
+        self.config = config
+
+    def _pairs(self):
+        """-> [(content, standby entry)] for every mirrored content."""
+        out = []
+        for e in self.config.entries:
+            if e.content >= 0 and e.role is SegmentRole.MIRROR:
+                out.append((e.content, e))
+        return sorted(out, key=lambda p: p[0])
+
+    def _copy_content(self, snap: dict, content: int, dst_tree: str) -> int:
+        """Copy every manifest-referenced file + dictionaries of this
+        content from the acting tree into dst_tree. Committed files are
+        immutable, so copy-if-absent is a complete incremental protocol."""
+        src_tree = self.store.data_root(content)
+        copied = 0
+        for tname, tmeta in snap.get("tables", {}).items():
+            src_t = os.path.join(src_tree, tname)
+            # dictionaries: table-global, required to decode TEXT after
+            # failover; save() is atomic so a plain copy is safe
+            if os.path.isdir(src_t):
+                for fn in os.listdir(src_t):
+                    if fn.startswith("dict_"):
+                        dst_t = os.path.join(dst_tree, tname)
+                        os.makedirs(dst_t, exist_ok=True)
+                        shutil.copy(os.path.join(src_t, fn),
+                                    os.path.join(dst_t, fn))
+            for rel in tmeta.get("segfiles", {}).get(str(content), []):
+                dst = os.path.join(dst_tree, tname, rel)
+                if os.path.exists(dst):
+                    continue
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy(os.path.join(src_t, rel), dst + ".tmp")
+                os.replace(dst + ".tmp", dst)
+                copied += 1
+        return copied
+
+    def sync(self) -> dict[int, int]:
+        """Bring every standby tree up to the current manifest version.
+        Returns {content: replicated version}."""
+        snap = self.store.manifest.snapshot()
+        version = snap.get("version", 0)
+        out: dict[int, int] = {}
+        for content, standby in self._pairs():
+            dst_tree = _tree_root(self.store.root, content, standby.preferred_role)
+            if os.path.normpath(dst_tree) == os.path.normpath(
+                    self.store.data_root(content)):
+                continue   # standby tree IS the acting tree (misconfig guard)
+            self._copy_content(snap, content, dst_tree)
+            _write_marker(dst_tree, content, version)
+            out[content] = version
+            standby.mode_synced = True
+        return out
+
+    def refresh_sync_state(self) -> None:
+        """Recompute mode_synced from the durable standby-tree markers, so
+        a stale standby is never promoted."""
+        version = self.store.manifest.snapshot().get("version", 0)
+        for content, standby in self._pairs():
+            tree = _tree_root(self.store.root, content, standby.preferred_role)
+            standby.mode_synced = tree_version(tree, content) == version
+
+    def rebuild(self, content: int) -> int:
+        """Full recovery (pg_basebackup-style): copy the acting primary's
+        manifest-referenced files of ``content`` into the standby tree to
+        completion and mark it synced. Returns files copied."""
+        snap = self.store.manifest.snapshot()
+        acting = self.config.acting_primary(content)
+        if acting is None:
+            raise RuntimeError(f"content {content} has no acting primary")
+        standby_pref = (SegmentRole.PRIMARY
+                        if acting.preferred_role is SegmentRole.MIRROR
+                        else SegmentRole.MIRROR)
+        dst_tree = _tree_root(self.store.root, content, standby_pref)
+        copied = self._copy_content(snap, content, dst_tree)
+        _write_marker(dst_tree, content, snap.get("version", 0))
+        try:
+            self.config.entry(content, SegmentRole.MIRROR).mode_synced = True
+        except KeyError:
+            pass
+        dead = [e for e in self.config.entries
+                if e.content == content and e.status is SegmentStatus.DOWN]
+        for e in dead:
+            e.status = SegmentStatus.UP
+        if dead:
+            self.config.version += 1
+        return copied

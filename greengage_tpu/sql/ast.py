@@ -287,3 +287,8 @@ class SetStmt(ANode):
 @dataclass
 class TxStmt(ANode):
     action: str        # begin | commit | abort
+
+
+@dataclass
+class AnalyzeStmt(ANode):
+    table: str | None = None   # None = every table

@@ -146,6 +146,10 @@ class Parser:
             self.next()
             analyze = bool(self.accept("kw", "analyze"))
             return A.ExplainStmt(self.statement(), analyze)
+        if self.at_kw("analyze"):
+            self.next()
+            t = self.accept("name")
+            return A.AnalyzeStmt(t[1] if t else None)
         if self.at_kw("show"):
             self.next()
             return A.ShowStmt(self.next()[1])

@@ -44,6 +44,13 @@ class Dictionary:
     def decode(self, codes: np.ndarray) -> list[str]:
         return [self.values[c] for c in codes]
 
+    def encode_coded(self, vocab: list[str], codes: np.ndarray) -> np.ndarray:
+        """Bulk path: encode only the (small) vocabulary through the normal
+        append path, then remap the per-row code array vectorized — O(|vocab|)
+        Python work for any number of rows."""
+        mapping = self.encode(vocab)
+        return mapping.astype(np.int32)[codes]
+
     def lookup(self, s: str) -> int:
         """Code for s, or -1 if absent (absent ⇒ no row equals s)."""
         return self._index.get(s, -1)

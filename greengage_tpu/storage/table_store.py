@@ -29,6 +29,13 @@ from greengage_tpu.storage.dictionary import Dictionary
 from greengage_tpu.storage.manifest import Manifest
 
 
+def mirror_root(root: str, content: int) -> str:
+    """Directory tree holding content ``content``'s replicated files (the
+    mirror segment's data directory — on a real deployment a different
+    disk/host; see runtime/replication.py)."""
+    return os.path.join(root, "mirror", f"content{content}")
+
+
 def _as_i64(arr: np.ndarray) -> np.ndarray:
     """Reinterpret a column's device dtype as int64 for hashing.
 
@@ -48,6 +55,35 @@ class TableStore:
         self.catalog = catalog
         self.manifest = Manifest(root)
         self._dicts: dict[tuple[str, str], Dictionary] = {}
+
+    # ---- per-content data roots (mirror failover) ----------------------
+    def data_root(self, content: int) -> str:
+        """Directory holding content ``content``'s segment files. Normally
+        <root>/data; while a promoted mirror is acting primary for this
+        content, its mirror tree — so every read AND write lands on the
+        surviving copy after failover (runtime/replication.py)."""
+        segs = getattr(self.catalog, "segments", None)
+        if segs is not None:
+            acting = segs.acting_primary(content)
+            if acting is not None and acting.preferred_role.value == "m":
+                return mirror_root(self.root, content)
+        return os.path.join(self.root, "data")
+
+    def seg_file_path(self, table: str, rel: str) -> str:
+        """rel is 'seg<k>/<file>' as stored in the manifest."""
+        content = int(rel.split(os.sep, 1)[0][3:])
+        return os.path.join(self.data_root(content), table, rel)
+
+    def storage_ok(self, content: int) -> bool:
+        """Every manifest-referenced file of this content is present on its
+        acting root (the FTS storage-health probe)."""
+        snap = self.manifest.snapshot()
+        root = self.data_root(content)
+        for tname, tmeta in snap.get("tables", {}).items():
+            for rel in tmeta.get("segfiles", {}).get(str(content), []):
+                if not os.path.exists(os.path.join(root, tname, rel)):
+                    return False
+        return True
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
@@ -76,6 +112,27 @@ class TableStore:
                 h = np.where(v, h, np.uint32(0))
             acc = h if acc is None else native.hash_combine(acc, h)
         return acc
+
+    def segment_for_values(self, schema: TableSchema, values: dict) -> int:
+        """The one segment owning rows whose distribution keys equal
+        ``values`` (storage representation: TEXT = dictionary code, absent
+        string = -1 which hits the sentinel hash row). Direct-dispatch's
+        hash computation (cdbtargeteddispatch.c analog), bit-identical to
+        placement."""
+        cols = {}
+        valids = {}
+        for k in schema.policy.keys:
+            v = values[k]
+            c = schema.column(k)
+            if v is None:
+                cols[k] = np.zeros(1, dtype=np.int64)
+                valids[k] = np.zeros(1, dtype=bool)
+            elif c.type.kind is T.Kind.TEXT:
+                cols[k] = np.array([v], dtype=np.int32)
+            else:
+                cols[k] = np.array([v], dtype=c.type.np_dtype)
+        rh = self.row_hashes(schema, cols, valids, schema.policy.keys)
+        return int(rh[0] % np.uint32(schema.policy.numsegments))
 
     def _placement(self, schema: TableSchema, cols, valids, nrows: int, row_offset: int) -> np.ndarray:
         pol = schema.policy
@@ -109,7 +166,11 @@ class TableStore:
             if c.type.kind is T.Kind.TEXT:
                 d = self.dictionary(table, c.name)
                 vmask = valids.get(c.name)
-                if vmask is None:
+                if isinstance(raw, T.Coded):
+                    arr = d.encode_coded(list(raw.vocab), raw.codes)
+                    if vmask is not None:
+                        arr = np.where(vmask, arr, d.encode([""])[0])
+                elif vmask is None:
                     arr = d.encode(list(raw))
                 else:
                     strs = ["" if not ok else s for s, ok in zip(raw, vmask)]
@@ -185,7 +246,7 @@ class TableStore:
         cols: dict[str, np.ndarray] = {}
         valids: dict[str, np.ndarray | None] = {}
         nrows = tmeta["nrows"].get(str(seg), 0)
-        base = os.path.join(self.root, "data", table)
+        base = os.path.join(self.data_root(seg), table)
         for name in want:
             c = schema.column(name)
             data_parts, valid_parts = [], []
@@ -277,10 +338,9 @@ class TableStore:
         schema.policy = new_policy
         self.catalog._save()
         # GC the old layout's files (unreachable from the new manifest)
-        base = os.path.join(self.root, "data", table)
         for rel in old_files:
             try:
-                os.remove(os.path.join(base, rel))
+                os.remove(self.seg_file_path(table, rel))
             except OSError:
                 pass
         return nrows
@@ -322,10 +382,9 @@ class TableStore:
         self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
         v = self.manifest.prepare(tx)
         self.manifest.commit(v)
-        base = os.path.join(self.root, "data", table)
         for rel in old_files:
             try:
-                os.remove(os.path.join(base, rel))
+                os.remove(self.seg_file_path(table, rel))
             except OSError:
                 pass
 
@@ -354,7 +413,7 @@ class TableStore:
         for s, idx in enumerate(seg_rows):
             if len(idx) == 0:
                 continue
-            segdir = os.path.join(self.root, "data", schema.name, f"seg{s}")
+            segdir = os.path.join(self.data_root(s), schema.name, f"seg{s}")
             os.makedirs(segdir, exist_ok=True)
             files = tmeta["segfiles"].setdefault(str(s), [])
             for c in schema.columns:

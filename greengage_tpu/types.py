@@ -198,3 +198,24 @@ def decimal_to_int(value, scale: int) -> int:
 
     d = Decimal(str(value)).quantize(Decimal(1).scaleb(-scale), rounding=ROUND_HALF_UP)
     return int(d.scaleb(scale))
+
+
+@dataclass
+class Coded:
+    """Bulk-load representation of a TEXT column: a small vocabulary plus an
+    int32 code per row. Lets multi-million-row loads skip the per-string
+    Python encode loop — the store maps vocab -> dictionary codes once and
+    remaps the code array vectorized (the fast path the reference gets from
+    gpfdist's parallel format parsing, gpfdist.c).
+    """
+
+    vocab: list
+    codes: "np.ndarray"
+
+    def __len__(self):
+        return len(self.codes)
+
+    def decode(self):
+        import numpy as np
+
+        return np.asarray(self.vocab, dtype=object)[self.codes]

@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from greengage_tpu import types as T
+from greengage_tpu.types import Coded
 
 NATIONS = [
     ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
@@ -41,9 +42,15 @@ def _dec(rng, n, lo, hi, scale=2):
     return rng.integers(int(lo * 10**scale), int(hi * 10**scale) + 1, n).astype(np.int64)
 
 
-def _vocab(rng, n, prefix, k):
-    idx = rng.integers(0, k, n)
-    return [f"{prefix}{i}" for i in idx]
+def _vocab(rng, n, prefix, k) -> Coded:
+    """Low-NDV text column in bulk-coded form (vocab + int32 codes): O(k)
+    Python string work regardless of row count."""
+    idx = rng.integers(0, k, n).astype(np.int32)
+    return Coded([f"{prefix}{i}" for i in range(k)], idx)
+
+
+def _choice(rng, n, values: list[str]) -> Coded:
+    return Coded(list(values), rng.integers(0, len(values), n).astype(np.int32))
 
 
 def generate(sf: float, seed: int = 19940801) -> dict[str, dict]:
@@ -81,15 +88,16 @@ def generate(sf: float, seed: int = 19940801) -> dict[str, dict]:
         "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int32),
         "c_phone": _vocab(rng, n_cust, "phone ", 1000),
         "c_acctbal": _dec(rng, n_cust, -999.99, 9999.99),
-        "c_mktsegment": [SEGMENTS[i] for i in rng.integers(0, 5, n_cust)],
+        "c_mktsegment": _choice(rng, n_cust, SEGMENTS),
         "c_comment": _vocab(rng, n_cust, "cust comment ", 300),
     }
     part = {
         "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
         "p_name": _vocab(rng, n_part, "part name ", 2000),
-        "p_mfgr": [f"Manufacturer#{i}" for i in rng.integers(1, 6, n_part)],
-        "p_brand": [f"Brand#{i}{j}" for i, j in zip(
-            rng.integers(1, 6, n_part), rng.integers(1, 6, n_part))],
+        "p_mfgr": Coded([f"Manufacturer#{i}" for i in range(1, 6)],
+                        rng.integers(0, 5, n_part).astype(np.int32)),
+        "p_brand": Coded([f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)],
+                         rng.integers(0, 25, n_part).astype(np.int32)),
         "p_type": _vocab(rng, n_part, "type ", 150),
         "p_size": rng.integers(1, 51, n_part).astype(np.int32),
         "p_container": _vocab(rng, n_part, "container ", 40),
@@ -100,11 +108,13 @@ def generate(sf: float, seed: int = 19940801) -> dict[str, dict]:
     orders = {
         "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
         "o_custkey": rng.integers(1, n_cust + 1, n_orders).astype(np.int64),
-        "o_orderstatus": [["F", "O", "P"][i] for i in rng.integers(0, 3, n_orders)],
+        "o_orderstatus": _choice(rng, n_orders, ["F", "O", "P"]),
         "o_totalprice": _dec(rng, n_orders, 800.0, 500000.0),
         "o_orderdate": odate,
-        "o_orderpriority": [PRIORITIES[i] for i in rng.integers(0, 5, n_orders)],
-        "o_clerk": [f"Clerk#{i:09d}" for i in rng.integers(1, max(n_orders // 1000, 2), n_orders)],
+        "o_orderpriority": _choice(rng, n_orders, PRIORITIES),
+        "o_clerk": Coded(
+            [f"Clerk#{i:09d}" for i in range(1, max(n_orders // 1000, 2))],
+            rng.integers(0, max(n_orders // 1000, 2) - 1, n_orders).astype(np.int32)),
         "o_shippriority": np.zeros(n_orders, dtype=np.int32),
         "o_comment": _vocab(rng, n_orders, "order comment ", 500),
     }
@@ -115,23 +125,26 @@ def generate(sf: float, seed: int = 19940801) -> dict[str, dict]:
     l_odate = np.repeat(odate, lines_per)
     ship_delay = rng.integers(1, 122, n_line)
     l_ship = (l_odate + ship_delay).astype(np.int32)
+    # linenumber = position within order, vectorized: global index minus the
+    # order's first global index, +1
+    starts = np.repeat(np.cumsum(lines_per) - lines_per, lines_per)
+    l_linenumber = (np.arange(n_line) - starts + 1).astype(np.int32)
     lineitem = {
         "l_orderkey": l_orderkey,
         "l_partkey": rng.integers(1, n_part + 1, n_line).astype(np.int64),
         "l_suppkey": rng.integers(1, n_supp + 1, n_line).astype(np.int64),
-        "l_linenumber": np.concatenate(
-            [np.arange(1, k + 1) for k in lines_per]).astype(np.int32),
+        "l_linenumber": l_linenumber,
         "l_quantity": _dec(rng, n_line, 1.0, 50.0),
         "l_extendedprice": _dec(rng, n_line, 900.0, 100000.0),
         "l_discount": _dec(rng, n_line, 0.0, 0.10),
         "l_tax": _dec(rng, n_line, 0.0, 0.08),
-        "l_returnflag": [["A", "N", "R"][i] for i in rng.integers(0, 3, n_line)],
-        "l_linestatus": [["F", "O"][i] for i in rng.integers(0, 2, n_line)],
+        "l_returnflag": _choice(rng, n_line, ["A", "N", "R"]),
+        "l_linestatus": _choice(rng, n_line, ["F", "O"]),
         "l_shipdate": l_ship,
         "l_commitdate": (l_ship + rng.integers(-30, 31, n_line)).astype(np.int32),
         "l_receiptdate": (l_ship + rng.integers(1, 31, n_line)).astype(np.int32),
-        "l_shipinstruct": [INSTRUCTS[i] for i in rng.integers(0, 4, n_line)],
-        "l_shipmode": [SHIPMODES[i] for i in rng.integers(0, 7, n_line)],
+        "l_shipinstruct": _choice(rng, n_line, INSTRUCTS),
+        "l_shipmode": _choice(rng, n_line, SHIPMODES),
         "l_comment": _vocab(rng, n_line, "li comment ", 1000),
     }
     return {
@@ -198,7 +211,9 @@ def to_pandas(data: dict[str, dict], decimals_as_float: bool = True):
     for t, cols in data.items():
         df = {}
         for c, v in cols.items():
-            if decimals_as_float and c in scales:
+            if isinstance(v, Coded):
+                df[c] = v.decode()
+            elif decimals_as_float and c in scales:
                 df[c] = np.asarray(v, dtype=np.float64) / 100.0
             else:
                 df[c] = v

@@ -1,0 +1,63 @@
+"""Direct dispatch: distribution-key point queries stage ONE segment —
+VERDICT r1 missing item #8 (cdbtargeteddispatch.c analog)."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    d.sql("create table pts (id bigint, v int) distributed by (id)")
+    d.sql("insert into pts values " + ",".join(f"({i},{i * 3})" for i in range(200)))
+    d.sql("create table tkey (name text, v int) distributed by (name)")
+    d.sql("insert into tkey values ('alpha', 1), ('beta', 2), ('gamma', 3)")
+    return d
+
+
+def test_point_query_stages_one_segment(db):
+    r = db.sql("select v from pts where id = 42")
+    assert r.rows() == [(126,)]
+    assert "pts" in r.stats["direct_dispatch"]
+    # the pinned segment is the row's true placement
+    schema = db.catalog.get("pts")
+    seg = db.store.segment_for_values(schema, {"id": 42})
+    assert r.stats["direct_dispatch"]["pts"] == seg
+
+
+def test_point_query_results_match_full_scan(db):
+    for key in (0, 7, 199):
+        r = db.sql(f"select v from pts where id = {key}")
+        assert r.rows() == [(key * 3,)]
+
+
+def test_direct_dispatch_text_key(db):
+    r = db.sql("select v from tkey where name = 'beta'")
+    assert r.rows() == [(2,)]
+    assert "tkey" in r.stats["direct_dispatch"]
+
+
+def test_absent_text_key_is_empty_not_error(db):
+    r = db.sql("select v from tkey where name = 'nope'")
+    assert r.rows() == []
+
+
+def test_no_direct_on_partial_key_or_range(db):
+    r = db.sql("select count(*) from pts where id > 100")
+    assert r.rows() == [(99,)]
+    assert "pts" not in r.stats.get("direct_dispatch", {})
+
+
+def test_explain_shows_direct(db):
+    txt = db.sql("explain select v from pts where id = 42")
+    s = txt if isinstance(txt, str) else "\n".join(
+        str(row[0]) for row in txt.rows())
+    assert "direct dispatch" in s
+
+
+def test_direct_with_extra_conjuncts(db):
+    r = db.sql("select v from pts where id = 10 and v > 0")
+    assert r.rows() == [(30,)]
+    assert "pts" in r.stats["direct_dispatch"]

@@ -1,0 +1,140 @@
+"""Mirrors that hold data: replication, failover serving real rows, and
+rebuild — VERDICT r1 item #3 (gp_replication.c / buildMirrorSegments.py
+analog). The r1 gap: promotion was bookkeeping over an empty mirror; these
+tests kill a segment's storage and require the SAME rows back."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.catalog.segments import SegmentRole, SegmentStatus
+from greengage_tpu.runtime.replication import replicated_version
+from greengage_tpu.storage.table_store import mirror_root
+
+
+@pytest.fixture()
+def db(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "cluster"), numsegments=8, mirrors=True)
+    d.sql("create table t (k int, v int) distributed by (k)")
+    d.sql("insert into t values " + ",".join(f"({i},{i*10})" for i in range(64)))
+    return d
+
+
+def _kill_content_storage(db, content: int):
+    """Simulate losing the primary's disk for one content."""
+    for f in glob.glob(os.path.join(db.path, "data", "*", f"seg{content}", "*")):
+        os.remove(f)
+
+
+def test_mirrors_replicate_on_commit(db):
+    # synchronous replication: every commit leaves mirrors at head version
+    v = db.store.manifest.snapshot()["version"]
+    for content in range(8):
+        assert replicated_version(db.path, content) == v
+        mdir = mirror_root(db.path, content)
+        files = glob.glob(os.path.join(mdir, "t", f"seg{content}", "*.ggb"))
+        # every manifest-referenced file for this content is mirrored
+        snap = db.store.manifest.snapshot()
+        want = snap["tables"]["t"]["segfiles"].get(str(content), [])
+        assert len(files) >= len(want)
+    assert all(e.mode_synced for e in db.catalog.segments.entries
+               if e.role is SegmentRole.MIRROR)
+
+
+def test_failover_serves_identical_rows(db):
+    before = sorted(db.sql("select k, v from t").rows())
+    assert len(before) == 64
+    victim = 3
+    _kill_content_storage(db, victim)
+    res = db.fts.probe_once()
+    assert res[victim] is False
+    acting = db.catalog.segments.acting_primary(victim)
+    assert acting is not None and acting.preferred_role is SegmentRole.MIRROR
+    # reads now come from the mirror tree: same rows
+    after = sorted(db.sql("select k, v from t").rows())
+    assert after == before
+
+
+def test_degraded_writes_land_on_mirror_and_survive(db):
+    victim = 5
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    db.sql("insert into t values " + ",".join(f"({i},{i})" for i in range(64, 96)))
+    got = sorted(db.sql("select k from t").rows())
+    assert len(got) == 96
+    # new files for the victim content were written into the mirror tree
+    assert db.store.data_root(victim) == mirror_root(db.path, victim)
+
+
+def test_recover_rebuilds_and_rebalances(db, tmp_path):
+    from greengage_tpu.mgmt import cli
+
+    before = sorted(db.sql("select k, v from t").rows())
+    victim = 2
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    db.sql("insert into t values (1000, 1)")
+    db.close()
+    rc = cli.main(["recover", "-d", db.path])
+    assert rc == 0
+    db2 = greengage_tpu.connect(db.path)
+    cfg = db2.catalog.segments
+    assert all(e.role is e.preferred_role for e in cfg.entries)
+    assert all(e.status is SegmentStatus.UP for e in cfg.entries)
+    rows = sorted(db2.sql("select k, v from t").rows())
+    assert (1000, 1) in rows
+    assert [r for r in rows if r[0] < 64] == before
+    # primary tree is whole again
+    assert db2.store.storage_ok(victim)
+    assert cli.main(["checkcat", "-d", db.path]) == 0
+
+
+def test_stale_mirror_never_promoted(db):
+    db.sql("set mirror_sync = off")
+    db.sql("insert into t values (500, 5)")   # mirrors now behind
+    victim = 1
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    acting = db.catalog.segments.acting_primary(victim)
+    # no promotion: the stale mirror keeps its role; the primary is down
+    assert acting is not None and acting.preferred_role is SegmentRole.PRIMARY
+    assert acting.status is SegmentStatus.DOWN
+
+
+def test_double_failover_round_trip(db):
+    """Writes committed AFTER a failover must replicate back to the demoted
+    primary's tree, so a second failover (mirror tree dies) can promote the
+    original primary WITHOUT losing them — r2 code-review finding: sync()
+    used to copy acting->acting and stamp the marker anyway."""
+    victim = 6
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    # committed write while the mirror is acting primary
+    db.sql("insert into t values (2000, 2), (2001, 3)")
+    want = sorted(db.sql("select k, v from t").rows())
+    # now the MIRROR tree dies; the original primary must be in sync again
+    for f in glob.glob(os.path.join(db.path, "mirror", f"content{victim}",
+                                    "*", f"seg{victim}", "*")):
+        os.remove(f)
+    res = db.fts.probe_once()
+    assert res[victim] is False
+    acting = db.catalog.segments.acting_primary(victim)
+    assert acting is not None and acting.preferred_role is SegmentRole.PRIMARY
+    got = sorted(db.sql("select k, v from t").rows())
+    assert got == want
+    assert any(r[0] == 2000 for r in got)
+
+
+def test_promotion_survives_restart(db):
+    victim = 4
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    before = sorted(db.sql("select k, v from t").rows())
+    db.close()
+    db2 = greengage_tpu.connect(db.path)
+    acting = db2.catalog.segments.acting_primary(victim)
+    assert acting is not None and acting.preferred_role is SegmentRole.MIRROR
+    assert sorted(db2.sql("select k, v from t").rows()) == before

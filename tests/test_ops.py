@@ -127,24 +127,23 @@ def test_groupby_matches_pandas(n, groups):
     keys = [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
             agg_ops.KeySpec(jnp.asarray(k2), None, T.INT32)]
     perm, boundary, sel_sorted = agg_ops.group_sort(keys, jnp.asarray(sel))
-    starts, ends = agg_ops.group_spans(boundary)
-    perm_np = np.asarray(perm)
-    vs = jnp.asarray(v)[perm]
-    vals, valids = agg_ops.sorted_aggregate(
-        starts, ends, sel_sorted,
+    out_cap = n
+    vals, valids, srcpos, total = agg_ops.sorted_group_aggregate(
+        boundary, sel_sorted,
         [agg_ops.AggSpec("cnt", "count_star", None, None),
-         agg_ops.AggSpec("s", "sum", vs, None),
-         agg_ops.AggSpec("mn", "min", vs, None),
-         agg_ops.AggSpec("av", "avg", vs, None)])
-
-    used_np = np.asarray(boundary)
+         agg_ops.AggSpec("s", "sum", jnp.asarray(v)[perm], None),
+         agg_ops.AggSpec("mn", "min", jnp.asarray(v)[perm], None),
+         agg_ops.AggSpec("av", "avg", jnp.asarray(v)[perm], None)],
+        out_cap)
+    G = int(total)
+    rep = np.asarray(perm)[np.asarray(srcpos)[:G]]
     got = pd.DataFrame({
-        "k1": k1[perm_np][used_np],
-        "k2": k2[perm_np][used_np],
-        "cnt": np.asarray(vals["cnt"])[used_np],
-        "s": np.asarray(vals["s"])[used_np],
-        "mn": np.asarray(vals["mn"])[used_np],
-        "av": np.asarray(vals["av"])[used_np],
+        "k1": k1[rep],
+        "k2": k2[rep],
+        "cnt": np.asarray(vals["cnt"])[:G],
+        "s": np.asarray(vals["s"])[:G],
+        "mn": np.asarray(vals["mn"])[:G],
+        "av": np.asarray(vals["av"])[:G],
     }).sort_values(["k1", "k2"]).reset_index(drop=True)
 
     df = pd.DataFrame({"k1": k1[sel], "k2": k2[sel], "v": v[sel]})
@@ -168,11 +167,10 @@ def test_groupby_null_keys_merge():
         [agg_ops.KeySpec(jnp.asarray(k), jnp.asarray(kv), T.INT64)],
         jnp.asarray(sel))
     assert int(np.asarray(boundary).sum()) == 3  # groups: 1, 2, NULL
-    starts, ends = agg_ops.group_spans(boundary)
-    vals, _ = agg_ops.sorted_aggregate(
-        starts, ends, sel_sorted,
-        [agg_ops.AggSpec("c", "count_star", None, None)])
-    cnts = sorted(np.asarray(vals["c"])[np.asarray(boundary)].tolist())
+    vals, _, srcpos, total = agg_ops.sorted_group_aggregate(
+        boundary, sel_sorted,
+        [agg_ops.AggSpec("c", "count_star", None, None)], 5)
+    cnts = sorted(np.asarray(vals["c"])[:int(total)].tolist())
     assert cnts == [1, 2, 2]
 
 
@@ -183,11 +181,10 @@ def test_groupby_dead_rows_excluded():
     perm, boundary, sel_sorted = agg_ops.group_sort(
         [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)], jnp.asarray(sel))
     assert int(np.asarray(boundary).sum()) == 2  # groups 5 and 7 only
-    starts, ends = agg_ops.group_spans(boundary)
     v = jnp.asarray(np.array([1, 100, 2, 3, 100], dtype=np.int64))[perm]
-    vals, _ = agg_ops.sorted_aggregate(
-        starts, ends, sel_sorted, [agg_ops.AggSpec("s", "sum", v, None)])
-    got = sorted(np.asarray(vals["s"])[np.asarray(boundary)].tolist())
+    vals, _, srcpos, total = agg_ops.sorted_group_aggregate(
+        boundary, sel_sorted, [agg_ops.AggSpec("s", "sum", v, None)], 5)
+    got = sorted(np.asarray(vals["s"])[:int(total)].tolist())
     assert got == [1, 5]
 
 

@@ -52,16 +52,30 @@ def test_fts_probe_all_up(db):
 
 
 def test_fts_failover_promotes_mirror(tmp_path, devices8):
-    from greengage_tpu.catalog.segments import SegmentConfig, SegmentRole
+    """Promotion requires an in-sync mirror. A freshly created mirror holds
+    no data (mode_synced=False) and must NOT be promoted; after a sync it
+    is. Full end-to-end failover over real replicated files is in
+    tests/test_mirrors.py."""
+    from greengage_tpu.catalog.segments import (
+        SegmentConfig, SegmentRole, SegmentStatus)
     from greengage_tpu.runtime.fts import FtsProber
 
     cfg = SegmentConfig.create(4, with_mirrors=True)
     prober = FtsProber(cfg)
+    faults.inject("fts_probe", "error", segment=1, occurrences=1)
+    res = prober.probe_once()
+    assert res[1] is False
+    # unsynced mirror: primary down, NO promotion (would lose data)
+    down = cfg.entry(1, SegmentRole.PRIMARY)
+    assert down.preferred_role is SegmentRole.PRIMARY
+    assert down.status is SegmentStatus.DOWN
+
+    # content 2's mirror is in sync (replication ran): promotion proceeds
+    cfg.entry(2, SegmentRole.MIRROR).mode_synced = True
     faults.inject("fts_probe", "error", segment=2, occurrences=1)
     v0 = cfg.version
     res = prober.probe_once()
     assert res[2] is False
-    # mirror promoted: content 2 has a primary again (the old mirror)
     promoted = cfg.entry(2, SegmentRole.PRIMARY)
     assert promoted.preferred_role is SegmentRole.MIRROR
     assert cfg.version == v0 + 1

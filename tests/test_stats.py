@@ -1,0 +1,109 @@
+"""ANALYZE statistics + stats-driven planning — VERDICT r1 item #4
+(pg_statistic / analyze.c sampling / ORCA statistics calculus analog)."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.planner.stats import _haas_stokes
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    d.sql("create table s (k int, grp int, val float, lab text, n int) "
+          "distributed by (k)")
+    rng = np.random.default_rng(11)
+    n = 20_000
+    d.load_table("s", {
+        "k": np.arange(n),
+        "grp": rng.integers(0, 500, n),
+        "val": rng.uniform(-50.0, 150.0, n),
+        "lab": greengage_tpu.types.Coded(
+            ["x", "y", "z"], rng.integers(0, 3, n).astype(np.int32)),
+        "n": np.arange(n) % 100,
+    }, valids={"n": np.arange(n) % 10 != 0})
+    d.sql("analyze s")
+    return d
+
+
+def test_stats_collected(db):
+    ts = db.catalog.get("s").stats
+    assert ts is not None and ts.rows == 20_000
+    g = ts.columns["grp"]
+    assert 400 <= g.ndv <= 600
+    assert g.min == 0 and g.max == 499
+    v = ts.columns["val"]
+    assert -50.5 < v.min < -49 and 149 < v.max < 150.5
+    nn = ts.columns["n"]
+    assert abs(nn.null_frac - 0.1) < 0.01
+    lab = ts.columns["lab"]
+    assert 2.5 <= lab.ndv <= 3.5
+    assert len(lab.mcv) == 3   # low-NDV column keeps MCVs
+
+
+def test_stats_persist_across_restart(db):
+    db.catalog._save()
+    db2 = greengage_tpu.connect(db.path)
+    ts = db2.catalog.get("s").stats
+    assert ts is not None and ts.rows == 20_000
+    assert 400 <= ts.columns["grp"].ndv <= 600
+
+
+def test_estimates_follow_stats(db):
+    """Planned row estimates must track stats: eq ~ rows/ndv, range via
+    min/max interpolation, group count via NDV."""
+    from greengage_tpu.planner.logical import Aggregate, Filter
+
+    planned, _, _ = db._plan(_parse_one(db, "select count(*) from s where grp = 7"))
+    f = _find(planned, Filter)
+    assert 20 <= f.est_rows <= 60          # 20000/500 = 40
+    planned, _, _ = db._plan(_parse_one(db, "select count(*) from s where val < 0.0"))
+    f = _find(planned, Filter)
+    assert 3000 <= f.est_rows <= 6000      # 25% of uniform [-50, 150]
+    planned, _, _ = db._plan(
+        _parse_one(db, "select grp, count(*) from s group by grp"))
+    a = _find(planned, Aggregate)
+    assert 300 <= a.est_rows <= 800        # ~500 groups, not sqrt(20000)*4=565...
+    # tighter: the FINAL agg est must be ndv-derived, not the row count
+    assert a.est_rows < 2000
+
+
+def test_join_estimate_uses_ndv(db):
+    from greengage_tpu.planner.logical import Join
+
+    db.sql("create table dim (grp int, name text) distributed by (grp)")
+    db.sql("insert into dim values " +
+           ",".join(f"({i},'g{i}')" for i in range(0, 500, 5)))
+    db.sql("analyze dim")
+    planned, _, _ = db._plan(_parse_one(
+        db, "select s.k from s join dim on s.grp = dim.grp"))
+    j = _find(planned, Join)
+    # |s|*|dim| / max(ndv) = 20000*100/500 = 4000
+    assert 2000 <= j.est_rows <= 8000
+
+
+def test_haas_stokes_bounds():
+    # all-distinct sample extrapolates to the table
+    assert _haas_stokes(1000, 1000, 1000, 1_000_000) == 1_000_000
+    # no singletons: domain essentially covered
+    assert _haas_stokes(1000, 10, 0, 1_000_000) == 10
+    # estimator stays within [d, N]
+    e = _haas_stokes(1000, 500, 250, 1_000_000)
+    assert 500 <= e <= 1_000_000
+
+
+def _parse_one(db, sql):
+    from greengage_tpu.sql.parser import parse
+
+    return parse(sql)[0]
+
+
+def _find(plan, klass):
+    if isinstance(plan, klass):
+        return plan
+    for c in plan.children:
+        got = _find(c, klass)
+        if got is not None:
+            return got
+    return None
