@@ -40,7 +40,13 @@ if os.environ.get("GGTPU_PLATFORM"):
 # must reuse executables from disk — the "gang reuse across sessions"
 # analog. GGTPU_XLA_CACHE=0 disables.
 _cache = os.environ.get(
-    "GGTPU_XLA_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "ggtpu_xla"))
+    "GGTPU_XLA_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "ggtpu_xla",
+                 # separate dirs per platform: the tunneled TPU service
+                 # compiles with different target features than local CPU,
+                 # and mixed AOT entries trip feature-mismatch loads
+                 os.environ.get("GGTPU_PLATFORM")
+                 or os.environ.get("JAX_PLATFORMS") or "default"))
 if _cache and _cache != "0":
     try:
         jax.config.update("jax_compilation_cache_dir", _cache)

@@ -112,7 +112,9 @@ class SegmentConfig:
             self.entries.append(
                 SegmentEntry(c, SegmentRole.PRIMARY, SegmentRole.PRIMARY, device_index=c))
             if has_mirrors:
-                self.entries.append(SegmentEntry(c, SegmentRole.MIRROR, SegmentRole.MIRROR))
+                # new mirror holds no data until the first replication pass
+                self.entries.append(SegmentEntry(
+                    c, SegmentRole.MIRROR, SegmentRole.MIRROR, mode_synced=False))
         self.numsegments = new_numsegments
         self.version += 1
 

@@ -12,12 +12,13 @@ from dataclasses import dataclass, field
 
 @dataclass
 class Settings:
-    # hash table sizing; probe rounds are unrolled in the compiled program
-    # and each costs a full-batch gather pass (~64ms/6M rows on v5e), so
-    # rounds are few and a miss retries at a bigger/looser table tier
-    hash_num_probes: int = 8
+    # join probe-chain BOUND: the build/probe walks are dynamic-trip
+    # while_loops that run only as deep as the worst real chain (2-4 at
+    # load 1/3); the bound only caps pathological chains, flagging
+    # overflow for the bigger-table retry tier
+    hash_num_probes: int = 32
     hash_table_min: int = 256
-    hash_table_max: int = 1 << 22
+    hash_table_max: int = 1 << 25
     # dense group-by path: used when the product of group-key domains
     # (dictionary sizes / bool) is at most this (scatter-free aggregation)
     dense_group_limit: int = 512

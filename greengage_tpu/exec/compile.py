@@ -106,6 +106,13 @@ class Compiler:
         self._collect_scans(below)
         input_spec = []
         for t in sorted(self.scan_caps):
+            if self.scan_direct.get(t) is None:
+                # no (consistent) direct pin: the staged capacity must cover
+                # EVERY segment, not just the pinned ones two conflicting
+                # point-scans named (their caps were merged into scan_caps)
+                counts = self.store.segment_rowcounts(t)
+                self.scan_caps[t] = max(self.scan_caps[t],
+                                        max(counts, default=0), 1)
             cols = []
             for c in sorted(self.scan_cols[t]):
                 cols.append(c)
@@ -226,9 +233,16 @@ class Compiler:
                 if id(plan) in self.cap_overrides:
                     # exact cardinality reported by the overflowed run
                     return max(int(self.cap_overrides[id(plan)]), 64)
-                # CSR expansion output capacity; exponential tier growth as
-                # a fallback when no exact report is available
-                return int(probe_cap * 1.5 * (16 ** self.tier)) + 64
+                # CSR expansion output capacity from the (stats-driven)
+                # cardinality estimate; est_rows is CLUSTER-GLOBAL, the
+                # batch is per segment — divide by width for partitioned
+                # loci (skew is caught by the exact-count overflow retry)
+                est = max(plan.est_rows, 64.0) * 1.5
+                if plan.locus is not None and plan.locus.is_partitioned \
+                        and self.nseg > 1:
+                    est /= self.nseg
+                base = max(int(est) + 64, probe_cap // 4)
+                return int(base * (4 ** self.tier)) + 64
             return probe_cap
         if isinstance(plan, Aggregate):
             if not plan.group_keys:
@@ -288,7 +302,13 @@ class Compiler:
         return domains
 
     def _join_table_size(self, build_cap: int) -> int:
-        return max(self.s.hash_table_min, min(_pow2(build_cap * 2), self.s.hash_table_max))
+        # 3x headroom keeps the load factor under ~1/3: expected chain ~1.5
+        # rounds, and the dynamic-trip probe loop only pays what it walks
+        m = _pow2(build_cap * 3) * (4 ** self.tier)
+        return max(self.s.hash_table_min, min(m, self.s.hash_table_max))
+
+    def _join_probes(self) -> int:
+        return self.s.hash_num_probes * (2 ** min(self.tier, 2))
 
     # ------------------------------------------------------------------
     # node compilation (returns closures ctx -> Batch)
@@ -368,7 +388,7 @@ class Compiler:
         right_fn = self._compile_node(plan.right)
         build_cap = self._capacity_of(plan.right)
         M = self._join_table_size(build_cap)
-        probes = self.s.hash_num_probes
+        probes = self._join_probes()
         lkeys, rkeys = plan.left_keys, plan.right_keys
         kind = plan.kind
         residual = plan.residual
@@ -454,7 +474,7 @@ class Compiler:
         build_cap = self._capacity_of(plan.right)
         M = self._join_table_size(build_cap)
         out_cap = self._capacity_of(plan)
-        probes = self.s.hash_num_probes
+        probes = self._join_probes()
         lkeys, rkeys = plan.left_keys, plan.right_keys
         kind = plan.kind
         residual = plan.residual

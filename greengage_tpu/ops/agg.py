@@ -283,6 +283,14 @@ def sorted_group_aggregate(boundary, sel_sorted, aggs: list[AggSpec],
             lo = masked & jnp.int64(0xFFFFFFFF)     # [0, 2^32)
             hi = masked >> jnp.int64(32)            # arithmetic shift
             return (span(jnp.cumsum(hi)) << jnp.int64(32)) + span(jnp.cumsum(lo))
+        if masked.dtype == jnp.float64:
+            # floats cannot limb-split: a whole-batch prefix sum loses
+            # precision proportional to the BATCH total (a small group's
+            # span difference subtracts two near-equal ~1e12 prefixes), so
+            # float sums pay the scatter — accumulation stays group-local,
+            # matching per-group summation accuracy
+            tbl = jnp.zeros((out_cap + 1,), jnp.float64).at[tgt].add(masked)
+            return tbl[:out_cap]
         return span(jnp.cumsum(masked))
 
     def seg_minmax(filled, func, ident):

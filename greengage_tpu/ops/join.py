@@ -58,9 +58,18 @@ def _strict_eq(a, av, b, bv):
 def _claim(keys: list[KeySpec], sel, table_size: int, num_probes: int):
     """Shared open-addressing claim/resolve loop (build side).
 
-    -> (tkeys, slot_row, used, overflow, dup, final_slot): every
+    A ``lax.while_loop`` with a dynamic trip count: iterations run only as
+    deep as the worst probe chain actually is (typically 2-4 at load 1/3),
+    not a statically unrolled worst case — on TPU every round costs
+    full-batch gathers/scatters, and unrolled rounds also bloat XLA compile
+    time. ``num_probes`` is the chain-length BOUND; rows still active at
+    the bound raise ``overflow`` for the executor's table-size retry tier.
+
+    -> (tkeys, slot_row, used, overflow, dup, final_slot, strict): every
     strictly-selected build row resolves to the slot holding its key;
     final_slot == table_size marks dead/unresolved rows."""
+    from jax import lax
+
     M = table_size
     assert M & (M - 1) == 0
     n = sel.shape[0]
@@ -70,56 +79,76 @@ def _claim(keys: list[KeySpec], sel, table_size: int, num_probes: int):
         if k.valid is not None:
             strict = strict & k.valid   # NULL keys never participate
     h = _key_hash(keys)
-    slot, step = agg_probe_sequence(h, M)
+    slot0, step = agg_probe_sequence(h, M)
+    kvals = tuple(k.values for k in keys)
 
-    active = strict
-    used = jnp.zeros((M,), dtype=bool)
-    slot_row = jnp.zeros((M,), dtype=jnp.int32)
-    tkeys = [jnp.zeros((M,), dtype=k.values.dtype) for k in keys]
-    final_slot = jnp.full((n,), M, dtype=jnp.int32)
-    dup = jnp.zeros((), dtype=bool)
+    def cond(st):
+        return jnp.any(st[1]) & (st[7] < num_probes)
 
-    for _ in range(num_probes):
+    def body(st):
+        slot, active, used, slot_row, tkeys, final_slot, dup, i = st
         bids = jnp.full((M,), BIG, dtype=jnp.int32).at[slot].min(
             jnp.where(active, row_idx, BIG)
         )
         newly = (~used) & (bids < BIG)
         winner = jnp.clip(bids, 0, n - 1)
-        for i, k in enumerate(keys):
-            tkeys[i] = jnp.where(newly, k.values[winner], tkeys[i])
+        tkeys = tuple(jnp.where(newly, kv[winner], tk)
+                      for kv, tk in zip(kvals, tkeys))
         slot_row = jnp.where(newly, winner, slot_row)
         used = used | newly
         match = active & used[slot]
-        for i, k in enumerate(keys):
-            match = match & (k.values == tkeys[i][slot])
+        for kv, tk in zip(kvals, tkeys):
+            match = match & (kv == tk[slot])
         # a row matching a slot stored for a *different* row = duplicate key
         dup = dup | jnp.any(match & (slot_row[slot] != row_idx))
         final_slot = jnp.where(match, slot, final_slot)
         active = active & ~match
         slot = (slot + step) & (M - 1)
+        return (slot, active, used, slot_row, tkeys, final_slot, dup, i + 1)
 
-    return tkeys, slot_row, used, jnp.any(active), dup, final_slot, strict
+    init = (slot0, strict, jnp.zeros((M,), bool), jnp.zeros((M,), jnp.int32),
+            tuple(jnp.zeros((M,), dtype=k.values.dtype) for k in keys),
+            jnp.full((n,), M, jnp.int32), jnp.zeros((), bool), jnp.int32(0))
+    _, active, used, slot_row, tkeys, final_slot, dup, _ = lax.while_loop(
+        cond, body, init)
+    return list(tkeys), slot_row, used, jnp.any(active), dup, final_slot, strict
 
 
 def _walk(used, slot_keys, M, keys: list[KeySpec], sel, num_probes: int):
-    """Shared probe walk. -> (matched, slot_of) per probe row."""
+    """Shared probe walk (dynamic-trip while_loop, see _claim).
+
+    Termination: a probe row stops at its key's slot (hit) or at an empty
+    slot (key absent from the build). -> (matched, slot_of) per row."""
+    from jax import lax
+
     strict = sel
     for k in keys:
         if k.valid is not None:
             strict = strict & k.valid
     h = _key_hash(keys)
-    slot, step = agg_probe_sequence(h, M)
-    matched = jnp.zeros_like(sel)
-    slot_of = jnp.zeros(sel.shape, dtype=jnp.int32)
-    active = strict
-    for _ in range(num_probes):
-        hit = active & used[slot]
-        for i, k in enumerate(keys):
-            hit = hit & (k.values == slot_keys[i][slot])
+    slot0, step = agg_probe_sequence(h, M)
+    kvals = tuple(k.values for k in keys)
+    skeys = tuple(slot_keys)
+
+    def cond(st):
+        return jnp.any(st[1]) & (st[4] < num_probes)
+
+    def body(st):
+        slot, active, matched, slot_of, i = st
+        occupied = used[slot]
+        hit = active & occupied
+        for kv, tk in zip(kvals, skeys):
+            hit = hit & (kv == tk[slot])
         matched = matched | hit
         slot_of = jnp.where(hit, slot, slot_of)
-        active = active & ~hit
+        # stop on hit OR on an empty slot (absent key)
+        active = active & ~hit & occupied
         slot = (slot + step) & (M - 1)
+        return (slot, active, matched, slot_of, i + 1)
+
+    init = (slot0, strict, jnp.zeros_like(sel),
+            jnp.zeros(sel.shape, jnp.int32), jnp.int32(0))
+    _, _, matched, slot_of, _ = lax.while_loop(cond, body, init)
     return matched, slot_of
 
 

@@ -111,6 +111,32 @@ class Planner:
             child.direct_seg = self.store.segment_for_values(
                 schema, {k: found[k] for k in schema.policy.keys})
 
+    def _build_unique(self, plan: Plan, key_exprs) -> bool:
+        """Join-key uniqueness for build-side selection: the structural
+        dist-key heuristic, OR statistics — a key column whose NDV ≈ its
+        table's row count is a key (covers REPLICATED dimensions like
+        nation/region, which have no distribution key and were forced onto
+        the duplicate-capable join path, compounding capacity estimates).
+        A wrong stats guess is caught by the runtime dup flag and re-planned
+        with force_multi_join."""
+        if _keys_look_unique(plan, key_exprs):
+            return True
+        lookup = self._stats_lookup(plan)
+        for e in key_exprs:
+            if not isinstance(e, E.ColRef):
+                continue
+            org = _origin(plan, e.name)
+            cs = lookup(e.name)
+            if org is None or cs is None:
+                continue
+            try:
+                ts = self.catalog.get(org[0]).stats
+            except Exception:
+                continue
+            if ts is not None and ts.rows > 0 and cs.ndv >= 0.97 * ts.rows:
+                return True
+        return False
+
     # ---- statistics access (pg_statistic / ORCA stats-calculus analog) --
     def _stats_lookup(self, plan: Plan):
         """-> lookup(col_id) resolving a column through pass-through nodes
@@ -165,8 +191,8 @@ class Planner:
         # distributed by their primary key); among candidates pick the
         # smaller. Inner joins may swap freely (outputs are selected by id).
         if node.kind == "inner":
-            lu = _keys_look_unique(left, node.left_keys)
-            ru = _keys_look_unique(right, node.right_keys)
+            lu = self._build_unique(left, node.left_keys)
+            ru = self._build_unique(right, node.right_keys)
             swap = False
             if lu and not ru:
                 swap = True
@@ -264,7 +290,7 @@ class Planner:
         # yet, and the unique path is correct whenever the dup flag stays
         # clear at runtime.
         if node.kind == "inner" or (node.kind == "left" and node.residual is None):
-            if self.force_multi_join or not _keys_look_unique(
+            if self.force_multi_join or not self._build_unique(
                     node.right, node.right_keys):
                 node.multi = True
                 # duplicate fanout multiplies output rows; nudge the

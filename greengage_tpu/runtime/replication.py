@@ -94,18 +94,24 @@ class Replicator:
         content from the acting tree into dst_tree. Committed files are
         immutable, so copy-if-absent is a complete incremental protocol."""
         src_tree = self.store.data_root(content)
+        data_tree = os.path.join(self.store.root, "data")
         copied = 0
         for tname, tmeta in snap.get("tables", {}).items():
             src_t = os.path.join(src_tree, tname)
-            # dictionaries: table-global, required to decode TEXT after
-            # failover; save() is atomic so a plain copy is safe
-            if os.path.isdir(src_t):
-                for fn in os.listdir(src_t):
-                    if fn.startswith("dict_"):
-                        dst_t = os.path.join(dst_tree, tname)
-                        os.makedirs(dst_t, exist_ok=True)
-                        shutil.copy(os.path.join(src_t, fn),
-                                    os.path.join(dst_t, fn))
+            # dictionaries: table-global and AUTHORITATIVE in the data tree
+            # (flush_dicts always writes there, even while a mirror acts as
+            # primary), so they flow ONE WAY data -> mirror; copying into
+            # the data tree would clobber a fresher dictionary with a stale
+            # mirror copy (r2 review finding)
+            if os.path.normpath(dst_tree) != os.path.normpath(data_tree):
+                dict_src = os.path.join(data_tree, tname)
+                if os.path.isdir(dict_src):
+                    for fn in os.listdir(dict_src):
+                        if fn.startswith("dict_"):
+                            dst_t = os.path.join(dst_tree, tname)
+                            os.makedirs(dst_t, exist_ok=True)
+                            shutil.copy(os.path.join(dict_src, fn),
+                                        os.path.join(dst_t, fn))
             for rel in tmeta.get("segfiles", {}).get(str(content), []):
                 dst = os.path.join(dst_tree, tname, rel)
                 if os.path.exists(dst):
@@ -154,6 +160,8 @@ class Replicator:
                         else SegmentRole.MIRROR)
         dst_tree = _tree_root(self.store.root, content, standby_pref)
         copied = self._copy_content(snap, content, dst_tree)
+        # dictionaries live authoritatively in the data tree and are not
+        # deleted by a seg-file loss; nothing to rebuild for them
         _write_marker(dst_tree, content, snap.get("version", 0))
         try:
             self.config.entry(content, SegmentRole.MIRROR).mode_synced = True

@@ -138,3 +138,35 @@ def test_promotion_survives_restart(db):
     acting = db2.catalog.segments.acting_primary(victim)
     assert acting is not None and acting.preferred_role is SegmentRole.MIRROR
     assert sorted(db2.sql("select k, v from t").rows()) == before
+
+
+def test_text_dictionary_survives_failover_writes(db):
+    """Dictionaries are authoritative in the data tree; a post-failover
+    INSERT with new TEXT values must not be clobbered by replication
+    copying a stale mirror dictionary back (r2 review finding)."""
+    db.sql("create table mtx (k int, name text) distributed by (k)")
+    db.sql("insert into mtx values (1, 'alpha'), (2, 'beta')")
+    victim = 0
+    _kill_content_storage(db, victim)
+    db.fts.probe_once()
+    db.sql("insert into mtx values (3, 'gamma'), (4, 'delta')")
+    got = sorted(r[1] for r in db.sql("select k, name from mtx").rows())
+    assert got == ["alpha", "beta", "delta", "gamma"]
+    # reopen: dictionary on disk must decode every committed code
+    db.catalog._save()
+    import greengage_tpu
+
+    db2 = greengage_tpu.connect(db.path)
+    got2 = sorted(r[1] for r in db2.sql("select k, name from mtx").rows())
+    assert got2 == got
+
+
+def test_expand_new_mirrors_start_unsynced(db):
+    cfg = db.catalog.segments
+    # direct topology expansion (the session-level expand is exercised in
+    # test_runtime): new mirrors must not be promotable before replication
+    cfg.expand(10)
+    from greengage_tpu.catalog.segments import SegmentRole
+
+    for c in (8, 9):
+        assert cfg.entry(c, SegmentRole.MIRROR).mode_synced is False
