@@ -257,6 +257,9 @@ def main():
 
     detail = {"sf": SF, "rows": n_rows, "device": str(dev.device_kind),
               "loaded_now": loaded, "setup_s": round(setup_s, 1)}
+    # the chip's real HBM is the limit for this known workload (the default
+    # admission guard is conservative for ad-hoc queries)
+    db.sql("set vmem_protect_limit_mb = 15000")
     q1_line = None
     for qname, sql, nbase in (("q1", Q1, "baseline_q1"),
                               ("q3", Q3, "baseline_q3"),
@@ -265,6 +268,9 @@ def main():
             continue
         try:
             log(f"=== {qname} ===")
+            # release the previous query's staged device arrays: at SF10
+            # the three queries' column sets together exceed HBM
+            db.executor._stage_cache.clear()
             best, first, r = timed(db, sql, RUNS)
             cpu_s = globals()[nbase](data)
             value = n_rows / best

@@ -45,6 +45,11 @@ class Column:
     name: str
     type: T.SqlType
     nullable: bool = True
+    # TEXT storage encoding: "auto" resolves at first insert to "dict"
+    # (code per row + table-global dictionary; low NDV) or "raw" (byte
+    # blob + offsets per segment; high NDV — the varlena analog,
+    # src/backend/access/aocs/aocsam.c:661 datum streams)
+    encoding: str = "auto"
 
 
 @dataclass
@@ -82,6 +87,7 @@ class TableSchema:
                     "kind": c.type.kind.value,
                     "scale": c.type.scale,
                     "nullable": c.nullable,
+                    **({"encoding": c.encoding} if c.encoding != "auto" else {}),
                 }
                 for c in self.columns
             ],
@@ -97,7 +103,8 @@ class TableSchema:
     @staticmethod
     def from_dict(d: dict) -> "TableSchema":
         cols = [
-            Column(c["name"], T.SqlType(T.Kind(c["kind"]), c.get("scale", 0)), c.get("nullable", True))
+            Column(c["name"], T.SqlType(T.Kind(c["kind"]), c.get("scale", 0)),
+                   c.get("nullable", True), c.get("encoding", "auto"))
             for c in d["columns"]
         ]
         p = d["policy"]

@@ -465,10 +465,6 @@ class Compiler:
 
     def _c_join_multi(self, plan: Join):
         """Duplicate-capable inner/left join via CSR expansion."""
-        if plan.kind == "left" and plan.residual is not None:
-            raise NotImplementedError(
-                "LEFT JOIN with a non-equality ON condition over a "
-                "duplicate-key build side is not supported yet")
         left_fn = self._compile_node(plan.left)
         right_fn = self._compile_node(plan.right)
         build_cap = self._capacity_of(plan.right)
@@ -516,9 +512,23 @@ class Compiler:
             if residual is not None:
                 mask = Evaluator(out, self.consts).predicate(residual)
                 if kind == "left":
-                    newm = matched & mask
+                    # per-match disqualification over duplicate builds
+                    # (TPC-H Q13 shape): a pair failing the residual drops
+                    # its output row — UNLESS the probe row then has no
+                    # surviving pair, in which case its FIRST expanded row
+                    # becomes the single null-extended row
+                    keep = matched & mask
+                    K = keep.shape[0]
+                    P = lb.selection().shape[0]   # probe-side capacity
+                    any_kept = jnp.zeros((P + 1,), bool).at[
+                        jnp.where(present, prow, P)].max(keep)
+                    first = jnp.concatenate(
+                        [jnp.ones((min(K, 1),), bool), prow[1:] != prow[:-1]]) \
+                        if K > 1 else jnp.ones((K,), bool)
+                    null_row = present & first & ~any_kept[prow]
+                    out = out.with_sel(present & (keep | null_row))
                     for c in right_cols:
-                        out.valids[c.id] = out.valids[c.id] & newm
+                        out.valids[c.id] = out.valids[c.id] & keep
                 else:
                     out = out.with_sel(out.selection() & mask)
             return out

@@ -209,8 +209,18 @@ class Executor:
                         parts.append(_pad(val, cap, False))
                     host = np.concatenate(parts) if parts else np.zeros(0, bool)
                 else:
-                    dt = schema.column(c).type.np_dtype
-                    parts = [_pad(cc[c].astype(dt, copy=False), cap) for cc, _, _ in per_seg]
+                    if c.startswith("@hp:"):
+                        dt = np.dtype(bool)   # host-evaluated predicate col
+                    else:
+                        col_s = schema.column(c)
+                        # raw TEXT stages int64 row surrogates, not the
+                        # int32 dict-code dtype (segment bits live above 40)
+                        dt = (np.dtype(np.int64)
+                              if col_s.type.kind == T.Kind.TEXT
+                              and col_s.encoding == "raw"
+                              else col_s.type.np_dtype)
+                    parts = [_pad(cc.get(c, np.zeros(0, dt)).astype(dt, copy=False), cap)
+                             for cc, _, _ in per_seg]
                     host = np.concatenate(parts)
                 staged.append(jax.device_put(host, shard))
             present = np.concatenate(
@@ -262,7 +272,16 @@ class Executor:
                 out_cols[c.id] = data
                 out_valids[c.id] = None if valid.all() else valid
                 continue
-            if c.type.kind is T.Kind.TEXT and c.dict_ref is not None:
+            if c.type.kind is T.Kind.TEXT and getattr(c, "raw_ref", None) is not None:
+                # raw TEXT: device carried row surrogates; decode from the
+                # byte-blob storage now. NULL/padded rows carry garbage
+                # surrogates — never dereference them.
+                vals = np.empty(len(data), dtype=object)
+                m = np.asarray(valid, bool)
+                vals[m] = self.store.fetch_raw(
+                    c.raw_ref[0], c.raw_ref[1], data[m], snapshot)
+                out_cols[c.id] = vals
+            elif c.type.kind is T.Kind.TEXT and c.dict_ref is not None:
                 d = self.store.dictionary(*c.dict_ref)
                 vals = np.array(
                     [d.values[x] if 0 <= x < len(d) else None for x in data], dtype=object)

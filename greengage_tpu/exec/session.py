@@ -412,7 +412,15 @@ class Database:
         res = self.executor.run(planned, consts, outs, raw=True)
         return res, outs
 
+    def _check_no_raw_dml(self, table: str):
+        if self.store.has_raw_columns(table):
+            raise SqlError(
+                f'table "{table}" has raw-encoded TEXT columns; '
+                "DELETE/UPDATE require dictionary-encoded text for the "
+                "republish path (raw DML lands with the visimap analog)")
+
     def _delete(self, stmt: A.DeleteStmt):
+        self._check_no_raw_dml(stmt.table)
         self._check_no_tx("DELETE")
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)
@@ -439,6 +447,7 @@ class Database:
         return f"DELETE {total - len(res)}"
 
     def _update(self, stmt: A.UpdateStmt):
+        self._check_no_raw_dml(stmt.table)
         self._check_no_tx("UPDATE")
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)

@@ -27,6 +27,9 @@ class ColInfo:
     name: str                      # user-facing output name
     dict_ref: tuple[str, str] | None = None   # (table, column) for TEXT
     hidden: bool = False           # ORDER BY pass-through, not in the result
+    # raw-encoded TEXT (no dictionary): device carries a row surrogate,
+    # strings decode at finalize via this (table, column)
+    raw_ref: tuple[str, str] | None = None
 
 
 @dataclass

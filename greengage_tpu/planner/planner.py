@@ -285,11 +285,9 @@ class Planner:
             node.est_rows = left.est_rows * 0.5
         # build-side duplicate keys force the CSR multi-match kernel for
         # inner/left (semi/anti only need existence, the plain table is
-        # fine). LEFT JOIN with a residual stays on the unique-build path:
-        # the multi kernel can't express per-match residual disqualification
-        # yet, and the unique path is correct whenever the dup flag stays
-        # clear at runtime.
-        if node.kind == "inner" or (node.kind == "left" and node.residual is None):
+        # fine); the multi kernel handles per-match residual
+        # disqualification with one-null-row-per-probe collapse.
+        if node.kind in ("inner", "left"):
             if self.force_multi_join or not self._build_unique(
                     node.right, node.right_keys):
                 node.multi = True

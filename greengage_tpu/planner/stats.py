@@ -142,6 +142,11 @@ def analyze_table(store, schema, snapshot=None) -> TableStats:
             per_col_valid[c.name] = per_col_valid[c.name][:1]
     ts.rows = total
     for c in schema.columns:
+        if c.type.kind is T.Kind.TEXT and c.encoding == "raw":
+            # raw columns carry surrogates on the scan path: no NDV/MCV
+            # (their predicates are host-evaluated anyway)
+            ts.columns[c.name] = ColumnStats()
+            continue
         arr = np.concatenate(per_col[c.name]) if per_col[c.name] else np.empty(0)
         valid = np.concatenate(per_col_valid[c.name]) if per_col_valid[c.name] else None
         if valid is not None and valid.all():

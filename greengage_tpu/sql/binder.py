@@ -92,6 +92,7 @@ class Binder:
         self.store = store
         self._uid = itertools.count()
         self.consts: dict[str, np.ndarray] = {}   # LUT pool shipped to device
+        self._scan_for: dict[str, "Scan"] = {}    # base col id -> its Scan
         # callable(SelectStmt) -> (python scalar | None, SqlType): runs an
         # uncorrelated scalar subquery at bind time (InitPlan analog)
         self.subquery_executor = subquery_executor
@@ -215,11 +216,17 @@ class Binder:
                                      _dict_ref_of(e), hidden=True)
                         sel_exprs.append((ci, e))
                         e = _colref(ci)
-                order_keys.append((e, oi.desc, oi.nulls_first))
+                order_keys.append((self._no_raw(e, "sort key"),
+                                   oi.desc, oi.nulls_first))
 
         plan = Project(plan, sel_exprs)
 
         if stmt.distinct:
+            for c in proj_cols:
+                if c.raw_ref is not None:
+                    raise SqlError(
+                        "raw-encoded text cannot be used as a DISTINCT key "
+                        "(re-create the column as dictionary-encoded)")
             keys = [(c, E.ColRef(c.id, c.type)) for c in proj_cols]
             plan = Aggregate(plan, keys, [])
 
@@ -419,8 +426,10 @@ class Binder:
         rewrites: dict = {}
         for fcs in groups.values():
             spec = fcs[0].over
-            pkeys = [self._expr(p, scope) for p in spec.partition_by]
-            okeys = [(self._expr(oi.expr, scope), oi.desc, oi.nulls_first)
+            pkeys = [self._no_raw(self._expr(p, scope), "window partition key")
+                     for p in spec.partition_by]
+            okeys = [(self._no_raw(self._expr(oi.expr, scope),
+                                   "window order key"), oi.desc, oi.nulls_first)
                      for oi in spec.order_by]
             wfuncs = []
             for fc in fcs:
@@ -467,6 +476,8 @@ class Binder:
         union_cols = []
         for i in range(arity):
             t = branches[0][1][i].type
+            if any(outs_[i].raw_ref is not None for _, outs_ in branches):
+                raise SqlError("raw-encoded text is not supported in UNION")
             dref = branches[0][1][i].dict_ref
             for _, outs in branches[1:]:
                 ot = outs[i].type
@@ -502,7 +513,7 @@ class Binder:
             keys = []
             for oi in stmt.order_by:
                 e = self._bind_order_expr(oi.expr, outs, None)
-                keys.append((e, oi.desc, oi.nulls_first))
+                keys.append((self._no_raw(e, "sort key"), oi.desc, oi.nulls_first))
             plan = Sort(plan, keys)
         if stmt.limit is not None or stmt.offset:
             plan = Limit(plan, stmt.limit, stmt.offset)
@@ -562,13 +573,18 @@ class Binder:
             cols = {}
             out = []
             for c in schema.columns:
+                is_text = c.type.kind is T.Kind.TEXT
+                is_raw = is_text and c.encoding == "raw"
                 ci = ColInfo(
                     self.new_id(c.name), c.type, c.name,
-                    dict_ref=(t.name, c.name) if c.type.kind is T.Kind.TEXT else None,
+                    dict_ref=(t.name, c.name) if is_text and not is_raw else None,
+                    raw_ref=(t.name, c.name) if is_raw else None,
                 )
                 cols[c.name] = ci
                 out.append(ci)
             scan = Scan(t.name, out)
+            for ci in out:
+                self._scan_for[ci.id] = scan
             scope = Scope()
             scope.add(t.alias or t.name, cols)
             return scan, scope
@@ -601,6 +617,10 @@ class Binder:
         get a translation LUT on the right side."""
         out_l, out_r = [], []
         for lk, rk in zip(lkeys, rkeys):
+            if _raw_ref_of(lk) is not None or _raw_ref_of(rk) is not None:
+                raise SqlError(
+                    "raw-encoded text cannot be a join key (re-create the "
+                    "column as dictionary-encoded)")
             lt, rt = lk.type, rk.type
             if lt.kind is T.Kind.TEXT and rt.kind is T.Kind.TEXT:
                 ld = _dict_ref_of(lk)
@@ -700,6 +720,7 @@ class Binder:
         proj: list[tuple[ColInfo, E.Expr]] = []
         key_cols: list[tuple[ColInfo, E.Expr]] = []
         for gast, ge in group_exprs:
+            self._no_raw(ge, "GROUP BY key")
             ci = ColInfo(self.new_id("g"), ge.type, _ast_name(gast), _dict_ref_of(ge))
             proj.append((ci, ge))
             key_cols.append((ci, E.ColRef(ci.id, ci.type)))
@@ -715,6 +736,9 @@ class Binder:
             else:
                 ae = self._expr(fc.args[0], scope)
                 atype = ae.type
+                if fc.name in ("min", "max"):
+                    # min/max of raw text would return the row surrogate
+                    self._no_raw(ae, f"{fc.name}() argument")
                 ci_in = ColInfo(self.new_id("a_in"), ae.type, "arg", _dict_ref_of(ae))
                 proj.append((ci_in, ae))
                 arg_ref = E.ColRef(ci_in.id, ci_in.type)
@@ -777,14 +801,23 @@ class Binder:
                 cols = (scope.table_cols(it.expr.table) if it.expr.table
                         else scope.all_cols())
                 for c in cols:
-                    ci = ColInfo(self.new_id(c.name), c.type, c.name, c.dict_ref)
+                    ci = ColInfo(self.new_id(c.name), c.type, c.name, c.dict_ref,
+                                 raw_ref=c.raw_ref)
                     sel_exprs.append((ci, E.ColRef(c.id, c.type)))
                 continue
             e = self._rewritten_expr(it.expr, rewrites, scope, allow_plain)
             name = it.alias or _ast_name(it.expr)
-            ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e))
+            ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e),
+                         raw_ref=_raw_ref_of(e))
             sel_exprs.append((ci, e))
         return scope, sel_exprs
+
+    def _no_raw(self, e: E.Expr, what: str) -> E.Expr:
+        if _raw_ref_of(e) is not None:
+            raise SqlError(
+                f"raw-encoded text cannot be used as a {what} (re-create "
+                "the column as dictionary-encoded)")
+        return e
 
     def _bind_order_expr(self, ast, proj_cols, scope):
         if isinstance(ast, A.Num) and re.fullmatch(r"\d+", ast.text):
@@ -897,6 +930,15 @@ class Binder:
             return E.Not(e) if ast.negate else e
         if isinstance(ast, A.InExpr):
             arg = self._expr(ast.arg, scope)
+            if _raw_ref_of(arg) is not None:
+                vals = []
+                for v in ast.values:
+                    lit = self._expr(v, scope)
+                    if not isinstance(lit, E.Literal):
+                        raise SqlError("IN list must be literals")
+                    vals.append(lit.value)
+                e = self._host_pred(arg, {"op": "in", "values": vals})
+                return E.Not(e) if ast.negate else e
             d = _dict_ref_of(arg) if arg.type.kind is T.Kind.TEXT else None
             dictionary = self.store.dictionary(*d) if d else None
             vals = []
@@ -914,6 +956,9 @@ class Binder:
             arg = self._expr(ast.arg, scope)
             if arg.type.kind is not T.Kind.TEXT:
                 raise SqlError("LIKE requires a text column")
+            if _raw_ref_of(arg) is not None:
+                e = self._host_pred(arg, {"op": "like", "pattern": ast.pattern})
+                return E.Not(e) if ast.negate else e
             d = _dict_ref_of(arg)
             if d is None:
                 raise SqlError("LIKE requires a dictionary-backed column")
@@ -958,10 +1003,40 @@ class Binder:
             raise SqlError(f"unknown function {ast.name}")
         raise SqlError(f"cannot bind {type(ast).__name__}")
 
+    # ---- raw-text host predicates --------------------------------------
+    def _host_pred(self, arg: E.Expr, payload: dict) -> E.Expr:
+        """Lower a predicate over a raw TEXT column into a host-evaluated
+        boolean staged with the scan (the dictionary-LUT strategy at
+        O(rows) host cost, cached per manifest version)."""
+        rr = _raw_ref_of(arg)
+        if not isinstance(arg, E.ColRef) or arg.name not in self._scan_for:
+            raise SqlError(
+                "predicates on raw-encoded text are only supported directly "
+                "on base-table columns")
+        scan = self._scan_for[arg.name]
+        name = self.store.host_pred_name(rr[1], payload)
+        for c in scan.cols:   # reuse an identical predicate column
+            if c.name == name:
+                return _colref(c)
+        ci = ColInfo(self.new_id("hp"), T.BOOL, name)
+        scan.cols.append(ci)
+        self._scan_for[ci.id] = scan
+        return _colref(ci)
+
     # ---- comparisons with literal coercion ----------------------------
     def _bind_cmp(self, ast: A.Bin, scope) -> E.Expr:
         le = self._expr(ast.left, scope)
         re_ = self._expr(ast.right, scope)
+        # raw TEXT comparisons evaluate on host (storage carries surrogates)
+        for a, b in ((le, re_), (re_, le)):
+            if _raw_ref_of(a) is not None:
+                if not (isinstance(b, E.Literal) and b.type.kind is T.Kind.TEXT
+                        and ast.op in ("=", "<>")):
+                    raise SqlError(
+                        "raw-encoded text supports only =/<> against string "
+                        "literals, LIKE, and IN")
+                e = self._host_pred(a, {"op": "eq", "value": b.value})
+                return E.Not(e) if ast.op == "<>" else e
         le, re_ = self._coerce_pair(le, re_)
         return E.Cmp(ast.op, le, re_)
 
@@ -1066,11 +1141,17 @@ def _colref(c: ColInfo) -> E.ColRef:
     e = E.ColRef(c.id, c.type)
     if c.dict_ref is not None:
         object.__setattr__(e, "_dict_ref", c.dict_ref)
+    if c.raw_ref is not None:
+        object.__setattr__(e, "_raw_ref", c.raw_ref)
     return e
 
 
 def _dict_ref_of(e: E.Expr):
     return getattr(e, "_dict_ref", None)
+
+
+def _raw_ref_of(e: E.Expr):
+    return getattr(e, "_raw_ref", None)
 
 
 def _contains_agg(ast) -> bool:
@@ -1256,15 +1337,7 @@ def _ast_name(ast) -> str:
 
 
 def _like_to_regex(pattern: str) -> "re.Pattern":
-    out = []
-    for ch in pattern:
-        if ch == "%":
-            out.append(".*")
-        elif ch == "_":
-            out.append(".")
-        else:
-            out.append(re.escape(ch))
-    return re.compile("".join(out), re.DOTALL)
+    return T.like_to_regex(pattern)
 
 
 def _apply_interval(days: int, iv: A.IntervalLit, op: str) -> int:

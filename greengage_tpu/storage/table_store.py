@@ -29,6 +29,34 @@ from greengage_tpu.storage.dictionary import Dictionary
 from greengage_tpu.storage.manifest import Manifest
 
 
+class _RawChunk:
+    """One segment's raw TEXT column: per-row END offsets + validity, with
+    the byte blob loaded LAZILY — scans/ANALYZE only need offsets/validity
+    (small files); predicates and projections pull the blob on demand."""
+
+    def __init__(self, ends: np.ndarray, valid: np.ndarray | None,
+                 blob_paths: list[str]):
+        self.ends = ends
+        self.valid = valid
+        self._blob_paths = blob_paths
+        self._strs: list[str] | None = None
+
+    def __len__(self):
+        return len(self.ends)
+
+    def strings(self) -> list[str]:
+        if self._strs is None:
+            blobs = [read_column_file(p).astype(np.uint8)
+                     for p in self._blob_paths]
+            b = (np.concatenate(blobs) if blobs
+                 else np.zeros(0, np.uint8)).tobytes()
+            starts = np.concatenate([np.zeros(1, np.int64), self.ends[:-1]]) \
+                if len(self.ends) else np.zeros(0, np.int64)
+            self._strs = [b[s:e].decode("utf-8")
+                          for s, e in zip(starts, self.ends)]
+        return self._strs
+
+
 def mirror_root(root: str, content: int) -> str:
     """Directory tree holding content ``content``'s replicated files (the
     mirror segment's data directory — on a real deployment a different
@@ -55,6 +83,8 @@ class TableStore:
         self.catalog = catalog
         self.manifest = Manifest(root)
         self._dicts: dict[tuple[str, str], Dictionary] = {}
+        self._raw_cache: dict = {}    # (table, col, seg, version) -> RawChunk
+        self._hp_cache: dict = {}     # (table, seg, name, version) -> result
 
     # ---- per-content data roots (mirror failover) ----------------------
     def data_root(self, content: int) -> str:
@@ -159,11 +189,25 @@ class TableStore:
                     f'null value in column "{c.name}" violates not-null constraint')
         nrows = None
         enc: dict[str, np.ndarray] = {}
+        raw_strs: dict[str, np.ndarray] = {}   # raw-encoded TEXT columns
         for c in schema.columns:
             if c.name not in columns:
                 raise ValueError(f"missing column {c.name}")
             raw = columns[c.name]
             if c.type.kind is T.Kind.TEXT:
+                c = self._resolve_text_encoding(schema, c, raw)
+                if c.encoding == "raw":
+                    vals = (raw.decode() if isinstance(raw, T.Coded)
+                            else np.asarray(raw, dtype=object))
+                    raw_strs[c.name] = vals
+                    # placeholder for ragged checks; never hashed (raw
+                    # distribution keys are rejected in _resolve)
+                    arr = np.zeros(len(vals), dtype=np.int64)
+                    enc[c.name] = arr
+                    nrows = len(arr) if nrows is None else nrows
+                    if len(arr) != nrows:
+                        raise ValueError("ragged insert")
+                    continue
                 d = self.dictionary(table, c.name)
                 vmask = valids.get(c.name)
                 if isinstance(raw, T.Coded):
@@ -203,7 +247,8 @@ class TableStore:
             seg_of = self._placement(schema, enc, valids, nrows, total_existing)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
 
-        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, fileno)
+        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, fileno,
+                             raw_strs=raw_strs)
 
         if own_tx:
             # Ordering: stage files -> prepare (version CAS = the write lock)
@@ -222,6 +267,30 @@ class TableStore:
             # flush_dicts(table) between those phases (see runtime/dtm.py).
             pass
         return nrows
+
+    def _resolve_text_encoding(self, schema, col, raw_values):
+        """First-insert decision for TEXT encoding="auto": high-NDV columns
+        go raw (byte blob + offsets; arbitrary-cardinality strings, the
+        varlena analog), low-NDV go dict. Distribution keys are always dict
+        (placement hashes string bytes via the dictionary LUT)."""
+        if col.encoding != "auto":
+            return col
+        from greengage_tpu.catalog.schema import Column
+
+        if col.name in schema.policy.keys or isinstance(raw_values, T.Coded):
+            mode = "dict"
+        else:
+            sample = list(raw_values[:100_000])
+            mode = ("raw" if len(sample) >= 4096
+                    and len(set(sample)) > 0.5 * len(sample) else "dict")
+        new = Column(col.name, col.type, col.nullable, mode)
+        schema.columns[[c.name for c in schema.columns].index(col.name)] = new
+        self.catalog._save()
+        return new
+
+    def has_raw_columns(self, table: str) -> bool:
+        return any(c.type.kind is T.Kind.TEXT and c.encoding == "raw"
+                   for c in self.catalog.get(table).columns)
 
     def flush_dicts(self, table: str) -> None:
         schema = self.catalog.get(table)
@@ -248,7 +317,22 @@ class TableStore:
         nrows = tmeta["nrows"].get(str(seg), 0)
         base = os.path.join(self.data_root(seg), table)
         for name in want:
+            if name.startswith("@hp:"):
+                # host-evaluated predicate over a raw TEXT column: the
+                # device stages a boolean column (the dictionary-LUT idea
+                # at O(rows) host cost; cached per manifest version)
+                arr, vmask = self.eval_host_pred(table, seg, name, snap)
+                cols[name] = arr
+                valids[name] = vmask
+                continue
             c = schema.column(name)
+            if c.type.kind is T.Kind.TEXT and c.encoding == "raw":
+                # device sees a stable row surrogate; strings decode at
+                # result finalize (fetch_raw)
+                cols[name] = ((np.int64(seg) << np.int64(40))
+                              + np.arange(nrows, dtype=np.int64))
+                valids[name] = self.raw_chunk(table, seg, name, snap).valid
+                continue
             data_parts, valid_parts = [], []
             for rel in files:
                 fn = os.path.basename(rel)
@@ -275,6 +359,103 @@ class TableStore:
                 raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
         return cols, valids, nrows
 
+    # ---- raw TEXT columns (varlena analog) -----------------------------
+    def raw_chunk(self, table: str, seg: int, col: str, snapshot=None):
+        """Assembled (blob, offsets, valid, strings-cache) for one raw TEXT
+        column of one segment, manifest-version cached."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = (table, col, seg, version)
+        if key in self._raw_cache:
+            return self._raw_cache[key]
+        tmeta = snap["tables"].get(table, {"segfiles": {}})
+        files = tmeta["segfiles"].get(str(seg), [])
+        base = os.path.join(self.data_root(seg), table)
+        blob_paths, offs_parts, valid_parts = [], [], []
+        bytes_base = 0
+        valid_for = {}
+        for rel in files:
+            fn = os.path.basename(rel)
+            if fn.startswith(col + ".") and fn.endswith(".valid.ggb"):
+                valid_for[fn.replace(".valid.ggb", "")] = read_column_file(
+                    os.path.join(base, rel))
+        for rel in files:
+            fn = os.path.basename(rel)
+            if fn.startswith(col + ".") and fn.endswith(".rawoffs.ggb"):
+                offs = read_column_file(os.path.join(base, rel)).astype(np.int64)
+                n = len(offs) - 1
+                offs_parts.append(offs[1:] + bytes_base)   # per-row END offsets
+                blob_paths.append(os.path.join(
+                    base, rel.replace(".rawoffs.ggb", ".rawbytes.ggb")))
+                v = valid_for.get(fn.replace(".rawoffs.ggb", ""))
+                valid_parts.append(np.asarray(v, bool) if v is not None
+                                   else np.ones(n, dtype=bool))
+                bytes_base += int(offs[-1])
+        ends = np.concatenate(offs_parts) if offs_parts else np.zeros(0, np.int64)
+        valid = np.concatenate(valid_parts) if valid_parts else np.zeros(0, bool)
+        chunk = _RawChunk(ends, None if valid.all() else valid, blob_paths)
+        self._raw_cache[key] = chunk
+        if len(self._raw_cache) > 64:
+            self._raw_cache.pop(next(iter(self._raw_cache)))
+        return chunk
+
+    @staticmethod
+    def host_pred_name(col: str, payload: dict) -> str:
+        """Virtual staged-column name carrying a host-evaluated raw-text
+        predicate: '@hp:<col>:<hex json payload>'."""
+        import json
+
+        return f"@hp:{col}:{json.dumps(payload, sort_keys=True).encode().hex()}"
+
+    def eval_host_pred(self, table: str, seg: int, name: str, snapshot=None):
+        """-> (bool array, valid|None) for one '@hp:' virtual column."""
+        import json
+
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = (table, seg, name, version)
+        if key in self._hp_cache:
+            return self._hp_cache[key]
+        _, col, hexpayload = name.split(":", 2)
+        payload = json.loads(bytes.fromhex(hexpayload))
+        chunk = self.raw_chunk(table, seg, col, snap)
+        strs = chunk.strings()
+        op = payload["op"]
+        if op == "like":
+            rx = T.like_to_regex(payload["pattern"])
+            out = np.fromiter((rx.fullmatch(s) is not None for s in strs),
+                              bool, len(strs))
+        elif op == "eq":
+            out = np.fromiter((s == payload["value"] for s in strs),
+                              bool, len(strs))
+        elif op == "in":
+            vals = set(payload["values"])
+            out = np.fromiter((s in vals for s in strs), bool, len(strs))
+        else:
+            raise ValueError(f"unknown host predicate op {op}")
+        res = (out, chunk.valid)
+        self._hp_cache[key] = res
+        if len(self._hp_cache) > 256:
+            self._hp_cache.pop(next(iter(self._hp_cache)))
+        return res
+
+    def fetch_raw(self, table: str, col: str, surrogates: np.ndarray,
+                  snapshot=None):
+        """Decode raw-TEXT row surrogates ((seg << 40) | row) back to
+        strings for result finalize."""
+        out = np.empty(len(surrogates), dtype=object)
+        if len(surrogates) == 0:
+            return out
+        sur = np.asarray(surrogates, np.int64)
+        segs = sur >> np.int64(40)
+        rows = sur & np.int64((1 << 40) - 1)
+        for s in np.unique(segs):
+            chunk = self.raw_chunk(table, int(s), col, snapshot)
+            strs = chunk.strings()
+            mask = segs == s
+            out[mask] = [strs[r] for r in rows[mask]]
+        return out
+
     def rewrite_table(self, table: str, new_numsegments: int) -> int:
         """ALTER TABLE ... EXPAND TABLE analog (tablecmds.c:4067): re-place
         every row at the new cluster width and publish atomically. Works on
@@ -283,6 +464,10 @@ class TableStore:
         from greengage_tpu.catalog.schema import DistPolicy, PolicyKind
 
         schema = self.catalog.get(table)
+        if self.has_raw_columns(table):
+            raise ValueError(
+                f"table {table} has raw-encoded TEXT columns; "
+                "redistribution/republish of raw text is not supported yet")
         old_nseg = schema.policy.numsegments
         # gather all rows from the old layout
         parts_cols: dict[str, list] = {c.name: [] for c in schema.columns}
@@ -354,6 +539,10 @@ class TableStore:
         from greengage_tpu.catalog.schema import PolicyKind
 
         schema = self.catalog.get(table)
+        if self.has_raw_columns(table):
+            raise ValueError(
+                f"table {table} has raw-encoded TEXT columns; "
+                "redistribution/republish of raw text is not supported yet")
         for c in schema.columns:
             v = valids.get(c.name)
             if not c.nullable and v is not None and not np.all(v):
@@ -407,9 +596,11 @@ class TableStore:
         if changed:
             self.catalog._save()
 
-    def _write_segfiles(self, schema, tmeta, enc, valids, seg_rows, fileno) -> None:
+    def _write_segfiles(self, schema, tmeta, enc, valids, seg_rows, fileno,
+                        raw_strs=None) -> None:
         compresstype = schema.options.get("compresstype", "zlib")
         complevel = int(schema.options.get("compresslevel", 1))
+        raw_strs = raw_strs or {}
         for s, idx in enumerate(seg_rows):
             if len(idx) == 0:
                 continue
@@ -417,10 +608,32 @@ class TableStore:
             os.makedirs(segdir, exist_ok=True)
             files = tmeta["segfiles"].setdefault(str(s), [])
             for c in schema.columns:
-                fn = f"{c.name}.{fileno}.ggb"
-                write_column_file(os.path.join(segdir, fn), enc[c.name][idx],
-                                  compresstype, complevel)
-                files.append(os.path.join(f"seg{s}", fn))
+                if c.name in raw_strs:
+                    # raw TEXT: utf-8 byte blob + row offsets (varlena-style
+                    # datum stream, aocsam.c:661)
+                    vmask = valids.get(c.name)
+                    vals = raw_strs[c.name][idx]
+                    ok = np.asarray(vmask, bool)[idx] if vmask is not None else None
+                    bts = [b"" if (ok is not None and not ok[i]) or v is None
+                           else str(v).encode("utf-8")
+                           for i, v in enumerate(vals)]
+                    lens = np.fromiter((len(b) for b in bts), np.int64, len(bts))
+                    offs = np.concatenate(
+                        [np.zeros(1, np.int64), np.cumsum(lens)])
+                    blob = np.frombuffer(b"".join(bts), np.uint8).copy()
+                    ofn = f"{c.name}.{fileno}.rawoffs.ggb"
+                    bfn = f"{c.name}.{fileno}.rawbytes.ggb"
+                    write_column_file(os.path.join(segdir, ofn), offs,
+                                      compresstype, complevel)
+                    write_column_file(os.path.join(segdir, bfn), blob,
+                                      compresstype, complevel)
+                    files.append(os.path.join(f"seg{s}", ofn))
+                    files.append(os.path.join(f"seg{s}", bfn))
+                else:
+                    fn = f"{c.name}.{fileno}.ggb"
+                    write_column_file(os.path.join(segdir, fn), enc[c.name][idx],
+                                      compresstype, complevel)
+                    files.append(os.path.join(f"seg{s}", fn))
                 v = valids.get(c.name)
                 if v is not None:
                     vfn = f"{c.name}.{fileno}.valid.ggb"
@@ -433,6 +646,8 @@ class TableStore:
     def has_nulls(self, table: str, col: str, snapshot: dict | None = None) -> bool:
         """True if any committed segfile of this column has a validity file
         (compile-time schema for the executor's input staging)."""
+        if col.startswith("@hp:"):
+            col = col.split(":", 2)[1]   # predicate nullability = column's
         snap = snapshot or self.manifest.snapshot()
         tmeta = snap["tables"].get(table, {"segfiles": {}})
         marker = f"{col}."

@@ -219,3 +219,19 @@ class Coded:
         import numpy as np
 
         return np.asarray(self.vocab, dtype=object)[self.codes]
+
+
+def like_to_regex(pattern: str):
+    """SQL LIKE pattern -> compiled regex (shared by the dictionary-LUT
+    lowering and the raw-text host evaluator)."""
+    import re
+
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out), re.DOTALL)

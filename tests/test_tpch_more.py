@@ -129,3 +129,28 @@ def test_q19_discounted_revenue(db, oracle):
         assert got is None or got == 0
     else:
         assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_q13_customer_distribution(db, oracle):
+    """LEFT OUTER JOIN with a NOT LIKE residual over a duplicate-key build
+    side + two-level grouping (was a hard NotImplementedError in r1)."""
+    r = db.sql("""
+      select c_count, count(*) as custdist from (
+        select c_custkey, count(o_orderkey) as c_count
+        from customer left join orders
+          on c_custkey = o_custkey and o_comment not like '%comment 1%'
+        group by c_custkey
+      ) c_orders
+      group by c_count
+      order by custdist desc, c_count desc
+    """)
+    c, o = oracle["customer"], oracle["orders"]
+    of = o[~o.o_comment.str.contains("comment 1", regex=False)]
+    j = c.merge(of, left_on="c_custkey", right_on="o_custkey", how="left")
+    inner = j.groupby("c_custkey")["o_orderkey"].count().reset_index(name="c_count")
+    want = inner.groupby("c_count").size().reset_index(name="custdist") \
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+    got = r.to_pandas()
+    assert len(got) == len(want), (len(got), len(want))
+    assert np.array_equal(got.iloc[:, 0].values, want.c_count.values)
+    assert np.array_equal(got.iloc[:, 1].values, want.custdist.values)
