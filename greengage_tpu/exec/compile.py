@@ -191,6 +191,16 @@ class Compiler:
                 cap = 0
             width = sum(max(c.type.np_dtype.itemsize, 1) + 1 for c in p.out_cols())
             total += cap * width
+            if isinstance(p, Join):
+                if getattr(p, "direct_domain", None) is not None and self.tier == 0:
+                    # dense build table: slot_row/counts int32 + int64 temps
+                    total += int(p.direct_domain) * 16
+                else:
+                    try:
+                        total += self._join_table_size(
+                            self._capacity_of(p.right)) * 16
+                    except NotImplementedError:
+                        pass
             stack.extend(p.children)
         return total
 
@@ -403,6 +413,14 @@ class Compiler:
 
         null_aware = getattr(plan, "null_aware", False)
 
+        # direct addressing at tier 0 only: a build-overflow retry (stale
+        # stats: live keys outside the analyzed domain) falls back to the
+        # general hash table at tier 1
+        direct = (getattr(plan, "direct_domain", None) is not None
+                  and self.tier == 0 and len(rkeys) == 1)
+        direct_lo = getattr(plan, "direct_lo", 0)
+        direct_domain = getattr(plan, "direct_domain", 0)
+
         def run(ctx):
             from jax import lax
 
@@ -410,11 +428,18 @@ class Compiler:
             rb = right_fn(ctx)
             rspecs = self._key_specs(rb, rkeys)
             lspecs = self._key_specs(lb, lkeys)
-            table = join_ops.build(rspecs, rb.selection(), M, probes)
+            if direct:
+                table = join_ops.build_direct(
+                    rspecs[0], rb.selection(), direct_lo, direct_domain)
+                matched, brow = join_ops.probe_direct(
+                    table, lspecs[0], lb.selection(), direct_lo)
+            else:
+                table = join_ops.build(rspecs, rb.selection(), M, probes)
+                matched, brow = join_ops.probe(
+                    table, lspecs, lb.selection(), probes)
             ctx["flags"].append((fid_ov, table.overflow))
             if fid_dup is not None:
                 ctx["flags"].append((fid_dup, table.dup))
-            matched, brow = join_ops.probe(table, lspecs, lb.selection(), probes)
             cols = dict(lb.cols)
             valids = dict(lb.valids)
             sel = lb.selection()

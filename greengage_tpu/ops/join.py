@@ -252,3 +252,55 @@ def gather_build_columns(build_cols: dict, build_valids: dict, build_row, matche
         gv = v[build_row] if v is not None else jnp.ones_like(matched)
         out_valids[name] = gv & matched
     return out_cols, out_valids
+
+
+# ---------------------------------------------------------------------------
+# Direct-addressed join: dense integer build keys (the TPC-H PK-FK case)
+#
+# When ANALYZE shows the build key's domain [min, max] is comparable to the
+# build row count (surrogate/sequence keys: orderkey, custkey, ...), the
+# hash table degenerates to a dense array indexed by (key - min): build is
+# ONE scatter, probe is ONE gather — measured on v5e, the iterative
+# open-addressing build alone costs ~30s at 15M rows while this whole join
+# runs in ~2 passes of memory bandwidth. Unique-key builds only (the dup
+# flag reports violations for the executor's re-plan).
+# ---------------------------------------------------------------------------
+
+
+def build_direct(key: KeySpec, sel, lo: int, domain: int) -> BuildTable:
+    """Dense build table over key values in [lo, lo+domain)."""
+    v = key.values.astype(jnp.int64) - jnp.int64(lo)
+    strict = sel
+    if key.valid is not None:
+        strict = strict & key.valid
+    in_dom = strict & (v >= 0) & (v < domain)
+    idx = jnp.where(in_dom, v, domain).astype(jnp.int64)
+    n = sel.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    slot_row = jnp.full((domain + 1,), -1, jnp.int32).at[idx].max(
+        jnp.where(in_dom, row_idx, -1))
+    used = slot_row[:domain] >= 0
+    # duplicates: two build rows claimed the same slot -> counts > 1
+    counts = jnp.zeros((domain + 1,), jnp.int32).at[idx].add(
+        jnp.where(in_dom, 1, 0))
+    dup = jnp.any(counts[:domain] > 1)
+    # out-of-domain LIVE build keys cannot be represented -> overflow
+    # (executor retries; the planner widens the domain from fresh stats)
+    overflow = jnp.any(strict & ~in_dom)
+    return BuildTable(
+        slot_keys=[], slot_key_valids=[], slot_row=slot_row[:domain],
+        used=used, overflow=overflow, dup=dup, size=domain)
+
+
+def probe_direct(table: BuildTable, key: KeySpec, sel, lo: int):
+    """-> (matched, build_row) — one gather, no walk, no key re-compare
+    (slot index IS the key)."""
+    v = key.values.astype(jnp.int64) - jnp.int64(lo)
+    strict = sel
+    if key.valid is not None:
+        strict = strict & key.valid
+    in_dom = strict & (v >= 0) & (v < table.size)
+    idx = jnp.where(in_dom, v, 0).astype(jnp.int64)
+    row = table.slot_row[idx]
+    matched = in_dom & (row >= 0)
+    return matched, jnp.where(matched, row, 0)

@@ -95,6 +95,10 @@ class Join(Plan):
     # if the subquery produced any NULL key; NULL probe keys never qualify;
     # an empty subquery qualifies every probe row.
     null_aware: bool = False
+    # direct-addressed build (ops/join.py build_direct): the single int
+    # build key's stats-known dense domain [direct_lo, direct_lo+domain)
+    direct_lo: int | None = None
+    direct_domain: int | None = None
 
     def out_cols(self):
         if self.kind in ("semi", "anti"):

@@ -294,7 +294,41 @@ class Planner:
                 # duplicate fanout multiplies output rows; nudge the
                 # estimate so operators above size their tables for it
                 node.est_rows = max(node.est_rows, left.est_rows * 2.0)
+        self._maybe_direct_join(node)
         return node
+
+    def _maybe_direct_join(self, node: Join) -> None:
+        """Dense integer build keys (sequence/surrogate PKs): address the
+        build table directly by (key - min) — one scatter to build, one
+        gather to probe (ops/join.py build_direct). Decided from ANALYZE
+        min/max; stale stats surface as a build overflow and the retry
+        tier falls back to the hash table."""
+        if node.multi or node.kind == "cross" or len(node.right_keys) != 1:
+            return
+        rk = node.right_keys[0]
+        if not isinstance(rk, E.ColRef) or rk.type.kind not in (
+                T.Kind.INT32, T.Kind.INT64, T.Kind.DATE):
+            return
+        org = _origin(node.right, rk.name)
+        cs = self._stats_lookup(node.right)(rk.name)
+        if org is None or cs is None or cs.min is None or cs.max is None:
+            return
+        try:
+            ts = self.catalog.get(org[0]).stats
+        except Exception:
+            return
+        rows = ts.rows if ts is not None else 0
+        domain = int(cs.max) - int(cs.min) + 1
+        # bound by the base table's density (sequence-like keys) and by a
+        # hard table-memory cap. A filtered build over a big domain still
+        # wins — table init is one bandwidth pass and the scatter costs
+        # only the build rows, vs the iterative hash build's many rounds —
+        # and the domain memory is charged to the vmem admission estimate.
+        if domain <= 0 or domain > max(4 * max(rows, 1), 1 << 21) \
+                or domain > (1 << 27):
+            return
+        node.direct_lo = int(cs.min)
+        node.direct_domain = domain
 
     # ------------------------------------------------------------------
     def _plan_aggregate(self, node: Aggregate) -> Plan:
