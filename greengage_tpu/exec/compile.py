@@ -86,6 +86,8 @@ class Compiler:
         self.scan_caps: dict[str, int] = {}
         self.scan_cols: dict[str, set] = {}
         self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
+        self.scan_count: dict[str, int] = {}
+        self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
 
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
@@ -118,8 +120,20 @@ class Compiler:
                 cols.append(c)
                 if self.store.has_nulls(t, c):
                     cols.append(VALID_PREFIX + c)
+            # zone-map pruning applies only when this table is scanned once
+            # (a second scan would need the pruned-away rows) and carries
+            # no raw-text surrogates (their row numbering must stay whole)
+            prune = self.scan_prune.get(t) or None
+            if prune and (self.scan_count.get(t, 0) != 1 or any(
+                    c.startswith("@hp:") for c in cols)):
+                prune = None
+            if prune:
+                schema_t = self.catalog.get(t)
+                if any(col.type.kind == T.Kind.TEXT and col.encoding == "raw"
+                       for col in schema_t.columns if col.name in self.scan_cols[t]):
+                    prune = None
             input_spec.append((t, cols, self.scan_caps[t],
-                               self.scan_direct.get(t)))
+                               self.scan_direct.get(t), prune))
 
         compiled = self._compile_node(below)   # closure: ctx -> Batch
         out_cols = below.out_cols()
@@ -129,7 +143,7 @@ class Compiler:
         def seg_fn(*flat):
             ctx = {"tables": {}, "flags": []}
             i = 0
-            for tname, cols, cap, _direct in input_spec:
+            for tname, cols, cap, _direct, _prune in input_spec:
                 entry = {}
                 for c in cols:
                     entry[c] = flat[i]
@@ -158,7 +172,7 @@ class Compiler:
             jax.shard_map(
                 seg_fn,
                 mesh=self.mesh,
-                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _, _ in input_spec))),
+                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _, _, _ in input_spec))),
                 out_specs=tuple(P(SEG_AXIS) for _ in range(nouts)),
                 check_vma=False,
             )
@@ -220,6 +234,8 @@ class Compiler:
             # direct dispatch only holds if EVERY scan of the table agrees
             prev = self.scan_direct.get(plan.table, "unset")
             self.scan_direct[plan.table] = ds if prev in ("unset", ds) else None
+            self.scan_count[plan.table] = self.scan_count.get(plan.table, 0) + 1
+            self.scan_prune[plan.table] = tuple(plan.prune_preds or ())
         for c in plan.children:
             self._collect_scans(c)
 

@@ -151,9 +151,10 @@ class Executor:
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
                     "segments": self.nseg,
-                    "scan_tables": [t for t, _, _, _ in comp.input_spec],
-                    "direct_dispatch": {t: d for t, _, _, d in comp.input_spec
+                    "scan_tables": [t for t, _, _, _, _ in comp.input_spec],
+                    "direct_dispatch": {t: d for t, _, _, d, _ in comp.input_spec
                                         if d is not None},
+                    "zone_prune": dict(getattr(self, "_last_prune_stats", {})),
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
                     "metrics": {k: int(np.max(v)) for k, v in metrics.items()},
@@ -180,13 +181,18 @@ class Executor:
         version = snapshot.get("version", 0)
         for k in [k for k in self._stage_cache if k[3] != version]:
             del self._stage_cache[k]
-        for table, cols, cap, direct in comp.input_spec:
-            key = (table, tuple(cols), cap, version, direct)
+        self._last_prune_stats = {}
+        for table, cols, cap, direct, prune in comp.input_spec:
+            key = (table, tuple(cols), cap, version, direct, prune)
             if key in self._stage_cache:
-                arrays.extend(self._stage_cache[key])
+                staged, pstats = self._stage_cache[key]
+                arrays.extend(staged)
+                if pstats is not None:
+                    self._last_prune_stats[table] = pstats
                 continue
             storage_cols = [c for c in cols if not c.startswith(VALID_PREFIX)]
             per_seg = []
+            kept = total_blocks = 0
             for seg in range(self.nseg):
                 if direct is not None and seg != direct:
                     # direct dispatch: only the owning segment's storage is
@@ -194,8 +200,15 @@ class Executor:
                     per_seg.append(({c: np.empty(0, dtype=np.int64)
                                      for c in storage_cols}, {}, 0))
                     continue
-                c, v, n = self.store.read_segment(table, seg, storage_cols, snapshot)
+                c, v, n = self.store.read_segment(
+                    table, seg, storage_cols, snapshot, prune=prune)
                 per_seg.append((c, v, n))
+                st = self.store.last_prune
+                if prune and st is not None:
+                    kept += st[0]
+                    total_blocks += st[1]
+            if prune and total_blocks:
+                self._last_prune_stats[table] = (kept, total_blocks)
             staged = []
             schema = self.catalog.get(table)
             for c in cols:
@@ -226,7 +239,8 @@ class Executor:
             present = np.concatenate(
                 [_pad(np.ones(n, dtype=bool), cap, False) for _, _, n in per_seg])
             staged.append(jax.device_put(present, shard))
-            self._stage_cache[key] = staged
+            self._stage_cache[key] = (
+                staged, self._last_prune_stats.get(table))
             arrays.extend(staged)
         return arrays
 

@@ -59,6 +59,10 @@ class Scan(Plan):
     # equality pins every row of interest to ONE segment; only that
     # segment's storage is staged to device
     direct_seg: int | None = None
+    # zone-map pruning (PartitionSelector/block-directory analog): pushed
+    # conjuncts [(storage col, op, value)] let staging skip blocks whose
+    # [min, max] cannot satisfy them
+    prune_preds: tuple = ()
 
     def out_cols(self):
         return self.cols

@@ -15,6 +15,8 @@ the coordinator (Entry locus).
 
 from __future__ import annotations
 
+import numpy as np
+
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.catalog import PolicyKind
@@ -83,31 +85,50 @@ class Planner:
         return node
 
     def _maybe_direct_dispatch(self, node: Filter) -> None:
-        """Point-query pruning (cdbtargeteddispatch.c analog): equality
-        literals covering a scan's full hash-distribution key pin all
-        qualifying rows to one segment — only that segment's storage gets
-        staged to device."""
+        """Scan-level predicate pushdown: (a) direct dispatch
+        (cdbtargeteddispatch.c) when equality literals cover the full
+        hash-distribution key; (b) zone-map prune predicates
+        (PartitionSelector analog) for range/equality conjuncts over
+        numeric/date columns — staging skips blocks they rule out."""
         child = node.child
         if not isinstance(child, Scan):
             return
         schema = self.catalog.get(child.table)
-        if schema.policy.kind is not PolicyKind.HASH:
-            return
         by_id = {c.id: c.name for c in child.cols}
         found: dict[str, object] = {}
+        prune: list[tuple] = []
         conjuncts = (list(node.predicate.args)
                      if isinstance(node.predicate, E.BoolOp)
                      and node.predicate.op == "and" else [node.predicate])
+        flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
         for c in conjuncts:
-            if not (isinstance(c, E.Cmp) and c.op == "="):
+            if not isinstance(c, E.Cmp):
                 continue
-            lhs, rhs = c.left, c.right
+            lhs, rhs, op = c.left, c.right, c.op
             if isinstance(rhs, E.ColRef) and isinstance(lhs, E.Literal):
-                lhs, rhs = rhs, lhs
-            if isinstance(lhs, E.ColRef) and isinstance(rhs, E.Literal) \
-                    and lhs.name in by_id:
+                lhs, rhs, op = rhs, lhs, flip.get(op, op)
+            if not (isinstance(lhs, E.ColRef) and isinstance(rhs, E.Literal)
+                    and lhs.name in by_id):
+                continue
+            if op == "=":
                 found[by_id[lhs.name]] = rhs.value
-        if all(k in found for k in schema.policy.keys):
+            if op in ("=", "<", "<=", ">", ">=") and rhs.value is not None \
+                    and lhs.type.kind in (T.Kind.INT32, T.Kind.INT64,
+                                          T.Kind.DATE, T.Kind.DECIMAL,
+                                          T.Kind.FLOAT64):
+                # keep ints EXACT (python int<->float comparisons are exact,
+                # but float() conversion above 2^53 is not)
+                v = rhs.value
+                if isinstance(v, (bool, np.bool_)):
+                    continue
+                if isinstance(v, (int, np.integer)):
+                    prune.append((by_id[lhs.name], op, int(v)))
+                elif isinstance(v, (float, np.floating)):
+                    prune.append((by_id[lhs.name], op, float(v)))
+        if prune:
+            child.prune_preds = tuple(prune)
+        if schema.policy.kind is PolicyKind.HASH \
+                and all(k in found for k in schema.policy.keys):
             child.direct_seg = self.store.segment_for_values(
                 schema, {k: found[k] for k in schema.policy.keys})
 

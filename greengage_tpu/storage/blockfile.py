@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
 
 import numpy as np
 
@@ -36,11 +37,31 @@ def write_column_file(path: str, values: np.ndarray, compresstype: str = "zlib",
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         off = 0
+        zonable = values.dtype.kind in ("i", "u", "f") and values.dtype.itemsize > 1
         for start in range(0, len(values), block_rows):
             chunk = values[start : start + block_rows]
             frame = native.block_encode(chunk.tobytes(), len(chunk), comp, complevel)
             f.write(frame)
-            blocks.append({"offset": off, "nrows": len(chunk), "bytes": len(frame)})
+            b = {"offset": off, "nrows": len(chunk), "bytes": len(frame)}
+            if zonable and len(chunk):
+                # zone map: per-block min/max for scan pruning (the
+                # PartitionSelector/block-directory analog — blocks whose
+                # range cannot satisfy a scan predicate are never staged).
+                # Integer bounds stay EXACT python ints (floats above 2^53
+                # would make strict-inequality pruning unsound); float
+                # columns exclude NaNs (they match no range predicate), and
+                # an all-NaN block gets no zone and is never pruned.
+                if chunk.dtype.kind == "f":
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore", RuntimeWarning)
+                        lo, hi = np.nanmin(chunk), np.nanmax(chunk)
+                    if not np.isnan(lo):
+                        b["zmin"] = float(lo)
+                        b["zmax"] = float(hi)
+                else:
+                    b["zmin"] = int(np.min(chunk))
+                    b["zmax"] = int(np.max(chunk))
+            blocks.append(b)
             off += len(frame)
         footer = {
             "dtype": values.dtype.str,

@@ -304,9 +304,67 @@ class TableStore:
             del self._dicts[key]
 
     # ---- read path -----------------------------------------------------
+    last_prune: tuple | None = None   # (blocks kept, blocks total) of last read
+
+    def _kept_blocks(self, files, base, prune):
+        """Per data-fileno block keep-list from zone maps: a block survives
+        only if EVERY pushed predicate could match its [zmin, zmax].
+        -> ({fileno: [block idx]}, kept, total); filenos absent from the
+        dict keep all blocks."""
+        from greengage_tpu.storage.blockfile import read_footer
+
+        keep: dict[str, list[int]] = {}
+        kept = total = 0
+        by_fileno_nblocks: dict[str, int] = {}
+        by_col = {}
+        for col, op, val in prune:
+            by_col.setdefault(col, []).append((op, val))
+        for rel in files:   # one footer read per relevant file
+            fn = os.path.basename(rel)
+            parts = fn.split(".")
+            if len(parts) != 3 or not fn.endswith(".ggb"):
+                continue   # data files only: <col>.<fileno>.ggb
+            col, fileno = parts[0], parts[1]
+            preds = by_col.get(col)
+            if not preds:
+                continue
+            blocks = read_footer(os.path.join(base, rel))["blocks"]
+            by_fileno_nblocks[fileno] = len(blocks)
+            ok = []
+            for i, b in enumerate(blocks):
+                if "zmin" not in b:
+                    ok.append(i)
+                    continue
+                lo, hi = b["zmin"], b["zmax"]
+                good = True
+                for op, val in preds:
+                    if not ((op == "=" and lo <= val <= hi)
+                            or (op == "<" and lo < val)
+                            or (op == "<=" and lo <= val)
+                            or (op == ">" and hi > val)
+                            or (op == ">=" and hi >= val)):
+                        good = False
+                        break
+                if good:
+                    ok.append(i)
+            prev = keep.get(fileno)
+            if prev is None:
+                keep[fileno] = ok
+            else:
+                prev_set = set(prev)
+                keep[fileno] = [i for i in ok if i in prev_set]
+        for fileno, ok in keep.items():
+            total += by_fileno_nblocks.get(fileno, 0)
+            kept += len(ok)
+        return keep, kept, total
+
     def read_segment(self, table: str, seg: int, columns: list[str] | None = None,
-                     snapshot: dict | None = None):
-        """-> (cols: {name: np.ndarray}, valids: {name: np.ndarray|None}, nrows)."""
+                     snapshot: dict | None = None, prune: tuple | None = None):
+        """-> (cols: {name: np.ndarray}, valids: {name: np.ndarray|None}, nrows).
+
+        ``prune``: zone-map predicates [(col, op, value)] — blocks they rule
+        out are skipped for EVERY requested column (block partitioning is
+        identical across a fileno's columns), shrinking the staged rows."""
         schema = self.catalog.get(table)
         snap = snapshot or self.manifest.snapshot()
         tmeta = snap["tables"].get(table, {"segfiles": {}, "nrows": {}})
@@ -316,6 +374,11 @@ class TableStore:
         valids: dict[str, np.ndarray | None] = {}
         nrows = tmeta["nrows"].get(str(seg), 0)
         base = os.path.join(self.data_root(seg), table)
+        keep = None
+        self.last_prune = None
+        if prune:
+            keep, kept_n, total_n = self._kept_blocks(files, base, prune)
+            self.last_prune = (kept_n, total_n)
         for name in want:
             if name.startswith("@hp:"):
                 # host-evaluated predicate over a raw TEXT column: the
@@ -337,7 +400,12 @@ class TableStore:
             for rel in files:
                 fn = os.path.basename(rel)
                 if fn.startswith(name + ".") and fn.endswith(".ggb"):
-                    arr = read_column_file(os.path.join(base, rel))
+                    bidx = None
+                    if keep is not None:
+                        parts = fn.split(".")
+                        fileno = parts[1] if len(parts) >= 3 else None
+                        bidx = keep.get(fileno)
+                    arr = read_column_file(os.path.join(base, rel), bidx)
                     if fn.endswith(".valid.ggb"):
                         valid_parts.append((rel, arr))
                     else:
@@ -355,8 +423,10 @@ class TableStore:
                 valids[name] = np.concatenate(vs).astype(bool)
             else:
                 valids[name] = None
-            if len(cols[name]) != nrows:
+            if keep is None and len(cols[name]) != nrows:
                 raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
+        if keep is not None and want:
+            nrows = len(next(iter(cols.values()))) if cols else 0
         return cols, valids, nrows
 
     # ---- raw TEXT columns (varlena analog) -----------------------------
