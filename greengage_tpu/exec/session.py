@@ -72,6 +72,12 @@ class Database:
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
                              on_change=self.catalog._save)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
+        # serializes write/DDL statements across threads sharing this
+        # Database (server connections); readers stay lock-free on
+        # manifest snapshots
+        import threading
+
+        self._write_lock = threading.RLock()
 
     # ------------------------------------------------------------------
     def sql(self, text: str):
@@ -87,6 +93,12 @@ class Database:
             return self._select(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._explain(stmt)
+        # every other statement mutates shared state (catalog, manifest,
+        # dictionaries, settings, tx) — one writer at a time per process
+        with self._write_lock:
+            return self._execute_write(stmt)
+
+    def _execute_write(self, stmt):
         if isinstance(stmt, A.CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, A.DropTableStmt):
@@ -403,9 +415,20 @@ class Database:
     # republishes in one manifest commit (the visimap/SplitUpdate roles,
     # reference: src/backend/access/appendonly visimap + nodeSplitUpdate.c)
     # ------------------------------------------------------------------
-    def _check_no_tx(self, what: str):
-        if self.dtm.current is not None and self.dtm.current.state == "active":
-            raise SqlError(f"{what} inside a transaction is not supported yet")
+    def _tx_for_dml(self, table: str, what: str):
+        """DML inside a transaction stages a replacement built from the
+        COMMITTED snapshot (tx reads see committed data only, like every
+        read here), so a table already written in this tx cannot also be
+        rewritten — the replacement would silently drop the tx's rows."""
+        tx = self.dtm.current
+        if tx is None or tx.state != "active":
+            return None
+        if table in tx.tables_written:
+            raise SqlError(
+                f"{what}: table was already modified in this transaction "
+                "(DML reads the committed snapshot; interleaved rewrite "
+                "would lose the transaction's own writes)")
+        return tx
 
     def _run_raw(self, sel_stmt):
         planned, consts, outs = self._plan(sel_stmt)
@@ -421,15 +444,17 @@ class Database:
 
     def _delete(self, stmt: A.DeleteStmt):
         self._check_no_raw_dml(stmt.table)
-        self._check_no_tx("DELETE")
+        tx = self._tx_for_dml(stmt.table, "DELETE")
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)
         total = sum(self.store.segment_rowcounts(stmt.table))
         if stmt.where is None:
-            self.store.replace_contents(
-                stmt.table,
-                {c.name: np.empty(0, dtype=c.type.np_dtype) for c in schema.columns},
-                {})
+            empty = {c.name: np.empty(0, dtype=c.type.np_dtype)
+                     for c in schema.columns}
+            if tx is not None:
+                tx.replace(stmt.table, empty, {})
+            else:
+                self.store.replace_contents(stmt.table, empty, {})
             return f"DELETE {total}"
         # survivors: predicate false OR NULL
         survive = A.Bin("or", A.Unary("not", stmt.where), A.IsNullTest(stmt.where, False))
@@ -443,12 +468,15 @@ class Database:
             v = res.valids.get(o.id)
             if v is not None:
                 valids[c.name] = v
-        self.store.replace_contents(stmt.table, enc, valids)
+        if tx is not None:
+            tx.replace(stmt.table, enc, valids)
+        else:
+            self.store.replace_contents(stmt.table, enc, valids)
         return f"DELETE {total - len(res)}"
 
     def _update(self, stmt: A.UpdateStmt):
         self._check_no_raw_dml(stmt.table)
-        self._check_no_tx("UPDATE")
+        tx = self._tx_for_dml(stmt.table, "UPDATE")
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)
         seen = set()
@@ -527,7 +555,10 @@ class Database:
             enc[c.name] = merged.astype(c.type.np_dtype)
             if not mergedv.all():
                 valids[c.name] = mergedv
-        self.store.replace_contents(stmt.table, enc, valids)
+        if tx is not None:
+            tx.replace(stmt.table, enc, valids)
+        else:
+            self.store.replace_contents(stmt.table, enc, valids)
         return f"UPDATE {int(mask.sum())}"
 
     # ------------------------------------------------------------------

@@ -56,6 +56,15 @@ def cmd_replicate(args):
     return 0
 
 
+def cmd_vacuum(args):
+    """Reclaim unreferenced segment files (rolled-back/stale writers)."""
+    db = _open(args.dir)
+    db.store.reap_gc()
+    n = db.store.sweep_orphans(args.grace)
+    print(f"vacuum: removed {n} orphaned files")
+    return 0
+
+
 def cmd_analyze(args):
     """analyzedb analog: refresh planner statistics."""
     db = _open(args.dir)
@@ -91,7 +100,44 @@ def cmd_state(args):
     return 0
 
 
+def cmd_server(args):
+    """gpstart-style serving mode: listen on a unix socket until killed."""
+    from greengage_tpu.runtime.server import SqlServer
+
+    db = _open(args.dir)
+    srv = SqlServer(db, args.socket)
+    srv.start()
+    print(f"serving {args.dir} on {args.socket} (ctrl-c to stop)")
+    import signal
+
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
 def cmd_sql(args):
+    if not getattr(args, "socket", None) and not args.dir:
+        print("error: sql requires -d DIR (embedded) or -s SOCKET (server)",
+              file=sys.stderr)
+        return 1
+    if getattr(args, "socket", None):
+        from greengage_tpu.runtime.server import SqlClient
+
+        c = SqlClient(args.socket)
+        resp = c.sql(args.query)
+        if resp.get("tag") is not None:
+            print(resp["tag"])
+        elif resp.get("columns") is not None:
+            print("\t".join(resp["columns"]))
+            for row in resp["rows"]:
+                print("\t".join("" if v is None else str(v) for v in row))
+            print(f"({len(resp['rows'])} rows)")
+        c.close()
+        return 0
     db = _open(args.dir)
     out = db.sql(args.query)
     if isinstance(out, str):
@@ -121,6 +167,9 @@ def cmd_recover(args):
     rolled = db.store.manifest.recover()
     if rolled:
         print(f"rolled back in-doubt transactions: versions {rolled}")
+    swept = db.store.sweep_orphans()
+    if swept:
+        print(f"reclaimed {swept} orphaned segment files")
     cfg = db.catalog.segments
     # full recovery (gprecoverseg -F / buildMirrorSegments full rebuild):
     # any content served by a promoted mirror gets its original primary
@@ -261,6 +310,11 @@ def main(argv=None):
     p.add_argument("-d", "--dir", required=True)
     p.set_defaults(fn=cmd_replicate)
 
+    p = sub.add_parser("vacuum")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--grace", type=float, default=120.0)
+    p.set_defaults(fn=cmd_vacuum)
+
     p = sub.add_parser("analyze")
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("-t", "--table", default=None)
@@ -272,9 +326,15 @@ def main(argv=None):
     p.set_defaults(fn=cmd_state)
 
     p = sub.add_parser("sql")
-    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
     p.add_argument("query")
     p.set_defaults(fn=cmd_sql)
+
+    p = sub.add_parser("server")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-s", "--socket", required=True)
+    p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("expand")
     p.add_argument("-d", "--dir", required=True)

@@ -24,6 +24,7 @@ class Transaction:
         self.store = store
         self.tx = store.manifest.begin()
         self.tables_written: set[str] = set()
+        self._gc: list = []       # (table, old rels) GC'd after commit
         self.state = "active"     # active | prepared | committed | aborted
 
     def insert(self, table: str, columns, valids=None) -> int:
@@ -32,6 +33,21 @@ class Transaction:
         n = self.store.insert(table, columns, valids, tx=self.tx)
         self.tables_written.add(table)
         return n
+
+    def replace(self, table: str, enc, valids) -> None:
+        """Stage a DELETE/UPDATE republish; the old files become
+        unreachable at commit and are GC'd then, the NEW files are
+        reclaimed if the transaction rolls back."""
+        if self.state != "active":
+            raise TransactionError(f"transaction is {self.state}")
+        old = self.store.stage_replace(self.tx, table, enc, valids)
+        new_rels = [rel for files in self.tx["tables"][table]["segfiles"].values()
+                    for rel in files]
+        if not hasattr(self, "_staged_new"):
+            self._staged_new = []
+        self._staged_new.append((table, new_rels))
+        self._gc.append((table, old))
+        self.tables_written.add(table)
 
     def commit(self) -> None:
         if self.state != "active":
@@ -43,22 +59,38 @@ class Transaction:
         try:
             version = self.store.manifest.prepare(self.tx)
         except RuntimeError as e:
-            self.state = "aborted"
+            self.abort()
             raise TransactionError(str(e))
         self.state = "prepared"
+        self._prepared_version = version
         faults.check("dtx_after_prepare")       # crash here -> recover() rolls back
-        for t in self.tables_written:
-            self.store.flush_dicts(t)
-        faults.check("dtx_before_commit")
-        self.store.manifest.commit(version)
+        try:
+            for t in self.tables_written:
+                self.store.flush_dicts(t)
+            faults.check("dtx_before_commit")
+            self.store.manifest.commit(version)
+        except BaseException:
+            # release the version claim: a stale claim would block every
+            # writer until recover() (r2 review finding)
+            self.store.manifest.abort(version)
+            self.state = "aborted"
+            raise
         self.state = "committed"
+        for table, rels in self._gc:
+            self.store.gc_files(table, rels)
 
     def abort(self) -> None:
         if self.state in ("committed",):
             raise TransactionError("already committed")
+        if self.state == "prepared" and getattr(self, "_prepared_version", None):
+            self.store.manifest.abort(self._prepared_version)
         self.state = "aborted"
         for t in self.tables_written:
             self.store._invalidate_dicts(t)
+        # the replacement files staged by in-tx DML are manifest-unreachable
+        # now; reclaim them instead of leaking a table copy per rollback
+        for table, new_rels in getattr(self, "_staged_new", []):
+            self.store.gc_files(table, new_rels, defer=False)
 
 
 class DtmSession:

@@ -1,0 +1,126 @@
+"""Line-protocol SQL server — the postmaster/libpq listener analog.
+
+A thin concurrent front end over one Database: clients connect to a unix
+socket (or TCP port) and exchange newline-delimited JSON:
+
+    -> {"sql": "select ..."}
+    <- {"ok": true, "columns": [...], "rows": [[...], ...], "tag": null}
+    <- {"ok": false, "error": "..."}
+
+Reference parity: exec_simple_query serving many clients
+(src/backend/tcop/postgres.c:1622). Each connection gets a thread; SELECTs
+run lock-free on manifest snapshots, write statements serialize on the
+session write lock (one writer gang at a time), so concurrent COPY +
+SELECT + UPDATE interleave safely. Session-scoped state (BEGIN/COMMIT) is
+per-Database, not per-connection, so transactions over the wire are
+rejected — a connection-scoped transaction manager is the next step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+
+
+def _encode_value(v):
+    import numpy as np
+
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    return str(v)
+
+
+class SqlServer:
+    def __init__(self, db, socket_path: str):
+        self.db = db
+        self.socket_path = socket_path
+        self._server = None
+        self._thread = None
+        self.connections_served = 0
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.remove(self.socket_path)
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                outer.connections_served += 1
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        sql = req["sql"]
+                        from greengage_tpu.sql import ast as A
+                        from greengage_tpu.sql.parser import parse
+
+                        if any(isinstance(st, A.TxStmt) for st in parse(sql)):
+                            raise ValueError(
+                                "transactions are per-session; not "
+                                "available over the wire yet")
+                        out = outer.db.sql(sql)
+                        if isinstance(out, str) or out is None:
+                            resp = {"ok": True, "columns": None,
+                                    "rows": None, "tag": out}
+                        else:
+                            resp = {
+                                "ok": True,
+                                "columns": list(out.columns),
+                                "rows": [[_encode_value(v) for v in row]
+                                         for row in out.rows()],
+                                "tag": None,
+                            }
+                    except Exception as e:   # per-statement error isolation
+                        resp = {"ok": False, "error": f"{e}"}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(self.socket_path, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="gg-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if os.path.exists(self.socket_path):
+            os.remove(self.socket_path)
+
+
+class SqlClient:
+    """Tiny client for the line protocol (the psql/libpq stand-in)."""
+
+    def __init__(self, socket_path: str):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(socket_path)
+        self._f = self._sock.makefile("rwb")
+
+    def sql(self, text: str):
+        self._f.write((json.dumps({"sql": text}) + "\n").encode())
+        self._f.flush()
+        resp = json.loads(self._f.readline())
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "server error"))
+        return resp
+
+    def close(self):
+        self._f.close()
+        self._sock.close()

@@ -45,7 +45,12 @@ class Manifest:
         return os.path.join(self.root, f"manifest.{version}.prepared")
 
     def prepare(self, tx: dict) -> int:
-        """Phase 1: durably stage the new manifest. Returns new version."""
+        """Phase 1: durably stage the new manifest. Returns new version.
+
+        The staged file is claimed with an EXCLUSIVE hard link: two writers
+        racing past the version check cannot both stage version v — the
+        loser gets the same write-write conflict it would have gotten from
+        the version check (the CAS is atomic, not just check-then-write)."""
         current = self.snapshot()
         if current["version"] != tx["base_version"]:
             raise RuntimeError(
@@ -59,15 +64,36 @@ class Manifest:
             json.dump(data, f, indent=1)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, staged)
+        try:
+            os.link(tmp, staged)
+        except FileExistsError:
+            os.remove(tmp)
+            raise RuntimeError(
+                f"write-write conflict: version v{version} already prepared "
+                "by a concurrent writer")
+        os.remove(tmp)
         return version
 
     def commit(self, version: int) -> None:
-        """Phase 2: the atomic commit point."""
+        """Phase 2: the atomic commit point (copy + atomic replace).
+
+        The staged file is KEPT as a permanent claim on its version
+        number: a concurrent writer that read the manifest just before
+        this commit still holds the old version and would otherwise
+        re-prepare (and later clobber) this version — its exclusive link
+        against the surviving claim turns that into the write-write
+        conflict it is. Claims are tiny and GC'd far behind the head by
+        recover()."""
         staged = self._staged_path(version)
         if not os.path.exists(staged):
             raise RuntimeError(f"no prepared manifest v{version}")
-        os.replace(staged, self.path)
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".manifest")
+        with os.fdopen(fd, "wb") as f:
+            with open(staged, "rb") as src:
+                f.write(src.read())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
 
     def abort(self, version: int) -> None:
         staged = self._staged_path(version)
@@ -76,13 +102,19 @@ class Manifest:
 
     def recover(self) -> list[int]:
         """In-doubt resolution (cdbdtxrecovery.c analog): roll back any
-        prepared-but-uncommitted manifests found after a crash."""
+        prepared-but-uncommitted manifests (version ABOVE the committed
+        head) found after a crash; claims at or below the head are the
+        committed versions' permanent markers (GC'd once far behind)."""
+        current = self.snapshot().get("version", 0)
         rolled = []
         for fn in os.listdir(self.root):
             if fn.startswith("manifest.") and fn.endswith(".prepared"):
                 v = int(fn.split(".")[1])
-                os.remove(os.path.join(self.root, fn))
-                rolled.append(v)
+                if v > current:
+                    os.remove(os.path.join(self.root, fn))
+                    rolled.append(v)
+                elif v < current - 64:
+                    os.remove(os.path.join(self.root, fn))
         return rolled
 
     def commit_tx(self, tx: dict) -> int:

@@ -57,6 +57,15 @@ class _RawChunk:
         return self._strs
 
 
+def merge_segfile_records(tx: dict, table: str, records: list) -> None:
+    """Merge staged-file records [(seg, [rel files], nrows)] into a
+    manifest transaction (idempotent re-apply for optimistic write retry)."""
+    tmeta = tx["tables"].setdefault(table, {"segfiles": {}, "nrows": {}})
+    for seg, rels, n in records:
+        tmeta["segfiles"].setdefault(str(seg), []).extend(rels)
+        tmeta["nrows"][str(seg)] = tmeta["nrows"].get(str(seg), 0) + n
+
+
 def mirror_root(root: str, content: int) -> str:
     """Directory tree holding content ``content``'s replicated files (the
     mirror segment's data directory — on a real deployment a different
@@ -190,6 +199,9 @@ class TableStore:
         nrows = None
         enc: dict[str, np.ndarray] = {}
         raw_strs: dict[str, np.ndarray] = {}   # raw-encoded TEXT columns
+        dict_sizes = {c.name: len(self.dictionary(table, c.name))
+                      for c in schema.columns
+                      if c.type.kind is T.Kind.TEXT and c.encoding != "raw"}
         for c in schema.columns:
             if c.name not in columns:
                 raise ValueError(f"missing column {c.name}")
@@ -247,19 +259,44 @@ class TableStore:
             seg_of = self._placement(schema, enc, valids, nrows, total_existing)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
 
-        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, fileno,
-                             raw_strs=raw_strs)
+        records = self._write_segfiles(schema, tmeta, enc, valids, seg_rows,
+                                       fileno, raw_strs=raw_strs)
 
         if own_tx:
             # Ordering: stage files -> prepare (version CAS = the write lock)
-            # -> persist dictionaries (fsynced; superset-safe) -> commit. A
-            # losing concurrent writer fails at prepare() before its in-memory
-            # dictionary extensions ever reach disk.
-            try:
-                v = self.manifest.prepare(tx)
-            except RuntimeError:
+            # -> persist dictionaries (fsynced; superset-safe) -> commit.
+            # A concurrent-writer CAS conflict RETRIES against the fresh
+            # snapshot: the staged files are tx-unique and remain valid, so
+            # only the manifest record needs re-merging (the appendonly
+            # writer's segfile-concurrency model — writers never block
+            # readers and autocommit writers serialize optimistically).
+            import time as _time
+
+            # a CROSS-PROCESS retry is only safe when this insert assigned
+            # no new dictionary codes: a concurrent writer in another
+            # process may have claimed the same codes for different words
+            # (in-process writers share Dictionary objects and serialize on
+            # the session write lock, so they never hit this)
+            dict_grew = any(
+                len(self.dictionary(table, n)) != sz
+                for n, sz in dict_sizes.items())
+            last = None
+            for attempt in range(20):
+                try:
+                    v = self.manifest.prepare(tx)
+                    break
+                except RuntimeError as e:
+                    last = e
+                    if dict_grew:
+                        self._invalidate_dicts(table)
+                        raise
+                    _time.sleep(0.01 * (attempt + 1))
+                    tx = self.manifest.begin()
+                    merge_segfile_records(tx, table, records)
+            else:
                 self._invalidate_dicts(table)
-                raise
+                raise RuntimeError(
+                    f"write-write conflict persisted after retries: {last}")
             self.flush_dicts(table)
             self.manifest.commit(v)
         else:
@@ -600,12 +637,14 @@ class TableStore:
                 pass
         return nrows
 
-    def replace_contents(self, table: str, enc: dict, valids: dict) -> None:
-        """Atomically replace a table's rows (DELETE/UPDATE republish).
-        ``enc`` holds storage-representation arrays (TEXT = dictionary
-        codes); placement is recomputed, so updated distribution keys move
-        rows to their new owner segments (SplitUpdate's explicit
-        redistribution analog, src/backend/executor/nodeSplitUpdate.c)."""
+    def stage_replace(self, tx: dict, table: str, enc: dict, valids: dict) -> list:
+        """Stage a full-table replacement into a manifest transaction.
+        Returns the OLD file rels (unreachable once the tx commits; the
+        caller GCs them post-commit). ``enc`` holds storage-representation
+        arrays (TEXT = dictionary codes); placement is recomputed, so
+        updated distribution keys move rows to their new owner segments
+        (SplitUpdate's explicit redistribution analog,
+        src/backend/executor/nodeSplitUpdate.c)."""
         from greengage_tpu.catalog.schema import PolicyKind
 
         schema = self.catalog.get(table)
@@ -619,13 +658,12 @@ class TableStore:
                 raise ValueError(
                     f'null value in column "{c.name}" violates not-null constraint')
         nseg = schema.policy.numsegments
-        snap = self.manifest.snapshot()
         old_files = [
-            rel for files in snap["tables"].get(table, {"segfiles": {}})["segfiles"].values()
+            rel for files in tx["tables"].get(
+                table, {"segfiles": {}})["segfiles"].values()
             for rel in files
         ]
         nrows = len(next(iter(enc.values()))) if enc else 0
-        tx = self.manifest.begin()
         tx["tables"][table] = {"segfiles": {}, "nrows": {},
                                "numsegments": nseg}
         tmeta = tx["tables"][table]
@@ -639,13 +677,93 @@ class TableStore:
             seg_of = (np.arange(nrows) % nseg).astype(np.int32)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
         self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
-        v = self.manifest.prepare(tx)
-        self.manifest.commit(v)
-        for rel in old_files:
+        return old_files
+
+    GC_GRACE_S = 30.0   # snapshot readers finish well within this
+
+    def gc_files(self, table: str, rels: list, defer: bool = True) -> None:
+        """Reclaim files made unreachable by a commit. Deletion is DEFERRED
+        by a grace period: concurrent lock-free readers may still be
+        scanning these files from an older snapshot (the server's
+        concurrent SELECT vs UPDATE interleaving). defer=False deletes
+        immediately (rollback of files nobody else ever saw)."""
+        import time as _time
+
+        if defer:
+            if not hasattr(self, "_pending_gc"):
+                self._pending_gc = []
+            self._pending_gc.append((_time.monotonic(), table, list(rels)))
+            self.reap_gc()
+            return
+        for rel in rels:
             try:
                 os.remove(self.seg_file_path(table, rel))
             except OSError:
                 pass
+
+    def reap_gc(self) -> int:
+        """Delete deferred-GC entries older than the grace period."""
+        import time as _time
+
+        pend = getattr(self, "_pending_gc", [])
+        now = _time.monotonic()
+        keep, removed = [], 0
+        for ts, table, rels in pend:
+            if now - ts >= self.GC_GRACE_S:
+                self.gc_files(table, rels, defer=False)
+                removed += len(rels)
+            else:
+                keep.append((ts, table, rels))
+        self._pending_gc = keep
+        return removed
+
+    def sweep_orphans(self, grace_s: float = 120.0) -> int:
+        """Delete segment files not referenced by the current manifest and
+        older than ``grace_s`` (crashed writers' staging, rolled-back DML
+        from dead processes, deferred GC lost at exit) — the VACUUM role.
+        Recent files are spared: they may belong to an in-flight write."""
+        import time as _time
+
+        snap = self.manifest.snapshot()
+        referenced = set()
+        for tname, tmeta in snap.get("tables", {}).items():
+            for files in tmeta.get("segfiles", {}).values():
+                for rel in files:
+                    referenced.add((tname, os.path.basename(rel)))
+        removed = 0
+        now = _time.time()
+        for root in {os.path.join(self.root, "data")}:
+            if not os.path.isdir(root):
+                continue
+            for tname in os.listdir(root):
+                tdir = os.path.join(root, tname)
+                if not os.path.isdir(tdir):
+                    continue
+                for segdir in os.listdir(tdir):
+                    sdir = os.path.join(tdir, segdir)
+                    if not segdir.startswith("seg") or not os.path.isdir(sdir):
+                        continue
+                    for fn in os.listdir(sdir):
+                        if not fn.endswith(".ggb"):
+                            continue
+                        if (tname, fn) in referenced:
+                            continue
+                        p = os.path.join(sdir, fn)
+                        try:
+                            if now - os.path.getmtime(p) >= grace_s:
+                                os.remove(p)
+                                removed += 1
+                        except OSError:
+                            pass
+        return removed
+
+    def replace_contents(self, table: str, enc: dict, valids: dict) -> None:
+        """Autocommit full-table replacement (see stage_replace)."""
+        tx = self.manifest.begin()
+        old_files = self.stage_replace(tx, table, enc, valids)
+        v = self.manifest.prepare(tx)
+        self.manifest.commit(v)
+        self.gc_files(table, old_files)
 
     def reconcile_widths(self) -> None:
         """Crash recovery for expansion: the manifest's per-table width is
@@ -667,16 +785,20 @@ class TableStore:
             self.catalog._save()
 
     def _write_segfiles(self, schema, tmeta, enc, valids, seg_rows, fileno,
-                        raw_strs=None) -> None:
+                        raw_strs=None) -> list:
+        """Write per-segment column files, record them in ``tmeta``, and
+        return the records for optimistic-retry re-merge."""
         compresstype = schema.options.get("compresstype", "zlib")
         complevel = int(schema.options.get("compresslevel", 1))
         raw_strs = raw_strs or {}
+        records: list = []
         for s, idx in enumerate(seg_rows):
             if len(idx) == 0:
                 continue
             segdir = os.path.join(self.data_root(s), schema.name, f"seg{s}")
             os.makedirs(segdir, exist_ok=True)
             files = tmeta["segfiles"].setdefault(str(s), [])
+            files_before = len(files)
             for c in schema.columns:
                 if c.name in raw_strs:
                     # raw TEXT: utf-8 byte blob + row offsets (varlena-style
@@ -712,6 +834,8 @@ class TableStore:
                                       compresstype, complevel)
                     files.append(os.path.join(f"seg{s}", vfn))
             tmeta["nrows"][str(s)] = tmeta["nrows"].get(str(s), 0) + int(len(idx))
+            records.append((s, list(files[files_before:]), int(len(idx))))
+        return records
 
     def has_nulls(self, table: str, col: str, snapshot: dict | None = None) -> bool:
         """True if any committed segfile of this column has a validity file

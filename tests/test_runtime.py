@@ -110,11 +110,23 @@ def test_tx_crash_between_prepare_and_commit(db):
     db.sql("insert into t values (7, 70)")
     with pytest.raises(FaultError):
         db.sql("commit")
-    # prepared-but-uncommitted: invisible; recovery rolls it back
+    # in-process failure SELF-HEALS (r2): the version claim is released in
+    # the error path, nothing stays in doubt, and new writes proceed
     assert db.sql("select count(*) from t").rows()[0][0] == 4
+    assert db.store.manifest.recover() == []
+    db.sql("insert into t values (70, 700)")
+    assert db.sql("select count(*) from t").rows()[0][0] == 5
+    db.sql("delete from t where k = 70")
+
+    # a REAL crash leaves the prepared-but-uncommitted manifest behind (no
+    # cleanup code ran): recover() must roll it back, unblocking writers
+    tx = db.store.manifest.begin()
+    v = db.store.manifest.prepare(tx)
     rolled = db.store.manifest.recover()
-    assert rolled
+    assert rolled == [v]
     assert db.sql("select count(*) from t").rows()[0][0] == 4
+    db.sql("insert into t values (71, 710)")
+    db.sql("delete from t where k = 71")
 
 
 def test_tx_nesting_rejected(db):
