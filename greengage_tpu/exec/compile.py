@@ -66,12 +66,13 @@ class CompileResult:
     # the retry capacity from the exact cardinality the device reported
     flag_caps: dict = field(default_factory=dict)
     est_bytes: int = 0                 # rough per-segment device allocation
+    node_rows: dict = field(default_factory=dict)  # metric -> plan node id
 
 
 class Compiler:
     def __init__(self, catalog, store, mesh, nseg: int, consts: dict,
                  settings: Settings, tier: int = 0,
-                 cap_overrides: dict | None = None):
+                 cap_overrides: dict | None = None, instrument: bool = False):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -88,6 +89,8 @@ class Compiler:
         self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
         self.scan_count: dict[str, int] = {}
         self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
+        self.instrument = instrument      # EXPLAIN ANALYZE per-node rows
+        self.node_rows: dict[str, int] = {}   # metric name -> plan node id
 
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
@@ -189,6 +192,7 @@ class Compiler:
             metric_names=metric_names,
             flag_caps=dict(self.flag_caps),
             est_bytes=self._estimate_bytes(below),
+            node_rows=dict(self.node_rows),
         )
 
     def _estimate_bytes(self, plan: Plan) -> int:
@@ -340,7 +344,22 @@ class Compiler:
     # node compilation (returns closures ctx -> Batch)
     # ------------------------------------------------------------------
     def _compile_node(self, plan: Plan):
-        return getattr(self, "_c_" + type(plan).__name__.lower())(plan)
+        fn = getattr(self, "_c_" + type(plan).__name__.lower())(plan)
+        if not self.instrument:
+            return fn
+        # per-node output row counter (the INSTRUMENT_CDB / explain_gp.c
+        # per-operator Instrumentation analog): one cheap reduction per node
+        mid = f"nrows_{len(self.metrics)}"
+        self.metrics.append(mid)
+        self.node_rows[mid] = id(plan)
+
+        def counted(ctx):
+            b = fn(ctx)
+            ctx["metrics"].append(
+                (mid, jnp.sum(b.selection().astype(jnp.int64))))
+            return b
+
+        return counted
 
     def _c_scan(self, plan: Scan):
         table = plan.table
@@ -824,18 +843,19 @@ class Compiler:
                                  if okeys else jnp.ones((cap,), bool))
 
             funcs = []
-            for ci, fname, arg, ordered in wfuncs:
+            for ci, fname, arg, ordered, param in wfuncs:
                 vals, valid, scale = None, None, 0
                 if arg is not None:
                     vals, valid = ev.value(arg)
                     if arg.type.kind is T.Kind.DECIMAL:
                         scale = arg.type.scale
                 funcs.append(win_ops.WinFunc(ci.id, fname, vals, valid,
-                                             scale, ordered))
-            wvals, wvalids = win_ops.compute(part_eq, peer_eq, sel_sorted, funcs)
+                                             scale, ordered, param))
+            wvals, wvalids = win_ops.compute(part_eq, peer_eq, sel_sorted,
+                                             funcs, frame=plan.frame)
             out_c = dict(sb.cols)
             out_v = dict(sb.valids)
-            for ci, _, _, _ in wfuncs:
+            for ci, *_ in wfuncs:
                 out_c[ci.id] = wvals[ci.id]
                 if wvalids.get(ci.id) is not None:
                     out_v[ci.id] = wvalids[ci.id]

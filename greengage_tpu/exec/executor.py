@@ -66,7 +66,13 @@ class Result:
         import pandas as pd
 
         data = {}
-        for name, cid in zip(self.columns, self._order):
+        names = []
+        seen: dict = {}
+        for name in self.columns:   # dedupe: two count() outputs must not
+            k = seen.get(name, 0)   # collapse into one DataFrame column
+            seen[name] = k + 1
+            names.append(name if k == 0 else f"{name}_{k}")
+        for name, cid in zip(names, self._order):
             col = self.cols[cid]
             v = self.valids.get(cid)
             if v is not None:
@@ -91,7 +97,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, plan, consts: dict, out_cols, cache_key=None,
-            raw: bool = False) -> Result:
+            raw: bool = False, instrument: bool = False) -> Result:
         self._raw = raw
         t0 = time.monotonic()
         snapshot = self.store.manifest.snapshot()
@@ -100,14 +106,15 @@ class Executor:
         cap_overrides: dict = {}
         for tier in range(self.settings.motion_retry_tiers):
             ck = ((cache_key, version, tier) if cache_key is not None
-                  and not cap_overrides else None)
+                  and not cap_overrides and not instrument else None)
             was_cached = ck is not None and ck in self._plan_cache
             if was_cached:
                 comp = self._plan_cache[ck]
             else:
                 comp = Compiler(self.catalog, self.store, self.mesh, self.nseg,
                                 consts, self.settings, tier=tier,
-                                cap_overrides=cap_overrides).compile(plan)
+                                cap_overrides=cap_overrides,
+                                instrument=instrument).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -157,7 +164,14 @@ class Executor:
                     "zone_prune": dict(getattr(self, "_last_prune_stats", {})),
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
-                    "metrics": {k: int(np.max(v)) for k, v in metrics.items()},
+                    # per-node row counters SUM across segments; capacity
+                    # metrics report the per-segment max
+                    "metrics": {k: (int(np.sum(v)) if k.startswith("nrows_")
+                                    else int(np.max(v)))
+                                for k, v in metrics.items()},
+                    "node_rows": {comp.node_rows[k]: int(np.sum(v))
+                                  for k, v in metrics.items()
+                                  if k in comp.node_rows},
                 }
                 return res
             # size the retry from exact cardinalities where the device

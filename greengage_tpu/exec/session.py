@@ -280,8 +280,13 @@ class Database:
         planned, consts, outs = self._plan(stmt.query)
         text = describe(planned)
         if stmt.analyze:
-            res = self.executor.run(planned, consts, outs)
+            # per-node instrumentation (explain_gp.c's Instrumentation
+            # tree analog): every operator reports its actual output rows
+            res = self.executor.run(planned, consts, outs, instrument=True)
             s = res.stats or {}
+            annot = {pid: f"actual rows={n}"
+                     for pid, n in (s.get("node_rows") or {}).items()}
+            text = describe(planned, annot=annot)
             text += (
                 f"\n Execution time: {res.wall_ms:.2f} ms, rows: {len(res)}"
                 f"\n Segments: {s.get('segments')}, capacity tiers used: "
@@ -289,7 +294,8 @@ class Database:
                 f"{s.get('below_gather_capacity')}"
                 f"\n Tables scanned: {', '.join(s.get('scan_tables', []))}")
             for k, v in (s.get("metrics") or {}).items():
-                text += f"\n {k}: {v}"
+                if not k.startswith("nrows_"):
+                    text += f"\n {k}: {v}"
         r = Result(columns=["QUERY PLAN"],
                    cols={"p": np.array(text.split("\n"), dtype=object)},
                    valids={}, _order=["p"])
@@ -371,44 +377,97 @@ class Database:
         delim = stmt.options.get("delimiter", ",")
         header = str(stmt.options.get("header", "false")).lower() in ("true", "1")
         null_s = stmt.options.get("null", "")
-        # native fast path (fstream/gpfdist parsing analog); quoted files and
-        # custom null markers fall back to the Python csv reader below
-        try:
-            from greengage_tpu.storage.csv_native import CsvFallback, parse_file
+        reject_limit = stmt.options.get("segment_reject_limit")
+        reject_limit = int(reject_limit) if reject_limit is not None else None
+        is_url = stmt.path.startswith("gpfdist://")
 
-            cols_n, valids_n = parse_file(stmt.path, schema, delim, header, null_s)
-            n = self._write_rows(stmt.table, cols_n, valids_n)
-            return f"COPY {n}"
-        except CsvFallback:
-            pass
-        cols: dict[str, list] = {c.name: [] for c in schema.columns}
-        valids: dict[str, list] = {c.name: [] for c in schema.columns}
-        with open(stmt.path, newline="") as f:
-            rd = _csv.reader(f, delimiter=delim)
-            for i, row in enumerate(rd):
-                if header and i == 0:
-                    continue
-                if len(row) != len(schema.columns):
-                    raise SqlError(f"COPY row {i}: arity mismatch")
-                for c, v in zip(schema.columns, row):
-                    if v == null_s:
-                        valids[c.name].append(False)
-                        cols[c.name].append(_zero_for(c.type))
-                        continue
-                    valids[c.name].append(True)
-                    cols[c.name].append(T.from_string(v, c.type))
+        if not is_url and reject_limit is None:
+            # native fast path (fstream parsing analog); quoted files and
+            # custom null markers fall back to the Python reader below
+            try:
+                from greengage_tpu.storage.csv_native import (CsvFallback,
+                                                              parse_file)
+
+                cols_n, valids_n = parse_file(
+                    stmt.path, schema, delim, header, null_s)
+                n = self._write_rows(stmt.table, cols_n, valids_n)
+                return f"COPY {n}"
+            except CsvFallback:
+                pass
+            except ValueError:
+                # bad data: re-parse via the SREH-aware reader so the error
+                # names the offending line
+                pass
+
+        from greengage_tpu.runtime import ingest
+
+        # chunk sources: gpfdist serves disjoint newline-aligned slices
+        # fetched in parallel (the per-segment external scan role); local
+        # files load as one chunk
+        if is_url:
+            nchunks = max(int(stmt.options.get("chunks", self.numsegments)), 1)
+            chunks = ingest.fetch_chunks(stmt.path, nchunks)
+        else:
+            with open(stmt.path, "rb") as f:
+                chunks = [f.read()]
+
+        all_cols: dict[str, list] = {c.name: [] for c in schema.columns}
+        all_valids: dict[str, list] = {c.name: [] for c in schema.columns}
+        rejects: list = []
+        line_base = 0
+        for ci, blob in enumerate(chunks):
+            try:
+                text = blob.decode("utf-8")
+            except UnicodeDecodeError:
+                # invalid bytes: salvage per line; undecodable lines go to
+                # the reject path instead of silently corrupting TEXT
+                lines = []
+                for li, raw in enumerate(blob.split(b"\n")):
+                    try:
+                        lines.append(raw.decode("utf-8"))
+                    except UnicodeDecodeError:
+                        rejects.append((line_base + li + 1, repr(raw),
+                                        "invalid UTF-8"))
+                text = "\n".join(lines)
+            cols, valids, rej = ingest.parse_csv_rows(
+                text, schema, delim, header and ci == 0, null_s,
+                line_base=line_base)
+            for name in all_cols:
+                all_cols[name].extend(cols[name])
+                all_valids[name].extend(valids[name])
+            rejects.extend(rej)
+            line_base += blob.count(b"\n")
+        if rejects and reject_limit is None:
+            line, raw, err = rejects[0]
+            raise SqlError(f"COPY line {line}: {err}")
+        if reject_limit is not None and len(rejects) > reject_limit:
+            raise SqlError(
+                f"COPY aborted: {len(rejects)} rejected rows exceed "
+                f"SEGMENT REJECT LIMIT {reject_limit}")
+        if rejects:
+            ingest.append_error_log(self.path, stmt.table, rejects)
+
         enc_cols = {}
         enc_valids = {}
         for c in schema.columns:
-            va = np.array(valids[c.name], dtype=bool)
+            va = np.array(all_valids[c.name], dtype=bool)
             if c.type.kind is T.Kind.TEXT:
-                enc_cols[c.name] = cols[c.name]
+                enc_cols[c.name] = all_cols[c.name]
             else:
-                enc_cols[c.name] = np.array(cols[c.name], dtype=c.type.np_dtype)
+                enc_cols[c.name] = np.array(all_cols[c.name], dtype=c.type.np_dtype)
             if not va.all():
                 enc_valids[c.name] = va
         n = self._write_rows(stmt.table, enc_cols, enc_valids)
-        return f"COPY {n}"
+        tag = f"COPY {n}"
+        if rejects:
+            tag += f" (rejected {len(rejects)} rows, logged)"
+        return tag
+
+    def error_log(self, table: str) -> list[dict]:
+        """Rejected-row log for a table (gp_read_error_log analog)."""
+        from greengage_tpu.runtime import ingest
+
+        return ingest.read_error_log(self.path, table)
 
     # ------------------------------------------------------------------
     # DELETE / UPDATE: append-only storage rewrites the surviving rows and

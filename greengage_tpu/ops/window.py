@@ -28,11 +28,14 @@ from jax import lax
 @dataclass
 class WinFunc:
     name: str              # output column id
-    func: str              # row_number | rank | dense_rank | sum | count | avg | min | max
+    func: str              # row_number | rank | dense_rank | sum | count |
+    #                        avg | min | max | lag | lead | first_value |
+    #                        last_value | ntile
     values: jnp.ndarray | None
     valid: jnp.ndarray | None
     decimal_scale: int = 0
     ordered: bool = False  # window had ORDER BY -> running (peer) frame
+    param: int | None = None   # lag/lead offset, ntile buckets
 
 
 def _starts(boundary, idx):
@@ -67,12 +70,16 @@ def _seg_scan_minmax(v, boundary, op):
     return out
 
 
-def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
+def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc],
+            frame: tuple | None = None):
     """Window values over the SORTED batch.
 
     partition_eq_prev[i]: row i has the same partition keys as row i-1
     peer_eq_prev[i]: same partition AND same order keys as row i-1
     (both False at i=0 and for dead rows — dead rows sit at the end).
+    frame: None = default RANGE peers; (a, b) = ROWS a PRECEDING..b
+    FOLLOWING offsets (None = unbounded) applied to sum/count/avg and
+    first/last_value via cumsum span differences clamped to the partition.
     -> {name: values}, {name: valid}
     """
     n = sel_sorted.shape[0]
@@ -83,6 +90,15 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
     peer_start = _starts(peer_bound, idx)
     peer_end = _ends(peer_start, n)
     p_end = _ends(p_start, n)
+
+    def frame_span(has_order):
+        """Per-row inclusive [lo, hi] row range the aggregate covers."""
+        if frame is None:
+            return p_start, (peer_end if has_order else p_end)
+        a, b = frame
+        lo = p_start if a is None else jnp.maximum(p_start, idx - a)
+        hi = p_end if b is None else jnp.minimum(p_end, idx + b)
+        return lo, hi
 
     out_vals, out_valid = {}, {}
     for f in funcs:
@@ -100,9 +116,42 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
             out_valid[f.name] = None
             continue
 
+        if f.func == "ntile":
+            cnt_p = (p_end - p_start + 1).astype(jnp.int64)
+            rn = (idx - p_start).astype(jnp.int64)
+            nb = jnp.int64(f.param)
+            q, r = cnt_p // nb, cnt_p % nb
+            big = r * (q + 1)
+            bucket = jnp.where(
+                rn < big,
+                rn // jnp.maximum(q + 1, 1),
+                r + (rn - big) // jnp.maximum(q, 1))
+            # more buckets than rows: bucket = rn
+            bucket = jnp.where(q == 0, jnp.minimum(rn, nb - 1), bucket)
+            out_vals[f.name] = bucket + 1
+            out_valid[f.name] = None
+            continue
+        if f.func in ("lag", "lead"):
+            k = f.param
+            src = idx - k if f.func == "lag" else idx + k
+            ok = (src >= p_start) if f.func == "lag" else (src <= p_end)
+            srcc = jnp.clip(src, 0, n - 1)
+            out_vals[f.name] = f.values[srcc]
+            v = jnp.ones((n,), bool) if f.valid is None else f.valid
+            out_valid[f.name] = ok & v[srcc] & sel_sorted
+            continue
+        if f.func in ("first_value", "last_value"):
+            lo, hi = frame_span(f.ordered)
+            src = lo if f.func == "first_value" else hi
+            srcc = jnp.clip(src, 0, n - 1)
+            out_vals[f.name] = f.values[srcc]
+            v = jnp.ones((n,), bool) if f.valid is None else f.valid
+            out_valid[f.name] = v[srcc] & (hi >= lo) & sel_sorted
+            continue
+
         has_order = f.ordered
         lv = sel_sorted if f.valid is None else (sel_sorted & f.valid)
-        end = peer_end if has_order else p_end
+        lo_i, end = frame_span(has_order)
         if f.func in ("sum", "count", "avg"):
             if f.func == "count" and f.values is None:
                 vals = jnp.ones((n,), dtype=jnp.int64)
@@ -111,10 +160,12 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
             acc = jnp.float64 if vals.dtype.kind == "f" else jnp.int64
             cs = jnp.cumsum(jnp.where(lv, vals.astype(acc), acc(0)))
             cnt = jnp.cumsum(jnp.where(lv, jnp.int64(1), jnp.int64(0)))
-            base = jnp.where(p_start > 0, cs[jnp.clip(p_start - 1, 0, n - 1)], acc(0))
-            cbase = jnp.where(p_start > 0, cnt[jnp.clip(p_start - 1, 0, n - 1)], 0)
-            s = cs[end] - base
-            c = cnt[end] - cbase
+            base = jnp.where(lo_i > 0, cs[jnp.clip(lo_i - 1, 0, n - 1)], acc(0))
+            cbase = jnp.where(lo_i > 0, cnt[jnp.clip(lo_i - 1, 0, n - 1)], 0)
+            s = cs[jnp.clip(end, 0, n - 1)] - base
+            c = cnt[jnp.clip(end, 0, n - 1)] - cbase
+            s = jnp.where(end >= lo_i, s, acc(0))
+            c = jnp.where(end >= lo_i, c, 0)
             if f.func == "count":
                 out_vals[f.name] = c
                 out_valid[f.name] = None
@@ -140,12 +191,20 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc]):
             run = _seg_scan_minmax(filled, p_bound, op)
             cnt = jnp.cumsum(jnp.where(lv, jnp.int64(1), jnp.int64(0)))
             cbase = jnp.where(p_start > 0, cnt[jnp.clip(p_start - 1, 0, n - 1)], 0)
-            if has_order:
-                out_vals[f.name] = run[peer_end]
-                out_valid[f.name] = (cnt[peer_end] - cbase) > 0
+            # frame semantics (binder allows running/whole ROWS frames only):
+            #   default       -> peers (ordered) / whole partition
+            #   ROWS ..CURRENT ROW   -> running value AT this row
+            #   ROWS ..UNBOUNDED FOLLOWING -> whole partition
+            if frame == (None, 0):
+                end_mm = idx
+            elif frame == (None, None):
+                end_mm = p_end
+            elif has_order:
+                end_mm = peer_end
             else:
-                out_vals[f.name] = run[p_end]
-                out_valid[f.name] = (cnt[p_end] - cbase) > 0
+                end_mm = p_end
+            out_vals[f.name] = run[end_mm]
+            out_valid[f.name] = (cnt[end_mm] - cbase) > 0
             continue
         raise NotImplementedError(f.func)
     return out_vals, out_valid

@@ -144,15 +144,18 @@ class Limit(Plan):
 @dataclass
 class Window(Plan):
     """WindowAgg: per-partition functions over sorted rows (nodeWindowAgg.c).
-    Each wfunc: (out ColInfo, func name, arg Expr|None, ordered)."""
+    Each wfunc: (out ColInfo, func name, arg Expr|None, ordered, param).
+    frame: None (default RANGE ..CURRENT ROW peers) or (preceding,
+    following) ROWS offsets with None = unbounded."""
 
     child: Plan
     partition_keys: list[E.Expr]
     order_keys: list          # (expr, desc, nulls_first)
     wfuncs: list
+    frame: tuple | None = None
 
     def out_cols(self):
-        return self.child.out_cols() + [c for c, _, _, _ in self.wfuncs]
+        return self.child.out_cols() + [c for c, *_ in self.wfuncs]
 
 
 @dataclass
@@ -186,8 +189,9 @@ class Motion(Plan):
         return self.child.out_cols()
 
 
-def describe(plan: Plan, indent: int = 0) -> str:
-    """EXPLAIN-style tree rendering (explain.c analog)."""
+def describe(plan: Plan, indent: int = 0, annot: dict | None = None) -> str:
+    """EXPLAIN-style tree rendering (explain.c analog). ``annot`` maps
+    id(plan) -> string appended per node (EXPLAIN ANALYZE row counts)."""
     pad = "  " * indent
     name = type(plan).__name__
     extra = ""
@@ -207,9 +211,12 @@ def describe(plan: Plan, indent: int = 0) -> str:
         extra = f" {plan.limit}"
     locus = f"  [{plan.locus.describe()}]" if plan.locus else ""
     rows = f" rows={int(plan.est_rows)}" if plan.est_rows else ""
-    lines = [f"{pad}{name}{extra}{locus}{rows}"]
+    note = ""
+    if annot and id(plan) in annot:
+        note = f"  ({annot[id(plan)]})"
+    lines = [f"{pad}{name}{extra}{locus}{rows}{note}"]
     for c in plan.children:
-        lines.append(describe(c, indent + 1))
+        lines.append(describe(c, indent + 1, annot))
     return "\n".join(lines)
 
 

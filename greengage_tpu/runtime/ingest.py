@@ -1,0 +1,236 @@
+"""Parallel file-distribution ingest + single-row error handling.
+
+Reference parity:
+  * gpfdist (src/bin/gpfdist/gpfdist.c): a standalone HTTP server that
+    hands out DISJOINT newline-aligned chunks of a file so many loaders
+    pull in parallel. FileDistServer implements the chunk protocol
+    (``GET /rel/path?chunk=i&nchunks=N``); chunk boundaries snap forward
+    to the next newline so every row belongs to exactly one chunk.
+  * SREH (src/backend/cdb/cdbsreh.c): ``SEGMENT REJECT LIMIT`` semantics —
+    malformed rows are collected into an error log instead of aborting the
+    whole load, up to a limit. parse_csv_rows returns (rows, rejects);
+    the session layer enforces the limit and appends rejects to
+    ``<cluster>/errlog/<table>.jsonl`` (the gp_read_error_log analog).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import socketserver
+import threading
+import urllib.parse
+import urllib.request
+
+
+# ---------------------------------------------------------------------------
+# gpfdist-lite server
+# ---------------------------------------------------------------------------
+
+class FileDistServer:
+    """HTTP chunk server over a directory of load files."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+        self.root = os.path.abspath(root)
+        self.host = host
+        self.port = port
+        self._server = None
+        self._thread = None
+        self.requests_served = 0
+
+    def url(self, relpath: str) -> str:
+        return f"gpfdist://{self.host}:{self.port}/{relpath}"
+
+    def start(self) -> None:
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def do_GET(self):
+                outer.requests_served += 1
+                parsed = urllib.parse.urlparse(self.path)
+                rel = urllib.parse.unquote(parsed.path).lstrip("/")
+                full = os.path.abspath(os.path.join(outer.root, rel))
+                if not full.startswith(outer.root + os.sep) \
+                        and full != outer.root:
+                    self.send_error(403)
+                    return
+                if not os.path.isfile(full):
+                    self.send_error(404)
+                    return
+                q = urllib.parse.parse_qs(parsed.query)
+                try:
+                    data = _read_chunk(
+                        full,
+                        int(q.get("chunk", ["0"])[0]),
+                        int(q.get("nchunks", ["1"])[0]))
+                except ValueError:
+                    self.send_error(400)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/csv")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        class Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="gg-gpfdist", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _newline_after(f, pos: int, size: int) -> int:
+    """First offset AFTER the next newline at/after pos (size if none)."""
+    if pos <= 0:
+        return 0
+    if pos >= size:
+        return size
+    f.seek(pos)
+    while True:
+        buf = f.read(1 << 16)
+        if not buf:
+            return size
+        i = buf.find(b"\n")
+        if i >= 0:
+            return pos + i + 1
+        pos += len(buf)
+
+
+def _read_chunk(path: str, chunk: int, nchunks: int) -> bytes:
+    """Newline-aligned chunk: [align(i*size/N), align((i+1)*size/N))."""
+    if not (0 <= chunk < nchunks):
+        raise ValueError("chunk out of range")
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        start = _newline_after(f, chunk * size // nchunks, size)
+        end = _newline_after(f, (chunk + 1) * size // nchunks, size)
+        f.seek(start)
+        return f.read(end - start)
+
+
+def fetch_chunks(url: str, nchunks: int) -> list[bytes]:
+    """Pull all chunks of a gpfdist:// URL concurrently (the parallel
+    external-table scan role — every segment fetches disjoint slices)."""
+    http_url = "http://" + url[len("gpfdist://"):]
+    out: list = [None] * nchunks
+    errs: list = []
+
+    def one(i):
+        try:
+            with urllib.request.urlopen(
+                    f"{http_url}?chunk={i}&nchunks={nchunks}") as r:
+                out[i] = r.read()
+        except Exception as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(nchunks)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    if errs:
+        raise IOError(f"gpfdist fetch failed: {errs[0]}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SREH CSV parsing
+# ---------------------------------------------------------------------------
+
+def parse_csv_rows(text: str, schema, delim: str, header: bool, null_s: str,
+                   line_base: int = 0):
+    """-> (cols {name: list}, valids {name: list}, rejects [(line, raw,
+    error)]). Malformed rows are REJECTED, not fatal (cdbsreh.c role) —
+    the caller enforces the reject limit."""
+    import csv as _csv
+    import io
+
+    from greengage_tpu import types as T
+
+    cols = {c.name: [] for c in schema.columns}
+    valids = {c.name: [] for c in schema.columns}
+    rejects = []
+    rd = _csv.reader(io.StringIO(text), delimiter=delim)
+    for i, row in enumerate(rd):
+        if header and i == 0:
+            continue
+        if not row:
+            continue
+        if len(row) != len(schema.columns):
+            rejects.append((line_base + i + 1, delim.join(row),
+                            f"expected {len(schema.columns)} columns, "
+                            f"got {len(row)}"))
+            continue
+        parsed_vals = []
+        parsed_valid = []
+        err = None
+        for c, v in zip(schema.columns, row):
+            if v == null_s:
+                parsed_vals.append(_zero_for(c.type))
+                parsed_valid.append(False)
+                continue
+            try:
+                parsed_vals.append(T.from_string(v, c.type))
+                parsed_valid.append(True)
+            except (ValueError, TypeError, ArithmeticError) as e:
+                err = f'column "{c.name}": {e}'
+                break
+        if err is not None:
+            rejects.append((line_base + i + 1, delim.join(row), err))
+            continue
+        for c, v, ok in zip(schema.columns, parsed_vals, parsed_valid):
+            cols[c.name].append(v)
+            valids[c.name].append(ok)
+    return cols, valids, rejects
+
+
+def _zero_for(t):
+    from greengage_tpu import types as T
+
+    if t.kind is T.Kind.TEXT:
+        return ""
+    if t.kind is T.Kind.FLOAT64:
+        return 0.0
+    if t.kind is T.Kind.BOOL:
+        return False
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# error log (gp_read_error_log analog)
+# ---------------------------------------------------------------------------
+
+def append_error_log(root: str, table: str, rejects: list) -> None:
+    d = os.path.join(root, "errlog")
+    os.makedirs(d, exist_ok=True)
+    import time
+
+    with open(os.path.join(d, f"{table}.jsonl"), "a") as f:
+        for line, raw, err in rejects:
+            f.write(json.dumps({"ts": time.time(), "line": line,
+                                "row": raw, "error": err}) + "\n")
+
+
+def read_error_log(root: str, table: str) -> list[dict]:
+    p = os.path.join(root, "errlog", f"{table}.jsonl")
+    if not os.path.exists(p):
+        return []
+    out = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -135,6 +135,9 @@ class CastExpr(ANode):
 class WindowSpec(ANode):
     partition_by: list = field(default_factory=list)
     order_by: list = field(default_factory=list)   # OrderItem
+    # ("rows"|"range", (bound kind, n), (bound kind, n)) or None (default
+    # frame: RANGE UNBOUNDED PRECEDING .. CURRENT ROW)
+    frame: tuple | None = None
 
 
 @dataclass

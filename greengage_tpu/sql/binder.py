@@ -395,7 +395,9 @@ class Binder:
     # window functions
     # ------------------------------------------------------------------
     _WINFUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
-                 "min", "max"}
+                 "min", "max", "lag", "lead", "first_value", "last_value",
+                 "ntile"}
+    _WIN_NEED_ORDER = {"lag", "lead", "first_value", "last_value", "ntile"}
 
     def _bind_windows(self, stmt, plan, scope):
         from greengage_tpu.planner.logical import Window
@@ -417,6 +419,7 @@ class Binder:
             parts.append("|")
             for oi in over.order_by:
                 parts.append(f"{_ast_key(oi.expr)}:{oi.desc}:{oi.nulls_first}")
+            parts.append(f"|{over.frame}")
             return " ".join(parts)
 
         groups: dict[str, list[A.FuncCall]] = {}
@@ -431,6 +434,7 @@ class Binder:
             okeys = [(self._no_raw(self._expr(oi.expr, scope),
                                    "window order key"), oi.desc, oi.nulls_first)
                      for oi in spec.order_by]
+            frame = self._bind_frame(spec.frame)
             wfuncs = []
             for fc in fcs:
                 fname = fc.name
@@ -438,11 +442,33 @@ class Binder:
                     raise SqlError(f"unknown window function {fname}")
                 if fc.distinct:
                     raise SqlError("DISTINCT in window functions is not supported")
+                if fname in self._WIN_NEED_ORDER and not spec.order_by:
+                    raise SqlError(f"{fname}() requires OVER (... ORDER BY)")
                 arg = None
+                param = None
                 if fname in ("row_number", "rank", "dense_rank"):
                     if fc.args or fc.star:
                         raise SqlError(f"{fname}() takes no arguments")
                     rtype = T.INT64
+                elif fname == "ntile":
+                    param = self._win_int_param(fc, 0, fname)
+                    if param < 1:
+                        raise SqlError("ntile() buckets must be positive")
+                    rtype = T.INT64
+                elif fname in ("lag", "lead"):
+                    if not fc.args:
+                        raise SqlError(f"{fname}() requires an argument")
+                    arg = self._expr(fc.args[0], scope)
+                    param = (self._win_int_param(fc, 1, fname)
+                             if len(fc.args) > 1 else 1)
+                    if param < 0:
+                        raise SqlError(f"{fname}() offset must be >= 0")
+                    rtype = arg.type
+                elif fname in ("first_value", "last_value"):
+                    if not fc.args:
+                        raise SqlError(f"{fname}() requires an argument")
+                    arg = self._expr(fc.args[0], scope)
+                    rtype = arg.type
                 elif fc.star or not fc.args:
                     if fname != "count":
                         raise SqlError(f"{fname}(*) is not valid")
@@ -455,11 +481,58 @@ class Binder:
                             f"window {fname}() over text is not supported yet")
                     rtype = E.agg_result_type(
                         "count" if fname == "count" else fname, arg.type)
-                ci = ColInfo(self.new_id(fname), rtype, fname)
-                wfuncs.append((ci, fname, arg, bool(spec.order_by)))
+                if fname in ("min", "max") and frame is not None                         and frame != (None, 0) and frame != (None, None):
+                    raise SqlError(
+                        f"window {fname}() supports only ROWS UNBOUNDED "
+                        "PRECEDING frames (running or whole-partition)")
+                if arg is not None:
+                    self._no_raw(arg, "window function argument")
+                ci = ColInfo(self.new_id(fname), rtype, fname,
+                             _dict_ref_of(arg) if arg is not None and
+                             fname in ("lag", "lead", "first_value",
+                                       "last_value", "min", "max") else None)
+                wfuncs.append((ci, fname, arg, bool(spec.order_by), param))
                 rewrites[id(fc)] = ci
-            plan = Window(plan, pkeys, okeys, wfuncs)
+            plan = Window(plan, pkeys, okeys, wfuncs, frame)
         return plan, rewrites
+
+    def _win_int_param(self, fc, idx, fname) -> int:
+        a = fc.args[idx] if len(fc.args) > idx else None
+        if not isinstance(a, A.Num) or "." in a.text:
+            raise SqlError(f"{fname}() parameter must be an integer literal")
+        return int(a.text)
+
+    @staticmethod
+    def _bind_frame(frame):
+        """AST frame -> (preceding, following) row offsets with None =
+        unbounded. Only ROWS frames change evaluation; the default RANGE
+        UNBOUNDED PRECEDING..CURRENT ROW is the built-in peer semantics."""
+        if frame is None:
+            return None
+        mode, lo, hi = frame
+        if mode == "range":
+            if lo == ("unbounded_preceding", None) and hi == ("current", None):
+                return None   # the default frame
+            raise SqlError(
+                "only the default RANGE frame is supported; use ROWS")
+
+        def bound(b, is_start):
+            kind, n = b
+            if kind == "unbounded_preceding":
+                if not is_start:
+                    raise SqlError("frame end cannot be UNBOUNDED PRECEDING")
+                return None
+            if kind == "unbounded_following":
+                if is_start:
+                    raise SqlError("frame start cannot be UNBOUNDED FOLLOWING")
+                return None
+            if kind == "current":
+                return 0
+            if kind == "preceding":
+                return n if is_start else -n
+            return -n if is_start else n   # following
+
+        return (bound(lo, True), bound(hi, False))
 
     # ------------------------------------------------------------------
     # UNION
@@ -757,22 +830,69 @@ class Binder:
                     ColInfo(ci_in.id, ci_in.type, ci_in.name, ci_in.dict_ref))
 
         plan = Project(plan, proj)
-        if distinct_args:
-            # DISTINCT aggregates: dedupe (group keys, arg) first, then
-            # aggregate plain over the distinct combinations (the classic
-            # two-level rewrite). Mixing DISTINCT and plain aggregates in
-            # one query would need split-and-rejoin plans — not yet.
-            if len(aggs) != 1:
-                raise SqlError(
-                    "DISTINCT aggregates cannot be combined with other "
-                    "aggregates yet")
+        distinct_ids = {ci.id for ci in distinct_args}
+        plain_aggs = [(ci, a) for ci, a in aggs if not a.distinct]
+        dist_aggs = [(ci, a) for ci, a in aggs if a.distinct]
+        if dist_aggs and len(dist_aggs) > 1:
+            raise SqlError(
+                "multiple DISTINCT aggregates in one query are not "
+                "supported yet")
+        if dist_aggs and plain_aggs:
+            # MIXED distinct + plain: split-and-rejoin (the reference plans
+            # this with multiple agg levels): plan A aggregates the plain
+            # functions, plan B dedupes the distinct argument then
+            # aggregates it; A join B on the group keys reassembles one row
+            # per group. Both branches share the projected input subtree.
+            ci_d, agg_d = dist_aggs[0]
+            dci = distinct_args[0]
+            plan_a = Aggregate(plan, key_cols, plain_aggs)
+            # NOTE the id invariant: an Aggregate's group-key exprs must
+            # reference the SAME ids its key ColInfos carry, so the final
+            # phase of a two-phase plan resolves them against the partial's
+            # output. Both branches therefore reuse key_cols; the join's
+            # duplicate output ids carry equal values by the join equality.
+            dedupe = Aggregate(plan, list(key_cols) + [
+                (dci, E.ColRef(dci.id, dci.type))], [])
+            plan_b = Aggregate(
+                dedupe,
+                [(kc, E.ColRef(kc.id, kc.type)) for kc, _ in key_cols],
+                [(ci_d, E.Agg(agg_d.func, E.ColRef(dci.id, dci.type),
+                              False, agg_d.type))])
+            if key_cols:
+                # NULL-safe rejoin: GROUP BY treats NULL keys as one group,
+                # but join equality drops NULLs — so each key joins as
+                # (COALESCE(k, 0), k IS NULL) pairs, which match NULL
+                # groups to each other and never collide with real zeros
+                def null_safe(kc):
+                    ref = _colref(kc)
+                    coalesced = E.Case(
+                        ((E.IsNull(ref), _zero_lit(kc.type)),), ref, kc.type)
+                    if kc.dict_ref is not None:
+                        # TEXT: codes hash through the dictionary LUT;
+                        # code -1 hits the sentinel row
+                        object.__setattr__(coalesced, "_dict_ref", kc.dict_ref)
+                    return [coalesced, E.IsNull(ref)]
+
+                lks = [e for kc, _ in key_cols for e in null_safe(kc)]
+                rks = [e for kc, _ in key_cols for e in null_safe(kc)]
+                lks, rks = self._align_join_keys(lks, rks)
+                plan = Join("inner", plan_a, plan_b, lks, rks)
+            else:
+                one = E.Literal(1, T.INT32)
+                plan = Join("inner", plan_a, plan_b, [one], [one])
+        elif dist_aggs:
+            # DISTINCT only: dedupe (group keys, arg) first, then aggregate
+            # plain over the distinct combinations (the classic two-level
+            # rewrite)
             dci = distinct_args[0]
             dedupe_keys = list(key_cols) + [
                 (dci, E.ColRef(dci.id, dci.type))]
             plan = Aggregate(plan, dedupe_keys, [])
-            ci, agg = aggs[0]
+            ci, agg = dist_aggs[0]
             aggs = [(ci, E.Agg(agg.func, agg.arg, False, agg.type))]
-        plan = Aggregate(plan, key_cols, aggs)
+            plan = Aggregate(plan, key_cols, aggs)
+        else:
+            plan = Aggregate(plan, key_cols, aggs)
 
         # 4. scope over agg outputs; rewrites: ast node -> ColInfo
         out_scope = Scope()
@@ -1146,6 +1266,16 @@ def _colref(c: ColInfo) -> E.ColRef:
     return e
 
 
+def _zero_lit(t: T.SqlType) -> E.Literal:
+    if t.kind is T.Kind.TEXT:
+        return E.Literal(-1, t)      # dictionary code space: -1 = absent
+    if t.kind is T.Kind.FLOAT64:
+        return E.Literal(0.0, t)
+    if t.kind is T.Kind.BOOL:
+        return E.Literal(False, t)
+    return E.Literal(0, t)
+
+
 def _dict_ref_of(e: E.Expr):
     return getattr(e, "_dict_ref", None)
 
@@ -1372,7 +1502,7 @@ def _collect_needed(plan: Plan, needed: set):
             needed.update(E.columns_used(e))
         for e, _, _ in plan.order_keys:
             needed.update(E.columns_used(e))
-        for _, _, arg, _ in plan.wfuncs:
+        for _, _, arg, *_ in plan.wfuncs:
             if arg is not None:
                 needed.update(E.columns_used(arg))
     if isinstance(plan, Project):

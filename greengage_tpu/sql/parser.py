@@ -103,6 +103,24 @@ class Parser:
         t = self.peek()
         return t[0] == "kw" and t[1] in kws
 
+    # frame words (ROWS/RANGE/UNBOUNDED/...) are context-sensitive like in
+    # the reference grammar: plain identifiers elsewhere, recognized only
+    # inside an OVER () clause
+    def at_word(self, *words):
+        t = self.peek()
+        return t[0] in ("kw", "name") and t[1] in words
+
+    def accept_word(self, word):
+        if self.at_word(word):
+            return self.next()
+        return None
+
+    def expect_word(self, word):
+        t = self.accept_word(word)
+        if t is None:
+            raise SqlError(f"expected {word}, got {self.peek()[1]!r}")
+        return t
+
     # ---- statements ----------------------------------------------------
     def parse(self) -> list[A.ANode]:
         stmts = []
@@ -515,6 +533,17 @@ class Parser:
                         over.order_by.append(self.order_item())
                         while self.accept("op", ","):
                             over.order_by.append(self.order_item())
+                    if self.at_word("rows", "range") \
+                            and self.peek(1) != ("op", ")"):
+                        mode = self.next()[1]
+                        if self.accept("kw", "between"):
+                            lo = self._frame_bound()
+                            self.expect("kw", "and")
+                            hi = self._frame_bound()
+                        else:
+                            lo = self._frame_bound()
+                            hi = ("current", None)
+                        over.frame = (mode, lo, hi)
                     self.expect("op", ")")
                 return A.FuncCall(fname, args, star=star, distinct=distinct,
                                   over=over)
@@ -637,6 +666,22 @@ class Parser:
                 break
         return A.InsertStmt(table, columns, rows)
 
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | N PRECEDING/FOLLOWING"""
+        if self.accept_word("unbounded"):
+            kw = self.next()[1]
+            if kw not in ("preceding", "following"):
+                raise SqlError(f"expected PRECEDING/FOLLOWING, got {kw!r}")
+            return ("unbounded_" + kw, None)
+        if self.accept_word("current"):
+            self.expect_word("row")
+            return ("current", None)
+        n = int(self.expect("num")[1])
+        kw = self.next()[1]
+        if kw not in ("preceding", "following"):
+            raise SqlError(f"expected PRECEDING/FOLLOWING, got {kw!r}")
+        return (kw, n)
+
     def copy_stmt(self) -> A.CopyStmt:
         self.expect("kw", "copy")
         table = self.expect("name")[1]
@@ -647,7 +692,9 @@ class Parser:
             self.expect("op", "(")
             while True:
                 k = self.next()[1]
-                v = self.next()[1] if self.peek()[0] in ("name", "str", "num") else "true"
+                v = (self.next()[1]
+                     if self.peek()[0] in ("name", "str", "num", "kw")
+                     else "true")
                 options[k] = v
                 if not self.accept("op", ","):
                     break

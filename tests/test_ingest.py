@@ -1,0 +1,102 @@
+"""gpfdist-lite parallel ingest + SREH — VERDICT r1 item #8
+(gpfdist.c chunk serving; cdbsreh.c SEGMENT REJECT LIMIT)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.runtime.ingest import (FileDistServer, _read_chunk,
+                                          fetch_chunks)
+from greengage_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def db(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d.sql("create table ld (id int, qty decimal(10,2), tag text) "
+          "distributed by (id)")
+    return d
+
+
+def _write_csv(path, nrows=5000, bad_lines=()):
+    with open(path, "w") as f:
+        f.write("id,qty,tag\n")
+        for i in range(nrows):
+            if i in bad_lines:
+                f.write(f"{i},not-a-number,t{i % 7}\n")
+            else:
+                f.write(f"{i},{i}.25,t{i % 7}\n")
+
+
+def test_chunk_alignment_covers_every_row(tmp_path):
+    p = str(tmp_path / "f.csv")
+    _write_csv(p, nrows=997)
+    whole = open(p, "rb").read()
+    for n in (1, 3, 8):
+        parts = [_read_chunk(p, i, n) for i in range(n)]
+        assert b"".join(parts) == whole
+        # every chunk is newline-terminated (no split rows)
+        for part in parts:
+            assert part == b"" or part.endswith(b"\n")
+
+
+def test_parallel_gpfdist_load(db, tmp_path):
+    _write_csv(str(tmp_path / "ld.csv"), nrows=4000)
+    srv = FileDistServer(str(tmp_path))
+    srv.start()
+    try:
+        tag = db.sql(f"copy ld from '{srv.url('ld.csv')}' "
+                     "with (header true, chunks 6)")
+        assert tag == "COPY 4000"
+        assert srv.requests_served >= 6
+        r = db.sql("select count(*), min(id), max(id) from ld")
+        assert r.rows() == [(4000, 0, 3999)]
+        r = db.sql("select qty from ld where id = 7")
+        assert abs(r.rows()[0][0] - 7.25) < 1e-9
+    finally:
+        srv.stop()
+
+
+def test_sreh_reject_limit_holds(db, tmp_path):
+    p = str(tmp_path / "bad.csv")
+    _write_csv(p, nrows=1000, bad_lines=(10, 500, 900))
+    tag = db.sql(f"copy ld from '{p}' with (header true, "
+                 "segment_reject_limit 5)")
+    assert tag.startswith("COPY 997")
+    assert "rejected 3" in tag
+    log = db.error_log("ld")
+    assert len(log) == 3
+    assert all("not-a-number" in e["row"] for e in log)
+    assert any(e["line"] == 12 for e in log)   # 1-based incl. header
+
+
+def test_sreh_reject_limit_exceeded_aborts(db, tmp_path):
+    p = str(tmp_path / "vbad.csv")
+    _write_csv(p, nrows=100, bad_lines=tuple(range(0, 60)))
+    before = db.sql("select count(*) from ld").rows()[0][0]
+    with pytest.raises(SqlError, match="REJECT LIMIT"):
+        db.sql(f"copy ld from '{p}' with (header true, "
+               "segment_reject_limit 10)")
+    assert db.sql("select count(*) from ld").rows()[0][0] == before
+
+
+def test_no_reject_limit_aborts_on_first_bad_row(db, tmp_path):
+    p = str(tmp_path / "one.csv")
+    _write_csv(p, nrows=50, bad_lines=(25,))
+    with pytest.raises(SqlError, match="COPY line"):
+        db.sql(f"copy ld from '{p}' with (header true)")
+
+
+def test_sreh_over_gpfdist(db, tmp_path):
+    _write_csv(str(tmp_path / "g.csv"), nrows=2000, bad_lines=(100, 1500))
+    srv = FileDistServer(str(tmp_path))
+    srv.start()
+    try:
+        tag = db.sql(f"copy ld from '{srv.url('g.csv')}' "
+                     "with (header true, chunks 4, segment_reject_limit 10)")
+        assert tag.startswith("COPY 1998")
+        assert len(db.error_log("ld")) == 2
+    finally:
+        srv.stop()
