@@ -8,12 +8,15 @@ from greengage_tpu.exec.session import Database  # noqa: F401
 
 
 def connect(path: str | None = None, numsegments: int | None = None,
-            mirrors: bool = False) -> "Database":
+            mirrors: bool = False, multihost=None) -> "Database":
     """Open (or create) a database.
 
     path=None gives an in-memory single-host cluster; numsegments defaults to
     the number of visible JAX devices (each segment binds to one chip).
     mirrors=True creates a mirror per segment (replicated on every committed
     write; promoted by FTS on primary failure).
+    multihost: a parallel.multihost.MultihostRuntime — the mesh then spans
+    every process's devices (workers run `gg worker`).
     """
-    return Database(path=path, numsegments=numsegments, mirrors=mirrors)
+    return Database(path=path, numsegments=numsegments, mirrors=mirrors,
+                    multihost=multihost)

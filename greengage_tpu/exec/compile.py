@@ -72,7 +72,8 @@ class CompileResult:
 class Compiler:
     def __init__(self, catalog, store, mesh, nseg: int, consts: dict,
                  settings: Settings, tier: int = 0,
-                 cap_overrides: dict | None = None, instrument: bool = False):
+                 cap_overrides: dict | None = None, instrument: bool = False,
+                 multihost: bool = False):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -91,6 +92,10 @@ class Compiler:
         self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
         self.instrument = instrument      # EXPLAIN ANALYZE per-node rows
         self.node_rows: dict[str, int] = {}   # metric name -> plan node id
+        # multi-host: outputs/flags/metrics are device-reduced + replicated
+        # so EVERY process fetches full results and takes identical
+        # retry decisions (parallel/multihost.py lockstep invariants)
+        self.multihost = multihost
 
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
@@ -143,7 +148,12 @@ class Compiler:
         flag_names = list(self.flags)
         nseg = self.nseg
 
+        mh = self.multihost
+        metric_names = list(self.metrics)
+
         def seg_fn(*flat):
+            from jax import lax
+
             ctx = {"tables": {}, "flags": []}
             i = 0
             for tname, cols, cap, _direct, _prune in input_spec:
@@ -163,20 +173,35 @@ class Compiler:
                 v = batch.valids.get(c.id)
                 outs.append(jnp.ones_like(sel) if v is None else v)
             outs.append(sel)
+            if mh:
+                # gather every segment's shard on device so all processes
+                # hold the full result (the Gather Motion as a collective)
+                outs = [lax.all_gather(o, SEG_AXIS) for o in outs]
             for _, f in ctx["flags"]:
-                outs.append(jnp.broadcast_to(f.astype(jnp.int32), (1,)))
-            for _, m in ctx["metrics"]:
-                outs.append(jnp.broadcast_to(m.astype(jnp.int64), (1,)))
+                f = f.astype(jnp.int32)
+                if mh:
+                    f = lax.pmax(f, SEG_AXIS)
+                outs.append(jnp.broadcast_to(f, (1,)))
+            for name, m in ctx["metrics"]:
+                m = m.astype(jnp.int64)
+                if mh:
+                    m = (lax.psum(m, SEG_AXIS) if name.startswith("nrows_")
+                         else lax.pmax(m, SEG_AXIS))
+                outs.append(jnp.broadcast_to(m, (1,)))
             return tuple(outs)
 
-        metric_names = list(self.metrics)
-        nouts = 2 * len(out_cols) + 1 + len(flag_names) + len(metric_names)
+        ncols_out = 2 * len(out_cols) + 1
+        nouts = ncols_out + len(flag_names) + len(metric_names)
+        if mh:
+            out_specs = tuple([P()] * nouts)
+        else:
+            out_specs = tuple([P(SEG_AXIS)] * nouts)
         fn = jax.jit(
             jax.shard_map(
                 seg_fn,
                 mesh=self.mesh,
                 in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _, _, _ in input_spec))),
-                out_specs=tuple(P(SEG_AXIS) for _ in range(nouts)),
+                out_specs=out_specs,
                 check_vma=False,
             )
         )

@@ -86,12 +86,14 @@ class Result:
 
 
 class Executor:
-    def __init__(self, catalog, store, mesh, nseg: int, settings):
+    def __init__(self, catalog, store, mesh, nseg: int, settings,
+                 multihost=None):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
         self.nseg = nseg
         self.settings = settings
+        self.multihost = multihost    # parallel.multihost.MultihostRuntime
         self._stage_cache: dict = {}
         self._plan_cache: dict = {}   # (cache_key, version, tier) -> CompileResult
 
@@ -114,7 +116,8 @@ class Executor:
                 comp = Compiler(self.catalog, self.store, self.mesh, self.nseg,
                                 consts, self.settings, tier=tier,
                                 cap_overrides=cap_overrides,
-                                instrument=instrument).compile(plan)
+                                instrument=instrument,
+                                multihost=self.multihost is not None).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -165,8 +168,10 @@ class Executor:
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
                     # per-node row counters SUM across segments; capacity
-                    # metrics report the per-segment max
-                    "metrics": {k: (int(np.sum(v)) if k.startswith("nrows_")
+                    # metrics report the per-segment max (multi-host:
+                    # already device-reduced + replicated)
+                    "metrics": {k: (int(v.flat[0]) if self.multihost
+                                    else int(np.sum(v)) if k.startswith("nrows_")
                                     else int(np.max(v)))
                                 for k, v in metrics.items()},
                     "node_rows": {comp.node_rows[k]: int(np.sum(v))
@@ -180,15 +185,26 @@ class Executor:
                 hint = comp.flag_caps.get(fname)
                 if hint is not None:
                     plan_id, metric = hint
-                    need = int(np.max(metrics[metric]))
+                    need = (int(metrics[metric].flat[0]) if self.multihost
+                            else int(np.max(metrics[metric])))
                     cap_overrides[plan_id] = need + max(need // 16, 64)
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
 
     # ------------------------------------------------------------------
+    def _local_segments(self):
+        if self.multihost is None:
+            return set(range(self.nseg))
+        if not self.multihost.local_segments:
+            from greengage_tpu.parallel.multihost import local_segment_positions
+
+            self.multihost.local_segments = local_segment_positions()
+        return set(s for s in self.multihost.local_segments if s < self.nseg)
+
     def _stage(self, comp: CompileResult, snapshot) -> list:
         arrays = []
         shard = seg_sharding(self.mesh)
+        local_segs = self._local_segments()
         # evict staged arrays from older manifest versions (any write bumps
         # the version, so stale device copies are unreachable and only waste
         # HBM — the dispatcher's CdbComponentDatabases invalidation analog)
@@ -208,7 +224,7 @@ class Executor:
             per_seg = []
             kept = total_blocks = 0
             for seg in range(self.nseg):
-                if direct is not None and seg != direct:
+                if seg not in local_segs or (direct is not None and seg != direct):
                     # direct dispatch: only the owning segment's storage is
                     # read/staged (cdbtargeteddispatch.c analog)
                     per_seg.append(({c: np.empty(0, dtype=np.int64)
@@ -249,14 +265,28 @@ class Executor:
                     parts = [_pad(cc.get(c, np.zeros(0, dt)).astype(dt, copy=False), cap)
                              for cc, _, _ in per_seg]
                     host = np.concatenate(parts)
-                staged.append(jax.device_put(host, shard))
+                staged.append(self._put(host, shard, cap))
             present = np.concatenate(
                 [_pad(np.ones(n, dtype=bool), cap, False) for _, _, n in per_seg])
-            staged.append(jax.device_put(present, shard))
+            staged.append(self._put(present, shard, cap))
             self._stage_cache[key] = (
                 staged, self._last_prune_stats.get(table))
             arrays.extend(staged)
         return arrays
+
+    def _put(self, host: np.ndarray, shard, cap: int):
+        """Place a [nseg*cap] host array onto the mesh. Multi-host: each
+        process holds data only for its LOCAL segments (remote positions
+        are zero padding) and contributes exactly its addressable shards
+        via make_array_from_callback."""
+        if self.multihost is None:
+            return jax.device_put(host, shard)
+
+        def cb(index):
+            sl = index[0]
+            return host[sl.start or 0: sl.stop]
+
+        return jax.make_array_from_callback(host.shape, shard, cb)
 
     # ------------------------------------------------------------------
     def _finalize(self, comp: CompileResult, flat, snapshot) -> Result:

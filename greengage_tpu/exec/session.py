@@ -29,9 +29,10 @@ from greengage_tpu.storage import TableStore
 
 class Database:
     def __init__(self, path: str | None = None, numsegments: int | None = None,
-                 devices=None, mirrors: bool = False):
+                 devices=None, mirrors: bool = False, multihost=None):
         import jax
 
+        self.multihost = multihost   # parallel.multihost.MultihostRuntime
         devs = list(devices) if devices is not None else jax.devices()
         self._devices = devs
         if path is not None and os.path.exists(os.path.join(path, "catalog.json")):
@@ -53,15 +54,21 @@ class Database:
             path = tempfile.mkdtemp(prefix="ggtpu_")
             self.catalog.path = path
         self.path = path
-        self.catalog._save()   # persist width even before the first table
+        is_worker = multihost is not None and not multihost.is_coordinator
+        if not is_worker:
+            self.catalog._save()   # persist width even before the first table
         self.store = TableStore(path, self.catalog)
-        self.store.manifest.recover()   # in-doubt resolution on startup
-        self.store.reconcile_widths()   # expansion crash recovery
+        if not is_worker:
+            # workers never write: recovery/reconciliation would race the
+            # coordinator's in-flight transactions
+            self.store.manifest.recover()   # in-doubt resolution on startup
+            self.store.reconcile_widths()   # expansion crash recovery
         self.settings = Settings()
         self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
-                                 numsegments, self.settings)
+                                 numsegments, self.settings,
+                                 multihost=multihost)
         from greengage_tpu.runtime.dtm import DtmSession
         from greengage_tpu.runtime.fts import FtsProber
         from greengage_tpu.runtime.replication import Replicator
@@ -83,10 +90,92 @@ class Database:
     def sql(self, text: str):
         """Execute one or more statements; returns the last statement's
         Result (or a status string for DDL/DML)."""
+        if self.multihost is not None and self.multihost.is_coordinator:
+            return self._coordinator_sql(text)
         out = None
         for stmt in parse(text):
             out = self._execute(stmt)
         return out
+
+    # ---- multi-host statement protocol (parallel/multihost.py) ---------
+    @staticmethod
+    def _needs_mesh(stmt) -> bool:
+        if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
+            return True
+        if isinstance(stmt, A.ExplainStmt):
+            return stmt.analyze
+        if isinstance(stmt, A.DeleteStmt):
+            return stmt.where is not None
+        return isinstance(stmt, A.UpdateStmt)
+
+    def _coordinator_sql(self, text: str):
+        """Host-only statements run locally (workers pick the effects up
+        from the shared directory at their next refresh). Mesh statements
+        broadcast first, then execute here CONCURRENTLY with the workers
+        (the collectives rendezvous); worker acks gate the next statement."""
+        stmts = parse(text)
+        mesh_stmts = [st for st in stmts if self._needs_mesh(st)]
+        if mesh_stmts and len(stmts) > 1:
+            raise SqlError(
+                "multi-host mode runs one mesh statement (SELECT/DML) per "
+                "sql() call; split the statement batch")
+        out = None
+        for stmt in stmts:
+            if self._needs_mesh(stmt):
+                # coordinator-side validation BEFORE the broadcast: a
+                # host-side rejection after workers enter the collectives
+                # would deadlock the cluster (workers wait in psum, the
+                # coordinator never joins)
+                if isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
+                    self._check_no_raw_dml(stmt.table)
+                    self._tx_for_dml(stmt.table, type(stmt).__name__[:6].upper())
+                ch = self.multihost.channel
+                ch.send({"op": "sql", "sql": text})
+                try:
+                    out = self._execute(stmt)
+                finally:
+                    ch.collect_acks()
+            else:
+                out = self._execute(stmt)
+        return out
+
+    def worker_sql(self, text: str):
+        """Run the DEVICE side of the coordinator's statement in lockstep
+        (exec_mpp_query role): SELECT/EXPLAIN ANALYZE execute fully; write
+        statements run only their internal mesh scans (DELETE/UPDATE read
+        passes) — publishing is the coordinator's job."""
+        for stmt in parse(text):
+            if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
+                self._select(stmt)
+            elif isinstance(stmt, A.ExplainStmt) and stmt.analyze:
+                self._explain(stmt)
+            elif isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
+                self._worker_dml_scan(stmt)
+            # everything else is host-side work owned by the coordinator
+
+    def _worker_dml_scan(self, stmt):
+        """Reproduce the coordinator's internal raw SELECT so its mesh
+        program has all participants (the plan is deterministic)."""
+        if isinstance(stmt, A.DeleteStmt):
+            if stmt.where is None:
+                return
+            survive = A.Bin("or", A.Unary("not", stmt.where),
+                            A.IsNullTest(stmt.where, False))
+            sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
+                               from_=[A.BaseTable(stmt.table)], where=survive)
+            self._run_raw(sel)
+        else:
+            self._update(stmt, worker_scan_only=True)
+
+    def refresh(self) -> None:
+        """Adopt the coordinator's committed catalog/manifest state from
+        the shared cluster directory (workers call this per statement)."""
+        self.catalog = Catalog.load(self.path)
+        self.store.catalog = self.catalog
+        self.numsegments = self.catalog.segments.numsegments
+        self.executor.catalog = self.catalog
+        self._select_cache.clear()
+        self.store._invalidate_dicts_all()
 
     def _execute(self, stmt):
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
@@ -384,20 +473,24 @@ class Database:
         if not is_url and reject_limit is None:
             # native fast path (fstream parsing analog); quoted files and
             # custom null markers fall back to the Python reader below
-            try:
-                from greengage_tpu.storage.csv_native import (CsvFallback,
-                                                              parse_file)
+            from greengage_tpu.storage.csv_native import (CsvFallback,
+                                                          parse_file)
 
-                cols_n, valids_n = parse_file(
+            parsed_native = None
+            try:
+                parsed_native = parse_file(
                     stmt.path, schema, delim, header, null_s)
-                n = self._write_rows(stmt.table, cols_n, valids_n)
-                return f"COPY {n}"
             except CsvFallback:
                 pass
             except ValueError:
                 # bad data: re-parse via the SREH-aware reader so the error
-                # names the offending line
+                # names the offending line (the try covers ONLY the parse —
+                # a write-path error must not re-ingest the file)
                 pass
+            if parsed_native is not None:
+                cols_n, valids_n = parsed_native
+                n = self._write_rows(stmt.table, cols_n, valids_n)
+                return f"COPY {n}"
 
         from greengage_tpu.runtime import ingest
 
@@ -428,6 +521,7 @@ class Database:
                     except UnicodeDecodeError:
                         rejects.append((line_base + li + 1, repr(raw),
                                         "invalid UTF-8"))
+                        lines.append("")   # keep line numbering aligned
                 text = "\n".join(lines)
             cols, valids, rej = ingest.parse_csv_rows(
                 text, schema, delim, header and ci == 0, null_s,
@@ -533,7 +627,7 @@ class Database:
             self.store.replace_contents(stmt.table, enc, valids)
         return f"DELETE {total - len(res)}"
 
-    def _update(self, stmt: A.UpdateStmt):
+    def _update(self, stmt: A.UpdateStmt, worker_scan_only: bool = False):
         self._check_no_raw_dml(stmt.table)
         tx = self._tx_for_dml(stmt.table, "UPDATE")
         _reject_dml_subqueries(stmt.where)
@@ -574,13 +668,15 @@ class Database:
                                           alias=f"__new_{cname}"))
             device_slots[cname] = next_slot
             next_slot += 1
-        if dict_dirty:
+        if dict_dirty and not worker_scan_only:
             self.store.flush_dicts(stmt.table)
         flag = stmt.where if stmt.where is not None else A.Bool(True)
         items.append(A.SelectItem(flag, alias="__upd"))
         flag_slot = next_slot
         sel = A.SelectStmt(items=items, from_=[A.BaseTable(stmt.table)])
         res, outs = self._run_raw(sel)
+        if worker_scan_only:
+            return "UPDATE 0"   # multi-host worker: scan only, no publish
         fo = outs[flag_slot]
         fval = res.cols[fo.id].astype(bool)
         fv = res.valids.get(fo.id)

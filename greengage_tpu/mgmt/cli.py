@@ -100,6 +100,28 @@ def cmd_state(args):
     return 0
 
 
+def cmd_worker(args):
+    """Multi-host worker process (the segment-host postmaster role): joins
+    the distributed device runtime, then follows the coordinator's
+    statement channel in lockstep. Requires the cluster directory on a
+    shared filesystem. Start workers first, then the coordinator with
+    greengage_tpu.connect(..., multihost=init_multihost(...))."""
+    from greengage_tpu.parallel.multihost import init_multihost, worker_loop
+
+    mh = init_multihost(args.coordinator, args.num_processes,
+                        args.process_id, args.control_port)
+    import greengage_tpu
+
+    # multihost must flow through connect(): the worker guard skips the
+    # startup writes (catalog save / manifest recovery) that would race
+    # the coordinator's in-flight transactions
+    db = greengage_tpu.connect(path=args.dir, multihost=mh)
+    print(f"worker {args.process_id}/{args.num_processes} serving "
+          f"{len(__import__('jax').local_devices())} local devices", flush=True)
+    worker_loop(db)
+    return 0
+
+
 def cmd_server(args):
     """gpstart-style serving mode: listen on a unix socket until killed."""
     from greengage_tpu.runtime.server import SqlServer
@@ -335,6 +357,14 @@ def main(argv=None):
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("-s", "--socket", required=True)
     p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("worker")
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--coordinator", required=True)   # host:port (jax.distributed)
+    p.add_argument("--control-port", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.set_defaults(fn=cmd_worker)
 
     p = sub.add_parser("expand")
     p.add_argument("-d", "--dir", required=True)

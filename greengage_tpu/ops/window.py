@@ -132,13 +132,20 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc],
             out_valid[f.name] = None
             continue
         if f.func in ("lag", "lead"):
-            k = f.param
+            k, default = f.param if isinstance(f.param, tuple) else (f.param, None)
             src = idx - k if f.func == "lag" else idx + k
             ok = (src >= p_start) if f.func == "lag" else (src <= p_end)
             srcc = jnp.clip(src, 0, n - 1)
-            out_vals[f.name] = f.values[srcc]
+            vals = f.values[srcc]
             v = jnp.ones((n,), bool) if f.valid is None else f.valid
-            out_valid[f.name] = ok & v[srcc] & sel_sorted
+            if default is not None:
+                # SQL-standard third argument: out-of-partition offsets
+                # yield the default instead of NULL
+                vals = jnp.where(ok, vals, jnp.asarray(default, vals.dtype))
+                out_valid[f.name] = (ok & v[srcc] | ~ok) & sel_sorted
+            else:
+                out_valid[f.name] = ok & v[srcc] & sel_sorted
+            out_vals[f.name] = vals
             continue
         if f.func in ("first_value", "last_value"):
             lo, hi = frame_span(f.ordered)

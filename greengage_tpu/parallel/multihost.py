@@ -1,0 +1,183 @@
+"""Multi-host runtime — the interconnect/dispatch fabric across hosts.
+
+Reference parity: the reference spans hosts with libpq dispatch (control
+plane) + UDPIFC/ic-proxy (data plane, src/backend/cdb/motion/ic_udpifc.c,
+README.ic-proxy.md). The TPU-native translation:
+
+  data plane   = XLA collectives over the GLOBAL device mesh
+                 (jax.distributed: every process contributes its local
+                 chips; all_to_all/all_gather ride ICI/DCN)
+  control plane = a slim TCP statement channel (the libpq 'M'-message
+                 role): the coordinator broadcasts each SQL statement,
+                 every process plans/compiles the SAME program from the
+                 shared catalog (multi-controller SPMD), workers stage
+                 only their LOCAL segments' storage, and the jitted
+                 program's collectives synchronize execution.
+
+Lockstep invariants (why this is deterministic):
+  * all processes see the same cluster directory (shared/replicated fs);
+    workers refresh catalog+manifest before each statement,
+  * binder/planner are deterministic, so every process compiles an
+    identical HLO and the collectives rendezvous,
+  * overflow flags and metrics are device-reduced (pmax/psum over the
+    mesh) and replicated, so every process takes the same capacity-retry
+    decision without any extra control traffic,
+  * only the coordinator performs writes (manifest/catalog/dictionaries);
+    workers run the device part of DML's internal scans and skip the
+    publish.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class MultihostRuntime:
+    process_id: int
+    num_processes: int
+    channel: object = None            # CoordinatorChannel | WorkerChannel
+    local_segments: tuple = ()        # mesh positions of this process's devices
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def init_multihost(coordinator: str, num_processes: int, process_id: int,
+                   control_port: int) -> MultihostRuntime:
+    """Join the distributed JAX runtime and the control channel. Must run
+    BEFORE any devices are used."""
+    import jax
+
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+    host = coordinator.rsplit(":", 1)[0]
+    if process_id == 0:
+        ch = CoordinatorChannel(control_port, num_processes - 1)
+    else:
+        ch = WorkerChannel(host, control_port)
+    return MultihostRuntime(process_id, num_processes, ch)
+
+
+def local_segment_positions() -> tuple:
+    """Mesh positions (= segment ids) of this process's devices, assuming
+    the mesh enumerates jax.devices() in order (parallel/mesh.py does)."""
+    import jax
+
+    all_devs = {id(d): i for i, d in enumerate(jax.devices())}
+    return tuple(sorted(all_devs[id(d)] for d in jax.local_devices()))
+
+
+# ---------------------------------------------------------------------------
+# control channel: line-JSON over TCP
+# ---------------------------------------------------------------------------
+
+class CoordinatorChannel:
+    """Accepts every worker once, then broadcasts statements and collects
+    acks (the CdbDispatchCommand/checkDispatchResult roles)."""
+
+    def __init__(self, port: int, expected_workers: int):
+        self._lock = threading.Lock()
+        self._workers: list = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(expected_workers)
+        for _ in range(expected_workers):
+            conn, _ = self._srv.accept()
+            self._workers.append(conn.makefile("rwb"))
+
+    def send(self, msg: dict) -> None:
+        line = (json.dumps(msg) + "\n").encode()
+        self._lock.acquire()
+        try:
+            for w in self._workers:
+                w.write(line)
+                w.flush()
+        except BaseException:
+            self._lock.release()
+            raise
+
+    def collect_acks(self) -> list[dict]:
+        try:
+            acks = []
+            for w in self._workers:
+                resp = json.loads(w.readline())
+                acks.append(resp)
+        finally:
+            self._lock.release()
+        errs = [a for a in acks if not a.get("ok")]
+        if errs:
+            raise RuntimeError(f"worker error: {errs[0].get('error')}")
+        return acks
+
+    def broadcast(self, msg: dict) -> list[dict]:
+        """Send to all workers and wait for every ack."""
+        self.send(msg)
+        return self.collect_acks()
+
+    def close(self):
+        try:
+            self.send({"op": "stop"})
+            self._lock.release()
+        except Exception:
+            pass
+        for w in self._workers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._srv.close()
+
+
+class WorkerChannel:
+    def __init__(self, host: str, port: int, retries: int = 100):
+        import time
+
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection((host, port), timeout=30)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(f"cannot reach coordinator: {last}")
+        self._f = self._sock.makefile("rwb")
+
+    def recv(self) -> dict:
+        line = self._f.readline()
+        if not line:
+            return {"op": "stop"}
+        return json.loads(line)
+
+    def ack(self, ok: bool = True, error: str | None = None):
+        self._f.write((json.dumps({"ok": ok, "error": error}) + "\n").encode())
+        self._f.flush()
+
+
+# ---------------------------------------------------------------------------
+# worker loop
+# ---------------------------------------------------------------------------
+
+def worker_loop(db) -> None:
+    """Follow the coordinator: execute each statement's DEVICE work in
+    lockstep (the exec_mpp_query role, postgres.c:1057). Writes are the
+    coordinator's job; the shared-directory refresh picks them up."""
+    ch = db.multihost.channel
+    while True:
+        msg = ch.recv()
+        if msg.get("op") == "stop":
+            break
+        try:
+            if msg.get("op") == "sql":
+                db.refresh()
+                db.worker_sql(msg["sql"])
+            ch.ack(True)
+        except Exception as e:
+            ch.ack(False, f"{type(e).__name__}: {e}")

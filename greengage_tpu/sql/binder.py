@@ -459,10 +459,18 @@ class Binder:
                     if not fc.args:
                         raise SqlError(f"{fname}() requires an argument")
                     arg = self._expr(fc.args[0], scope)
-                    param = (self._win_int_param(fc, 1, fname)
-                             if len(fc.args) > 1 else 1)
-                    if param < 0:
+                    k = (self._win_int_param(fc, 1, fname)
+                         if len(fc.args) > 1 else 1)
+                    if k < 0:
                         raise SqlError(f"{fname}() offset must be >= 0")
+                    default = None
+                    if len(fc.args) > 2:
+                        d = self._expr(fc.args[2], scope)
+                        if not isinstance(d, E.Literal):
+                            raise SqlError(
+                                f"{fname}() default must be a literal")
+                        default = self._coerce_literal(d, arg.type).value
+                    param = (k, default)
                     rtype = arg.type
                 elif fname in ("first_value", "last_value"):
                     if not fc.args:
@@ -830,7 +838,6 @@ class Binder:
                     ColInfo(ci_in.id, ci_in.type, ci_in.name, ci_in.dict_ref))
 
         plan = Project(plan, proj)
-        distinct_ids = {ci.id for ci in distinct_args}
         plain_aggs = [(ci, a) for ci, a in aggs if not a.distinct]
         dist_aggs = [(ci, a) for ci, a in aggs if a.distinct]
         if dist_aggs and len(dist_aggs) > 1:

@@ -676,7 +676,10 @@ class Parser:
         if self.accept_word("current"):
             self.expect_word("row")
             return ("current", None)
-        n = int(self.expect("num")[1])
+        tok = self.expect("num")[1]
+        if "." in tok:
+            raise SqlError(f"frame offset must be an integer, got {tok!r}")
+        n = int(tok)
         kw = self.next()[1]
         if kw not in ("preceding", "following"):
             raise SqlError(f"expected PRECEDING/FOLLOWING, got {kw!r}")

@@ -340,6 +340,9 @@ class TableStore:
         for key in [k for k in self._dicts if k[0] == table]:
             del self._dicts[key]
 
+    def _invalidate_dicts_all(self) -> None:
+        self._dicts.clear()
+
     # ---- read path -----------------------------------------------------
     last_prune: tuple | None = None   # (blocks kept, blocks total) of last read
 

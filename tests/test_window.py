@@ -228,3 +228,10 @@ def test_frame_words_remain_identifiers(wdb):
     wdb.sql("insert into fwords values (1, 10, 20)")
     r = wdb.sql("select range, current from fwords")
     assert r.rows() == [(10, 20)]
+
+
+def test_lag_with_default(wdb):
+    wdb.sql("create table lg3 (k int, g int, v int) distributed by (k)")
+    wdb.sql("insert into lg3 values (1,0,10),(2,0,20),(3,0,30)")
+    r = wdb.sql("select v, lag(v, 1, -1) over (order by v) from lg3 order by v")
+    assert [tuple(x) for x in r.rows()] == [(10, -1), (20, 10), (30, 20)]
