@@ -615,14 +615,22 @@ class Binder:
             plan = self._push_filters(plan, scope, conjuncts)
             return plan, scope, None
 
-        # comma-FROM: greedy equi-join ordering (join-order search analog,
-        # CJoinOrderGreedy in ORCA / make_rel_from_joinlist in the planner)
+        # comma-FROM join ordering: Selinger-style DP over left-deep trees
+        # when statistics exist (CJoinOrderDP.cpp analog, <= 10 relations),
+        # falling back to the r1 greedy order (CJoinOrderGreedy analog)
         remaining = list(items)
         conds = list(conjuncts)
         # push single-table predicates first
         for i, (p, s) in enumerate(remaining):
             p2, conds = self._push_single_table(p, s, conds)
             remaining[i] = (p2, s)
+
+        # keep SELECT * / scope resolution in FROM-clause order regardless
+        # of the join order the optimizer picks
+        orig_scopes = [sc for _, sc in remaining]
+        order = self._dp_join_order(remaining, conds)
+        if order is not None:
+            remaining = [remaining[i] for i in order]
 
         plan, scope = remaining.pop(0)
         while remaining:
@@ -646,7 +654,133 @@ class Binder:
             plan = Join("inner", plan, rp, lkeys, rkeys)
             scope = scope.merged(rs)
         leftover = _join_and(conds)
-        return plan, scope, leftover
+        out_scope = Scope()
+        for sc in orig_scopes:
+            out_scope = out_scope.merged(sc)
+        return plan, out_scope, leftover
+
+    # ------------------------------------------------------------------
+    # DP join ordering (System R over left-deep trees)
+    # ------------------------------------------------------------------
+    def _dp_join_order(self, items, conds):
+        """-> permutation of item indices minimizing the classic sum of
+        intermediate cardinalities, or None (no stats / too many / cross
+        products involved). Cardinalities: filtered base rows x product of
+        1/max(NDV) per equi edge — the same estimates the planner uses, so
+        the chosen order matches its costing."""
+        n = len(items)
+        if n < 3 or n > 10:
+            return None
+        cards = []
+        col_stats = []
+        for plan, scope in items:
+            info = self._rel_card(plan)
+            if info is None:
+                return None
+            cards.append(info[0])
+            col_stats.append(info[1])
+        # equi edges: (i, j, sel)
+        edges: dict[tuple, float] = {}
+        for c in conds:
+            pair = self._edge_of(c, items)
+            if pair is None:
+                continue
+            i, j, li, ri = pair
+            si = col_stats[i].get(li)
+            sj = col_stats[j].get(ri)
+            if si is None or sj is None or si.ndv <= 0 or sj.ndv <= 0:
+                return None
+            sel = 1.0 / max(si.ndv, sj.ndv)
+            key = (min(i, j), max(i, j))
+            edges[key] = edges.get(key, 1.0) * sel
+        if not edges:
+            return None
+
+        def joined_card(card, S, j):
+            sel = 1.0
+            connected = False
+            for i in range(n):
+                if S & (1 << i):
+                    e = edges.get((min(i, j), max(i, j)))
+                    if e is not None:
+                        sel *= e
+                        connected = True
+            if not connected:
+                return None
+            return card * cards[j] * sel
+
+        # dp[mask] = (total cost, out card, order tuple), left-deep only;
+        # each round's frontier holds all masks of one popcount, so a plain
+        # per-round min per mask is the full Selinger DP
+        frontier = {1 << i: (0.0, cards[i], (i,)) for i in range(n)}
+        for _ in range(n - 1):
+            nxt: dict[int, tuple] = {}
+            for mask, (cost, card, order) in frontier.items():
+                for j in range(n):
+                    if mask & (1 << j):
+                        continue
+                    jc = joined_card(card, mask, j)
+                    if jc is None:
+                        continue   # avoid cross products
+                    m2 = mask | (1 << j)
+                    c2 = cost + jc
+                    cur = nxt.get(m2)
+                    if cur is None or c2 < cur[0]:
+                        nxt[m2] = (c2, jc, order + (j,))
+            frontier = nxt
+        full = (1 << n) - 1
+        if full not in frontier:
+            return None   # not fully connectable without cross joins
+        return list(frontier[full][2])
+
+    def _rel_card(self, plan):
+        """(filtered row estimate, {col id -> ColumnStats}) for a base
+        relation (possibly already wrapped in pushed Filters)."""
+        from greengage_tpu.planner import cost as C
+
+        filters = []
+        node = plan
+        while isinstance(node, Filter):
+            filters.append(node.predicate)
+            node = node.child
+        if not isinstance(node, Scan):
+            return None
+        schema = self.catalog.get(node.table)
+        ts = getattr(schema, "stats", None)
+        if ts is None or ts.rows <= 0:
+            return None
+        by_id = {c.id: c.name for c in node.cols}
+        stats_by_id = {cid: ts.columns.get(nm) for cid, nm in by_id.items()}
+
+        def lookup(cid):
+            return stats_by_id.get(cid)
+
+        rows = float(ts.rows)
+        for pred in filters:
+            rows *= C.filter_selectivity(pred, lookup)
+        return max(rows, 1.0), stats_by_id
+
+    def _edge_of(self, cond, items):
+        """cond is an equi edge between two distinct items ->
+        (i, j, left col id, right col id) or None."""
+        if not (isinstance(cond, A.Bin) and cond.op == "="):
+            return None
+
+        def side(ast):
+            if not isinstance(ast, A.Name):
+                return None
+            for idx, (_, scope) in enumerate(items):
+                try:
+                    ci = scope.resolve(ast.parts)
+                    return idx, ci.id
+                except SqlError:
+                    continue
+            return None
+
+        a, b = side(cond.left), side(cond.right)
+        if a is None or b is None or a[0] == b[0]:
+            return None
+        return a[0], b[0], a[1], b[1]
 
     def _bind_table_ref(self, t: A.TableRef):
         if isinstance(t, A.BaseTable):
