@@ -37,8 +37,8 @@ from greengage_tpu.parallel import SEG_AXIS
 from greengage_tpu.parallel import motion as motion_ops
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.planner.logical import (
-    Aggregate, Filter, Join, Limit, Motion, MotionKind, Plan, Project, Scan,
-    Sort, Union, Window,
+    Aggregate, Filter, Join, Limit, Motion, MotionKind, PartialState, Plan,
+    Project, Scan, Sort, Union, Window,
 )
 
 VALID_PREFIX = "@v:"
@@ -73,7 +73,8 @@ class Compiler:
     def __init__(self, catalog, store, mesh, nseg: int, consts: dict,
                  settings: Settings, tier: int = 0,
                  cap_overrides: dict | None = None, instrument: bool = False,
-                 multihost: bool = False):
+                 multihost: bool = False, scan_cap_override: dict | None = None,
+                 aux_tables: dict | None = None):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -96,6 +97,10 @@ class Compiler:
         # so EVERY process fetches full results and takes identical
         # retry decisions (parallel/multihost.py lockstep invariants)
         self.multihost = multihost
+        # spill support (exec/spill.py): chunked scan capacities and
+        # host-staged ephemeral inputs ("@spill:" tables)
+        self.scan_cap_override = scan_cap_override or {}
+        self.aux_tables = aux_tables or {}
 
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
@@ -116,17 +121,20 @@ class Compiler:
         self._collect_scans(below)
         input_spec = []
         for t in sorted(self.scan_caps):
-            if self.scan_direct.get(t) is None:
+            if self.scan_direct.get(t) is None and t not in self.aux_tables:
                 # no (consistent) direct pin: the staged capacity must cover
                 # EVERY segment, not just the pinned ones two conflicting
                 # point-scans named (their caps were merged into scan_caps)
-                counts = self.store.segment_rowcounts(t)
+                counts = self._seg_counts(t)
                 self.scan_caps[t] = max(self.scan_caps[t],
                                         max(counts, default=0), 1)
             cols = []
             for c in sorted(self.scan_cols[t]):
                 cols.append(c)
-                if self.store.has_nulls(t, c):
+                if t in self.aux_tables:
+                    if self.aux_tables[t][1].get(c) is not None:
+                        cols.append(VALID_PREFIX + c)
+                elif self.store.has_nulls(t, c):
                     cols.append(VALID_PREFIX + c)
             # zone-map pruning applies only when this table is scanned once
             # (a second scan would need the pruned-away rows) and carries
@@ -250,9 +258,31 @@ class Compiler:
     # ------------------------------------------------------------------
     # capacities
     # ------------------------------------------------------------------
+    def _seg_counts(self, table: str) -> list[int]:
+        """Per-segment row counts, clamped by any spill chunk override."""
+        counts = self.store.segment_rowcounts(table)
+        cap = self.scan_cap_override.get(table)
+        if cap is not None:
+            counts = [min(c, cap) for c in counts]
+        return counts
+
     def _collect_scans(self, plan: Plan):
         if isinstance(plan, Scan):
-            counts = self.store.segment_rowcounts(plan.table)
+            if plan.table in self.aux_tables:
+                cols0 = self.aux_tables[plan.table][0]
+                n = len(next(iter(cols0.values()))) if cols0 else 0
+                cap = max(-(-max(n, 1) // self.nseg), 1)
+                self.scan_caps[plan.table] = max(
+                    self.scan_caps.get(plan.table, 0), cap)
+                self.scan_cols.setdefault(plan.table, set()).update(
+                    c.name for c in plan.cols)
+                self.scan_direct[plan.table] = None
+                self.scan_count[plan.table] = self.scan_count.get(plan.table, 0) + 1
+                self.scan_prune[plan.table] = ()
+                for c in plan.children:
+                    self._collect_scans(c)
+                return
+            counts = self._seg_counts(plan.table)
             ds = plan.direct_seg
             if ds is not None and 0 <= ds < len(counts):
                 cap = max(counts[ds], 1)
@@ -273,8 +303,7 @@ class Compiler:
         if isinstance(plan, Scan):
             if plan.table in self.scan_caps:
                 return self.scan_caps[plan.table]
-            counts = self.store.segment_rowcounts(plan.table)
-            return max(max(counts, default=0), 1)
+            return max(max(self._seg_counts(plan.table), default=0), 1)
         if isinstance(plan, (Filter, Project, Sort, Window)):
             return self._capacity_of(plan.child)
         if isinstance(plan, Limit):
@@ -316,6 +345,8 @@ class Compiler:
                 return min(max(int(self.cap_overrides[id(plan)]), 64), child_cap)
             est = int(max(plan.est_rows, 16.0) * 1.3) + 64
             return min(est * (4 ** self.tier), child_cap)
+        if isinstance(plan, PartialState):
+            return self._capacity_of(plan.child)
         if isinstance(plan, Union):
             return sum(self._capacity_of(c) for c in plan.inputs)
         if isinstance(plan, Motion):
@@ -781,6 +812,9 @@ class Compiler:
             return Batch(cols, valids, used)
 
         return run
+
+    def _c_partialstate(self, plan: PartialState):
+        return self._compile_node(plan.child)
 
     # ---- motion --------------------------------------------------------
     def _c_motion(self, plan: Motion):

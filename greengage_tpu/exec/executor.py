@@ -99,8 +99,12 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, plan, consts: dict, out_cols, cache_key=None,
-            raw: bool = False, instrument: bool = False) -> Result:
+            raw: bool = False, instrument: bool = False,
+            scan_cap_override=None, row_ranges=None, aux_tables=None,
+            allow_spill: bool = True) -> Result:
         self._raw = raw
+        self._row_ranges = row_ranges or {}
+        self._aux_tables = aux_tables or {}
         t0 = time.monotonic()
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
@@ -108,7 +112,9 @@ class Executor:
         cap_overrides: dict = {}
         for tier in range(self.settings.motion_retry_tiers):
             ck = ((cache_key, version, tier) if cache_key is not None
-                  and not cap_overrides and not instrument else None)
+                  and not cap_overrides and not instrument
+                  and not scan_cap_override and not row_ranges
+                  and not aux_tables else None)
             was_cached = ck is not None and ck in self._plan_cache
             if was_cached:
                 comp = self._plan_cache[ck]
@@ -117,7 +123,9 @@ class Executor:
                                 consts, self.settings, tier=tier,
                                 cap_overrides=cap_overrides,
                                 instrument=instrument,
-                                multihost=self.multihost is not None).compile(plan)
+                                multihost=self.multihost is not None,
+                                scan_cap_override=scan_cap_override,
+                                aux_tables=aux_tables).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -131,6 +139,25 @@ class Executor:
                         self._plan_cache.pop(next(iter(self._plan_cache)))
             limit = self.settings.vmem_protect_limit_mb * (1 << 20)
             if limit and comp.est_bytes > limit:
+                if allow_spill and self.multihost is None:
+                    # host-offload spill (exec/spill.py): partition the
+                    # probe-linear table into passes that fit, merge the
+                    # partial-aggregate states on a final pass
+                    from greengage_tpu.exec import spill
+
+                    try:
+                        res, npasses = spill.spill_run(
+                            self, plan, consts, out_cols, raw)
+                    except spill.NotSpillable:
+                        raise QueryError(
+                            f"query would allocate ~{comp.est_bytes >> 20} MB "
+                            f"per segment, above vmem_protect_limit_mb="
+                            f"{self.settings.vmem_protect_limit_mb}, and its "
+                            "shape is not spillable (no partial-aggregate "
+                            "cut over a single-scan probe table)")
+                    res.stats = dict(res.stats or {})
+                    res.stats["spill_passes"] = npasses
+                    return res
                 raise QueryError(
                     f"query would allocate ~{comp.est_bytes >> 20} MB per "
                     f"segment, above vmem_protect_limit_mb="
@@ -191,6 +218,14 @@ class Executor:
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
 
+    def run_single(self, plan, consts, out_cols, raw=False,
+                   scan_cap_override=None, row_ranges=None, aux_tables=None):
+        """One spill pass: no recursive spilling, no plan caching."""
+        return self.run(plan, consts, out_cols, cache_key=None, raw=raw,
+                        scan_cap_override=scan_cap_override,
+                        row_ranges=row_ranges, aux_tables=aux_tables,
+                        allow_spill=False)
+
     # ------------------------------------------------------------------
     def _local_segments(self):
         if self.multihost is None:
@@ -212,9 +247,15 @@ class Executor:
         for k in [k for k in self._stage_cache if k[3] != version]:
             del self._stage_cache[k]
         self._last_prune_stats = {}
+        aux = getattr(self, "_aux_tables", {})
+        ranges = getattr(self, "_row_ranges", {})
         for table, cols, cap, direct, prune in comp.input_spec:
-            key = (table, tuple(cols), cap, version, direct, prune)
-            if key in self._stage_cache:
+            if table in aux:
+                arrays.extend(self._stage_aux(table, cols, cap, aux[table], shard))
+                continue
+            key = (table, tuple(cols), cap, version, direct, prune,
+                   ranges.get(table))
+            if table not in ranges and key in self._stage_cache:
                 staged, pstats = self._stage_cache[key]
                 arrays.extend(staged)
                 if pstats is not None:
@@ -232,6 +273,12 @@ class Executor:
                     continue
                 c, v, n = self.store.read_segment(
                     table, seg, storage_cols, snapshot, prune=prune)
+                if table in ranges:
+                    a, b = ranges[table]
+                    c = {k: arr[a:b] for k, arr in c.items()}
+                    v = {k: (arr[a:b] if arr is not None else None)
+                         for k, arr in v.items()}
+                    n = max(min(n, b) - a, 0)
                 per_seg.append((c, v, n))
                 st = self.store.last_prune
                 if prune and st is not None:
@@ -269,10 +316,37 @@ class Executor:
             present = np.concatenate(
                 [_pad(np.ones(n, dtype=bool), cap, False) for _, _, n in per_seg])
             staged.append(self._put(present, shard, cap))
-            self._stage_cache[key] = (
-                staged, self._last_prune_stats.get(table))
+            if table not in ranges:
+                self._stage_cache[key] = (
+                    staged, self._last_prune_stats.get(table))
             arrays.extend(staged)
         return arrays
+
+    def _stage_aux(self, table, cols, cap, data, shard):
+        """Stage an ephemeral host table ('@spill:' partial rows): rows
+        split contiguously across segments, padded to cap."""
+        aux_cols, aux_valids = data
+        n = len(next(iter(aux_cols.values()))) if aux_cols else 0
+        staged = []
+        counts = [max(min(n, (s + 1) * cap) - s * cap, 0)
+                  for s in range(self.nseg)]
+        for c in cols:
+            if c.startswith(VALID_PREFIX):
+                name = c[len(VALID_PREFIX):]
+                src = aux_valids.get(name)
+                if src is None:
+                    src = np.ones(n, dtype=bool)
+                parts = [_pad(src[s * cap: s * cap + counts[s]], cap, False)
+                         for s in range(self.nseg)]
+            else:
+                src = aux_cols[c]
+                parts = [_pad(src[s * cap: s * cap + counts[s]], cap)
+                         for s in range(self.nseg)]
+            staged.append(self._put(np.concatenate(parts), shard, cap))
+        present = np.concatenate(
+            [_pad(np.ones(cn, dtype=bool), cap, False) for cn in counts])
+        staged.append(self._put(present, shard, cap))
+        return staged
 
     def _put(self, host: np.ndarray, shard, cap: int):
         """Place a [nseg*cap] host array onto the mesh. Multi-host: each

@@ -1,0 +1,223 @@
+"""Host-offload spill: pass-partitioned execution past HBM capacity.
+
+The workfile-manager role (reference: src/backend/utils/workfile_manager/
+workfile_mgr.c:544, hybrid hash agg spilling in execHHashagg.c) rethought
+for the TPU memory hierarchy: host RAM plays the workfile, and the unit of
+spilling is a whole EXECUTION PASS instead of a hash batch.
+
+Applicability: plans whose below-gather tree is
+    [Sort|Limit|Project|Filter]* FinalAggregate( Motion( PartialAggregate(
+        probe-linear subtree )))
+— every TPC-H-style join+GROUP BY/scalar aggregate. The probe-linear
+subtree is row-linear in one big table (joins only fan out on their PROBE
+side; builds stay whole), so partitioning that table's rows into P chunks
+and running the subtree + PARTIAL aggregate per chunk yields partial
+states whose union merges exactly in the FINAL aggregate:
+
+    pass p:  chunk_p -> joins -> partial agg   (fits in HBM)
+             gather partial rows to host       (small)
+    merge:   final plan with the partial subtree replaced by a host-staged
+             input of all passes' partial rows
+
+This completes any such query whose PER-PASS working set fits, instead of
+rejecting it at the vmem admission check.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greengage_tpu import expr as E
+from greengage_tpu import types as T
+from greengage_tpu.planner.locus import Locus
+from greengage_tpu.planner.logical import (Aggregate, ColInfo, Filter, Join,
+                                           Limit, Motion, MotionKind,
+                                           PartialState, Plan, Project, Scan,
+                                           Sort)
+
+
+class NotSpillable(ValueError):
+    """The plan's shape cannot be pass-partitioned soundly."""
+
+
+def partial_state_cols(partial: Aggregate) -> list:
+    """ColInfos for a partial Aggregate's actual output: group keys plus
+    the @c/@s/@m state columns the final phase merges (the compiler's
+    partial-phase naming contract, exec/compile.py _c_aggregate)."""
+    # keys re-exposed with name == id: the host-input staging maps columns
+    # by storage NAME, and the ephemeral table's storage names are the ids
+    out = [ColInfo(ci.id, ci.type, ci.id, ci.dict_ref)
+           for ci, _ in partial.group_keys]
+    for ci, a in partial.aggs:
+        if a.func in ("count", "count_star"):
+            out.append(ColInfo(ci.id + "@c", T.INT64, ci.id + "@c"))
+        elif a.func == "sum":
+            out.append(ColInfo(ci.id + "@s", a.type, ci.id + "@s"))
+        elif a.func == "avg":
+            stype = E.agg_result_type("sum", a.arg.type)
+            out.append(ColInfo(ci.id + "@s", stype, ci.id + "@s"))
+            out.append(ColInfo(ci.id + "@c", T.INT64, ci.id + "@c"))
+        elif a.func in ("min", "max"):
+            out.append(ColInfo(ci.id + "@m", a.arg.type, ci.id + "@m",
+                               dict_ref=getattr(a.arg, "_dict_ref", None)))
+    return out
+
+_WRAPPERS = (Sort, Limit, Project, Filter)
+
+
+def find_spill_split(plan: Motion):
+    """-> (motion, partial_agg) of the topmost final/partial aggregate pair
+    below the gather, or None if the plan does not have the spillable
+    shape."""
+    node = plan.child
+    while isinstance(node, _WRAPPERS):
+        node = node.child
+    if not isinstance(node, Aggregate) or node.phase != "final":
+        return None
+    motion = node.child
+    if not isinstance(motion, Motion):
+        return None
+    partial = motion.child
+    if not isinstance(partial, Aggregate) or partial.phase != "partial":
+        return None
+    return motion, partial
+
+
+def probe_lineage_tables(plan: Plan) -> list[str]:
+    """Tables whose rows the subtree is LINEAR in: reachable from the root
+    without crossing a join's build side (right child), a Union, or a
+    Window (row-coupled)."""
+    out = []
+    node = plan
+    while node is not None:
+        if isinstance(node, Scan):
+            out.append(node.table)
+            return out
+        if isinstance(node, Join):
+            node = node.left
+        elif isinstance(node, (Sort, Limit, Project, Filter, Motion)):
+            # NOTE: a nested Aggregate (DISTINCT dedupe level) is NOT
+            # row-linear — agg(chunk_A) U agg(chunk_B) != agg(all) — so it
+            # ends the lineage and the plan is unspillable
+            node = node.child
+        else:
+            return out
+    return out
+
+
+def count_scans(plan: Plan, table: str) -> int:
+    n = 0
+    stack = [plan]
+    while stack:
+        p = stack.pop()
+        if isinstance(p, Scan) and p.table == table:
+            n += 1
+        stack.extend(p.children)
+    return n
+
+
+def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
+    """Execute ``plan`` in partitioned passes. Raises ValueError when the
+    plan shape is not spillable (caller surfaces the vmem rejection)."""
+    split = find_spill_split(plan)
+    if split is None:
+        raise NotSpillable("plan shape not spillable")
+    motion, partial = split
+    lineage = probe_lineage_tables(partial.child)
+    if not lineage:
+        raise NotSpillable("no probe-linear table to partition")
+    table = lineage[-1]
+    if table.startswith("@") or count_scans(plan, table) != 1:
+        raise NotSpillable("partition table is scanned more than once")
+    store = executor.store
+    counts = store.segment_rowcounts(table)
+    max_rows = max(counts, default=0)
+    if max_rows == 0:
+        raise NotSpillable("partition table is empty")
+
+    settings = executor.settings
+    limit_bytes = settings.vmem_protect_limit_mb * (1 << 20)
+
+    # pass program: gather the PARTIAL aggregate's STATE columns (raw
+    # storage representation; finalize must not decode)
+    state_cols = partial_state_cols(partial)
+    capture = PartialState(partial, state_cols)
+    capture.locus = partial.locus
+    capture.est_rows = partial.est_rows
+    pass_plan = Motion(MotionKind.GATHER, capture)
+    pass_plan.locus = Locus.entry()
+
+    # find the chunk size that brings the pass program under the limit
+    from greengage_tpu.exec.compile import Compiler
+
+    chunk = max_rows
+    floor = 1 << 12
+    while True:
+        chunk = max(chunk // 2, floor)
+        comp = Compiler(executor.catalog, store, executor.mesh, executor.nseg,
+                        consts, settings,
+                        scan_cap_override={table: chunk}).compile(pass_plan)
+        if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
+            break
+    if comp.est_bytes > limit_bytes:
+        raise NotSpillable("per-pass working set still exceeds the limit")
+    npasses = -(-max_rows // chunk)
+
+    # run the passes, collecting partial rows on the host (the workfile)
+    partial_cols = state_cols
+    host_cols = {c.id: [] for c in partial_cols}
+    host_valids = {c.id: [] for c in partial_cols}
+    any_invalid = {c.id: False for c in partial_cols}
+    for p in range(npasses):
+        rr = (p * chunk, (p + 1) * chunk)
+        res = executor.run_single(
+            pass_plan, consts, partial_cols, raw=True,
+            scan_cap_override={table: chunk},
+            row_ranges={table: rr})
+        for c in partial_cols:
+            host_cols[c.id].append(np.asarray(res.cols[c.id]))
+            v = res.valids.get(c.id)
+            if v is None:
+                v = np.ones(len(res.cols[c.id]), dtype=bool)
+            else:
+                any_invalid[c.id] = True
+            host_valids[c.id].append(np.asarray(v, bool))
+
+    aux_cols = {c.id: np.concatenate(host_cols[c.id]) for c in partial_cols}
+    aux_valids = {c.id: (np.concatenate(host_valids[c.id])
+                         if any_invalid[c.id] else None)
+                  for c in partial_cols}
+
+    # merge program: the original plan with the partial subtree swapped for
+    # a host input of the concatenated partial rows
+    aux_name = "@spill:partials"
+    host_scan = Scan(aux_name, list(partial_cols))
+    host_scan.locus = partial.locus
+    host_scan.est_rows = float(len(next(iter(aux_cols.values()), [])))
+    merged = _replace_child(plan, partial, host_scan)
+    return executor.run_single(
+        merged, consts, out_cols, raw=raw,
+        aux_tables={aux_name: (aux_cols, aux_valids)}), npasses
+
+
+def _replace_child(plan: Plan, target: Plan, repl: Plan) -> Plan:
+    """Shallow-rebuild the path from ``plan`` to ``target`` with the target
+    swapped (the original tree stays untouched for re-raising)."""
+    import copy
+
+    if plan is target:
+        return repl
+    clone = copy.copy(plan)
+    for attr in ("child", "left", "right"):
+        c = getattr(plan, attr, None)
+        if c is None:
+            continue
+        if c is target or _contains(c, target):
+            setattr(clone, attr, _replace_child(c, target, repl))
+    return clone
+
+
+def _contains(plan: Plan, target: Plan) -> bool:
+    if plan is target:
+        return True
+    return any(_contains(c, target) for c in plan.children)

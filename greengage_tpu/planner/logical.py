@@ -172,6 +172,19 @@ class Union(Plan):
         return self.cols
 
 
+@dataclass
+class PartialState(Plan):
+    """Exposes a partial Aggregate's STATE columns (the @s/@c/@m naming the
+    final phase consumes) as a schema — used by the spill executor to
+    gather partial states to the host between passes (exec/spill.py)."""
+
+    child: Plan
+    cols: list[ColInfo]
+
+    def out_cols(self):
+        return self.cols
+
+
 class MotionKind(enum.Enum):
     REDISTRIBUTE = "Redistribute"
     BROADCAST = "Broadcast"
