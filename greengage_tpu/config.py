@@ -35,6 +35,12 @@ class Settings:
     # synchronous_standby_names / syncrep gate analog); off = mirrors go
     # stale and are barred from promotion until `gg replicate`
     mirror_sync: bool = True
+    # resource queue (resscheduler.c ResLockPortal analog): bound on
+    # concurrent mesh statements (0 = unlimited), per-query estimated
+    # device memory ceiling, and how long a statement may queue
+    resource_queue_active: int = 0
+    resource_queue_memory_mb: int = 0
+    resource_queue_timeout_s: float = 30.0
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1

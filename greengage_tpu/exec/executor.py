@@ -32,6 +32,18 @@ class QueryError(RuntimeError):
     pass
 
 
+def effective_limit_bytes(settings) -> int:
+    """Per-query device-memory ceiling: the tighter of the hardware vmem
+    guard and the resource queue's cap (queue-capped queries spill rather
+    than fail, like workfile-bound queries under the reference's resource
+    queues). 0 = unlimited."""
+    limit = settings.vmem_protect_limit_mb * (1 << 20)
+    qcap = int(getattr(settings, "resource_queue_memory_mb", 0)) << 20
+    if qcap and (not limit or qcap < limit):
+        limit = qcap
+    return limit
+
+
 @dataclass
 class Result:
     columns: list[str]
@@ -137,7 +149,7 @@ class Executor:
                     self._plan_cache[ck] = comp
                     if len(self._plan_cache) > 128:
                         self._plan_cache.pop(next(iter(self._plan_cache)))
-            limit = self.settings.vmem_protect_limit_mb * (1 << 20)
+            limit = effective_limit_bytes(self.settings)
             if limit and comp.est_bytes > limit:
                 if allow_spill and self.multihost is None:
                     # host-offload spill (exec/spill.py): partition the
@@ -160,9 +172,9 @@ class Executor:
                     return res
                 raise QueryError(
                     f"query would allocate ~{comp.est_bytes >> 20} MB per "
-                    f"segment, above vmem_protect_limit_mb="
-                    f"{self.settings.vmem_protect_limit_mb} (runaway "
-                    "protection; raise the limit or reduce the data)")
+                    f"segment, above the {limit >> 20} MB memory ceiling "
+                    "(vmem protection / resource queue; raise the limit or "
+                    "reduce the data)")
             inputs = self._stage(comp, snapshot)
             flat = comp.device_fn(*inputs)
             # ONE device->host fetch for every output (per-transfer latency

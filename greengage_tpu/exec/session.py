@@ -73,7 +73,10 @@ class Database:
         from greengage_tpu.runtime.fts import FtsProber
         from greengage_tpu.runtime.replication import Replicator
 
+        from greengage_tpu.runtime.resqueue import ResourceQueue
+
         self.dtm = DtmSession(self.store)
+        self.resqueue = ResourceQueue(self.settings)
         self.replicator = (Replicator(self.store, self.catalog.segments)
                            if self.catalog.segments.has_mirrors() else None)
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
@@ -122,19 +125,19 @@ class Database:
         out = None
         for stmt in stmts:
             if self._needs_mesh(stmt):
-                # coordinator-side validation BEFORE the broadcast: a
-                # host-side rejection after workers enter the collectives
-                # would deadlock the cluster (workers wait in psum, the
-                # coordinator never joins)
+                # coordinator-side validation AND queue admission BEFORE
+                # the broadcast: a host-side rejection or queue wait after
+                # workers enter the collectives would deadlock the cluster
                 if isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
                     self._check_no_raw_dml(stmt.table)
                     self._tx_for_dml(stmt.table, type(stmt).__name__[:6].upper())
-                ch = self.multihost.channel
-                ch.send({"op": "sql", "sql": text})
-                try:
-                    out = self._execute(stmt)
-                finally:
-                    ch.collect_acks()
+                with self.resqueue.admit():
+                    ch = self.multihost.channel
+                    ch.send({"op": "sql", "sql": text})
+                    try:
+                        out = self._execute(stmt)
+                    finally:
+                        ch.collect_acks()
             else:
                 out = self._execute(stmt)
         return out
@@ -331,25 +334,32 @@ class Database:
             if len(self._select_cache) > 256:
                 self._select_cache.pop(next(iter(self._select_cache)))
         planned, consts, outs, exec_key = cached
-        try:
-            # executor adds the manifest version; the bare statement identity
-            # lets it evict compiled programs of old versions
-            res = self.executor.run(planned, consts, outs, cache_key=exec_key)
-            self._record_stats(res)
-            return res
-        except QueryError as e:
-            if "duplicate keys" not in str(e):
-                raise
-            # the uniqueness heuristic was wrong at runtime: re-plan with the
-            # CSR multi-match join forced everywhere; cache the multi plan
-            # (with its own executor key) so repeats skip the failing program
-            planned, consts, outs = self._plan(stmt, force_multi_join=True)
-            self._select_cache[key] = (planned, consts, outs,
-                                       stmt_key + "#multi")
-            res = self.executor.run(planned, consts, outs,
-                                    cache_key=stmt_key + "#multi")
-            self._record_stats(res)
-            return res
+        # resource-queue admission (ResLockPortal analog): bound concurrent
+        # mesh statements; excess statements queue or time out. Multi-host
+        # admission happens on the COORDINATOR before the broadcast (a
+        # post-broadcast wait here would strand workers in the collectives)
+        with (self.resqueue.admit() if self.multihost is None
+              else _NullSlot()):
+            try:
+                # executor adds the manifest version; the bare statement
+                # identity lets it evict compiled programs of old versions
+                res = self.executor.run(planned, consts, outs,
+                                        cache_key=exec_key)
+                self._record_stats(res)
+                return res
+            except QueryError as e:
+                if "duplicate keys" not in str(e):
+                    raise
+                # the uniqueness heuristic was wrong at runtime: re-plan with
+                # the CSR multi-match join forced everywhere; cache the multi
+                # plan (with its own key) so repeats skip the failing program
+                planned, consts, outs = self._plan(stmt, force_multi_join=True)
+                self._select_cache[key] = (planned, consts, outs,
+                                           stmt_key + "#multi")
+                res = self.executor.run(planned, consts, outs,
+                                        cache_key=stmt_key + "#multi")
+                self._record_stats(res)
+                return res
 
     def _record_stats(self, res) -> None:
         import time as _time
@@ -758,6 +768,14 @@ class Database:
 
     def close(self):
         pass
+
+
+class _NullSlot:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
 
 
 class _EmptyScope:

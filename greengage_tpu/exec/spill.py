@@ -135,8 +135,10 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
     if max_rows == 0:
         raise NotSpillable("partition table is empty")
 
+    from greengage_tpu.exec.executor import effective_limit_bytes
+
     settings = executor.settings
-    limit_bytes = settings.vmem_protect_limit_mb * (1 << 20)
+    limit_bytes = effective_limit_bytes(settings)
 
     # pass program: gather the PARTIAL aggregate's STATE columns (raw
     # storage representation; finalize must not decode)

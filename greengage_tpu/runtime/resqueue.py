@@ -1,0 +1,101 @@
+"""Resource queues — concurrency/memory admission control.
+
+Reference parity: resource queues gate statements before execution
+(ResLockPortal, src/backend/utils/resscheduler/resscheduler.c:534) by
+active-statement count and cost ceilings; resource groups add per-role
+memory shares (src/backend/utils/resgroup/resgroup.c). The TPU-native
+translation: the scarce resources are CHIP TIME (one SPMD program runs at
+a time per mesh) and HBM, so a queue bounds concurrent mesh statements and
+per-query estimated device bytes, and queues excess statements FIFO with a
+timeout instead of failing them.
+
+Usage (session-level):
+    SET resource_queue_active = 2        -- concurrent mesh statements
+    SET resource_queue_memory_mb = 4096  -- per-query est ceiling (0 = off)
+    SET resource_queue_timeout_s = 30
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class QueueTimeout(RuntimeError):
+    pass
+
+
+class ResourceQueue:
+    """FIFO admission gate for mesh statements."""
+
+    def __init__(self, settings):
+        self.settings = settings
+        self._lock = threading.Lock()
+        self._slots = threading.Condition(self._lock)
+        self.active = 0
+        self.waiting = 0
+        self.admitted_total = 0
+        self.timed_out_total = 0
+
+    def admit(self):
+        """Blocks until a slot frees; raises QueueTimeout once
+        resource_queue_timeout_s of TOTAL wait has elapsed (deadline-based:
+        wakeups don't restart the clock). A waiter abandoning on timeout
+        re-notifies so a racing release is never lost."""
+        import time
+
+        limit = int(self.settings.resource_queue_active)
+        with self._slots:
+            if limit <= 0:
+                self.admitted_total += 1
+                return _Slot(self, counted=False)
+            timeout = float(self.settings.resource_queue_timeout_s)
+            deadline = time.monotonic() + timeout
+            self.waiting += 1
+            try:
+                while self.active >= limit:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._slots.wait(remaining):
+                        # final predicate re-check: a notify that raced our
+                        # timeout must not be swallowed
+                        if self.active < limit:
+                            break
+                        self._slots.notify()
+                        self.timed_out_total += 1
+                        raise QueueTimeout(
+                            f"statement timed out after {timeout:.0f}s "
+                            f"waiting for a resource queue slot "
+                            f"({self.active} active, limit {limit})")
+            finally:
+                self.waiting -= 1
+            self.active += 1
+            self.admitted_total += 1
+        return _Slot(self, counted=True)
+
+    def _release(self):
+        with self._slots:
+            self.active -= 1
+            self._slots.notify()
+
+    def stats(self) -> dict:
+        return {"active": self.active, "waiting": self.waiting,
+                "admitted": self.admitted_total,
+                "timed_out": self.timed_out_total,
+                "limit": int(self.settings.resource_queue_active)}
+
+
+class _Slot:
+    def __init__(self, q: ResourceQueue, counted: bool):
+        self._q = q
+        self._counted = counted
+        self._done = False
+
+    def release(self):
+        if self._counted and not self._done:
+            self._done = True
+            self._q._release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.release()

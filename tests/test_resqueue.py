@@ -1,0 +1,66 @@
+"""Resource queues — SURVEY §2.4 (resscheduler.c ResLockPortal analog):
+concurrency-bounded admission with FIFO queueing, timeouts, and a
+per-query memory ceiling that routes big queries to the spill path."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.runtime.resqueue import QueueTimeout
+
+
+@pytest.fixture()
+def db(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d.sql("create table t (k int, v int) distributed by (k)")
+    d.load_table("t", {"k": np.arange(1_000_000), "v": np.arange(1_000_000) % 7})
+    return d
+
+
+def test_concurrency_gate_queues_then_runs(db):
+    db.sql("set resource_queue_active = 1")
+    order = []
+    lock = threading.Lock()
+
+    def q(name):
+        r = db.sql("select count(*) from t")
+        with lock:
+            order.append((name, r.rows()[0][0]))
+
+    ts = [threading.Thread(target=q, args=(f"c{i}",)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(order) == 4 and all(n == 1_000_000 for _, n in order)
+    st = db.resqueue.stats()
+    assert st["admitted"] >= 4 and st["active"] == 0 and st["waiting"] == 0
+
+
+def test_queue_timeout(db):
+    db.sql("set resource_queue_active = 1")
+    db.sql("set resource_queue_timeout_s = 0.2")
+    slot = db.resqueue.admit()        # occupy the only slot
+    try:
+        with pytest.raises(QueueTimeout, match="resource queue slot"):
+            db.sql("select count(*) from t")
+    finally:
+        slot.release()
+    db.sql("set resource_queue_timeout_s = 30")
+    assert db.sql("select count(*) from t").rows()[0][0] == 1_000_000
+
+
+def test_queue_memory_cap_spills(db):
+    db.sql("create table d2 (pk int, g int) distributed by (pk)")
+    db.sql("insert into d2 values " + ",".join(f"({i},{i%5})" for i in range(1, 200)))
+    db.sql("analyze")
+    q = "select g, count(*) from t join d2 on t.v + 1 = d2.pk group by g order by g"
+    want = db.sql(q).rows()
+    db.sql("set resource_queue_memory_mb = 2")
+    try:
+        r = db.sql(q)
+        assert r.rows() == want
+        assert r.stats.get("spill_passes", 0) >= 2
+    finally:
+        db.sql("set resource_queue_memory_mb = 0")
