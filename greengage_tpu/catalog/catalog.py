@@ -21,6 +21,7 @@ class Catalog:
     def __init__(self, numsegments: int, path: str | None = None,
                  mirrors: bool = False):
         self.tables: dict[str, TableSchema] = {}
+        self.extensions: list[str] = []   # CREATE EXTENSION survivors
         self.segments = SegmentConfig.create(numsegments, with_mirrors=mirrors)
         self.path = path  # cluster dir; None = in-memory only
 
@@ -61,6 +62,7 @@ class Catalog:
             "numsegments": self.segments.numsegments,
             "segments": self.segments.to_dict(),
             "tables": {n: t.to_dict() for n, t in self.tables.items()},
+            "extensions": self.extensions,
         }
         os.makedirs(self.path, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".catalog")
@@ -79,4 +81,5 @@ class Catalog:
             cat.segments = SegmentConfig.from_dict(data["segments"])
         for n, t in data["tables"].items():
             cat.tables[n] = TableSchema.from_dict(t)
+        cat.extensions = list(data.get("extensions", ()))
         return cat

@@ -1,0 +1,3 @@
+"""Bundled extensions (the gpcontrib/ analog): loadable via
+CREATE EXTENSION <name>; each module registers its scalar functions
+through greengage_tpu.extensions.register_scalar at import."""

@@ -1,0 +1,22 @@
+"""geo extension: spherical-distance helpers (the earthdistance/postgis
+slice of gpcontrib). Pure jnp — XLA fuses the trig chain into the
+surrounding scan, so distance predicates cost one fused elementwise pass."""
+
+import jax.numpy as jnp
+
+from greengage_tpu import types as T
+from greengage_tpu.extensions import register_scalar
+
+_EARTH_KM = 6371.0088  # IUGG mean radius
+
+
+def _haversine_km(lat1, lon1, lat2, lon2):
+    p1, p2 = jnp.radians(lat1), jnp.radians(lat2)
+    dphi = p2 - p1
+    dlmb = jnp.radians(lon2 - lon1)
+    a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2
+    return 2 * _EARTH_KM * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+register_scalar("haversine_km", _haversine_km, ("float64",) * 4, T.FLOAT64,
+                extension="geo")

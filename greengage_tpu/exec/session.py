@@ -82,12 +82,27 @@ class Database:
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
                              on_change=self.catalog._save)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
+        self._load_extensions()
         # serializes write/DDL statements across threads sharing this
         # Database (server connections); readers stay lock-free on
         # manifest snapshots
         import threading
 
         self._write_lock = threading.RLock()
+
+    def _load_extensions(self) -> None:
+        """Best-effort: a recorded extension whose module is gone must not
+        brick the cluster (PG opens the database and errors at use); its
+        functions simply stay unknown."""
+        import warnings
+
+        from greengage_tpu import extensions as X
+
+        for name in self.catalog.extensions:
+            try:
+                X.load(name)
+            except ValueError as e:
+                warnings.warn(f"extension {name!r} failed to load: {e}")
 
     # ------------------------------------------------------------------
     def sql(self, text: str):
@@ -174,6 +189,7 @@ class Database:
         """Adopt the coordinator's committed catalog/manifest state from
         the shared cluster directory (workers call this per statement)."""
         self.catalog = Catalog.load(self.path)
+        self._load_extensions()
         self.store.catalog = self.catalog
         self.numsegments = self.catalog.segments.numsegments
         self.executor.catalog = self.catalog
@@ -227,6 +243,8 @@ class Database:
             return out
         if isinstance(stmt, A.AnalyzeStmt):
             return self._analyze(stmt.table)
+        if isinstance(stmt, A.CreateExtensionStmt):
+            return self._create_extension(stmt)
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
         if isinstance(stmt, A.SetStmt):
@@ -245,6 +263,21 @@ class Database:
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
+    def _create_extension(self, stmt) -> str:
+        """Import the extension module (registering its UDFs) and record
+        it in the catalog so reopened clusters and workers reload it
+        (reference: src/backend/commands/extension.c:1546)."""
+        from greengage_tpu import extensions as X
+
+        if stmt.name in self.catalog.extensions:
+            if stmt.if_not_exists:
+                return "CREATE EXTENSION"
+            raise ValueError(f'extension "{stmt.name}" already exists')
+        X.load(stmt.name)
+        self.catalog.extensions.append(stmt.name)
+        self.catalog._save()
+        return "CREATE EXTENSION"
+
     def _analyze(self, table: str | None) -> str:
         """ANALYZE [table]: collect per-column NDV/min-max/null-frac/MCV
         into the catalog (pg_statistic analog; planner/stats.py)."""

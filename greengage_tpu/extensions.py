@@ -1,0 +1,155 @@
+"""Extension mechanism — scalar UDF registry + CREATE EXTENSION.
+
+Reference parity: pg_proc function lookup + CREATE EXTENSION
+(reference: src/backend/commands/extension.c:1546 CreateExtension,
+src/backend/parser/parse_func.c func_get_detail; gpcontrib/ for the
+shipped extension set). The TPU-native translation: a UDF is a
+jax-traceable callable registered under (name, arity). The binder types
+calls against the declared signature and the expression compiler INLINES
+the callable into the fused XLA program — there is no fmgr call boundary,
+so a UDF costs the same as a builtin (XLA fuses it into the surrounding
+kernel). Extensions are plain Python modules that call register_scalar at
+import; CREATE EXTENSION imports them and records the name in the catalog
+so reopened clusters reload them.
+
+All functions are STRICT (NULL in -> NULL out), matching the common PG
+default; the evaluator AND-combines argument validity.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from dataclasses import dataclass
+from typing import Callable
+
+from greengage_tpu import types as T
+
+
+@dataclass(frozen=True)
+class ScalarFunction:
+    name: str
+    fn: Callable                  # jax-traceable; receives device arrays
+    arg_types: tuple[str, ...]    # 'float64'|'int64'|'numeric'|'bool'|'date'|'any'
+    result_type: object           # T.SqlType, or 'first' (= first arg's type)
+    extension: str                # '' for builtins
+    masked: bool = False          # fn returns (value, invalid_bool_mask)
+
+
+_REGISTRY: dict[tuple[str, int], ScalarFunction] = {}
+_LOADED: list[str] = []
+_LOADING: list[str] = []   # extension name currently importing (load())
+
+
+def register_scalar(name: str, fn: Callable, arg_types, result_type,
+                    extension: str | None = None, masked: bool = False) -> None:
+    """Register a scalar UDF; overloading is by arity only (keyed on
+    (lowercase name, nargs)). Re-registration replaces (idempotent module
+    reloads). Functions registered during load() are tagged with that
+    extension's name so visibility follows each database's catalog."""
+    if extension is None:
+        extension = _LOADING[-1] if _LOADING else ""
+    spec = ScalarFunction(name.lower(), fn, tuple(arg_types), result_type,
+                          extension, masked)
+    key = (spec.name, len(spec.arg_types))
+    old = _REGISTRY.get(key)
+    if old is not None and old.extension != spec.extension:
+        # an extension must not shadow a builtin (or another extension's
+        # function) process-wide — that would change behavior in databases
+        # that never created it
+        owner = f'extension "{old.extension}"' if old.extension else "builtin"
+        raise ValueError(
+            f'function "{spec.name}"/{key[1]} conflicts with {owner}')
+    _REGISTRY[key] = spec
+
+
+def lookup(name: str, arity: int) -> ScalarFunction | None:
+    return _REGISTRY.get((name.lower(), arity))
+
+
+def arities(name: str) -> list[int]:
+    return sorted(a for (n, a) in _REGISTRY if n == name.lower())
+
+
+def loaded() -> list[str]:
+    return list(_LOADED)
+
+
+def load(name: str) -> None:
+    """CREATE EXTENSION body: import the module (which registers its
+    functions as an import side effect). Search order: the bundled
+    contrib namespace, then any importable module of that name. A module
+    that imports but registers NOTHING is rejected — `create extension
+    json` must not silently record an arbitrary stdlib module."""
+    if name in _LOADED:
+        return
+    target = None
+    for modname in (f"greengage_tpu.contrib.{name}", name):
+        if importlib.util.find_spec(modname) is not None:
+            target = modname
+            break
+    if target is None:
+        raise ValueError(f'extension "{name}" is not available: no module '
+                         f'"greengage_tpu.contrib.{name}" or "{name}"')
+    before = len(_REGISTRY)
+    _LOADING.append(name)
+    try:
+        # a failure INSIDE the module (missing dependency) propagates
+        # as-is rather than being masked by a fallback import
+        importlib.import_module(target)
+    finally:
+        _LOADING.pop()
+    if len(_REGISTRY) == before and not any(
+            sp.extension == name for sp in _REGISTRY.values()):
+        raise ValueError(
+            f'module "{target}" registered no functions; not a '
+            f"greengage_tpu extension")
+    _LOADED.append(name)
+
+
+# --------------------------------------------------------------------------
+# builtin math functions (the numeric slice of pg_proc the reference's
+# planner assumes; src/include/catalog/pg_proc.h)
+# --------------------------------------------------------------------------
+
+def _register_builtins():
+    import jax.numpy as jnp
+
+    F, f64 = T.FLOAT64, ("float64",)
+    for nm, fn in (("sqrt", jnp.sqrt), ("exp", jnp.exp), ("ln", jnp.log),
+                   ("log", lambda x: jnp.log10(x)),
+                   ("degrees", jnp.degrees), ("radians", jnp.radians),
+                   ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+                   ("atan", jnp.arctan)):
+        register_scalar(nm, fn, f64, F)
+    register_scalar("power", jnp.power, ("float64", "float64"), F)
+    register_scalar("atan2", jnp.arctan2, ("float64", "float64"), F)
+    # floor/ceil/round/trunc keep float64 (deviation: PG returns numeric
+    # for numeric input; the session layer can cast back)
+    register_scalar("floor", jnp.floor, f64, F)
+    register_scalar("ceil", jnp.ceil, f64, F)
+    register_scalar("ceiling", jnp.ceil, f64, F)
+    register_scalar("round", jnp.round, f64, F)
+    register_scalar("round", lambda x, n: jnp.round(x * 10.0 ** n) / 10.0 ** n,
+                    ("float64", "int64"), F)
+    register_scalar("trunc", jnp.trunc, f64, F)
+    # integer / sign-preserving
+    register_scalar("abs", jnp.abs, ("numeric",), "first")
+
+    def _mod(a, b):
+        # truncation semantics, sign of the dividend (PG numeric mod);
+        # mod(x, 0) yields NULL via the mask (the kernel-level deviation
+        # documented at expr_eval.zero_invalid — PG raises)
+        bad = b == 0
+        safe = jnp.where(bad, jnp.int64(1), b)
+        return a - safe * jnp.trunc(a / safe).astype(a.dtype), bad
+
+    register_scalar("mod", _mod, ("int64", "int64"), "first", masked=True)
+    register_scalar("sign", lambda x: jnp.sign(x).astype(jnp.int32),
+                    ("numeric",), T.INT32)
+    # GREATEST/LEAST are deliberately absent: PG's ignore NULL arguments
+    # (they are expression constructs, not strict functions) and the
+    # strict registry would silently return NULL instead
+
+
+_register_builtins()

@@ -278,7 +278,15 @@ class Evaluator:
         vals = [a for a, _ in args]
         fn = _FUNCS.get(e.name)
         if fn is None:
-            raise NotImplementedError(f"function {e.name}")
+            from greengage_tpu import extensions as X
+
+            spec = X.lookup(e.name, len(vals))
+            if spec is None:
+                raise NotImplementedError(f"function {e.name}")
+            if spec.masked:
+                v, bad = spec.fn(*vals)
+                return v, _and_valid(valid, ~bad)
+            fn = spec.fn
         return fn(*vals), valid
 
 
@@ -306,7 +314,6 @@ _FUNCS = {
     "extract_year": lambda d: _civil_from_days(d)[0],
     "extract_month": lambda d: _civil_from_days(d)[1],
     "extract_day": lambda d: _civil_from_days(d)[2],
-    "abs": jnp.abs,
 }
 
 

@@ -222,6 +222,13 @@ def describe(plan: Plan, indent: int = 0, annot: dict | None = None) -> str:
         extra = f" {plan.phase} keys=({', '.join(c.name for c, _ in plan.group_keys)})"
     elif isinstance(plan, Limit):
         extra = f" {plan.limit}"
+    elif isinstance(plan, Filter):
+        extra = f" {_expr_str(plan.predicate)}"
+    elif isinstance(plan, Project):
+        shown = [f"{c.name}={_expr_str(e)}" for c, e in plan.exprs[:6]
+                 if not isinstance(e, E.ColRef) or e.name != c.name]
+        if shown:
+            extra = f" [{', '.join(shown)}]"
     locus = f"  [{plan.locus.describe()}]" if plan.locus else ""
     rows = f" rows={int(plan.est_rows)}" if plan.est_rows else ""
     note = ""
@@ -240,4 +247,15 @@ def _expr_str(e: E.Expr) -> str:
         return repr(e.value)
     if isinstance(e, E.BinOp) or isinstance(e, E.Cmp):
         return f"({_expr_str(e.left)} {e.op} {_expr_str(e.right)})"
+    if isinstance(e, E.Func):
+        return f"{e.name}({', '.join(_expr_str(a) for a in e.args)})"
+    if isinstance(e, E.Cast):
+        return _expr_str(e.arg)
+    if isinstance(e, E.IsNull):
+        neg = " not" if e.negate else ""
+        return f"({_expr_str(e.arg)} is{neg} null)"
+    if isinstance(e, E.BoolOp):
+        return "(" + f" {e.op} ".join(_expr_str(a) for a in e.args) + ")"
+    if isinstance(e, E.Not):
+        return f"not {_expr_str(e.arg)}"
     return type(e).__name__

@@ -295,3 +295,9 @@ class TxStmt(ANode):
 @dataclass
 class AnalyzeStmt(ANode):
     table: str | None = None   # None = every table
+
+
+@dataclass
+class CreateExtensionStmt(ANode):
+    name: str
+    if_not_exists: bool = False

@@ -1115,9 +1115,13 @@ class Binder:
             hit = rewrites.get(id(ast)) or rewrites.get(_ast_key(ast))
             if hit is not None:
                 return _colref(hit)
-            if isinstance(ast, A.FuncCall) and ast.over is None and \
-                    ast.name in ("count", "sum", "avg", "min", "max"):
-                raise SqlError("unmatched aggregate")  # should be in rewrites
+            if isinstance(ast, A.FuncCall) and ast.over is None:
+                if ast.name in ("count", "sum", "avg", "min", "max"):
+                    raise SqlError("unmatched aggregate")  # should be in rewrites
+                # scalar function OVER aggregates: round(sum(x), 2)
+                args = [self._rewritten_expr(a, rewrites, scope, allow_plain)
+                        for a in ast.args]
+                return self._typed_scalar_func(ast.name, len(ast.args), args)
             if isinstance(ast, A.Name):
                 if allow_plain:
                     return self._expr(ast, scope)
@@ -1258,11 +1262,63 @@ class Binder:
         if isinstance(ast, A.FuncCall):
             if ast.name in ("count", "sum", "avg", "min", "max"):
                 raise SqlError(f"aggregate {ast.name}() not allowed here")
-            if ast.name == "abs":
-                a = self._expr(ast.args[0], scope)
-                return E.Func("abs", (a,), a.type)
-            raise SqlError(f"unknown function {ast.name}")
+            return self._bind_scalar_func(ast, scope)
         raise SqlError(f"cannot bind {type(ast).__name__}")
+
+    def _bind_scalar_func(self, ast: A.FuncCall, scope) -> E.Expr:
+        """Resolve against the extension registry (pg_proc analog,
+        reference: src/backend/parser/parse_func.c func_get_detail);
+        overload resolution is by arity, coercion by declared signature."""
+        return self._typed_scalar_func(
+            ast.name, len(ast.args),
+            [self._expr(a, scope) for a in ast.args])
+
+    def _typed_scalar_func(self, name: str, nargs: int,
+                           bound: list) -> E.Expr:
+        from greengage_tpu import extensions as X
+
+        spec = X.lookup(name, nargs)
+        if spec is not None and spec.extension and \
+                spec.extension not in getattr(self.catalog, "extensions", ()):
+            # visibility follows THIS database's catalog, not process
+            # import history (pg_proc is per-database)
+            raise SqlError(f"unknown function {name}")
+        if spec is None:
+            ar = X.arities(name)
+            if ar:
+                raise SqlError(
+                    f"function {name} takes "
+                    f"{' or '.join(map(str, ar))} argument(s), got {nargs}")
+            raise SqlError(f"unknown function {name}")
+        args = [self._coerce_func_arg(a, want, name)
+                for a, want in zip(bound, spec.arg_types)]
+        rt = args[0].type if spec.result_type == "first" else spec.result_type
+        return E.Func(spec.name, tuple(args), rt)
+
+    @staticmethod
+    def _coerce_func_arg(a: E.Expr, want: str, fname: str) -> E.Expr:
+        k = a.type.kind
+        num = (T.Kind.INT32, T.Kind.INT64, T.Kind.FLOAT64, T.Kind.DECIMAL)
+        if want == "any":
+            return a
+        if want == "float64":
+            if k is T.Kind.FLOAT64:
+                return a
+            if k in num:
+                return E.Cast(a, T.FLOAT64)
+        elif want == "int64":
+            if k is T.Kind.INT64:
+                return a
+            if k is T.Kind.INT32:
+                return E.Cast(a, T.INT64)
+        elif want == "numeric":
+            if k in num:
+                return a
+        elif want == "bool" and k is T.Kind.BOOL:
+            return a
+        elif want == "date" and k is T.Kind.DATE:
+            return a
+        raise SqlError(f"function {fname} expects {want}, got {a.type}")
 
     # ---- raw-text host predicates --------------------------------------
     def _host_pred(self, arg: E.Expr, payload: dict) -> E.Expr:

@@ -585,6 +585,13 @@ class Parser:
 
     def create_table(self) -> A.CreateTableStmt:
         self.expect("kw", "create")
+        if self.accept_word("extension"):
+            ine = False
+            if self.accept("kw", "if"):
+                self.expect("kw", "not")
+                self.expect("kw", "exists")
+                ine = True
+            return A.CreateExtensionStmt(self.expect("name")[1], ine)
         self.expect("kw", "table")
         ine = False
         if self.accept("kw", "if"):

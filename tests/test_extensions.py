@@ -1,0 +1,118 @@
+"""Scalar UDFs + CREATE EXTENSION (extensions.py; reference parity:
+pg_proc lookup in parse_func.c and commands/extension.c)."""
+
+import math
+
+import pytest
+
+import greengage_tpu
+from greengage_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=2)
+    d.sql("create table t (a bigint, b double precision, d numeric(10,2)) "
+          "distributed by (a)")
+    d.sql("insert into t values (4, 2.25, 12.50), (9, -3.0, 0.25), "
+          "(null, null, null)")
+    return d
+
+
+def test_builtin_math(db):
+    r = db.sql("select sqrt(a) as s, abs(b) as ab, mod(a, 5) as m from t "
+               "where a is not null").to_pandas().sort_values("s")
+    assert list(r["s"]) == [2.0, 3.0]
+    assert list(r["ab"]) == [2.25, 3.0]
+    assert list(r["m"]) == [4, 4]
+
+
+def test_round_two_arg_and_power(db):
+    r = db.sql("select round(b, 1) as r, power(abs(b), 2.0) as p from t "
+               "where a = 4").to_pandas()
+    assert list(r["r"]) == [2.2] or list(r["r"]) == [2.3]  # banker's vs half-up
+    assert list(r["p"]) == [pytest.approx(5.0625)]
+
+
+def test_decimal_coerced_to_float(db):
+    r = db.sql("select sqrt(d) as s from t where a = 9").to_pandas()
+    assert list(r["s"]) == [0.5]
+
+
+def test_null_propagates(db):
+    r = db.sql("select count(sqrt(b)) as c, count(*) as n from t").to_pandas()
+    assert list(r["c"]) == [2]   # sqrt(-3.0) is NaN but not NULL; NULL row drops
+    assert list(r["n"]) == [3]
+
+
+def test_arity_and_unknown_errors(db):
+    with pytest.raises(SqlError, match="argument"):
+        db.sql("select sqrt(a, b) from t")
+    with pytest.raises(SqlError, match="unknown function"):
+        db.sql("select frobnicate(a) from t")
+
+
+def test_udf_in_predicate_and_groupby(db):
+    r = db.sql("select sign(b) as s, count(*) as c from t "
+               "where b is not null group by sign(b)").to_pandas()
+    assert sorted(zip(r["s"], r["c"])) == [(-1, 1), (1, 1)]
+
+
+def test_create_extension_geo(db):
+    db.sql("create extension geo")
+    r = db.sql(
+        "select round(haversine_km(48.8566, 2.3522, 51.5074, -0.1278), 0) "
+        "as km from t where a = 4").to_pandas()
+    assert abs(r["km"][0] - 343.5) < 2
+
+
+def test_extension_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "c")
+    d = greengage_tpu.connect(path, numsegments=2)
+    d.sql("create table p (x double precision) distributed randomly")
+    d.sql("insert into p values (1.0)")
+    d.sql("create extension geo")
+    d2 = greengage_tpu.connect(path)
+    r = d2.sql("select haversine_km(x, x, x, x) as k from p").to_pandas()
+    assert list(r["k"]) == [0.0]
+    d2.sql("create extension if not exists geo")   # idempotent
+
+
+def test_unknown_extension(db):
+    with pytest.raises(Exception, match="not available"):
+        db.sql("create extension no_such_ext")
+
+
+def test_stdlib_module_is_not_an_extension(db):
+    with pytest.raises(Exception, match="registered no functions"):
+        db.sql("create extension json")
+
+
+def test_duplicate_create_errors(db):
+    db.sql("create extension geo")
+    with pytest.raises(Exception, match="already exists"):
+        db.sql("create extension geo")
+
+
+def test_extension_visibility_is_per_database(db, tmp_path):
+    db.sql("create extension geo")   # registers globally, records in catalog
+    other = greengage_tpu.connect(str(tmp_path / "other"), numsegments=2)
+    other.sql("create table o (x double precision) distributed randomly")
+    other.sql("insert into o values (1.0)")
+    with pytest.raises(SqlError, match="unknown function"):
+        other.sql("select haversine_km(x, x, x, x) from o")
+
+
+def test_mod_truncation_and_zero(db):
+    r = db.sql("select mod(-7 + a - a, 5) as m, mod(a, a - a) as z from t "
+               "where a = 4").to_pandas()
+    assert list(r["m"]) == [-2]          # PG sign-of-dividend semantics
+    assert r["z"].isna().all()           # mod(x, 0) -> NULL (PG raises)
+
+
+def test_date_rejected_by_math_funcs(tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "dd"), numsegments=2)
+    d.sql("create table ev (dt date) distributed randomly")
+    d.sql("insert into ev values (date '2024-01-01')")
+    with pytest.raises(SqlError, match="expects"):
+        d.sql("select sqrt(dt) from ev")
