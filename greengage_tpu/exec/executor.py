@@ -97,6 +97,24 @@ class Result:
         return pd.DataFrame(data)
 
 
+class EndpointBatch:
+    """A completed mesh program whose per-segment output shards are held
+    (on host) for endpoint-at-a-time retrieval; the backing store of one
+    parallel retrieve cursor."""
+
+    def __init__(self, executor, comp, flat, snapshot, raw: bool):
+        self.executor = executor
+        self.comp = comp
+        self.flat = flat
+        self.snapshot = snapshot
+        self.raw = raw
+        # replicated below-gather locus: a single endpoint carries the
+        # whole (identical) result
+        rep = comp.gather_child_locus.kind in (LocusKind.SEGMENT_GENERAL,
+                                               LocusKind.GENERAL)
+        self.nendpoints = 1 if rep else executor.nseg
+
+
 class Executor:
     def __init__(self, catalog, store, mesh, nseg: int, settings,
                  multihost=None):
@@ -113,7 +131,7 @@ class Executor:
     def run(self, plan, consts: dict, out_cols, cache_key=None,
             raw: bool = False, instrument: bool = False,
             scan_cap_override=None, row_ranges=None, aux_tables=None,
-            allow_spill: bool = True) -> Result:
+            allow_spill: bool = True, deferred: bool = False) -> Result:
         self._raw = raw
         self._row_ranges = row_ranges or {}
         self._aux_tables = aux_tables or {}
@@ -151,6 +169,12 @@ class Executor:
                         self._plan_cache.pop(next(iter(self._plan_cache)))
             limit = effective_limit_bytes(self.settings)
             if limit and comp.est_bytes > limit:
+                if deferred:
+                    raise QueryError(
+                        f"parallel retrieve cursor would hold ~"
+                        f"{comp.est_bytes >> 20} MB per segment, above the "
+                        f"{limit >> 20} MB memory ceiling; cursors pin the "
+                        "whole result and cannot spill")
                 if allow_spill and self.multihost is None:
                     # host-offload spill (exec/spill.py): partition the
                     # probe-linear table into passes that fit, merge the
@@ -194,6 +218,11 @@ class Executor:
             overflow = [k for k, v in flags.items()
                         if not k.startswith("join_dup") and v.any()]
             if not overflow:
+                if deferred:
+                    # parallel retrieve cursor: the program already ran and
+                    # every segment's shard is on the host — finalization
+                    # happens per-endpoint at RETRIEVE time
+                    return EndpointBatch(self, comp, flat, snapshot, raw)
                 res = self._finalize(comp, flat, snapshot)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
                 res.stats = {
@@ -229,6 +258,14 @@ class Executor:
                     cap_overrides[plan_id] = need + max(need // 16, 64)
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
+
+    def finalize_endpoint(self, batch: "EndpointBatch", seg: int) -> Result:
+        """RETRIEVE body: decode ONE segment's shard of a deferred run
+        (the retrieve-session path, reference: src/backend/cdb/endpoint/
+        cdbendpointretrieve.c — there a direct segment connection, here a
+        host-side per-shard finalize)."""
+        return self._finalize(batch.comp, batch.flat, batch.snapshot,
+                              seg_slice=[seg], raw=batch.raw)
 
     def run_single(self, plan, consts, out_cols, raw=False,
                    scan_cap_override=None, row_ranges=None, aux_tables=None):
@@ -375,16 +412,21 @@ class Executor:
         return jax.make_array_from_callback(host.shape, shard, cb)
 
     # ------------------------------------------------------------------
-    def _finalize(self, comp: CompileResult, flat, snapshot) -> Result:
+    def _finalize(self, comp: CompileResult, flat, snapshot,
+                  seg_slice=None, raw=None) -> Result:
+        if raw is not None:
+            self._raw = raw
         ncols = len(comp.out_cols)
         cap = comp.capacity
         sel = flat[2 * ncols].reshape(self.nseg, cap)
         cols_np = {}
         valids_np = {}
-        if comp.gather_child_locus.kind in (LocusKind.SEGMENT_GENERAL, LocusKind.GENERAL):
-            seg_slice = [0]  # replicated: one copy suffices (direct dispatch analog)
-        else:
-            seg_slice = range(self.nseg)
+        if seg_slice is None:
+            if comp.gather_child_locus.kind in (LocusKind.SEGMENT_GENERAL,
+                                                LocusKind.GENERAL):
+                seg_slice = [0]  # replicated: one copy suffices
+            else:
+                seg_slice = range(self.nseg)
         mask = np.concatenate([sel[s] for s in seg_slice])
         for i, c in enumerate(comp.out_cols):
             data = flat[2 * i].reshape(self.nseg, cap)

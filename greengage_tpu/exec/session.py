@@ -82,6 +82,7 @@ class Database:
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
                              on_change=self.catalog._save)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
+        self._cursors: dict[str, object] = {}  # parallel retrieve cursors
         self._load_extensions()
         # serializes write/DDL statements across threads sharing this
         # Database (server connections); readers stay lock-free on
@@ -124,6 +125,8 @@ class Database:
             return stmt.analyze
         if isinstance(stmt, A.DeleteStmt):
             return stmt.where is not None
+        if isinstance(stmt, A.DeclareCursorStmt):
+            return True   # the DECLARE runs the mesh program
         return isinstance(stmt, A.UpdateStmt)
 
     def _coordinator_sql(self, text: str):
@@ -146,6 +149,8 @@ class Database:
                 if isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
                     self._check_no_raw_dml(stmt.table)
                     self._tx_for_dml(stmt.table, type(stmt).__name__[:6].upper())
+                if isinstance(stmt, A.DeclareCursorStmt):
+                    self._validate_declare(stmt)
                 with self.resqueue.admit():
                     ch = self.multihost.channel
                     ch.send({"op": "sql", "sql": text})
@@ -165,6 +170,11 @@ class Database:
         for stmt in parse(text):
             if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
                 self._select(stmt)
+            elif isinstance(stmt, A.DeclareCursorStmt):
+                # RETRIEVE is host-side on the coordinator; the worker only
+                # participates in the DECLARE's collectives
+                planned, consts, outs = self._plan(stmt.query)
+                self.executor.run(planned, consts, outs)
             elif isinstance(stmt, A.ExplainStmt) and stmt.analyze:
                 self._explain(stmt)
             elif isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
@@ -201,6 +211,10 @@ class Database:
             return self._select(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._explain(stmt)
+        if isinstance(stmt, A.RetrieveStmt):
+            # read-only endpoint drain: the whole point is N retrieve
+            # sessions draining concurrently — never behind the write lock
+            return self._retrieve(stmt)
         # every other statement mutates shared state (catalog, manifest,
         # dictionaries, settings, tx) — one writer at a time per process
         with self._write_lock:
@@ -245,6 +259,15 @@ class Database:
             return self._analyze(stmt.table)
         if isinstance(stmt, A.CreateExtensionStmt):
             return self._create_extension(stmt)
+        if isinstance(stmt, A.DeclareCursorStmt):
+            return self._declare_cursor(stmt)
+        if isinstance(stmt, A.RetrieveStmt):
+            return self._retrieve(stmt)
+        if isinstance(stmt, A.CloseCursorStmt):
+            if stmt.cursor not in self._cursors:
+                raise ValueError(f'cursor "{stmt.cursor}" does not exist')
+            del self._cursors[stmt.cursor]
+            return "CLOSE CURSOR"
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
         if isinstance(stmt, A.SetStmt):
@@ -353,6 +376,54 @@ class Database:
         if t.kind is T.Kind.BOOL:
             return bool(v), t
         return int(v), t
+
+    # ---- parallel retrieve cursors (endpoint/cdbendpoint.c analog) -----
+    def _declare_cursor(self, stmt) -> str:
+        """DECLARE <c> PARALLEL RETRIEVE CURSOR FOR <select>: run the mesh
+        program once, keep every segment's output shard addressable as an
+        ENDPOINT; RETRIEVE drains one endpoint without gathering the rest
+        (reference: src/backend/cdb/endpoint/cdbendpoint.c — there results
+        park on the segments behind direct connections, here as per-shard
+        host buffers after the single device fetch)."""
+        self._validate_declare(stmt)
+        planned, consts, outs = self._plan(stmt.query)
+        with (self.resqueue.admit() if self.multihost is None
+              else _NullSlot()):
+            batch = self.executor.run(planned, consts, outs, deferred=True)
+        self._cursors[stmt.name] = batch
+        return f"DECLARE CURSOR ({batch.nendpoints} endpoints)"
+
+    def _validate_declare(self, stmt) -> None:
+        """Host-side DECLARE checks; in multi-host mode these MUST run on
+        the coordinator BEFORE the broadcast (workers enter the query's
+        collectives unconditionally)."""
+        if stmt.name in self._cursors:
+            raise ValueError(f'cursor "{stmt.name}" already exists')
+        q = stmt.query
+        if getattr(q, "order_by", None) or getattr(q, "limit", None) is not None \
+                or getattr(q, "offset", 0):
+            raise SqlError(
+                "parallel retrieve cursors return per-endpoint streams; "
+                "a cross-segment ORDER BY/LIMIT/OFFSET would need the "
+                "gather this cursor exists to avoid")
+
+    def _retrieve(self, stmt) -> Result:
+        batch = self._cursors.get(stmt.cursor)
+        if batch is None:
+            raise ValueError(f'cursor "{stmt.cursor}" does not exist')
+        if not 0 <= stmt.endpoint < batch.nendpoints:
+            raise ValueError(
+                f"endpoint {stmt.endpoint} out of range "
+                f"(cursor has {batch.nendpoints})")
+        return self.executor.finalize_endpoint(batch, stmt.endpoint)
+
+    def endpoints(self, cursor: str) -> list[dict]:
+        """gp_endpoints analog: addressable endpoints of an open cursor."""
+        batch = self._cursors.get(cursor)
+        if batch is None:
+            raise ValueError(f'cursor "{cursor}" does not exist')
+        return [{"cursor": cursor, "endpoint": k,
+                 "state": "READY"} for k in range(batch.nendpoints)]
 
     def _select(self, stmt: A.SelectStmt) -> Result:
         # plan cache key: structural statement identity (dataclass repr is

@@ -301,3 +301,20 @@ class AnalyzeStmt(ANode):
 class CreateExtensionStmt(ANode):
     name: str
     if_not_exists: bool = False
+
+
+@dataclass
+class DeclareCursorStmt(ANode):
+    name: str
+    query: ANode          # SelectStmt/UnionStmt
+
+
+@dataclass
+class RetrieveStmt(ANode):
+    endpoint: int
+    cursor: str
+
+
+@dataclass
+class CloseCursorStmt(ANode):
+    cursor: str

@@ -133,6 +133,26 @@ class Parser:
     def statement(self) -> A.ANode:
         if self.at_kw("select"):
             return self.select_or_union()
+        if self.at_word("declare"):
+            # DECLARE <name> PARALLEL RETRIEVE CURSOR FOR <select>
+            self.next()
+            name = self.expect("name")[1]
+            for w in ("parallel", "retrieve", "cursor"):
+                self.expect_word(w)
+            self.expect("kw", "for")
+            return A.DeclareCursorStmt(name, self.select_or_union())
+        if self.at_word("retrieve"):
+            # RETRIEVE ALL FROM ENDPOINT <n> OF <cursor>
+            self.next()
+            self.expect_word("all")
+            self.expect("kw", "from")
+            self.expect_word("endpoint")
+            ep = int(self.expect("num")[1])
+            self.expect_word("of")
+            return A.RetrieveStmt(ep, self.expect("name")[1])
+        if self.at_word("close"):
+            self.next()
+            return A.CloseCursorStmt(self.expect("name")[1])
         if self.at_kw("create"):
             return self.create_table()
         if self.at_kw("drop"):

@@ -50,6 +50,13 @@ out["updated"] = int(r.rows()[0][0])
 db.sql("delete from f where g = 12")
 r = db.sql("select count(*) from f")
 out["after_delete"] = int(r.rows()[0][0])
+# parallel retrieve cursor: DECLARE broadcasts (workers join the
+# collectives), RETRIEVE drains endpoints coordinator-side
+db.sql("declare pc parallel retrieve cursor for select k from f where v = 99")
+out["cursor_rows"] = sum(
+    len(db.sql(f"retrieve all from endpoint {k} of pc").rows())
+    for k in range(db.numsegments))
+db.sql("close pc")
 mh.channel.close()
 print("RESULT:" + json.dumps(out), flush=True)
 """
@@ -111,3 +118,4 @@ def test_two_process_cluster(tmp_path):
     assert out["updated"] == 10 - sum(1 for i in range(10) if i % 7 == 99)
     n_g12 = sum(1 for i in range(4000) if i % 13 == 12)
     assert out["after_delete"] == 4000 - n_g12
+    assert out["cursor_rows"] == 10   # the rows updated to v=99 (k<10)
