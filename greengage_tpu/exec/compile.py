@@ -524,11 +524,12 @@ class Compiler:
                     rspecs[0], rb.selection(), direct_lo, direct_domain)
                 matched, brow = join_ops.probe_direct(
                     table, lspecs[0], lb.selection(), direct_lo)
+                walk_ov = jnp.zeros((), bool)
             else:
                 table = join_ops.build(rspecs, rb.selection(), M, probes)
-                matched, brow = join_ops.probe(
+                matched, brow, walk_ov = join_ops.probe(
                     table, lspecs, lb.selection(), probes)
-            ctx["flags"].append((fid_ov, table.overflow))
+            ctx["flags"].append((fid_ov, table.overflow | walk_ov))
             if fid_dup is not None:
                 ctx["flags"].append((fid_dup, table.dup))
             cols = dict(lb.cols)
@@ -606,10 +607,14 @@ class Compiler:
             rb = right_fn(ctx)
             table = join_ops.build_multi(
                 self._key_specs(rb, rkeys), rb.selection(), M, probes)
-            ctx["flags"].append((fid_ov, table.base.overflow))
-            present, prow, brow, matched, expand_ov, total = join_ops.probe_multi(
+            (present, prow, brow, matched, expand_ov, walk_ov,
+             total) = join_ops.probe_multi(
                 table, self._key_specs(lb, lkeys), lb.selection(), probes,
                 out_cap, left_outer=(kind == "left"))
+            # walk overflow rides the table flag (tier retry grows M/hop
+            # bound); expand overflow rides its own flag whose retry hint
+            # sizes out_cap from `total`
+            ctx["flags"].append((fid_ov, table.base.overflow | walk_ov))
             ctx["flags"].append((fid_exp, expand_ov))
             ctx["metrics"].append((mid_total, total))
             cols, valids = {}, {}

@@ -100,19 +100,34 @@ class Result:
 class EndpointBatch:
     """A completed mesh program whose per-segment output shards are held
     (on host) for endpoint-at-a-time retrieval; the backing store of one
-    parallel retrieve cursor."""
+    parallel retrieve cursor.
 
-    def __init__(self, executor, comp, flat, snapshot, raw: bool):
-        self.executor = executor
+    Shards are COMPACTED to their live rows at construction: an open
+    cursor pins memory proportional to its actual result, not to the
+    program's static nseg x capacity padding (a selective cursor over a
+    big table would otherwise pin the whole scan capacity until CLOSE)."""
+
+    def __init__(self, comp, flat, snapshot, raw: bool, nseg: int):
         self.comp = comp
-        self.flat = flat
         self.snapshot = snapshot
         self.raw = raw
         # replicated below-gather locus: a single endpoint carries the
         # whole (identical) result
         rep = comp.gather_child_locus.kind in (LocusKind.SEGMENT_GENERAL,
                                                LocusKind.GENERAL)
-        self.nendpoints = 1 if rep else executor.nseg
+        self.nendpoints = 1 if rep else nseg
+        ncols = len(comp.out_cols)
+        cap = comp.capacity
+        sel = np.asarray(flat[2 * ncols]).reshape(nseg, cap)
+        self.segs: list[tuple[dict, dict]] = []
+        for k in range(self.nendpoints):
+            m = np.asarray(sel[k], bool)
+            cols, valids = {}, {}
+            for i, c in enumerate(comp.out_cols):
+                cols[c.id] = np.asarray(flat[2 * i]).reshape(nseg, cap)[k][m]
+                valids[c.id] = np.asarray(
+                    flat[2 * i + 1]).reshape(nseg, cap)[k][m]
+            self.segs.append((cols, valids))
 
 
 class Executor:
@@ -132,7 +147,6 @@ class Executor:
             raw: bool = False, instrument: bool = False,
             scan_cap_override=None, row_ranges=None, aux_tables=None,
             allow_spill: bool = True, deferred: bool = False) -> Result:
-        self._raw = raw
         self._row_ranges = row_ranges or {}
         self._aux_tables = aux_tables or {}
         t0 = time.monotonic()
@@ -222,8 +236,8 @@ class Executor:
                     # parallel retrieve cursor: the program already ran and
                     # every segment's shard is on the host — finalization
                     # happens per-endpoint at RETRIEVE time
-                    return EndpointBatch(self, comp, flat, snapshot, raw)
-                res = self._finalize(comp, flat, snapshot)
+                    return EndpointBatch(comp, flat, snapshot, raw, self.nseg)
+                res = self._finalize(comp, flat, snapshot, raw=raw)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
                 res.stats = {
                     "tiers_used": tier + 1,
@@ -260,12 +274,14 @@ class Executor:
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
 
     def finalize_endpoint(self, batch: "EndpointBatch", seg: int) -> Result:
-        """RETRIEVE body: decode ONE segment's shard of a deferred run
-        (the retrieve-session path, reference: src/backend/cdb/endpoint/
-        cdbendpointretrieve.c — there a direct segment connection, here a
-        host-side per-shard finalize)."""
-        return self._finalize(batch.comp, batch.flat, batch.snapshot,
-                              seg_slice=[seg], raw=batch.raw)
+        """RETRIEVE body: decode ONE segment's compacted shard of a
+        deferred run (the retrieve-session path, reference: src/backend/
+        cdb/endpoint/cdbendpointretrieve.c — there a direct segment
+        connection, here a host-side per-shard decode)."""
+        cols, valids = batch.segs[seg]
+        # shallow dict copies: _present reassigns dict slots (merge/limit)
+        return self._present(batch.comp, dict(cols), dict(valids),
+                             batch.snapshot, batch.raw)
 
     def run_single(self, plan, consts, out_cols, raw=False,
                    scan_cap_override=None, row_ranges=None, aux_tables=None):
@@ -413,9 +429,10 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _finalize(self, comp: CompileResult, flat, snapshot,
-                  seg_slice=None, raw=None) -> Result:
-        if raw is not None:
-            self._raw = raw
+                  seg_slice=None, raw: bool = False) -> Result:
+        # raw is an explicit parameter, never instance state: a lock-free
+        # RETRIEVE finalizing concurrently with a DML's raw-mode run must
+        # not flip the other call's decode behavior
         ncols = len(comp.out_cols)
         cap = comp.capacity
         sel = flat[2 * ncols].reshape(self.nseg, cap)
@@ -433,7 +450,12 @@ class Executor:
             valid = flat[2 * i + 1].reshape(self.nseg, cap)
             cols_np[c.id] = np.concatenate([data[s] for s in seg_slice])[mask]
             valids_np[c.id] = np.concatenate([valid[s] for s in seg_slice])[mask]
+        return self._present(comp, cols_np, valids_np, snapshot, raw)
 
+    def _present(self, comp: CompileResult, cols_np, valids_np, snapshot,
+                 raw: bool) -> Result:
+        """Host-side presentation of extracted row data: merge-sorted
+        receive, host LIMIT, TEXT/decimal/date decode, Result assembly."""
         # host merge of per-segment sorted runs (Merge Receive analog)
         if comp.merge_keys:
             order = _host_sort_order(cols_np, valids_np, comp.merge_keys, self.store)
@@ -454,7 +476,7 @@ class Executor:
         for c in comp.out_cols:
             data = cols_np[c.id]
             valid = valids_np[c.id]
-            if getattr(self, "_raw", False) or getattr(c, "hidden", False):
+            if raw or getattr(c, "hidden", False):
                 out_cols[c.id] = data
                 out_valids[c.id] = None if valid.all() else valid
                 continue

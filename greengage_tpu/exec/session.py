@@ -75,7 +75,13 @@ class Database:
 
         from greengage_tpu.runtime.resqueue import ResourceQueue
 
-        self.dtm = DtmSession(self.store)
+        # transaction state is PER THREAD: the SQL server runs one thread
+        # per connection, so each wire connection (and each direct-API
+        # thread) gets its own transaction, like one backend per libpq
+        # connection (reference: src/backend/cdb/cdbtm.c MyTmGxact being
+        # per-backend state)
+        self._DtmSession = DtmSession
+        self._dtm_local = None   # created below once threading is imported
         self.resqueue = ResourceQueue(self.settings)
         self.replicator = (Replicator(self.store, self.catalog.segments)
                            if self.catalog.segments.has_mirrors() else None)
@@ -83,6 +89,15 @@ class Database:
                              on_change=self.catalog._save)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
         self._cursors: dict[str, object] = {}  # parallel retrieve cursors
+        self._cursor_owner: dict[str, int] = {}  # cursor -> thread ident
+        # monotonic DROP TABLE log: an in-flight (unlocked) DECLARE
+        # compares its pre-run mark against this at registration to catch
+        # a table dropped out from under it mid-run. _drop_base counts
+        # pruned entries (the log is cleared whenever no DECLARE is in
+        # flight, so it cannot grow with long-lived drop-heavy sessions)
+        self._drop_log: list[str] = []
+        self._drop_base = 0
+        self._inflight_declares = 0
         self._load_extensions()
         # serializes write/DDL statements across threads sharing this
         # Database (server connections); readers stay lock-free on
@@ -90,6 +105,24 @@ class Database:
         import threading
 
         self._write_lock = threading.RLock()
+        self._dtm_local = threading.local()
+
+    @property
+    def dtm(self):
+        """The calling thread's transaction session (lazily created)."""
+        d = getattr(self._dtm_local, "dtm", None)
+        if d is None:
+            d = self._DtmSession(self.store)
+            self._dtm_local.dtm = d
+        return d
+
+    def abort_if_active(self) -> None:
+        """Roll back the calling thread's open transaction, if any — the
+        server calls this when a connection drops mid-transaction."""
+        cur = self.dtm.current
+        if cur is not None and cur.state == "active":
+            with self._write_lock:
+                self.dtm.abort()
 
     def _load_extensions(self) -> None:
         """Best-effort: a recorded extension whose module is gone must not
@@ -172,9 +205,23 @@ class Database:
                 self._select(stmt)
             elif isinstance(stmt, A.DeclareCursorStmt):
                 # RETRIEVE is host-side on the coordinator; the worker only
-                # participates in the DECLARE's collectives
-                planned, consts, outs = self._plan(stmt.query)
-                self.executor.run(planned, consts, outs)
+                # participates in the DECLARE's collectives. deferred=True
+                # mirrors the coordinator exactly: same pre-collective
+                # memory-ceiling behavior, and no wasted full-result
+                # finalize/decode of a shard nobody reads
+                planned, consts, outs, ek = self._cached_plan(stmt.query)
+                try:
+                    self.executor.run(planned, consts, outs, cache_key=ek,
+                                      deferred=True)
+                except QueryError as e:
+                    if "duplicate keys" not in str(e):
+                        raise
+                    # deterministic lockstep with the coordinator's re-plan:
+                    # both sides saw the same dup flag on the same data
+                    planned, consts, outs, ek = self._cached_plan(
+                        stmt.query, force_multi_join=True)
+                    self.executor.run(planned, consts, outs, cache_key=ek,
+                                      deferred=True)
             elif isinstance(stmt, A.ExplainStmt) and stmt.analyze:
                 self._explain(stmt)
             elif isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
@@ -215,6 +262,11 @@ class Database:
             # read-only endpoint drain: the whole point is N retrieve
             # sessions draining concurrently — never behind the write lock
             return self._retrieve(stmt)
+        if isinstance(stmt, A.DeclareCursorStmt):
+            # read-only query; only the cursor-registry insert takes the
+            # lock (inside _declare_cursor) — a multi-second DECLARE must
+            # not stall every concurrent writer
+            return self._declare_cursor(stmt)
         # every other statement mutates shared state (catalog, manifest,
         # dictionaries, settings, tx) — one writer at a time per process
         with self._write_lock:
@@ -227,6 +279,17 @@ class Database:
             existed = stmt.name in self.catalog
             self.catalog.drop_table(stmt.name, stmt.if_exists)
             if existed:
+                # invalidate open cursors that scanned this table: their
+                # deferred shards may still dereference the table's files
+                # (raw TEXT blobs, dictionaries) at RETRIEVE time
+                for cname, batch in list(self._cursors.items()):
+                    spec = getattr(getattr(batch, "comp", None),
+                                   "input_spec", ())
+                    if any(t == stmt.name for t, *_ in spec):
+                        self._cursors[cname] = (
+                            f'cursor "{cname}" was invalidated by DROP '
+                            f'TABLE {stmt.name}')
+                self._drop_log.append(stmt.name)
                 # drop storage too: manifest commit removes the table's
                 # segfiles from visibility; data dir cleanup is best-effort
                 tx = self.store.manifest.begin()
@@ -259,14 +322,11 @@ class Database:
             return self._analyze(stmt.table)
         if isinstance(stmt, A.CreateExtensionStmt):
             return self._create_extension(stmt)
-        if isinstance(stmt, A.DeclareCursorStmt):
-            return self._declare_cursor(stmt)
-        if isinstance(stmt, A.RetrieveStmt):
-            return self._retrieve(stmt)
         if isinstance(stmt, A.CloseCursorStmt):
             if stmt.cursor not in self._cursors:
                 raise ValueError(f'cursor "{stmt.cursor}" does not exist')
             del self._cursors[stmt.cursor]
+            self._cursor_owner.pop(stmt.cursor, None)
             return "CLOSE CURSOR"
         if isinstance(stmt, A.ShowStmt):
             return str(self.settings.show(stmt.what))
@@ -385,19 +445,78 @@ class Database:
         (reference: src/backend/cdb/endpoint/cdbendpoint.c — there results
         park on the segments behind direct connections, here as per-shard
         host buffers after the single device fetch)."""
+        import threading
+
         self._validate_declare(stmt)
-        planned, consts, outs = self._plan(stmt.query)
-        with (self.resqueue.admit() if self.multihost is None
-              else _NullSlot()):
-            batch = self.executor.run(planned, consts, outs, deferred=True)
-        self._cursors[stmt.name] = batch
-        return f"DECLARE CURSOR ({batch.nendpoints} endpoints)"
+        with self._write_lock:
+            drop_mark = self._drop_base + len(self._drop_log)
+            self._inflight_declares += 1
+        try:
+            # same plan/program memoization as _select: a drain-then-
+            # redeclare workload must not replan + recompile each DECLARE
+            planned, consts, outs, exec_key = self._cached_plan(stmt.query)
+            with (self.resqueue.admit() if self.multihost is None
+                  else _NullSlot()):
+                try:
+                    batch = self.executor.run(planned, consts, outs,
+                                              cache_key=exec_key,
+                                              deferred=True)
+                except QueryError as e:
+                    if "duplicate keys" not in str(e):
+                        raise
+                    # same re-plan fallback as _select: the uniqueness
+                    # heuristic was wrong at runtime -> CSR multi-match join
+                    planned, consts, outs, exec_key = self._cached_plan(
+                        stmt.query, force_multi_join=True)
+                    batch = self.executor.run(planned, consts, outs,
+                                              cache_key=exec_key,
+                                              deferred=True)
+            with self._write_lock:
+                prev = self._cursors.get(stmt.name)
+                if prev is not None and not isinstance(prev, str):
+                    # raced with another DECLARE of the same name
+                    raise ValueError(f'cursor "{stmt.name}" already exists')
+                # a table dropped while the (unlocked) run was in flight:
+                # register the tombstone DROP TABLE could not place yet
+                dropped = set(self._drop_log[drop_mark - self._drop_base:])
+                hit = [t for t, *_ in batch.comp.input_spec if t in dropped]
+                if hit:
+                    self._cursors[stmt.name] = (
+                        f'cursor "{stmt.name}" was invalidated by DROP '
+                        f'TABLE {hit[0]}')
+                    self._cursor_owner[stmt.name] = threading.get_ident()
+                    return "DECLARE CURSOR (invalidated by concurrent DROP)"
+                self._cursors[stmt.name] = batch
+                # cursors are session-scoped (one server connection = one
+                # thread); the server closes a dropped connection's cursors
+                self._cursor_owner[stmt.name] = threading.get_ident()
+            return f"DECLARE CURSOR ({batch.nendpoints} endpoints)"
+        finally:
+            with self._write_lock:
+                self._inflight_declares -= 1
+                if self._inflight_declares == 0 and self._drop_log:
+                    # no mark can reference the log anymore: prune it
+                    self._drop_base += len(self._drop_log)
+                    self._drop_log.clear()
+
+    def close_thread_cursors(self) -> None:
+        """Release cursors declared by the calling thread (connection
+        teardown; the reference's endpoints die with their session)."""
+        import threading
+
+        me = threading.get_ident()
+        with self._write_lock:
+            for name in [n for n, t in self._cursor_owner.items() if t == me]:
+                self._cursors.pop(name, None)
+                self._cursor_owner.pop(name, None)
 
     def _validate_declare(self, stmt) -> None:
         """Host-side DECLARE checks; in multi-host mode these MUST run on
         the coordinator BEFORE the broadcast (workers enter the query's
         collectives unconditionally)."""
-        if stmt.name in self._cursors:
+        existing = self._cursors.get(stmt.name)
+        if existing is not None and not isinstance(existing, str):
+            # (a str is a DROP TABLE tombstone — the name is reusable)
             raise ValueError(f'cursor "{stmt.name}" already exists')
         q = stmt.query
         if getattr(q, "order_by", None) or getattr(q, "limit", None) is not None \
@@ -411,33 +530,58 @@ class Database:
         batch = self._cursors.get(stmt.cursor)
         if batch is None:
             raise ValueError(f'cursor "{stmt.cursor}" does not exist')
+        if isinstance(batch, str):   # DROP TABLE tombstone
+            raise ValueError(batch)
         if not 0 <= stmt.endpoint < batch.nendpoints:
             raise ValueError(
                 f"endpoint {stmt.endpoint} out of range "
                 f"(cursor has {batch.nendpoints})")
-        return self.executor.finalize_endpoint(batch, stmt.endpoint)
+        try:
+            return self.executor.finalize_endpoint(batch, stmt.endpoint)
+        except (FileNotFoundError, OSError):
+            # a DROP TABLE can delete this cursor's backing storage while
+            # the (lock-free) decode is in flight; surface the tombstone
+            # it planted instead of a raw IO error
+            now = self._cursors.get(stmt.cursor)
+            if isinstance(now, str):
+                raise ValueError(now) from None
+            raise
 
     def endpoints(self, cursor: str) -> list[dict]:
         """gp_endpoints analog: addressable endpoints of an open cursor."""
         batch = self._cursors.get(cursor)
         if batch is None:
             raise ValueError(f'cursor "{cursor}" does not exist')
+        if isinstance(batch, str):
+            raise ValueError(batch)
         return [{"cursor": cursor, "endpoint": k,
                  "state": "READY"} for k in range(batch.nendpoints)]
 
-    def _select(self, stmt: A.SelectStmt) -> Result:
-        # plan cache key: structural statement identity (dataclass repr is
-        # deep + deterministic) + manifest version (bound plans embed
-        # dictionary codes/LUTs, which can grow with new data)
+    def _cached_plan(self, stmt, force_multi_join: bool = False):
+        """Memoized planning for SELECT-shaped statements (plain SELECT
+        and the DECLARE CURSOR body). Cache key: structural statement
+        identity (dataclass repr is deep + deterministic) + manifest
+        version (bound plans embed dictionary codes/LUTs, which can grow
+        with new data). A force_multi_join re-plan is remembered under the
+        PLAIN key so repeats skip the failing unique-join program.
+        -> (planned, consts, outs, exec_key)."""
         stmt_key = repr(stmt)
         key = (stmt_key, self.store.manifest.snapshot().get("version", 0))
+        if force_multi_join:
+            cached = (*self._plan(stmt, force_multi_join=True),
+                      stmt_key + "#multi")
+            self._select_cache[key] = cached
+            return cached
         cached = self._select_cache.get(key)
         if cached is None:
             cached = (*self._plan(stmt), stmt_key)
             self._select_cache[key] = cached
             if len(self._select_cache) > 256:
                 self._select_cache.pop(next(iter(self._select_cache)))
-        planned, consts, outs, exec_key = cached
+        return cached
+
+    def _select(self, stmt: A.SelectStmt) -> Result:
+        planned, consts, outs, exec_key = self._cached_plan(stmt)
         # resource-queue admission (ResLockPortal analog): bound concurrent
         # mesh statements; excess statements queue or time out. Multi-host
         # admission happens on the COORDINATOR before the broadcast (a
@@ -455,13 +599,12 @@ class Database:
                 if "duplicate keys" not in str(e):
                     raise
                 # the uniqueness heuristic was wrong at runtime: re-plan with
-                # the CSR multi-match join forced everywhere; cache the multi
-                # plan (with its own key) so repeats skip the failing program
-                planned, consts, outs = self._plan(stmt, force_multi_join=True)
-                self._select_cache[key] = (planned, consts, outs,
-                                           stmt_key + "#multi")
+                # the CSR multi-match join forced everywhere; cached under
+                # the plain key so repeats skip the failing program
+                planned, consts, outs, exec_key = self._cached_plan(
+                    stmt, force_multi_join=True)
                 res = self.executor.run(planned, consts, outs,
-                                        cache_key=stmt_key + "#multi")
+                                        cache_key=exec_key)
                 self._record_stats(res)
                 return res
 
@@ -703,6 +846,11 @@ class Database:
         return res, outs
 
     def _check_no_raw_dml(self, table: str):
+        # NOTE when this guard is lifted (raw DML): a committed republish
+        # GC's the old raw blobs, so open cursors whose out_cols carry
+        # raw_refs into this table must be tombstoned at commit (their
+        # RETRIEVE would fetch_raw from deleted files); dict codes are
+        # append-only and need no invalidation.
         if self.store.has_raw_columns(table):
             raise SqlError(
                 f'table "{table}" has raw-encoded TEXT columns; '

@@ -11,9 +11,11 @@ Reference parity: exec_simple_query serving many clients
 (src/backend/tcop/postgres.c:1622). Each connection gets a thread; SELECTs
 run lock-free on manifest snapshots, write statements serialize on the
 session write lock (one writer gang at a time), so concurrent COPY +
-SELECT + UPDATE interleave safely. Session-scoped state (BEGIN/COMMIT) is
-per-Database, not per-connection, so transactions over the wire are
-rejected — a connection-scoped transaction manager is the next step.
+SELECT + UPDATE interleave safely. Transaction state is per connection
+(the Database keeps one DtmSession per thread, and each connection is a
+thread), so BEGIN/COMMIT/ROLLBACK work over the wire; a connection that
+drops mid-transaction is rolled back, like a backend exiting. Conflicting
+commits fail at the manifest CAS with a serialization error.
 """
 
 from __future__ import annotations
@@ -56,6 +58,15 @@ class SqlServer:
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 outer.connections_served += 1
+                try:
+                    self._serve()
+                finally:
+                    # a connection dropping mid-transaction rolls back, and
+                    # its cursors close, like a libpq backend exiting
+                    outer.db.abort_if_active()
+                    outer.db.close_thread_cursors()
+
+            def _serve(self):
                 for line in self.rfile:
                     line = line.strip()
                     if not line:
@@ -63,13 +74,6 @@ class SqlServer:
                     try:
                         req = json.loads(line)
                         sql = req["sql"]
-                        from greengage_tpu.sql import ast as A
-                        from greengage_tpu.sql.parser import parse
-
-                        if any(isinstance(st, A.TxStmt) for st in parse(sql)):
-                            raise ValueError(
-                                "transactions are per-session; not "
-                                "available over the wire yet")
                         out = outer.db.sql(sql)
                         if isinstance(out, str) or out is None:
                             resp = {"ok": True, "columns": None,

@@ -171,14 +171,54 @@ def test_server_concurrent_clients(db, tmp_path):
         [t.join() for t in ts]
         assert not errs, errs
         assert results["w"][2]["rows"] == [[42]]
-        # transactions rejected over the wire, with a clear error
-        c = SqlClient(sock)
-        with pytest.raises(RuntimeError) as ei:
-            c.sql("begin")
-        assert "per-session" in str(ei.value)
         # errors are per-statement: the connection stays usable
+        c = SqlClient(sock)
+        with pytest.raises(RuntimeError):
+            c.sql("select * from nosuch")
         assert c.sql("select count(*) from acc")["rows"][0][0] == 41
         c.close()
         assert srv.connections_served >= 4
+    finally:
+        srv.stop()
+
+
+def test_server_wire_transactions(db, tmp_path):
+    """BEGIN/COMMIT are per connection: another client never sees
+    uncommitted rows; ROLLBACK discards; a dropped connection aborts."""
+    from greengage_tpu.runtime.server import SqlClient, SqlServer
+
+    sock = str(tmp_path / "gg.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    try:
+        a, b = SqlClient(sock), SqlClient(sock)
+        a.sql("begin")
+        a.sql("insert into acc values (9000, 7)")
+        # invisible to b until a commits
+        assert b.sql("select count(*) from acc where id = 9000")["rows"] == [[0]]
+        a.sql("commit")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if b.sql("select count(*) from acc where id = 9000")["rows"] == [[1]]:
+                break
+        assert b.sql("select count(*) from acc where id = 9000")["rows"] == [[1]]
+        # rollback discards
+        b.sql("begin")
+        b.sql("insert into acc values (9001, 7)")
+        b.sql("rollback")
+        assert a.sql("select count(*) from acc where id = 9001")["rows"] == [[0]]
+        # dropping a connection mid-transaction rolls it back
+        c = SqlClient(sock)
+        c.sql("begin")
+        c.sql("insert into acc values (9002, 7)")
+        c.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if db.sql("select count(*) from acc where id = 9002").rows() == [(0,)]:
+                break
+            time.sleep(0.05)
+        assert db.sql("select count(*) from acc where id = 9002").rows()[0][0] == 0
+        a.close()
+        b.close()
     finally:
         srv.stop()

@@ -83,6 +83,57 @@ def test_retrieve_decodes_after_raw_mode_dml(devices8):
     d.sql("close cm")
 
 
+def test_declare_duplicate_build_key_replans(db):
+    """A join whose build side has duplicate keys must work under DECLARE
+    exactly as it does under plain SELECT (multi-match re-plan)."""
+    db.sql("create table dim (k bigint, w bigint) distributed by (k)")
+    db.sql("insert into dim values (1, 10), (1, 11), (2, 20)")
+    whole = db.sql("select f.k, dim.w from f join dim on f.k = dim.k")
+    db.sql("declare cj parallel retrieve cursor for "
+           "select f.k, dim.w from f join dim on f.k = dim.k")
+    got = []
+    for e in db.endpoints("cj"):
+        r = db.sql(f"retrieve all from endpoint {e['endpoint']} of cj")
+        got.extend(map(tuple, r.rows()))
+    assert sorted(got) == sorted(map(tuple, whole.rows()))
+    db.sql("close cj")
+
+
+def test_drop_table_invalidates_cursor(db):
+    db.sql("declare cd parallel retrieve cursor for select k from f")
+    db.sql("drop table f")
+    with pytest.raises(ValueError, match="invalidated by DROP TABLE"):
+        db.sql("retrieve all from endpoint 0 of cd")
+    # the name is reusable (tombstone), and CLOSE clears it
+    db.sql("close cd")
+    with pytest.raises(ValueError, match="does not exist"):
+        db.sql("retrieve all from endpoint 0 of cd")
+
+
+def test_connection_drop_closes_cursors(db, tmp_path):
+    """A server connection's cursors die with it (session-scoped)."""
+    import time
+
+    from greengage_tpu.runtime.server import SqlClient, SqlServer
+
+    sock = str(tmp_path / "gg.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    try:
+        c = SqlClient(sock)
+        c.sql("declare conn_c parallel retrieve cursor for select k from f")
+        assert c.sql("retrieve all from endpoint 0 of conn_c")["ok"]
+        c.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and "conn_c" in db._cursors:
+            time.sleep(0.05)
+        assert "conn_c" not in db._cursors   # freed, name reusable
+        db.sql("declare conn_c parallel retrieve cursor for select k from f")
+        db.sql("close conn_c")
+    finally:
+        srv.stop()
+
+
 def test_retrieve_errors(db):
     db.sql("declare c4 parallel retrieve cursor for select k from f")
     with pytest.raises(ValueError, match="out of range"):

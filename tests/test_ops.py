@@ -204,9 +204,10 @@ def test_hash_join_pk_fk():
         [agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)],
         jnp.ones(nb, dtype=bool), 1024, 8)
     assert not bool(table.overflow) and not bool(table.dup)
-    matched, brow = join_ops.probe(
+    matched, brow, walk_ov = join_ops.probe(
         table, [agg_ops.KeySpec(jnp.asarray(pkey), None, T.INT64)],
         jnp.asarray(psel), 8)
+    assert not bool(walk_ov)
 
     bcols, bvalids = join_ops.gather_build_columns(
         {"bval": jnp.asarray(bval)}, {}, brow, matched)
@@ -235,7 +236,7 @@ def test_hash_join_null_keys_never_match():
                            jnp.ones(2, dtype=bool), 8, 4)
     pkey = np.array([1, 0], dtype=np.int64)
     pvalid = np.array([True, False])
-    matched, _ = join_ops.probe(
+    matched, _, _ = join_ops.probe(
         table, [agg_ops.KeySpec(jnp.asarray(pkey), jnp.asarray(pvalid), T.INT64)],
         jnp.ones(2, dtype=bool), 4)
     assert list(np.asarray(matched)) == [True, False]
