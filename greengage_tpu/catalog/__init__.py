@@ -1,4 +1,5 @@
 from greengage_tpu.catalog.schema import (  # noqa: F401
+    Partition,
     Column,
     DistPolicy,
     PolicyKind,

@@ -48,11 +48,28 @@ class Catalog:
 
     def get(self, name: str) -> TableSchema:
         if name not in self.tables:
+            # partition child storage tables ("parent#part") share the
+            # parent's schema — every storage path (insert, read, expand,
+            # replicate) resolves them transparently
+            if "#" in name:
+                parent, part = name.split("#", 1)
+                if parent in self.tables:
+                    schema = self.tables[parent]
+                    if any(p.name == part for p in schema.partitions):
+                        return schema
             raise ValueError(f'relation "{name}" does not exist')
         return self.tables[name]
 
     def __contains__(self, name: str) -> bool:
-        return name in self.tables
+        if name in self.tables:
+            return True
+        if "#" in name:
+            try:
+                self.get(name)
+                return True
+            except ValueError:
+                return False
+        return False
 
     # ---- persistence ---------------------------------------------------
     def _save(self) -> None:

@@ -41,6 +41,45 @@ class DistPolicy:
 
 
 @dataclass(frozen=True)
+class Partition:
+    """One partition of a RANGE/LIST-partitioned table.
+
+    Reference parity: pg_partition_rule (src/backend/cdb/cdbpartition.c) —
+    single-level here; each partition's rows live in their own storage
+    table ``<parent>#<name>`` so pruning is a staging decision and DROP
+    PARTITION is O(1). RANGE bounds are half-open [lo, hi) in the
+    column's storage representation (dates = epoch days, decimals =
+    scaled ints); None = unbounded. LIST carries its value set.
+    ``default``: catches rows no other partition accepts."""
+
+    name: str
+    lo: object = None           # RANGE inclusive start
+    hi: object = None           # RANGE exclusive end
+    values: tuple = ()          # LIST values
+    default: bool = False
+
+    def storage_name(self, parent: str) -> str:
+        return f"{parent}#{self.name}"
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name}
+        if self.lo is not None:
+            d["lo"] = self.lo
+        if self.hi is not None:
+            d["hi"] = self.hi
+        if self.values:
+            d["values"] = list(self.values)
+        if self.default:
+            d["default"] = True
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Partition":
+        return Partition(d["name"], d.get("lo"), d.get("hi"),
+                         tuple(d.get("values", ())), d.get("default", False))
+
+
+@dataclass(frozen=True)
 class Column:
     name: str
     type: T.SqlType
@@ -59,6 +98,10 @@ class TableSchema:
     policy: DistPolicy
     options: dict = field(default_factory=dict)  # e.g. compresstype, blocksize
     stats: object = None   # planner.stats.TableStats from ANALYZE (or None)
+    # single-level partitioning (cdbpartition.c role): ("range"|"list",
+    # column name) + the partition set; None = unpartitioned
+    partition_by: tuple | None = None
+    partitions: list[Partition] = field(default_factory=list)
 
     def __post_init__(self):
         names = [c.name for c in self.columns]
@@ -73,6 +116,103 @@ class TableSchema:
             if c.name == name:
                 return c
         raise KeyError(f"{self.name}.{name}")
+
+    # ---- partitioning ------------------------------------------------
+    @property
+    def is_partitioned(self) -> bool:
+        return self.partition_by is not None
+
+    def storage_tables(self) -> list[str]:
+        """Storage-level table names holding this table's rows."""
+        if not self.is_partitioned:
+            return [self.name]
+        return [p.storage_name(self.name) for p in self.partitions]
+
+    def partition(self, name: str) -> Partition:
+        for p in self.partitions:
+            if p.name == name:
+                return p
+        raise KeyError(f"partition {name} of {self.name}")
+
+    def route_rows(self, values, valid) -> "list":
+        """Partition index per row (host-side, at write time). -1 = no
+        partition accepts the row (an error unless a DEFAULT exists —
+        handled by the caller). NULL partition keys route to the DEFAULT
+        partition, like the reference's default-part catch-all."""
+        import numpy as np
+
+        kind, _col = self.partition_by
+        v = np.asarray(values)
+        out = np.full(len(v), -1, dtype=np.int64)
+        default_i = next((i for i, p in enumerate(self.partitions)
+                          if p.default), None)
+        for i, p in enumerate(self.partitions):
+            if p.default:
+                continue
+            if kind == "range":
+                m = np.ones(len(v), bool)
+                if p.lo is not None:
+                    m &= v >= p.lo
+                if p.hi is not None:
+                    m &= v < p.hi
+            else:
+                m = np.isin(v, np.asarray(list(p.values), dtype=v.dtype))
+            out = np.where((out == -1) & m, i, out)
+        if valid is not None:
+            out = np.where(np.asarray(valid, bool), out, -1)
+        if default_i is not None:
+            out = np.where(out == -1, default_i, out)
+        return out
+
+    def prune_partitions(self, conjuncts: list[tuple]) -> list[int]:
+        """Static partition pruning: indices of partitions that can hold
+        rows satisfying the pushed conjuncts [(col, op, value)] — the
+        plan-time half of the PartitionSelector role
+        (src/backend/executor/nodePartitionSelector.c)."""
+        kind, col = self.partition_by
+        keep = []
+        for i, p in enumerate(self.partitions):
+            if p.default:
+                keep.append(i)   # catch-all: never statically prunable
+                continue
+            ok = True
+            for c, op, val in conjuncts:
+                if c != col:
+                    continue
+                if kind == "range":
+                    # partition holds x in [lo, hi); prune when NO such x
+                    # can satisfy the conjunct (int bounds tighten by 1)
+                    lo, hi = p.lo, p.hi
+                    is_int = isinstance(val, int)
+                    if op == "=" and ((lo is not None and val < lo)
+                                      or (hi is not None and val >= hi)):
+                        ok = False
+                    elif op == "<" and lo is not None and lo >= val:
+                        ok = False
+                    elif op == "<=" and lo is not None and lo > val:
+                        ok = False
+                    elif op == ">" and hi is not None and (
+                            hi <= val or (is_int and hi <= val + 1)):
+                        ok = False
+                    elif op == ">=" and hi is not None and hi <= val:
+                        ok = False
+                else:
+                    vals = p.values
+                    if op == "=" and val not in vals:
+                        ok = False
+                    elif op == "<" and all(x >= val for x in vals):
+                        ok = False
+                    elif op == "<=" and all(x > val for x in vals):
+                        ok = False
+                    elif op == ">" and all(x <= val for x in vals):
+                        ok = False
+                    elif op == ">=" and all(x < val for x in vals):
+                        ok = False
+                if not ok:
+                    break
+            if ok:
+                keep.append(i)
+        return keep
 
     @property
     def column_names(self) -> list[str]:
@@ -98,6 +238,9 @@ class TableSchema:
             },
             "options": self.options,
             **({"stats": self.stats.to_dict()} if self.stats is not None else {}),
+            **({"partition_by": list(self.partition_by),
+                "partitions": [p.to_dict() for p in self.partitions]}
+               if self.partition_by is not None else {}),
         }
 
     @staticmethod
@@ -110,6 +253,10 @@ class TableSchema:
         p = d["policy"]
         policy = DistPolicy(PolicyKind(p["kind"]), tuple(p.get("keys", ())), p.get("numsegments", 0))
         schema = TableSchema(d["name"], cols, policy, d.get("options", {}))
+        if "partition_by" in d:
+            schema.partition_by = tuple(d["partition_by"])
+            schema.partitions = [Partition.from_dict(p)
+                                 for p in d.get("partitions", [])]
         if "stats" in d:
             from greengage_tpu.planner.stats import TableStats
 

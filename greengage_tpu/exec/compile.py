@@ -91,6 +91,7 @@ class Compiler:
         self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
         self.scan_count: dict[str, int] = {}
         self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
+        self.scan_parts: dict[str, tuple | None] = {}  # table -> child tables
         self.instrument = instrument      # EXPLAIN ANALYZE per-node rows
         self.node_rows: dict[str, int] = {}   # metric name -> plan node id
         # multi-host: outputs/flags/metrics are device-reduced + replicated
@@ -125,7 +126,7 @@ class Compiler:
                 # no (consistent) direct pin: the staged capacity must cover
                 # EVERY segment, not just the pinned ones two conflicting
                 # point-scans named (their caps were merged into scan_caps)
-                counts = self._seg_counts(t)
+                counts = self._seg_counts(t, self.scan_parts.get(t))
                 self.scan_caps[t] = max(self.scan_caps[t],
                                         max(counts, default=0), 1)
             cols = []
@@ -149,7 +150,8 @@ class Compiler:
                        for col in schema_t.columns if col.name in self.scan_cols[t]):
                     prune = None
             input_spec.append((t, cols, self.scan_caps[t],
-                               self.scan_direct.get(t), prune))
+                               self.scan_direct.get(t), prune,
+                               self.scan_parts.get(t)))
 
         compiled = self._compile_node(below)   # closure: ctx -> Batch
         out_cols = below.out_cols()
@@ -164,7 +166,7 @@ class Compiler:
 
             ctx = {"tables": {}, "flags": []}
             i = 0
-            for tname, cols, cap, _direct, _prune in input_spec:
+            for tname, cols, cap, _direct, _prune, _parts in input_spec:
                 entry = {}
                 for c in cols:
                     entry[c] = flat[i]
@@ -208,7 +210,7 @@ class Compiler:
             jax.shard_map(
                 seg_fn,
                 mesh=self.mesh,
-                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, _, _, _ in input_spec))),
+                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, *_ in input_spec))),
                 out_specs=out_specs,
                 check_vma=False,
             )
@@ -258,9 +260,16 @@ class Compiler:
     # ------------------------------------------------------------------
     # capacities
     # ------------------------------------------------------------------
-    def _seg_counts(self, table: str) -> list[int]:
-        """Per-segment row counts, clamped by any spill chunk override."""
-        counts = self.store.segment_rowcounts(table)
+    def _seg_counts(self, table: str, parts: tuple | None = None) -> list[int]:
+        """Per-segment row counts, clamped by any spill chunk override.
+        A partitioned scan sums its (pruned) child tables — pruning
+        therefore shrinks the staged capacity, not just the IO."""
+        if parts is not None:
+            per = [self.store.segment_rowcounts(p) for p in parts]
+            counts = [sum(c[s] for c in per)
+                      for s in range(self.nseg)] if per else [0] * self.nseg
+        else:
+            counts = self.store.segment_rowcounts(table)
         cap = self.scan_cap_override.get(table)
         if cap is not None:
             counts = [min(c, cap) for c in counts]
@@ -282,7 +291,7 @@ class Compiler:
                 for c in plan.children:
                     self._collect_scans(c)
                 return
-            counts = self._seg_counts(plan.table)
+            counts = self._seg_counts(plan.table, plan.parts)
             ds = plan.direct_seg
             if ds is not None and 0 <= ds < len(counts):
                 cap = max(counts[ds], 1)
@@ -295,6 +304,14 @@ class Compiler:
             self.scan_direct[plan.table] = ds if prev in ("unset", ds) else None
             self.scan_count[plan.table] = self.scan_count.get(plan.table, 0) + 1
             self.scan_prune[plan.table] = tuple(plan.prune_preds or ())
+            # two scans of one parent stage the UNION of their live parts
+            if plan.parts is not None:
+                prev_parts = self.scan_parts.get(plan.table)
+                merged = (tuple(dict.fromkeys((prev_parts or ()) + plan.parts))
+                          if prev_parts is not None else plan.parts)
+                self.scan_parts[plan.table] = merged
+            else:
+                self.scan_parts.setdefault(plan.table, None)
         for c in plan.children:
             self._collect_scans(c)
 
@@ -303,7 +320,8 @@ class Compiler:
         if isinstance(plan, Scan):
             if plan.table in self.scan_caps:
                 return self.scan_caps[plan.table]
-            return max(max(self._seg_counts(plan.table), default=0), 1)
+            return max(max(self._seg_counts(plan.table, plan.parts),
+                           default=0), 1)
         if isinstance(plan, (Filter, Project, Sort, Window)):
             return self._capacity_of(plan.child)
         if isinstance(plan, Limit):

@@ -243,9 +243,11 @@ class Executor:
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
                     "segments": self.nseg,
-                    "scan_tables": [t for t, _, _, _, _ in comp.input_spec],
-                    "direct_dispatch": {t: d for t, _, _, d, _ in comp.input_spec
+                    "scan_tables": [t for t, *_ in comp.input_spec],
+                    "direct_dispatch": {t: d for t, _, _, d, *_ in comp.input_spec
                                         if d is not None},
+                    "partitions": {t: len(p) for t, _, _, _, _, p
+                                   in comp.input_spec if p is not None},
                     "zone_prune": dict(getattr(self, "_last_prune_stats", {})),
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
@@ -314,12 +316,12 @@ class Executor:
         self._last_prune_stats = {}
         aux = getattr(self, "_aux_tables", {})
         ranges = getattr(self, "_row_ranges", {})
-        for table, cols, cap, direct, prune in comp.input_spec:
+        for table, cols, cap, direct, prune, child_parts in comp.input_spec:
             if table in aux:
                 arrays.extend(self._stage_aux(table, cols, cap, aux[table], shard))
                 continue
             key = (table, tuple(cols), cap, version, direct, prune,
-                   ranges.get(table))
+                   child_parts, ranges.get(table))
             if table not in ranges and key in self._stage_cache:
                 staged, pstats = self._stage_cache[key]
                 arrays.extend(staged)
@@ -336,8 +338,8 @@ class Executor:
                     per_seg.append(({c: np.empty(0, dtype=np.int64)
                                      for c in storage_cols}, {}, 0))
                     continue
-                c, v, n = self.store.read_segment(
-                    table, seg, storage_cols, snapshot, prune=prune)
+                c, v, n = self._read_segment_parts(
+                    table, child_parts, seg, storage_cols, snapshot, prune)
                 if table in ranges:
                     a, b = ranges[table]
                     c = {k: arr[a:b] for k, arr in c.items()}
@@ -386,6 +388,41 @@ class Executor:
                     staged, self._last_prune_stats.get(table))
             arrays.extend(staged)
         return arrays
+
+    def _read_segment_parts(self, table, child_parts, seg, storage_cols,
+                            snapshot, prune):
+        """Read one segment's rows — for a partitioned scan, the (pruned)
+        child tables' rows concatenated in partition order. Zone-map
+        pruning applies per child; block stats sum across children."""
+        if child_parts is None:
+            return self.store.read_segment(table, seg, storage_cols,
+                                           snapshot, prune=prune)
+        per = []
+        kept = total = 0
+        any_prune = False
+        for child in child_parts:
+            c, v, n = self.store.read_segment(child, seg, storage_cols,
+                                              snapshot, prune=prune)
+            per.append((c, v, n))
+            st = self.store.last_prune
+            if st is not None:
+                any_prune = True
+                kept += st[0]
+                total += st[1]
+        self.store.last_prune = (kept, total) if any_prune else None
+        cols_out: dict = {}
+        valids_out: dict = {}
+        ntot = sum(n for _, _, n in per)
+        for col in storage_cols:
+            arrs = [c[col] for c, _, _ in per]
+            cols_out[col] = (np.concatenate(arrs) if arrs
+                             else np.empty(0, dtype=np.int64))
+            if any(v.get(col) is not None for _, v, _ in per):
+                valids_out[col] = np.concatenate([
+                    (v[col] if v.get(col) is not None
+                     else np.ones(n, dtype=bool))
+                    for _, v, n in per])
+        return cols_out, valids_out, ntot
 
     def _stage_aux(self, table, cols, cap, data, shard):
         """Stage an ephemeral host table ('@spill:' partial rows): rows

@@ -15,7 +15,8 @@ import numpy as np
 
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
-from greengage_tpu.catalog import Catalog, Column, DistPolicy, PolicyKind, TableSchema
+from greengage_tpu.catalog import (Catalog, Column, DistPolicy, Partition,
+                                   PolicyKind, TableSchema)
 from greengage_tpu.config import Settings
 from greengage_tpu.exec.executor import Executor, QueryError, Result
 from greengage_tpu.parallel import make_mesh
@@ -275,17 +276,24 @@ class Database:
     def _execute_write(self, stmt):
         if isinstance(stmt, A.CreateTableStmt):
             return self._create_table(stmt)
+        if isinstance(stmt, A.AlterTableStmt):
+            return self._alter_table(stmt)
         if isinstance(stmt, A.DropTableStmt):
             existed = stmt.name in self.catalog
+            schema0 = self.catalog.get(stmt.name) if existed else None
             self.catalog.drop_table(stmt.name, stmt.if_exists)
             if existed:
+                # all storage tables backing this relation (partitions are
+                # child storage tables named <parent>#<part>)
+                storage = schema0.storage_tables()
                 # invalidate open cursors that scanned this table: their
                 # deferred shards may still dereference the table's files
                 # (raw TEXT blobs, dictionaries) at RETRIEVE time
                 for cname, batch in list(self._cursors.items()):
                     spec = getattr(getattr(batch, "comp", None),
                                    "input_spec", ())
-                    if any(t == stmt.name for t, *_ in spec):
+                    if any(t == stmt.name or t in storage
+                           for t, *_ in spec):
                         self._cursors[cname] = (
                             f'cursor "{cname}" was invalidated by DROP '
                             f'TABLE {stmt.name}')
@@ -293,14 +301,19 @@ class Database:
                 # drop storage too: manifest commit removes the table's
                 # segfiles from visibility; data dir cleanup is best-effort
                 tx = self.store.manifest.begin()
-                if stmt.name in tx["tables"]:
-                    del tx["tables"][stmt.name]
+                touched = False
+                for st in storage:
+                    if st in tx["tables"]:
+                        del tx["tables"][st]
+                        touched = True
+                if touched:
                     self.store.manifest.commit_tx(tx)
                 self.store._invalidate_dicts(stmt.name)
                 import shutil
 
-                shutil.rmtree(os.path.join(self.path, "data", stmt.name),
-                              ignore_errors=True)
+                for st in storage:
+                    shutil.rmtree(os.path.join(self.path, "data", st),
+                                  ignore_errors=True)
             return "DROP TABLE"
         if isinstance(stmt, A.InsertStmt):
             out = self._insert(stmt)
@@ -661,9 +674,153 @@ class Database:
         options = dict(stmt.options)
         options.setdefault("compresstype", self.settings.default_compresstype)
         options.setdefault("compresslevel", self.settings.default_compresslevel)
-        self.catalog.create_table(TableSchema(stmt.name, cols, policy, options),
-                                  stmt.if_not_exists)
+        schema = TableSchema(stmt.name, cols, policy, options)
+        if stmt.partition_kind is not None:
+            if stmt.partition_col not in [c.name for c in cols]:
+                raise SqlError(
+                    f"partition column {stmt.partition_col} is not a column")
+            pcol = schema.column(stmt.partition_col)
+            if pcol.type.kind is T.Kind.TEXT:
+                raise SqlError("TEXT partition keys are not supported")
+            if policy.kind is PolicyKind.REPLICATED:
+                # GP parity: replicated tables cannot be partitioned
+                raise SqlError("DISTRIBUTED REPLICATED tables cannot be "
+                               "partitioned")
+            schema.partition_by = (stmt.partition_kind, stmt.partition_col)
+            parts: list[Partition] = []
+            for pd in stmt.partition_defs:
+                parts.extend(self._build_partitions(pd, pcol,
+                                                    stmt.partition_kind))
+            self._validate_partitions(parts, stmt.partition_kind, stmt.name)
+            schema.partitions = parts
+        self.catalog.create_table(schema, stmt.if_not_exists)
         return "CREATE TABLE"
+
+    def _part_literal(self, node, col):
+        """Coerce a partition-bound literal into the column's storage
+        representation (dates = epoch days, decimals = scaled ints).
+        NULL bounds are meaningless (NULL keys route to the DEFAULT
+        partition) and rejected."""
+        binder = Binder(self.catalog, self.store)
+        lit = binder._expr(node, _EmptyScope())
+        if not isinstance(lit, E.Literal):
+            raise SqlError("partition bounds must be literals")
+        lit = binder._coerce_literal(lit, col.type)
+        if lit.value is None:
+            raise SqlError("partition bounds/values cannot be NULL")
+        return lit.value
+
+    def _build_partitions(self, pd, pcol, kind) -> list[Partition]:
+        if pd.default:
+            return [Partition(pd.name, default=True)]
+        if kind == "list":
+            if not pd.values:
+                raise SqlError(
+                    f"partition {pd.name}: LIST partitions need VALUES")
+            if pd.lo is not None or pd.hi is not None or pd.every is not None:
+                raise SqlError(
+                    f"partition {pd.name}: START/END/EVERY are RANGE syntax")
+            vals = tuple(self._part_literal(v, pcol) for v in pd.values)
+            return [Partition(pd.name, values=vals)]
+        if pd.values:
+            raise SqlError(
+                f"partition {pd.name}: VALUES is LIST syntax; this table "
+                "is partitioned BY RANGE")
+        lo = self._part_literal(pd.lo, pcol) if pd.lo is not None else None
+        hi = self._part_literal(pd.hi, pcol) if pd.hi is not None else None
+        if pd.every is None:
+            return [Partition(pd.name, lo=lo, hi=hi)]
+        if lo is None or hi is None:
+            raise SqlError("EVERY requires both START and END")
+        # the step is a DELTA in the column's storage units (days for
+        # DATE, scaled units for DECIMAL), not a value of the column type
+        binder = Binder(self.catalog, self.store)
+        step_lit = binder._expr(pd.every, _EmptyScope())
+        if not isinstance(step_lit, E.Literal) \
+                or not isinstance(step_lit.value, (int, float)):
+            raise SqlError("EVERY step must be a numeric literal "
+                           "(storage units: days for DATE)")
+        step = int(step_lit.value) if isinstance(lo, int) else step_lit.value
+        if not step or step <= 0:
+            raise SqlError("EVERY step must be positive")
+        out, k, cur = [], 1, lo
+        while cur < hi:
+            nxt = min(cur + step, hi)
+            out.append(Partition(f"{pd.name}_{k}", lo=cur, hi=nxt))
+            cur, k = nxt, k + 1
+        return out
+
+    @staticmethod
+    def _validate_partitions(parts, kind, table) -> None:
+        names = [p.name for p in parts]
+        if len(set(names)) != len(names):
+            raise SqlError(f"duplicate partition name in {table}")
+        if sum(1 for p in parts if p.default) > 1:
+            raise SqlError("multiple DEFAULT partitions")
+        real = [p for p in parts if not p.default]
+        if kind == "range":
+            bounded = sorted(
+                (p for p in real),
+                key=lambda p: (p.lo is not None, p.lo))
+            for a, b in zip(bounded, bounded[1:]):
+                a_hi = a.hi
+                b_lo = b.lo
+                if a_hi is None or b_lo is None or b_lo < a_hi:
+                    raise SqlError(
+                        f"overlapping range partitions {a.name}/{b.name}")
+        else:
+            seen: set = set()
+            for p in real:
+                for v in p.values:
+                    if v in seen:
+                        raise SqlError(
+                            f"value {v!r} in multiple list partitions")
+                    seen.add(v)
+        if not parts:
+            raise SqlError("partitioned table needs at least one partition")
+
+    def _alter_table(self, stmt: A.AlterTableStmt) -> str:
+        """ALTER TABLE ... ADD/DROP PARTITION (reference: cdbpartition.c
+        partition maintenance). DROP is O(1): unlink the child storage
+        table; no other partition moves."""
+        schema = self.catalog.get(stmt.table)
+        if not schema.is_partitioned:
+            raise SqlError(f'table "{stmt.table}" is not partitioned')
+        kind, pcol_name = schema.partition_by
+        if stmt.action == "add_partition":
+            pcol = schema.column(pcol_name)
+            new = self._build_partitions(stmt.partition, pcol, kind)
+            self._validate_partitions(schema.partitions + new, kind,
+                                      stmt.table)
+            schema.partitions.extend(new)
+            self.catalog._save()
+            self._select_cache.clear()
+            return "ALTER TABLE"
+        # drop_partition
+        part = schema.partition(stmt.partition_name)   # KeyError -> msg
+        child = part.storage_name(stmt.table)
+        if len(schema.partitions) == 1:
+            raise SqlError("cannot drop the last partition; DROP TABLE")
+        schema.partitions = [p for p in schema.partitions
+                             if p.name != part.name]
+        self.catalog._save()
+        for cname, batch in list(self._cursors.items()):
+            spec = getattr(getattr(batch, "comp", None), "input_spec", ())
+            if any(t == stmt.table for t, *_ in spec):
+                self._cursors[cname] = (
+                    f'cursor "{cname}" was invalidated by DROP PARTITION '
+                    f'on {stmt.table}')
+        tx = self.store.manifest.begin()
+        if child in tx["tables"]:
+            del tx["tables"][child]
+            self.store.manifest.commit_tx(tx)
+        import shutil
+
+        shutil.rmtree(os.path.join(self.path, "data", child),
+                      ignore_errors=True)
+        self._select_cache.clear()
+        self._post_commit()
+        return "ALTER TABLE"
 
     def _insert(self, stmt: A.InsertStmt):
         schema = self.catalog.get(stmt.table)
@@ -706,11 +863,77 @@ class Database:
     def _write_rows(self, table: str, columns, valids) -> int:
         """All write paths (INSERT/COPY/load_table) stage into the open
         transaction if one is active; published at COMMIT. (Reads inside the
-        tx still see the committed snapshot only.)"""
+        tx still see the committed snapshot only.) Partitioned tables route
+        rows to their partitions' child storage tables here."""
+        schema = self.catalog.get(table)
+        if schema.is_partitioned and "#" not in table:
+            return self._write_routed(schema, columns, valids or {})
         tx = self.dtm.current
         if tx is not None and tx.state == "active":
             return tx.insert(table, columns, valids)
         return self.store.insert(table, columns, valids)
+
+    def _write_routed(self, schema, columns, valids) -> int:
+        """Split a row batch by partition and write each slice into its
+        child storage table (one manifest commit when inside a tx; one per
+        child otherwise — each child insert is atomic either way)."""
+        # whole-batch validation BEFORE any child stages: a later child's
+        # constraint failure must not leave earlier slices in the user's tx
+        for c in schema.columns:
+            v = valids.get(c.name)
+            if not c.nullable and v is not None and not np.all(v):
+                raise SqlError(
+                    f'null value in column "{c.name}" violates not-null '
+                    "constraint")
+        kind, pcol = schema.partition_by
+        col = schema.column(pcol)
+        raw = columns[pcol]
+        if col.type.kind is T.Kind.DATE and not isinstance(raw, np.ndarray):
+            vals = np.array([T.date_to_days(v) for v in raw], dtype=np.int32)
+        elif col.type.kind is T.Kind.DECIMAL and not isinstance(raw, np.ndarray):
+            vals = np.array([T.decimal_to_int(v, col.type.scale) for v in raw],
+                            dtype=np.int64)
+        else:
+            vals = np.asarray(raw, dtype=col.type.np_dtype)
+        pidx = np.asarray(schema.route_rows(vals, valids.get(pcol)))
+        if (pidx < 0).any():
+            bad = vals[pidx < 0][0]
+            raise SqlError(
+                f"no partition of {schema.name} accepts value {bad!r} "
+                "(and there is no DEFAULT partition)")
+
+        def _slice(v, m):
+            if isinstance(v, T.Coded):
+                return T.Coded(v.vocab, v.codes[m])
+            if isinstance(v, np.ndarray):
+                return v[m]
+            return np.asarray(v, dtype=object)[m]
+
+        # inside a transaction all children stage into ONE manifest tx
+        # (atomic multi-partition insert); autocommit writes each child
+        # with its own commit, like per-partition appendonly segfiles
+        own_tx = None
+        tx = self.dtm.current
+        if tx is None or tx.state != "active":
+            own_tx = self.dtm.begin()
+            tx = own_tx
+        total = 0
+        try:
+            for i, p in enumerate(schema.partitions):
+                m = pidx == i
+                if not m.any():
+                    continue
+                sub_c = {k: _slice(v, m) for k, v in columns.items()}
+                sub_v = {k: _slice(v, m) for k, v in valids.items()
+                         if v is not None}
+                total += tx.insert(p.storage_name(schema.name), sub_c, sub_v)
+            if own_tx is not None:
+                self.dtm.commit()
+        except Exception:
+            if own_tx is not None and self.dtm.current is own_tx:
+                self.dtm.abort()
+            raise
+        return total
 
     def load_table(self, table: str, columns: dict, valids: dict | None = None):
         """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
@@ -833,7 +1056,9 @@ class Database:
         tx = self.dtm.current
         if tx is None or tx.state != "active":
             return None
-        if table in tx.tables_written:
+        # partition children count as the parent (storage names "t#part")
+        written = {t.split("#", 1)[0] for t in tx.tables_written}
+        if table.split("#", 1)[0] in written:
             raise SqlError(
                 f"{what}: table was already modified in this transaction "
                 "(DML reads the committed snapshot; interleaved rewrite "
@@ -857,6 +1082,44 @@ class Database:
                 "DELETE/UPDATE require dictionary-encoded text for the "
                 "republish path (raw DML lands with the visimap analog)")
 
+    def _replace_table(self, schema, enc, valids, tx) -> None:
+        """Republish a table's full contents. Partitioned tables route the
+        surviving rows by partition key and replace EVERY child (a child
+        that receives no rows becomes empty) — UPDATEs may move rows
+        across partitions, unlike the reference's pre-7 restriction."""
+        if not schema.is_partitioned:
+            if tx is not None:
+                tx.replace(schema.name, enc, valids)
+            else:
+                self.store.replace_contents(schema.name, enc, valids)
+            return
+        _kind, pcol = schema.partition_by
+        pidx = np.asarray(schema.route_rows(enc[pcol], valids.get(pcol)))
+        if (pidx < 0).any():
+            bad = enc[pcol][pidx < 0][0]
+            raise SqlError(
+                f"no partition of {schema.name} accepts value {bad!r} "
+                "(and there is no DEFAULT partition)")
+        # atomic across children: autocommit wraps the multi-child rewrite
+        # in ONE manifest commit — a reader must never see a row twice (or
+        # zero times) while an UPDATE moves it between partitions
+        own = None
+        if tx is None:
+            own = self.dtm.begin()
+            tx = own
+        try:
+            for i, p in enumerate(schema.partitions):
+                m = pidx == i
+                sub_c = {k: v[m] for k, v in enc.items()}
+                sub_v = {k: v[m] for k, v in valids.items()}
+                tx.replace(p.storage_name(schema.name), sub_c, sub_v)
+            if own is not None:
+                self.dtm.commit()
+        except Exception:
+            if own is not None and self.dtm.current is own:
+                self.dtm.abort()
+            raise
+
     def _delete(self, stmt: A.DeleteStmt):
         self._check_no_raw_dml(stmt.table)
         tx = self._tx_for_dml(stmt.table, "DELETE")
@@ -866,10 +1129,7 @@ class Database:
         if stmt.where is None:
             empty = {c.name: np.empty(0, dtype=c.type.np_dtype)
                      for c in schema.columns}
-            if tx is not None:
-                tx.replace(stmt.table, empty, {})
-            else:
-                self.store.replace_contents(stmt.table, empty, {})
+            self._replace_table(schema, empty, {}, tx)
             return f"DELETE {total}"
         # survivors: predicate false OR NULL
         survive = A.Bin("or", A.Unary("not", stmt.where), A.IsNullTest(stmt.where, False))
@@ -883,10 +1143,7 @@ class Database:
             v = res.valids.get(o.id)
             if v is not None:
                 valids[c.name] = v
-        if tx is not None:
-            tx.replace(stmt.table, enc, valids)
-        else:
-            self.store.replace_contents(stmt.table, enc, valids)
+        self._replace_table(schema, enc, valids, tx)
         return f"DELETE {total - len(res)}"
 
     def _update(self, stmt: A.UpdateStmt, worker_scan_only: bool = False):
@@ -972,10 +1229,7 @@ class Database:
             enc[c.name] = merged.astype(c.type.np_dtype)
             if not mergedv.all():
                 valids[c.name] = mergedv
-        if tx is not None:
-            tx.replace(stmt.table, enc, valids)
-        else:
-            self.store.replace_contents(stmt.table, enc, valids)
+        self._replace_table(schema, enc, valids, tx)
         return f"UPDATE {int(mask.sum())}"
 
     # ------------------------------------------------------------------
@@ -1007,7 +1261,15 @@ class Database:
         # phase 2: redistribute each table
         moved = {}
         for name in list(self.catalog.tables):
-            moved[name] = self.store.rewrite_table(name, new_numsegments)
+            schema = self.catalog.get(name)
+            if schema.is_partitioned:
+                # rewrite each child; the shared policy width flips once
+                # (all children reference the parent's DistPolicy)
+                moved[name] = sum(
+                    self.store.rewrite_table(st, new_numsegments)
+                    for st in schema.storage_tables())
+            else:
+                moved[name] = self.store.rewrite_table(name, new_numsegments)
         if self.replicator is not None:
             from greengage_tpu.runtime.replication import Replicator
 

@@ -291,25 +291,29 @@ def cmd_checkcat(args):
         if t not in db.catalog:
             problems.append(f"manifest table {t} missing from catalog")
     for name, schema in db.catalog.tables.items():
-        tmeta = snap["tables"].get(name)
-        if tmeta is None:
-            continue
-        for seg, files in tmeta["segfiles"].items():
-            if int(seg) >= schema.policy.numsegments:
-                problems.append(f"{name}: segfiles on seg {seg} beyond width")
-            for rel in files:
-                # resolves through per-content roots (mirror failover aware)
-                p = db.store.seg_file_path(name, rel)
-                if not os.path.exists(p):
-                    problems.append(f"{name}: missing file {rel}")
-        # row counts readable + placement verified per segment
-        try:
-            total = sum(db.store.segment_rowcounts(name))
-            declared = sum(int(v) for v in tmeta["nrows"].values())
-            if total != declared:
-                problems.append(f"{name}: rowcount mismatch {total} != {declared}")
-        except Exception as e:
-            problems.append(f"{name}: unreadable ({e})")
+        # partitioned parents audit through their child storage tables
+        for sname in schema.storage_tables():
+            tmeta = snap["tables"].get(sname)
+            if tmeta is None:
+                continue
+            for seg, files in tmeta["segfiles"].items():
+                if int(seg) >= schema.policy.numsegments:
+                    problems.append(
+                        f"{sname}: segfiles on seg {seg} beyond width")
+                for rel in files:
+                    # resolves through per-content roots (failover aware)
+                    p = db.store.seg_file_path(sname, rel)
+                    if not os.path.exists(p):
+                        problems.append(f"{sname}: missing file {rel}")
+            # row counts readable + placement verified per segment
+            try:
+                total = sum(db.store.segment_rowcounts(sname))
+                declared = sum(int(v) for v in tmeta["nrows"].values())
+                if total != declared:
+                    problems.append(
+                        f"{sname}: rowcount mismatch {total} != {declared}")
+            except Exception as e:
+                problems.append(f"{sname}: unreadable ({e})")
     if problems:
         for p in problems:
             print("PROBLEM:", p)

@@ -63,6 +63,11 @@ class Scan(Plan):
     # conjuncts [(storage col, op, value)] let staging skip blocks whose
     # [min, max] cannot satisfy them
     prune_preds: tuple = ()
+    # partitioned parent: child storage tables to stage (the full set from
+    # the binder, statically pruned by the planner); None = unpartitioned.
+    # parts_total remembers the pre-pruning count for EXPLAIN.
+    parts: tuple | None = None
+    parts_total: int = 0
 
     def out_cols(self):
         return self.cols
@@ -210,6 +215,9 @@ def describe(plan: Plan, indent: int = 0, annot: dict | None = None) -> str:
     extra = ""
     if isinstance(plan, Scan):
         extra = f" {plan.table}"
+        if plan.parts is not None:
+            total = plan.parts_total or len(plan.parts)
+            extra += f" (partitions: {len(plan.parts)}/{total})"
         if plan.direct_seg is not None:
             extra += f" (direct dispatch: seg {plan.direct_seg})"
     elif isinstance(plan, Join):

@@ -127,6 +127,15 @@ class Planner:
                     prune.append((by_id[lhs.name], op, float(v)))
         if prune:
             child.prune_preds = tuple(prune)
+        if child.parts is not None and schema.is_partitioned:
+            # static partition pruning from the same pushed conjuncts
+            # (plan-time half of nodePartitionSelector.c)
+            child.parts_total = len(schema.partitions)
+            keep = schema.prune_partitions(
+                [(c, op, v) for c, op, v in prune])
+            name_keep = {schema.partitions[i].storage_name(child.table)
+                         for i in keep}
+            child.parts = tuple(p for p in child.parts if p in name_keep)
         if schema.policy.kind is PolicyKind.HASH \
                 and all(k in found for k in schema.policy.keys):
             child.direct_seg = self.store.segment_for_values(

@@ -124,14 +124,17 @@ def analyze_table(store, schema, snapshot=None) -> TableStats:
     per_col: dict[str, list] = {c.name: [] for c in schema.columns}
     per_col_valid: dict[str, list] = {c.name: [] for c in schema.columns}
     total = 0
-    for seg in range(nseg):
-        cols, valids, n = store.read_segment(schema.name, seg, None, snap)
-        total += n
-        for c in schema.columns:
-            per_col[c.name].append(cols[c.name])
-            v = valids.get(c.name)
-            per_col_valid[c.name].append(
-                v if v is not None else np.ones(n, dtype=bool))
+    # partitioned tables: stats aggregate over the child storage tables
+    # (one logical relation, like pg_statistic on the partition root)
+    for storage in schema.storage_tables():
+        for seg in range(nseg):
+            cols, valids, n = store.read_segment(storage, seg, None, snap)
+            total += n
+            for c in schema.columns:
+                per_col[c.name].append(cols[c.name])
+                v = valids.get(c.name)
+                per_col_valid[c.name].append(
+                    v if v is not None else np.ones(n, dtype=bool))
     from greengage_tpu.catalog.schema import PolicyKind
 
     if schema.policy.kind is PolicyKind.REPLICATED and nseg > 0:

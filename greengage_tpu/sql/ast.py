@@ -228,6 +228,16 @@ class ColumnDef(ANode):
 
 
 @dataclass
+class PartitionDef(ANode):
+    name: str
+    lo: ANode | None = None       # RANGE START literal (inclusive)
+    hi: ANode | None = None       # RANGE END literal (exclusive)
+    every: ANode | None = None    # RANGE EVERY step (expands to a series)
+    values: list = field(default_factory=list)   # LIST literals
+    default: bool = False
+
+
+@dataclass
 class CreateTableStmt(ANode):
     name: str
     columns: list[ColumnDef]
@@ -235,6 +245,17 @@ class CreateTableStmt(ANode):
     dist_keys: list[str] = field(default_factory=list)
     options: dict = field(default_factory=dict)
     if_not_exists: bool = False
+    partition_kind: str | None = None    # range | list
+    partition_col: str | None = None
+    partition_defs: list[PartitionDef] = field(default_factory=list)
+
+
+@dataclass
+class AlterTableStmt(ANode):
+    table: str
+    action: str                   # add_partition | drop_partition
+    partition: PartitionDef | None = None
+    partition_name: str | None = None
 
 
 @dataclass

@@ -798,6 +798,11 @@ class Binder:
                 cols[c.name] = ci
                 out.append(ci)
             scan = Scan(t.name, out)
+            if schema.is_partitioned:
+                # all child storage tables; the planner statically prunes
+                # this set from pushed conjuncts (PartitionSelector role)
+                scan.parts = tuple(schema.storage_tables())
+                scan.parts_total = len(schema.partitions)
             for ci in out:
                 self._scan_for[ci.id] = scan
             scope = Scope()

@@ -157,6 +157,8 @@ class Parser:
             return self.create_table()
         if self.at_kw("drop"):
             return self.drop_table()
+        if self.at_word("alter"):
+            return self.alter_table()
         if self.at_kw("insert"):
             return self.insert_stmt()
         if self.at_kw("copy"):
@@ -650,7 +652,70 @@ class Parser:
                 self.expect("op", ")")
         elif cols:
             dist_keys = [cols[0].name]  # GP default: first column
-        return A.CreateTableStmt(name, cols, dist_kind, dist_keys, options, ine)
+        pkind = pcol = None
+        pdefs: list[A.PartitionDef] = []
+        if self.accept("kw", "partition"):
+            # PARTITION BY RANGE (col) (PARTITION p START (x) END (y)
+            # [EVERY (n)], ..., DEFAULT PARTITION d) | PARTITION BY LIST
+            # (col) (PARTITION p VALUES (a, b), ...) — the GP 6 syntax
+            # subset (reference: src/backend/parser/gram.y partition rules)
+            self.expect("kw", "by")
+            if self.accept_word("range"):
+                pkind = "range"
+            else:
+                self.expect_word("list")
+                pkind = "list"
+            self.expect("op", "(")
+            pcol = self.expect("name")[1]
+            self.expect("op", ")")
+            self.expect("op", "(")
+            pdefs.append(self.partition_def(pkind))
+            while self.accept("op", ","):
+                pdefs.append(self.partition_def(pkind))
+            self.expect("op", ")")
+        return A.CreateTableStmt(name, cols, dist_kind, dist_keys, options,
+                                 ine, pkind, pcol, pdefs)
+
+    def partition_def(self, kind: str | None) -> A.PartitionDef:
+        if self.accept_word("default"):
+            self.expect("kw", "partition")
+            return A.PartitionDef(self.expect("name")[1], default=True)
+        self.expect("kw", "partition")
+        name = self.expect("name")[1]
+        if kind == "list" or (kind is None and self.at_kw("values")):
+            self.expect("kw", "values")
+            self.expect("op", "(")
+            vals = [self.expr()]
+            while self.accept("op", ","):
+                vals.append(self.expr())
+            self.expect("op", ")")
+            return A.PartitionDef(name, values=vals)
+        lo = hi = every = None
+        if self.accept_word("start"):
+            self.expect("op", "(")
+            lo = self.expr()
+            self.expect("op", ")")
+        if self.accept("kw", "end"):
+            self.expect("op", "(")
+            hi = self.expr()
+            self.expect("op", ")")
+        if self.accept_word("every"):
+            self.expect("op", "(")
+            every = self.expr()
+            self.expect("op", ")")
+        return A.PartitionDef(name, lo=lo, hi=hi, every=every)
+
+    def alter_table(self) -> A.AlterTableStmt:
+        self.expect_word("alter")
+        self.expect("kw", "table")
+        table = self.expect("name")[1]
+        if self.accept_word("add"):
+            return A.AlterTableStmt(table, "add_partition",
+                                    partition=self.partition_def(None))
+        self.expect("kw", "drop")
+        self.expect("kw", "partition")
+        return A.AlterTableStmt(table, "drop_partition",
+                                partition_name=self.expect("name")[1])
 
     def column_def(self) -> A.ColumnDef:
         name = self.expect("name")[1]

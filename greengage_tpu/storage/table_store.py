@@ -126,12 +126,16 @@ class TableStore:
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
+        # partition children share the PARENT's dictionary: one code space
+        # per logical table, so codes compare/join across partitions
+        table = table.split("#", 1)[0]
         key = (table, col)
         if key not in self._dicts:
             self._dicts[key] = Dictionary.load(self._dict_path(table, col))
         return self._dicts[key]
 
     def _dict_path(self, table: str, col: str) -> str:
+        table = table.split("#", 1)[0]
         return os.path.join(self.root, "data", table, f"dict_{col}.json")
 
     # ---- placement -----------------------------------------------------
@@ -259,8 +263,8 @@ class TableStore:
             seg_of = self._placement(schema, enc, valids, nrows, total_existing)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
 
-        records = self._write_segfiles(schema, tmeta, enc, valids, seg_rows,
-                                       fileno, raw_strs=raw_strs)
+        records = self._write_segfiles(schema, table, tmeta, enc, valids,
+                                       seg_rows, fileno, raw_strs=raw_strs)
 
         if own_tx:
             # Ordering: stage files -> prepare (version CAS = the write lock)
@@ -331,12 +335,14 @@ class TableStore:
 
     def flush_dicts(self, table: str) -> None:
         schema = self.catalog.get(table)
+        table = table.split("#", 1)[0]   # children share the parent dict
         for c in schema.columns:
             if c.type.kind is T.Kind.TEXT and (table, c.name) in self._dicts:
                 os.makedirs(os.path.join(self.root, "data", table), exist_ok=True)
                 self._dicts[(table, c.name)].save(self._dict_path(table, c.name))
 
     def _invalidate_dicts(self, table: str) -> None:
+        table = table.split("#", 1)[0]
         for key in [k for k in self._dicts if k[0] == table]:
             del self._dicts[key]
 
@@ -625,7 +631,8 @@ class TableStore:
         else:
             seg_of = (np.arange(nrows) % new_numsegments).astype(np.int32)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(new_numsegments)]
-        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
+        self._write_segfiles(schema, table, tmeta, enc, valids, seg_rows,
+                             uuid.uuid4().hex[:12])
         v = self.manifest.prepare(tx)
         self.manifest.commit(v)
         # catalog: table now spans the new width (manifest is authoritative
@@ -679,7 +686,8 @@ class TableStore:
         else:
             seg_of = (np.arange(nrows) % nseg).astype(np.int32)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
-        self._write_segfiles(schema, tmeta, enc, valids, seg_rows, uuid.uuid4().hex[:12])
+        self._write_segfiles(schema, table, tmeta, enc, valids, seg_rows,
+                             uuid.uuid4().hex[:12])
         return old_files
 
     GC_GRACE_S = 30.0   # snapshot readers finish well within this
@@ -787,8 +795,8 @@ class TableStore:
         if changed:
             self.catalog._save()
 
-    def _write_segfiles(self, schema, tmeta, enc, valids, seg_rows, fileno,
-                        raw_strs=None) -> list:
+    def _write_segfiles(self, schema, table, tmeta, enc, valids, seg_rows,
+                        fileno, raw_strs=None) -> list:
         """Write per-segment column files, record them in ``tmeta``, and
         return the records for optimistic-retry re-merge."""
         compresstype = schema.options.get("compresstype", "zlib")
@@ -798,7 +806,9 @@ class TableStore:
         for s, idx in enumerate(seg_rows):
             if len(idx) == 0:
                 continue
-            segdir = os.path.join(self.data_root(s), schema.name, f"seg{s}")
+            # the STORAGE table name, not schema.name: partition children
+            # ("t#part") share the parent's schema but own their directory
+            segdir = os.path.join(self.data_root(s), table, f"seg{s}")
             os.makedirs(segdir, exist_ok=True)
             files = tmeta["segfiles"].setdefault(str(s), [])
             files_before = len(files)
@@ -846,17 +856,27 @@ class TableStore:
         if col.startswith("@hp:"):
             col = col.split(":", 2)[1]   # predicate nullability = column's
         snap = snapshot or self.manifest.snapshot()
-        tmeta = snap["tables"].get(table, {"segfiles": {}})
+        schema = self.catalog.get(table) if table in self.catalog else None
+        names = (schema.storage_tables()
+                 if schema is not None and schema.name == table else [table])
         marker = f"{col}."
-        for files in tmeta["segfiles"].values():
-            for rel in files:
-                fn = os.path.basename(rel)
-                if fn.startswith(marker) and fn.endswith(".valid.ggb"):
-                    return True
+        for name in names:
+            tmeta = snap["tables"].get(name, {"segfiles": {}})
+            for files in tmeta["segfiles"].values():
+                for rel in files:
+                    fn = os.path.basename(rel)
+                    if fn.startswith(marker) and fn.endswith(".valid.ggb"):
+                        return True
         return False
 
     def segment_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
         schema = self.catalog.get(table)
         snap = snapshot or self.manifest.snapshot()
-        tmeta = snap["tables"].get(table, {"nrows": {}})
-        return [tmeta["nrows"].get(str(s), 0) for s in range(schema.policy.numsegments)]
+        names = (schema.storage_tables()
+                 if schema.name == table else [table])
+        out = [0] * schema.policy.numsegments
+        for name in names:
+            tmeta = snap["tables"].get(name, {"nrows": {}})
+            for s in range(schema.policy.numsegments):
+                out[s] += tmeta["nrows"].get(str(s), 0)
+        return out

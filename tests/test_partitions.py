@@ -1,0 +1,229 @@
+"""Partitioned tables + pruning (reference parity: cdbpartition.c range/
+list partitioning, nodePartitionSelector.c pruning roles). Each partition
+is its own child storage table; pruning is a plan-time staging decision
+that also shrinks the compiled program's scan capacity."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.sql.parser import SqlError
+
+
+@pytest.fixture()
+def db(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d.sql("""
+        create table sales (id int, day int, amount bigint, region text)
+        distributed by (id)
+        partition by range (day) (
+            partition q1 start (0) end (90),
+            partition q2 start (90) end (180),
+            partition q3 start (180) end (270),
+            default partition tail
+        )""")
+    d.sql("insert into sales values " + ",".join(
+        f"({i}, {i % 400}, {i * 3}, 'r{i % 3}')" for i in range(400)))
+    return d
+
+
+def test_rows_land_in_partitions(db):
+    # child storage tables hold disjoint day ranges
+    counts = {p.name: sum(db.store.segment_rowcounts(f"sales#{p.name}"))
+              for p in db.catalog.get("sales").partitions}
+    assert counts["q1"] == 90 and counts["q2"] == 90 and counts["q3"] == 90
+    assert counts["tail"] == 130          # days 270..399
+    assert sum(counts.values()) == 400
+
+
+def test_select_spans_partitions(db):
+    r = db.sql("select count(*), sum(amount) from sales")
+    assert r.rows() == [(400, sum(i * 3 for i in range(400)))]
+
+
+def test_static_pruning_matches_oracle_and_prunes(db):
+    r = db.sql("select count(*) from sales where day < 90")
+    assert r.rows() == [(90,)]
+    # EXPLAIN shows the pruned partition set (default partition never
+    # statically pruned)
+    txt = db.sql("explain select count(*) from sales where day < 90")
+    assert "partitions: 2/4" in str(txt)
+    r = db.sql("select count(*) from sales where day >= 90 and day < 180")
+    assert r.rows() == [(90,)]
+    txt = db.sql(
+        "explain select count(*) from sales where day >= 90 and day < 180")
+    assert "partitions: 2/4" in str(txt)
+    # point query
+    r = db.sql("select amount from sales where day = 5 order by amount")
+    assert [a for (a,) in r.rows()] == [15]
+
+
+def test_group_by_across_partitions(db):
+    r = db.sql("select region, count(*) from sales group by region "
+               "order by region")
+    assert r.rows() == [("r0", 134), ("r1", 133), ("r2", 133)]
+
+
+def test_join_partitioned_fact(db):
+    db.sql("create table dim (region text, label int) "
+           "distributed replicated")
+    db.sql("insert into dim values ('r0', 10), ('r1', 11), ('r2', 12)")
+    r = db.sql("select label, count(*) from sales join dim "
+               "on sales.region = dim.region group by label order by label")
+    assert r.rows() == [(10, 134), (11, 133), (12, 133)]
+
+
+def test_dml_routes_and_moves_rows(db):
+    db.sql("delete from sales where day >= 270")
+    assert db.sql("select count(*) from sales").rows() == [(270,)]
+    assert sum(db.store.segment_rowcounts("sales#tail")) == 0
+    # UPDATE that moves a row across partitions (day 10 -> 100)
+    db.sql("update sales set day = 100 where id = 10")
+    assert sum(db.store.segment_rowcounts("sales#q1")) == 89
+    assert sum(db.store.segment_rowcounts("sales#q2")) == 91
+    r = db.sql("select day from sales where id = 10")
+    assert r.rows() == [(100,)]
+
+
+def test_no_partition_accepts_errors_without_default(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c2"), numsegments=2)
+    d.sql("create table t (k int, v int) distributed by (k) "
+          "partition by range (v) (partition a start (0) end (10))")
+    with pytest.raises(SqlError, match="no partition"):
+        d.sql("insert into t values (1, 99)")
+    d.sql("insert into t values (1, 5)")
+    assert d.sql("select count(*) from t").rows() == [(1,)]
+
+
+def test_list_partitions(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c3"), numsegments=2)
+    d.sql("""create table ev (k int, typ int) distributed by (k)
+             partition by list (typ) (
+               partition small values (1, 2),
+               partition big values (3),
+               default partition other)""")
+    d.sql("insert into ev values (1,1),(2,2),(3,3),(4,7)")
+    assert sum(d.store.segment_rowcounts("ev#small")) == 2
+    assert sum(d.store.segment_rowcounts("ev#big")) == 1
+    assert sum(d.store.segment_rowcounts("ev#other")) == 1
+    assert d.sql("select count(*) from ev where typ = 3").rows() == [(1,)]
+    txt = d.sql("explain select count(*) from ev where typ = 3")
+    assert "partitions: 2/3" in str(txt)   # big + default
+
+
+def test_every_expansion(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c4"), numsegments=2)
+    d.sql("create table m (k int, d int) distributed by (k) partition by "
+          "range (d) (partition p start (0) end (30) every (10))")
+    names = [p.name for p in d.catalog.get("m").partitions]
+    assert names == ["p_1", "p_2", "p_3"]
+    d.sql("insert into m values (1, 5), (2, 15), (3, 25)")
+    assert sum(d.store.segment_rowcounts("m#p_2")) == 1
+
+
+def test_add_drop_partition(db):
+    db.sql("alter table sales drop partition tail")
+    assert db.sql("select count(*) from sales").rows() == [(270,)]
+    db.sql("alter table sales add partition q4 start (270) end (360)")
+    db.sql("insert into sales values (9000, 300, 1, 'r0')")
+    assert sum(db.store.segment_rowcounts("sales#q4")) == 1
+    # dropped storage is gone from the manifest
+    snap = db.store.manifest.snapshot()
+    assert "sales#tail" not in snap["tables"]
+    with pytest.raises(SqlError, match="no partition"):
+        db.sql("insert into sales values (9001, 900, 1, 'r0')")
+
+
+def test_overlap_and_duplicate_validation(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c5"), numsegments=2)
+    with pytest.raises(SqlError, match="overlapping"):
+        d.sql("create table x (k int, v int) distributed by (k) "
+              "partition by range (v) (partition a start (0) end (10), "
+              "partition b start (5) end (20))")
+    with pytest.raises(SqlError, match="multiple list"):
+        d.sql("create table y (k int, v int) distributed by (k) "
+              "partition by list (v) (partition a values (1), "
+              "partition b values (1, 2))")
+
+
+def test_analyze_and_stats_span_partitions(db):
+    db.sql("analyze sales")
+    st = db.catalog.get("sales").stats
+    assert st.rows == 400
+    assert st.columns["day"].min == 0 and st.columns["day"].max == 399
+
+
+def test_transactional_multi_partition_insert(db):
+    db.sql("begin")
+    db.sql("insert into sales values (9100, 10, 1, 'r0'), "
+           "(9101, 100, 1, 'r1'), (9102, 500, 1, 'r2')")
+    db.sql("rollback")
+    assert db.sql("select count(*) from sales").rows() == [(400,)]
+    db.sql("begin")
+    db.sql("insert into sales values (9100, 10, 1, 'r0'), "
+           "(9101, 100, 1, 'r1')")
+    db.sql("commit")
+    assert db.sql("select count(*) from sales").rows() == [(402,)]
+
+
+def test_drop_table_drops_children(db):
+    db.sql("drop table sales")
+    snap = db.store.manifest.snapshot()
+    assert not any(t.startswith("sales#") for t in snap["tables"])
+    with pytest.raises(ValueError, match="does not exist"):
+        db.sql("select * from sales")
+
+
+def test_expand_partitioned(db):
+    before = db.sql("select sum(amount) from sales").rows()
+    db.expand(8)
+    assert db.sql("select sum(amount) from sales").rows() == before
+    counts = db.store.segment_rowcounts("sales#q1")
+    assert len(counts) == 8 and sum(counts) == 90
+
+
+def test_every_with_dates(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c6"), numsegments=2)
+    d.sql("create table dt (k int, dd date) distributed by (k) partition by "
+          "range (dd) (partition m start (date '2024-01-01') "
+          "end (date '2024-03-01') every (31))")
+    assert len(d.catalog.get("dt").partitions) == 2   # 60 days / 31
+    d.sql("insert into dt values (1, date '2024-02-15')")
+    assert sum(d.store.segment_rowcounts("dt#m_2")) == 1
+
+
+def test_partition_def_shape_validation(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c7"), numsegments=2)
+    d.sql("create table lt (k int, v int) distributed by (k) partition by "
+          "list (v) (partition a values (1))")
+    with pytest.raises(SqlError, match="VALUES"):
+        d.sql("alter table lt add partition b")   # range-shaped def on LIST
+    d.sql("create table rt (k int, v int) distributed by (k) partition by "
+          "range (v) (partition a start (0) end (10))")
+    with pytest.raises(SqlError, match="LIST syntax"):
+        d.sql("alter table rt add partition b values (5)")
+    with pytest.raises(SqlError, match="NULL"):
+        d.sql("create table nt (k int, v int) distributed by (k) partition "
+              "by list (v) (partition a values (null))")
+
+
+def test_failed_routed_insert_stages_nothing_in_tx(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c8"), numsegments=2)
+    d.sql("create table st (k int, v int not null) distributed by (k) "
+          "partition by range (v) (partition a start (0) end (10), "
+          "partition b start (10) end (20))")
+    d.sql("begin")
+    with pytest.raises(SqlError, match="not-null"):
+        # valid row routes to a; NULL row would route later — nothing may
+        # stage before the whole batch validates
+        d.sql("insert into st values (1, 5), (2, null)")
+    d.sql("commit")
+    assert d.sql("select count(*) from st").rows() == [(0,)]
+
+
+def test_checkcat_clean(db, tmp_path, capsys):
+    from greengage_tpu.mgmt import cli
+
+    rc = cli.main(["checkcat", "-d", str(tmp_path / "c")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "consistent" in out
