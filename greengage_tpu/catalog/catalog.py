@@ -22,6 +22,7 @@ class Catalog:
                  mirrors: bool = False):
         self.tables: dict[str, TableSchema] = {}
         self.extensions: list[str] = []   # CREATE EXTENSION survivors
+        self.resource_groups: list[dict] = []   # resgroup definitions
         self.segments = SegmentConfig.create(numsegments, with_mirrors=mirrors)
         self.path = path  # cluster dir; None = in-memory only
 
@@ -80,6 +81,7 @@ class Catalog:
             "segments": self.segments.to_dict(),
             "tables": {n: t.to_dict() for n, t in self.tables.items()},
             "extensions": self.extensions,
+            "resource_groups": self.resource_groups,
         }
         os.makedirs(self.path, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=self.path, prefix=".catalog")
@@ -99,4 +101,5 @@ class Catalog:
         for n, t in data["tables"].items():
             cat.tables[n] = TableSchema.from_dict(t)
         cat.extensions = list(data.get("extensions", ()))
+        cat.resource_groups = list(data.get("resource_groups", ()))
         return cat

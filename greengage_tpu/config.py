@@ -41,6 +41,10 @@ class Settings:
     resource_queue_active: int = 0
     resource_queue_memory_mb: int = 0
     resource_queue_timeout_s: float = 30.0
+    # resource groups: cluster-wide cap on concurrent mesh statements;
+    # when it binds, the backoff scheduler picks the next group by
+    # weighted consumed chip time (runtime/resgroup.py)
+    resource_group_global_active: int = 0
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1

@@ -265,7 +265,10 @@ class Compiler:
         A partitioned scan sums its (pruned) child tables — pruning
         therefore shrinks the staged capacity, not just the IO."""
         if parts is not None:
-            per = [self.store.segment_rowcounts(p) for p in parts]
+            # one manifest snapshot for all children (it is a full-file
+            # JSON parse; per-child reads would be O(parts) disk parses)
+            snap = self.store.manifest.snapshot()
+            per = [self.store.segment_rowcounts(p, snap) for p in parts]
             counts = [sum(c[s] for c in per)
                       for s in range(self.nseg)] if per else [0] * self.nseg
         else:

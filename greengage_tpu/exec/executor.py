@@ -41,6 +41,11 @@ def effective_limit_bytes(settings) -> int:
     qcap = int(getattr(settings, "resource_queue_memory_mb", 0)) << 20
     if qcap and (not limit or qcap < limit):
         limit = qcap
+    from greengage_tpu.runtime.resgroup import current_memory_limit_mb
+
+    gcap = current_memory_limit_mb() << 20   # thread's resource group share
+    if gcap and (not limit or gcap < limit):
+        limit = gcap
     return limit
 
 

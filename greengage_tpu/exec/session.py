@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import csv as _csv
 import os
+from contextlib import contextmanager as _contextmanager
 
 import numpy as np
 
@@ -84,6 +85,13 @@ class Database:
         self._DtmSession = DtmSession
         self._dtm_local = None   # created below once threading is imported
         self.resqueue = ResourceQueue(self.settings)
+        from greengage_tpu.runtime.resgroup import (ResourceGroup,
+                                                    ResourceGroupManager)
+
+        self.resgroups = ResourceGroupManager(
+            self.settings,
+            {d["name"]: ResourceGroup.from_dict(d)
+             for d in self.catalog.resource_groups})
         self.replicator = (Replicator(self.store, self.catalog.segments)
                            if self.catalog.segments.has_mirrors() else None)
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
@@ -185,7 +193,7 @@ class Database:
                     self._tx_for_dml(stmt.table, type(stmt).__name__[:6].upper())
                 if isinstance(stmt, A.DeclareCursorStmt):
                     self._validate_declare(stmt)
-                with self.resqueue.admit():
+                with self._admission():
                     ch = self.multihost.channel
                     ch.send({"op": "sql", "sql": text})
                     try:
@@ -342,10 +350,19 @@ class Database:
             self._cursor_owner.pop(stmt.cursor, None)
             return "CLOSE CURSOR"
         if isinstance(stmt, A.ShowStmt):
+            if stmt.what == "resource_group":
+                return self.resgroups.current_group()
             return str(self.settings.show(stmt.what))
         if isinstance(stmt, A.SetStmt):
+            if stmt.name == "resource_group":
+                # per-THREAD binding (one server connection = one thread),
+                # like SET ROLE picking the backend's resgroup
+                self.resgroups.set_group(str(stmt.value))
+                return "SET"
             self.settings.set(stmt.name, stmt.value)
             return "SET"
+        if isinstance(stmt, A.ResourceGroupStmt):
+            return self._resource_group(stmt)
         if isinstance(stmt, A.TxStmt):
             if stmt.action == "begin":
                 self.dtm.begin()
@@ -468,7 +485,7 @@ class Database:
             # same plan/program memoization as _select: a drain-then-
             # redeclare workload must not replan + recompile each DECLARE
             planned, consts, outs, exec_key = self._cached_plan(stmt.query)
-            with (self.resqueue.admit() if self.multihost is None
+            with (self._admission() if self.multihost is None
                   else _NullSlot()):
                 try:
                     batch = self.executor.run(planned, consts, outs,
@@ -599,7 +616,7 @@ class Database:
         # mesh statements; excess statements queue or time out. Multi-host
         # admission happens on the COORDINATOR before the broadcast (a
         # post-broadcast wait here would strand workers in the collectives)
-        with (self.resqueue.admit() if self.multihost is None
+        with (self._admission() if self.multihost is None
               else _NullSlot()):
             try:
                 # executor adds the manifest version; the bare statement
@@ -761,7 +778,8 @@ class Database:
         if kind == "range":
             bounded = sorted(
                 (p for p in real),
-                key=lambda p: (p.lo is not None, p.lo))
+                key=lambda p: (p.lo is not None,
+                               p.lo if p.lo is not None else 0))
             for a, b in zip(bounded, bounded[1:]):
                 a_hi = a.hi
                 b_lo = b.lo
@@ -778,6 +796,42 @@ class Database:
                     seen.add(v)
         if not parts:
             raise SqlError("partitioned table needs at least one partition")
+
+    def _admission(self):
+        """Statement admission: resource-group slot (weighted backoff when
+        the global cap binds) nested inside/with the legacy resource
+        queue; either is a no-op when unconfigured."""
+        from contextlib import ExitStack
+
+        st = ExitStack()
+        st.enter_context(self.resgroups.admit())
+        st.enter_context(self.resqueue.admit())
+        return st
+
+    def resgroup_status(self) -> list[dict]:
+        """gp_toolkit.gp_resgroup_status analog."""
+        return self.resgroups.status()
+
+    def _resource_group(self, stmt) -> str:
+        allowed = {"concurrency", "memory_limit_mb", "cpu_weight"}
+        bad = set(stmt.options) - allowed
+        if bad:
+            raise SqlError(f"unknown resource group option(s): "
+                           f"{', '.join(sorted(bad))}")
+        if stmt.action == "create":
+            self.resgroups.create(stmt.name, **stmt.options)
+            tag = "CREATE RESOURCE GROUP"
+        elif stmt.action == "drop":
+            self.resgroups.drop(stmt.name)
+            tag = "DROP RESOURCE GROUP"
+        else:
+            self.resgroups.alter(stmt.name, **stmt.options)
+            tag = "ALTER RESOURCE GROUP"
+        # persist definitions (built-ins included so tuned caps survive)
+        self.catalog.resource_groups = [
+            g.to_dict() for g in self.resgroups.groups.values()]
+        self.catalog._save()
+        return tag
 
     def _alter_table(self, stmt: A.AlterTableStmt) -> str:
         """ALTER TABLE ... ADD/DROP PARTITION (reference: cdbpartition.c
@@ -810,6 +864,9 @@ class Database:
                 self._cursors[cname] = (
                     f'cursor "{cname}" was invalidated by DROP PARTITION '
                     f'on {stmt.table}')
+        # same in-flight-DECLARE race as DROP TABLE: a cursor still being
+        # declared over this table must tombstone itself at registration
+        self._drop_log.append(stmt.table)
         tx = self.store.manifest.begin()
         if child in tx["tables"]:
             del tx["tables"][child]
@@ -909,16 +966,10 @@ class Database:
                 return v[m]
             return np.asarray(v, dtype=object)[m]
 
-        # inside a transaction all children stage into ONE manifest tx
-        # (atomic multi-partition insert); autocommit writes each child
-        # with its own commit, like per-partition appendonly segfiles
-        own_tx = None
-        tx = self.dtm.current
-        if tx is None or tx.state != "active":
-            own_tx = self.dtm.begin()
-            tx = own_tx
+        # all children stage into ONE manifest tx (atomic multi-partition
+        # insert), the user's own transaction when one is open
         total = 0
-        try:
+        with self._autocommit_tx() as tx:
             for i, p in enumerate(schema.partitions):
                 m = pidx == i
                 if not m.any():
@@ -927,13 +978,25 @@ class Database:
                 sub_v = {k: _slice(v, m) for k, v in valids.items()
                          if v is not None}
                 total += tx.insert(p.storage_name(schema.name), sub_c, sub_v)
-            if own_tx is not None:
-                self.dtm.commit()
+        return total
+
+    @_contextmanager
+    def _autocommit_tx(self):
+        """Yield the thread's active transaction, or an ephemeral one that
+        commits on success / aborts on error — the shared wrapper for
+        writes that must land atomically across several storage tables."""
+        tx = self.dtm.current
+        if tx is not None and tx.state == "active":
+            yield tx
+            return
+        own = self.dtm.begin()
+        try:
+            yield own
+            self.dtm.commit()
         except Exception:
-            if own_tx is not None and self.dtm.current is own_tx:
+            if self.dtm.current is own:
                 self.dtm.abort()
             raise
-        return total
 
     def load_table(self, table: str, columns: dict, valids: dict | None = None):
         """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
@@ -1103,22 +1166,19 @@ class Database:
         # atomic across children: autocommit wraps the multi-child rewrite
         # in ONE manifest commit — a reader must never see a row twice (or
         # zero times) while an UPDATE moves it between partitions
-        own = None
-        if tx is None:
-            own = self.dtm.begin()
-            tx = own
-        try:
+        if tx is not None:
             for i, p in enumerate(schema.partitions):
                 m = pidx == i
-                sub_c = {k: v[m] for k, v in enc.items()}
-                sub_v = {k: v[m] for k, v in valids.items()}
-                tx.replace(p.storage_name(schema.name), sub_c, sub_v)
-            if own is not None:
-                self.dtm.commit()
-        except Exception:
-            if own is not None and self.dtm.current is own:
-                self.dtm.abort()
-            raise
+                tx.replace(p.storage_name(schema.name),
+                           {k: v[m] for k, v in enc.items()},
+                           {k: v[m] for k, v in valids.items()})
+            return
+        with self._autocommit_tx() as atx:
+            for i, p in enumerate(schema.partitions):
+                m = pidx == i
+                atx.replace(p.storage_name(schema.name),
+                            {k: v[m] for k, v in enc.items()},
+                            {k: v[m] for k, v in valids.items()})
 
     def _delete(self, stmt: A.DeleteStmt):
         self._check_no_raw_dml(stmt.table)

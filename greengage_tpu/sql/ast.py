@@ -251,6 +251,13 @@ class CreateTableStmt(ANode):
 
 
 @dataclass
+class ResourceGroupStmt(ANode):
+    action: str                   # create | drop | alter
+    name: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class AlterTableStmt(ANode):
     table: str
     action: str                   # add_partition | drop_partition

@@ -605,8 +605,13 @@ class Parser:
             typmod = tuple(mods)
         return name, typmod
 
-    def create_table(self) -> A.CreateTableStmt:
+    def create_table(self):
         self.expect("kw", "create")
+        if self.accept_word("resource"):
+            self.expect_word("group")
+            name = self.expect("name")[1]
+            return A.ResourceGroupStmt("create", name,
+                                       self.resgroup_options())
         if self.accept_word("extension"):
             ine = False
             if self.accept("kw", "if"):
@@ -705,8 +710,16 @@ class Parser:
             self.expect("op", ")")
         return A.PartitionDef(name, lo=lo, hi=hi, every=every)
 
-    def alter_table(self) -> A.AlterTableStmt:
+    def alter_table(self):
         self.expect_word("alter")
+        if self.accept_word("resource"):
+            # ALTER RESOURCE GROUP g SET <option> <value>
+            self.expect_word("group")
+            name = self.expect("name")[1]
+            self.expect("kw", "set")
+            opt = self.expect("name")[1]
+            return A.ResourceGroupStmt("alter", name,
+                                       {opt: int(self.expect("num")[1])})
         self.expect("kw", "table")
         table = self.expect("name")[1]
         if self.accept_word("add"):
@@ -726,8 +739,25 @@ class Parser:
             not_null = True
         return A.ColumnDef(name, tname, typmod, not_null)
 
-    def drop_table(self) -> A.DropTableStmt:
+    def resgroup_options(self) -> dict:
+        """WITH (concurrency=N, memory_limit_mb=M, cpu_weight=W)."""
+        options: dict = {}
+        if self.accept("kw", "with"):
+            self.expect("op", "(")
+            while True:
+                k = self.expect("name")[1]
+                self.expect("op", "=")
+                options[k] = int(self.expect("num")[1])
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return options
+
+    def drop_table(self):
         self.expect("kw", "drop")
+        if self.accept_word("resource"):
+            self.expect_word("group")
+            return A.ResourceGroupStmt("drop", self.expect("name")[1])
         self.expect("kw", "table")
         ie = False
         if self.accept("kw", "if"):

@@ -221,6 +221,13 @@ def test_failed_routed_insert_stages_nothing_in_tx(db, tmp_path):
     assert d.sql("select count(*) from st").rows() == [(0,)]
 
 
+def test_two_unbounded_starts_rejected(db, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c9"), numsegments=2)
+    with pytest.raises(SqlError, match="overlapping"):
+        d.sql("create table ub (k int, v int) distributed by (k) partition "
+              "by range (v) (partition a end (10), partition b end (20))")
+
+
 def test_checkcat_clean(db, tmp_path, capsys):
     from greengage_tpu.mgmt import cli
 
