@@ -339,6 +339,8 @@ class Database:
             out = self._update(stmt)
             self._post_commit()
             return out
+        if isinstance(stmt, A.CreateExternalTableStmt):
+            return self._create_external_table(stmt)
         if isinstance(stmt, A.AnalyzeStmt):
             return self._analyze(stmt.table)
         if isinstance(stmt, A.CreateExtensionStmt):
@@ -360,6 +362,10 @@ class Database:
                 self.resgroups.set_group(str(stmt.value))
                 return "SET"
             self.settings.set(stmt.name, stmt.value)
+            if stmt.name.startswith("resource_"):
+                # wake blocked waiters: a lowered/disabled cap must admit
+                # them now, not at their timeout
+                self.resgroups.kick()
             return "SET"
         if isinstance(stmt, A.ResourceGroupStmt):
             return self._resource_group(stmt)
@@ -400,6 +406,10 @@ class Database:
         snap = self.store.manifest.snapshot()
         for n in names:
             schema = self.catalog.get(n)
+            if self._external_def(schema) is not None:
+                if table:
+                    raise SqlError("cannot ANALYZE an external table")
+                continue   # database-wide ANALYZE skips externals
             schema.stats = analyze_table(self.store, schema, snap)
         self.catalog._save()
         self._select_cache.clear()   # fresh stats can change plans
@@ -443,7 +453,11 @@ class Database:
         planned, consts, outs = self._plan(stmt)
         if len(outs) != 1:
             raise SqlError("scalar subquery must return one column")
-        res = self.executor.run(planned, consts, outs)
+        aux, dirty = self._load_external_aux(planned)
+        if dirty:
+            planned, consts, outs = self._plan(stmt)
+        res = self.executor.run(planned, consts, outs,
+                                aux_tables=aux or None)
         if len(res) > 1:
             raise SqlError("more than one row returned by a scalar subquery")
         t = outs[0].type
@@ -485,12 +499,17 @@ class Database:
             # same plan/program memoization as _select: a drain-then-
             # redeclare workload must not replan + recompile each DECLARE
             planned, consts, outs, exec_key = self._cached_plan(stmt.query)
+            aux, dirty = self._load_external_aux(planned)
+            if dirty:
+                planned, consts, outs, exec_key = self._cached_plan(
+                    stmt.query)
             with (self._admission() if self.multihost is None
                   else _NullSlot()):
                 try:
                     batch = self.executor.run(planned, consts, outs,
                                               cache_key=exec_key,
-                                              deferred=True)
+                                              deferred=True,
+                                              aux_tables=aux or None)
                 except QueryError as e:
                     if "duplicate keys" not in str(e):
                         raise
@@ -500,7 +519,8 @@ class Database:
                         stmt.query, force_multi_join=True)
                     batch = self.executor.run(planned, consts, outs,
                                               cache_key=exec_key,
-                                              deferred=True)
+                                              deferred=True,
+                                              aux_tables=aux or None)
             with self._write_lock:
                 prev = self._cursors.get(stmt.name)
                 if prev is not None and not isinstance(prev, str):
@@ -612,6 +632,12 @@ class Database:
 
     def _select(self, stmt: A.SelectStmt) -> Result:
         planned, consts, outs, exec_key = self._cached_plan(stmt)
+        # external tables materialize to host arrays before execution
+        # (fileam external_beginscan role); first-seen strings grow the
+        # dictionary, so the bound plan refreshes afterwards
+        aux, dirty = self._load_external_aux(planned)
+        if dirty:
+            planned, consts, outs, exec_key = self._cached_plan(stmt)
         # resource-queue admission (ResLockPortal analog): bound concurrent
         # mesh statements; excess statements queue or time out. Multi-host
         # admission happens on the COORDINATOR before the broadcast (a
@@ -622,7 +648,8 @@ class Database:
                 # executor adds the manifest version; the bare statement
                 # identity lets it evict compiled programs of old versions
                 res = self.executor.run(planned, consts, outs,
-                                        cache_key=exec_key)
+                                        cache_key=exec_key,
+                                        aux_tables=aux or None)
                 self._record_stats(res)
                 return res
             except QueryError as e:
@@ -634,7 +661,8 @@ class Database:
                 planned, consts, outs, exec_key = self._cached_plan(
                     stmt, force_multi_join=True)
                 res = self.executor.run(planned, consts, outs,
-                                        cache_key=exec_key)
+                                        cache_key=exec_key,
+                                        aux_tables=aux or None)
                 self._record_stats(res)
                 return res
 
@@ -656,9 +684,13 @@ class Database:
         planned, consts, outs = self._plan(stmt.query)
         text = describe(planned)
         if stmt.analyze:
+            aux, dirty = self._load_external_aux(planned)
+            if dirty:
+                planned, consts, outs = self._plan(stmt.query)
             # per-node instrumentation (explain_gp.c's Instrumentation
             # tree analog): every operator reports its actual output rows
-            res = self.executor.run(planned, consts, outs, instrument=True)
+            res = self.executor.run(planned, consts, outs, instrument=True,
+                                    aux_tables=aux or None)
             s = res.stats or {}
             annot = {pid: f"actual rows={n}"
                      for pid, n in (s.get("node_rows") or {}).items()}
@@ -804,8 +836,14 @@ class Database:
         from contextlib import ExitStack
 
         st = ExitStack()
-        st.enter_context(self.resgroups.admit())
-        st.enter_context(self.resqueue.admit())
+        try:
+            st.enter_context(self.resgroups.admit())
+            st.enter_context(self.resqueue.admit())
+        except BaseException:
+            # a queue timeout after the group slot was granted must release
+            # the slot (and unpin the thread's group memory ceiling)
+            st.close()
+            raise
         return st
 
     def resgroup_status(self) -> list[dict]:
@@ -832,6 +870,163 @@ class Database:
             g.to_dict() for g in self.resgroups.groups.values()]
         self.catalog._save()
         return tag
+
+    # ---- external tables (fileam.c / CREATE EXTERNAL TABLE role) ------
+    def _create_external_table(self, stmt) -> str:
+        """An external table is a catalog-only relation whose rows come
+        from (or go to) a URL/command at scan/insert time — no manifest
+        storage (reference: src/backend/access/external/fileam.c,
+        exttablecmds.c). Readable scans re-read the source every query."""
+        cols = []
+        for c in stmt.columns:
+            col = Column(c.name, type_from_name(c.type_name, c.typmod),
+                         not c.not_null)
+            if col.type.kind is T.Kind.TEXT:
+                # external TEXT is dictionary-coded at load (the scan path
+                # stages device arrays; raw byte blobs need storage files)
+                col = Column(col.name, col.type, col.nullable,
+                             encoding="dict")
+            cols.append(col)
+        if not stmt.urls and stmt.exec_cmd is None:
+            raise SqlError("external table needs LOCATION or EXECUTE")
+        schema = TableSchema(
+            stmt.name, cols,
+            DistPolicy(PolicyKind.RANDOM, (), self.numsegments),
+            {"external": {
+                "writable": stmt.writable,
+                "urls": list(stmt.urls),
+                "exec_cmd": stmt.exec_cmd,
+                "format": dict(stmt.format_opts),
+                "reject_limit": stmt.reject_limit,
+            }})
+        self.catalog.create_table(schema, stmt.if_not_exists)
+        return "CREATE EXTERNAL TABLE"
+
+    @staticmethod
+    def _external_def(schema) -> dict | None:
+        return schema.options.get("external")
+
+    def _external_chunks(self, schema, ext: dict) -> list:
+        """Fetch the raw bytes of an external source as
+        (blob, starts_new_file) pairs — HEADER must be stripped once per
+        FILE, not once per scan (a gpfdist stream is one file split into
+        chunks; a glob/EXECUTE yields one file per chunk)."""
+        from greengage_tpu.runtime import ingest
+
+        chunks: list = []
+        if ext["exec_cmd"] is not None:
+            # EXECUTE ON ALL: the command runs once per segment with
+            # GP_SEGMENT_ID/GP_SEGMENT_COUNT env (fileam.c EXECUTE popen)
+            import subprocess
+
+            for seg in range(self.numsegments):
+                env = dict(os.environ,
+                           GP_SEGMENT_ID=str(seg),
+                           GP_SEGMENT_COUNT=str(self.numsegments))
+                out = subprocess.run(
+                    ext["exec_cmd"], shell=True, env=env,
+                    capture_output=True, timeout=120)
+                if out.returncode != 0:
+                    raise SqlError(
+                        f"external EXECUTE failed on segment {seg}: "
+                        f"{out.stderr.decode(errors='replace')[:200]}")
+                chunks.append((out.stdout, True))
+            return chunks
+        import glob as _glob
+
+        for url in ext["urls"]:
+            if url.startswith("gpfdist://"):
+                for ci, blob in enumerate(
+                        ingest.fetch_chunks(url, self.numsegments)):
+                    chunks.append((blob, ci == 0))
+            else:
+                path = url[len("file://"):] if url.startswith("file://") else url
+                matches = sorted(_glob.glob(path))
+                if not matches:
+                    raise SqlError(f"external location {url!r} matches "
+                                   "no files")
+                for m in matches:
+                    with open(m, "rb") as f:
+                        chunks.append((f.read(), True))
+        return chunks
+
+    def _load_external_aux(self, planned) -> dict:
+        """Materialize every external table scanned by this plan into host
+        arrays for aux staging (the external_beginscan role: re-read per
+        query, SREH reject limits applied)."""
+        from greengage_tpu.planner.logical import Scan
+        from greengage_tpu.runtime import ingest
+
+        aux: dict = {}
+        any_dirty = False
+        stack = [planned]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            if not isinstance(node, Scan) or node.table in aux:
+                continue
+            schema = self.catalog.get(node.table) \
+                if node.table in self.catalog else None
+            ext = self._external_def(schema) if schema is not None else None
+            if ext is None:
+                continue
+            if ext["writable"]:
+                raise SqlError(
+                    f'"{node.table}" is a WRITABLE external table; it '
+                    "cannot be scanned")
+            fmt = ext.get("format", {})
+            delim = fmt.get("delimiter", ",")
+            header = str(fmt.get("header", "false")).lower() in ("true", "1")
+            null_s = fmt.get("null", "")
+            cols_all = {c.name: [] for c in schema.columns}
+            valids_all = {c.name: [] for c in schema.columns}
+            rejects: list = []
+            line_base = 0
+            for blob, file_start in self._external_chunks(schema, ext):
+                text = blob.decode("utf-8", errors="replace")
+                cols, valids, rej = ingest.parse_csv_rows(
+                    text, schema, delim, header and file_start, null_s,
+                    line_base=line_base)
+                for n in cols_all:
+                    cols_all[n].extend(cols[n])
+                    valids_all[n].extend(valids[n])
+                rejects.extend(rej)
+                line_base += blob.count(b"\n")
+            limit = ext.get("reject_limit")
+            if rejects and limit is None:
+                line, _raw, err = rejects[0]
+                raise SqlError(f"external table {node.table} line {line}: "
+                               f"{err}")
+            if limit is not None and len(rejects) > limit:
+                raise SqlError(
+                    f"external scan aborted: {len(rejects)} rejected rows "
+                    f"exceed SEGMENT REJECT LIMIT {limit}")
+            if rejects:
+                ingest.append_error_log(self.path, node.table, rejects)
+            enc_c: dict = {}
+            enc_v: dict = {}
+            dict_dirty = False
+            for c in schema.columns:
+                va = np.array(valids_all[c.name], dtype=bool)
+                if c.type.kind is T.Kind.TEXT:
+                    d = self.store.dictionary(node.table, c.name)
+                    strs = ["" if not ok else s for s, ok
+                            in zip(cols_all[c.name], va)]
+                    before = len(d)
+                    enc_c[c.name] = d.encode(strs)
+                    dict_dirty = dict_dirty or len(d) != before
+                else:
+                    enc_c[c.name] = np.array(cols_all[c.name],
+                                             dtype=c.type.np_dtype)
+                enc_v[c.name] = None if va.all() else va
+            if dict_dirty:
+                self.store.flush_dicts(node.table)
+                # new codes can shift LUT-dependent bound plans: the
+                # caller re-binds against the grown dictionary
+                self._select_cache.clear()
+                any_dirty = True
+            aux[node.table] = (enc_c, enc_v)
+        return aux, any_dirty
 
     def _alter_table(self, stmt: A.AlterTableStmt) -> str:
         """ALTER TABLE ... ADD/DROP PARTITION (reference: cdbpartition.c
@@ -881,6 +1076,14 @@ class Database:
 
     def _insert(self, stmt: A.InsertStmt):
         schema = self.catalog.get(stmt.table)
+        ext = self._external_def(schema)
+        if stmt.query is not None:
+            return self._insert_select(schema, ext, stmt)
+        if ext is not None:
+            raise SqlError(
+                f'"{stmt.table}" is an external table; load it via its '
+                "LOCATION source (INSERT ... SELECT writes WRITABLE "
+                "external tables)")
         names = stmt.columns or schema.column_names
         if set(names) != set(schema.column_names):
             raise SqlError("INSERT must provide all columns")
@@ -916,6 +1119,88 @@ class Database:
                 enc_valids[n] = va
         n = self._write_rows(stmt.table, enc_cols, enc_valids)
         return f"INSERT 0 {n}"
+
+    def _insert_select(self, schema, ext, stmt) -> str:
+        """INSERT INTO t SELECT ...: run the query, convert the presented
+        values back to storage representation, and either append to the
+        table or — for WRITABLE EXTERNAL tables — emit CSV to the
+        location/command (the gpfdist WET/EXECUTE writer role)."""
+        res = self._select(stmt.query) if not isinstance(stmt.query, A.UnionStmt) \
+            else self._execute(stmt.query)
+        names = stmt.columns or schema.column_names
+        if set(names) != set(schema.column_names):
+            raise SqlError("INSERT must provide all columns")
+        if len(res.columns) != len(names):
+            raise SqlError(
+                f"INSERT SELECT arity mismatch: query returns "
+                f"{len(res.columns)} columns, target has {len(names)}")
+        if ext is not None:
+            if not ext["writable"]:
+                raise SqlError(
+                    f'cannot write to READABLE external table "{schema.name}"')
+            return self._write_external(schema, ext, res)
+        cols: dict = {}
+        valids: dict = {}
+        order = res._order
+        for n, oid in zip(names, order):
+            c = schema.column(n)
+            data = res.cols[oid]
+            v = res.valids.get(oid)
+            if c.type.kind is T.Kind.DECIMAL:
+                # presented value is a float; re-scale with round-half-
+                # away (the engine's numeric rounding rule)
+                f = np.asarray(data, dtype=np.float64) * (10.0 ** c.type.scale)
+                data = (np.floor(np.abs(f) + 0.5) * np.sign(f)).astype(np.int64)
+            elif c.type.kind is T.Kind.DATE:
+                data = (np.asarray(data, dtype="datetime64[D]")
+                        - np.datetime64("1970-01-01", "D")).astype(np.int32)
+            elif c.type.kind is T.Kind.TEXT:
+                data = ["" if s is None else str(s) for s in data]
+            else:
+                data = np.asarray(data)
+                if v is not None:
+                    # NULL slots may carry NaN/garbage; zero them so the
+                    # dtype cast cannot fail
+                    data = np.where(v, data, 0)
+                data = data.astype(c.type.np_dtype)
+            cols[n] = data
+            if v is not None:
+                valids[n] = np.asarray(v, dtype=bool)
+        n = self._write_rows(schema.name, cols, valids)
+        self._post_commit()
+        return f"INSERT 0 {n}"
+
+    def _write_external(self, schema, ext, res) -> str:
+        import csv as _c
+        import io
+
+        buf = io.StringIO()
+        fmt = ext.get("format", {})
+        w = _c.writer(buf, delimiter=fmt.get("delimiter", ","))
+        null_s = fmt.get("null", "")
+        for row in res.rows():
+            w.writerow([null_s if v is None else v for v in row])
+        payload = buf.getvalue()
+        if ext["exec_cmd"] is not None:
+            import subprocess
+
+            out = subprocess.run(ext["exec_cmd"], shell=True,
+                                 input=payload.encode(), timeout=120,
+                                 capture_output=True)
+            if out.returncode != 0:
+                raise SqlError(
+                    "external EXECUTE writer failed: "
+                    f"{out.stderr.decode(errors='replace')[:200]}")
+            return f"INSERT 0 {len(res)}"
+        url = ext["urls"][0]
+        if url.startswith("gpfdist://"):
+            raise SqlError("writing through a gpfdist URL is not supported; "
+                           "use file:// or EXECUTE")
+        path = url[len("file://"):] if url.startswith("file://") else url
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(payload)
+        return f"INSERT 0 {len(res)}"
 
     def _write_rows(self, table: str, columns, valids) -> int:
         """All write paths (INSERT/COPY/load_table) stage into the open
@@ -1006,6 +1291,9 @@ class Database:
 
     def _copy(self, stmt: A.CopyStmt):
         schema = self.catalog.get(stmt.table)
+        if self._external_def(schema) is not None:
+            raise SqlError("COPY targets heap tables; external tables load "
+                           "from their LOCATION at scan time")
         delim = stmt.options.get("delimiter", ",")
         header = str(stmt.options.get("header", "false")).lower() in ("true", "1")
         null_s = stmt.options.get("null", "")
@@ -1133,7 +1421,15 @@ class Database:
         res = self.executor.run(planned, consts, outs, raw=True)
         return res, outs
 
+    def _check_dml_target(self, table: str):
+        schema = self.catalog.get(table)
+        if self._external_def(schema) is not None:
+            raise SqlError(
+                f'"{table}" is an external table; DML is not supported '
+                "(reference: external tables reject UPDATE/DELETE)")
+
     def _check_no_raw_dml(self, table: str):
+        self._check_dml_target(table)
         # NOTE when this guard is lifted (raw DML): a committed republish
         # GC's the old raw blobs, so open cursors whose out_cols carry
         # raw_refs into this table must be tombstoned at commit (their

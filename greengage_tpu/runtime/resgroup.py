@@ -202,6 +202,11 @@ class ResourceGroupManager:
                 g.active -= 1
             self._cond.notify_all()
 
+    def kick(self) -> None:
+        """Re-evaluate all waiters (a settings change moved the caps)."""
+        with self._cond:
+            self._cond.notify_all()
+
     # ---- observability (gp_toolkit.gp_resgroup_status analog) ---------
     def status(self) -> list[dict]:
         with self._lock:

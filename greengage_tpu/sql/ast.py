@@ -272,10 +272,23 @@ class DropTableStmt(ANode):
 
 
 @dataclass
+class CreateExternalTableStmt(ANode):
+    name: str
+    columns: list[ColumnDef]
+    writable: bool = False
+    urls: list[str] = field(default_factory=list)   # LOCATION clause
+    exec_cmd: str | None = None                     # EXECUTE clause
+    format_opts: dict = field(default_factory=dict)
+    reject_limit: int | None = None
+    if_not_exists: bool = False
+
+
+@dataclass
 class InsertStmt(ANode):
     table: str
     columns: list[str]
     rows: list[list[ANode]]
+    query: ANode | None = None    # INSERT INTO ... SELECT
 
 
 @dataclass

@@ -612,6 +612,11 @@ class Parser:
             name = self.expect("name")[1]
             return A.ResourceGroupStmt("create", name,
                                        self.resgroup_options())
+        if self.accept_word("writable"):
+            self.expect_word("external")
+            return self.create_external_table(True)
+        if self.accept_word("external"):
+            return self.create_external_table(False)
         if self.accept_word("extension"):
             ine = False
             if self.accept("kw", "if"):
@@ -680,6 +685,57 @@ class Parser:
             self.expect("op", ")")
         return A.CreateTableStmt(name, cols, dist_kind, dist_keys, options,
                                  ine, pkind, pcol, pdefs)
+
+    def create_external_table(self, writable: bool) -> A.CreateExternalTableStmt:
+        """CREATE [WRITABLE] EXTERNAL TABLE t (cols) { LOCATION ('url',...)
+        | EXECUTE 'cmd' } [FORMAT 'csv' (delimiter ',' header null '')]
+        [SEGMENT REJECT LIMIT n] — the GP external-table syntax subset
+        (reference: src/backend/parser/gram.y CreateExternalStmt)."""
+        self.expect("kw", "table")
+        ine = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "not")
+            self.expect("kw", "exists")
+            ine = True
+        name = self.expect("name")[1]
+        self.expect("op", "(")
+        cols = [self.column_def()]
+        while self.accept("op", ","):
+            cols.append(self.column_def())
+        self.expect("op", ")")
+        urls: list[str] = []
+        exec_cmd = None
+        if self.accept_word("location"):
+            self.expect("op", "(")
+            urls.append(self.expect("str")[1])
+            while self.accept("op", ","):
+                urls.append(self.expect("str")[1])
+            self.expect("op", ")")
+        else:
+            self.expect_word("execute")
+            exec_cmd = self.expect("str")[1]
+            if self.accept("kw", "on"):   # ON ALL is the only mode
+                self.expect("kw", "all")
+        fmt: dict = {}
+        if self.accept_word("format"):
+            kind = self.expect("str")[1].lower()
+            if kind not in ("csv", "text"):
+                raise SqlError(f"unsupported external format {kind!r}")
+            fmt["kind"] = kind
+            if self.accept("op", "("):
+                while not self.accept("op", ")"):
+                    k = self.next()[1]
+                    if self.peek()[0] == "str":
+                        fmt[k] = self.expect("str")[1]
+                    else:
+                        fmt[k] = "true"   # bare flag, e.g. HEADER
+        reject_limit = None
+        if self.accept_word("segment"):
+            self.expect_word("reject")
+            self.expect("kw", "limit")
+            reject_limit = int(self.expect("num")[1])
+        return A.CreateExternalTableStmt(
+            name, cols, writable, urls, exec_cmd, fmt, reject_limit, ine)
 
     def partition_def(self, kind: str | None) -> A.PartitionDef:
         if self.accept_word("default"):
@@ -775,6 +831,9 @@ class Parser:
             while self.accept("op", ","):
                 columns.append(self.expect("name")[1])
             self.expect("op", ")")
+        if self.at_kw("select"):
+            return A.InsertStmt(table, columns, [],
+                                query=self.select_or_union())
         self.expect("kw", "values")
         rows = []
         while True:
