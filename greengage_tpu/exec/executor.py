@@ -528,8 +528,15 @@ class Executor:
                 # surrogates — never dereference them.
                 vals = np.empty(len(data), dtype=object)
                 m = np.asarray(valid, bool)
-                vals[m] = self.store.fetch_raw(
+                decoded = self.store.fetch_raw(
                     c.raw_ref[0], c.raw_ref[1], data[m], snapshot)
+                if getattr(c, "raw_chain", None):
+                    from greengage_tpu.utils import strfuncs
+
+                    decoded = np.array(
+                        [strfuncs.apply_chain(s, c.raw_chain)
+                         for s in decoded], dtype=object)
+                vals[m] = decoded
                 out_cols[c.id] = vals
             elif c.type.kind is T.Kind.TEXT and c.dict_ref is not None:
                 d = self.store.dictionary(*c.dict_ref)

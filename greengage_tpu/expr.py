@@ -107,6 +107,21 @@ class Lut(Expr):
 
 
 @dataclass(frozen=True)
+class RawChain(Expr):
+    """String-function chain over a raw-encoded TEXT column.
+
+    The device carries the column's row surrogate unchanged; the chain is
+    applied on the host — at predicate staging (table_store.eval_host_pred)
+    or at result decode (executor finalize). chain = ((name, *literal_args),
+    ...) in application order; see utils/strfuncs.py for semantics.
+    """
+
+    arg: Expr           # base-table ColRef of the raw column
+    chain: tuple = ()
+    type: T.SqlType = T.TEXT
+
+
+@dataclass(frozen=True)
 class InList(Expr):
     arg: Expr
     values: tuple       # storage-representation scalars

@@ -263,6 +263,11 @@ class Evaluator:
         idx = jnp.where(codes < 0, table.shape[0] - 1, codes)
         return table[idx], val
 
+    def _eval_rawchain(self, e: "E.RawChain"):
+        # device representation of a raw-TEXT function result is the
+        # untouched row surrogate; the host applies the chain at decode
+        return self.value(e.arg)
+
     def _eval_inlist(self, e: E.InList):
         v, val = self.value(e.arg)
         res = jnp.zeros((self.n,), dtype=bool)

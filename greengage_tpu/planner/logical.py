@@ -30,6 +30,9 @@ class ColInfo:
     # raw-encoded TEXT (no dictionary): device carries a row surrogate,
     # strings decode at finalize via this (table, column)
     raw_ref: tuple[str, str] | None = None
+    # string-function steps applied on the host after raw decode
+    # (utils/strfuncs chain form)
+    raw_chain: tuple | None = None
 
 
 @dataclass

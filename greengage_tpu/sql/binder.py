@@ -809,11 +809,16 @@ class Binder:
             scope.add(t.alias or t.name, cols)
             return scan, scope
         if isinstance(t, A.SubqueryRef):
-            plan, outs = self._bind_select(t.query)
+            if isinstance(t.query, A.UnionStmt):
+                plan, outs = self._bind_union(t.query)
+            else:
+                plan, outs = self._bind_select(t.query)
             scope = Scope()
             scope.add(t.alias, {c.name: c for c in outs})
             return plan, scope
         if isinstance(t, A.JoinRef):
+            if t.kind == "full":
+                return self._bind_full_join(t)
             lp, ls = self._bind_table_ref(t.left)
             rp, rs = self._bind_table_ref(t.right)
             merged = ls.merged(rs)
@@ -831,6 +836,74 @@ class Binder:
                         residual=self._predicate(residual, merged) if residual else None)
             return join, merged
         raise SqlError(f"unsupported FROM item {type(t).__name__}")
+
+    def _bind_full_join(self, t: A.JoinRef):
+        """FULL OUTER JOIN as a union rewrite:
+            A FULL JOIN B ON k  ==  (A LEFT JOIN B ON k)
+                                    UNION ALL
+                                    (NULL-extended B ANTI JOIN A ON k)
+        Each side is bound twice (fresh column ids per instance); the two
+        branches are positionally wired through a Union whose output columns
+        carry the original table aliases so name resolution sees one joined
+        scope. Matches nodeHashjoin.c's HJ_FILL_OUTER handling by plan shape
+        rather than kernel state.
+        """
+        from greengage_tpu.planner.logical import Union
+
+        conjuncts = _split_and(t.on)
+        lp, ls = self._bind_table_ref(t.left)
+        rp, rs = self._bind_table_ref(t.right)
+        eq, rest = _extract_equi(conjuncts, ls, rs)
+        if not eq:
+            raise SqlError("join requires at least one equality condition")
+        if rest:
+            raise SqlError(
+                "FULL JOIN supports only equality conditions in ON")
+        lkeys = [self._expr(l, ls) for l, _ in eq]
+        rkeys = [self._expr(r, rs) for _, r in eq]
+        lkeys, rkeys = self._align_join_keys(lkeys, rkeys)
+        branch1 = Join("left", lp, rp, lkeys, rkeys)
+
+        # second instances for the anti branch (B rows with no A match)
+        lp2, ls2 = self._bind_table_ref(t.left)
+        rp2, rs2 = self._bind_table_ref(t.right)
+        lkeys2 = [self._expr(l, ls2) for l, _ in eq]
+        rkeys2 = [self._expr(r, rs2) for _, r in eq]
+        rkeys2, lkeys2 = self._align_join_keys(rkeys2, lkeys2)
+        branch2 = Join("anti", rp2, lp2, rkeys2, lkeys2)
+
+        # flattened output: left cols then right cols, preserving alias
+        # structure. (alias, name, branch-1 col, branch-2 col-or-None)
+        slots = []
+        for (a1, cols1), (a2, cols2) in zip(ls.tables, ls2.tables):
+            for n, c in cols1.items():
+                slots.append((a1, n, c, None))  # left side: NULL in branch 2
+        for (a1, cols1), (a2, cols2) in zip(rs.tables, rs2.tables):
+            for n, c in cols1.items():
+                slots.append((a1, n, c, cols2[n]))
+
+        union_cols = []
+        b1_exprs, b2_exprs = [], []
+        out_scope = Scope()
+        per_alias: dict[str, dict[str, ColInfo]] = {}
+        for alias, name, c1, c2 in slots:
+            if c1.raw_ref is not None:
+                raise SqlError(
+                    "raw-encoded text is not supported in FULL JOIN")
+            uc = ColInfo(self.new_id(name), c1.type, name, c1.dict_ref)
+            union_cols.append(uc)
+            per_alias.setdefault(alias, {})[name] = uc
+            b1_exprs.append((ColInfo(self.new_id(name), c1.type, name,
+                                     c1.dict_ref), _colref(c1)))
+            e2 = (E.Literal(None, c1.type) if c2 is None else _colref(c2))
+            b2_exprs.append((ColInfo(self.new_id(name), c1.type, name,
+                                     c1.dict_ref), e2))
+        for alias, cols in per_alias.items():
+            out_scope.add(alias, cols)
+        inputs = [Project(branch1, b1_exprs), Project(branch2, b2_exprs)]
+        plan = Union(inputs, union_cols)
+        plan.branch_ids = [[c.id for c, _ in p.exprs] for p in inputs]
+        return plan, out_scope
 
     def _align_join_keys(self, lkeys, rkeys):
         """Type-align join key pairs; TEXT pairs from different dictionaries
@@ -959,6 +1032,10 @@ class Binder:
                 if fc.name in ("min", "max"):
                     # min/max of raw text would return the row surrogate
                     self._no_raw(ae, f"{fc.name}() argument")
+                if fc.name != "count":
+                    # count(chain) is fine (validity passes through); any
+                    # value-dependent aggregate would sum surrogates
+                    self._no_rawchain(ae, f"{fc.name}() argument")
                 ci_in = ColInfo(self.new_id("a_in"), ae.type, "arg", _dict_ref_of(ae))
                 proj.append((ci_in, ae))
                 arg_ref = E.ColRef(ci_in.id, ci_in.type)
@@ -1068,15 +1145,27 @@ class Binder:
                         else scope.all_cols())
                 for c in cols:
                     ci = ColInfo(self.new_id(c.name), c.type, c.name, c.dict_ref,
-                                 raw_ref=c.raw_ref)
+                                 raw_ref=c.raw_ref,
+                                 raw_chain=getattr(c, "raw_chain", None))
                     sel_exprs.append((ci, E.ColRef(c.id, c.type)))
                 continue
             e = self._rewritten_expr(it.expr, rewrites, scope, allow_plain)
             name = it.alias or _ast_name(it.expr)
+            if isinstance(e, E.RawChain) and e.type.kind is not T.Kind.TEXT:
+                raise SqlError(
+                    "numeric functions of raw-encoded text are only "
+                    "supported in WHERE")
             ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e),
-                         raw_ref=_raw_ref_of(e))
+                         raw_ref=_raw_ref_of(e), raw_chain=_raw_chain_of(e))
             sel_exprs.append((ci, e))
         return scope, sel_exprs
+
+    def _no_rawchain(self, e: E.Expr, what: str) -> E.Expr:
+        if isinstance(e, E.RawChain):
+            raise SqlError(
+                f"string functions of raw-encoded text cannot be used in "
+                f"{what} (supported: WHERE comparisons, output columns)")
+        return e
 
     def _no_raw(self, e: E.Expr, what: str) -> E.Expr:
         if _raw_ref_of(e) is not None:
@@ -1184,6 +1273,8 @@ class Binder:
                 return E.Literal(-a.value, a.type)
             return E.BinOp("-", E.Literal(0, a.type), a, a.type)
         if isinstance(ast, A.Bin):
+            if ast.op == "||":
+                return self._bind_concat(ast, scope)
             if ast.op in ("and", "or"):
                 return E.BoolOp(ast.op, (self._predicate(ast.left, scope),
                                          self._predicate(ast.right, scope)))
@@ -1207,7 +1298,12 @@ class Binder:
                     if not isinstance(lit, E.Literal):
                         raise SqlError("IN list must be literals")
                     vals.append(lit.value)
-                e = self._host_pred(arg, {"op": "in", "values": vals})
+                if isinstance(arg, E.RawChain):
+                    e = self._host_pred(arg, {
+                        "op": "chain", "chain": [list(s) for s in arg.chain],
+                        "cmp": "in", "value": vals})
+                else:
+                    e = self._host_pred(arg, {"op": "in", "values": vals})
                 return E.Not(e) if ast.negate else e
             d = _dict_ref_of(arg) if arg.type.kind is T.Kind.TEXT else None
             dictionary = self.store.dictionary(*d) if d else None
@@ -1226,6 +1322,11 @@ class Binder:
             arg = self._expr(ast.arg, scope)
             if arg.type.kind is not T.Kind.TEXT:
                 raise SqlError("LIKE requires a text column")
+            if isinstance(arg, E.RawChain):
+                e = self._host_pred(arg, {
+                    "op": "chain", "chain": [list(s) for s in arg.chain],
+                    "cmp": "like", "value": ast.pattern})
+                return E.Not(e) if ast.negate else e
             if _raw_ref_of(arg) is not None:
                 e = self._host_pred(arg, {"op": "like", "pattern": ast.pattern})
                 return E.Not(e) if ast.negate else e
@@ -1242,8 +1343,11 @@ class Binder:
             vals = []
             for c, v in ast.whens:
                 whens.append(self._predicate(c, scope))
-                vals.append(self._expr(v, scope))
-            else_e = self._expr(ast.else_, scope) if ast.else_ is not None else None
+                vals.append(self._no_rawchain(self._expr(v, scope),
+                                              "CASE branches"))
+            else_e = self._no_rawchain(self._expr(ast.else_, scope),
+                                       "CASE branches") \
+                if ast.else_ is not None else None
             out_t = vals[0].type
             for v in vals[1:]:
                 out_t = T.promote(out_t, v.type)
@@ -1251,7 +1355,7 @@ class Binder:
                 out_t = T.promote(out_t, else_e.type)
             return E.Case(tuple(zip(whens, vals)), else_e, out_t)
         if isinstance(ast, A.CastExpr):
-            a = self._expr(ast.arg, scope)
+            a = self._no_rawchain(self._expr(ast.arg, scope), "CAST")
             target = type_from_name(ast.type_name, ast.typmod)
             if isinstance(a, E.Literal):
                 return self._coerce_literal(a, target)
@@ -1267,8 +1371,109 @@ class Binder:
         if isinstance(ast, A.FuncCall):
             if ast.name in ("count", "sum", "avg", "min", "max"):
                 raise SqlError(f"aggregate {ast.name}() not allowed here")
+            from greengage_tpu.utils import strfuncs
+
+            if ast.name in strfuncs.SPECS and ast.name != "concat":
+                return self._bind_string_func(
+                    ast.name, [self._expr(a, scope) for a in ast.args])
             return self._bind_scalar_func(ast, scope)
         raise SqlError(f"cannot bind {type(ast).__name__}")
+
+    # ---- string functions ---------------------------------------------
+    def _bind_string_func(self, name: str, args: list) -> E.Expr:
+        """Lower a SQL string function; strategy depends on the subject's
+        encoding — see utils/strfuncs.py. Extra arguments must be literals
+        (the per-distinct-value/host-chain strategies evaluate them once)."""
+        from greengage_tpu.utils import strfuncs
+
+        lo, hi, kind = strfuncs.SPECS[name]
+        if len(args) < lo or (hi is not None and len(args) > hi):
+            raise SqlError(f"wrong number of arguments for {name}()")
+        subject, extras = args[0], args[1:]
+        lits = []
+        for a in extras:
+            if not isinstance(a, E.Literal):
+                raise SqlError(
+                    f"{name}(): arguments after the string must be literals")
+            lits.append(a.value)
+        if subject.type.kind is not T.Kind.TEXT:
+            raise SqlError(f"{name}() requires a text argument")
+        return self._lower_str_step(subject, (name, *lits), kind)
+
+    def _bind_concat(self, ast: A.Bin, scope) -> E.Expr:
+        """x || y (textcat): flatten the chain; at most one non-literal
+        part, folded into a ("concat", prefix, suffix) step around it."""
+        parts: list[E.Expr] = []
+
+        def flat(n):
+            if isinstance(n, A.Bin) and n.op == "||":
+                flat(n.left)
+                flat(n.right)
+            else:
+                parts.append(self._expr(n, scope))
+
+        flat(ast)
+        rendered: list[str | None] = []
+        subject_i = None
+        for i, p in enumerate(parts):
+            if isinstance(p, E.Literal):
+                rendered.append(None if p.value is None
+                                else _render_text(p))
+            else:
+                if subject_i is not None:
+                    raise SqlError(
+                        "|| supports at most one column operand (combine "
+                        "literals around a single column)")
+                subject_i = i
+                rendered.append(None)
+        if any(r is None and (subject_i != i)
+               for i, r in enumerate(rendered)):
+            # a NULL literal operand: || propagates NULL (textcat semantics)
+            return E.Literal(None, T.TEXT)
+        if subject_i is None:
+            return E.Literal("".join(rendered), T.TEXT)
+        subject = parts[subject_i]
+        if subject.type.kind is not T.Kind.TEXT:
+            raise SqlError("|| column operand must be text (use cast)")
+        prefix = "".join(rendered[:subject_i])
+        suffix = "".join(rendered[subject_i + 1:])
+        if not prefix and not suffix:
+            return subject
+        return self._lower_str_step(subject, ("concat", prefix, suffix), "str")
+
+    def _lower_str_step(self, subject: E.Expr, step: tuple, kind: str) -> E.Expr:
+        """Apply one string-function step to a bound TEXT expression."""
+        from greengage_tpu.utils import strfuncs
+
+        if isinstance(subject, E.Literal):
+            if subject.value is None:
+                return E.Literal(None, T.TEXT if kind == "str" else T.INT32)
+            v = strfuncs.apply(step[0], subject.value, *step[1:])
+            return (E.Literal(v, T.TEXT) if kind == "str"
+                    else E.Literal(int(v), T.INT32))
+        if isinstance(subject, E.RawChain) or _raw_ref_of(subject) is not None:
+            base = subject.arg if isinstance(subject, E.RawChain) else subject
+            prev = _raw_chain_of(subject) or ()
+            t = T.TEXT if kind == "str" else T.INT32
+            rc = E.RawChain(base, prev + (tuple(step),), t)
+            object.__setattr__(rc, "_raw_ref", _raw_ref_of(subject))
+            return rc
+        d = _dict_ref_of(subject)
+        if d is None:
+            raise SqlError(
+                f"{step[0]}() requires a text column or string literal")
+        dic = self.store.dictionary(*d)
+        outs = [strfuncs.apply(step[0], v, *step[1:]) for v in dic.values]
+        if kind == "int":
+            lut = np.array(list(outs) + [0], dtype=np.int32)
+            return E.Lut(subject, self._const(lut), type=T.INT32)
+        dedup = list(dict.fromkeys(outs))
+        ref = self.store.derived_dictionary(dedup)
+        dd = self.store.dictionary(*ref)
+        lut = np.array([dd.lookup(o) for o in outs] + [-1], dtype=np.int32)
+        e = E.Lut(subject, self._const(lut), type=T.TEXT)
+        object.__setattr__(e, "_dict_ref", ref)
+        return e
 
     def _bind_scalar_func(self, ast: A.FuncCall, scope) -> E.Expr:
         """Resolve against the extension registry (pg_proc analog,
@@ -1331,11 +1536,12 @@ class Binder:
         boolean staged with the scan (the dictionary-LUT strategy at
         O(rows) host cost, cached per manifest version)."""
         rr = _raw_ref_of(arg)
-        if not isinstance(arg, E.ColRef) or arg.name not in self._scan_for:
+        base = arg.arg if isinstance(arg, E.RawChain) else arg
+        if not isinstance(base, E.ColRef) or base.name not in self._scan_for:
             raise SqlError(
                 "predicates on raw-encoded text are only supported directly "
                 "on base-table columns")
-        scan = self._scan_for[arg.name]
+        scan = self._scan_for[base.name]
         name = self.store.host_pred_name(rr[1], payload)
         for c in scan.cols:   # reuse an identical predicate column
             if c.name == name:
@@ -1349,16 +1555,51 @@ class Binder:
     def _bind_cmp(self, ast: A.Bin, scope) -> E.Expr:
         le = self._expr(ast.left, scope)
         re_ = self._expr(ast.right, scope)
+        if (isinstance(le, E.Literal) and isinstance(re_, E.Literal)
+                and le.type.kind is T.Kind.TEXT
+                and re_.type.kind is T.Kind.TEXT):
+            if le.value is None or re_.value is None:
+                return E.Literal(None, T.BOOL)
+            import operator
+
+            fn = {"=": operator.eq, "<>": operator.ne, "<": operator.lt,
+                  "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+            return E.Literal(fn[ast.op](le.value, re_.value), T.BOOL)
         # raw TEXT comparisons evaluate on host (storage carries surrogates)
-        for a, b in ((le, re_), (re_, le)):
-            if _raw_ref_of(a) is not None:
-                if not (isinstance(b, E.Literal) and b.type.kind is T.Kind.TEXT
-                        and ast.op in ("=", "<>")):
+        for a, b, flipped in ((le, re_, False), (re_, le, True)):
+            if _raw_ref_of(a) is None:
+                continue
+            if isinstance(a, E.RawChain):
+                if not isinstance(b, E.Literal):
                     raise SqlError(
-                        "raw-encoded text supports only =/<> against string "
-                        "literals, LIKE, and IN")
-                e = self._host_pred(a, {"op": "eq", "value": b.value})
-                return E.Not(e) if ast.op == "<>" else e
+                        "raw-text function results compare only against "
+                        "literals")
+                op = ast.op
+                if flipped:
+                    op = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}.get(op, op)
+                if a.type.kind is T.Kind.TEXT:
+                    if b.type.kind is not T.Kind.TEXT:
+                        raise SqlError(
+                            "raw-text function result compared to non-string")
+                    val = b.value
+                else:
+                    if not isinstance(b.value, (int, float)):
+                        raise SqlError(
+                            "numeric string function compared to non-number")
+                    val = b.value
+                    if b.type.kind is T.Kind.DECIMAL:
+                        # literals carry the scaled-int representation
+                        val = b.value / 10 ** b.type.scale
+                return self._host_pred(a, {
+                    "op": "chain", "chain": [list(s) for s in a.chain],
+                    "cmp": op, "value": val})
+            if not (isinstance(b, E.Literal) and b.type.kind is T.Kind.TEXT
+                    and ast.op in ("=", "<>")):
+                raise SqlError(
+                    "raw-encoded text supports only =/<> against string "
+                    "literals, LIKE, and IN")
+            e = self._host_pred(a, {"op": "eq", "value": b.value})
+            return E.Not(e) if ast.op == "<>" else e
         le, re_ = self._coerce_pair(le, re_)
         return E.Cmp(ast.op, le, re_)
 
@@ -1441,6 +1682,8 @@ class Binder:
             return E.Literal(days, T.DATE)
         le = self._expr(ast.left, scope)
         re_ = self._expr(ast.right, scope)
+        self._no_rawchain(le, "arithmetic")
+        self._no_rawchain(re_, "arithmetic")
         # unknown literal coercion mirrors comparison
         if isinstance(re_, E.Literal) and re_.type.kind is T.Kind.TEXT:
             re_ = self._coerce_literal(re_, le.type)
@@ -1465,7 +1708,37 @@ def _colref(c: ColInfo) -> E.ColRef:
         object.__setattr__(e, "_dict_ref", c.dict_ref)
     if c.raw_ref is not None:
         object.__setattr__(e, "_raw_ref", c.raw_ref)
+    if getattr(c, "raw_chain", None):
+        object.__setattr__(e, "_raw_chain", c.raw_chain)
     return e
+
+
+def _raw_chain_of(e: E.Expr):
+    if isinstance(e, E.RawChain):
+        return e.chain
+    return getattr(e, "_raw_chain", None)
+
+
+def _render_text(lit: E.Literal) -> str:
+    """Literal -> its SQL text form (|| operand rendering)."""
+    t, v = lit.type, lit.value
+    if t.kind is T.Kind.TEXT:
+        return v
+    if t.kind is T.Kind.DECIMAL:
+        s = t.scale
+        if not s:
+            return str(v)
+        sign = "-" if v < 0 else ""
+        a = abs(v)
+        return f"{sign}{a // 10**s}.{a % 10**s:0{s}d}"
+    if t.kind is T.Kind.DATE:
+        import datetime
+
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=v)).isoformat()
+    if t.kind is T.Kind.BOOL:
+        return "true" if v else "false"
+    return str(v)
 
 
 def _zero_lit(t: T.SqlType) -> E.Literal:

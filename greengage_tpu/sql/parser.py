@@ -9,6 +9,8 @@ BETWEEN/LIKE < additive < multiplicative < unary minus.
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 import re
 
 from greengage_tpu.sql import ast as A
@@ -33,7 +35,8 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "null", "true", "false", "is",
     "in", "between", "like", "case", "when", "then", "else", "end", "cast",
-    "join", "inner", "left", "right", "outer", "cross", "on", "distinct",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "distinct",
     "asc", "desc", "nulls", "first", "last", "create", "table", "drop",
     "insert", "into", "values", "copy", "explain", "analyze", "date",
     "interval", "extract", "distributed", "randomly", "replicated", "with",
@@ -131,6 +134,19 @@ class Parser:
         return stmts
 
     def statement(self) -> A.ANode:
+        if self.at_kw("with"):
+            # WITH ctes: inline expansion (non-recursive). The reference
+            # materializes shared CTEs via ShareInputScan
+            # (src/backend/executor/nodeShareInputScan.c:1); here every
+            # reference inlines the subplan and XLA's common-subexpression
+            # elimination dedupes identical subprograms within the single
+            # compiled SPMD program — the TPU-native sharing analog.
+            ctes = self.with_prefix()
+            if self.at_kw("insert"):
+                stmt = self.insert_stmt()
+            else:
+                stmt = self.select_or_union()
+            return _substitute_ctes(stmt, ctes)
         if self.at_kw("select"):
             return self.select_or_union()
         if self.at_word("declare"):
@@ -213,6 +229,38 @@ class Parser:
             self.accept("kw", "transaction") or self.accept("kw", "work")
             return A.TxStmt("abort")
         raise SqlError(f"unexpected {self.peek()[1]!r}")
+
+    # ---- WITH (common table expressions) ------------------------------
+    def with_prefix(self) -> dict:
+        """Parse `WITH name [(cols)] AS (query) [, ...]` -> {name: query}.
+
+        Later CTEs may reference earlier ones (expanded eagerly, so the
+        returned queries are self-contained). WITH RECURSIVE is rejected.
+        """
+        self.expect("kw", "with")
+        if self.at_word("recursive"):
+            raise SqlError("WITH RECURSIVE is not supported")
+        ctes: dict = {}
+        while True:
+            name = self.expect("name")[1]
+            colnames = None
+            if self.accept("op", "("):
+                colnames = [self.expect("name")[1]]
+                while self.accept("op", ","):
+                    colnames.append(self.expect("name")[1])
+                self.expect("op", ")")
+            self.expect("kw", "as")
+            self.expect("op", "(")
+            inner = self.with_prefix() if self.at_kw("with") else {}
+            q = self.select_or_union()
+            self.expect("op", ")")
+            q = _substitute_ctes(q, {**ctes, **inner})
+            if colnames:
+                _apply_cte_column_aliases(q, colnames, name)
+            ctes[name] = q
+            if not self.accept("op", ","):
+                break
+        return ctes
 
     # ---- SELECT --------------------------------------------------------
     def select_or_union(self) -> A.ANode:
@@ -316,7 +364,7 @@ class Parser:
     def table_ref(self) -> A.TableRef:
         left = self.table_primary()
         while True:
-            if self.at_kw("join", "inner", "left", "cross", "right"):
+            if self.at_kw("join", "inner", "left", "cross", "right", "full"):
                 kind = "inner"
                 if self.accept("kw", "left"):
                     self.accept("kw", "outer")
@@ -324,6 +372,9 @@ class Parser:
                 elif self.accept("kw", "right"):
                     self.accept("kw", "outer")
                     kind = "right"
+                elif self.accept("kw", "full"):
+                    self.accept("kw", "outer")
+                    kind = "full"
                 elif self.accept("kw", "cross"):
                     kind = "cross"
                 else:
@@ -343,7 +394,11 @@ class Parser:
 
     def table_primary(self) -> A.TableRef:
         if self.accept("op", "("):
-            q = self.select_stmt()
+            if self.at_kw("with"):
+                ctes = self.with_prefix()
+                q = _substitute_ctes(self.select_or_union(), ctes)
+            else:
+                q = self.select_stmt()
             self.expect("op", ")")
             self.accept("kw", "as")
             alias = self.expect("name")[1]
@@ -442,7 +497,7 @@ class Parser:
         e = self.mul_expr()
         while True:
             t = self.peek()
-            if t[0] == "op" and t[1] in ("+", "-"):
+            if t[0] == "op" and t[1] in ("+", "-", "||"):
                 self.next()
                 e = A.Bin(t[1], e, self.mul_expr())
             else:
@@ -497,6 +552,30 @@ class Parser:
         if self.at_kw("false"):
             self.next()
             return A.Bool(False)
+        if self.at_kw("left", "right") and self.peek(1) == ("op", "("):
+            # left()/right() are reserved words (join syntax) but also
+            # string functions when followed by an argument list
+            name = self.next()[1]
+            self.expect("op", "(")
+            args = [self.expr()]
+            while self.accept("op", ","):
+                args.append(self.expr())
+            self.expect("op", ")")
+            return A.FuncCall(name, args)
+        if self.at_kw("substring"):
+            # SUBSTRING(x FROM a [FOR b]) and SUBSTRING(x, a[, b])
+            self.next()
+            self.expect("op", "(")
+            args = [self.expr()]
+            if self.accept("kw", "from"):
+                args.append(self.expr())
+                if self.accept("kw", "for"):
+                    args.append(self.expr())
+            else:
+                while self.accept("op", ","):
+                    args.append(self.expr())
+            self.expect("op", ")")
+            return A.FuncCall("substring", args)
         if self.at_kw("date"):
             self.next()
             return A.DateLit(self.expect("str")[1])
@@ -884,6 +963,52 @@ class Parser:
                     break
             self.expect("op", ")")
         return A.CopyStmt(table, path, options)
+
+
+def _substitute_ctes(node, ctes: dict):
+    """Replace BaseTable references to CTE names with inlined SubqueryRefs.
+
+    Generic dataclass walk over the AST; each reference gets its own deep
+    copy of the CTE body (plans are mutated during binding).
+    """
+    if not ctes:
+        return node
+
+    def walk_val(v):
+        if isinstance(v, A.BaseTable):
+            q = ctes.get(v.name)
+            if q is not None:
+                return A.SubqueryRef(copy.deepcopy(q), v.alias or v.name)
+            return v
+        if isinstance(v, A.ANode):
+            for f in dataclasses.fields(v):
+                setattr(v, f.name, walk_val(getattr(v, f.name)))
+            return v
+        if isinstance(v, list):
+            return [walk_val(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(walk_val(x) for x in v)
+        return v
+
+    return walk_val(node)
+
+
+def _apply_cte_column_aliases(q, colnames: list, cte: str) -> None:
+    """`WITH c(a, b) AS (...)`: rename the query's output columns."""
+    target = q
+    while isinstance(target, A.UnionStmt):
+        # union output names come from the first branch (PG semantics)
+        target = target.selects[0]
+    items = target.items
+    if any(isinstance(i.expr, A.Star) for i in items):
+        raise SqlError(
+            f'cannot apply column aliases to "{cte}": SELECT * in CTE body')
+    if len(items) != len(colnames):
+        raise SqlError(
+            f'CTE "{cte}" has {len(items)} columns but {len(colnames)} '
+            "aliases were given")
+    for item, name in zip(items, colnames):
+        item.alias = name
 
 
 def parse(text: str) -> list[A.ANode]:

@@ -92,6 +92,11 @@ class TableStore:
         self.catalog = catalog
         self.manifest = Manifest(root)
         self._dicts: dict[tuple[str, str], Dictionary] = {}
+        # in-memory dictionaries for string-function results over
+        # dictionary columns (("@expr", sha) refs); deterministic content
+        # hash keys them so concurrent binders and multihost lockstep
+        # binding agree without persistence
+        self._derived: dict[tuple[str, str], Dictionary] = {}
         self._raw_cache: dict = {}    # (table, col, seg, version) -> RawChunk
         self._hp_cache: dict = {}     # (table, seg, name, version) -> result
 
@@ -126,6 +131,8 @@ class TableStore:
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
+        if table == "@expr":
+            return self._derived[(table, col)]
         # partition children share the PARENT's dictionary: one code space
         # per logical table, so codes compare/join across partitions
         table = table.split("#", 1)[0]
@@ -133,6 +140,18 @@ class TableStore:
         if key not in self._dicts:
             self._dicts[key] = Dictionary.load(self._dict_path(table, col))
         return self._dicts[key]
+
+    def derived_dictionary(self, values: list[str]) -> tuple[str, str]:
+        """Register (or reuse) an in-memory dictionary for a string-function
+        result; -> ("@expr", sha1) ref usable wherever a (table, col)
+        dict_ref is (hash LUTs, sort ranks, result decode)."""
+        import hashlib
+
+        h = hashlib.sha1("\x00".join(values).encode()).hexdigest()[:16]
+        ref = ("@expr", h)
+        if ref not in self._derived:
+            self._derived[ref] = Dictionary(list(values))
+        return ref
 
     def _dict_path(self, table: str, col: str) -> str:
         table = table.split("#", 1)[0]
@@ -547,6 +566,31 @@ class TableStore:
         elif op == "in":
             vals = set(payload["values"])
             out = np.fromiter((s in vals for s in strs), bool, len(strs))
+        elif op == "chain":
+            # string-function chain + comparison (utils/strfuncs semantics)
+            import operator
+
+            from greengage_tpu.utils import strfuncs
+
+            chain = payload["chain"]
+            vals = [strfuncs.apply_chain(s, chain) for s in strs]
+            cmp = payload["cmp"]
+            if cmp == "like":
+                rx = T.like_to_regex(payload["value"])
+                out = np.fromiter(
+                    (rx.fullmatch(v) is not None for v in vals),
+                    bool, len(vals))
+            elif cmp == "in":
+                targets = set(payload["value"])
+                out = np.fromiter((v in targets for v in vals),
+                                  bool, len(vals))
+            else:
+                fn = {"=": operator.eq, "<>": operator.ne,
+                      "<": operator.lt, "<=": operator.le,
+                      ">": operator.gt, ">=": operator.ge}[cmp]
+                tgt = payload["value"]
+                out = np.fromiter((fn(v, tgt) for v in vals),
+                                  bool, len(vals))
         else:
             raise ValueError(f"unknown host predicate op {op}")
         res = (out, chunk.valid)

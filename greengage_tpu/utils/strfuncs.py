@@ -1,0 +1,105 @@
+"""Host-side SQL string function semantics.
+
+One implementation shared by three lowering strategies (binder decides per
+column encoding):
+  - literal folding (all-constant arguments),
+  - dictionary LUTs (function applied once per distinct value, device does
+    an int32 gather — the TPU-native form of per-row varlena evaluation),
+  - raw-TEXT host chains (applied per row at predicate staging / result
+    decode, the fallback for high-cardinality columns).
+
+Semantics follow PostgreSQL's varlena.c / oracle_compat.c behavior for the
+common cases (1-based substring indexing, negative-start window clamping,
+strpos returning 0 when absent); reference entry points:
+src/backend/utils/adt/varlena.c (text_substr, textcat, textpos),
+src/backend/utils/adt/oracle_compat.c (upper/lower/ltrim/rtrim/lpad/rpad).
+"""
+
+from __future__ import annotations
+
+# name -> (min_args, max_args, result kind "str" | "int")
+SPECS = {
+    "upper": (1, 1, "str"),
+    "lower": (1, 1, "str"),
+    "trim": (1, 1, "str"),
+    "ltrim": (1, 2, "str"),
+    "rtrim": (1, 2, "str"),
+    "substring": (2, 3, "str"),
+    "substr": (2, 3, "str"),
+    "replace": (3, 3, "str"),
+    "left": (2, 2, "str"),
+    "right": (2, 2, "str"),
+    "lpad": (2, 3, "str"),
+    "rpad": (2, 3, "str"),
+    "concat": (1, None, "str"),   # bound from x || y; extras = (prefix, suffix)
+    "reverse": (1, 1, "str"),
+    "length": (1, 1, "int"),
+    "char_length": (1, 1, "int"),
+    "character_length": (1, 1, "int"),
+    "strpos": (2, 2, "int"),
+}
+
+
+def apply(name: str, s: str, *extra):
+    """Apply one function to one string; extra = literal arguments."""
+    if name == "upper":
+        return s.upper()
+    if name == "lower":
+        return s.lower()
+    if name == "trim":
+        return s.strip()
+    if name == "ltrim":
+        return s.lstrip(extra[0]) if extra else s.lstrip()
+    if name == "rtrim":
+        return s.rstrip(extra[0]) if extra else s.rstrip()
+    if name in ("substring", "substr"):
+        start = int(extra[0])
+        if len(extra) == 1:
+            return s[max(start - 1, 0):]
+        ln = int(extra[1])
+        if ln < 0:
+            raise ValueError("negative substring length not allowed")
+        # PG: the window is [start, start+ln); a start < 1 shortens it
+        end = start - 1 + ln
+        return s[max(start - 1, 0):max(end, 0)]
+    if name == "replace":
+        return s.replace(extra[0], extra[1])
+    if name == "left":
+        n = int(extra[0])
+        return s[:n] if n >= 0 else s[: max(len(s) + n, 0)]
+    if name == "right":
+        n = int(extra[0])
+        if n >= 0:
+            return s[len(s) - n:] if n else ""
+        return s[min(-n, len(s)):]
+    if name == "lpad":
+        n = int(extra[0])
+        fill = extra[1] if len(extra) > 1 else " "
+        if n <= len(s):
+            return s[:n]
+        pad = (fill * n)[: n - len(s)] if fill else ""
+        return pad + s
+    if name == "rpad":
+        n = int(extra[0])
+        fill = extra[1] if len(extra) > 1 else " "
+        if n <= len(s):
+            return s[:n]
+        pad = (fill * n)[: n - len(s)] if fill else ""
+        return s + pad
+    if name == "concat":
+        prefix, suffix = extra
+        return f"{prefix}{s}{suffix}"
+    if name == "reverse":
+        return s[::-1]
+    if name in ("length", "char_length", "character_length"):
+        return len(s)
+    if name == "strpos":
+        return s.find(extra[0]) + 1
+    raise ValueError(f"unknown string function {name}")
+
+
+def apply_chain(s: str, chain) -> object:
+    """Apply a sequence of [name, *extras] steps to one string."""
+    for step in chain:
+        s = apply(step[0], s, *step[1:])
+    return s

@@ -1,0 +1,270 @@
+"""CTEs (WITH), FULL OUTER JOIN, and string scalar functions.
+
+Reference parity targets: WITH binding in parse_analyze / ShareInputScan
+(src/backend/executor/nodeShareInputScan.c — here: inline expansion + XLA
+CSE), FULL hash join fill (src/backend/executor/nodeHashjoin.c HJ_FILL
+logic — here: left-join ∪ anti-join union rewrite), and the varlena
+string functions (src/backend/utils/adt/varlena.c, oracle_compat.c).
+"""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.sql.parser import SqlError
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table t (a int, b int) distributed by (a)")
+    d.sql("insert into t values (1, 10), (2, 20), (3, 30), (4, 40)")
+    d.sql("create table s (a int, c int) distributed by (a)")
+    d.sql("insert into s values (3, 300), (4, 400), (5, 500), (6, 600)")
+    d.sql("create table w (k int, tag text) distributed by (k)")
+    d.sql("insert into w values (1, 'alpha'), (2, 'Beta'), (3, 'GAMMA q'), "
+          "(4, 'alpha')")
+    return d
+
+
+@pytest.fixture(scope="module")
+def rawdb(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table r (a int, c text) distributed by (a)")
+    col = d.catalog.get("r").column("c")
+    object.__setattr__(col, "encoding", "raw")
+    d.load_table("r", {
+        "a": np.array([1, 2, 3], np.int32),
+        "c": np.array(["Hello World", "bye", "  pad  "], dtype=object),
+    })
+    return d
+
+
+# ---------------------------------------------------------------------------
+# WITH
+# ---------------------------------------------------------------------------
+
+def test_cte_basic(db):
+    r = db.sql("with big as (select a, b from t where b > 15) "
+               "select a, b from big order by a")
+    assert r.rows() == [(2, 20), (3, 30), (4, 40)]
+
+
+def test_cte_referenced_twice(db):
+    r = db.sql("with c as (select a, b from t) "
+               "select x.a, y.b from c x join c y on x.a = y.a order by x.a")
+    assert r.rows() == [(1, 10), (2, 20), (3, 30), (4, 40)]
+
+
+def test_cte_column_aliases(db):
+    r = db.sql("with c(k, v) as (select a, b from t) "
+               "select k, v from c where v >= 30 order by k")
+    assert r.rows() == [(3, 30), (4, 40)]
+
+
+def test_cte_chained(db):
+    r = db.sql("with c1 as (select a, b from t), "
+               "c2 as (select a from c1 where b > 25) "
+               "select a from c2 order by a")
+    assert r.rows() == [(3,), (4,)]
+
+
+def test_cte_with_aggregate_body(db):
+    r = db.sql("with totals as (select a, sum(b) as sb from t group by a) "
+               "select count(*), sum(sb) from totals")
+    assert r.rows() == [(4, 100)]
+
+
+def test_cte_in_derived_table(db):
+    r = db.sql("select q.a from "
+               "(with c as (select a from t where a > 2) "
+               "select a from c) q order by a")
+    assert r.rows() == [(3,), (4,)]
+
+
+def test_cte_shadows_table(db):
+    # CTE name takes precedence over a catalog table of the same name
+    r = db.sql("with s as (select a from t where a = 1) select a from s")
+    assert r.rows() == [(1,)]
+
+
+def test_cte_recursive_rejected(db):
+    with pytest.raises(SqlError, match="RECURSIVE"):
+        db.sql("with recursive c as (select 1) select * from c")
+
+
+def test_cte_union_body(db):
+    r = db.sql("with c as (select a from t where a <= 1 union all "
+               "select a from t where a >= 4) select a from c order by a")
+    assert r.rows() == [(1,), (4,)]
+
+
+# ---------------------------------------------------------------------------
+# FULL OUTER JOIN
+# ---------------------------------------------------------------------------
+
+def test_full_join_rows(db):
+    r = db.sql("select t.a, t.b, s.c from t full join s on t.a = s.a "
+               "order by t.a nulls last, s.c")
+    assert r.rows() == [
+        (1, 10, None), (2, 20, None), (3, 30, 300), (4, 40, 400),
+        (None, None, 500), (None, None, 600)]
+
+
+def test_full_join_counts(db):
+    r = db.sql("select count(*), count(t.b), count(s.c) "
+               "from t full outer join s on t.a = s.a")
+    assert r.rows() == [(6, 4, 4)]
+
+
+def test_full_join_where(db):
+    # WHERE after the join filters null-extended rows like PG
+    r = db.sql("select t.a, s.c from t full join s on t.a = s.a "
+               "where s.c is null order by t.a")
+    assert r.rows() == [(1, None), (2, None)]
+
+
+def test_full_join_aggregate_grouped(db):
+    r = db.sql("select s.a, count(t.a) from t full join s on t.a = s.a "
+               "group by s.a order by s.a nulls first")
+    assert r.rows() == [(None, 2), (3, 1), (4, 1), (5, 0), (6, 0)]
+
+
+def test_full_join_non_equi_rejected(db):
+    with pytest.raises(SqlError, match="equality"):
+        db.sql("select * from t full join s on t.a = s.a and t.b > s.c")
+
+
+# ---------------------------------------------------------------------------
+# string functions: dictionary columns
+# ---------------------------------------------------------------------------
+
+def test_upper_lower_projection(db):
+    r = db.sql("select k, upper(tag), lower(tag) from w order by k")
+    assert r.rows() == [
+        (1, "ALPHA", "alpha"), (2, "BETA", "beta"),
+        (3, "GAMMA Q", "gamma q"), (4, "ALPHA", "alpha")]
+
+
+def test_length_substring(db):
+    r = db.sql("select k, length(tag), substring(tag, 2, 3) from w "
+               "order by k")
+    assert r.rows() == [(1, 5, "lph"), (2, 4, "eta"), (3, 7, "AMM"),
+                        (4, 5, "lph")]
+
+
+def test_substring_from_for_syntax(db):
+    r = db.sql("select k from w where substring(tag from 1 for 1) = 'a' "
+               "order by k")
+    assert r.rows() == [(1,), (4,)]
+
+
+def test_concat_operator(db):
+    r = db.sql("select k, 'x-' || tag || '!' from w order by k limit 2")
+    assert r.rows() == [(1, "x-alpha!"), (2, "x-Beta!")]
+
+
+def test_group_by_string_function(db):
+    r = db.sql("select upper(tag) as u, count(*) from w group by upper(tag) "
+               "order by u")
+    assert r.rows() == [("ALPHA", 2), ("BETA", 1), ("GAMMA Q", 1)]
+
+
+def test_where_on_function_result(db):
+    assert db.sql("select k from w where upper(tag) = 'ALPHA' "
+                  "order by k").rows() == [(1,), (4,)]
+    assert db.sql("select k from w where length(tag) > 5").rows() == [(3,)]
+
+
+def test_function_like(db):
+    r = db.sql("select k from w where lower(tag) like '%a%q' order by k")
+    assert r.rows() == [(3,)]
+
+
+def test_nested_functions(db):
+    r = db.sql("select k, upper(substring(trim(tag), 1, 2)) from w "
+               "order by k limit 2")
+    assert r.rows() == [(1, "AL"), (2, "BE")]
+
+
+def test_replace_trim_pad(db):
+    r = db.sql("select replace(tag, 'a', 'o'), lpad(tag, 7, '.') from w "
+               "where k = 1")
+    assert r.rows() == [("olpho", "..alpha")]
+
+
+def test_literal_folding(db):
+    r = db.sql("select k from w where 'FOO' = upper('foo') and k = 1")
+    assert r.rows() == [(1,)]
+
+
+def test_strpos(db):
+    assert db.sql("select k from w where strpos(tag, 'q') > 0").rows() \
+        == [(3,)]
+
+
+def test_order_by_string_function(db):
+    r = db.sql("select k from w order by lower(tag) desc, k")
+    assert [x[0] for x in r.rows()] == [3, 2, 1, 4]
+
+
+# ---------------------------------------------------------------------------
+# string functions: raw-encoded columns (host chains)
+# ---------------------------------------------------------------------------
+
+def test_raw_projection_chain(rawdb):
+    r = rawdb.sql("select a, upper(c) from r order by a")
+    assert r.rows() == [(1, "HELLO WORLD"), (2, "BYE"), (3, "  PAD  ")]
+
+
+def test_raw_predicate_chains(rawdb):
+    assert rawdb.sql("select a from r where length(c) > 5 "
+                     "order by a").rows() == [(1,), (3,)]
+    assert rawdb.sql("select a from r where upper(c) like 'HELLO%'").rows() \
+        == [(1,)]
+    assert rawdb.sql("select a from r where substring(c, 1, 1) in ('H', 'b') "
+                     "order by a").rows() == [(1,), (2,)]
+    assert rawdb.sql("select a from r where 3 = length(c)").rows() == [(2,)]
+
+
+def test_raw_concat_projection(rawdb):
+    r = rawdb.sql("select a, trim(c) || '.' from r order by a")
+    assert r.rows() == [(1, "Hello World."), (2, "bye."), (3, "pad.")]
+
+
+def test_raw_numeric_projection_rejected(rawdb):
+    with pytest.raises(SqlError, match="WHERE"):
+        rawdb.sql("select length(c) from r")
+
+
+def test_raw_group_by_function_rejected(rawdb):
+    with pytest.raises(SqlError):
+        rawdb.sql("select upper(c), count(*) from r group by upper(c)")
+
+
+def test_left_right_functions(db):
+    r = db.sql("select left(tag, 2), right(tag, 2) from w where k = 1")
+    assert r.rows() == [("al", "ha")]
+
+
+def test_raw_chain_in_arithmetic_rejected(rawdb):
+    # surrogate must never leak into device arithmetic
+    with pytest.raises(SqlError, match="arithmetic"):
+        rawdb.sql("select a from r where length(c) + 0 = 11")
+    with pytest.raises(SqlError):
+        rawdb.sql("select a, sum(length(c)) from r group by a")
+
+
+def test_raw_chain_through_subquery(rawdb):
+    r = rawdb.sql("select u from (select a, upper(c) as u from r) q "
+                  "order by a")
+    assert [x[0] for x in r.rows()] == ["HELLO WORLD", "BYE", "  PAD  "]
+    r = rawdb.sql("select * from (select a, trim(c) as v from r) q "
+                  "order by a")
+    assert [x[1] for x in r.rows()] == ["Hello World", "bye", "pad"]
+
+
+def test_raw_chain_decimal_compare(rawdb):
+    r = rawdb.sql("select a from r where length(c) > 2.5 order by a")
+    assert r.rows() == [(1,), (2,), (3,)]
+    assert rawdb.sql("select a from r where length(c) < 3.5").rows() == [(2,)]
