@@ -25,8 +25,10 @@ class Settings:
     # motion (gp_interconnect_queue_depth analog)
     motion_capacity_slack: float = 1.6  # per-destination bucket headroom
     motion_retry_tiers: int = 3         # capacity x4 per retry on overflow
-    # execution
-    optimizer: bool = True              # motion-aware planner on/off (GUC 'optimizer')
+    # planner selection (the GUC 'optimizer' analog): on = Cascades-lite
+    # memo search (planner/memo.py, the ORCA engine analog); off = the
+    # left-deep Selinger DP / greedy order in the binder
+    optimizer: bool = True
     explain_verbose: bool = False
     # memory protection (gp_vmem_protect_limit analog): estimated device
     # bytes a single query may allocate; 0 disables the check

@@ -366,6 +366,8 @@ class Database:
                 # wake blocked waiters: a lowered/disabled cap must admit
                 # them now, not at their timeout
                 self.resgroups.kick()
+            if stmt.name == "optimizer":
+                self._select_cache.clear()   # planner selection changed
             return "SET"
         if isinstance(stmt, A.ResourceGroupStmt):
             return self._resource_group(stmt)
@@ -439,12 +441,15 @@ class Database:
             self.catalog._save()
 
     # ------------------------------------------------------------------
-    def _plan(self, stmt, force_multi_join: bool = False):
+    def _plan(self, stmt, force_multi_join: bool = False, info: dict | None = None):
         binder = Binder(self.catalog, self.store,
-                        subquery_executor=self._scalar_subquery)
+                        subquery_executor=self._scalar_subquery,
+                        optimizer=self.settings.optimizer)
         logical, outs = binder.bind_select(stmt)
         planned = plan_query(logical, self.catalog, self.store, self.numsegments,
                              force_multi_join=force_multi_join)
+        if info is not None:
+            info["memo_used"] = binder.memo_used
         return planned, binder.consts, outs
 
     def _scalar_subquery(self, stmt):
@@ -681,8 +686,13 @@ class Database:
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, (A.SelectStmt, A.UnionStmt)):
             raise SqlError("EXPLAIN supports SELECT only")
-        planned, consts, outs = self._plan(stmt.query)
-        text = describe(planned)
+        info: dict = {}
+        planned, consts, outs = self._plan(stmt.query, info=info)
+        # report the planner that actually produced the join order (the
+        # memo bails without stats / on >10 rels / explicit JOIN syntax)
+        text = ("Optimizer: %s\n" % (
+            "memo (Cascades-lite)" if info.get("memo_used")
+            else "fallback (left-deep DP/greedy)")) + describe(planned)
         if stmt.analyze:
             aux, dirty = self._load_external_aux(planned)
             if dirty:

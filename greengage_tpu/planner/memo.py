@@ -1,0 +1,214 @@
+"""Cascades-lite memo optimizer — the ORCA analog.
+
+Reference parity: the GPORCA stack (src/backend/gporca): CMemo groups +
+exploration/implementation rules (libgpopt/src/engine/CEngine.cpp:1678),
+distribution-property enforcement (CDistributionSpecHashed), and the
+statistics calculus feeding costs (libnaucrates/src/statistics/). The
+redesign collapses that machinery to the part that changes plans on a
+TPU mesh: **global join-order search over bushy trees with
+distribution-property-aware dynamic programming**, costed in bytes
+moved over ICI (planner/cost.py's model) — motion is the dominant cost
+a join order can change on this architecture.
+
+Shape of the search (DPccp-flavored over the equi-edge graph):
+
+  group  = bitmask of base relations (the CMemo group analog)
+  state  = {distribution property -> cheapest (cost, tree, rows)}
+           where a property is the tuple of column ids the result is
+           hash-distributed on, or "repl" for replicated inputs
+  expand = for each connected (subgraph, complement) split joined by at
+           least one equi edge, try: colocated (no motion), redistribute
+           one side, redistribute both, broadcast either side —
+           exactly the cdbpath_motion_for_join menu, but costed
+           *globally* so a cheap distribution below pays off above.
+
+The winner is extracted as a nested tuple of relation indices, e.g.
+``((0, 2), (1, 3))`` — a bushy tree the binder turns into Join nodes.
+The fallback planner (optimizer=off) keeps its left-deep Selinger DP /
+greedy order; both share the same cost constants, so EXPLAIN diffs
+between the two are attributable to search scope, not cost-model drift.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from greengage_tpu.planner import cost as C
+
+MAX_RELS = 10          # same bound as the left-deep DP (CJoinOrderDP caps too)
+MAX_PROPS = 4          # distribution properties kept per group (pruning)
+
+REPL = "repl"          # property: replicated everywhere (no motion to join)
+
+
+@dataclass
+class RelInfo:
+    """One base relation (a filtered scan) entering the join search."""
+
+    rows: float
+    width: float                       # bytes/row estimate
+    dist_cols: tuple = ()              # bound col ids it is hash-placed on
+    replicated: bool = False
+
+
+@dataclass
+class EdgeInfo:
+    """All equi-join conjuncts between two relations, merged."""
+
+    a: int
+    b: int
+    pairs: list = field(default_factory=list)   # (a-side col id, b-side col id)
+    sel: float = 1.0                            # product of 1/max(ndv, ndv)
+
+
+@dataclass
+class _Alt:
+    cost: float
+    tree: object          # int leaf | (tree, tree)
+    rows: float
+    width: float
+    leaves: int           # bitmask of member relations
+
+
+def optimize(rels: list[RelInfo], edges: list[EdgeInfo], nseg: int):
+    """-> nested index tree minimizing total bytes moved + touched, or
+    None when the search doesn't apply (too many rels, disconnected
+    join graph, no edges)."""
+    n = len(rels)
+    if n < 2 or n > MAX_RELS or not edges:
+        return None
+
+    adj: dict[int, int] = {i: 0 for i in range(n)}      # idx -> neighbor mask
+    edge_by_pair: dict[tuple, EdgeInfo] = {}
+    for e in edges:
+        adj[e.a] |= 1 << e.b
+        adj[e.b] |= 1 << e.a
+        edge_by_pair[(min(e.a, e.b), max(e.a, e.b))] = e
+
+    full = (1 << n) - 1
+
+    def connected(mask: int) -> bool:
+        first = mask & -mask
+        seen = first
+        frontier = first
+        while frontier:
+            nxt = 0
+            m = frontier
+            while m:
+                i = (m & -m).bit_length() - 1
+                m &= m - 1
+                nxt |= adj[i] & mask & ~seen
+            seen |= nxt
+            frontier = nxt
+        return seen == mask
+
+    if not connected(full):
+        # cross-product components: let the fallback handle them
+        return None
+
+    def members(mask: int):
+        m = mask
+        while m:
+            i = (m & -m).bit_length() - 1
+            m &= m - 1
+            yield i
+
+    # memo: mask -> {prop: _Alt}
+    memo: dict[int, dict] = {}
+    for i, r in enumerate(rels):
+        prop = REPL if r.replicated else tuple(r.dist_cols)
+        memo[1 << i] = {prop: _Alt(0.0, i, max(r.rows, 1.0), r.width, 1 << i)}
+
+    for mask in range(3, full + 1):
+        if mask.bit_count() < 2 or (mask & full) != mask or not connected(mask):
+            continue
+        state: dict = {}
+        low = mask & -mask
+        sub = (mask - 1) & mask
+        while sub:
+            other = mask ^ sub
+            if (sub & low) and other:
+                s1 = memo.get(sub)
+                s2 = memo.get(other)
+                if s1 and s2:
+                    xe = _cross_edges(sub, other, members, edge_by_pair)
+                    if xe:
+                        _expand(state, s1, s2, sub, xe, nseg)
+            sub = (sub - 1) & mask
+        if state:
+            best = sorted(state.items(), key=lambda kv: kv[1].cost)
+            memo[mask] = dict(best[:MAX_PROPS])
+
+    final = memo.get(full)
+    if not final:
+        return None
+    return min(final.values(), key=lambda a: a.cost).tree
+
+
+def _cross_edges(m1: int, m2: int, members, edge_by_pair):
+    out = []
+    for i in members(m1):
+        for j in members(m2):
+            e = edge_by_pair.get((min(i, j), max(i, j)))
+            if e is not None:
+                out.append(e)
+    return out
+
+
+def _join_options(p1, a1: _Alt, p2, a2: _Alt, k1, k2, pairmap, nseg: int):
+    """Yield (extra motion cost, output distribution prop) for joining
+    sides with properties p1/p2 over aligned key col-id lists k1/k2 —
+    the cdbpath_motion_for_join decision menu."""
+    r1, w1, r2, w2 = a1.rows, a1.width, a2.rows, a2.width
+    if p1 == REPL:
+        yield 0.0, (p2 if p2 != REPL else ())
+        return
+    if p2 == REPL:
+        yield 0.0, p1
+        return
+    k1set, k2set = set(k1), set(k2)
+    colocated = (p1 and len(p1) == len(p2)
+                 and all(c in k1set for c in p1)
+                 and tuple(pairmap.get(c) for c in p1) == tuple(p2))
+    if colocated:
+        yield 0.0, p1
+        return
+    if p1 and all(c in k1set for c in p1):
+        # move side 2 to match side 1's existing distribution
+        yield C.motion_cost("redistribute", r2, w2, nseg), p1
+    if p2 and all(c in k2set for c in p2):
+        yield C.motion_cost("redistribute", r1, w1, nseg), p2
+    yield (C.motion_cost("redistribute", r1, w1, nseg)
+           + C.motion_cost("redistribute", r2, w2, nseg)), tuple(k1)
+    yield C.motion_cost("broadcast", r2, w2, nseg), p1
+    yield C.motion_cost("broadcast", r1, w1, nseg), p2
+
+
+def _expand(state: dict, s1: dict, s2: dict, mask1: int, xe, nseg: int) -> None:
+    """Add all physical alternatives for joining group s1 x s2 across
+    edges xe into ``state``."""
+    pairs = []
+    sel = 1.0
+    for e in xe:
+        sel *= e.sel
+        if (1 << e.a) & mask1:
+            pairs.extend(e.pairs)
+        else:
+            pairs.extend((b, a) for a, b in e.pairs)
+    k1 = [a for a, _ in pairs]
+    k2 = [b for _, b in pairs]
+    pairmap = dict(pairs)
+
+    for p1, a1 in s1.items():
+        for p2, a2 in s2.items():
+            rows = max(a1.rows * a2.rows * sel, 1.0)
+            width = a1.width + a2.width
+            # local compute: one HBM pass over both inputs + the output
+            local = a1.rows * a1.width + a2.rows * a2.width + rows * width
+            for extra, prop in _join_options(p1, a1, p2, a2, k1, k2,
+                                             pairmap, nseg):
+                cost = a1.cost + a2.cost + local + extra
+                cur = state.get(prop)
+                if cur is None or cost < cur.cost:
+                    state[prop] = _Alt(cost, (a1.tree, a2.tree), rows, width,
+                                       a1.leaves | a2.leaves)

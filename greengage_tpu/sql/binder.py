@@ -17,6 +17,7 @@ import numpy as np
 
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
+from greengage_tpu.catalog import PolicyKind
 from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Plan, Project, Scan, Sort,
 )
@@ -87,7 +88,8 @@ class Scope:
 
 
 class Binder:
-    def __init__(self, catalog, store, subquery_executor=None):
+    def __init__(self, catalog, store, subquery_executor=None,
+                 optimizer: bool = True):
         self.catalog = catalog
         self.store = store
         self._uid = itertools.count()
@@ -96,6 +98,11 @@ class Binder:
         # callable(SelectStmt) -> (python scalar | None, SqlType): runs an
         # uncorrelated scalar subquery at bind time (InitPlan analog)
         self.subquery_executor = subquery_executor
+        # GUC 'optimizer' (the planner-selection analog): True routes
+        # multi-relation FROMs through the Cascades-lite memo search
+        # (planner/memo.py); False keeps the left-deep DP/greedy order
+        self.optimizer = optimizer
+        self.memo_used = False    # set when the memo produced a join tree
 
     def new_id(self, hint: str) -> str:
         return f"{hint}#{next(self._uid)}"
@@ -202,7 +209,10 @@ class Binder:
                             e = _colref(out_ci)
                         else:
                             ci = ColInfo(self.new_id("ord"), hit.type, "?order?",
-                                         hit.dict_ref, hidden=True)
+                                         hit.dict_ref, hidden=True,
+                                         raw_ref=hit.raw_ref,
+                                         raw_chain=getattr(hit, "raw_chain",
+                                                           None))
                             sel_exprs.append((ci, _colref(hit)))
                             e = _colref(ci)
                 if e is None:
@@ -213,7 +223,9 @@ class Binder:
                             raise
                         e = self._expr(oi.expr, scope)
                         ci = ColInfo(self.new_id("ord"), e.type, "?order?",
-                                     _dict_ref_of(e), hidden=True)
+                                     _dict_ref_of(e), hidden=True,
+                                     raw_ref=_raw_ref_of(e),
+                                     raw_chain=_raw_chain_of(e))
                         sel_exprs.append((ci, e))
                         e = _colref(ci)
                 order_keys.append((self._no_raw(e, "sort key"),
@@ -628,6 +640,20 @@ class Binder:
         # keep SELECT * / scope resolution in FROM-clause order regardless
         # of the join order the optimizer picks
         orig_scopes = [sc for _, sc in remaining]
+
+        if self.optimizer:
+            # Cascades-lite memo: bushy trees + distribution-property DP
+            tree = self._memo_join_tree(remaining, conds)
+            if tree is not None:
+                self.memo_used = True
+                plan, scope, conds = self._build_join_tree(
+                    tree, remaining, conds)
+                leftover = _join_and(conds)
+                out_scope = Scope()
+                for sc in orig_scopes:
+                    out_scope = out_scope.merged(sc)
+                return plan, out_scope, leftover
+
         order = self._dp_join_order(remaining, conds)
         if order is not None:
             remaining = [remaining[i] for i in order]
@@ -658,6 +684,84 @@ class Binder:
         for sc in orig_scopes:
             out_scope = out_scope.merged(sc)
         return plan, out_scope, leftover
+
+    # ------------------------------------------------------------------
+    # memo search (the ORCA engine entry; planner/memo.py)
+    # ------------------------------------------------------------------
+    def _memo_join_tree(self, items, conds):
+        """-> nested index tree from the Cascades-lite memo, or None when
+        it doesn't apply (missing stats, edge cols without NDV, too many
+        or disconnected relations — the fallback DP/greedy takes over)."""
+        from greengage_tpu.planner import cost as C
+        from greengage_tpu.planner import memo as M
+
+        rels = []
+        col_stats = []
+        for plan, scope in items:
+            info = self._rel_card(plan)
+            if info is None:
+                return None
+            rows, stats = info
+            node = plan
+            while isinstance(node, Filter):
+                node = node.child
+            schema = self.catalog.get(node.table)
+            pol = schema.policy
+            dist: tuple = ()
+            replicated = False
+            if pol.kind is PolicyKind.HASH:
+                by_name = {c.name: c.id for c in node.cols}
+                if all(k in by_name for k in pol.keys):
+                    dist = tuple(by_name[k] for k in pol.keys)
+            elif pol.kind is PolicyKind.REPLICATED:
+                replicated = True
+            rels.append(M.RelInfo(rows, C.row_width(plan.out_cols()),
+                                  dist, replicated))
+            col_stats.append(stats)
+
+        edges: dict[tuple, M.EdgeInfo] = {}
+        for c in conds:
+            hit = self._edge_of(c, items)
+            if hit is None:
+                continue
+            i, j, li, ri = hit
+            si, sj = col_stats[i].get(li), col_stats[j].get(ri)
+            if si is None or sj is None or si.ndv <= 0 or sj.ndv <= 0:
+                return None
+            key = (min(i, j), max(i, j))
+            e = edges.get(key)
+            if e is None:
+                e = edges[key] = M.EdgeInfo(key[0], key[1])
+            pair = (li, ri) if i == key[0] else (ri, li)
+            e.pairs.append(pair)
+            e.sel /= max(si.ndv, sj.ndv)
+        if not edges:
+            return None
+        nseg = self.catalog.segments.numsegments
+        return M.optimize(rels, list(edges.values()), nseg)
+
+    def _build_join_tree(self, tree, items, conds):
+        """Materialize the memo's nested index tree into Join nodes,
+        consuming the equi conjuncts that each join edge uses."""
+        conds = list(conds)
+
+        def rec(t):
+            nonlocal conds
+            if not isinstance(t, tuple):
+                return items[t]
+            lp, ls = rec(t[0])
+            rp, rs = rec(t[1])
+            eq, conds = _extract_equi(conds, ls, rs)
+            merged = ls.merged(rs)
+            if not eq:
+                return Join("cross", lp, rp, [], []), merged
+            lkeys = [self._expr(l, ls) for l, _ in eq]
+            rkeys = [self._expr(r, rs) for _, r in eq]
+            lkeys, rkeys = self._align_join_keys(lkeys, rkeys)
+            return Join("inner", lp, rp, lkeys, rkeys), merged
+
+        plan, scope = rec(tree)
+        return plan, scope, conds
 
     # ------------------------------------------------------------------
     # DP join ordering (System R over left-deep trees)
@@ -1161,7 +1265,10 @@ class Binder:
         return scope, sel_exprs
 
     def _no_rawchain(self, e: E.Expr, what: str) -> E.Expr:
-        if isinstance(e, E.RawChain):
+        # chain carriers are RawChain nodes OR ColRefs whose subquery
+        # projection attached a chain (the surrogate decodes only at
+        # finalize, so any value-consuming context would see garbage)
+        if isinstance(e, E.RawChain) or _raw_chain_of(e) is not None:
             raise SqlError(
                 f"string functions of raw-encoded text cannot be used in "
                 f"{what} (supported: WHERE comparisons, output columns)")
@@ -1398,6 +1505,9 @@ class Binder:
             lits.append(a.value)
         if subject.type.kind is not T.Kind.TEXT:
             raise SqlError(f"{name}() requires a text argument")
+        if name in ("substring", "substr") and len(lits) == 2 \
+                and isinstance(lits[1], (int, float)) and lits[1] < 0:
+            raise SqlError("negative substring length not allowed")
         return self._lower_str_step(subject, (name, *lits), kind)
 
     def _bind_concat(self, ast: A.Bin, scope) -> E.Expr:
@@ -1448,7 +1558,10 @@ class Binder:
         if isinstance(subject, E.Literal):
             if subject.value is None:
                 return E.Literal(None, T.TEXT if kind == "str" else T.INT32)
-            v = strfuncs.apply(step[0], subject.value, *step[1:])
+            try:
+                v = strfuncs.apply(step[0], subject.value, *step[1:])
+            except (ValueError, TypeError) as ex:
+                raise SqlError(f"{step[0]}(): {ex}")
             return (E.Literal(v, T.TEXT) if kind == "str"
                     else E.Literal(int(v), T.INT32))
         if isinstance(subject, E.RawChain) or _raw_ref_of(subject) is not None:
@@ -1463,7 +1576,11 @@ class Binder:
             raise SqlError(
                 f"{step[0]}() requires a text column or string literal")
         dic = self.store.dictionary(*d)
-        outs = [strfuncs.apply(step[0], v, *step[1:]) for v in dic.values]
+        try:
+            outs = [strfuncs.apply(step[0], v, *step[1:])
+                    for v in dic.values]
+        except (ValueError, TypeError) as ex:
+            raise SqlError(f"{step[0]}(): {ex}")
         if kind == "int":
             lut = np.array(list(outs) + [0], dtype=np.int32)
             return E.Lut(subject, self._const(lut), type=T.INT32)

@@ -978,7 +978,12 @@ def _substitute_ctes(node, ctes: dict):
         if isinstance(v, A.BaseTable):
             q = ctes.get(v.name)
             if q is not None:
-                return A.SubqueryRef(copy.deepcopy(q), v.alias or v.name)
+                # the body may itself reference OTHER ctes (a nested WITH
+                # parsed before the outer ones were known) — substitute
+                # inside the copy, excluding this name (no self-recursion)
+                rest = {k: b for k, b in ctes.items() if k != v.name}
+                body = _substitute_ctes(copy.deepcopy(q), rest)
+                return A.SubqueryRef(body, v.alias or v.name)
             return v
         if isinstance(v, A.ANode):
             for f in dataclasses.fields(v):

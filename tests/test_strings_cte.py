@@ -264,6 +264,36 @@ def test_raw_chain_through_subquery(rawdb):
     assert [x[1] for x in r.rows()] == ["Hello World", "bye", "pad"]
 
 
+def test_raw_order_by_chain_rejected(rawdb):
+    # sorting on a host-decoded chain would sort by device surrogate
+    with pytest.raises(SqlError, match="sort key"):
+        rawdb.sql("select a from r order by length(c)")
+    with pytest.raises(SqlError, match="sort key"):
+        rawdb.sql("select a from r order by upper(c)")
+
+
+def test_raw_chain_case_through_subquery_rejected(rawdb):
+    with pytest.raises(SqlError, match="CASE"):
+        rawdb.sql("select case when a > 0 then u else u end "
+                  "from (select a, upper(c) as u from r) s")
+
+
+def test_cte_nested_with_outer_reference(db):
+    r = db.sql("with a1 as (select a, b from t), "
+               "b1 as (with c1 as (select a from a1 where b > 25) "
+               "select a from c1) select a from b1 order by a")
+    assert r.rows() == [(3,), (4,)]
+
+
+def test_negative_substring_length_is_sql_error(db, rawdb):
+    with pytest.raises(SqlError, match="negative substring length"):
+        db.sql("select substring(tag, 2, -1) from w")
+    with pytest.raises(SqlError, match="negative substring length"):
+        rawdb.sql("select a from r where substring(c, 2, -1) = 'x'")
+    with pytest.raises(SqlError, match="negative substring length"):
+        db.sql("select k from w where substring('abc', 1, -2) = 'a'")
+
+
 def test_raw_chain_decimal_compare(rawdb):
     r = rawdb.sql("select a from r where length(c) > 2.5 order by a")
     assert r.rows() == [(1,), (2,), (3,)]
