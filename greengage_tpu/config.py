@@ -50,6 +50,9 @@ class Settings:
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1
+    # logging (log_statement / log_min_duration_statement analog): every
+    # statement + errors land in <cluster>/log CSV files
+    log_statement: bool = True
 
     _overrides: dict = field(default_factory=dict)
 

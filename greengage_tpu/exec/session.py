@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import csv as _csv
 import os
+import time
 from contextlib import contextmanager as _contextmanager
 
 import numpy as np
@@ -96,6 +97,13 @@ class Database:
                            if self.catalog.segments.has_mirrors() else None)
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
                              on_change=self.catalog._save)
+        from greengage_tpu.runtime.logger import ClusterLog
+
+        # elog/syslogger analog: CSV logs under <cluster>/log (mined by
+        # `gg logfilter`); workers stay quiet (the coordinator logs)
+        self.log = ClusterLog(self.path, enabled=not is_worker)
+        self.log.info("lifecycle", f"database ready: {numsegments} segments, "
+                      f"{len(devs)} devices")
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
         self._cursors: dict[str, object] = {}  # parallel retrieve cursors
         self._cursor_owner: dict[str, int] = {}  # cursor -> thread ident
@@ -143,7 +151,7 @@ class Database:
 
         for name in self.catalog.extensions:
             try:
-                X.load(name)
+                X.load(name, cluster_path=self.path)
             except ValueError as e:
                 warnings.warn(f"extension {name!r} failed to load: {e}")
 
@@ -154,8 +162,24 @@ class Database:
         if self.multihost is not None and self.multihost.is_coordinator:
             return self._coordinator_sql(text)
         out = None
-        for stmt in parse(text):
-            out = self._execute(stmt)
+        stmts = parse(text)
+        for i, stmt in enumerate(stmts):
+            # per-statement attribution even in a multi-statement batch
+            what = text.strip() if len(stmts) == 1 else \
+                f"[{i + 1}/{len(stmts)} {type(stmt).__name__}] {text.strip()}"
+            t0 = time.monotonic()
+            try:
+                out = self._execute(stmt)
+            except Exception as e:
+                if self.settings.log_statement:
+                    self.log.error("statement", f"{e} -- in: {what}",
+                                   duration_ms=(time.monotonic() - t0) * 1e3)
+                raise
+            if self.settings.log_statement:
+                self.log.info(
+                    "statement", what,
+                    duration_ms=(time.monotonic() - t0) * 1e3,
+                    rows=(len(out) if hasattr(out, "columns") else None))
         return out
 
     # ---- multi-host statement protocol (parallel/multihost.py) ---------
@@ -394,7 +418,7 @@ class Database:
             if stmt.if_not_exists:
                 return "CREATE EXTENSION"
             raise ValueError(f'extension "{stmt.name}" already exists')
-        X.load(stmt.name)
+        X.load(stmt.name, cluster_path=self.path)
         self.catalog.extensions.append(stmt.name)
         self.catalog._save()
         return "CREATE EXTENSION"
@@ -451,6 +475,73 @@ class Database:
         if info is not None:
             info["memo_used"] = binder.memo_used
         return planned, binder.consts, outs
+
+    def _const_select(self, stmt: A.SelectStmt) -> Result:
+        """FROM-less SELECT: one constant row evaluated on the host (the
+        coordinator-only Result node analog — no dispatch, no mesh;
+        reference: SELECT without FROM planning to a Result plan in
+        src/backend/optimizer/plan/planner.c)."""
+        from greengage_tpu.sql.binder import Binder, Scope, _ast_name
+
+        if stmt.group_by or stmt.having or stmt.distinct or stmt.order_by:
+            raise SqlError(
+                "SELECT without FROM supports only a constant target list")
+        import jax.numpy as jnp
+
+        from greengage_tpu.ops.batch import Batch
+        from greengage_tpu.ops.expr_eval import Evaluator
+
+        binder = Binder(self.catalog, self.store,
+                        subquery_executor=self._scalar_subquery)
+        scope = Scope()
+        one_row = Batch({"__one__": jnp.zeros((1,), jnp.int32)}, {}, None)
+        where_false = False
+        if stmt.where is not None:
+            pred = binder._predicate(stmt.where, scope)
+            keep = Evaluator(one_row, binder.consts).predicate(pred)
+            where_false = not bool(np.asarray(keep)[0])
+        cols, valids, names, order = {}, {}, [], []
+        for i, it in enumerate(stmt.items):
+            if isinstance(it.expr, A.Star):
+                raise SqlError("SELECT * requires FROM")
+            e = binder._expr(it.expr, scope)
+            name = it.alias or _ast_name(it.expr)
+            cid = f"c#{i}"
+            t = e.type
+            if isinstance(e, E.Literal) and e.value is None:
+                val, valid_np = np.array([None], dtype=object), \
+                    np.array([False])
+            elif t.kind is T.Kind.TEXT:
+                if not isinstance(e, E.Literal):
+                    raise SqlError("SELECT without FROM supports only "
+                                   "constant text expressions")
+                val, valid_np = np.array([e.value], dtype=object), None
+            else:
+                ev = Evaluator(one_row, binder.consts)
+                arr, valid = ev.value(e)
+                arr = np.asarray(arr)
+                valid_np = (None if valid is None
+                            else np.asarray(valid).astype(bool))
+                if valid_np is not None and not valid_np[0]:
+                    val = np.array([None], dtype=object)
+                elif t.kind is T.Kind.DECIMAL:
+                    val, valid_np = arr / (10.0 ** t.scale), None
+                elif t.kind is T.Kind.DATE:
+                    val = (np.datetime64("1970-01-01", "D")
+                           + arr.astype("timedelta64[D]"))
+                    valid_np = None
+                else:
+                    val, valid_np = arr, None
+            cols[cid] = val
+            valids[cid] = valid_np
+            names.append(name)
+            order.append(cid)
+        limit = stmt.limit if stmt.limit is not None else 1
+        if limit == 0 or stmt.offset or where_false:
+            cols = {k: v[:0] for k, v in cols.items()}
+            valids = {k: (None if v is None else v[:0])
+                      for k, v in valids.items()}
+        return Result(columns=names, cols=cols, valids=valids, _order=order)
 
     def _scalar_subquery(self, stmt):
         """Run an uncorrelated scalar subquery at bind time (InitPlan
@@ -636,6 +727,8 @@ class Database:
         return cached
 
     def _select(self, stmt: A.SelectStmt) -> Result:
+        if isinstance(stmt, A.SelectStmt) and not stmt.from_:
+            return self._const_select(stmt)
         planned, consts, outs, exec_key = self._cached_plan(stmt)
         # external tables materialize to host arrays before execution
         # (fileam external_beginscan role); first-seen strings grow the

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import importlib
 import importlib.util
+import os
 from dataclasses import dataclass
 from typing import Callable
 
@@ -75,14 +76,36 @@ def loaded() -> list[str]:
     return list(_LOADED)
 
 
-def load(name: str) -> None:
+def load(name: str, cluster_path: str | None = None) -> None:
     """CREATE EXTENSION body: import the module (which registers its
     functions as an import side effect). Search order: the bundled
-    contrib namespace, then any importable module of that name. A module
+    contrib namespace, the cluster's installed packages
+    (<cluster>/extensions/<name>, populated by ``gg pkg install`` — the
+    gppkg analog), then any importable module of that name. A module
     that imports but registers NOTHING is rejected — `create extension
     json` must not silently record an arbitrary stdlib module."""
+    import sys
+
+    pkg_root = (os.path.join(cluster_path, "extensions")
+                if cluster_path else None)
+    has_pkg = pkg_root and os.path.isdir(os.path.join(pkg_root, name))
     if name in _LOADED:
+        # registration is process-global; per-database VISIBILITY is
+        # enforced at bind time (catalog.extensions check). Guard the one
+        # hazard: a same-named package in a DIFFERENT cluster's extensions
+        # dir would silently reuse the first cluster's code
+        if has_pkg:
+            mod = sys.modules.get(name)
+            modfile = getattr(mod, "__file__", "") or ""
+            if mod is not None and not modfile.startswith(
+                    os.path.abspath(pkg_root) + os.sep):
+                raise ValueError(
+                    f'extension "{name}" already loaded from '
+                    f"{modfile or 'another source'} in this process; "
+                    "same-named packages from two clusters cannot coexist")
         return
+    if has_pkg and pkg_root not in sys.path:
+        sys.path.insert(0, pkg_root)
     target = None
     for modname in (f"greengage_tpu.contrib.{name}", name):
         if importlib.util.find_spec(modname) is not None:

@@ -66,7 +66,7 @@ def cmd_vacuum(args):
 
 
 def cmd_analyze(args):
-    """analyzedb analog: refresh planner statistics."""
+    """ANALYZE wrapper: refresh planner statistics."""
     db = _open(args.dir)
     db.sql(f"analyze {args.table}" if args.table else "analyze")
     names = [args.table] if args.table else sorted(db.catalog.tables)
@@ -74,6 +74,267 @@ def cmd_analyze(args):
         ts = db.catalog.get(n).stats
         if ts is not None:
             print(f"  {n}: {ts.rows} rows, {len(ts.columns)} columns analyzed")
+    return 0
+
+
+def cmd_analyzedb(args):
+    """analyzedb analog: incremental ANALYZE — only tables whose on-disk
+    data changed since their last statistics pass (manifest-entry
+    fingerprints stand in for analyzedb's mtime/state tracking)."""
+    from greengage_tpu.planner.stats import table_fingerprint
+
+    db = _open(args.dir)
+    snap = db.store.manifest.snapshot()
+    stale, fresh = [], []
+    for name in sorted(db.catalog.tables):
+        schema = db.catalog.get(name)
+        if getattr(schema, "external", None) or \
+                db._external_def(schema) is not None:
+            continue
+        ts = schema.stats
+        if (ts is None or not ts.fingerprint
+                or ts.fingerprint != table_fingerprint(snap, schema)
+                or args.full):
+            stale.append(name)
+        else:
+            fresh.append(name)
+    for name in stale:
+        db.sql(f"analyze {name}")
+        print(f"  analyzed {name}: {db.catalog.get(name).stats.rows} rows")
+    for name in fresh:
+        print(f"  skipped {name}: statistics are current")
+    db.log.info("mgmt", f"analyzedb: {len(stale)} analyzed, "
+                f"{len(fresh)} current")
+    return 0
+
+
+def cmd_checkperf(args):
+    """gpcheckperf analog: micro-benchmark the cluster's hardware paths —
+    data-dir disk bandwidth, host memory bandwidth, device HBM bandwidth,
+    and the mesh collective (ICI) path."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    mb = args.size_mb
+    buf = np.random.default_rng(0).bytes(mb << 20)
+    results = {}
+
+    # disk: write + fsync + read in the cluster's data dir
+    with tempfile.NamedTemporaryFile(dir=args.dir, suffix=".perf") as f:
+        t0 = time.monotonic()
+        f.write(buf)
+        f.flush()
+        os.fsync(f.fileno())
+        results["disk_write_MBps"] = mb / (time.monotonic() - t0)
+        f.seek(0)
+        t0 = time.monotonic()
+        while f.read(1 << 22):
+            pass
+        results["disk_read_MBps"] = mb / (time.monotonic() - t0)
+
+    # host memory bandwidth (memcpy)
+    a = np.frombuffer(buf, np.uint8)
+    t0 = time.monotonic()
+    for _ in range(4):
+        b = a.copy()
+    results["host_mem_MBps"] = 4 * mb / (time.monotonic() - t0)
+    del b
+
+    # device HBM + collective over the mesh
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.frombuffer(buf, np.float32))
+        jax.block_until_ready(x)
+        t0 = time.monotonic()
+        for _ in range(4):
+            y = jax.block_until_ready(x * 2.0)
+        # read + write per pass
+        results["device_hbm_MBps"] = 8 * mb / (time.monotonic() - t0)
+        del y
+        db = _open(args.dir)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = db.mesh
+        n = mesh.devices.size
+        shard = jax.device_put(
+            jnp.ones((n, (mb << 18) // n), jnp.float32),
+            NamedSharding(mesh, PartitionSpec("seg", None)))
+        from jax.experimental.shard_map import shard_map
+
+        f2 = jax.jit(shard_map(
+            lambda v: jax.lax.psum(v, "seg"), mesh=mesh,
+            in_specs=PartitionSpec("seg", None),
+            out_specs=PartitionSpec("seg", None)))
+        jax.block_until_ready(f2(shard))
+        t0 = time.monotonic()
+        for _ in range(4):
+            jax.block_until_ready(f2(shard))
+        results["collective_allreduce_MBps"] = 4 * mb / (time.monotonic() - t0)
+    except Exception as e:   # no device available is a report, not a crash
+        results["device_error"] = str(e)[:120]
+
+    print(f"{'path':<28} {'bandwidth':>14}")
+    for k, v in results.items():
+        if isinstance(v, float):
+            print(f"{k:<28} {v:>11.0f} MB/s")
+        else:
+            print(f"{k:<28} {v}")
+    return 0
+
+
+def cmd_load(args):
+    """gpload analog: YAML-driven bulk load. The control file maps onto
+    an external table + INSERT SELECT (exactly gpload's own strategy:
+    it generates gpfdist external tables under the covers).
+
+    YAML shape (subset of gpload's):
+        gpload:
+          input:
+            source:
+              file: [/path/part*.csv]     # or a gpfdist:// URL
+            format: csv
+            delimiter: ','
+            header: true
+            error_limit: 50
+          output:
+            table: sales
+            mode: insert | truncate
+    """
+    import yaml
+
+    with open(args.config) as f:
+        doc = yaml.safe_load(f)
+    spec = doc.get("gpload", doc)
+    inp = spec.get("input", {})
+    out = spec.get("output", {})
+    if isinstance(inp, list):   # gpload writes sections as 1-elem maps
+        inp = {k: v for d in inp for k, v in d.items()}
+    if isinstance(out, list):
+        out = {k: v for d in out for k, v in d.items()}
+    table = out.get("table")
+    if not table:
+        print("error: output.table is required", file=sys.stderr)
+        return 1
+    src = inp.get("source", {})
+    if isinstance(src, list):
+        src = {k: v for d in src for k, v in d.items()}
+    files = src.get("file") or ([src["url"]] if "url" in src else None)
+    if isinstance(files, str):
+        files = [files]
+    if not files:
+        print("error: input.source.file (or url) is required", file=sys.stderr)
+        return 1
+
+    db = _open(args.dir)
+    schema = db.catalog.get(table)
+    from greengage_tpu import types as T
+
+    def typ(c):
+        k = c.type.kind
+        return {T.Kind.INT32: "int", T.Kind.INT64: "bigint",
+                T.Kind.FLOAT64: "double precision", T.Kind.BOOL: "bool",
+                T.Kind.DATE: "date", T.Kind.TEXT: "text"}.get(
+                    k, f"decimal(18,{c.type.scale})")
+
+    cols = ", ".join(f"{c.name} {typ(c)}" for c in schema.columns)
+    ext = f"gpload_ext_{table}"
+    urls = ", ".join(
+        "'" + (u if "://" in u else "file://" + os.path.abspath(u)) + "'"
+        for u in files)
+    fmt_opts = []
+    if inp.get("delimiter"):
+        fmt_opts.append(f"delimiter '{inp['delimiter']}'")
+    if str(inp.get("header", "")).lower() in ("true", "1", "yes"):
+        fmt_opts.append("header")
+    fmt = f"format '{inp.get('format', 'csv')}'"
+    if fmt_opts:
+        fmt += " (" + " ".join(fmt_opts) + ")"
+    reject = ""
+    if inp.get("error_limit"):
+        reject = f" segment reject limit {int(inp['error_limit'])}"
+    db.sql(f"drop table if exists {ext}")
+    db.sql(f"create external table {ext} ({cols}) location ({urls}) "
+           f"{fmt}{reject}")
+    try:
+        if out.get("mode", "insert") == "truncate":
+            db.sql(f"delete from {table}")
+        db.sql(f"insert into {table} select * from {ext}")
+        n = db.sql(f"select count(*) from {table}").rows()[0][0]
+        print(f"loaded into {table}: now {n} rows")
+        db.log.info("mgmt", f"gpload into {table}: {n} rows total")
+    finally:
+        db.sql(f"drop table if exists {ext}")
+    return 0
+
+
+def cmd_pkg(args):
+    """gppkg analog: install/remove/list extension packages for a
+    cluster. A package is a directory (or .tar.gz) holding
+    ``<name>/__init__.py`` that registers scalar functions via
+    greengage_tpu.extensions.register_scalar. Installing copies it under
+    <cluster>/extensions/ and makes `CREATE EXTENSION <name>` resolve it
+    for THIS cluster only (per-database pg_proc visibility)."""
+    import shutil
+    import tarfile
+
+    ext_root = os.path.join(args.dir, "extensions")
+    if args.action in ("install", "remove") and not args.package:
+        print(f"error: gg pkg {args.action} requires a package argument",
+              file=sys.stderr)
+        return 1
+    if args.action == "list":
+        names = (sorted(os.listdir(ext_root))
+                 if os.path.isdir(ext_root) else [])
+        db = _open(args.dir)
+        created = set(getattr(db.catalog, "extensions", ()))
+        for n in names:
+            mark = " (created)" if n in created else ""
+            print(f"  {n}{mark}")
+        print(f"({len(names)} packages)")
+        return 0
+    if args.action == "remove":
+        target = os.path.join(ext_root, args.package)
+        if not os.path.isdir(target):
+            print(f"error: package {args.package!r} is not installed",
+                  file=sys.stderr)
+            return 1
+        db = _open(args.dir)
+        if args.package in getattr(db.catalog, "extensions", ()):
+            print(f"error: extension {args.package!r} is still created "
+                  "(drop it first)", file=sys.stderr)
+            return 1
+        shutil.rmtree(target)
+        print(f"removed {args.package}")
+        return 0
+    # install
+    src = args.package
+    os.makedirs(ext_root, exist_ok=True)
+    if src.endswith((".tar.gz", ".tgz", ".tar")):
+        with tarfile.open(src) as tf:
+            names = [m.name.split("/")[0] for m in tf.getmembers()
+                     if m.name and not m.name.startswith((".", "/"))]
+            if not names:
+                print("error: empty package", file=sys.stderr)
+                return 1
+            pkg = names[0]
+            tf.extractall(ext_root, filter="data")
+    else:
+        pkg = os.path.basename(src.rstrip("/"))
+        dst = os.path.join(ext_root, pkg)
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(src, dst)
+    init = os.path.join(ext_root, pkg, "__init__.py")
+    if not os.path.exists(init):
+        print(f"error: {pkg}/__init__.py missing — not an extension "
+              "package", file=sys.stderr)
+        return 1
+    print(f"installed {pkg} (enable with: gg sql -d {args.dir} "
+          f"\"create extension {pkg}\")")
     return 0
 
 
@@ -86,6 +347,11 @@ def cmd_state(args):
         print("probe:", json.dumps(results))
     print(f"cluster: {args.dir}  width: {db.numsegments}  "
           f"config version: {db.catalog.segments.version}")
+    info = _read_pidfile(args.dir)
+    if info and _pid_alive(info[0]):
+        print(f"server: running (pid {info[0]}, socket {info[1]})")
+    else:
+        print("server: not running (embedded access only)")
     print(f"{'content':>8} {'role':>5} {'pref':>5} {'status':>7} {'device':>7} {'synced':>7}")
     for row in cluster_state(db.catalog.segments):
         print(f"{row['content']:>8} {row['role']:>5} {row['preferred_role']:>5} "
@@ -138,6 +404,141 @@ def cmd_server(args):
         pass
     finally:
         srv.stop()
+    return 0
+
+
+def _pidfile(dirpath: str) -> str:
+    return os.path.join(dirpath, "server.pid")
+
+
+def _read_pidfile(dirpath: str):
+    """-> (pid, socket_path) or None."""
+    try:
+        with open(_pidfile(dirpath)) as f:
+            pid_s, sock = f.read().splitlines()[:2]
+        return int(pid_s), sock
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def cmd_start(args):
+    """gpstart analog: daemonize a serving postmaster for the cluster.
+
+    Double-fork detach; the child writes <dir>/server.pid (pid + socket,
+    the postmaster.pid analog) and serves until `gg stop`. stdout/stderr
+    go to <dir>/log/server.out.
+    """
+    info = _read_pidfile(args.dir)
+    if info and _pid_alive(info[0]):
+        print(f"error: server already running (pid {info[0]})",
+              file=sys.stderr)
+        return 1
+    sock = args.socket or os.path.join(args.dir, ".gg.sock")
+    pid = os.fork()
+    if pid:
+        # parent: reap the intermediate child (it exits at once in the
+        # double fork), then poll the pidfile until the daemon confirms
+        import time as _t
+
+        os.waitpid(pid, 0)
+        for _ in range(1200):   # jax import + device init can take ~30s
+            info = _read_pidfile(args.dir)
+            if info and _pid_alive(info[0]):
+                print(f"server started (pid {info[0]}, socket {info[1]})")
+                return 0
+            _t.sleep(0.05)
+        print("error: server failed to start (see log/server.out)",
+              file=sys.stderr)
+        return 1
+    # child: become the daemon
+    os.setsid()
+    if os.fork():
+        os._exit(0)
+    os.makedirs(os.path.join(args.dir, "log"), exist_ok=True)
+    out = open(os.path.join(args.dir, "log", "server.out"), "a")
+    os.dup2(out.fileno(), 1)
+    os.dup2(out.fileno(), 2)
+    from greengage_tpu.runtime.server import SqlServer
+
+    db = _open(args.dir)
+    srv = SqlServer(db, sock)
+    srv.start()
+    with open(_pidfile(args.dir), "w") as f:
+        f.write(f"{os.getpid()}\n{sock}\n")
+    db.log.info("lifecycle", f"server started on {sock}")
+    import signal
+
+    # sigwait avoids the check-then-pause lost-wakeup race: the signal is
+    # blocked until we are actually waiting for it
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           {signal.SIGTERM, signal.SIGINT})
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    db.log.info("lifecycle", "server stopping (signal)")
+    srv.stop()
+    try:
+        os.remove(_pidfile(args.dir))
+    except OSError:
+        pass
+    os._exit(0)
+
+
+def cmd_stop(args):
+    """gpstop analog. -m smart/fast: SIGTERM + wait; -m immediate:
+    SIGKILL."""
+    import signal
+    import time as _t
+
+    info = _read_pidfile(args.dir)
+    if not info or not _pid_alive(info[0]):
+        print("server is not running")
+        try:
+            os.remove(_pidfile(args.dir))
+        except OSError:
+            pass
+        return 0
+    pid, _sock = info
+    os.kill(pid, signal.SIGKILL if args.mode == "immediate"
+            else signal.SIGTERM)
+    for _ in range(int(args.timeout / 0.05)):
+        if not _pid_alive(pid):
+            print(f"server stopped (pid {pid})")
+            try:
+                os.remove(_pidfile(args.dir))
+            except OSError:
+                pass
+            return 0
+        _t.sleep(0.05)
+    print(f"error: server (pid {pid}) did not exit in {args.timeout}s "
+          "(try -m immediate)", file=sys.stderr)
+    return 1
+
+
+def cmd_logfilter(args):
+    """gplogfilter analog: mine the cluster's CSV logs."""
+    from greengage_tpu.runtime.logger import filter_entries, read_entries
+
+    entries = filter_entries(
+        read_entries(args.dir), trouble=args.trouble, match=args.match,
+        begin=args.begin, end=args.end,
+        min_duration_ms=args.min_duration)
+    if args.tail:
+        entries = entries[-args.tail:]
+    for e in entries:
+        dur = f" ({e['duration_ms']}ms)" if e["duration_ms"] else ""
+        rows = f" rows={e['rows']}" if e["rows"] else ""
+        print(f"{e['ts']} {e['severity']:>7} [{e['kind']}]{dur}{rows} "
+              f"{e['message']}")
+    print(f"({len(entries)} entries)", file=sys.stderr)
     return 0
 
 
@@ -346,6 +747,27 @@ def main(argv=None):
     p.add_argument("-t", "--table", default=None)
     p.set_defaults(fn=cmd_analyze)
 
+    p = sub.add_parser("analyzedb")   # incremental stats refresh
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--full", action="store_true")
+    p.set_defaults(fn=cmd_analyzedb)
+
+    p = sub.add_parser("checkperf")   # gpcheckperf analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--size-mb", type=int, default=64)
+    p.set_defaults(fn=cmd_checkperf)
+
+    p = sub.add_parser("load")        # gpload analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-f", "--config", required=True)
+    p.set_defaults(fn=cmd_load)
+
+    p = sub.add_parser("pkg")         # gppkg analog
+    p.add_argument("action", choices=("install", "remove", "list"))
+    p.add_argument("package", nargs="?", default=None)
+    p.add_argument("-d", "--dir", required=True)
+    p.set_defaults(fn=cmd_pkg)
+
     p = sub.add_parser("state")
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("--probe", action="store_true")
@@ -361,6 +783,28 @@ def main(argv=None):
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("-s", "--socket", required=True)
     p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("start")   # gpstart analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-s", "--socket", default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop")    # gpstop analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-m", "--mode", choices=("smart", "fast", "immediate"),
+                   default="smart")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("logfilter")   # gplogfilter analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-t", "--trouble", action="store_true")
+    p.add_argument("-m", "--match", default=None)
+    p.add_argument("-b", "--begin", default=None)
+    p.add_argument("-e", "--end", default=None)
+    p.add_argument("--min-duration", type=float, default=None)
+    p.add_argument("-n", "--tail", type=int, default=None)
+    p.set_defaults(fn=cmd_logfilter)
 
     p = sub.add_parser("worker")
     p.add_argument("-d", "--dir", required=True)
@@ -394,7 +838,10 @@ def main(argv=None):
     p.set_defaults(fn=cmd_restore)
 
     args = ap.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:      # e.g. `gg logfilter | head`
+        return 0
 
 
 if __name__ == "__main__":

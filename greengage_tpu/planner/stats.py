@@ -50,16 +50,22 @@ class TableStats:
     rows: int = 0
     version: int = -1           # manifest version when analyzed
     columns: dict = field(default_factory=dict)   # name -> ColumnStats
+    # content hash of the table's manifest entry at analyze time: the
+    # `gg analyzedb` incremental gate (analyzedb's mtime+state tracking
+    # analog) — unchanged hash = stats still describe the data
+    fingerprint: str = ""
 
     def to_dict(self) -> dict:
         return {"rows": self.rows, "version": self.version,
+                "fingerprint": self.fingerprint,
                 "columns": {n: c.to_dict() for n, c in self.columns.items()}}
 
     @staticmethod
     def from_dict(d: dict) -> "TableStats":
         return TableStats(d.get("rows", 0), d.get("version", -1),
                           {n: ColumnStats.from_dict(c)
-                           for n, c in d.get("columns", {}).items()})
+                           for n, c in d.get("columns", {}).items()},
+                          d.get("fingerprint", ""))
 
 
 def _haas_stokes(n_sample: int, d_sample: int, f1: int, total_rows: int) -> float:
@@ -114,11 +120,24 @@ def analyze_column(arr: np.ndarray, valid: np.ndarray | None,
     return st
 
 
+def table_fingerprint(snap: dict, schema) -> str:
+    """Stable hash of a table's manifest entries (all storage children) —
+    equal fingerprints mean the on-disk data is unchanged since analyze."""
+    import hashlib
+    import json
+
+    tables = snap.get("tables", {})
+    ent = {s: tables.get(s) for s in schema.storage_tables()}
+    return hashlib.sha1(
+        json.dumps(ent, sort_keys=True, default=str).encode()).hexdigest()[:16]
+
+
 def analyze_table(store, schema, snapshot=None) -> TableStats:
     """One ANALYZE pass over a table: full min/max/null (vectorized),
     sampled NDV/MCV, per column."""
     snap = snapshot or store.manifest.snapshot()
-    ts = TableStats(version=snap.get("version", 0))
+    ts = TableStats(version=snap.get("version", 0),
+                    fingerprint=table_fingerprint(snap, schema))
     nseg = schema.policy.numsegments
     rng = np.random.default_rng(0xA7A1)
     per_col: dict[str, list] = {c.name: [] for c in schema.columns}

@@ -1,0 +1,132 @@
+"""Structured cluster logging — the elog/syslogger analog.
+
+Reference parity: the CSV server log emitted by the syslogger
+(src/backend/postmaster/syslogger.c, write_csvlog in elog.c): one file
+per day under ``<cluster>/log/``, one CSV record per event. Field
+layout (a condensed version of the reference's 23-column csvlog):
+
+    timestamp, severity, pid, thread, kind, duration_ms, rows, message
+
+Statements, errors, lifecycle events (startup/shutdown/recovery), and
+management actions all land here; ``gg logfilter`` (mgmt/cli.py) is the
+gplogfilter analog that mines them. Appends are line-atomic under a
+process-wide lock; multiple threads (server connections) share one
+logger. The logger never raises into the caller — a full disk must not
+take the query path down with it.
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime
+import io
+import os
+import threading
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL", "PANIC")
+
+
+class ClusterLog:
+    def __init__(self, root: str, enabled: bool = True):
+        self.dir = os.path.join(root, "log")
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._fh = None            # open append handle for _fh_day
+        self._fh_day: datetime.date | None = None
+
+    def _path(self, day: datetime.date | None = None) -> str:
+        day = day or datetime.date.today()
+        return os.path.join(self.dir, f"ggtpu-{day.isoformat()}.csv")
+
+    def _handle(self):
+        """Open (or roll to today's) append handle; called under _lock."""
+        day = datetime.date.today()
+        if self._fh is None or self._fh_day != day:
+            if self._fh is not None:
+                self._fh.close()
+            os.makedirs(self.dir, exist_ok=True)
+            self._fh = open(self._path(day), "a")
+            self._fh_day = day
+        return self._fh
+
+    def log(self, severity: str, kind: str, message: str,
+            duration_ms: float | None = None, rows: int | None = None) -> None:
+        if not self.enabled:
+            return
+        ts = datetime.datetime.now().isoformat(timespec="milliseconds")
+        buf = io.StringIO()
+        csv.writer(buf).writerow([
+            ts, severity, os.getpid(), threading.current_thread().name,
+            kind, "" if duration_ms is None else f"{duration_ms:.2f}",
+            "" if rows is None else rows,
+            message.replace("\n", " ")[:500],
+        ])
+        try:
+            with self._lock:
+                fh = self._handle()
+                fh.write(buf.getvalue())
+                fh.flush()   # line-durable for logfilter/crash forensics
+        except OSError:
+            pass   # logging must never fail the statement
+
+    # convenience levels -------------------------------------------------
+    def info(self, kind: str, message: str, **kw) -> None:
+        self.log("INFO", kind, message, **kw)
+
+    def error(self, kind: str, message: str, **kw) -> None:
+        self.log("ERROR", kind, message, **kw)
+
+    # ---- mining (the gplogfilter core) --------------------------------
+    def files(self) -> list[str]:
+        if not os.path.isdir(self.dir):
+            return []
+        return sorted(os.path.join(self.dir, f)
+                      for f in os.listdir(self.dir)
+                      if f.startswith("ggtpu-") and f.endswith(".csv"))
+
+
+FIELDS = ("ts", "severity", "pid", "thread", "kind",
+          "duration_ms", "rows", "message")
+
+
+def read_entries(root: str) -> list[dict]:
+    """Parse every log file under <root>/log into dicts (FIELDS keys)."""
+    out = []
+    log = ClusterLog(root)
+    for path in log.files():
+        with open(path, newline="") as f:
+            for rec in csv.reader(f):
+                if len(rec) != len(FIELDS):
+                    continue   # torn line (crash mid-append)
+                out.append(dict(zip(FIELDS, rec)))
+    return out
+
+
+def filter_entries(entries: list[dict], trouble: bool = False,
+                   match: str | None = None, begin: str | None = None,
+                   end: str | None = None,
+                   min_duration_ms: float | None = None) -> list[dict]:
+    """gplogfilter semantics: severity gate (-t), regex (-m), time window
+    (-b/-e), slow-statement floor."""
+    import re
+
+    rx = re.compile(match, re.I) if match else None
+    out = []
+    for e in entries:
+        if trouble and e["severity"] not in ("ERROR", "FATAL", "PANIC"):
+            continue
+        if rx is not None and not rx.search(e["message"]) \
+                and not rx.search(e["kind"]):
+            continue
+        if begin and e["ts"] < begin:
+            continue
+        if end and e["ts"] > end:
+            continue
+        if min_duration_ms is not None:
+            try:
+                if float(e["duration_ms"] or 0) < min_duration_ms:
+                    continue
+            except ValueError:
+                continue
+        out.append(e)
+    return out

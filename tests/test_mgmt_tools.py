@@ -1,0 +1,189 @@
+"""Management-plane tools: logging + gplogfilter, gpstart/gpstop daemon
+lifecycle, analyzedb incremental stats, gpload YAML loads, gppkg
+packages, gpcheckperf. Reference: gpMgmt/bin counterparts."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.mgmt import cli
+from greengage_tpu.runtime.logger import filter_entries, read_entries
+
+
+def run_cli(*argv):
+    return cli.main(list(argv))
+
+
+@pytest.fixture()
+def clu(tmp_path, devices8):
+    d = str(tmp_path / "clu")
+    assert run_cli("init", "-d", d, "-n", "4") == 0
+    return d
+
+
+# ---------------------------------------------------------------------------
+# logging + logfilter
+# ---------------------------------------------------------------------------
+
+def test_statement_logging_and_filter(clu):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table t (a int) distributed by (a)")
+    db.sql("insert into t values (1), (2)")
+    db.sql("select count(*) from t")
+    with pytest.raises(Exception):
+        db.sql("select nope from t")
+    entries = read_entries(clu)
+    kinds = {e["kind"] for e in entries}
+    assert "lifecycle" in kinds and "statement" in kinds
+    errs = filter_entries(entries, trouble=True)
+    assert any("nope" in e["message"] for e in errs)
+    assert all(e["severity"] == "ERROR" for e in errs)
+    sel = filter_entries(entries, match="count")
+    assert sel and all("count" in e["message"] for e in sel)
+    # duration floor keeps only real statements
+    slow = filter_entries(entries, min_duration_ms=0.0)
+    assert all(e["kind"] == "statement" for e in slow if e["duration_ms"])
+
+
+def test_log_statement_off(clu):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("set log_statement to off")
+    before = len(read_entries(clu))
+    db.sql("create table q (a int) distributed by (a)")
+    assert len(read_entries(clu)) == before
+    db.sql("set log_statement to on")
+
+
+# ---------------------------------------------------------------------------
+# analyzedb incremental
+# ---------------------------------------------------------------------------
+
+def test_analyzedb_incremental(clu, capsys):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table s1 (a int, b int) distributed by (a)")
+    db.sql("insert into s1 values (1, 10), (2, 20)")
+    db.sql("create table s2 (a int) distributed by (a)")
+    db.sql("insert into s2 values (5)")
+    assert run_cli("analyzedb", "-d", clu) == 0
+    out = capsys.readouterr().out
+    assert "analyzed s1" in out and "analyzed s2" in out
+    # second run: nothing changed -> both skipped
+    assert run_cli("analyzedb", "-d", clu) == 0
+    out = capsys.readouterr().out
+    assert "skipped s1" in out and "skipped s2" in out
+    # touch one table -> only it re-analyzes
+    db2 = greengage_tpu.connect(path=clu)
+    db2.sql("insert into s1 values (3, 30)")
+    assert run_cli("analyzedb", "-d", clu) == 0
+    out = capsys.readouterr().out
+    assert "analyzed s1" in out and "skipped s2" in out
+
+
+# ---------------------------------------------------------------------------
+# gpload
+# ---------------------------------------------------------------------------
+
+def test_gpload_yaml(clu, tmp_path, capsys):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table sales (id int, region text, amt decimal(8,2)) "
+           "distributed by (id)")
+    csv = tmp_path / "sales.csv"
+    csv.write_text("id,region,amt\n1,east,10.50\n2,west,20.25\nbad,x,y\n")
+    cfg = tmp_path / "load.yml"
+    cfg.write_text(textwrap.dedent(f"""
+        gpload:
+          input:
+            source:
+              file: [{csv}]
+            format: csv
+            header: true
+            error_limit: 5
+          output:
+            table: sales
+            mode: insert
+    """))
+    assert run_cli("load", "-d", clu, "-f", str(cfg)) == 0
+    assert "now 2 rows" in capsys.readouterr().out
+    db2 = greengage_tpu.connect(path=clu)
+    assert db2.sql("select count(*) from sales").rows() == [(2,)]
+    # truncate mode replaces
+    assert run_cli("load", "-d", clu, "-f", str(cfg)) == 0  # insert appends
+    db3 = greengage_tpu.connect(path=clu)
+    assert db3.sql("select count(*) from sales").rows() == [(4,)]
+    cfg.write_text(cfg.read_text().replace("mode: insert", "mode: truncate"))
+    assert run_cli("load", "-d", clu, "-f", str(cfg)) == 0
+    db4 = greengage_tpu.connect(path=clu)
+    assert db4.sql("select count(*) from sales").rows() == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# gppkg
+# ---------------------------------------------------------------------------
+
+def test_pkg_install_and_create_extension(clu, tmp_path, capsys):
+    pkg = tmp_path / "triple"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "from greengage_tpu.extensions import register_scalar\n"
+        "register_scalar('triple_it', lambda a: a * 3, ('numeric',), "
+        "'first')\n")
+    assert run_cli("pkg", "install", str(pkg), "-d", clu) == 0
+    assert run_cli("pkg", "list", "-d", clu) == 0
+    assert "triple" in capsys.readouterr().out
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create extension triple")
+    db.sql("create table n (a int) distributed by (a)")
+    db.sql("insert into n values (7)")
+    assert db.sql("select triple_it(a) from n").rows() == [(21,)]
+    # removal is refused while created
+    assert run_cli("pkg", "remove", "triple", "-d", clu) == 1
+
+
+# ---------------------------------------------------------------------------
+# daemon lifecycle (subprocess: fork conflicts with pytest/jax state)
+# ---------------------------------------------------------------------------
+
+def test_constant_select(clu):
+    db = greengage_tpu.connect(path=clu)
+    assert db.sql("select 1").rows() == [(1,)]
+    assert db.sql("select 1 + 2 as x, 'a' || 'b' as s").rows() == [(3, "ab")]
+    assert db.sql("select null as n").rows() == [(None,)]
+    assert db.sql("select upper('q'), abs(-4)").rows() == [("Q", 4)]
+    assert db.sql("select 1 limit 0").rows() == []
+    assert db.sql("select 1 where 1 = 0").rows() == []
+    assert db.sql("select 1 where 2 > 1").rows() == [(1,)]
+
+
+def test_pkg_missing_argument(clu, capsys):
+    assert run_cli("pkg", "install", "-d", clu) == 1
+    assert "requires a package" in capsys.readouterr().err
+
+
+def test_start_stop_lifecycle(clu):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GGTPU_PLATFORM="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "-m", "greengage_tpu.mgmt.cli", "start", "-d", clu],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "server started" in r.stdout
+    try:
+        sock = os.path.join(clu, ".gg.sock")
+        r = subprocess.run(
+            [sys.executable, "-m", "greengage_tpu.mgmt.cli", "sql",
+             "-s", sock, "select 1 as one"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert "1" in r.stdout, r.stdout + r.stderr
+    finally:
+        r = subprocess.run(
+            [sys.executable, "-m", "greengage_tpu.mgmt.cli", "stop",
+             "-d", clu], env=env, capture_output=True, text=True, timeout=60)
+    assert "server stopped" in r.stdout
+    assert not os.path.exists(os.path.join(clu, "server.pid"))
