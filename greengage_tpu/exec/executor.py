@@ -373,6 +373,8 @@ class Executor:
                 else:
                     if c.startswith("@hp:"):
                         dt = np.dtype(bool)   # host-evaluated predicate col
+                    elif c.startswith("@rc:"):
+                        dt = np.dtype(np.int32)   # transient raw-dict codes
                     else:
                         col_s = schema.column(c)
                         # raw TEXT stages int64 row surrogates, not the

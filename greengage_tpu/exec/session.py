@@ -400,8 +400,14 @@ class Database:
                 self.dtm.begin()
                 return "BEGIN"
             if stmt.action == "commit":
+                written = set(getattr(self.dtm.current, "tables_written", ()))
                 self.dtm.commit()
                 self._post_commit()
+                # a committed raw-table republish GC's the old blobs —
+                # only NOW do open cursors over those tables go stale
+                for t in written:
+                    if self.store.has_raw_columns(t):
+                        self._tombstone_raw_cursors(t)
                 return "COMMIT"
             self.dtm.abort()
             return "ROLLBACK"
@@ -1533,28 +1539,54 @@ class Database:
 
     def _check_no_raw_dml(self, table: str):
         self._check_dml_target(table)
-        # NOTE when this guard is lifted (raw DML): a committed republish
-        # GC's the old raw blobs, so open cursors whose out_cols carry
-        # raw_refs into this table must be tombstoned at commit (their
-        # RETRIEVE would fetch_raw from deleted files); dict codes are
-        # append-only and need no invalidation.
-        if self.store.has_raw_columns(table):
+        # raw DML republishes decoded strings (see _decode_raw_out); only
+        # the partitioned+raw combination stays out — raw surrogates don't
+        # identify the storage child the string lives in
+        if self.store.has_raw_columns(table) \
+                and self.catalog.get(table).is_partitioned:
             raise SqlError(
-                f'table "{table}" has raw-encoded TEXT columns; '
-                "DELETE/UPDATE require dictionary-encoded text for the "
-                "republish path (raw DML lands with the visimap analog)")
+                f'table "{table}" is partitioned with raw-encoded TEXT '
+                "columns; DELETE/UPDATE are not supported on that "
+                "combination")
 
-    def _replace_table(self, schema, enc, valids, tx) -> None:
+    def _decode_raw_out(self, table: str, cname: str, data, valid):
+        """DML republish: raw-column device surrogates -> host strings."""
+        data = np.asarray(data, np.int64)
+        strs = np.empty(len(data), dtype=object)
+        m = (np.ones(len(data), bool) if valid is None
+             else np.asarray(valid, bool))
+        strs[m] = self.store.fetch_raw(table, cname, data[m])
+        return strs
+
+    def _tombstone_raw_cursors(self, table: str) -> None:
+        """A committed raw-table republish GC's the old blobs; any open
+        cursor over the table would fetch_raw from deleted files — plant
+        the same tombstone DROP TABLE uses."""
+        for cname, batch in list(self._cursors.items()):
+            spec = getattr(getattr(batch, "comp", None), "input_spec", ())
+            if any(t == table for t, *_ in spec):
+                self._cursors[cname] = (
+                    f'cursor "{cname}" was invalidated by DELETE/UPDATE '
+                    f'on raw-text table {table}')
+
+    def _replace_table(self, schema, enc, valids, tx, raw_strs=None) -> None:
         """Republish a table's full contents. Partitioned tables route the
         surviving rows by partition key and replace EVERY child (a child
         that receives no rows becomes empty) — UPDATEs may move rows
         across partitions, unlike the reference's pre-7 restriction."""
         if not schema.is_partitioned:
             if tx is not None:
-                tx.replace(schema.name, enc, valids)
+                # tombstoning waits for COMMIT (rollback keeps old blobs
+                # live; see the TxStmt commit handler)
+                tx.replace(schema.name, enc, valids, raw_strs)
             else:
-                self.store.replace_contents(schema.name, enc, valids)
+                self.store.replace_contents(schema.name, enc, valids,
+                                            raw_strs)
+                if raw_strs:
+                    self._tombstone_raw_cursors(schema.name)
             return
+        if raw_strs:
+            raise SqlError("partitioned raw-text republish is not supported")
         _kind, pcol = schema.partition_by
         pidx = np.asarray(schema.route_rows(enc[pcol], valids.get(pcol)))
         if (pidx < 0).any():
@@ -1585,10 +1617,13 @@ class Database:
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)
         total = sum(self.store.segment_rowcounts(stmt.table))
+        raw_names = self.store.raw_column_names(stmt.table)
         if stmt.where is None:
-            empty = {c.name: np.empty(0, dtype=c.type.np_dtype)
-                     for c in schema.columns}
-            self._replace_table(schema, empty, {}, tx)
+            empty = {c.name: np.empty(
+                0, dtype=(np.int64 if c.name in raw_names
+                          else c.type.np_dtype)) for c in schema.columns}
+            raw_strs = {n: np.empty(0, dtype=object) for n in raw_names}
+            self._replace_table(schema, empty, {}, tx, raw_strs or None)
             return f"DELETE {total}"
         # survivors: predicate false OR NULL
         survive = A.Bin("or", A.Unary("not", stmt.where), A.IsNullTest(stmt.where, False))
@@ -1597,12 +1632,20 @@ class Database:
         res, outs = self._run_raw(sel)
         enc = {}
         valids = {}
+        raw_strs = {}
         for c, o in zip(schema.columns, outs):
-            enc[c.name] = np.ascontiguousarray(res.cols[o.id], dtype=c.type.np_dtype)
             v = res.valids.get(o.id)
+            if c.name in raw_names:
+                # decode surrogates while the old blobs are still live
+                raw_strs[c.name] = self._decode_raw_out(
+                    stmt.table, c.name, res.cols[o.id], v)
+                enc[c.name] = np.zeros(len(res.cols[o.id]), np.int64)
+            else:
+                enc[c.name] = np.ascontiguousarray(res.cols[o.id],
+                                                   dtype=c.type.np_dtype)
             if v is not None:
                 valids[c.name] = v
-        self._replace_table(schema, enc, valids, tx)
+        self._replace_table(schema, enc, valids, tx, raw_strs or None)
         return f"DELETE {total - len(res)}"
 
     def _update(self, stmt: A.UpdateStmt, worker_scan_only: bool = False):
@@ -1630,6 +1673,11 @@ class Database:
         dict_dirty = False
         for cname, e in stmt.sets:
             col = schema.column(cname)
+            if col.type.kind is T.Kind.TEXT and col.encoding == "raw":
+                raise SqlError(
+                    f'column "{cname}" is raw-encoded text; SET on raw '
+                    "columns is not supported (raw columns pass through "
+                    "UPDATE unchanged)")
             if col.type.kind is T.Kind.TEXT:
                 if isinstance(e, A.Str):
                     code = self.store.dictionary(stmt.table, cname).encode([e.value])[0]
@@ -1660,7 +1708,17 @@ class Database:
         fv = res.valids.get(fo.id)
         mask = fval if fv is None else (fval & fv)   # NULL predicate -> no update
         enc, valids = {}, {}
+        raw_strs = {}
         for c, o in zip(schema.columns, outs[:ncols]):
+            if c.type.kind is T.Kind.TEXT and c.encoding == "raw":
+                # pass-through: decode while old blobs are live, republish
+                v = res.valids.get(o.id)
+                raw_strs[c.name] = self._decode_raw_out(
+                    stmt.table, c.name, res.cols[o.id], v)
+                enc[c.name] = np.zeros(len(res.cols[o.id]), np.int64)
+                if v is not None:
+                    valids[c.name] = np.asarray(v, bool)
+                continue
             old = np.ascontiguousarray(res.cols[o.id], dtype=c.type.np_dtype)
             oldv = res.valids.get(o.id)
             oldv = np.ones(len(old), bool) if oldv is None else oldv
@@ -1688,7 +1746,7 @@ class Database:
             enc[c.name] = merged.astype(c.type.np_dtype)
             if not mergedv.all():
                 valids[c.name] = mergedv
-        self._replace_table(schema, enc, valids, tx)
+        self._replace_table(schema, enc, valids, tx, raw_strs or None)
         return f"UPDATE {int(mask.sum())}"
 
     # ------------------------------------------------------------------

@@ -34,13 +34,13 @@ class Transaction:
         self.tables_written.add(table)
         return n
 
-    def replace(self, table: str, enc, valids) -> None:
+    def replace(self, table: str, enc, valids, raw_strs=None) -> None:
         """Stage a DELETE/UPDATE republish; the old files become
         unreachable at commit and are GC'd then, the NEW files are
         reclaimed if the transaction rolls back."""
         if self.state != "active":
             raise TransactionError(f"transaction is {self.state}")
-        old = self.store.stage_replace(self.tx, table, enc, valids)
+        old = self.store.stage_replace(self.tx, table, enc, valids, raw_strs)
         new_rels = [rel for files in self.tx["tables"][table]["segfiles"].values()
                     for rel in files]
         if not hasattr(self, "_staged_new"):

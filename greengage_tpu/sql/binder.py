@@ -196,6 +196,21 @@ class Binder:
         # allows them for ungrouped queries) ride as hidden pass-throughs
         agg_rewrites = rewrites if has_aggs else {}
         src_to_out = {e.name: ci for ci, e in sel_exprs if isinstance(e, E.ColRef)}
+        if stmt.distinct:
+            # raw DISTINCT keys become transient-dictionary codes (equal
+            # strings = equal codes; rendering decodes via the dictionary).
+            # Before ORDER BY binding, so sort keys see coded columns.
+            for i, (ci, e) in enumerate(sel_exprs):
+                if ci.raw_ref is None:
+                    continue
+                coded = self._raw_to_codes(e)
+                if coded is None:
+                    raise SqlError(
+                        "raw-encoded text cannot be used as a DISTINCT key")
+                ci.dict_ref = _dict_ref_of(coded)
+                ci.raw_ref = None
+                ci.raw_chain = None
+                sel_exprs[i] = (ci, coded)
         order_keys = []
         if stmt.order_by:
             for oi in stmt.order_by:
@@ -228,17 +243,29 @@ class Binder:
                                      raw_chain=_raw_chain_of(e))
                         sel_exprs.append((ci, e))
                         e = _colref(ci)
+                if _raw_ref_of(e) is not None and not stmt.distinct \
+                        and not has_aggs:
+                    # raw sort key: convert the projected column's SOURCE
+                    # expression (handles ordinals/aliases uniformly) and
+                    # ride the transient-dictionary codes as a hidden
+                    # column (codes + rank LUT sort correctly; surrogates
+                    # don't)
+                    src = None
+                    if isinstance(e, E.ColRef):
+                        src = next((ex for ci2, ex in sel_exprs
+                                    if ci2.id == e.name), None)
+                    coded = self._raw_to_codes(
+                        src if src is not None else e)
+                    ci = ColInfo(self.new_id("ord"), coded.type, "?order?",
+                                 _dict_ref_of(coded), hidden=True)
+                    sel_exprs.append((ci, coded))
+                    e = _colref(ci)
                 order_keys.append((self._no_raw(e, "sort key"),
                                    oi.desc, oi.nulls_first))
 
         plan = Project(plan, sel_exprs)
 
         if stmt.distinct:
-            for c in proj_cols:
-                if c.raw_ref is not None:
-                    raise SqlError(
-                        "raw-encoded text cannot be used as a DISTINCT key "
-                        "(re-create the column as dictionary-encoded)")
             keys = [(c, E.ColRef(c.id, c.type)) for c in proj_cols]
             plan = Aggregate(plan, keys, [])
 
@@ -1014,10 +1041,12 @@ class Binder:
         get a translation LUT on the right side."""
         out_l, out_r = [], []
         for lk, rk in zip(lkeys, rkeys):
-            if _raw_ref_of(lk) is not None or _raw_ref_of(rk) is not None:
-                raise SqlError(
-                    "raw-encoded text cannot be a join key (re-create the "
-                    "column as dictionary-encoded)")
+            # raw TEXT join keys ride their transient dictionaries; the
+            # cross-dictionary translation below then applies as usual
+            if _raw_ref_of(lk) is not None:
+                lk = self._raw_to_codes(lk)
+            if _raw_ref_of(rk) is not None:
+                rk = self._raw_to_codes(rk)
             lt, rt = lk.type, rk.type
             if lt.kind is T.Kind.TEXT and rt.kind is T.Kind.TEXT:
                 ld = _dict_ref_of(lk)
@@ -1117,7 +1146,9 @@ class Binder:
         proj: list[tuple[ColInfo, E.Expr]] = []
         key_cols: list[tuple[ColInfo, E.Expr]] = []
         for gast, ge in group_exprs:
-            self._no_raw(ge, "GROUP BY key")
+            conv = self._raw_to_codes(ge)
+            if conv is not None:
+                ge = conv
             ci = ColInfo(self.new_id("g"), ge.type, _ast_name(gast), _dict_ref_of(ge))
             proj.append((ci, ge))
             key_cols.append((ci, E.ColRef(ci.id, ci.type)))
@@ -1132,14 +1163,22 @@ class Binder:
                 atype = None
             else:
                 ae = self._expr(fc.args[0], scope)
-                atype = ae.type
                 if fc.name in ("min", "max"):
-                    # min/max of raw text would return the row surrogate
+                    # raw text -> transient dictionary codes, then TEXT
+                    # codes -> lexicographic rank space (first-seen codes
+                    # don't order; ranks do and decode via the sorted
+                    # dictionary)
+                    conv = self._raw_to_codes(ae)
+                    if conv is not None:
+                        ae = conv
+                    if ae.type.kind is T.Kind.TEXT:
+                        ae = self._text_rank_expr(ae)
                     self._no_raw(ae, f"{fc.name}() argument")
                 if fc.name != "count":
                     # count(chain) is fine (validity passes through); any
                     # value-dependent aggregate would sum surrogates
                     self._no_rawchain(ae, f"{fc.name}() argument")
+                atype = ae.type
                 ci_in = ColInfo(self.new_id("a_in"), ae.type, "arg", _dict_ref_of(ae))
                 proj.append((ci_in, ae))
                 arg_ref = E.ColRef(ci_in.id, ci_in.type)
@@ -1148,7 +1187,12 @@ class Binder:
             func = "count_star" if fc.star else fc.name
             rtype = E.agg_result_type(func, atype)
             agg = E.Agg(func, arg_ref, fc.distinct, rtype)
-            ci = ColInfo(self.new_id(func), rtype, func)
+            # TEXT min/max results decode through the argument's (rank)
+            # dictionary
+            ci = ColInfo(self.new_id(func), rtype, func,
+                         dict_ref=(_dict_ref_of(ae)
+                                   if rtype.kind is T.Kind.TEXT and not fc.star
+                                   else None))
             aggs.append((ci, agg))
             agg_map[id(fc)] = ci
             if fc.distinct:
@@ -1263,6 +1307,57 @@ class Binder:
                          raw_ref=_raw_ref_of(e), raw_chain=_raw_chain_of(e))
             sel_exprs.append((ci, e))
         return scope, sel_exprs
+
+    def _raw_to_codes(self, e: E.Expr):
+        """Raw-TEXT expression -> dictionary-coded expression under the
+        column's transient per-version dictionary (TableStore
+        .raw_dictionary). This is how raw columns become usable as
+        GROUP BY / ORDER BY / DISTINCT / join keys: the device sees int32
+        codes with full dictionary services (hash LUTs, rank LUTs,
+        translation, decode). Returns None when ``e`` is not raw."""
+        rr = _raw_ref_of(e)
+        if rr is None:
+            return None
+        base = e.arg if isinstance(e, E.RawChain) else e
+        if not isinstance(base, E.ColRef) or base.name not in self._scan_for:
+            raise SqlError(
+                "raw-encoded text keys are only supported directly on "
+                "base-table columns")
+        scan = self._scan_for[base.name]
+        vname = "@rc:" + rr[1]
+        ref = self.store.raw_dictionary(rr[0], rr[1])
+        ci = next((c for c in scan.cols if c.name == vname), None)
+        if ci is None:
+            ci = ColInfo(self.new_id("rc"), T.TEXT, vname, dict_ref=ref)
+            scan.cols.append(ci)
+            self._scan_for[ci.id] = scan
+        coded: E.Expr = _colref(ci)
+        for step in (_raw_chain_of(e) or ()):
+            from greengage_tpu.utils import strfuncs
+
+            kind = strfuncs.SPECS[step[0]][2]
+            coded = self._lower_str_step(coded, tuple(step), kind)
+        return coded
+
+    def _text_rank_expr(self, ae: E.Expr) -> E.Expr:
+        """min/max over TEXT: first-seen dictionary codes do not order
+        lexicographically, so re-code into rank space — a LUT onto the
+        sorted dictionary, whose output dict_ref is the sorted values
+        (ranks decode directly). Fixes min/max returning arbitrary
+        first-seen strings."""
+        d = _dict_ref_of(ae)
+        if d is None:
+            raise SqlError(
+                "min/max over text requires a dictionary-backed column")
+        dic = self.store.dictionary(*d)
+        order = np.argsort(np.asarray(dic.values, dtype=object))
+        rank = np.empty(len(order), dtype=np.int32)
+        rank[order] = np.arange(len(order), dtype=np.int32)
+        ref = self.store.derived_dictionary([dic.values[i] for i in order])
+        lut = np.concatenate([rank, [np.int32(-1)]]).astype(np.int32)
+        e = E.Lut(ae, self._const(lut), type=T.TEXT)
+        object.__setattr__(e, "_dict_ref", ref)
+        return e
 
     def _no_rawchain(self, e: E.Expr, what: str) -> E.Expr:
         # chain carriers are RawChain nodes OR ColRefs whose subquery

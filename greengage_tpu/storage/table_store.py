@@ -99,6 +99,10 @@ class TableStore:
         self._derived: dict[tuple[str, str], Dictionary] = {}
         self._raw_cache: dict = {}    # (table, col, seg, version) -> RawChunk
         self._hp_cache: dict = {}     # (table, seg, name, version) -> result
+        # transient per-version dictionaries over raw columns (group/sort/
+        # join keys on raw TEXT): ref registry + per-segment code arrays
+        self._rawdict_refs: dict = {}   # (table, col, version) -> ref
+        self._rawcode_cache: dict = {}  # (storage, seg, col, version) -> (codes, valid)
 
     # ---- per-content data roots (mirror failover) ----------------------
     def data_root(self, content: int) -> str:
@@ -131,7 +135,7 @@ class TableStore:
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
-        if table == "@expr":
+        if table in ("@expr", "@rawdict"):
             return self._derived[(table, col)]
         # partition children share the PARENT's dictionary: one code space
         # per logical table, so codes compare/join across partitions
@@ -152,6 +156,53 @@ class TableStore:
         if ref not in self._derived:
             self._derived[ref] = Dictionary(list(values))
         return ref
+
+    def raw_dictionary(self, table: str, col: str, snapshot=None) -> tuple:
+        """Transient dictionary over a raw TEXT column's live strings —
+        one first-seen code space across all segments (and partition
+        children), cached per manifest version. Lets raw columns flow
+        through every dictionary-based path (GROUP BY hashing, sort rank
+        LUTs, join translation, result decode) at O(rows) host cost,
+        without persisting a dictionary that high-NDV data would bloat.
+        -> ("@rawdict", key) usable as a dict_ref."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        parent = table.split("#", 1)[0]
+        key = (parent, col, version)
+        hit = self._rawdict_refs.get(key)
+        if hit is not None:
+            return hit
+        schema = self.catalog.get(parent)
+        d = Dictionary()
+        nseg = schema.policy.numsegments
+        for storage in schema.storage_tables():
+            for seg in range(nseg):
+                chunk = self.raw_chunk(storage, seg, col, snap)
+                codes = d.encode(chunk.strings())
+                self._rawcode_cache[(storage, seg, col, version)] = (
+                    codes.astype(np.int32), chunk.valid)
+        ref = ("@rawdict", f"{parent}:{col}:{version}")
+        self._derived[ref] = d
+        self._rawdict_refs[key] = ref
+        if len(self._rawdict_refs) > 16:   # bound transient memory
+            old_key = next(iter(self._rawdict_refs))   # (parent, col, ver)
+            old_ref = self._rawdict_refs.pop(old_key)
+            self._derived.pop(old_ref, None)
+            for k in [k for k in self._rawcode_cache
+                      if k[0].split("#", 1)[0] == old_key[0]
+                      and k[2] == old_key[1] and k[3] == old_key[2]]:
+                self._rawcode_cache.pop(k, None)
+        return ref
+
+    def raw_codes(self, table: str, seg: int, col: str, snapshot=None):
+        """-> (int32 codes, valid|None) for one segment of a raw column
+        under the transient dictionary (staged as an '@rc:' column)."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = (table, seg, col, version)
+        if key not in self._rawcode_cache:
+            self.raw_dictionary(table, col, snap)
+        return self._rawcode_cache[key]
 
     def _dict_path(self, table: str, col: str) -> str:
         table = table.split("#", 1)[0]
@@ -348,9 +399,12 @@ class TableStore:
         self.catalog._save()
         return new
 
+    def raw_column_names(self, table: str) -> set:
+        return {c.name for c in self.catalog.get(table).columns
+                if c.type.kind is T.Kind.TEXT and c.encoding == "raw"}
+
     def has_raw_columns(self, table: str) -> bool:
-        return any(c.type.kind is T.Kind.TEXT and c.encoding == "raw"
-                   for c in self.catalog.get(table).columns)
+        return bool(self.raw_column_names(table))
 
     def flush_dicts(self, table: str) -> None:
         schema = self.catalog.get(table)
@@ -445,6 +499,13 @@ class TableStore:
             keep, kept_n, total_n = self._kept_blocks(files, base, prune)
             self.last_prune = (kept_n, total_n)
         for name in want:
+            if name.startswith("@rc:"):
+                # raw column under its transient dictionary (group/sort/
+                # join keys on raw TEXT)
+                arr, vmask = self.raw_codes(table, seg, name[4:], snap)
+                cols[name] = arr
+                valids[name] = vmask
+                continue
             if name.startswith("@hp:"):
                 # host-evaluated predicate over a raw TEXT column: the
                 # device stages a boolean column (the dictionary-LUT idea
@@ -454,9 +515,16 @@ class TableStore:
                 valids[name] = vmask
                 continue
             c = schema.column(name)
-            if c.type.kind is T.Kind.TEXT and c.encoding == "raw":
+            stored_raw = c.type.kind is T.Kind.TEXT and (
+                c.encoding == "raw"
+                or any(os.path.basename(rel).startswith(name + ".")
+                       and rel.endswith(".rawoffs.ggb") for rel in files))
+            if stored_raw:
                 # device sees a stable row surrogate; strings decode at
-                # result finalize (fetch_raw)
+                # result finalize (fetch_raw). The file check guards the
+                # crash window where raw segfiles committed but the
+                # catalog's encoding resolution didn't persist — reading
+                # offs/bytes blobs as int32 codes would be garbage
                 cols[name] = ((np.int64(seg) << np.int64(40))
                               + np.arange(nrows, dtype=np.int64))
                 valids[name] = self.raw_chunk(table, seg, name, snap).valid
@@ -624,10 +692,7 @@ class TableStore:
         from greengage_tpu.catalog.schema import DistPolicy, PolicyKind
 
         schema = self.catalog.get(table)
-        if self.has_raw_columns(table):
-            raise ValueError(
-                f"table {table} has raw-encoded TEXT columns; "
-                "redistribution/republish of raw text is not supported yet")
+        raw_names = self.raw_column_names(table)
         old_nseg = schema.policy.numsegments
         # gather all rows from the old layout
         parts_cols: dict[str, list] = {c.name: [] for c in schema.columns}
@@ -640,6 +705,11 @@ class TableStore:
             cols, valids, n = self.read_segment(table, seg, snapshot=snap)
             total += n
             for c in schema.columns:
+                if c.name in raw_names:
+                    # re-placement needs the actual strings, not surrogates
+                    cols[c.name] = np.asarray(
+                        self.raw_chunk(table, seg, c.name, snap).strings(),
+                        dtype=object)
                 parts_cols[c.name].append(cols[c.name])
                 v = valids[c.name]
                 if v is not None:
@@ -647,7 +717,12 @@ class TableStore:
                 parts_valids[c.name].append(
                     v if v is not None else np.ones(n, dtype=bool))
         enc = {c.name: np.concatenate(parts_cols[c.name]) if parts_cols[c.name]
-               else np.empty(0, dtype=c.type.np_dtype) for c in schema.columns}
+               else np.empty(0, dtype=(object if c.name in raw_names
+                                       else c.type.np_dtype))
+               for c in schema.columns}
+        raw_strs = {n: enc[n] for n in raw_names}
+        for n in raw_names:   # placeholder for width checks; never hashed
+            enc[n] = np.zeros(len(raw_strs[n]), np.int64)
         valids = {
             c.name: np.concatenate(parts_valids[c.name])
             for c in schema.columns
@@ -676,7 +751,7 @@ class TableStore:
             seg_of = (np.arange(nrows) % new_numsegments).astype(np.int32)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(new_numsegments)]
         self._write_segfiles(schema, table, tmeta, enc, valids, seg_rows,
-                             uuid.uuid4().hex[:12])
+                             uuid.uuid4().hex[:12], raw_strs=raw_strs)
         v = self.manifest.prepare(tx)
         self.manifest.commit(v)
         # catalog: table now spans the new width (manifest is authoritative
@@ -691,21 +766,24 @@ class TableStore:
                 pass
         return nrows
 
-    def stage_replace(self, tx: dict, table: str, enc: dict, valids: dict) -> list:
+    def stage_replace(self, tx: dict, table: str, enc: dict, valids: dict,
+                      raw_strs: dict | None = None) -> list:
         """Stage a full-table replacement into a manifest transaction.
         Returns the OLD file rels (unreachable once the tx commits; the
         caller GCs them post-commit). ``enc`` holds storage-representation
-        arrays (TEXT = dictionary codes); placement is recomputed, so
-        updated distribution keys move rows to their new owner segments
+        arrays (TEXT = dictionary codes; raw TEXT = placeholder, actual
+        strings in ``raw_strs``); placement is recomputed, so updated
+        distribution keys move rows to their new owner segments
         (SplitUpdate's explicit redistribution analog,
         src/backend/executor/nodeSplitUpdate.c)."""
         from greengage_tpu.catalog.schema import PolicyKind
 
         schema = self.catalog.get(table)
-        if self.has_raw_columns(table):
+        raw_cols = self.raw_column_names(table)
+        if raw_cols - set(raw_strs or ()):
             raise ValueError(
-                f"table {table} has raw-encoded TEXT columns; "
-                "redistribution/republish of raw text is not supported yet")
+                f"table {table} republish is missing decoded strings for "
+                f"raw columns {sorted(raw_cols - set(raw_strs or ()))}")
         for c in schema.columns:
             v = valids.get(c.name)
             if not c.nullable and v is not None and not np.all(v):
@@ -731,7 +809,7 @@ class TableStore:
             seg_of = (np.arange(nrows) % nseg).astype(np.int32)
             seg_rows = [np.nonzero(seg_of == s)[0] for s in range(nseg)]
         self._write_segfiles(schema, table, tmeta, enc, valids, seg_rows,
-                             uuid.uuid4().hex[:12])
+                             uuid.uuid4().hex[:12], raw_strs=raw_strs)
         return old_files
 
     GC_GRACE_S = 30.0   # snapshot readers finish well within this
@@ -812,10 +890,11 @@ class TableStore:
                             pass
         return removed
 
-    def replace_contents(self, table: str, enc: dict, valids: dict) -> None:
+    def replace_contents(self, table: str, enc: dict, valids: dict,
+                         raw_strs: dict | None = None) -> None:
         """Autocommit full-table replacement (see stage_replace)."""
         tx = self.manifest.begin()
-        old_files = self.stage_replace(tx, table, enc, valids)
+        old_files = self.stage_replace(tx, table, enc, valids, raw_strs)
         v = self.manifest.prepare(tx)
         self.manifest.commit(v)
         self.gc_files(table, old_files)
@@ -899,6 +978,8 @@ class TableStore:
         (compile-time schema for the executor's input staging)."""
         if col.startswith("@hp:"):
             col = col.split(":", 2)[1]   # predicate nullability = column's
+        elif col.startswith("@rc:"):
+            col = col[4:]                # code nullability = column's
         snap = snapshot or self.manifest.snapshot()
         schema = self.catalog.get(table) if table in self.catalog else None
         names = (schema.storage_tables()

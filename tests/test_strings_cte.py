@@ -237,9 +237,12 @@ def test_raw_numeric_projection_rejected(rawdb):
         rawdb.sql("select length(c) from r")
 
 
-def test_raw_group_by_function_rejected(rawdb):
-    with pytest.raises(SqlError):
-        rawdb.sql("select upper(c), count(*) from r group by upper(c)")
+def test_raw_group_by_function(rawdb):
+    # round-2: function-of-raw group keys lower through the transient
+    # dictionary + derived-dictionary LUT chain
+    r = rawdb.sql("select upper(c) as u, count(*) from r group by upper(c) "
+                  "order by u")
+    assert r.rows() == [("  PAD  ", 1), ("BYE", 1), ("HELLO WORLD", 1)]
 
 
 def test_left_right_functions(db):
@@ -264,12 +267,13 @@ def test_raw_chain_through_subquery(rawdb):
     assert [x[1] for x in r.rows()] == ["Hello World", "bye", "pad"]
 
 
-def test_raw_order_by_chain_rejected(rawdb):
-    # sorting on a host-decoded chain would sort by device surrogate
-    with pytest.raises(SqlError, match="sort key"):
-        rawdb.sql("select a from r order by length(c)")
-    with pytest.raises(SqlError, match="sort key"):
-        rawdb.sql("select a from r order by upper(c)")
+def test_raw_order_by_chain(rawdb):
+    # round-2: raw sort keys ride transient-dictionary codes; chains
+    # (length/upper) compose through derived dictionaries
+    assert [x[0] for x in rawdb.sql(
+        "select a from r order by length(c), a").rows()] == [2, 3, 1]
+    assert [x[0] for x in rawdb.sql(
+        "select a from r order by upper(c)").rows()] == [3, 2, 1]
 
 
 def test_raw_chain_case_through_subquery_rejected(rawdb):
