@@ -53,6 +53,12 @@ class Settings:
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True
+    # continuous archiving (archive_mode/archive_command analog): after
+    # each committed write, ship the new manifest version + its new
+    # segment files to archive_dir (storage/archive.py); `gg restore-pitr`
+    # rebuilds any archived version
+    archive_mode: bool = False
+    archive_dir: str = ""
 
     _overrides: dict = field(default_factory=dict)
 

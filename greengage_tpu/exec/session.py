@@ -180,6 +180,21 @@ class Database:
                     "statement", what,
                     duration_ms=(time.monotonic() - t0) * 1e3,
                     rows=(len(out) if hasattr(out, "columns") else None))
+            if self.settings.archive_mode and self.settings.archive_dir \
+                    and isinstance(stmt, (
+                        A.CreateTableStmt, A.DropTableStmt, A.AlterTableStmt,
+                        A.CreateExternalTableStmt, A.CreateExtensionStmt,
+                        A.ResourceGroupStmt)):
+                # DDL moves the catalog without a manifest commit: refresh
+                # the archived catalog copy (write paths archive via
+                # _post_commit)
+                from greengage_tpu.storage.archive import Archive
+
+                try:
+                    Archive(self.settings.archive_dir).archive_now(
+                        self.path, self.store)
+                except Exception as e:
+                    self.log.error("archive", f"archiving failed: {e}")
         return out
 
     # ---- multi-host statement protocol (parallel/multihost.py) ---------
@@ -454,10 +469,21 @@ class Database:
         version before the statement returns, so FTS can always promote.
         SET mirror_sync = off trades that away; mirrors then go stale and
         refresh_sync_state() blocks their promotion."""
+        if self.dtm.current is not None and getattr(self.dtm.current, "state", "") == "active":
+            return   # still invisible; replicate/archive at COMMIT
+        if self.settings.archive_mode and self.settings.archive_dir:
+            # continuous archiving: ship the committed version before the
+            # statement returns (archive_command semantics); a failing
+            # archive logs but never fails the write
+            from greengage_tpu.storage.archive import Archive
+
+            try:
+                Archive(self.settings.archive_dir).archive_now(
+                    self.path, self.store)
+            except Exception as e:
+                self.log.error("archive", f"archiving failed: {e}")
         if self.replicator is None:
             return
-        if self.dtm.current is not None and getattr(self.dtm.current, "state", "") == "active":
-            return   # still invisible; replicate at COMMIT
         if self.settings.mirror_sync:
             self.replicator.sync()
         else:

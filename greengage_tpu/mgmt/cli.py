@@ -626,6 +626,44 @@ def cmd_recover(args):
     return 0
 
 
+def cmd_archive(args):
+    """Continuous-archiving catch-up (archive_command analog): ship the
+    current committed version to the archive. Per-commit archiving is a
+    session GUC (SET archive_mode TO on; SET archive_dir TO '...')."""
+    from greengage_tpu.storage.archive import Archive
+
+    db = _open(args.dir)
+    a = Archive(args.archive)
+    v = a.archive_now(args.dir, db.store)
+    if v is None:
+        print(f"version {db.store.manifest.snapshot().get('version', 0)} "
+              "already archived")
+    else:
+        print(f"archived version {v} to {args.archive}")
+        db.log.info("archive", f"manual archive of v{v} to {args.archive}")
+    vs = a.versions()
+    print(f"archive holds {len(vs)} versions "
+          f"(v{vs[0][0]}..v{vs[-1][0]})" if vs else "archive is empty")
+    return 0
+
+
+def cmd_restore_pitr(args):
+    """PITR: rebuild a cluster directory at an archived version or the
+    newest version at/before a timestamp (recovery_target_time)."""
+    from greengage_tpu.storage.archive import Archive
+
+    a = Archive(args.archive)
+    try:
+        v = a.restore(args.dir, version=args.version, time=args.time)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"restored version {v} into {args.dir}")
+    vs = dict(a.versions())
+    print(f"recovery target: v{v} (archived {vs.get(v)})")
+    return 0
+
+
 def cmd_backup(args):
     """Full backup (gp_pitr/pg_basebackup analog). The manifest snapshot
     names one committed version's files; DELETE/UPDATE/expand may GC old
@@ -826,6 +864,18 @@ def main(argv=None):
     p = sub.add_parser("checkcat")
     p.add_argument("-d", "--dir", required=True)
     p.set_defaults(fn=cmd_checkcat)
+
+    p = sub.add_parser("archive")       # WAL-archive analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-a", "--archive", required=True)
+    p.set_defaults(fn=cmd_archive)
+
+    p = sub.add_parser("restore-pitr")  # point-in-time recovery
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-a", "--archive", required=True)
+    p.add_argument("-v", "--version", type=int, default=None)
+    p.add_argument("-t", "--time", default=None)
+    p.set_defaults(fn=cmd_restore_pitr)
 
     p = sub.add_parser("backup")
     p.add_argument("-d", "--dir", required=True)

@@ -1,0 +1,236 @@
+"""Continuous archiving + point-in-time recovery — the WAL-archive analog.
+
+The reference ships WAL segments to an archive (archive_command,
+src/backend/access/transam/xlogarchive.c) and replays them to a recovery
+target (PITR). This engine's "WAL" is the manifest-version sequence: each
+commit atomically publishes manifest v+1 whose file lists fully determine
+the cluster contents, and segment files are immutable once written
+(append-only storage; DML republishes under NEW filenos). So archiving is:
+
+  per committed version v: copy the manifest (tiny) + the segment files
+  NEW since the previously archived version (diffed against its archived
+  manifest — incremental by construction, file names embed unique
+  filenos and are never rewritten) + the catalog + the append-only
+  dictionaries (a newer superset decodes any older version's codes).
+
+Durability details: every file lands via temp-write + os.replace (a
+crash mid-copy never leaves a truncated file that looks archived), the
+whole archive pass runs under an flock (concurrent per-commit archiving
+and `gg archive` catch-up serialize instead of losing index entries),
+and the index entry is written last, marking the version complete.
+Timestamps are UTC (recovery_target_time comparisons stay monotonic).
+
+PITR rebuilds a cluster directory from the archived manifest at the
+requested version/timestamp and the files it references. Restore targets
+an EMPTY directory (like pg_basebackup -D), and the restored cluster
+starts with mirrors marked unsynced (run `gg replicate` after).
+"""
+
+from __future__ import annotations
+
+import datetime
+import fcntl
+import json
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="microseconds")
+
+
+def _atomic_copy(src: str, dst: str) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst), prefix=".arch")
+    os.close(fd)
+    shutil.copy(src, tmp)
+    os.replace(tmp, dst)
+
+
+def _atomic_write(dst: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst), prefix=".arch")
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, dst)
+
+
+class Archive:
+    def __init__(self, path: str):
+        self.path = path
+
+    # ---- layout --------------------------------------------------------
+    def _p(self, *parts) -> str:
+        return os.path.join(self.path, *parts)
+
+    @contextmanager
+    def _locked(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self._p(".lock"), "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            yield
+
+    def _index(self) -> dict:
+        try:
+            with open(self._p("index.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"versions": {}}
+
+    def _save_index(self, idx: dict) -> None:
+        _atomic_write(self._p("index.json"),
+                      json.dumps(idx, indent=1).encode())
+
+    def versions(self) -> list[tuple[int, str]]:
+        idx = self._index()
+        return sorted((int(v), meta["ts"])
+                      for v, meta in idx["versions"].items())
+
+    # ---- archive one committed version ---------------------------------
+    def archive_now(self, cluster_path: str, store) -> int | None:
+        """Archive the cluster's CURRENT committed snapshot. Returns the
+        version newly archived, or None if it was already archived (the
+        catalog copy is still refreshed then — DDL changes the catalog
+        without bumping the manifest version)."""
+        with self._locked():
+            return self._archive_locked(cluster_path, store)
+
+    def _archive_locked(self, cluster_path: str, store) -> int | None:
+        snap = store.manifest.snapshot()
+        v = snap.get("version", 0)
+        idx = self._index()
+        cat_src = os.path.join(cluster_path, "catalog.json")
+        cat_dst = self._p("catalogs", f"catalog.{v}.json")
+        if str(v) in idx["versions"]:
+            # segment data for v is complete; refresh the catalog if DDL
+            # moved it since (otherwise a post-archive CREATE TABLE would
+            # be unrecoverable)
+            with open(cat_src, "rb") as f:
+                cur = f.read()
+            try:
+                with open(cat_dst, "rb") as f:
+                    old = f.read()
+            except OSError:
+                old = None
+            if cur != old:
+                _atomic_write(cat_dst, cur)
+            return None
+        # diff against the newest archived version's manifest: only files
+        # new since then need copying (plus belt-and-braces existence
+        # checks — atomic copies mean an existing file IS complete)
+        prev_rels: set = set()
+        archived = [int(k) for k in idx["versions"]]
+        if archived:
+            pv = max(archived)
+            try:
+                with open(self._p("manifests", f"manifest.{pv}.json")) as f:
+                    pm = json.load(f)
+                for tname, tmeta in pm.get("tables", {}).items():
+                    for files in tmeta["segfiles"].values():
+                        for rel in files:
+                            prev_rels.add((tname, rel))
+            except (OSError, ValueError):
+                pass   # fall back to per-file existence checks
+        copied = 0
+        for tname, tmeta in snap["tables"].items():
+            dst_base = self._p("files", tname)
+            for segkey, files in tmeta["segfiles"].items():
+                # reads follow the store's failover redirect: a promoted
+                # mirror's tree holds this content's current files
+                src_base = os.path.join(store.data_root(int(segkey)), tname)
+                for rel in files:
+                    dst = os.path.join(dst_base, rel)
+                    if (tname, rel) in prev_rels or os.path.exists(dst):
+                        continue
+                    _atomic_copy(os.path.join(src_base, rel), dst)
+                    copied += 1
+            # dictionaries: append-only -> latest copy serves all
+            # versions; skip when the size is unchanged
+            src_dict_base = os.path.join(cluster_path, "data", tname)
+            if os.path.isdir(src_dict_base):
+                for fn in os.listdir(src_dict_base):
+                    if not fn.startswith("dict_"):
+                        continue
+                    src = os.path.join(src_dict_base, fn)
+                    dst = os.path.join(dst_base, fn)
+                    try:
+                        if os.path.getsize(dst) == os.path.getsize(src):
+                            continue
+                    except OSError:
+                        pass
+                    _atomic_copy(src, dst)
+        _atomic_write(self._p("manifests", f"manifest.{v}.json"),
+                      json.dumps(snap, indent=1).encode())
+        with open(cat_src, "rb") as f:
+            _atomic_write(cat_dst, f.read())
+        # index entry LAST: it marks the version complete
+        idx = self._index()
+        idx["versions"][str(v)] = {"ts": _utcnow(), "files": copied}
+        self._save_index(idx)
+        return v
+
+    # ---- PITR ----------------------------------------------------------
+    def resolve_target(self, version: int | None = None,
+                       time: str | None = None) -> int:
+        """Recovery target: the newest archived version <= the requested
+        version / UTC timestamp (recovery_target_time semantics)."""
+        vs = self.versions()
+        if not vs:
+            raise ValueError("archive is empty")
+        if version is None and time is None:
+            return vs[-1][0]
+        best = None
+        for v, ts in vs:
+            if version is not None and v > version:
+                continue
+            if time is not None and ts > time:
+                continue
+            best = v if best is None else max(best, v)
+        if best is None:
+            raise ValueError(
+                f"no archived version at or before the requested target "
+                f"(earliest is v{vs[0][0]} @ {vs[0][1]})")
+        return best
+
+    def restore(self, target_dir: str, version: int | None = None,
+                time: str | None = None) -> int:
+        """Rebuild a cluster directory at the recovery target. The
+        manifest is written LAST so a half-restored directory is never
+        openable as a valid cluster."""
+        v = self.resolve_target(version, time)
+        os.makedirs(target_dir, exist_ok=True)
+        if os.path.exists(os.path.join(target_dir, "manifest.json")):
+            raise ValueError(
+                f"refusing to restore into {target_dir}: already a cluster "
+                "(manifest.json exists)")
+        with open(self._p("manifests", f"manifest.{v}.json")) as f:
+            snap = json.load(f)
+        with open(self._p("catalogs", f"catalog.{v}.json")) as f:
+            cat = json.load(f)
+        # the restored tree has no mirror data: mark mirrors unsynced so
+        # FTS cannot promote a mirror that was never rebuilt here
+        for ent in cat.get("segments", {}).get("entries", []):
+            if ent.get("role") == "m" or ent.get("preferred_role") == "m":
+                ent["synced"] = False
+        with open(os.path.join(target_dir, "catalog.json"), "w") as f:
+            json.dump(cat, f, indent=1)
+        for tname, tmeta in snap["tables"].items():
+            src_base = self._p("files", tname)
+            dst_base = os.path.join(target_dir, "data", tname)
+            if os.path.isdir(src_base):
+                for fn in os.listdir(src_base):
+                    if fn.startswith("dict_"):
+                        os.makedirs(dst_base, exist_ok=True)
+                        shutil.copy(os.path.join(src_base, fn),
+                                    os.path.join(dst_base, fn))
+            for files in tmeta["segfiles"].values():
+                for rel in files:
+                    dst = os.path.join(dst_base, rel)
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy(os.path.join(src_base, rel), dst)
+        with open(os.path.join(target_dir, "manifest.json"), "w") as f:
+            json.dump(snap, f, indent=1)
+        return v
