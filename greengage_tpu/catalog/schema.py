@@ -102,6 +102,11 @@ class TableSchema:
     # column name) + the partition set; None = unpartitioned
     partition_by: tuple | None = None
     partitions: list[Partition] = field(default_factory=list)
+    # secondary indexes: name -> {"column": col, "using": "btree"|"bitmap"}.
+    # Both access methods lower to the same per-segfile block-value index
+    # (storage sidecars; see table_store.block_index) — the pg_index
+    # analog that turns unclustered equality scans block-selective
+    indexes: dict = field(default_factory=dict)
 
     def __post_init__(self):
         names = [c.name for c in self.columns]
@@ -241,6 +246,7 @@ class TableSchema:
             **({"partition_by": list(self.partition_by),
                 "partitions": [p.to_dict() for p in self.partitions]}
                if self.partition_by is not None else {}),
+            **({"indexes": self.indexes} if self.indexes else {}),
         }
 
     @staticmethod
@@ -261,4 +267,5 @@ class TableSchema:
             from greengage_tpu.planner.stats import TableStats
 
             schema.stats = TableStats.from_dict(d["stats"])
+        schema.indexes = d.get("indexes", {})
         return schema

@@ -184,7 +184,8 @@ class Database:
                     and isinstance(stmt, (
                         A.CreateTableStmt, A.DropTableStmt, A.AlterTableStmt,
                         A.CreateExternalTableStmt, A.CreateExtensionStmt,
-                        A.ResourceGroupStmt)):
+                        A.ResourceGroupStmt, A.CreateIndexStmt,
+                        A.DropIndexStmt)):
                 # DDL moves the catalog without a manifest commit: refresh
                 # the archived catalog copy (write paths archive via
                 # _post_commit)
@@ -382,6 +383,10 @@ class Database:
             return self._create_external_table(stmt)
         if isinstance(stmt, A.AnalyzeStmt):
             return self._analyze(stmt.table)
+        if isinstance(stmt, A.CreateIndexStmt):
+            return self._create_index(stmt)
+        if isinstance(stmt, A.DropIndexStmt):
+            return self._drop_index(stmt)
         if isinstance(stmt, A.CreateExtensionStmt):
             return self._create_extension(stmt)
         if isinstance(stmt, A.CloseCursorStmt):
@@ -429,6 +434,64 @@ class Database:
         raise SqlError(f"unsupported statement {type(stmt).__name__}")
 
     # ------------------------------------------------------------------
+    def _create_index(self, stmt: A.CreateIndexStmt) -> str:
+        """CREATE INDEX (pg_index analog): registers the index and builds
+        the per-segfile block-value sidecars eagerly so the first probe
+        doesn't pay the build. 'btree' and 'bitmap' both lower to the
+        block-value index (see TableStore.block_index)."""
+        if stmt.using not in ("btree", "bitmap"):
+            raise SqlError(f"unknown index access method {stmt.using!r}")
+        for schema in (self.catalog.get(t) for t in self.catalog.tables):
+            if stmt.name in schema.indexes:
+                if stmt.if_not_exists:
+                    return "CREATE INDEX"
+                raise SqlError(f'index "{stmt.name}" already exists')
+        schema = self.catalog.get(stmt.table)
+        if self._external_def(schema) is not None:
+            raise SqlError("cannot index an external table")
+        col = schema.column(stmt.column)
+        if col.type.kind is T.Kind.TEXT and col.encoding == "raw":
+            raise SqlError(
+                "raw-encoded text cannot be indexed (block indexes probe "
+                "storage values; raw storage has no per-row value column)")
+        schema.indexes[stmt.name] = {"column": stmt.column,
+                                     "using": stmt.using}
+        self.catalog._save()
+        self._build_index_sidecars(schema)
+        self._select_cache.clear()
+        # staged-input cache entries predate the index (same manifest
+        # version): drop them so the next scan actually prunes
+        getattr(self.executor, "_stage_cache", {}).clear()
+        return "CREATE INDEX"
+
+    def _build_index_sidecars(self, schema) -> None:
+        snap = self.store.manifest.snapshot()
+        for storage in schema.storage_tables():
+            tmeta = snap["tables"].get(storage)
+            if not tmeta:
+                continue
+            cols = {d["column"] for d in schema.indexes.values()}
+            for segkey, files in tmeta["segfiles"].items():
+                base = os.path.join(
+                    self.store.data_root(int(segkey)), storage)
+                for rel in files:
+                    fn = os.path.basename(rel)
+                    parts = fn.split(".")
+                    if len(parts) == 3 and fn.endswith(".ggb") \
+                            and parts[0] in cols:
+                        self.store.block_index(base, rel)
+
+    def _drop_index(self, stmt: A.DropIndexStmt) -> str:
+        for schema in (self.catalog.get(t) for t in self.catalog.tables):
+            if stmt.name in schema.indexes:
+                del schema.indexes[stmt.name]
+                self.catalog._save()
+                self._select_cache.clear()
+                return "DROP INDEX"
+        if stmt.if_exists:
+            return "DROP INDEX"
+        raise SqlError(f'index "{stmt.name}" does not exist')
+
     def _create_extension(self, stmt) -> str:
         """Import the extension module (registering its UDFs) and record
         it in the catalog so reopened clusters and workers reload it

@@ -125,6 +125,14 @@ class Planner:
                     prune.append((by_id[lhs.name], op, int(v)))
                 elif isinstance(v, (float, np.floating)):
                     prune.append((by_id[lhs.name], op, float(v)))
+            elif op == "=" and lhs.type.kind is T.Kind.TEXT \
+                    and rhs.value is not None \
+                    and isinstance(rhs.value, (int, np.integer)):
+                # dict-TEXT equality: the literal is already a storage
+                # code. Codes are unordered, so ONLY equality is sound —
+                # and it is, for both zone maps (code outside a block's
+                # [min, max] cannot be present) and the block index
+                prune.append((by_id[lhs.name], op, int(rhs.value)))
         if prune:
             child.prune_preds = tuple(prune)
         if child.parts is not None and schema.is_partitioned:

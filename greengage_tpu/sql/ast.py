@@ -334,6 +334,21 @@ class TxStmt(ANode):
 
 
 @dataclass
+class CreateIndexStmt(ANode):
+    name: str
+    table: str
+    column: str
+    using: str = "btree"
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropIndexStmt(ANode):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class AnalyzeStmt(ANode):
     table: str | None = None   # None = every table
 

@@ -703,6 +703,22 @@ class Parser:
                 self.expect("kw", "exists")
                 ine = True
             return A.CreateExtensionStmt(self.expect("name")[1], ine)
+        if self.accept_word("index"):
+            ine = False
+            if self.accept("kw", "if"):
+                self.expect("kw", "not")
+                self.expect("kw", "exists")
+                ine = True
+            name = self.expect("name")[1]
+            self.expect("kw", "on")
+            table = self.expect("name")[1]
+            using = "btree"
+            if self.accept_word("using"):
+                using = self.next()[1]
+            self.expect("op", "(")
+            col = self.expect("name")[1]
+            self.expect("op", ")")
+            return A.CreateIndexStmt(name, table, col, using, ine)
         self.expect("kw", "table")
         ine = False
         if self.accept("kw", "if"):
@@ -893,6 +909,12 @@ class Parser:
         if self.accept_word("resource"):
             self.expect_word("group")
             return A.ResourceGroupStmt("drop", self.expect("name")[1])
+        if self.accept_word("index"):
+            ie = False
+            if self.accept("kw", "if"):
+                self.expect("kw", "exists")
+                ie = True
+            return A.DropIndexStmt(self.expect("name")[1], ie)
         self.expect("kw", "table")
         ie = False
         if self.accept("kw", "if"):

@@ -42,6 +42,15 @@ def _utcnow() -> str:
         timespec="microseconds")
 
 
+def _parse_ts(s: str) -> datetime.datetime:
+    """Accept both ISO-T and the PG-style 'YYYY-MM-DD HH:MM:SS' recovery
+    target form; naive timestamps are taken as UTC."""
+    dt = datetime.datetime.fromisoformat(s.replace(" ", "T"))
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt
+
+
 def _atomic_copy(src: str, dst: str) -> None:
     os.makedirs(os.path.dirname(dst), exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst), prefix=".arch")
@@ -103,20 +112,28 @@ class Archive:
         v = snap.get("version", 0)
         idx = self._index()
         cat_src = os.path.join(cluster_path, "catalog.json")
-        cat_dst = self._p("catalogs", f"catalog.{v}.json")
         if str(v) in idx["versions"]:
-            # segment data for v is complete; refresh the catalog if DDL
-            # moved it since (otherwise a post-archive CREATE TABLE would
-            # be unrecoverable)
+            # segment data for v is complete; catalog-only DDL since then
+            # lands as a NEW timestamped catalog revision — never
+            # overwriting an earlier one (a DROP TABLE must not destroy
+            # the archive's ability to restore the pre-drop catalog)
+            ent = idx["versions"][str(v)]
+            revs = ent.setdefault("catalogs", [{"k": 0, "ts": ent["ts"]}])
+            last_k = revs[-1]["k"]
             with open(cat_src, "rb") as f:
                 cur = f.read()
             try:
-                with open(cat_dst, "rb") as f:
+                with open(self._p("catalogs",
+                                  f"catalog.{v}.{last_k}.json"), "rb") as f:
                     old = f.read()
             except OSError:
                 old = None
             if cur != old:
-                _atomic_write(cat_dst, cur)
+                k = last_k + 1
+                _atomic_write(self._p("catalogs", f"catalog.{v}.{k}.json"),
+                              cur)
+                revs.append({"k": k, "ts": _utcnow()})
+                self._save_index(idx)
             return None
         # diff against the newest archived version's manifest: only files
         # new since then need copying (plus belt-and-braces existence
@@ -148,14 +165,17 @@ class Archive:
                     _atomic_copy(os.path.join(src_base, rel), dst)
                     copied += 1
             # dictionaries: append-only -> latest copy serves all
-            # versions; skip when the size is unchanged
-            src_dict_base = os.path.join(cluster_path, "data", tname)
+            # versions; skip when the size is unchanged. Partition
+            # children ('t#p1') share the PARENT's dictionary files
+            parent = tname.split("#", 1)[0]
+            src_dict_base = os.path.join(cluster_path, "data", parent)
+            dict_dst_base = self._p("files", parent)
             if os.path.isdir(src_dict_base):
                 for fn in os.listdir(src_dict_base):
                     if not fn.startswith("dict_"):
                         continue
                     src = os.path.join(src_dict_base, fn)
-                    dst = os.path.join(dst_base, fn)
+                    dst = os.path.join(dict_dst_base, fn)
                     try:
                         if os.path.getsize(dst) == os.path.getsize(src):
                             continue
@@ -165,10 +185,13 @@ class Archive:
         _atomic_write(self._p("manifests", f"manifest.{v}.json"),
                       json.dumps(snap, indent=1).encode())
         with open(cat_src, "rb") as f:
-            _atomic_write(cat_dst, f.read())
+            _atomic_write(self._p("catalogs", f"catalog.{v}.0.json"),
+                          f.read())
         # index entry LAST: it marks the version complete
         idx = self._index()
-        idx["versions"][str(v)] = {"ts": _utcnow(), "files": copied}
+        ts = _utcnow()
+        idx["versions"][str(v)] = {"ts": ts, "files": copied,
+                                   "catalogs": [{"k": 0, "ts": ts}]}
         self._save_index(idx)
         return v
 
@@ -182,11 +205,12 @@ class Archive:
             raise ValueError("archive is empty")
         if version is None and time is None:
             return vs[-1][0]
+        target = _parse_ts(time) if time is not None else None
         best = None
         for v, ts in vs:
             if version is not None and v > version:
                 continue
-            if time is not None and ts > time:
+            if target is not None and _parse_ts(ts) > target:
                 continue
             best = v if best is None else max(best, v)
         if best is None:
@@ -208,7 +232,18 @@ class Archive:
                 "(manifest.json exists)")
         with open(self._p("manifests", f"manifest.{v}.json")) as f:
             snap = json.load(f)
-        with open(self._p("catalogs", f"catalog.{v}.json")) as f:
+        # catalog revision: with a time target, the last revision at or
+        # before it (recovers schemas later DDL dropped); otherwise the
+        # latest revision of the target version
+        revs = self._index()["versions"][str(v)].get(
+            "catalogs", [{"k": 0, "ts": ""}])
+        k = revs[-1]["k"]
+        if time is not None:
+            target = _parse_ts(time)
+            eligible = [r["k"] for r in revs
+                        if not r["ts"] or _parse_ts(r["ts"]) <= target]
+            k = eligible[-1] if eligible else revs[0]["k"]
+        with open(self._p("catalogs", f"catalog.{v}.{k}.json")) as f:
             cat = json.load(f)
         # the restored tree has no mirror data: mark mirrors unsynced so
         # FTS cannot promote a mirror that was never rebuilt here
@@ -220,12 +255,16 @@ class Archive:
         for tname, tmeta in snap["tables"].items():
             src_base = self._p("files", tname)
             dst_base = os.path.join(target_dir, "data", tname)
-            if os.path.isdir(src_base):
-                for fn in os.listdir(src_base):
+            # dictionaries live under the PARENT name for partition children
+            parent = tname.split("#", 1)[0]
+            pdict_src = self._p("files", parent)
+            pdict_dst = os.path.join(target_dir, "data", parent)
+            if os.path.isdir(pdict_src):
+                for fn in os.listdir(pdict_src):
                     if fn.startswith("dict_"):
-                        os.makedirs(dst_base, exist_ok=True)
-                        shutil.copy(os.path.join(src_base, fn),
-                                    os.path.join(dst_base, fn))
+                        os.makedirs(pdict_dst, exist_ok=True)
+                        shutil.copy(os.path.join(pdict_src, fn),
+                                    os.path.join(pdict_dst, fn))
             for files in tmeta["segfiles"].values():
                 for rel in files:
                     dst = os.path.join(dst_base, rel)

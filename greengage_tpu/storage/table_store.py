@@ -17,6 +17,7 @@ dictionary code, so placement is stable across dictionary growth.
 from __future__ import annotations
 
 import os
+import tempfile
 import uuid
 
 import numpy as np
@@ -425,11 +426,66 @@ class TableStore:
     # ---- read path -----------------------------------------------------
     last_prune: tuple | None = None   # (blocks kept, blocks total) of last read
 
-    def _kept_blocks(self, files, base, prune):
-        """Per data-fileno block keep-list from zone maps: a block survives
-        only if EVERY pushed predicate could match its [zmin, zmax].
-        -> ({fileno: [block idx]}, kept, total); filenos absent from the
-        dict keep all blocks."""
+    def block_index(self, base: str, rel: str):
+        """Per-segfile block-value index (the btree/bitmap AM analog for
+        append-only block storage): sorted (value, block) pairs, deduped
+        per block, as a rebuildable .bidx.npz sidecar next to the data
+        file. An equality probe binary-searches the values and returns
+        exactly the blocks containing the key — block-selective scans on
+        UNCLUSTERED data, where zone maps (which need clustering) keep
+        everything. Low-NDV columns degenerate to few (value, block)
+        runs — the bitmap-index shape; high-NDV to a dense sorted list —
+        the btree shape. Sidecars are derived data: built lazily, not in
+        the manifest, reaped with their data file."""
+        from greengage_tpu.storage.blockfile import (read_column_file,
+                                                     read_footer)
+
+        path = os.path.join(base, rel)
+        sidecar = path[:-len(".ggb")] + ".bidx.npz"
+        try:
+            if os.path.getmtime(sidecar) >= os.path.getmtime(path):
+                with np.load(sidecar) as z:
+                    return z["values"], z["blocks"]
+        except (OSError, ValueError, KeyError):
+            pass
+        footer = read_footer(path)
+        data = read_column_file(path)
+        vals_parts, blk_parts = [], []
+        row = 0
+        for i, b in enumerate(footer["blocks"]):
+            u = np.unique(data[row:row + b["nrows"]])
+            vals_parts.append(u)
+            blk_parts.append(np.full(len(u), i, np.int32))
+            row += b["nrows"]
+        values = (np.concatenate(vals_parts) if vals_parts
+                  else np.empty(0, data.dtype))
+        blocks = (np.concatenate(blk_parts) if blk_parts
+                  else np.empty(0, np.int32))
+        order = np.argsort(values, kind="stable")
+        values, blocks = values[order], blocks[order]
+        try:
+            fd, tmp = tempfile.mkstemp(dir=base, prefix=".bidx",
+                                       suffix=".npz")
+            os.close(fd)
+            np.savez(tmp, values=values, blocks=blocks)
+            os.replace(tmp, sidecar)
+        except OSError:
+            pass   # cache write failure: the in-memory index still serves
+        return values, blocks
+
+    @staticmethod
+    def _index_blocks_for(values, blocks, val) -> set:
+        """Blocks containing ``val`` (equality probe)."""
+        lo = np.searchsorted(values, val, side="left")
+        hi = np.searchsorted(values, val, side="right")
+        return set(blocks[lo:hi].tolist())
+
+    def _kept_blocks(self, files, base, prune, indexed_cols=frozenset()):
+        """Per data-fileno block keep-list: a block survives only if EVERY
+        pushed predicate could match its zone map [zmin, zmax] AND, for
+        equality predicates on indexed columns, the block index says the
+        key is present. -> ({fileno: [block idx]}, kept, total); filenos
+        absent from the dict keep all blocks."""
         from greengage_tpu.storage.blockfile import read_footer
 
         keep: dict[str, list[int]] = {}
@@ -449,8 +505,19 @@ class TableStore:
                 continue
             blocks = read_footer(os.path.join(base, rel))["blocks"]
             by_fileno_nblocks[fileno] = len(blocks)
+            idx_keep: set | None = None
+            if col in indexed_cols:
+                eq_vals = [v for op, v in preds if op == "="]
+                if eq_vals:
+                    vals, blks = self.block_index(base, rel)
+                    for v in eq_vals:
+                        hit = self._index_blocks_for(vals, blks, v)
+                        idx_keep = hit if idx_keep is None \
+                            else idx_keep & hit
             ok = []
             for i, b in enumerate(blocks):
+                if idx_keep is not None and i not in idx_keep:
+                    continue
                 if "zmin" not in b:
                     ok.append(i)
                     continue
@@ -496,7 +563,10 @@ class TableStore:
         keep = None
         self.last_prune = None
         if prune:
-            keep, kept_n, total_n = self._kept_blocks(files, base, prune)
+            idx_cols = frozenset(
+                d["column"] for d in getattr(schema, "indexes", {}).values())
+            keep, kept_n, total_n = self._kept_blocks(files, base, prune,
+                                                      idx_cols)
             self.last_prune = (kept_n, total_n)
         for name in want:
             if name.startswith("@rc:"):
@@ -833,6 +903,13 @@ class TableStore:
                 os.remove(self.seg_file_path(table, rel))
             except OSError:
                 pass
+            if rel.endswith(".ggb") and len(
+                    os.path.basename(rel).split(".")) == 3:
+                try:   # derived block-index sidecar dies with its file
+                    os.remove(self.seg_file_path(table, rel)[:-len(".ggb")]
+                              + ".bidx.npz")
+                except OSError:
+                    pass
 
     def reap_gc(self) -> int:
         """Delete deferred-GC entries older than the grace period."""
