@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 
@@ -219,6 +220,10 @@ def cmd_load(args):
     if not table:
         print("error: output.table is required", file=sys.stderr)
         return 1
+    if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", str(table)):
+        print(f"error: output.table {table!r} is not a valid identifier",
+              file=sys.stderr)
+        return 1
     src = inp.get("source", {})
     if isinstance(src, list):
         src = {k: v for d in src for k, v in d.items()}
@@ -240,17 +245,26 @@ def cmd_load(args):
                 T.Kind.DATE: "date", T.Kind.TEXT: "text"}.get(
                     k, f"decimal(18,{c.type.scale})")
 
+    def lit(v):
+        # YAML-provided values (delimiters, paths) may contain quotes —
+        # escape them the SQL way before splicing into a statement
+        return "'" + str(v).replace("'", "''") + "'"
+
     cols = ", ".join(f"{c.name} {typ(c)}" for c in schema.columns)
     ext = f"gpload_ext_{table}"
     urls = ", ".join(
-        "'" + (u if "://" in u else "file://" + os.path.abspath(u)) + "'"
+        lit(u if "://" in u else "file://" + os.path.abspath(u))
         for u in files)
     fmt_opts = []
     if inp.get("delimiter"):
-        fmt_opts.append(f"delimiter '{inp['delimiter']}'")
+        fmt_opts.append(f"delimiter {lit(inp['delimiter'])}")
     if str(inp.get("header", "")).lower() in ("true", "1", "yes"):
         fmt_opts.append("header")
-    fmt = f"format '{inp.get('format', 'csv')}'"
+    fmt_name = str(inp.get("format", "csv"))
+    if fmt_name not in ("csv", "text"):
+        print(f"error: unsupported format {fmt_name!r}", file=sys.stderr)
+        return 1
+    fmt = f"format '{fmt_name}'"
     if fmt_opts:
         fmt += " (" + " ".join(fmt_opts) + ")"
     reject = ""

@@ -35,12 +35,12 @@ class ClusterLog:
         self._fh_day: datetime.date | None = None
 
     def _path(self, day: datetime.date | None = None) -> str:
-        day = day or datetime.date.today()
+        day = day or datetime.datetime.now(datetime.timezone.utc).date()
         return os.path.join(self.dir, f"ggtpu-{day.isoformat()}.csv")
 
     def _handle(self):
         """Open (or roll to today's) append handle; called under _lock."""
-        day = datetime.date.today()
+        day = datetime.datetime.now(datetime.timezone.utc).date()
         if self._fh is None or self._fh_day != day:
             if self._fh is not None:
                 self._fh.close()
@@ -53,7 +53,10 @@ class ClusterLog:
             duration_ms: float | None = None, rows: int | None = None) -> None:
         if not self.enabled:
             return
-        ts = datetime.datetime.now().isoformat(timespec="milliseconds")
+        # UTC to match the archive index / recovery_target_time: logfilter
+        # timestamps are the natural way to pick a PITR target
+        ts = datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="milliseconds").replace("+00:00", "Z")
         buf = io.StringIO()
         csv.writer(buf).writerow([
             ts, severity, os.getpid(), threading.current_thread().name,

@@ -245,11 +245,22 @@ class Archive:
             k = eligible[-1] if eligible else revs[0]["k"]
         with open(self._p("catalogs", f"catalog.{v}.{k}.json")) as f:
             cat = json.load(f)
-        # the restored tree has no mirror data: mark mirrors unsynced so
-        # FTS cannot promote a mirror that was never rebuilt here
+        # restored files always land in the preferred data/ layout, so
+        # acting roles from the archived catalog (e.g. a promoted mirror)
+        # must be reset: role := preferred_role, primaries up, mirrors
+        # down+unsynced until rebuilt (FTS must not promote them)
         for ent in cat.get("segments", {}).get("entries", []):
-            if ent.get("role") == "m" or ent.get("preferred_role") == "m":
+            ent["role"] = ent.get("preferred_role", ent.get("role"))
+            if ent["role"] == "m":
                 ent["synced"] = False
+                ent["status"] = "d"
+                ent["device_index"] = None
+            else:
+                ent["status"] = "u"
+                if ent.get("content", -1) >= 0:
+                    # a promotion moves the device binding to the mirror
+                    # entry; restored primaries must get it back
+                    ent["device_index"] = ent["content"]
         with open(os.path.join(target_dir, "catalog.json"), "w") as f:
             json.dump(cat, f, indent=1)
         for tname, tmeta in snap["tables"].items():

@@ -136,7 +136,20 @@ class TableStore:
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
-        if table in ("@expr", "@rawdict"):
+        if table == "@rawdict":
+            # transient raw-TEXT dicts are bounded-evicted; a cached plan
+            # may still hold an evicted ref — rebuild from the key, which
+            # encodes parent:col:version (exactly raw_dictionary's inputs)
+            if (table, col) not in self._derived:
+                parent, rcol, ver = col.rsplit(":", 2)
+                snap = self.manifest.snapshot()
+                if snap.get("version", 0) != int(ver):
+                    raise KeyError(
+                        f"raw dictionary {col} evicted and manifest moved to "
+                        f"v{snap.get('version', 0)}; plan cache is stale")
+                self.raw_dictionary(parent, rcol, snap)
+            return self._derived[(table, col)]
+        if table == "@expr":
             return self._derived[(table, col)]
         # partition children share the PARENT's dictionary: one code space
         # per logical table, so codes compare/join across partitions
