@@ -155,38 +155,52 @@ def _cross_edges(m1: int, m2: int, members, edge_by_pair):
     return out
 
 
+def _pick_build(r1: float, r2: float):
+    """Partitioned-both-sides default: build the smaller side.
+    -> (build_rows, build_replicated, probe_rows)."""
+    return (r1, False, r2) if r1 <= r2 else (r2, False, r1)
+
+
 def _join_options(p1, a1: _Alt, p2, a2: _Alt, k1, k2, pairmap, nseg: int):
-    """Yield (extra motion cost, output distribution prop) for joining
-    sides with properties p1/p2 over aligned key col-id lists k1/k2 —
-    the cdbpath_motion_for_join decision menu."""
+    """Yield (extra motion cost ns, output distribution prop,
+    (build_rows, build_replicated, probe_rows)) for joining sides with
+    properties p1/p2 over aligned key col-id lists k1/k2 — the
+    cdbpath_motion_for_join decision menu. The build tuple lets the caller
+    charge the hash build at its TRUE per-chip size: a broadcast/replicated
+    build runs full-size on every chip (sort at ~40 ns/row/operand), which
+    a bytes-only model undercharges by ~250x relative to its ICI cost."""
     r1, w1, r2, w2 = a1.rows, a1.width, a2.rows, a2.width
     if p1 == REPL:
-        yield 0.0, (p2 if p2 != REPL else ())
+        yield 0.0, (p2 if p2 != REPL else ()), (r1, True, r2)
         return
     if p2 == REPL:
-        yield 0.0, p1
+        yield 0.0, p1, (r2, True, r1)
         return
     k1set, k2set = set(k1), set(k2)
     colocated = (p1 and len(p1) == len(p2)
                  and all(c in k1set for c in p1)
                  and tuple(pairmap.get(c) for c in p1) == tuple(p2))
     if colocated:
-        yield 0.0, p1
+        yield 0.0, p1, _pick_build(r1, r2)
         return
     if p1 and all(c in k1set for c in p1):
         # move side 2 to match side 1's existing distribution
-        yield C.motion_cost("redistribute", r2, w2, nseg), p1
+        yield (C.motion_cost("redistribute", r2, w2, nseg), p1,
+               _pick_build(r1, r2))
     if p2 and all(c in k2set for c in p2):
-        yield C.motion_cost("redistribute", r1, w1, nseg), p2
-    yield (C.motion_cost("redistribute", r1, w1, nseg)
-           + C.motion_cost("redistribute", r2, w2, nseg)), tuple(k1)
-    yield C.motion_cost("broadcast", r2, w2, nseg), p1
-    yield C.motion_cost("broadcast", r1, w1, nseg), p2
+        yield (C.motion_cost("redistribute", r1, w1, nseg), p2,
+               _pick_build(r1, r2))
+    yield ((C.motion_cost("redistribute", r1, w1, nseg)
+            + C.motion_cost("redistribute", r2, w2, nseg)), tuple(k1),
+           _pick_build(r1, r2))
+    # broadcast side X => X is the (replicated, full-size) build side
+    yield (C.motion_cost("broadcast", r2, w2, nseg), p1, (r2, True, r1))
+    yield (C.motion_cost("broadcast", r1, w1, nseg), p2, (r1, True, r2))
 
 
 def _expand(state: dict, s1: dict, s2: dict, mask1: int, xe, nseg: int) -> None:
     """Add all physical alternatives for joining group s1 x s2 across
-    edges xe into ``state``."""
+    edges xe into ``state``, costed with the calibrated per-chip model."""
     pairs = []
     sel = 1.0
     for e in xe:
@@ -198,15 +212,21 @@ def _expand(state: dict, s1: dict, s2: dict, mask1: int, xe, nseg: int) -> None:
     k1 = [a for a, _ in pairs]
     k2 = [b for _, b in pairs]
     pairmap = dict(pairs)
+    nk = max(len(pairs), 1)
 
     for p1, a1 in s1.items():
         for p2, a2 in s2.items():
             rows = max(a1.rows * a2.rows * sel, 1.0)
             width = a1.width + a2.width
-            # local compute: one HBM pass over both inputs + the output
-            local = a1.rows * a1.width + a2.rows * a2.width + rows * width
-            for extra, prop in _join_options(p1, a1, p2, a2, k1, k2,
-                                             pairmap, nseg):
+            # one HBM pass over both inputs + the output, per chip
+            streams = (C.stream_cost(a1.rows, a1.width, nseg)
+                       + C.stream_cost(a2.rows, a2.width, nseg)
+                       + C.stream_cost(rows, width, nseg))
+            for extra, prop, (brows, brepl, prows) in _join_options(
+                    p1, a1, p2, a2, k1, k2, pairmap, nseg):
+                local = (streams
+                         + C.join_build_cost(brows, nk, nseg, replicated=brepl)
+                         + C.join_probe_cost(prows, nk, nseg))
                 cost = a1.cost + a2.cost + local + extra
                 cur = state.get(prop)
                 if cur is None or cost < cur.cost:

@@ -290,12 +290,20 @@ class Planner:
                                            tuple(r2l[k] for k in right.locus.keys))
             node.locus = right.locus
         else:
-            # neither side usable: redistribute both vs broadcast build side
+            # neither side usable: redistribute both vs broadcast build side.
+            # Calibrated comparison (cost.py): a broadcast build is sorted
+            # FULL-SIZE on every chip (~40 ns/row/operand), so the ICI bytes
+            # it saves must beat that extra build work — a bytes-only model
+            # systematically over-broadcasts mid-size relations.
             lw = C.row_width(left.out_cols())
             rw = C.row_width(right.out_cols())
-            redist = C.motion_cost("redistribute", left.est_rows, lw, nseg) + \
-                C.motion_cost("redistribute", right.est_rows, rw, nseg)
-            bcast = C.motion_cost("broadcast", right.est_rows, rw, nseg)
+            nk = max(len(pairs), 1)
+            redist = (C.motion_cost("redistribute", left.est_rows, lw, nseg)
+                      + C.motion_cost("redistribute", right.est_rows, rw, nseg)
+                      + C.join_build_cost(right.est_rows, nk, nseg))
+            bcast = (C.motion_cost("broadcast", right.est_rows, rw, nseg)
+                     + C.join_build_cost(right.est_rows, nk, nseg,
+                                         replicated=True))
             if bcast < redist:
                 node.right = self._broadcast(right)
                 node.locus = left.locus
@@ -405,6 +413,33 @@ class Planner:
                                         LocusKind.SEGMENT_GENERAL):
             node.phase = "single"
             node.locus = child.locus
+            node.est_rows = groups
+            return node
+
+        # Agg placement is a COSTED alternative (the cdbgroup.c one-stage vs
+        # two-stage choice ORCA explores as memo alternatives):
+        #   two-phase: partial local -> redistribute states -> final merge
+        #   one-phase: redistribute raw rows by group keys -> single agg
+        # When groups ~ rows (high-NDV keys like Q3's l_orderkey), the
+        # partial pass reduces nothing — it pays a full sort-agg AND moves
+        # nearly the same bytes, so shipping raw rows wins.
+        nk = len(node.group_keys)
+        na = max(len(node.aggs), 1)
+        child_w = C.row_width(child.out_cols())
+        state_w = 8.0 * (nk + 2 * na)    # @s/@c/@m partial state columns
+        partial_rows = min(child.est_rows, groups * max(self.nseg, 1))
+        two_cost = (C.agg_cost(child.est_rows, groups, nk, na, child_w, self.nseg)
+                    + C.motion_cost("redistribute", partial_rows, state_w, self.nseg)
+                    + C.agg_cost(partial_rows, groups, nk, na, state_w, self.nseg))
+        one_cost = (C.motion_cost("redistribute", child.est_rows, child_w, self.nseg)
+                    + C.agg_cost(child.est_rows, groups, nk, na, child_w, self.nseg))
+        all_colrefs = all(isinstance(e, E.ColRef) for _, e in node.group_keys)
+        if all_colrefs and child.locus.is_partitioned and one_cost < two_cost:
+            moved = self._redistribute(
+                node.child, [e for _, e in node.group_keys], key_ids)
+            node.child = moved
+            node.phase = "single"
+            node.locus = moved.locus
             node.est_rows = groups
             return node
 
