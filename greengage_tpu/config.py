@@ -22,6 +22,12 @@ class Settings:
     # dense group-by path: used when the product of group-key domains
     # (dictionary sizes / bool) is at most this (scatter-free aggregation)
     dense_group_limit: int = 512
+    # fused single-pass dense aggregation (ops/fused_agg.py pallas kernel):
+    # one HBM pass for every aggregate of a small-domain GROUP BY; falls
+    # back to the XLA per-aggregate path on unsupported shapes or kernel
+    # compile failure (executor disables it for the retry)
+    fused_dense_agg: bool = True
+    fused_dense_min_rows: int = 1 << 16
     # motion (gp_interconnect_queue_depth analog)
     motion_capacity_slack: float = 1.6  # per-destination bucket headroom
     motion_retry_tiers: int = 3         # capacity x4 per retry on overflow

@@ -67,6 +67,7 @@ class CompileResult:
     flag_caps: dict = field(default_factory=dict)
     est_bytes: int = 0                 # rough per-segment device allocation
     node_rows: dict = field(default_factory=dict)  # metric -> plan node id
+    flag_packs: dict = field(default_factory=dict)  # pack flag -> plan id
 
 
 class Compiler:
@@ -74,7 +75,9 @@ class Compiler:
                  settings: Settings, tier: int = 0,
                  cap_overrides: dict | None = None, instrument: bool = False,
                  multihost: bool = False, scan_cap_override: dict | None = None,
-                 aux_tables: dict | None = None):
+                 aux_tables: dict | None = None,
+                 pack_disabled: set | None = None,
+                 fused_disabled: bool = False):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -86,6 +89,13 @@ class Compiler:
         self.flags: list[str] = []
         self.metrics: list[str] = []
         self.flag_caps: dict = {}
+        # key packing from ANALYZE bounds: a bounds violation (stale stats)
+        # re-runs the SAME tier with that node's packing disabled
+        self.pack_disabled = pack_disabled or set()
+        self.flag_packs: dict = {}         # pack flag id -> plan node id
+        # fused dense-agg kernel: disabled wholesale after a pallas
+        # compile failure (executor retries with the XLA path)
+        self.fused_disabled = fused_disabled
         self.scan_caps: dict[str, int] = {}
         self.scan_cols: dict[str, set] = {}
         self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
@@ -155,6 +165,35 @@ class Compiler:
 
         compiled = self._compile_node(below)   # closure: ctx -> Batch
         out_cols = below.out_cols()
+
+        # Device-side result compaction before the Gather (Gather Motion,
+        # nodeMotion.c:171): the device->host relay costs ~65ms + 28MB/s
+        # (NOTES.md), so shipping nseg x capacity padded rows for a
+        # selective result is pathological. When estimated live rows sit
+        # far below capacity, stable-sort live-first (2 operands) and ship
+        # a small static slice; the exact live count feeds the overflow
+        # retry. Sorts/Limits already compact; Aggregate outputs are dense
+        # domains or group tables numbered live-first.
+        cap_below = self._capacity_of(below)
+        compact_k = None
+        fid_cmp = mid_cmp = None
+        if (not isinstance(below, (Sort, Limit, Aggregate, PartialState))
+                and cap_below >= (1 << 14)):
+            est = max(getattr(below, "est_rows", 0.0) or 0.0, 1.0)
+            if below.locus is not None and below.locus.is_partitioned \
+                    and self.nseg > 1:
+                est /= self.nseg
+            k = _pow2(int(est * 1.5) + 64) * (4 ** self.tier)
+            if id(plan) in self.cap_overrides:
+                k = _pow2(int(self.cap_overrides[id(plan)]))
+            if k * 2 <= cap_below:
+                compact_k = min(k, cap_below)
+                fid_cmp = f"gather_compact_overflow_{len(self.flags)}"
+                self.flags.append(fid_cmp)
+                mid_cmp = f"gather_compact_total_{len(self.metrics)}"
+                self.metrics.append(mid_cmp)
+                self.flag_caps[fid_cmp] = (id(plan), mid_cmp)
+
         flag_names = list(self.flags)
         nseg = self.nseg
 
@@ -177,6 +216,20 @@ class Compiler:
             ctx["metrics"] = []
             batch = compiled(ctx)
             sel = batch.selection()
+            if compact_k is not None:
+                dead = (~sel).astype(jnp.uint8)
+                rid = jnp.arange(sel.shape[0], dtype=jnp.int32)
+                _, perm = lax.sort((dead, rid), num_keys=2)
+                perm = perm[:compact_k]
+                total = jnp.sum(sel.astype(jnp.int32))
+                ctx["flags"].append((fid_cmp, total > compact_k))
+                ctx["metrics"].append((mid_cmp, total))
+                batch = Batch(
+                    {c.id: batch.cols[c.id][perm] for c in out_cols},
+                    {c.id: batch.valids[c.id][perm] for c in out_cols
+                     if batch.valids.get(c.id) is not None},
+                    jnp.arange(compact_k, dtype=jnp.int32) < total)
+                sel = batch.selection()
             outs = []
             for c in out_cols:
                 outs.append(batch.cols[c.id])
@@ -187,13 +240,20 @@ class Compiler:
                 # gather every segment's shard on device so all processes
                 # hold the full result (the Gather Motion as a collective)
                 outs = [lax.all_gather(o, SEG_AXIS) for o in outs]
-            for _, f in ctx["flags"]:
-                f = f.astype(jnp.int32)
+            # emit in REGISTRATION order (flag_names/metric_names) — the
+            # executor zips values against those name lists, and operators
+            # may append to ctx in a different order than they registered
+            fdict = dict(ctx["flags"])
+            assert len(fdict) == len(flag_names), (
+                sorted(fdict), sorted(flag_names))
+            for name in flag_names:
+                f = fdict[name].astype(jnp.int32)
                 if mh:
                     f = lax.pmax(f, SEG_AXIS)
                 outs.append(jnp.broadcast_to(f, (1,)))
-            for name, m in ctx["metrics"]:
-                m = m.astype(jnp.int64)
+            mdict = dict(ctx["metrics"])
+            for name in metric_names:
+                m = mdict[name].astype(jnp.int64)
                 if mh:
                     m = (lax.psum(m, SEG_AXIS) if name.startswith("nrows_")
                          else lax.pmax(m, SEG_AXIS))
@@ -223,11 +283,13 @@ class Compiler:
             gather_child_locus=below.locus,
             merge_keys=plan.merge_keys,
             host_limit=host_limit,
-            capacity=self._capacity_of(below),
+            capacity=compact_k if compact_k is not None
+            else self._capacity_of(below),
             metric_names=metric_names,
             flag_caps=dict(self.flag_caps),
             est_bytes=self._estimate_bytes(below),
             node_rows=dict(self.node_rows),
+            flag_packs=dict(self.flag_packs),
         )
 
     def _estimate_bytes(self, plan: Plan) -> int:
@@ -524,6 +586,7 @@ class Compiler:
         right_cols = [c for c in plan.right.out_cols()]
 
         null_aware = getattr(plan, "null_aware", False)
+        jkb = getattr(plan, "key_bounds", None)
 
         # direct addressing at tier 0 only: a build-overflow retry (stale
         # stats: live keys outside the analyzed domain) falls back to the
@@ -532,6 +595,15 @@ class Compiler:
                   and self.tier == 0 and len(rkeys) == 1)
         direct_lo = getattr(plan, "direct_lo", 0)
         direct_domain = getattr(plan, "direct_domain", 0)
+        fid_pack = None
+        if (not direct and jkb is not None
+                and id(plan) not in self.pack_disabled
+                and join_ops.join_pack_bits(jkb) is not None):
+            fid_pack = f"pack_overflow_{len(self.flags)}"
+            self.flags.append(fid_pack)
+            self.flag_packs[fid_pack] = id(plan)
+        else:
+            jkb = None
 
         def run(ctx):
             from jax import lax
@@ -547,9 +619,11 @@ class Compiler:
                     table, lspecs[0], lb.selection(), direct_lo)
                 walk_ov = jnp.zeros((), bool)
             else:
-                table = join_ops.build(rspecs, rb.selection(), M, probes)
+                table = join_ops.build(rspecs, rb.selection(), M, probes, jkb)
                 matched, brow, walk_ov = join_ops.probe(
                     table, lspecs, lb.selection(), probes)
+                if fid_pack is not None:
+                    ctx["flags"].append((fid_pack, table.pack_viol))
             ctx["flags"].append((fid_ov, table.overflow | walk_ov))
             if fid_dup is not None:
                 ctx["flags"].append((fid_dup, table.dup))
@@ -622,16 +696,27 @@ class Compiler:
         self.flag_caps[fid_exp] = (id(plan), mid_total)
         left_cols = [c for c in plan.left.out_cols()]
         right_cols = [c for c in plan.right.out_cols()]
+        jkb = getattr(plan, "key_bounds", None)
+        fid_pack = None
+        if (jkb is not None and id(plan) not in self.pack_disabled
+                and join_ops.join_pack_bits(jkb) is not None):
+            fid_pack = f"pack_overflow_{len(self.flags)}"
+            self.flags.append(fid_pack)
+            self.flag_packs[fid_pack] = id(plan)
+        else:
+            jkb = None
 
         def run(ctx):
             lb = left_fn(ctx)
             rb = right_fn(ctx)
             table = join_ops.build_multi(
-                self._key_specs(rb, rkeys), rb.selection(), M, probes)
+                self._key_specs(rb, rkeys), rb.selection(), M, probes, jkb)
             (present, prow, brow, matched, expand_ov, walk_ov,
              total) = join_ops.probe_multi(
                 table, self._key_specs(lb, lkeys), lb.selection(), probes,
                 out_cap, left_outer=(kind == "left"))
+            if fid_pack is not None:
+                ctx["flags"].append((fid_pack, table.pack_viol))
             # walk overflow rides the table flag (tier retry grows M/hop
             # bound); expand overflow rides its own flag whose retry hint
             # sizes out_cap from `total`
@@ -702,12 +787,34 @@ class Compiler:
         keys = plan.group_keys
         aggs = plan.aggs
         phase = plan.phase
+        # packed single-operand group sort from ANALYZE key bounds
+        key_bounds = getattr(plan, "key_bounds", None)
+        fid_pack = None
+        if (use_sort and key_bounds is not None
+                and id(plan) not in self.pack_disabled
+                and agg_ops.pack_bits(key_bounds) is not None):
+            fid_pack = f"pack_overflow_{len(self.flags)}"
+            self.flags.append(fid_pack)
+            self.flag_packs[fid_pack] = id(plan)
+        else:
+            key_bounds = None
+
+        # fused single-pass dense kernel (ops/fused_agg.py): worth the
+        # pallas call only on big batches; interpret mode keeps the CPU
+        # mesh (tests/demo cluster) running the same code path
+        fused_ok = (dense is not None and not self.fused_disabled
+                    and self.s.fused_dense_agg
+                    and (self._capacity_of(plan.child)
+                         >= self.s.fused_dense_min_rows))
+        fused_interpret = self.mesh.devices.flat[0].platform == "cpu"
 
         def run(ctx):
             b = child_fn(ctx)
             sel = b.selection()
             gid = None
             perm = None
+            used = None
+            meta0 = {}
             cols, valids = {}, {}
             if keys and dense is not None:
                 kspecs = self._key_specs(b, [e for _, e in keys])
@@ -715,15 +822,15 @@ class Compiler:
                 decoded = agg_ops.dense_decode_keys(kspecs, dense, M)
                 tkeys = [code for code, _ in decoded]
                 tvalids = [valid for _, valid in decoded]
-                used = jnp.any(
-                    sel[:, None] & (gid[:, None] == jnp.arange(M, dtype=jnp.int32)[None, :]),
-                    axis=0)
             elif keys:
                 # sort-based high-cardinality grouping (execHHashagg spill
                 # regime analog): sort by keys, cumsum-span reduce into the
                 # group table; slot g's keys gather from its first row
                 kspecs = self._key_specs(b, [e for _, e in keys])
-                perm, boundary, sel_sorted = agg_ops.group_sort(kspecs, sel)
+                perm, boundary, sel_sorted, pack_viol = agg_ops.group_sort(
+                    kspecs, sel, key_bounds)
+                if fid_pack is not None:
+                    ctx["flags"].append((fid_pack, pack_viol))
                 tkeys, tvalids = [], []
             else:
                 slots = jnp.where(sel, 0, 1)
@@ -741,7 +848,20 @@ class Compiler:
 
             def do_agg(specs):
                 if gid is not None:
-                    return agg_ops.dense_aggregate(gid, Mx, specs, sel)
+                    # "@used" rides the same pass: per-group live-row
+                    # presence without the extra [n, D] broadcast scan
+                    specs2 = list(specs) + [
+                        agg_ops.AggSpec("@used", "count_star", None, None)]
+                    from greengage_tpu.ops import fused_agg
+                    if fused_ok and fused_agg.supported(specs2):
+                        vals, avalids = fused_agg.fused_dense_aggregate(
+                            gid, Mx, specs2, sel, interpret=fused_interpret)
+                    else:
+                        vals, avalids = agg_ops.dense_aggregate(
+                            gid, Mx, specs2, sel)
+                    meta0["used"] = vals.pop("@used") > 0
+                    avalids.pop("@used", None)
+                    return vals, avalids
                 if perm is not None:
                     ps = [agg_ops.AggSpec(
                         s.name, s.func,
@@ -821,6 +941,8 @@ class Compiler:
                         cols[ci.id] = vals[ci.id]
                         if avalids.get(ci.id) is not None:
                             valids[ci.id] = avalids[ci.id]
+            if gid is not None:
+                used = meta0["used"]
             if perm is not None:
                 # group g's key values gather from its first sorted row
                 rep = perm[meta["srcpos"]]
@@ -905,7 +1027,7 @@ class Compiler:
             # sort by (partition, order); dead rows go to the end
             skeys = self._sort_keys(
                 b, [(e, False, None) for e in pkeys] + list(okeys))
-            perm, sel_sorted = sort_ops.sort_batch(skeys, b.selection(), cap)
+            perm, sel_sorted, _ = sort_ops.sort_batch(skeys, b.selection(), cap)
             cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
             sb = Batch(cols, valids, sel_sorted)
             ev = Evaluator(sb, self.consts)
@@ -1003,11 +1125,27 @@ class Compiler:
         child_fn = self._compile_node(plan.child)
         keys = plan.keys
         cap = self._capacity_of(plan.child)
+        key_bounds = getattr(plan, "key_bounds", None)
+        fid_pack = None
+        if key_bounds is not None and id(plan) not in self.pack_disabled:
+            fid_pack = f"pack_overflow_{len(self.flags)}"
+            self.flags.append(fid_pack)
+            self.flag_packs[fid_pack] = id(plan)
+        else:
+            key_bounds = None
 
         def run(ctx):
             b = child_fn(ctx)
             sk = self._sort_keys(b, keys)
-            perm, sel_sorted = sort_ops.sort_batch(sk, b.selection(), cap)
+            kb = key_bounds
+            if kb is not None and sort_ops.order_pack_bits(sk, kb) is None:
+                kb = None
+            perm, sel_sorted, viol = sort_ops.sort_batch(
+                sk, b.selection(), cap, kb)
+            if fid_pack is not None:
+                ctx["flags"].append(
+                    (fid_pack, viol if viol is not None
+                     else jnp.zeros((), bool)))
             cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
             return Batch(cols, valids, sel_sorted)
 

@@ -159,11 +159,21 @@ class Executor:
         version = snapshot.get("version", 0)
         last_err = None
         cap_overrides: dict = {}
-        for tier in range(self.settings.motion_retry_tiers):
+        pack_disabled: set = set()
+        fused_disabled = False
+        tier = 0
+        attempts = 0
+        # tiers grow capacities; a key-packing bounds violation (stale
+        # ANALYZE stats) instead re-runs the SAME tier unpacked, so the
+        # attempt bound covers both kinds of retry
+        while tier < self.settings.motion_retry_tiers \
+                and attempts < self.settings.motion_retry_tiers + 4:
+            attempts += 1
             ck = ((cache_key, version, tier) if cache_key is not None
                   and not cap_overrides and not instrument
                   and not scan_cap_override and not row_ranges
-                  and not aux_tables else None)
+                  and not aux_tables and not pack_disabled
+                  and not fused_disabled else None)
             was_cached = ck is not None and ck in self._plan_cache
             if was_cached:
                 comp = self._plan_cache[ck]
@@ -174,7 +184,9 @@ class Executor:
                                 instrument=instrument,
                                 multihost=self.multihost is not None,
                                 scan_cap_override=scan_cap_override,
-                                aux_tables=aux_tables).compile(plan)
+                                aux_tables=aux_tables,
+                                pack_disabled=pack_disabled,
+                                fused_disabled=fused_disabled).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -219,7 +231,18 @@ class Executor:
                     "(vmem protection / resource queue; raise the limit or "
                     "reduce the data)")
             inputs = self._stage(comp, snapshot)
-            flat = comp.device_fn(*inputs)
+            try:
+                flat = comp.device_fn(*inputs)
+            except Exception:
+                # a pallas lowering/compile failure on this backend must
+                # not fail the query: retry the SAME tier on the pure-XLA
+                # path and drop the poisoned cached program
+                if fused_disabled or not self.settings.fused_dense_agg:
+                    raise
+                fused_disabled = True
+                if ck is not None:
+                    self._plan_cache.pop(ck, None)
+                continue
             # ONE device->host fetch for every output (per-transfer latency
             # through tunneled/remote device paths dwarfs per-byte cost)
             flat = jax.device_get(list(flat))
@@ -270,13 +293,20 @@ class Executor:
                 return res
             # size the retry from exact cardinalities where the device
             # reported them (join expansion totals)
-            for fname in overflow:
+            pack_over = [f for f in overflow if f.startswith("pack_overflow")]
+            capacity_over = [f for f in overflow
+                             if not f.startswith("pack_overflow")]
+            for fname in pack_over:
+                pack_disabled.add(comp.flag_packs[fname])
+            for fname in capacity_over:
                 hint = comp.flag_caps.get(fname)
                 if hint is not None:
                     plan_id, metric = hint
                     need = (int(metrics[metric].flat[0]) if self.multihost
                             else int(np.max(metrics[metric])))
                     cap_overrides[plan_id] = need + max(need // 16, 64)
+            if capacity_over:
+                tier += 1
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
 

@@ -1,0 +1,216 @@
+"""Single-pass fused dense aggregation — the Q1 roofline kernel.
+
+The XLA dense path (ops/agg.py dense_aggregate) emits one [n, D] masked
+reduction per aggregate input; XLA compiles each into its own pass over
+the batch, so TPC-H Q1's 8 aggregates re-read gid and value columns ~12x
+(measured 86ms for 60M rows at SF10 ≈ 31 GB/s effective vs ~819 GB/s v5e
+HBM peak). This pallas kernel makes ONE pass: each row block is loaded
+once, every accumulator updates from VMEM, and only [D] partials per
+accumulator ever leave the core.
+
+Semantics come for free by record-replay around agg._run_aggs (the single
+source of SQL aggregate truth): a recording pass captures every segmented
+reduction _run_aggs asks for (already masked/identity-filled), the kernel
+computes ALL of them in one sweep, and a replay pass hands the results
+back in the same order. sum/count/avg/min/max all ride the same kernel.
+
+Layout: rows reshaped to [n/128, 128] (lane-major); the grid walks row
+blocks sequentially (TPU grid semantics), accumulating per-(accum, group,
+lane) partials in VMEM scratch and collapsing lanes on the final block.
+int64 accumulators keep scaled-decimal SQL sums exact (no float path is).
+
+Reference parity: the vectorized replacement for the per-tuple
+advance_aggregates loop of the hybrid hash agg (execHHashagg.c) in the
+small-domain regime.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+SUBLANES = 64          # rows per grid step = SUBLANES * LANES
+
+
+def supported(aggs) -> bool:
+    return all(s.func in ("sum", "count", "count_star", "avg", "min", "max")
+               for s in aggs)
+
+
+def _segment_reduce_fused(gid, D: int, jobs, interpret: bool):
+    """jobs: list of (values[n] pre-masked/filled, op, ident) with op in
+    {'sum','min','max'}. -> list of per-group [D] results, one HBM pass."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = gid.shape[0]
+    block = SUBLANES * LANES
+    nblocks = max((n + block - 1) // block, 1)
+    npad = nblocks * block
+
+    # split jobs by accumulator dtype (pallas scratch is single-dtype)
+    lanes: dict[str, list[int]] = {"i": [], "f": []}
+    for j, (v, _, _) in enumerate(jobs):
+        lanes["f" if v.dtype.kind == "f" else "i"].append(j)
+    ki, kf = len(lanes["i"]), len(lanes["f"])
+
+    def pad2(x, fill):
+        x = jnp.pad(x, (0, npad - n), constant_values=fill)
+        return x.reshape(nblocks * SUBLANES, LANES)
+
+    gid2 = pad2(gid.astype(jnp.int32), 0)
+    arrs = []
+    idents = []
+    ops = []
+    order = lanes["i"] + lanes["f"]
+    for j in order:
+        v, op, ident = jobs[j]
+        if v.dtype.kind == "f":
+            v = v.astype(jnp.float64)
+        else:
+            v = v.astype(jnp.int64)
+        arrs.append(pad2(v, ident))   # padding rows carry the identity
+        idents.append(ident)
+        ops.append(op)
+
+    def kernel(gid_ref, *rest):
+        vrefs = rest[:len(arrs)]
+        outs = rest[len(arrs):len(arrs) + (1 if ki else 0) + (1 if kf else 0)]
+        scratches = rest[len(arrs) + len(outs):]
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            si = 0
+            for kind, count in (("i", ki), ("f", kf)):
+                if count:
+                    sc = scratches[si]
+                    init = jnp.stack([
+                        jnp.full((D, LANES), idents[ (0 if kind == "i" else ki) + a],
+                                 sc.dtype)
+                        for a in range(count)])
+                    sc[...] = init
+                    si += 1
+
+        g = gid_ref[...]
+        si = 0
+        base = 0
+        for kind, count in (("i", ki), ("f", kf)):
+            if not count:
+                continue
+            sc = scratches[si]
+            for a in range(count):
+                v = vrefs[base + a][...]
+                op = ops[base + a]
+                ident = idents[base + a]
+                for gi in range(D):
+                    m = g == gi
+                    masked = jnp.where(m, v, jnp.asarray(ident, v.dtype))
+                    if op == "sum":
+                        sc[a, gi, :] += jnp.sum(masked, axis=0)
+                    elif op == "min":
+                        sc[a, gi, :] = jnp.minimum(
+                            sc[a, gi, :], jnp.min(masked, axis=0))
+                    else:
+                        sc[a, gi, :] = jnp.maximum(
+                            sc[a, gi, :], jnp.max(masked, axis=0))
+            si += 1
+            base += count
+
+        @pl.when(step == nblocks - 1)
+        def _finish():
+            si = 0
+            base = 0
+            oi = 0
+            for kind, count in (("i", ki), ("f", kf)):
+                if not count:
+                    continue
+                sc = scratches[si]
+                red = []
+                for a in range(count):
+                    op = ops[base + a]
+                    if op == "sum":
+                        red.append(jnp.sum(sc[a], axis=1))
+                    elif op == "min":
+                        red.append(jnp.min(sc[a], axis=1))
+                    else:
+                        red.append(jnp.max(sc[a], axis=1))
+                outs[oi][...] = jnp.stack(red)
+                si += 1
+                base += count
+                oi += 1
+
+    row_spec = pl.BlockSpec((SUBLANES, LANES), lambda i: (i, 0))
+    out_shapes = []
+    out_specs = []
+    scratch_shapes = []
+    if ki:
+        out_shapes.append(jax.ShapeDtypeStruct((ki, D), jnp.int64))
+        out_specs.append(pl.BlockSpec((ki, D), lambda i: (0, 0)))
+        scratch_shapes.append(pltpu.VMEM((ki, D, LANES), jnp.int64))
+    if kf:
+        out_shapes.append(jax.ShapeDtypeStruct((kf, D), jnp.float64))
+        out_specs.append(pl.BlockSpec((kf, D), lambda i: (0, 0)))
+        scratch_shapes.append(pltpu.VMEM((kf, D, LANES), jnp.float64))
+
+    res = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[row_spec] * (1 + len(arrs)),
+        out_specs=out_specs if len(out_specs) > 1 else out_specs[0],
+        out_shape=out_shapes if len(out_shapes) > 1 else out_shapes[0],
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )(gid2, *arrs)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+
+    results: list = [None] * len(jobs)
+    oi = 0
+    if ki:
+        for a, j in enumerate(lanes["i"]):
+            results[j] = res[oi][a]
+        oi += 1
+    if kf:
+        for a, j in enumerate(lanes["f"]):
+            results[j] = res[oi][a]
+    return results
+
+
+def fused_dense_aggregate(gid, D: int, aggs, sel, interpret: bool = False):
+    """Drop-in for agg.dense_aggregate: -> (vals, valids) with identical
+    semantics, computed in one pass. Only call when supported(aggs)."""
+    from greengage_tpu.ops import agg as agg_ops
+
+    # pass 1: record every segmented reduction _run_aggs asks for; the
+    # dummy [D] returns flow into dead arithmetic XLA removes (only the
+    # replay pass's outputs are kept)
+    jobs: list = []
+
+    def rec_sum(masked):
+        jobs.append((masked, "sum",
+                     0.0 if masked.dtype.kind == "f" else 0))
+        return jnp.zeros((D,), masked.dtype)
+
+    def rec_minmax(filled, func, ident):
+        jobs.append((filled, func, ident.item() if hasattr(ident, "item")
+                     else ident))
+        return jnp.zeros((D,), filled.dtype)
+
+    agg_ops._run_aggs(aggs, sel, rec_sum, rec_minmax)
+
+    results = _segment_reduce_fused(gid, D, jobs, interpret)
+
+    # pass 2: replay with the fused results, in the same call order
+    it = iter(results)
+
+    def replay_sum(masked):
+        r = next(it)
+        return r.astype(jnp.float64 if masked.dtype.kind == "f" else jnp.int64)
+
+    def replay_minmax(filled, func, ident):
+        r = next(it)
+        return r.astype(filled.dtype) if filled.dtype.kind != "f" else r
+
+    return agg_ops._run_aggs(aggs, sel, replay_sum, replay_minmax)

@@ -64,12 +64,79 @@ def _order_encode(k: SortKey) -> list[jnp.ndarray]:
     return ops
 
 
-def sort_batch(keys: list[SortKey], sel, capacity: int):
-    """-> (perm int32[capacity], sel_sorted bool[capacity]).
+def order_pack_bits(keys: list[SortKey], bounds: list | None) -> int | None:
+    """Packed-operand feasibility: per-key (lo, hi) integer bounds must be
+    known for EVERY key and their (span + NULL slot) fields fit 63 bits
+    (bit 63 carries the dead-row flag)."""
+    if bounds is None or len(bounds) != len(keys) \
+            or any(b is None for b in bounds):
+        return None
+    total = 0
+    for k, (lo, hi) in zip(keys, bounds):
+        if k.rank_lut is not None:
+            return None            # TEXT collation ranks: not packable here
+        span = int(hi) - int(lo) + 1
+        if span <= 0:
+            return None
+        total += max(span.bit_length(), 1)   # span+1 field values (NULL)
+        if total > 63:
+            return None
+    return total
+
+
+def pack_order_keys(keys: list[SortKey], bounds: list, sel):
+    """Order-preserving pack of bounded integer ORDER BY keys into one
+    uint64 (dead flag at bit 63, fields MSB-first in key priority):
+
+      ASC : field = v - lo (+1 when NULLS FIRST); NULL = 0 or span
+      DESC: field = hi - v (+1 when NULLS FIRST); NULL = 0 or span
+
+    -> (word uint64[n], violation bool scalar): violation = a live non-NULL
+    value outside its advertised bound (stale stats) — packing would
+    mis-order, caller re-runs unpacked."""
+    n = sel.shape[0]
+    word = jnp.zeros((n,), jnp.uint64)
+    violation = jnp.zeros((), bool)
+    for k, (lo, hi) in zip(keys, bounds):
+        span = int(hi) - int(lo) + 1
+        width = max(span.bit_length(), 1)
+        v = k.values.astype(jnp.int64)
+        in_b = (v >= lo) & (v <= hi)
+        live = sel if k.valid is None else (sel & k.valid)
+        violation = violation | jnp.any(live & ~in_b)
+        base = (jnp.int64(hi) - v) if k.desc else (v - jnp.int64(lo))
+        base = jnp.where(in_b, base, 0)
+        nulls_first = k.nulls_first if k.nulls_first is not None else k.desc
+        if nulls_first:
+            field = base + 1
+            null_val = 0
+        else:
+            field = base
+            null_val = span
+        if k.valid is not None:
+            field = jnp.where(k.valid, field, jnp.int64(null_val))
+        word = (word << jnp.uint64(width)) | field.astype(jnp.uint64)
+    word = jnp.where(sel, word, word | (jnp.uint64(1) << jnp.uint64(63)))
+    return word, violation
+
+
+def sort_batch(keys: list[SortKey], sel, capacity: int,
+               bounds: list | None = None):
+    """-> (perm int32[capacity], sel_sorted bool[capacity], violation).
 
     perm is the gather permutation: out_col = col[perm]. Stable on ties
-    (row index is the final operand).
+    (row index is the final operand). ``bounds`` enables the packed
+    single-operand sort; violation is None when packing wasn't attempted,
+    else a bool scalar the caller must route to a pack-overflow flag.
     """
+    if bounds is not None and order_pack_bits(keys, bounds) is not None:
+        word, violation = pack_order_keys(keys, bounds, sel)
+        sorted_ops = lax.sort(
+            (word, jnp.arange(capacity, dtype=jnp.int32)), num_keys=2)
+        perm = sorted_ops[-1]
+        sel_sorted = (sorted_ops[0] >> jnp.uint64(63)) == 0
+        return perm, sel_sorted, violation
+
     dead = (~sel).astype(jnp.uint8)        # live rows first
     operands = [dead]
     for k in keys:
@@ -78,7 +145,7 @@ def sort_batch(keys: list[SortKey], sel, capacity: int):
     sorted_ops = lax.sort(tuple(operands), num_keys=len(operands))
     perm = sorted_ops[-1]
     sel_sorted = sorted_ops[0] == 0
-    return perm, sel_sorted
+    return perm, sel_sorted, None
 
 
 def apply_perm(cols: dict, valids: dict, perm):

@@ -340,6 +340,10 @@ class Planner:
                 # duplicate fanout multiplies output rows; nudge the
                 # estimate so operators above size their tables for it
                 node.est_rows = max(node.est_rows, left.est_rows * 2.0)
+        # build-side key bounds for the packed/narrowed hash table
+        # (ops/join.py pack_join_keys): probe values outside the build's
+        # bounds simply never match, so only the BUILD side's stats matter
+        node.key_bounds = self._key_bounds(node.right, node.right_keys)
         self._maybe_direct_join(node)
         return node
 
@@ -414,6 +418,7 @@ class Planner:
             node.phase = "single"
             node.locus = child.locus
             node.est_rows = groups
+            node.key_bounds = self._key_bounds(child, [e for _, e in node.group_keys])
             return node
 
         # Agg placement is a COSTED alternative (the cdbgroup.c one-stage vs
@@ -441,17 +446,42 @@ class Planner:
             node.phase = "single"
             node.locus = moved.locus
             node.est_rows = groups
+            node.key_bounds = self._key_bounds(moved, [e for _, e in node.group_keys])
             return node
 
         # two-phase: partial local -> redistribute by group keys -> final
         partial = self._make_partial(node)
+        partial.key_bounds = self._key_bounds(node.child, [e for _, e in partial.group_keys])
         key_exprs = [E.ColRef(c.id, c.type) for c, _ in partial.group_keys]
         moved = self._redistribute(
             partial, key_exprs, tuple(c.id for c, _ in partial.group_keys))
         final = self._make_final(node, partial, moved)
         final.locus = moved.locus
         final.est_rows = groups
+        final.key_bounds = self._key_bounds(moved, [e for _, e in node.group_keys])
         return final
+
+    def _key_bounds(self, child: Plan, key_exprs) -> list:
+        """Per-key (lo, hi) integer bounds from ANALYZE stats — feeds the
+        packed single-operand group/order sorts and narrowed join tables
+        (ops/agg.py pack_keys, ops/sort.py pack_order_keys,
+        ops/join.py pack_join_keys). None for unanalyzed/computed/
+        non-integer keys; a stale bound is caught at runtime by the
+        pack-violation flag and re-run unpacked."""
+        lookup = self._stats_lookup(child)
+        out = []
+        for e in key_exprs:
+            b = None
+            if isinstance(e, E.ColRef) and e.type.kind in (
+                    T.Kind.INT32, T.Kind.INT64, T.Kind.DATE):
+                cs = lookup(e.name)
+                if cs is not None and cs.min is not None and cs.max is not None:
+                    try:
+                        b = (int(cs.min), int(cs.max))
+                    except (TypeError, ValueError, OverflowError):
+                        b = None
+            out.append(b)
+        return out
 
     def _est_groups(self, node: Aggregate, child: Plan) -> float:
         """NDV-product estimate when every group key resolves to analyzed
@@ -534,6 +564,8 @@ class Planner:
         node.child = self._rec(node.child)
         node.locus = node.child.locus
         node.est_rows = node.child.est_rows
+        node.key_bounds = self._key_bounds(
+            node.child, [e for e, _, _ in node.keys])
         return node
 
     def _plan_limit(self, node: Limit) -> Plan:

@@ -150,3 +150,41 @@ def test_replicated_build_dwarfs_its_ici_cost():
 def test_gather_charges_host_relay_floor():
     # even a 1-row gather pays the ~65ms relay call (NOTES.md measurement)
     assert C.motion_cost("gather", 1, 8, 8) >= C.NS_HOST_CALL
+
+
+# ---------------------------------------------------------------------------
+# stale stats: packed keys must self-heal via the pack-violation retry
+# ---------------------------------------------------------------------------
+
+def test_stale_bounds_group_by_still_exact(db):
+    d = greengage_tpu.connect(numsegments=8)
+    rng = np.random.default_rng(5)
+    d.sql("create table st (k int, g int, v int) distributed by (k)")
+    n = 4000
+    d.load_table("st", {"k": np.arange(n),
+                        "g": rng.integers(0, 30000, n).astype(np.int64),
+                        "v": np.ones(n, np.int64)})
+    d.sql("analyze st")
+    # grow the key domain far past the analyzed max WITHOUT re-analyzing
+    d.sql("insert into st values (999991, 900000, 1), (999992, 900001, 1)")
+    rows = d.sql("select g, sum(v) from st group by g").rows()
+    got = {g: s for g, s in rows}
+    assert got[900000] == 1 and got[900001] == 1
+    assert sum(got.values()) == n + 2
+
+
+def test_stale_bounds_join_still_exact(db):
+    d = greengage_tpu.connect(numsegments=8)
+    d.sql("create table bl (pk int, m int) distributed by (m)")
+    d.sql("create table pr (k int, fk int) distributed by (k)")
+    d.load_table("bl", {"pk": np.arange(100), "m": np.arange(100)})
+    d.load_table("pr", {"k": np.arange(500),
+                        "fk": (np.arange(500) % 120).astype(np.int64)})
+    d.sql("analyze")
+    # stale build bounds: new build key outside the analyzed [0, 99]
+    d.sql("insert into bl values (5000, 5000)")
+    d.sql("insert into pr values (501, 5000)")
+    n = d.sql("select count(*) from pr, bl where pr.fk = bl.pk").rows()[0][0]
+    # fks 0..99 each appear ceil-ish times within 0..119 cycle + the 5000 row
+    want = int(np.isin((np.arange(500) % 120), np.arange(100)).sum()) + 1
+    assert n == want

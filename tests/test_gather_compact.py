@@ -1,0 +1,64 @@
+"""Device-side result compaction before the Gather Motion (VERDICT r2 #9):
+a selective SELECT must ship ~actual rows through the device->host relay,
+not the scan's padded capacity. Reference: Gather Motion semantics
+(src/backend/executor/nodeMotion.c:171) — tuples stream, padding doesn't.
+"""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=2)
+    rng = np.random.default_rng(13)
+    n = 100_000
+    d.sql("create table big (k int, v int, w int) distributed by (k)")
+    d.load_table("big", {
+        "k": np.arange(n),
+        "v": rng.integers(0, 100_000, n).astype(np.int64),
+        "w": rng.integers(0, 50, n).astype(np.int64),
+    }, valids={"w": np.arange(n) % 7 != 0})
+    d.sql("analyze")
+    return d
+
+
+def test_selective_select_ships_compacted(db):
+    # ~0.1% selectivity: the shipped capacity must be a small fraction of
+    # the 50k-row per-segment scan capacity
+    r = db.sql("select k, v, w from big where v < 100")
+    actual = len(r)
+    assert 20 <= actual <= 300
+    shipped = r.stats["below_gather_capacity"]
+    assert shipped < 5000, (shipped, actual)
+    # and the rows themselves are right (spot-check against numpy)
+    want = int((np.asarray(db.sql("select count(*) from big where v < 100")
+                           .rows()[0][0])))
+    assert actual == want
+
+
+def test_compaction_preserves_nulls_and_values(db):
+    rows = db.sql("select k, w from big where v < 60").rows()
+    for k, w in rows:
+        if k % 7 == 0:
+            assert w is None
+        else:
+            assert w is not None
+
+
+def test_underestimate_retries_to_exact(db):
+    # force a bad estimate: a predicate the planner rates ~equality-selective
+    # but which actually passes half the table; the compaction must overflow
+    # and retry to the exact count, never drop rows
+    r = db.sql("select k from big where v % 2 = 0")
+    n = len(r)
+    want = db.sql("select count(*) from big where v % 2 = 0").rows()[0][0]
+    assert n == want
+    assert n > 40_000
+
+
+def test_full_table_select_not_compacted(db):
+    r = db.sql("select k from big")
+    assert len(r) == 100_000

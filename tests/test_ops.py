@@ -126,7 +126,7 @@ def test_groupby_matches_pandas(n, groups):
 
     keys = [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
             agg_ops.KeySpec(jnp.asarray(k2), None, T.INT32)]
-    perm, boundary, sel_sorted = agg_ops.group_sort(keys, jnp.asarray(sel))
+    perm, boundary, sel_sorted, _ = agg_ops.group_sort(keys, jnp.asarray(sel))
     out_cap = n
     vals, valids, srcpos, total = agg_ops.sorted_group_aggregate(
         boundary, sel_sorted,
@@ -163,7 +163,7 @@ def test_groupby_null_keys_merge():
     k = np.array([1, 1, 2, 0, 0], dtype=np.int64)
     kv = np.array([True, True, True, False, False])
     sel = np.ones(5, dtype=bool)
-    perm, boundary, sel_sorted = agg_ops.group_sort(
+    perm, boundary, sel_sorted, _ = agg_ops.group_sort(
         [agg_ops.KeySpec(jnp.asarray(k), jnp.asarray(kv), T.INT64)],
         jnp.asarray(sel))
     assert int(np.asarray(boundary).sum()) == 3  # groups: 1, 2, NULL
@@ -178,7 +178,7 @@ def test_groupby_dead_rows_excluded():
     # dead rows must neither form groups nor leak into neighbors' aggregates
     k = np.array([5, 5, 7, 7, 9], dtype=np.int64)
     sel = np.array([True, False, True, True, False])
-    perm, boundary, sel_sorted = agg_ops.group_sort(
+    perm, boundary, sel_sorted, _ = agg_ops.group_sort(
         [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)], jnp.asarray(sel))
     assert int(np.asarray(boundary).sum()) == 2  # groups 5 and 7 only
     v = jnp.asarray(np.array([1, 100, 2, 3, 100], dtype=np.int64))[perm]
@@ -255,7 +255,7 @@ def test_sort_multi_key_desc_nulls():
         sort_ops.SortKey(jnp.asarray(a), jnp.asarray(av), T.INT64, desc=False),
         sort_ops.SortKey(jnp.asarray(bcol), None, T.FLOAT64, desc=True),
     ]
-    perm, sel_sorted = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
+    perm, sel_sorted, _ = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
     order = np.asarray(perm)
     # asc on a (nulls last), desc on b: (1,7.0),(1,-2.0),(2,0.0),(3,1.5),(null)
     assert list(a[order][:4]) == [1, 1, 2, 3]
@@ -267,8 +267,132 @@ def test_sort_dead_rows_pushed_back_and_limit():
     x = np.array([5, 4, 3, 2, 1], dtype=np.int64)
     sel = np.array([True, False, True, False, True])
     keys = [sort_ops.SortKey(jnp.asarray(x), None, T.INT64)]
-    perm, sel_sorted = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
+    perm, sel_sorted, _ = sort_ops.sort_batch(keys, jnp.asarray(sel), 5)
     assert list(np.asarray(sel_sorted)) == [True, True, True, False, False]
     assert list(x[np.asarray(perm)][:3]) == [1, 3, 5]
     cols, valids, s = sort_ops.limit({"x": jnp.asarray(x)[np.asarray(perm)]}, {}, sel_sorted, 2)
     assert list(np.asarray(cols["x"])) == [1, 3]
+
+
+# ---------------------------------------------------------------------------
+# packed group sort (stats-bounded keys in one uint64 operand)
+# ---------------------------------------------------------------------------
+
+def test_packed_group_sort_matches_unpacked():
+    import pandas as pd
+
+    rng = np.random.default_rng(9)
+    n = 5000
+    k1 = rng.integers(-37, 4000, n).astype(np.int64)
+    k2 = rng.integers(0, 12, n).astype(np.int32)
+    kv2 = rng.random(n) < 0.9          # k2 nullable
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    sel = rng.random(n) < 0.8
+    keys = [agg_ops.KeySpec(jnp.asarray(k1), None, T.INT64),
+            agg_ops.KeySpec(jnp.asarray(k2), jnp.asarray(kv2), T.INT32)]
+    bounds = [(-37, 3999), (0, 11)]
+    assert agg_ops.pack_bits(bounds) is not None
+
+    perm, boundary, sel_sorted, viol = agg_ops.group_sort(
+        keys, jnp.asarray(sel), bounds)
+    assert viol is not None and not bool(viol)
+    vals, _, srcpos, total = agg_ops.sorted_group_aggregate(
+        boundary, sel_sorted,
+        [agg_ops.AggSpec("c", "count_star", None, None),
+         agg_ops.AggSpec("s", "sum", jnp.asarray(v)[perm], None)], n)
+    G = int(total)
+    rep = np.asarray(perm)[np.asarray(srcpos)[:G]]
+    got = pd.DataFrame({
+        "k1": k1[rep], "k2": np.where(kv2[rep], k2[rep], -999),
+        "c": np.asarray(vals["c"])[:G], "s": np.asarray(vals["s"])[:G],
+    }).sort_values(["k1", "k2"]).reset_index(drop=True)
+    df = pd.DataFrame({"k1": k1[sel], "k2": np.where(kv2, k2, -999)[sel],
+                       "v": v[sel]})
+    want = df.groupby(["k1", "k2"], as_index=False).agg(
+        c=("v", "size"), s=("v", "sum")).sort_values(
+        ["k1", "k2"]).reset_index(drop=True)
+    assert len(got) == len(want)
+    assert np.array_equal(got["k1"], want["k1"])
+    assert np.array_equal(got["k2"], want["k2"])
+    assert np.array_equal(got["c"], want["c"])
+    assert np.array_equal(got["s"], want["s"])
+
+
+def test_packed_group_sort_flags_bounds_violation():
+    k = np.array([5, 100, 7], dtype=np.int64)   # 100 outside (0, 63)
+    keys = [agg_ops.KeySpec(jnp.asarray(k), None, T.INT64)]
+    _, _, _, viol = agg_ops.group_sort(
+        keys, jnp.asarray(np.ones(3, bool)), [(0, 63)])
+    assert bool(viol)
+    # dead rows outside bounds do NOT trip the flag
+    _, _, _, viol2 = agg_ops.group_sort(
+        keys, jnp.asarray(np.array([True, False, True])), [(0, 63)])
+    assert not bool(viol2)
+
+
+def test_pack_bits_budget():
+    assert agg_ops.pack_bits([(0, 2**40), (0, 2**30)]) is None  # > 63 bits
+    assert agg_ops.pack_bits([(0, 2**40), (0, 2**20)]) is not None
+    assert agg_ops.pack_bits([(0, 0)]) == 1
+    assert agg_ops.pack_bits([None]) is None
+    assert agg_ops.pack_bits([]) is None
+
+
+def test_packed_join_matches_unpacked():
+    rng = np.random.default_rng(21)
+    nb, np_ = 500, 3000
+    bkey = rng.permutation(5000)[:nb].astype(np.int64) - 250  # unique, offset
+    pkey = rng.integers(-400, 5200, np_).astype(np.int64)
+    bounds = [(int(bkey.min()), int(bkey.max()))]
+    bs = [agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)]
+    ps = [agg_ops.KeySpec(jnp.asarray(pkey), None, T.INT64)]
+    sel_b = jnp.ones(nb, bool)
+    sel_p = jnp.ones(np_, bool)
+    for kb in (None, bounds):
+        table = join_ops.build(bs, sel_b, 2048, 64, kb)
+        if kb is not None:
+            assert table.bounds is not None and not bool(table.pack_viol)
+        matched, brow, ov = join_ops.probe(table, ps, sel_p, 64)
+        assert not bool(ov)
+        want = np.isin(pkey, bkey)
+        assert np.array_equal(np.asarray(matched), want)
+        hit = np.asarray(matched)
+        assert np.array_equal(bkey[np.asarray(brow)[hit]], pkey[hit])
+
+
+def test_packed_join_build_violation_flag():
+    bkey = np.array([1, 2, 99], dtype=np.int64)   # 99 outside stale (0, 10)
+    bs = [agg_ops.KeySpec(jnp.asarray(bkey), None, T.INT64)]
+    table = join_ops.build(bs, jnp.ones(3, bool), 64, 16, [(0, 10)])
+    assert bool(table.pack_viol)
+
+
+def test_packed_order_sort_matches_unpacked():
+    from greengage_tpu.ops import sort as sort_ops
+
+    rng = np.random.default_rng(33)
+    n = 4000
+    a = rng.integers(-50, 1000, n).astype(np.int64)
+    b = rng.integers(0, 90, n).astype(np.int32)
+    bv = rng.random(n) < 0.85
+    sel = rng.random(n) < 0.9
+    for desc_a, desc_b, nf in ((False, False, None), (True, False, None),
+                               (False, True, True), (True, True, False)):
+        keys = [sort_ops.SortKey(jnp.asarray(a), None, T.INT64, desc=desc_a),
+                sort_ops.SortKey(jnp.asarray(b), jnp.asarray(bv), T.INT32,
+                                 desc=desc_b, nulls_first=nf)]
+        bounds = [(-50, 999), (0, 89)]
+        p1, s1, viol = sort_ops.sort_batch(keys, jnp.asarray(sel), n, bounds)
+        assert viol is not None and not bool(viol)
+        p2, s2, v2 = sort_ops.sort_batch(keys, jnp.asarray(sel), n)
+        assert v2 is None
+        # same live set, identical key order (perm may differ only where
+        # rows tie on every key INCLUDING null state -> compare key tuples)
+        assert np.array_equal(np.asarray(s1), np.asarray(s2))
+        k1a, k1b = a[np.asarray(p1)], b[np.asarray(p1)]
+        k2a, k2b = a[np.asarray(p2)], b[np.asarray(p2)]
+        v1b, v2b = bv[np.asarray(p1)], bv[np.asarray(p2)]
+        live = np.asarray(s1)
+        assert np.array_equal(k1a[live], k2a[live])
+        assert np.array_equal(v1b[live], v2b[live])
+        assert np.array_equal(k1b[live & v1b], k2b[live & v2b])
