@@ -6,45 +6,59 @@ Prints ONE JSON line (the Q1 headline, comparable across rounds):
 and a per-query detail block on stderr (Q3/Q5 rows/s/chip + their CPU
 baselines), since the driver records exactly one line.
 
-value       = lineitem rows/sec/chip through SQL -> plan -> jitted SPMD
-              program -> gather, steady state (plan + staging cached),
-              best of N runs.
-vs_baseline = speedup over a CPU columnar baseline executing the same query
-              with numpy/pandas on this host (the reference publishes no
-              absolute numbers — BASELINE.md — so the measured CPU path
-              stands in for a CPU-segment executor on identical data).
+Structure (the round-3 lesson: two consecutive rounds lost their number to
+a wedged TPU backend and a driver timeout):
 
-The Q1 headline line is printed (and flushed) IMMEDIATELY after Q1
-completes, before any other query runs — a later query blowing the driver's
-time budget must never discard a finished Q1 measurement. Q3/Q5 are
-budget-gated: each starts only while elapsed wall time is under
-GGTPU_BENCH_BUDGET_S (they compile for minutes on a cold XLA cache).
+  parent  -- this process; NEVER imports jax (a wedged axon backend hangs
+             jax.devices() indefinitely inside plugin bootstrap). It
+             remediates stale chip-holding processes, probes the backend in
+             a deadlined subprocess with retry/backoff (the wedge clears
+             when stale clients die), runs the measurement child under a
+             deadline, and prints the headline the MOMENT the child records
+             it. If everything fails it still prints a parseable headline
+             with value 0 and the error.
+  --probe -- child: import jax, list devices, print the device kind.
+  --run   -- child: generate/load/measure; writes the headline atomically
+             to GGTPU_HEADLINE_FILE as soon as Q1 completes, then keeps
+             going with Q3/Q5 detail (stderr).
+
+Attempt order: SF10 first (the round target); if its child dies or the
+deadline nears with no headline, a short SF1 attempt still lands a real
+measured number (r1 proved SF1 end-to-end in ~40s).
 
 Env: GGTPU_BENCH_SF (default 10), GGTPU_BENCH_RUNS (default 3),
      GGTPU_BENCH_DIR (default /tmp/ggtpu_bench_sf<SF>; reused when already
      loaded at the right scale), GGTPU_BENCH_QUERIES (default q1,q3,q5),
-     GGTPU_BENCH_BUDGET_S (default 1200; start no new query past this).
+     GGTPU_BENCH_DEADLINE_S (default 1650: the driver's observed budget is
+     ~1800s and rc=124 discards nothing only because the parent prints the
+     headline incrementally), GGTPU_BENCH_PROBE_S (probe window, 480),
+     GGTPU_BENCH_FALLBACK_SF (default 1; 0 disables the fallback attempt).
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 T0 = time.monotonic()
 
 
 def log(msg: str) -> None:
-    print(f"[bench +{time.monotonic() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    print(f"[bench +{time.monotonic() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SF = float(os.environ.get("GGTPU_BENCH_SF", "10"))
 RUNS = int(os.environ.get("GGTPU_BENCH_RUNS", "3"))  # best-of; per-call
 QUERIES = os.environ.get("GGTPU_BENCH_QUERIES", "q1,q3,q5").split(",")
-BUDGET_S = float(os.environ.get("GGTPU_BENCH_BUDGET_S", "1200"))
+DEADLINE_S = float(os.environ.get("GGTPU_BENCH_DEADLINE_S", "1650"))
+PROBE_S = float(os.environ.get("GGTPU_BENCH_PROBE_S", "480"))
+FALLBACK_SF = float(os.environ.get("GGTPU_BENCH_FALLBACK_SF", "1"))
+HBM_PEAK_GBS = 819.0   # v5e HBM bandwidth roofline
 
 Q1 = """
 select l_returnflag, l_linestatus,
@@ -88,11 +102,229 @@ order by revenue desc
 """
 
 
+# ======================================================================
+# parent: orchestration without ever touching a jax backend
+# ======================================================================
+
+def _kill_stale_clients() -> None:
+    """Kill leftover bench children from a previous (timed-out) round: the
+    driver's `timeout` kills only the parent, orphaning children that still
+    hold the chip client — exactly the state that wedges the next backend
+    init. Identified by the GGTPU_BENCH_CHILD env marker or a bench.py
+    cmdline; never this process or its ancestors."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(16):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split(") ")[-1].split()[1])   # ppid
+        except Exception:
+            break
+        if pid <= 1:
+            break
+        ancestors.add(pid)
+    for d in os.listdir("/proc"):
+        if not d.isdigit():
+            continue
+        pid = int(d)
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open(f"/proc/{d}/stat") as f:
+                ppid = int(f.read().split(") ")[-1].split()[1])
+            if ppid == me:
+                continue   # a live child of THIS parent is never stale
+            with open(f"/proc/{d}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open(f"/proc/{d}/environ", "rb") as f:
+                env = f.read()
+        except Exception:
+            continue
+        stale = b"GGTPU_BENCH_CHILD=1" in env or (
+            "bench.py" in cmd and "python" in cmd)
+        if stale:
+            log(f"remediation: killing stale bench process {pid}: {cmd[:120]}")
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except Exception:
+                pass
+
+
+def _spawn_child(args, timeout_s, headline_file=None, tag="child"):
+    """Run a child with its own process group and a hard deadline; stdout
+    is redirected to stderr (the parent owns the real stdout). Polls the
+    headline file while waiting and prints the headline the moment it
+    appears — a later driver kill can then never discard it.
+    -> (rc | None on timeout, headline_printed)."""
+    env = dict(os.environ)
+    env["GGTPU_BENCH_CHILD"] = "1"
+    if headline_file:
+        env["GGTPU_HEADLINE_FILE"] = headline_file
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        env=env, stdout=sys.stderr, stderr=sys.stderr,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    printed = False
+    end = time.monotonic() + timeout_s
+    rc = None
+    while time.monotonic() < end:
+        rc = proc.poll()
+        if headline_file and not printed:
+            printed = _try_print_headline(headline_file)
+        if rc is not None:
+            break
+        time.sleep(2)
+    if rc is None:
+        log(f"{tag}: deadline ({timeout_s:.0f}s) — killing process group")
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            try:
+                os.killpg(proc.pid, sig)
+            except Exception:
+                pass
+            try:
+                proc.wait(timeout=10)
+                break
+            except Exception:
+                continue
+    if headline_file and not printed:
+        printed = _try_print_headline(headline_file)
+    return rc, printed
+
+
+_HEADLINE_DONE = False
+
+
+def _try_print_headline(path) -> bool:
+    """Print the child's recorded headline (once) if it exists."""
+    global _HEADLINE_DONE
+    if _HEADLINE_DONE:
+        return True
+    try:
+        with open(path) as f:
+            line = json.loads(f.read())
+    except Exception:
+        return False
+    print(json.dumps(line), flush=True)
+    _HEADLINE_DONE = True
+    return True
+
+
+def parent() -> None:
+    errors = []
+    _kill_stale_clients()
+
+    # ---- probe: deadlined + retried backend init ----------------------
+    probe_end = time.monotonic() + min(PROBE_S, DEADLINE_S * 0.4)
+    probe_ok = False
+    attempt = 0
+    while time.monotonic() < probe_end:
+        attempt += 1
+        budget = min(150.0, probe_end - time.monotonic() + 30)
+        log(f"probe attempt {attempt} (timeout {budget:.0f}s)")
+        rc, _ = _spawn_child(["--probe"], budget, tag="probe")
+        if rc == 0:
+            probe_ok = True
+            break
+        errors.append(f"probe#{attempt} rc={rc if rc is not None else 'timeout'}")
+        _kill_stale_clients()   # a hung probe child is itself a stale client
+        sleep = min(20.0 * attempt, 60.0)
+        if time.monotonic() + sleep >= probe_end:
+            break
+        log(f"probe failed ({errors[-1]}); backoff {sleep:.0f}s")
+        time.sleep(sleep)
+    if not probe_ok:
+        log("backend never initialized inside the probe window")
+        print(json.dumps({
+            "metric": "tpch_q1_rows_per_sec_per_chip", "value": 0,
+            "unit": "rows/s", "vs_baseline": 0.0,
+            "error": "TPU backend unavailable: " + "; ".join(errors[-4:])}),
+            flush=True)
+        return
+
+    # ---- measurement: SF target first, small-SF fallback --------------
+    headline_file = f"/tmp/ggtpu_bench_headline_{os.getpid()}.json"
+    try:   # a recycled PID must never replay a previous round's number
+        os.unlink(headline_file)
+    except OSError:
+        pass
+    attempts = [SF] + ([FALLBACK_SF] if FALLBACK_SF and FALLBACK_SF != SF
+                       else [])
+    # reserve time for the fallback attempt (r1 measured SF1 end-to-end,
+    # cold, in ~40s; 240s is compile-cache-cold slack)
+    reserve = 240.0 if len(attempts) > 1 else 0.0
+    for i, sf in enumerate(attempts):
+        remaining = DEADLINE_S - (time.monotonic() - T0)
+        budget = remaining - (reserve if i == 0 else 0.0)
+        if budget < 60:
+            errors.append(f"sf{sf:g}: no time left ({remaining:.0f}s)")
+            break
+        log(f"run attempt at SF{sf:g} (budget {budget:.0f}s)")
+        env_sf = os.environ.get("GGTPU_BENCH_SF")
+        os.environ["GGTPU_BENCH_SF"] = str(sf)
+        rc, printed = _spawn_child(["--run"], budget,
+                                   headline_file=headline_file,
+                                   tag=f"run sf{sf:g}")
+        if env_sf is None:
+            os.environ.pop("GGTPU_BENCH_SF", None)
+        else:
+            os.environ["GGTPU_BENCH_SF"] = env_sf
+        if printed:
+            return
+        errors.append(f"sf{sf:g} rc={rc if rc is not None else 'timeout'}")
+        log(f"run attempt at SF{sf:g} produced no headline ({errors[-1]})")
+        _kill_stale_clients()
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec_per_chip", "value": 0,
+        "unit": "rows/s", "vs_baseline": 0.0,
+        "error": "; ".join(errors[-6:])}), flush=True)
+
+
+# ======================================================================
+# probe child
+# ======================================================================
+
+def _apply_platform_override() -> None:
+    """GGTPU_BENCH_PLATFORM=cpu pins the children to the CPU backend for
+    harness smoke tests. Env vars (JAX_PLATFORMS) are NOT enough: the
+    environment's site hook re-registers the TPU plugin regardless — only
+    jax.config wins."""
+    plat = os.environ.get("GGTPU_BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def probe_child() -> None:
+    import jax
+
+    _apply_platform_override()
+    devs = jax.devices()
+    log(f"probe: {len(devs)} device(s), kind={devs[0].device_kind}, "
+        f"platform={devs[0].platform}")
+    # one tiny real computation: a backend that lists devices but cannot
+    # compile (the r3 'setup/compile error' state) must fail the probe
+    import jax.numpy as jnp
+
+    assert int(jax.jit(lambda x: (x * 2).sum())(jnp.arange(8))) == 56
+    print("probe ok", file=sys.stderr, flush=True)
+
+
+# ======================================================================
+# measurement child (the original bench body)
+# ======================================================================
+
 def _cut(day: str) -> int:
+    import numpy as np
+
     return (np.datetime64(day) - np.datetime64("1970-01-01")).astype(np.int64)
 
 
 def baseline_q1(data) -> float:
+    import numpy as np
+
     li = data["lineitem"]
     cutoff = _cut("1998-12-01") - 90
     qty, price = li["l_quantity"], li["l_extendedprice"]
@@ -196,6 +428,8 @@ def ensure_loaded(db, data, counts_want):
     partial/mismatched dir (killed prior run, different SF) is wiped and
     reloaded — load_table is append-only, so loading on top would silently
     inflate every number."""
+    import numpy as np  # noqa: F401  (tpch data arrays)
+
     have = {}
     for t in counts_want:
         try:
@@ -237,21 +471,28 @@ def timed(db, sql, runs):
     return best, first, r
 
 
-def main():
+def run_child():
+    import numpy as np  # noqa: F401
+
     import jax
+
+    _apply_platform_override()
 
     import greengage_tpu
     from greengage_tpu.utils import tpch
 
+    sf = float(os.environ.get("GGTPU_BENCH_SF", "10"))
+    headline_file = os.environ.get("GGTPU_HEADLINE_FILE", "")
+
     t_setup = time.monotonic()
-    log(f"generating SF{SF:g}")
-    data = tpch.generate(SF)
+    log(f"generating SF{sf:g}")
+    data = tpch.generate(sf)
     n_rows = len(data["lineitem"]["l_orderkey"])
     counts = {t: len(next(iter(v.values()))) for t, v in data.items()}
 
     dev = jax.devices()[0]
     bench_dir = os.environ.get(
-        "GGTPU_BENCH_DIR", f"/tmp/ggtpu_bench_sf{SF:g}_{len(jax.devices())}d")
+        "GGTPU_BENCH_DIR", f"/tmp/ggtpu_bench_sf{sf:g}_{len(jax.devices())}d")
     db = greengage_tpu.connect(path=bench_dir, numsegments=1)
     log("loading")
     db = ensure_loaded(db, data, counts)
@@ -262,31 +503,30 @@ def main():
     setup_s = time.monotonic() - t_setup
     log(f"setup done ({setup_s:.0f}s, loaded_now={loaded})")
 
-    detail = {"sf": SF, "rows": n_rows, "device": str(dev.device_kind),
+    detail = {"sf": sf, "rows": n_rows, "device": str(dev.device_kind),
               "loaded_now": loaded, "setup_s": round(setup_s, 1)}
     # the chip's real HBM is the limit for this known workload (the default
     # admission guard is conservative for ad-hoc queries)
     db.sql("set vmem_protect_limit_mb = 15000")
     # Q1 streams 7 lineitem columns: 4×int64 + 3×int32 codes/dates = 44 B/row
     q1_bytes_per_row = 44
-    headline_emitted = False
 
-    def emit_headline(line):
-        nonlocal headline_emitted
-        if headline_emitted:
+    def record_headline(line):
+        """Atomic write; the parent polls this file and prints the line the
+        moment it appears — a later kill can never discard it."""
+        if not headline_file:
+            print(json.dumps(line), flush=True)
             return
-        print(json.dumps(line), flush=True)
-        headline_emitted = True
+        tmp = headline_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(line))
+        os.replace(tmp, headline_file)
+        log(f"headline recorded: {line}")
 
     for qname, sql, nbase in (("q1", Q1, "baseline_q1"),
                               ("q3", Q3, "baseline_q3"),
                               ("q5", Q5, "baseline_q5")):
         if qname not in QUERIES:
-            continue
-        elapsed = time.monotonic() - T0
-        if qname != "q1" and elapsed > BUDGET_S:
-            detail[qname] = {"skipped": f"budget: elapsed {elapsed:.0f}s > {BUDGET_S:.0f}s"}
-            log(f"=== {qname} skipped (budget) ===")
             continue
         try:
             log(f"=== {qname} ===")
@@ -307,27 +547,43 @@ def main():
             }
             if qname == "q1":
                 assert len(r) == 6, f"Q1 expected 6 groups, got {len(r)}"
-                detail[qname]["gb_per_sec"] = round(
-                    n_rows * q1_bytes_per_row / best / 1e9, 1)
-                # emit the headline NOW: a later query timing out or dying
-                # must not cost the round its one recorded number
-                emit_headline({
+                gbs = n_rows * q1_bytes_per_row / best / 1e9
+                detail[qname]["gb_per_sec"] = round(gbs, 1)
+                # roofline: fraction of v5e HBM peak the scan achieved, and
+                # whether the fused pallas kernel actually ran (a silent
+                # XLA fallback must not pose as a pallas measurement)
+                detail[qname]["hbm_peak_frac"] = round(gbs / HBM_PEAK_GBS, 3)
+                detail[qname]["fused_kernel"] = bool(
+                    r.stats.get("fused_kernel"))
+                if db.executor.last_fused_error:
+                    detail[qname]["fused_error"] = db.executor.last_fused_error
+                record_headline({
                     "metric": "tpch_q1_rows_per_sec_per_chip",
                     "value": round(value),
                     "unit": "rows/s",
                     "vs_baseline": round(value / base, 3),
                 })
-        except Exception as e:  # one failing query must not kill the line
+        except Exception as e:  # one failing query must not kill the rest
             detail[qname] = {"error": f"{type(e).__name__}: {e}"}
-        print(json.dumps({qname: detail.get(qname)}), file=sys.stderr, flush=True)
+        print(json.dumps({qname: detail.get(qname)}), file=sys.stderr,
+              flush=True)
 
     print(json.dumps(detail, indent=None), file=sys.stderr, flush=True)
-    if not headline_emitted:
-        emit_headline({
+    if "q1" not in QUERIES:
+        # the headline is defined as the Q1 number; record an explicit
+        # not-run line so the parent doesn't burn a fallback attempt
+        record_headline({
             "metric": "tpch_q1_rows_per_sec_per_chip", "value": 0,
             "unit": "rows/s", "vs_baseline": 0.0,
-            "error": detail.get("q1", {}).get("error", "q1 not run")})
+            "error": "q1 not in GGTPU_BENCH_QUERIES"})
+    elif "error" in detail.get("q1", {}):
+        raise SystemExit(f"q1 failed: {detail['q1']['error']}")
 
 
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe_child()
+    elif "--run" in sys.argv:
+        run_child()
+    else:
+        parent()

@@ -28,6 +28,12 @@ class Settings:
     # compile failure (executor disables it for the retry)
     fused_dense_agg: bool = True
     fused_dense_min_rows: int = 1 << 16
+    # the kernel unrolls domain x accumulators reductions per grid step and
+    # keeps (accums, domain, 128)-lane scratch in VMEM: bound both so a
+    # wide dense domain never triggers multi-minute Mosaic compiles or
+    # VMEM exhaustion (the XLA path wins there anyway)
+    fused_dense_max_domain: int = 64
+    fused_dense_max_scratch_mb: int = 4
     # motion (gp_interconnect_queue_depth analog)
     motion_capacity_slack: float = 1.6  # per-destination bucket headroom
     motion_retry_tiers: int = 3         # capacity x4 per retry on overflow

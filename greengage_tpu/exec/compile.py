@@ -51,6 +51,19 @@ def _pow2(n: float) -> int:
     return m
 
 
+def _static_order_packable(keys, bounds) -> bool:
+    """Compile-time mirror of ops/sort.order_pack_bits: the shared bounds
+    budget (ops/sort.order_bounds_bits), plus no key may be TEXT (collation
+    ranks via rank_lut are unpackable) or FLOAT64 (bounds come from integer
+    ANALYZE stats only)."""
+    from greengage_tpu.ops import sort as sort_ops
+
+    if any(e.type.kind in (T.Kind.TEXT, T.Kind.FLOAT64)
+           for e, _, _ in keys):
+        return False
+    return sort_ops.order_bounds_bits(bounds, len(keys)) is not None
+
+
 @dataclass
 class CompileResult:
     device_fn: object                  # jitted shard_map program
@@ -67,7 +80,11 @@ class CompileResult:
     flag_caps: dict = field(default_factory=dict)
     est_bytes: int = 0                 # rough per-segment device allocation
     node_rows: dict = field(default_factory=dict)  # metric -> plan node id
-    flag_packs: dict = field(default_factory=dict)  # pack flag -> plan id
+    flag_packs: dict = field(default_factory=dict)  # pack flag -> plan nid
+    # True when the program may invoke the fused pallas dense-agg kernel:
+    # the executor only treats a device failure as "pallas couldn't lower"
+    # (and retries on the pure-XLA path) for such programs
+    uses_fused: bool = False
 
 
 class Compiler:
@@ -116,6 +133,19 @@ class Compiler:
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
         assert isinstance(plan, Motion) and plan.kind is MotionKind.GATHER
+        # Stable plan-node identity: preorder ordinals over the plan tree.
+        # cap_overrides / pack_disabled / flag_caps / flag_packs cross
+        # compile invocations through the executor's retry loop and plan
+        # cache, where the SAME statement may be re-planned into fresh node
+        # objects — id() would dangle (advisor r3), ordinals are stable
+        # because re-planning the same statement is deterministic.
+        self._nids: dict[int, int] = {}
+        stack = [plan]
+        while stack:
+            p = stack.pop()
+            self._nids[id(p)] = len(self._nids)
+            stack.extend(reversed(p.children))
+        self.uses_fused = False
         below = plan.child
         self._dict_refs: dict[str, tuple] = {}
         _collect_dict_refs(plan, self._dict_refs)
@@ -184,15 +214,15 @@ class Compiler:
                     and self.nseg > 1:
                 est /= self.nseg
             k = _pow2(int(est * 1.5) + 64) * (4 ** self.tier)
-            if id(plan) in self.cap_overrides:
-                k = _pow2(int(self.cap_overrides[id(plan)]))
+            if self._nid(plan) in self.cap_overrides:
+                k = _pow2(int(self.cap_overrides[self._nid(plan)]))
             if k * 2 <= cap_below:
                 compact_k = min(k, cap_below)
                 fid_cmp = f"gather_compact_overflow_{len(self.flags)}"
                 self.flags.append(fid_cmp)
                 mid_cmp = f"gather_compact_total_{len(self.metrics)}"
                 self.metrics.append(mid_cmp)
-                self.flag_caps[fid_cmp] = (id(plan), mid_cmp)
+                self.flag_caps[fid_cmp] = (self._nid(plan), mid_cmp)
 
         flag_names = list(self.flags)
         nseg = self.nseg
@@ -290,7 +320,12 @@ class Compiler:
             est_bytes=self._estimate_bytes(below),
             node_rows=dict(self.node_rows),
             flag_packs=dict(self.flag_packs),
+            uses_fused=self.uses_fused,
         )
+
+    def _nid(self, plan) -> int:
+        """Stable preorder ordinal of a plan node (see compile())."""
+        return self._nids[id(plan)]
 
     def _estimate_bytes(self, plan: Plan) -> int:
         """Rough per-segment device allocation for the whole program
@@ -397,9 +432,9 @@ class Compiler:
         if isinstance(plan, Join):
             probe_cap = self._capacity_of(plan.left)
             if getattr(plan, "multi", False):
-                if id(plan) in self.cap_overrides:
+                if self._nid(plan) in self.cap_overrides:
                     # exact cardinality reported by the overflowed run
-                    return max(int(self.cap_overrides[id(plan)]), 64)
+                    return max(int(self.cap_overrides[self._nid(plan)]), 64)
                 # CSR expansion output capacity from the (stats-driven)
                 # cardinality estimate; est_rows is CLUSTER-GLOBAL, the
                 # batch is per segment — divide by width for partitioned
@@ -424,8 +459,9 @@ class Compiler:
             # slack; can never exceed the child batch (groups <= rows), and
             # an exact-count retry tightens it after overflow
             child_cap = self._capacity_of(plan.child)
-            if id(plan) in self.cap_overrides:
-                return min(max(int(self.cap_overrides[id(plan)]), 64), child_cap)
+            if self._nid(plan) in self.cap_overrides:
+                return min(max(int(self.cap_overrides[self._nid(plan)]), 64),
+                           child_cap)
             est = int(max(plan.est_rows, 16.0) * 1.3) + 64
             return min(est * (4 ** self.tier), child_cap)
         if isinstance(plan, PartialState):
@@ -597,11 +633,11 @@ class Compiler:
         direct_domain = getattr(plan, "direct_domain", 0)
         fid_pack = None
         if (not direct and jkb is not None
-                and id(plan) not in self.pack_disabled
+                and self._nid(plan) not in self.pack_disabled
                 and join_ops.join_pack_bits(jkb) is not None):
             fid_pack = f"pack_overflow_{len(self.flags)}"
             self.flags.append(fid_pack)
-            self.flag_packs[fid_pack] = id(plan)
+            self.flag_packs[fid_pack] = self._nid(plan)
         else:
             jkb = None
 
@@ -693,16 +729,16 @@ class Compiler:
         mid_total = f"join_expand_total_{len(self.metrics)}"
         self.metrics.append(mid_total)
         # overflow retry can size from the exact reported cardinality
-        self.flag_caps[fid_exp] = (id(plan), mid_total)
+        self.flag_caps[fid_exp] = (self._nid(plan), mid_total)
         left_cols = [c for c in plan.left.out_cols()]
         right_cols = [c for c in plan.right.out_cols()]
         jkb = getattr(plan, "key_bounds", None)
         fid_pack = None
-        if (jkb is not None and id(plan) not in self.pack_disabled
+        if (jkb is not None and self._nid(plan) not in self.pack_disabled
                 and join_ops.join_pack_bits(jkb) is not None):
             fid_pack = f"pack_overflow_{len(self.flags)}"
             self.flags.append(fid_pack)
-            self.flag_packs[fid_pack] = id(plan)
+            self.flag_packs[fid_pack] = self._nid(plan)
         else:
             jkb = None
 
@@ -783,7 +819,7 @@ class Compiler:
             self.flags.append(fid)
             mid = f"agg_groups_{len(self.metrics)}"
             self.metrics.append(mid)
-            self.flag_caps[fid] = (id(plan), mid)
+            self.flag_caps[fid] = (self._nid(plan), mid)
         keys = plan.group_keys
         aggs = plan.aggs
         phase = plan.phase
@@ -791,21 +827,31 @@ class Compiler:
         key_bounds = getattr(plan, "key_bounds", None)
         fid_pack = None
         if (use_sort and key_bounds is not None
-                and id(plan) not in self.pack_disabled
+                and self._nid(plan) not in self.pack_disabled
                 and agg_ops.pack_bits(key_bounds) is not None):
             fid_pack = f"pack_overflow_{len(self.flags)}"
             self.flags.append(fid_pack)
-            self.flag_packs[fid_pack] = id(plan)
+            self.flag_packs[fid_pack] = self._nid(plan)
         else:
             key_bounds = None
 
         # fused single-pass dense kernel (ops/fused_agg.py): worth the
         # pallas call only on big batches; interpret mode keeps the CPU
-        # mesh (tests/demo cluster) running the same code path
+        # mesh (tests/demo cluster) running the same code path. The kernel
+        # unrolls D x n_accumulator masked reductions per grid step and
+        # holds (n_acc, D, 128) x 8B VMEM scratch, so bound the group
+        # domain and estimated scratch before committing to pallas
+        # (advisor r3): past the bound the XLA path is the better program.
+        n_acc_est = sum(2 if a.func == "avg" else 1 for _, a in aggs) + 1
         fused_ok = (dense is not None and not self.fused_disabled
                     and self.s.fused_dense_agg
+                    and M <= self.s.fused_dense_max_domain
+                    and n_acc_est * M * 128 * 8
+                    <= self.s.fused_dense_max_scratch_mb << 20
                     and (self._capacity_of(plan.child)
                          >= self.s.fused_dense_min_rows))
+        if fused_ok:
+            self.uses_fused = True
         fused_interpret = self.mesh.devices.flat[0].platform == "cpu"
 
         def run(ctx):
@@ -1127,10 +1173,15 @@ class Compiler:
         cap = self._capacity_of(plan.child)
         key_bounds = getattr(plan, "key_bounds", None)
         fid_pack = None
-        if key_bounds is not None and id(plan) not in self.pack_disabled:
+        # mirror order_pack_bits' static feasibility: registering a flag
+        # that runtime packing can never use ships a permanently-zero flag
+        # (plus a pmax collective in multihost) per execution (advisor r3)
+        if (key_bounds is not None
+                and self._nid(plan) not in self.pack_disabled
+                and _static_order_packable(keys, key_bounds)):
             fid_pack = f"pack_overflow_{len(self.flags)}"
             self.flags.append(fid_pack)
-            self.flag_packs[fid_pack] = id(plan)
+            self.flag_packs[fid_pack] = self._nid(plan)
         else:
             key_bounds = None
 

@@ -145,7 +145,13 @@ class Executor:
         self.settings = settings
         self.multihost = multihost    # parallel.multihost.MultihostRuntime
         self._stage_cache: dict = {}
-        self._plan_cache: dict = {}   # (cache_key, version, tier) -> CompileResult
+        # (cache_key, version, tier, fused_disabled) -> CompileResult
+        self._plan_cache: dict = {}
+        # statements whose fused pallas kernel failed to lower on this
+        # backend: later runs skip the pallas attempt entirely instead of
+        # paying a failed compile + XLA recompile every execution
+        self._fused_failed: set = set()
+        self.last_fused_error: str | None = None
 
     # ------------------------------------------------------------------
     def run(self, plan, consts: dict, out_cols, cache_key=None,
@@ -160,7 +166,7 @@ class Executor:
         last_err = None
         cap_overrides: dict = {}
         pack_disabled: set = set()
-        fused_disabled = False
+        fused_disabled = cache_key is not None and cache_key in self._fused_failed
         tier = 0
         attempts = 0
         # tiers grow capacities; a key-packing bounds violation (stale
@@ -169,11 +175,14 @@ class Executor:
         while tier < self.settings.motion_retry_tiers \
                 and attempts < self.settings.motion_retry_tiers + 4:
             attempts += 1
-            ck = ((cache_key, version, tier) if cache_key is not None
+            # fused_disabled programs cache under their own key: a backend
+            # that can't lower the pallas kernel still gets gang reuse of
+            # the working XLA fallback program (advisor r3)
+            ck = ((cache_key, version, tier, fused_disabled)
+                  if cache_key is not None
                   and not cap_overrides and not instrument
                   and not scan_cap_override and not row_ranges
-                  and not aux_tables and not pack_disabled
-                  and not fused_disabled else None)
+                  and not aux_tables and not pack_disabled else None)
             was_cached = ck is not None and ck in self._plan_cache
             if was_cached:
                 comp = self._plan_cache[ck]
@@ -233,13 +242,22 @@ class Executor:
             inputs = self._stage(comp, snapshot)
             try:
                 flat = comp.device_fn(*inputs)
-            except Exception:
+            except Exception as e:
                 # a pallas lowering/compile failure on this backend must
                 # not fail the query: retry the SAME tier on the pure-XLA
-                # path and drop the poisoned cached program
-                if fused_disabled or not self.settings.fused_dense_agg:
+                # path and drop the poisoned cached program. Only programs
+                # that actually embed the fused kernel AND errors that
+                # carry pallas/Mosaic markers qualify — anything else
+                # (OOM, interconnect) is a genuine runtime error, and a
+                # transient one must not poison the fused memo.
+                if fused_disabled or not comp.uses_fused \
+                        or not self.settings.fused_dense_agg \
+                        or not _is_pallas_error(e):
                     raise
                 fused_disabled = True
+                self.last_fused_error = f"{type(e).__name__}: {e}"
+                if cache_key is not None:
+                    self._fused_failed.add(cache_key)
                 if ck is not None:
                     self._plan_cache.pop(ck, None)
                 continue
@@ -270,6 +288,10 @@ class Executor:
                 res.stats = {
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
+                    # True when the program embeds the fused pallas kernel
+                    # (bench reports this: a silent XLA fallback must not
+                    # masquerade as a pallas measurement)
+                    "fused_kernel": bool(comp.uses_fused),
                     "segments": self.nseg,
                     "scan_tables": [t for t, *_ in comp.input_spec],
                     "direct_dispatch": {t: d for t, _, _, d, *_ in comp.input_spec
@@ -305,7 +327,12 @@ class Executor:
                     need = (int(metrics[metric].flat[0]) if self.multihost
                             else int(np.max(metrics[metric])))
                     cap_overrides[plan_id] = need + max(need // 16, 64)
-            if capacity_over:
+            # a gather-compaction overflow carries its exact live count in
+            # the cap override — re-run the SAME tier with just that slice
+            # widened; bumping the tier would needlessly 4x every other
+            # node and disable tier-0 direct joins (advisor r3)
+            if [f for f in capacity_over
+                    if not f.startswith("gather_compact_overflow")]:
                 tier += 1
             last_err = f"capacity overflow in {overflow} at tier {tier}"
         raise QueryError(f"query exceeded capacity tiers: {last_err}")
@@ -590,6 +617,16 @@ class Executor:
             valids=out_valids,
             _order=[c.id for c in visible],
         )
+
+
+def _is_pallas_error(e: Exception) -> bool:
+    """Does this exception look like a pallas/Mosaic lowering or compile
+    failure (vs a genuine runtime error like OOM or a dead interconnect)?
+    Mosaic failures surface as XlaRuntimeError/JaxRuntimeError whose text
+    names Mosaic or the TPU custom call; pallas tracing failures name
+    pallas itself."""
+    s = f"{type(e).__name__}: {e}".lower()
+    return any(m in s for m in ("pallas", "mosaic", "tpu_custom_call"))
 
 
 def _pad(arr: np.ndarray, cap: int, fill=0) -> np.ndarray:

@@ -64,17 +64,18 @@ def _order_encode(k: SortKey) -> list[jnp.ndarray]:
     return ops
 
 
-def order_pack_bits(keys: list[SortKey], bounds: list | None) -> int | None:
-    """Packed-operand feasibility: per-key (lo, hi) integer bounds must be
-    known for EVERY key and their (span + NULL slot) fields fit 63 bits
-    (bit 63 carries the dead-row flag)."""
-    if bounds is None or len(bounds) != len(keys) \
+def order_bounds_bits(bounds: list | None, nkeys: int) -> int | None:
+    """Shared field-width budget for ORDER BY key packing: per-key (lo, hi)
+    integer bounds must be known for EVERY key and their (span + NULL slot)
+    fields fit 63 bits (bit 63 carries the dead-row flag). Both the runtime
+    check (order_pack_bits) and the compiler's static feasibility mirror
+    (exec/compile._static_order_packable) call this, so the width rule can
+    never drift between them."""
+    if bounds is None or len(bounds) != nkeys \
             or any(b is None for b in bounds):
         return None
     total = 0
-    for k, (lo, hi) in zip(keys, bounds):
-        if k.rank_lut is not None:
-            return None            # TEXT collation ranks: not packable here
+    for lo, hi in bounds:
         span = int(hi) - int(lo) + 1
         if span <= 0:
             return None
@@ -82,6 +83,15 @@ def order_pack_bits(keys: list[SortKey], bounds: list | None) -> int | None:
         if total > 63:
             return None
     return total
+
+
+def order_pack_bits(keys: list[SortKey], bounds: list | None) -> int | None:
+    """Packed-operand feasibility for concrete SortKeys: the shared bounds
+    budget plus per-key runtime facts (TEXT collation ranks are not
+    packable)."""
+    if any(k.rank_lut is not None for k in keys):
+        return None
+    return order_bounds_bits(bounds, len(keys))
 
 
 def pack_order_keys(keys: list[SortKey], bounds: list, sel):
