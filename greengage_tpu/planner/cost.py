@@ -68,7 +68,29 @@ def _eq_sel(cs, v: float) -> float:
     return EQ_SELECTIVITY
 
 
+def _hist_frac_below(hist: list, v: float) -> float:
+    """Fraction of non-null values below ``v`` from an equi-depth histogram
+    (planner/stats.py): whole buckets below v count 1/nbuckets each, the
+    straddling bucket interpolates linearly within its boundaries — the
+    CHistogram bucket-calculus / ineq_histogram_selectivity analog."""
+    import bisect
+
+    nb = len(hist) - 1
+    if v <= hist[0]:
+        return 0.0
+    if v >= hist[-1]:
+        return 1.0
+    i = min(bisect.bisect_right(hist, v) - 1, nb - 1)
+    lo, hi = hist[i], hist[i + 1]
+    within = 0.5 if hi <= lo else (v - lo) / (hi - lo)
+    return (i + min(max(within, 0.0), 1.0)) / nb
+
+
 def _range_sel(cs, v: float, op: str) -> float:
+    if len(cs.hist) >= 2:
+        frac = _hist_frac_below(cs.hist, v)
+        s = frac if op in ("<", "<=") else 1.0 - frac
+        return float(min(max(s, 0.0), 1.0)) * (1.0 - cs.null_frac)
     if cs.min is None or cs.max is None:
         return RANGE_SELECTIVITY
     lo, hi = cs.min, cs.max

@@ -26,6 +26,9 @@ from greengage_tpu import types as T
 SAMPLE_ROWS = 240_000   # ~ the reference's default_statistics_target regime
 
 
+HIST_BUCKETS = 32   # equi-depth buckets per numeric/date column
+
+
 @dataclass
 class ColumnStats:
     ndv: float = 0.0            # estimated distinct values (excl. NULL)
@@ -33,16 +36,26 @@ class ColumnStats:
     min: float | None = None    # storage-encoded (dates=days, decimals=scaled)
     max: float | None = None
     mcv: list = field(default_factory=list)     # [(encoded value, fraction)]
+    # equi-depth histogram: HIST_BUCKETS+1 boundary values (sample
+    # quantiles), each bucket holding ~1/HIST_BUCKETS of the non-null
+    # mass — the pg_statistic histogram_bounds / CHistogram bucket
+    # calculus analog. Range selectivity reads bucket positions instead of
+    # linearly interpolating [min, max], which is wrong on any skewed
+    # distribution (and every mis-estimate here costs an XLA recompile
+    # tier).
+    hist: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {"ndv": self.ndv, "null_frac": self.null_frac,
-                "min": self.min, "max": self.max, "mcv": self.mcv}
+                "min": self.min, "max": self.max, "mcv": self.mcv,
+                "hist": self.hist}
 
     @staticmethod
     def from_dict(d: dict) -> "ColumnStats":
         return ColumnStats(d.get("ndv", 0.0), d.get("null_frac", 0.0),
                            d.get("min"), d.get("max"),
-                           [tuple(x) for x in d.get("mcv", [])])
+                           [tuple(x) for x in d.get("mcv", [])],
+                           list(d.get("hist", [])))
 
 
 @dataclass
@@ -117,6 +130,13 @@ def analyze_column(arr: np.ndarray, valid: np.ndarray | None,
         frac = counts / counts.sum()
         order = np.argsort(-counts)[:25]
         st.mcv = [(float(uniq[i]), float(frac[i])) for i in order]
+    # equi-depth histogram for range selectivity on orderable columns;
+    # skipped when the MCV list already describes the whole domain
+    if kind in (T.Kind.INT32, T.Kind.INT64, T.Kind.DECIMAL, T.Kind.DATE,
+                T.Kind.FLOAT64) and len(uniq) > 2:
+        bounds = np.quantile(
+            sample, np.linspace(0.0, 1.0, HIST_BUCKETS + 1))
+        st.hist = [float(b) for b in bounds]
     return st
 
 

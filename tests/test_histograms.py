@@ -1,0 +1,133 @@
+"""Equi-depth histograms (planner/stats.py) + bucket range selectivity
+(planner/cost.py _hist_frac_below) — VERDICT r3 #4.
+
+Reference parity: pg_statistic histogram_bounds consumed by
+ineq_histogram_selectivity, and ORCA's bucket calculus
+(libnaucrates/src/statistics/CHistogram.cpp). Linear [min, max]
+interpolation is wrong on any skewed distribution; the golden here pins a
+broadcast-vs-redistribute join flip that interpolation gets wrong and
+buckets get right.
+"""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu import types as T
+from greengage_tpu.planner import cost as C
+from greengage_tpu.planner import stats as S
+from greengage_tpu.planner.logical import describe
+from greengage_tpu.sql.parser import parse
+
+
+def _skewed(n, rng):
+    """99% of mass packed into [9000, 10000), 1% spread over [0, 9000)."""
+    tail = rng.integers(0, 9000, n // 100)
+    head = rng.integers(9000, 10000, n - len(tail))
+    return rng.permutation(np.concatenate([head, tail])).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# estimator units
+# ---------------------------------------------------------------------------
+
+def test_histogram_collected_and_selectivity_tracks_skew():
+    rng = np.random.default_rng(11)
+    vals = _skewed(100_000, rng)
+    cs = S.analyze_column(vals, None, len(vals), T.Kind.INT64, rng)
+    assert len(cs.hist) == S.HIST_BUCKETS + 1
+    truth = float((vals < 4500).mean())           # ~0.005
+    est = C._range_sel(cs, 4500.0, "<")
+    assert abs(est - truth) <= 0.02, (est, truth)
+    # the interpolation fallback (no histogram) is off by an order of
+    # magnitude on this distribution — the failure mode buckets fix
+    flat = S.ColumnStats(ndv=cs.ndv, min=cs.min, max=cs.max)
+    interp = C._range_sel(flat, 4500.0, "<")
+    assert interp > 10 * max(truth, 1e-9), (interp, truth)
+
+
+def test_histogram_endpoints_and_direction():
+    cs = S.ColumnStats(hist=[0.0, 1.0, 2.0, 10.0, 100.0])
+    assert C._range_sel(cs, -5.0, "<") == 0.0
+    assert C._range_sel(cs, 500.0, "<") == 1.0
+    assert C._range_sel(cs, 500.0, ">") == 0.0
+    lo = C._range_sel(cs, 1.5, "<")      # 1.5 buckets of 4
+    assert abs(lo - 1.5 / 4) < 1e-9
+    assert abs(C._range_sel(cs, 1.5, ">") - (1 - 1.5 / 4)) < 1e-9
+
+
+def test_stats_roundtrip_preserves_histogram():
+    cs = S.ColumnStats(ndv=5, hist=[0.0, 1.0, 2.0])
+    back = S.ColumnStats.from_dict(cs.to_dict())
+    assert back.hist == cs.hist
+    # pre-histogram persisted stats (round <=3 clusters) load cleanly
+    legacy = S.ColumnStats.from_dict({"ndv": 3.0, "min": 0.0, "max": 9.0})
+    assert legacy.hist == []
+    assert C._range_sel(legacy, 4.5, "<") == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# the plan golden: skewed range predicate flips broadcast <-> redistribute
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    rng = np.random.default_rng(23)
+    nf, nd = 200_000, 4000
+    d.sql("create table fact (k int, fk int, v int) distributed by (k)")
+    d.load_table("fact", {
+        "k": np.arange(nf),
+        "fk": rng.integers(0, nd, nf),
+        "v": rng.integers(0, 1000, nf),
+    })
+    # dim distributed by a non-join column: the join always needs motion,
+    # so the build side's ESTIMATED size decides broadcast vs redistribute
+    d.sql("create table dim (pk int, m int, s int) distributed by (m)")
+    d.load_table("dim", {
+        "pk": np.arange(nd), "m": np.arange(nd), "s": _skewed(nd, rng)})
+    d.sql("analyze")
+    return d
+
+
+def _plan(db, sql: str) -> str:
+    planned, _, _ = db._plan(parse(sql)[0])
+    return describe(planned)
+
+
+def _motion_above(plan_text: str, scan_substr: str) -> str:
+    lines = plan_text.splitlines()
+    for i, ln in enumerate(lines):
+        if scan_substr in ln:
+            for j in range(i - 1, -1, -1):
+                if "Motion" in lines[j] or "Join" in lines[j]:
+                    return lines[j]
+    return ""
+
+
+def test_skewed_range_filter_flips_to_broadcast(db):
+    # s < 4500 truly passes ~0.5% of dim (~20 rows): the histogram
+    # estimates ~60 (half of one 1/32 bucket) -> broadcast the tiny
+    # build. Linear interpolation says ~45% (~1800 rows) ->
+    # redistribute-both, the wrong plan (test_calibrated_costs.py pins
+    # that a 4000-row build at this fact size redistributes). The SAME
+    # query with a predicate whose linear and bucket estimates agree
+    # (s < 9750 ~ 76%) stays redistributed.
+    selective = _plan(db, "select sum(f.v) from fact f, dim d "
+                          "where f.fk = d.pk and d.s < 4500")
+    wide = _plan(db, "select sum(f.v) from fact f, dim d "
+                     "where f.fk = d.pk and d.s < 9750")
+    assert "Motion Broadcast" in _motion_above(selective, "Scan dim"), selective
+    assert "Motion Redistribute" in _motion_above(wide, "Scan dim"), wide
+
+
+def test_skewed_filter_execution_exact(db):
+    got = db.sql("select count(*) from fact f, dim d "
+                 "where f.fk = d.pk and d.s < 4500").rows()[0][0]
+    # host truth
+    import numpy as np
+    d = db.sql("select pk from dim where s < 4500").rows()
+    keep = {r[0] for r in d}
+    fk = db.sql("select fk from fact").rows()
+    want = sum(1 for (x,) in fk if x in keep)
+    assert got == want
