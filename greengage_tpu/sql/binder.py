@@ -151,7 +151,10 @@ class Binder:
                 normal.append(c)
         where = _join_and(normal)
 
-        plan, scope, leftover = self._bind_from(stmt.from_, where)
+        n_agg_items = sum(1 for it in stmt.items if _contains_agg(it.expr))
+        plan, scope, leftover = self._bind_from(
+            stmt.from_, where, group_by=stmt.group_by or None,
+            naggs=n_agg_items)
         if leftover is not None:
             f = Filter(plan, self._predicate(leftover, scope))
             plan = f
@@ -642,7 +645,10 @@ class Binder:
     # ------------------------------------------------------------------
     # FROM binding with pushdown + greedy join ordering
     # ------------------------------------------------------------------
-    def _bind_from(self, from_, where):
+    def _bind_from(self, from_, where, group_by=None, naggs=0):
+        """``group_by``/``naggs`` describe the aggregation that will sit
+        above this FROM (when the caller is a grouped SELECT): the memo
+        search folds its completion cost into join-order selection."""
         if not from_:
             raise SqlError("SELECT without FROM is not supported")
         items = [self._bind_table_ref(t) for t in from_]
@@ -670,7 +676,7 @@ class Binder:
 
         if self.optimizer:
             # Cascades-lite memo: bushy trees + distribution-property DP
-            tree = self._memo_join_tree(remaining, conds)
+            tree = self._memo_join_tree(remaining, conds, group_by, naggs)
             if tree is not None:
                 self.memo_used = True
                 plan, scope, conds = self._build_join_tree(
@@ -715,7 +721,7 @@ class Binder:
     # ------------------------------------------------------------------
     # memo search (the ORCA engine entry; planner/memo.py)
     # ------------------------------------------------------------------
-    def _memo_join_tree(self, items, conds):
+    def _memo_join_tree(self, items, conds, group_by=None, naggs=0):
         """-> nested index tree from the Cascades-lite memo, or None when
         it doesn't apply (missing stats, edge cols without NDV, too many
         or disconnected relations — the fallback DP/greedy takes over)."""
@@ -765,7 +771,37 @@ class Binder:
         if not edges:
             return None
         nseg = self.catalog.segments.numsegments
-        return M.optimize(rels, list(edges.values()), nseg)
+
+        # the GROUP BY above this FROM, resolved to bound col ids: joint
+        # join-order + agg-placement optimization (AggInfo docstring).
+        # Only simple column group keys qualify — computed keys can't match
+        # a distribution property anyway.
+        agg = None
+        if group_by:
+            gcols, ndv_prod = [], 1.0
+            for g in group_by:
+                hit = None
+                if isinstance(g, A.Name):
+                    for idx, (_, scope) in enumerate(items):
+                        try:
+                            ci = scope.resolve(g.parts)
+                            hit = (idx, ci.id)
+                            break
+                        except SqlError:
+                            continue
+                if hit is None:
+                    gcols = None
+                    break
+                idx, cid = hit
+                cs = col_stats[idx].get(cid)
+                if cs is None or cs.ndv <= 0:
+                    gcols = None
+                    break
+                gcols.append(cid)
+                ndv_prod *= max(cs.ndv, 1.0)
+            if gcols:
+                agg = M.AggInfo(tuple(gcols), ndv_prod, max(naggs, 1))
+        return M.optimize(rels, list(edges.values()), nseg, agg)
 
     def _build_join_tree(self, tree, items, conds):
         """Materialize the memo's nested index tree into Join nodes,
