@@ -94,7 +94,7 @@ class Compiler:
                  multihost: bool = False, scan_cap_override: dict | None = None,
                  aux_tables: dict | None = None,
                  pack_disabled: set | None = None,
-                 fused_disabled: bool = False):
+                 fused_disabled: bool = False, no_direct: bool = False):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -113,6 +113,10 @@ class Compiler:
         # fused dense-agg kernel: disabled wholesale after a pallas
         # compile failure (executor retries with the XLA path)
         self.fused_disabled = fused_disabled
+        # spill passes force the general hash join: a direct-addressed
+        # build allocates its FULL key domain regardless of how small the
+        # chunked build scan is, defeating the pass-size search
+        self.no_direct = no_direct
         self.scan_caps: dict[str, int] = {}
         self.scan_cols: dict[str, set] = {}
         self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
@@ -342,7 +346,8 @@ class Compiler:
             width = sum(max(c.type.np_dtype.itemsize, 1) + 1 for c in p.out_cols())
             total += cap * width
             if isinstance(p, Join):
-                if getattr(p, "direct_domain", None) is not None and self.tier == 0:
+                if getattr(p, "direct_domain", None) is not None \
+                        and self.tier == 0 and not self.no_direct:
                     # dense build table: slot_row/counts int32 + int64 temps
                     total += int(p.direct_domain) * 16
                 else:
@@ -628,7 +633,8 @@ class Compiler:
         # stats: live keys outside the analyzed domain) falls back to the
         # general hash table at tier 1
         direct = (getattr(plan, "direct_domain", None) is not None
-                  and self.tier == 0 and len(rkeys) == 1)
+                  and self.tier == 0 and len(rkeys) == 1
+                  and not self.no_direct)
         direct_lo = getattr(plan, "direct_lo", 0)
         direct_domain = getattr(plan, "direct_domain", 0)
         fid_pack = None

@@ -157,7 +157,8 @@ class Executor:
     def run(self, plan, consts: dict, out_cols, cache_key=None,
             raw: bool = False, instrument: bool = False,
             scan_cap_override=None, row_ranges=None, aux_tables=None,
-            allow_spill: bool = True, deferred: bool = False) -> Result:
+            allow_spill: bool = True, deferred: bool = False,
+            no_direct: bool = False) -> Result:
         self._row_ranges = row_ranges or {}
         self._aux_tables = aux_tables or {}
         t0 = time.monotonic()
@@ -195,7 +196,8 @@ class Executor:
                                 scan_cap_override=scan_cap_override,
                                 aux_tables=aux_tables,
                                 pack_disabled=pack_disabled,
-                                fused_disabled=fused_disabled).compile(plan)
+                                fused_disabled=fused_disabled,
+                                no_direct=no_direct).compile(plan)
                 if ck is not None:
                     # gang-reuse analog: keep the compiled SPMD program for
                     # repeated dispatch of the same statement; drop programs
@@ -215,10 +217,15 @@ class Executor:
                         f"{comp.est_bytes >> 20} MB per segment, above the "
                         f"{limit >> 20} MB memory ceiling; cursors pin the "
                         "whole result and cannot spill")
-                if allow_spill and self.multihost is None:
-                    # host-offload spill (exec/spill.py): partition the
-                    # probe-linear table into passes that fit, merge the
-                    # partial-aggregate states on a final pass
+                if allow_spill:
+                    # host-offload spill (exec/spill.py): partition a
+                    # probe-linear (or inner-join build) table into passes
+                    # that fit, merge the captured partial states /
+                    # deduped keys on a final pass. Multihost-safe: the
+                    # pass decision is deterministic (est_bytes +
+                    # settings) and every process gathers identical
+                    # replicated results, so workers take the same
+                    # branches in lockstep.
                     from greengage_tpu.exec import spill
 
                     try:
@@ -348,12 +355,13 @@ class Executor:
                              batch.snapshot, batch.raw)
 
     def run_single(self, plan, consts, out_cols, raw=False,
-                   scan_cap_override=None, row_ranges=None, aux_tables=None):
+                   scan_cap_override=None, row_ranges=None, aux_tables=None,
+                   no_direct=False):
         """One spill pass: no recursive spilling, no plan caching."""
         return self.run(plan, consts, out_cols, cache_key=None, raw=raw,
                         scan_cap_override=scan_cap_override,
                         row_ranges=row_ranges, aux_tables=aux_tables,
-                        allow_spill=False)
+                        allow_spill=False, no_direct=no_direct)
 
     # ------------------------------------------------------------------
     def _local_segments(self):

@@ -67,6 +67,24 @@ class Database:
             self.store.manifest.recover()   # in-doubt resolution on startup
             self.store.reconcile_widths()   # expansion crash recovery
         self.settings = Settings()
+        # measured cost-model primitives, if `gg checkperf --device
+        # --apply` ran against this cluster (planner/cost.set_calibration;
+        # workers load the same file, keeping plan choices in lockstep)
+        cal_path = os.path.join(path, "calibration.json")
+        from greengage_tpu.planner import cost as _cost
+
+        cal = None
+        if os.path.exists(cal_path):
+            import json as _json
+
+            try:
+                with open(cal_path) as f:
+                    cal = _json.load(f)
+            except (OSError, ValueError):
+                cal = None
+        # always (re)install — an uncalibrated cluster opened after a
+        # calibrated one in the same process must get the defaults back
+        _cost.set_calibration(cal)
         self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
@@ -241,6 +259,21 @@ class Database:
                     finally:
                         ch.collect_acks()
             else:
+                if isinstance(stmt, A.SetStmt):
+                    # settings steer MESH decisions (spill passes, retry
+                    # tiers, fused kernel): workers must apply the same
+                    # values or their lockstep branches desync. ONLY this
+                    # statement ships (a batch re-parse on the worker
+                    # would apply later statements the coordinator might
+                    # never reach)
+                    ch = self.multihost.channel
+                    ch.send({"op": "set", "name": stmt.name,
+                             "value": stmt.value})
+                    try:
+                        out = self._execute(stmt)
+                    finally:
+                        ch.collect_acks()
+                    continue
                 out = self._execute(stmt)
         return out
 
@@ -276,18 +309,13 @@ class Database:
             elif isinstance(stmt, (A.DeleteStmt, A.UpdateStmt)):
                 self._worker_dml_scan(stmt)
             # everything else is host-side work owned by the coordinator
+            # (SET arrives as its own channel op, never via batch text)
 
     def _worker_dml_scan(self, stmt):
         """Reproduce the coordinator's internal raw SELECT so its mesh
         program has all participants (the plan is deterministic)."""
         if isinstance(stmt, A.DeleteStmt):
-            if stmt.where is None:
-                return
-            survive = A.Bin("or", A.Unary("not", stmt.where),
-                            A.IsNullTest(stmt.where, False))
-            sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
-                               from_=[A.BaseTable(stmt.table)], where=survive)
-            self._run_raw(sel)
+            self._delete(stmt, worker_scan_only=True)
         else:
             self._update(stmt, worker_scan_only=True)
 
@@ -1481,6 +1509,27 @@ class Database:
                 self.dtm.abort()
             raise
 
+    def vacuum(self, table: str | None = None) -> dict:
+        """Compact deletion bitmaps away (the lazy-VACUUM role for the
+        visimap analog): every table carrying a bitmap is rewritten
+        live-rows-only at its current width, which also restores zone-map
+        pruned scans. -> {table: live rows kept}."""
+        if self.dtm.current is not None and self.dtm.current.state == "active":
+            raise SqlError("VACUUM cannot run inside a transaction")
+        with self._write_lock:
+            compacted: dict = {}
+            snap = self.store.manifest.snapshot()
+            for t, tmeta in snap.get("tables", {}).items():
+                if table is not None and t != table:
+                    continue
+                if tmeta.get("delmask"):
+                    n = self.store.rewrite_table(
+                        t, self.catalog.get(t).policy.numsegments)
+                    compacted[t] = n
+            self.store.reap_gc()
+            self._post_commit()
+        return compacted
+
     def load_table(self, table: str, columns: dict, valids: dict | None = None):
         """Bulk load host arrays (the gpfdist/COPY fast path for benchmarks)."""
         n = self._write_rows(table, columns, valids)
@@ -1700,25 +1749,93 @@ class Database:
                             {k: v[m] for k, v in enc.items()},
                             {k: v[m] for k, v in valids.items()})
 
-    def _delete(self, stmt: A.DeleteStmt):
+    def _predicate_mask(self, table: str, where) -> np.ndarray:
+        """Evaluate a DML predicate over every visible row on the mesh:
+        -> bool mask in gather order (segment-major, storage row order —
+        the plain projection preserves it; NULL predicate = False)."""
+        sel = A.SelectStmt(items=[A.SelectItem(where, alias="__dml_pred")],
+                           from_=[A.BaseTable(table)])
+        res, outs = self._run_raw(sel)
+        o = outs[0]
+        val = np.asarray(res.cols[o.id]).astype(bool)
+        v = res.valids.get(o.id)
+        return val if v is None else (val & np.asarray(v, bool))
+
+    def _visimap_masks(self, table: str, pred_mask: np.ndarray) -> dict:
+        """Merge a predicate mask over VISIBLE rows into per-segment
+        full-length deletion bitmaps (1 = deleted). Replicated tables
+        evaluate one copy and stamp every segment with the same bitmap
+        (copies share row order by construction)."""
+        schema = self.catalog.get(table)
+        snap = self.store.manifest.snapshot()
+        replicated = schema.policy.kind is PolicyKind.REPLICATED
+        nseg = schema.policy.numsegments
+        full = self.store.segment_rowcounts(table, snap)
+        masks: dict = {}
+        off = 0
+        for seg in ([0] if replicated else range(nseg)):
+            keep = self.store.delmask_keep(table, seg, snap)
+            live = int(keep.sum()) if keep is not None else full[seg]
+            m = pred_mask[off: off + live]
+            off += live
+            if not m.any():
+                continue
+            newdel = (np.zeros(full[seg], np.uint8) if keep is None
+                      else (~keep).astype(np.uint8))
+            live_pos = (np.flatnonzero(keep) if keep is not None
+                        else np.arange(full[seg]))
+            newdel[live_pos[m]] = 1
+            masks[seg] = newdel
+        if off != len(pred_mask):
+            raise RuntimeError(
+                f"DML scan returned {len(pred_mask)} rows but storage "
+                f"holds {off} visible rows — concurrent write raced the "
+                "statement; retry")
+        if replicated and masks:
+            masks = {s: masks[0] for s in range(nseg)}
+        return masks
+
+    def _delete(self, stmt: A.DeleteStmt, worker_scan_only: bool = False):
         self._check_no_raw_dml(stmt.table)
         tx = self._tx_for_dml(stmt.table, "DELETE")
         _reject_dml_subqueries(stmt.where)
         schema = self.catalog.get(stmt.table)
-        total = sum(self.store.segment_rowcounts(stmt.table))
+        # VISIBLE rows (manifest counts minus deletion bitmaps): the
+        # reported DELETE count must not re-count already-deleted rows
+        total = sum(self.store.live_rowcounts(stmt.table))
         raw_names = self.store.raw_column_names(stmt.table)
         if stmt.where is None:
+            if worker_scan_only:
+                return "DELETE 0"   # truncate: no mesh scan on either side
             empty = {c.name: np.empty(
                 0, dtype=(np.int64 if c.name in raw_names
                           else c.type.np_dtype)) for c in schema.columns}
             raw_strs = {n: np.empty(0, dtype=object) for n in raw_names}
             self._replace_table(schema, empty, {}, tx, raw_strs or None)
             return f"DELETE {total}"
-        # survivors: predicate false OR NULL
+        if not schema.is_partitioned:
+            # visimap path (appendonly_visimap.c analog): publish a
+            # deletion bitmap instead of rewriting the table — DELETE
+            # stages only the predicate's columns and writes O(bitmap),
+            # not O(table)
+            mask = self._predicate_mask(stmt.table, stmt.where)
+            if worker_scan_only:
+                return "DELETE 0"   # lockstep scan only; coordinator publishes
+            masks = self._visimap_masks(stmt.table, mask)
+            if masks:
+                if tx is not None:
+                    tx.set_delmask(stmt.table, masks)
+                else:
+                    self.store.set_delmask(stmt.table, masks)
+            return f"DELETE {int(mask.sum())}"
+        # partitioned fallback: republish survivors (predicate false OR
+        # NULL) — per-child bitmaps need per-child row spans, deferred
         survive = A.Bin("or", A.Unary("not", stmt.where), A.IsNullTest(stmt.where, False))
         sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
                            from_=[A.BaseTable(stmt.table)], where=survive)
         res, outs = self._run_raw(sel)
+        if worker_scan_only:
+            return "DELETE 0"
         enc = {}
         valids = {}
         raw_strs = {}
@@ -1788,7 +1905,17 @@ class Database:
         flag = stmt.where if stmt.where is not None else A.Bool(True)
         items.append(A.SelectItem(flag, alias="__upd"))
         flag_slot = next_slot
-        sel = A.SelectStmt(items=items, from_=[A.BaseTable(stmt.table)])
+        # visimap split (nodeSplitUpdate.c + appendonly_visimap.c): mark
+        # the old row versions deleted in the bitmap and APPEND the new
+        # versions — the matched-rows scan pushes the WHERE (pruning
+        # applies), so an UPDATE touches O(matched + bitmap), not
+        # O(table). Partitioned / whole-table UPDATEs keep the republish.
+        visimap = not schema.is_partitioned and stmt.where is not None
+        pred_mask = None
+        if visimap:
+            pred_mask = self._predicate_mask(stmt.table, stmt.where)
+        sel = A.SelectStmt(items=items, from_=[A.BaseTable(stmt.table)],
+                           where=stmt.where if visimap else None)
         res, outs = self._run_raw(sel)
         if worker_scan_only:
             return "UPDATE 0"   # multi-host worker: scan only, no publish
@@ -1835,6 +1962,20 @@ class Database:
             enc[c.name] = merged.astype(c.type.np_dtype)
             if not mergedv.all():
                 valids[c.name] = mergedv
+        if visimap:
+            if len(res) != int(pred_mask.sum()):
+                raise RuntimeError(
+                    f"UPDATE matched-row scan returned {len(res)} rows but "
+                    f"the predicate pass marked {int(pred_mask.sum())} — "
+                    "concurrent write raced the statement; retry")
+            masks = self._visimap_masks(stmt.table, pred_mask)
+            with self._autocommit_tx() as atx:
+                if masks:
+                    atx.set_delmask(stmt.table, masks)
+                if len(res):
+                    atx.insert_encoded(stmt.table, enc, valids,
+                                       raw_strs or None)
+            return f"UPDATE {int(pred_mask.sum())}"
         self._replace_table(schema, enc, valids, tx, raw_strs or None)
         return f"UPDATE {int(mask.sum())}"
 

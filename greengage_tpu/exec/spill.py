@@ -66,42 +66,93 @@ _WRAPPERS = (Sort, Limit, Project, Filter)
 
 
 def find_spill_split(plan: Motion):
-    """-> (motion, partial_agg) of the topmost final/partial aggregate pair
-    below the gather, or None if the plan does not have the spillable
-    shape."""
+    """-> (capture_agg, replace_target) of the DEEPEST reduction point on
+    the plan's spine, or None.
+
+    A reduction point is an Aggregate whose output rows merge exactly
+    across disjoint input partitions:
+      - a partial aggregate (states are sums/counts/min/max: additive) —
+        capture its STATE columns, swap the partial itself in the merge;
+      - a keys-only "dedupe" aggregate (DISTINCT level: dedupe is
+        idempotent under union — dedupe(∪ dedupe(chunk)) = dedupe(∪)) —
+        capture its key rows, swap the subtree BELOW its redistribute
+        Motion so the merge re-hashes the union before re-deduping.
+    The walk descends through wrappers, motions, and single-phase
+    aggregates so a DISTINCT dedupe buried under the outer aggregate's
+    own phases is still found (execHHashagg.c spills the dedupe level the
+    same way)."""
     node = plan.child
-    while isinstance(node, _WRAPPERS):
-        node = node.child
-    if not isinstance(node, Aggregate) or node.phase != "final":
-        return None
-    motion = node.child
-    if not isinstance(motion, Motion):
-        return None
-    partial = motion.child
-    if not isinstance(partial, Aggregate) or partial.phase != "partial":
-        return None
-    return motion, partial
+    best = None
+    while True:
+        while isinstance(node, _WRAPPERS):
+            node = node.child
+        if (isinstance(node, Aggregate) and node.phase == "final"
+                and isinstance(node.child, Motion)
+                and isinstance(node.child.child, Aggregate)
+                and node.child.child.phase == "partial"):
+            partial = node.child.child
+            best = (partial, partial, False)
+            node = partial.child
+            continue
+        if (isinstance(node, Aggregate) and node.phase == "single"
+                and not node.aggs and node.group_keys
+                # the merge re-runs this dedupe over host-staged rows
+                # carrying the OUTPUT ids, so every key must be a plain
+                # pass-through column (the binder's id invariant)
+                and all(isinstance(e, E.ColRef) and e.name == ci.id
+                        for ci, e in node.group_keys)):
+            if (isinstance(node.child, Motion)
+                    and node.child.kind is MotionKind.REDISTRIBUTE):
+                # the existing motion re-hashes the merge's union rows,
+                # co-locating cross-pass duplicates before the re-dedupe
+                best = (node, node.child.child, False)
+                node = node.child.child
+            else:
+                # colocated dedupe (input already hashed on the keys):
+                # duplicates of a key can still span PASSES, and the
+                # contiguous host staging scatters them across segments —
+                # the merge must insert a redistribute of its own
+                best = (node, node.child, True)
+                node = node.child
+            continue
+        if isinstance(node, Aggregate) and node.phase == "single":
+            node = node.child
+            continue
+        if isinstance(node, Motion) and node.kind is MotionKind.REDISTRIBUTE:
+            node = node.child
+            continue
+        break
+    return best
 
 
-def probe_lineage_tables(plan: Plan) -> list[str]:
-    """Tables whose rows the subtree is LINEAR in: reachable from the root
-    without crossing a join's build side (right child), a Union, or a
-    Window (row-coupled)."""
+def spill_candidate_tables(plan: Plan) -> list[str]:
+    """Tables over whose row-partitions the subtree's OUTPUT is a disjoint
+    union — partitioning any of them into passes is sound below an
+    (order-insensitive) reduction point.
+
+    Probe-side descent is always sound (each probe row lives in exactly
+    one chunk). Build-side descent is sound only through INNER (and
+    cross) joins: a chunked build partitions each probe row's matches
+    across passes, which unions exactly for inner joins but double-counts
+    semi joins and null-extends left joins per pass — the grace-join
+    batching analog (nodeHashjoin.c) restricted the same way. Aggregates,
+    windows, unions, sorts, and limits end soundness (limit/sort are not
+    union-distributive; a nested agg is its own reduction point)."""
     out = []
-    node = plan
-    while node is not None:
+
+    def walk(node):
         if isinstance(node, Scan):
             out.append(node.table)
-            return out
+            return
         if isinstance(node, Join):
-            node = node.left
-        elif isinstance(node, (Sort, Limit, Project, Filter, Motion)):
-            # NOTE: a nested Aggregate (DISTINCT dedupe level) is NOT
-            # row-linear — agg(chunk_A) U agg(chunk_B) != agg(all) — so it
-            # ends the lineage and the plan is unspillable
-            node = node.child
-        else:
-            return out
+            walk(node.left)
+            if node.kind in ("inner", "cross") and not node.null_aware:
+                walk(node.right)
+            return
+        if isinstance(node, (Project, Filter, Motion)):
+            walk(node.child)
+
+    walk(plan)
     return out
 
 
@@ -122,60 +173,93 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
     split = find_spill_split(plan)
     if split is None:
         raise NotSpillable("plan shape not spillable")
-    motion, partial = split
-    lineage = probe_lineage_tables(partial.child)
-    if not lineage:
-        raise NotSpillable("no probe-linear table to partition")
-    table = lineage[-1]
-    if table.startswith("@") or count_scans(plan, table) != 1:
-        raise NotSpillable("partition table is scanned more than once")
+    capture_agg, replace_target, add_motion = split
+    subtree = (capture_agg.child if capture_agg is replace_target
+               else replace_target)
+    candidates = [t for t in spill_candidate_tables(subtree)
+                  if not t.startswith("@") and count_scans(plan, t) == 1]
+    if not candidates:
+        raise NotSpillable("no partitionable table below the reduction point")
     store = executor.store
-    counts = store.segment_rowcounts(table)
-    max_rows = max(counts, default=0)
-    if max_rows == 0:
-        raise NotSpillable("partition table is empty")
 
     from greengage_tpu.exec.executor import effective_limit_bytes
 
     settings = executor.settings
     limit_bytes = effective_limit_bytes(settings)
 
-    # pass program: gather the PARTIAL aggregate's STATE columns (raw
-    # storage representation; finalize must not decode)
-    state_cols = partial_state_cols(partial)
-    capture = PartialState(partial, state_cols)
-    capture.locus = partial.locus
-    capture.est_rows = partial.est_rows
+    # pass program: gather the reduction point's output rows (partial
+    # STATE columns / dedupe keys; raw storage representation — finalize
+    # must not decode)
+    state_cols = partial_state_cols(capture_agg)
+    capture = PartialState(capture_agg, state_cols)
+    capture.locus = capture_agg.locus
+    capture.est_rows = capture_agg.est_rows
     pass_plan = Motion(MotionKind.GATHER, capture)
     pass_plan.locus = Locus.entry()
 
-    # find the chunk size that brings the pass program under the limit
+    # choose the partition tables (largest first — probe side AND/OR
+    # inner-join build sides, the grace-join regime: when both sides of a
+    # join exceed HBM, BOTH are range-partitioned and the passes walk the
+    # cartesian chunk grid, exactly nodeHashjoin.c's batch x batch
+    # schedule but with whole execution passes) and the chunk sizes that
+    # bring the pass program under the limit
     from greengage_tpu.exec.compile import Compiler
 
-    chunk = max_rows
+    candidates.sort(
+        key=lambda t: -max(store.segment_rowcounts(t), default=0))
     floor = 1 << 12
-    while True:
-        chunk = max(chunk // 2, floor)
-        comp = Compiler(executor.catalog, store, executor.mesh, executor.nseg,
-                        consts, settings,
-                        scan_cap_override={table: chunk}).compile(pass_plan)
-        if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
+    MAX_PASSES = 256
+    chosen: dict[str, int] = {}          # table -> chunk rows
+    comp = None
+    fits = False
+    for cand in candidates:
+        max_rows = max(store.segment_rowcounts(cand), default=0)
+        if max_rows == 0:
+            continue
+        chunk = max_rows
+        while True:
+            chunk = max(chunk // 2, floor)
+            over = dict(chosen)
+            over[cand] = chunk
+            comp = Compiler(executor.catalog, store, executor.mesh,
+                            executor.nseg, consts, settings,
+                            scan_cap_override=over,
+                            no_direct=True).compile(pass_plan)
+            if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
+                break
+        chosen[cand] = chunk
+        if comp.est_bytes <= limit_bytes:
+            fits = True
             break
-    if comp.est_bytes > limit_bytes:
-        raise NotSpillable("per-pass working set still exceeds the limit")
-    npasses = -(-max_rows // chunk)
+    if not fits:
+        raise NotSpillable("per-pass working set still exceeds the limit "
+                           "for every partitionable table combination")
+    per_table = []                        # (table, chunk, npasses)
+    npasses = 1
+    for t, chunk in chosen.items():
+        max_rows = max(store.segment_rowcounts(t), default=0)
+        n = -(-max_rows // chunk)
+        per_table.append((t, chunk, n))
+        npasses *= n
+    if npasses > MAX_PASSES:
+        raise NotSpillable(
+            f"spill would need {npasses} passes (> {MAX_PASSES})")
 
     # run the passes, collecting partial rows on the host (the workfile)
+    import itertools
+
+    grids = [[(t, (i * c, (i + 1) * c)) for i in range(n)]
+             for t, c, n in per_table]
+    caps = {t: c for t, c, _ in per_table}
     partial_cols = state_cols
     host_cols = {c.id: [] for c in partial_cols}
     host_valids = {c.id: [] for c in partial_cols}
     any_invalid = {c.id: False for c in partial_cols}
-    for p in range(npasses):
-        rr = (p * chunk, (p + 1) * chunk)
+    for combo in itertools.product(*grids):
         res = executor.run_single(
             pass_plan, consts, partial_cols, raw=True,
-            scan_cap_override={table: chunk},
-            row_ranges={table: rr})
+            scan_cap_override=caps,
+            row_ranges=dict(combo), no_direct=True)
         for c in partial_cols:
             host_cols[c.id].append(np.asarray(res.cols[c.id]))
             v = res.valids.get(c.id)
@@ -190,16 +274,31 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
                          if any_invalid[c.id] else None)
                   for c in partial_cols}
 
-    # merge program: the original plan with the partial subtree swapped for
-    # a host input of the concatenated partial rows
+    # merge program: the original plan with the replace target swapped for
+    # a host input of the concatenated captured rows. Partial case: the
+    # partial itself is replaced (its states redistribute + final-merge
+    # above). Dedupe case: the subtree BELOW the dedupe's redistribute is
+    # replaced, so the union re-hashes (co-locating cross-pass duplicates)
+    # and the dedupe re-runs on device.
     aux_name = "@spill:partials"
     host_scan = Scan(aux_name, list(partial_cols))
-    host_scan.locus = partial.locus
+    host_scan.locus = (capture_agg.locus if capture_agg is replace_target
+                       else Locus.strewn(executor.nseg))
     host_scan.est_rows = float(len(next(iter(aux_cols.values()), [])))
-    merged = _replace_child(plan, partial, host_scan)
+    repl: Plan = host_scan
+    if add_motion:
+        key_cols = [ci for ci, _ in capture_agg.group_keys]
+        m = Motion(MotionKind.REDISTRIBUTE, host_scan,
+                   hash_exprs=[E.ColRef(ci.id, ci.type) for ci in key_cols])
+        m.locus = Locus.hashed(tuple(ci.id for ci in key_cols),
+                               executor.nseg)
+        m.est_rows = host_scan.est_rows
+        repl = m
+    merged = _replace_child(plan, replace_target, repl)
     return executor.run_single(
         merged, consts, out_cols, raw=raw,
-        aux_tables={aux_name: (aux_cols, aux_valids)}), npasses
+        aux_tables={aux_name: (aux_cols, aux_valids)},
+        no_direct=True), npasses
 
 
 def _replace_child(plan: Plan, target: Plan, repl: Plan) -> Plan:

@@ -58,11 +58,14 @@ def cmd_replicate(args):
 
 
 def cmd_vacuum(args):
-    """Reclaim unreferenced segment files (rolled-back/stale writers)."""
+    """Compact deletion bitmaps (visimap VACUUM) and reclaim
+    unreferenced segment files (rolled-back/stale writers)."""
     db = _open(args.dir)
-    db.store.reap_gc()
+    compacted = db.vacuum(getattr(args, "table", None))   # reaps GC too
     n = db.store.sweep_orphans(args.grace)
-    print(f"vacuum: removed {n} orphaned files")
+    print(f"vacuum: compacted {len(compacted)} table(s) "
+          f"({sum(compacted.values())} live rows), "
+          f"removed {n} orphaned files")
     return 0
 
 
@@ -178,13 +181,84 @@ def cmd_checkperf(args):
     except Exception as e:   # no device available is a report, not a crash
         results["device_error"] = str(e)[:120]
 
+    if getattr(args, "device", False):
+        try:
+            cal = _measure_device_primitives()
+            results.update({f"cal_{k}": v for k, v in cal.items()})
+            if getattr(args, "apply", False):
+                import json as _json
+
+                p = os.path.join(args.dir, "calibration.json")
+                with open(p, "w") as f:
+                    _json.dump(cal, f, indent=1)
+                print(f"calibration written to {p}")
+        except Exception as e:
+            results["calibration_error"] = str(e)[:160]
+
     print(f"{'path':<28} {'bandwidth':>14}")
     for k, v in results.items():
         if isinstance(v, float):
-            print(f"{k:<28} {v:>11.0f} MB/s")
+            if k.startswith("cal_"):
+                print(f"{k:<28} {v:>14.6g}")
+            else:
+                print(f"{k:<28} {v:>11.0f} MB/s")
         else:
             print(f"{k:<28} {v}")
     return 0
+
+
+def _measure_device_primitives(n: int = 1 << 22) -> dict:
+    """Measure the planner cost model's primitives (planner/cost.py
+    CALIBRATION_DEFAULTS) on the live backend: random gather, scatter-add,
+    two-operand sort, HBM streaming, and the device->host relay. The ICI
+    constant needs >1 device; on a single chip it keeps its default."""
+    import time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    idx = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
+    val = jnp.asarray(rng.integers(0, 1 << 30, n).astype(np.int32))
+    key = jnp.asarray(rng.integers(0, 1 << 30, n).astype(np.int64))
+
+    def best_s(fn, *a, reps=3):
+        fn_j = jax.jit(fn)
+        jax.block_until_ready(fn_j(*a))   # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn_j(*a))
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    cal = {}
+    cal["ns_gather_row"] = best_s(lambda v, i: v[i], val, idx) * 1e9 / n
+    cal["ns_scatter_row"] = best_s(
+        lambda v, i: jnp.zeros((n,), v.dtype).at[i].add(v), val, idx) \
+        * 1e9 / n
+    # two operands (key + payload) -> per-operand cost
+    from jax import lax
+
+    cal["ns_sort_row"] = best_s(
+        lambda k, v: lax.sort((k, v), num_keys=1), key, val) * 1e9 / n / 2
+    # one read + one write pass of 8B rows
+    cal["ns_stream_byte"] = best_s(lambda k: k * 2, key) * 1e9 / (n * 16)
+    # device->host relay: fixed call floor from a tiny transfer, per-byte
+    # from a big one
+    small = jnp.ones((8,), jnp.int64)
+    t0 = time.monotonic()
+    for _ in range(3):
+        jax.device_get(small)
+    cal["ns_host_call"] = (time.monotonic() - t0) / 3 * 1e9
+    t0 = time.monotonic()
+    jax.device_get(key)
+    big_s = time.monotonic() - t0
+    per_byte = (big_s * 1e9 - cal["ns_host_call"]) / (n * 8)
+    cal["ns_host_byte"] = max(per_byte, 1e-4)
+    return cal
 
 
 def cmd_load(args):
@@ -791,6 +865,7 @@ def main(argv=None):
 
     p = sub.add_parser("vacuum")
     p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-t", "--table", default=None)
     p.add_argument("--grace", type=float, default=120.0)
     p.set_defaults(fn=cmd_vacuum)
 
@@ -807,6 +882,12 @@ def main(argv=None):
     p = sub.add_parser("checkperf")   # gpcheckperf analog
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("--size-mb", type=int, default=64)
+    p.add_argument("--device", action="store_true",
+                   help="measure planner cost-model primitives on the "
+                        "live backend")
+    p.add_argument("--apply", action="store_true",
+                   help="persist measurements to <dir>/calibration.json "
+                        "(loaded by every future connect)")
     p.set_defaults(fn=cmd_checkperf)
 
     p = sub.add_parser("load")        # gpload analog

@@ -178,6 +178,10 @@ def worker_loop(db) -> None:
             if msg.get("op") == "sql":
                 db.refresh()
                 db.worker_sql(msg["sql"])
+            elif msg.get("op") == "set":
+                # mesh-steering settings stay in lockstep (spill passes,
+                # retry tiers) — applied singly, never as batch re-parse
+                db.settings.set(msg["name"], msg["value"])
             ch.ack(True)
         except Exception as e:
             ch.ack(False, f"{type(e).__name__}: {e}")

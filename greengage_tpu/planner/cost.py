@@ -34,14 +34,41 @@ DEFAULT_FILTER_SELECTIVITY = 0.25
 EQ_SELECTIVITY = 0.05
 RANGE_SELECTIVITY = 0.33
 
-# measured v5e primitive costs (ns per row / byte); see module docstring
-NS_GATHER_ROW = 10.7
-NS_SCATTER_ROW = 90.0
-NS_SORT_ROW = 40.0          # per sort operand (key or payload column)
-NS_STREAM_BYTE = 0.0025
-NS_ICI_BYTE = 0.02
-NS_HOST_BYTE = 36.0         # axon device->host relay ~28 MB/s
-NS_HOST_CALL = 65e6         # fixed per device->host transfer
+# measured v5e primitive costs (ns per row / byte); see module docstring.
+# These are DEFAULTS: `gg checkperf --device --apply` re-measures them on
+# the live chip and persists a <cluster>/calibration.json that
+# set_calibration() loads at connect — on any other TPU generation the
+# model tracks the hardware instead of silently reverting to folklore
+# (the gpcheckperf + libgpdbcost calibration intent, gpMgmt/bin/gpcheckperf:1).
+CALIBRATION_DEFAULTS = {
+    "ns_gather_row": 10.7,
+    "ns_scatter_row": 90.0,
+    "ns_sort_row": 40.0,     # per sort operand (key or payload column)
+    "ns_stream_byte": 0.0025,
+    "ns_ici_byte": 0.02,
+    "ns_host_byte": 36.0,    # axon device->host relay ~28 MB/s
+    "ns_host_call": 65e6,    # fixed per device->host transfer
+}
+
+
+def set_calibration(values: dict | None) -> None:
+    """Install measured primitive costs (keys of CALIBRATION_DEFAULTS;
+    missing/invalid entries keep their defaults). None resets."""
+    g = globals()
+    for k, default in CALIBRATION_DEFAULTS.items():
+        v = (values or {}).get(k, default)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            v = default
+        g[k.upper()] = v if v > 0 else default
+
+
+def current_calibration() -> dict:
+    return {k: globals()[k.upper()] for k in CALIBRATION_DEFAULTS}
+
+
+set_calibration(None)   # establish NS_GATHER_ROW .. NS_HOST_CALL globals
 
 
 def _col_and_lit(pred: E.Cmp):

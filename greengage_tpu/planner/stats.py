@@ -116,9 +116,12 @@ def analyze_column(arr: np.ndarray, valid: np.ndarray | None,
                 T.Kind.FLOAT64, T.Kind.BOOL):
         st.min = float(np.min(vals))
         st.max = float(np.max(vals))
-    # NDV + MCV from a uniform sample
+    # NDV + MCV from a uniform WITHOUT-replacement sample: Duj1 models a
+    # true row sample — drawing with replacement manufactures duplicate
+    # draws of unique values, deflating NDV ~40% at a 0.8 sampling rate
+    # and mis-classifying primary keys as duplicate-capable join builds
     if len(vals) > SAMPLE_ROWS:
-        sample = vals[rng.integers(0, len(vals), SAMPLE_ROWS)]
+        sample = vals[rng.choice(len(vals), SAMPLE_ROWS, replace=False)]
     else:
         sample = vals
     uniq, counts = np.unique(sample, return_counts=True)

@@ -34,6 +34,30 @@ class Transaction:
         self.tables_written.add(table)
         return n
 
+    def insert_encoded(self, table: str, enc, valids, raw_strs=None) -> int:
+        """Stage an already-encoded append (the UPDATE new-row half of the
+        visimap split: delete bitmap + appended row versions)."""
+        if self.state != "active":
+            raise TransactionError(f"transaction is {self.state}")
+        n = self.store.insert_encoded(table, enc, valids, raw_strs,
+                                      tx=self.tx)
+        self.tables_written.add(table)
+        return n
+
+    def set_delmask(self, table: str, masks) -> None:
+        """Stage deletion bitmaps; replaced bitmaps are GC'd at commit,
+        the new ones reclaimed on rollback."""
+        if self.state != "active":
+            raise TransactionError(f"transaction is {self.state}")
+        old = self.store.stage_delmask(self.tx, table, masks)
+        new_rels = [self.tx["tables"][table]["delmask"][str(s)]
+                    for s in masks]
+        if not hasattr(self, "_staged_new"):
+            self._staged_new = []
+        self._staged_new.append((table, new_rels))
+        self._gc.append((table, old))
+        self.tables_written.add(table)
+
     def replace(self, table: str, enc, valids, raw_strs=None) -> None:
         """Stage a DELETE/UPDATE republish; the old files become
         unreachable at commit and are GC'd then, the NEW files are

@@ -104,6 +104,9 @@ class TableStore:
         # join keys on raw TEXT): ref registry + per-segment code arrays
         self._rawdict_refs: dict = {}   # (table, col, version) -> ref
         self._rawcode_cache: dict = {}  # (storage, seg, col, version) -> (codes, valid)
+        # deletion-bitmap keep masks (visimap analog): (table, seg, version)
+        # -> bool[manifest nrows] keep mask, or None when nothing deleted
+        self._delmask_cache: dict = {}
 
     # ---- per-content data roots (mirror failover) ----------------------
     def data_root(self, content: int) -> str:
@@ -330,6 +333,14 @@ class TableStore:
             if len(arr) != nrows:
                 raise ValueError("ragged insert")
 
+        return self._append_encoded(table, schema, enc, valids, raw_strs,
+                                    tx, dict_sizes)
+
+    def _append_encoded(self, table, schema, enc, valids, raw_strs, tx,
+                        dict_sizes) -> int:
+        """Shared append tail of insert()/insert_encoded(): placement,
+        segfile write, manifest merge (with the optimistic CAS retry)."""
+        nrows = len(next(iter(enc.values()))) if enc else 0
         own_tx = tx is None
         if own_tx:
             tx = self.manifest.begin()
@@ -575,7 +586,12 @@ class TableStore:
         base = os.path.join(self.data_root(seg), table)
         keep = None
         self.last_prune = None
-        if prune:
+        # deletion bitmap (visimap analog): rows marked deleted are dropped
+        # after assembly. Zone-map block pruning is skipped while a bitmap
+        # exists — pruned blocks would desync the bitmap's row numbering;
+        # VACUUM compaction restores pruned scans.
+        keep_rows = self.delmask_keep(table, seg, snap)
+        if prune and keep_rows is None:
             idx_cols = frozenset(
                 d["column"] for d in getattr(schema, "indexes", {}).values())
             keep, kept_n, total_n = self._kept_blocks(files, base, prune,
@@ -643,6 +659,16 @@ class TableStore:
                 raise IOError(f"{table}.{name} seg{seg}: {len(cols[name])} rows, manifest says {nrows}")
         if keep is not None and want:
             nrows = len(next(iter(cols.values()))) if cols else 0
+        if keep_rows is not None:
+            # raw-TEXT surrogates keep their ORIGINAL row numbers through
+            # the filter (they were generated before it), so fetch_raw
+            # still indexes the full blob correctly
+            for name in cols:
+                cols[name] = cols[name][keep_rows]
+                v = valids.get(name)
+                if v is not None:
+                    valids[name] = v[keep_rows]
+            nrows = int(keep_rows.sum())
         return cols, valids, nrows
 
     # ---- raw TEXT columns (varlena analog) -----------------------------
@@ -789,10 +815,13 @@ class TableStore:
             total += n
             for c in schema.columns:
                 if c.name in raw_names:
-                    # re-placement needs the actual strings, not surrogates
-                    cols[c.name] = np.asarray(
+                    # re-placement needs the actual strings, not surrogates;
+                    # the deletion bitmap filter must match read_segment's
+                    strs = np.asarray(
                         self.raw_chunk(table, seg, c.name, snap).strings(),
                         dtype=object)
+                    km = self.delmask_keep(table, seg, snap)
+                    cols[c.name] = strs if km is None else strs[km]
                 parts_cols[c.name].append(cols[c.name])
                 v = valids[c.name]
                 if v is not None:
@@ -988,6 +1017,104 @@ class TableStore:
         v = self.manifest.prepare(tx)
         self.manifest.commit(v)
         self.gc_files(table, old_files)
+
+    # ---- deletion bitmaps (the appendonly visimap analog) ---------------
+    # DELETE/UPDATE never rewrite data files: they publish a per-segment
+    # deletion bitmap ('@del.<fileno>.ggb' — '@' can never collide with a
+    # column identifier) recorded in BOTH tmeta["delmask"] (lookup) and
+    # segfiles (replication/archive/orphan-sweep walk segfiles, so the
+    # bitmap rides every existing durability path). The bitmap covers the
+    # first len(mask) rows of the segment in manifest file order; rows
+    # appended later are implicitly live. Full rewrites (stage_replace /
+    # rewrite_table / VACUUM compaction) drop it.
+    # Reference: src/backend/access/appendonly/appendonly_visimap.c:1.
+
+    def delmask_keep(self, table: str, seg: int,
+                     snapshot: dict | None = None):
+        """-> bool[nrows] keep mask (True = live) or None when the segment
+        has no deletions. Manifest-version cached."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = (table, seg, version)
+        if key in self._delmask_cache:
+            return self._delmask_cache[key]
+        tmeta = snap["tables"].get(table, {})
+        rel = tmeta.get("delmask", {}).get(str(seg))
+        keep = None
+        if rel is not None:
+            deleted = read_column_file(self.seg_file_path(table, rel))
+            nrows = tmeta.get("nrows", {}).get(str(seg), 0)
+            keep = np.ones(nrows, dtype=bool)
+            keep[: len(deleted)] = ~deleted.astype(bool)
+            if keep.all():
+                keep = None
+        self._delmask_cache[key] = keep
+        if len(self._delmask_cache) > 256:
+            self._delmask_cache.pop(next(iter(self._delmask_cache)))
+        return keep
+
+    def live_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
+        """Per-segment VISIBLE row counts (manifest nrows minus deletion
+        bitmap) — what read_segment will actually return."""
+        snap = snapshot or self.manifest.snapshot()
+        out = []
+        for seg, n in enumerate(self.segment_rowcounts(table, snap)):
+            keep = self.delmask_keep(table, seg, snap)
+            out.append(int(keep.sum()) if keep is not None else n)
+        return out
+
+    def stage_delmask(self, tx: dict, table: str,
+                      masks: dict[int, np.ndarray]) -> list:
+        """Stage new deletion bitmaps (1 = deleted, full manifest length)
+        into a manifest tx; returns the REPLACED bitmap rels for GC."""
+        schema = self.catalog.get(table)
+        tmeta = tx["tables"].setdefault(table, {"segfiles": {}, "nrows": {}})
+        dm = tmeta.setdefault("delmask", {})
+        compresstype = schema.options.get("compresstype", "zlib")
+        complevel = int(schema.options.get("compresslevel", 1))
+        fileno = uuid.uuid4().hex[:12]
+        old_rels = []
+        for seg, mask in masks.items():
+            mask = np.asarray(mask, dtype=np.uint8)
+            segdir = os.path.join(self.data_root(seg), table, f"seg{seg}")
+            os.makedirs(segdir, exist_ok=True)
+            fn = f"@del.{fileno}.ggb"
+            write_column_file(os.path.join(segdir, fn), mask,
+                              compresstype, complevel)
+            rel = os.path.join(f"seg{seg}", fn)
+            old = dm.get(str(seg))
+            files = tmeta["segfiles"].setdefault(str(seg), [])
+            if old is not None:
+                old_rels.append(old)
+                if old in files:
+                    files.remove(old)
+            files.append(rel)
+            dm[str(seg)] = rel
+        return old_rels
+
+    def set_delmask(self, table: str, masks: dict[int, np.ndarray]) -> None:
+        """Autocommit bitmap publish (one manifest commit)."""
+        tx = self.manifest.begin()
+        old = self.stage_delmask(tx, table, masks)
+        v = self.manifest.prepare(tx)
+        self.manifest.commit(v)
+        self.gc_files(table, old)
+
+    def insert_encoded(self, table: str, enc: dict, valids: dict,
+                       raw_strs: dict | None = None,
+                       tx: dict | None = None) -> int:
+        """Append rows already in STORAGE representation (TEXT = dictionary
+        codes, decimals scaled, dates as days) — the UPDATE republish-free
+        path: the new row versions come straight off a raw-mode scan."""
+        schema = self.catalog.get(table)
+        for c in schema.columns:
+            v = (valids or {}).get(c.name)
+            if not c.nullable and v is not None and not np.all(v):
+                raise ValueError(
+                    f'null value in column "{c.name}" violates not-null '
+                    "constraint")
+        return self._append_encoded(table, schema, enc, dict(valids or {}),
+                                    raw_strs or {}, tx, {})
 
     def reconcile_widths(self) -> None:
         """Crash recovery for expansion: the manifest's per-table width is

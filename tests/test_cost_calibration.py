@@ -1,0 +1,101 @@
+"""Self-calibrating cost model — VERDICT r3 #10 (gpcheckperf +
+libgpdbcost calibration intent: gpMgmt/bin/gpcheckperf:1).
+
+`gg checkperf --device --apply` measures the planner's primitive costs on
+the live backend and persists <cluster>/calibration.json; connect() loads
+it, so on any TPU generation the constants track the hardware instead of
+round-2 folklore."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.planner import cost as C
+
+
+@pytest.fixture(autouse=True)
+def _reset_calibration():
+    yield
+    C.set_calibration(None)
+
+
+def test_set_calibration_roundtrip():
+    base = C.current_calibration()
+    assert base["ns_sort_row"] == 40.0
+    C.set_calibration({"ns_sort_row": 1.5, "ns_ici_byte": 0.5})
+    assert C.NS_SORT_ROW == 1.5
+    assert C.NS_ICI_BYTE == 0.5
+    assert C.NS_GATHER_ROW == 10.7        # unmentioned keys keep defaults
+    C.set_calibration({"ns_sort_row": -3, "ns_ici_byte": "junk"})
+    assert C.NS_SORT_ROW == 40.0          # invalid values fall back
+    C.set_calibration(None)
+    assert C.current_calibration() == base
+
+
+def test_connect_loads_cluster_calibration(devices8, tmp_path):
+    path = str(tmp_path / "c")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "calibration.json"), "w") as f:
+        json.dump({"ns_gather_row": 99.5}, f)
+    greengage_tpu.connect(path=path, numsegments=2)
+    assert C.NS_GATHER_ROW == 99.5
+
+
+def test_calibration_flips_broadcast_choice(devices8):
+    """The r2-measured asymmetry (replicated sort build ~250x its ICI
+    bytes) is exactly what makes a 4000-row build REDISTRIBUTE
+    (test_calibrated_costs golden). On hardware whose measured sort is
+    100x cheaper, the same query must flip to BROADCAST — calibration
+    changes plans, not just numbers."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    d = greengage_tpu.connect(numsegments=8)
+    rng = np.random.default_rng(3)
+    nf = 200_000
+    d.sql("create table fact (k int, fk int, v int) distributed by (k)")
+    d.load_table("fact", {"k": np.arange(nf),
+                          "fk": rng.integers(0, 4000, nf),
+                          "v": rng.integers(0, 1000, nf)})
+    d.sql("create table dim (pk int, m int, w int) distributed by (m)")
+    d.load_table("dim", {"pk": np.arange(4000), "m": np.arange(4000),
+                         "w": np.arange(4000)})
+    d.sql("analyze")
+    q = "select sum(f.v) from fact f, dim d where f.fk = d.pk"
+
+    def motion_above_dim(text):
+        lines = text.splitlines()
+        for i, ln in enumerate(lines):
+            if "Scan dim" in ln:
+                for j in range(i - 1, -1, -1):
+                    if "Motion" in lines[j] or "Join" in lines[j]:
+                        return lines[j]
+        return ""
+
+    planned, _, _ = d._plan(parse(q)[0])
+    assert "Motion Redistribute" in motion_above_dim(describe(planned))
+    C.set_calibration({"ns_sort_row": 0.4, "ns_scatter_row": 0.9})
+    d._select_cache.clear()
+    planned, _, _ = d._plan(parse(q)[0])
+    assert "Motion Broadcast" in motion_above_dim(describe(planned))
+
+
+def test_checkperf_device_writes_calibration(devices8, tmp_path):
+    from greengage_tpu.mgmt import cli
+
+    path = str(tmp_path / "c")
+    greengage_tpu.connect(path=path, numsegments=2).close()
+    rc = cli.main(["checkperf", "-d", path, "--size-mb", "8",
+                   "--device", "--apply"])
+    assert rc == 0
+    with open(os.path.join(path, "calibration.json")) as f:
+        cal = json.load(f)
+    for k in ("ns_gather_row", "ns_scatter_row", "ns_sort_row",
+              "ns_stream_byte", "ns_host_call", "ns_host_byte"):
+        assert cal[k] > 0, (k, cal)
+    # a fresh connect adopts the measured values
+    greengage_tpu.connect(path=path, numsegments=2)
+    assert C.NS_GATHER_ROW == pytest.approx(cal["ns_gather_row"])

@@ -57,6 +57,20 @@ out["cursor_rows"] = sum(
     len(db.sql(f"retrieve all from endpoint {k} of pc").rows())
     for k in range(db.numsegments))
 db.sql("close pc")
+# spill under multihost: a big load (shared storage; host-side, no
+# lockstep needed), then a grouped agg past a tight vmem limit — the SET
+# broadcasts so both processes take the same pass-partitioned branch
+import numpy as np
+db.sql("create table f2 (k bigint, g int, v int) distributed by (k)")
+n2 = 600_000
+db.load_table("f2", {"k": np.arange(n2), "g": (np.arange(n2) % 13),
+                     "v": (np.arange(n2) % 7)})
+db.sql("analyze f2")
+db.sql("set vmem_protect_limit_mb = 1")
+r = db.sql("select g, count(*), sum(v) from f2 group by g order by g")
+out["spilled"] = [[int(x) for x in row] for row in r.rows()]
+out["spill_passes"] = int(r.stats.get("spill_passes", 0))
+db.sql("set vmem_protect_limit_mb = 12288")
 mh.channel.close()
 print("RESULT:" + json.dumps(out), flush=True)
 """
@@ -119,3 +133,9 @@ def test_two_process_cluster(tmp_path):
     n_g12 = sum(1 for i in range(4000) if i % 13 == 12)
     assert out["after_delete"] == 4000 - n_g12
     assert out["cursor_rows"] == 10   # the rows updated to v=99 (k<10)
+    want_spill = {}
+    for i in range(600_000):
+        c, s = want_spill.get(i % 13, (0, 0))
+        want_spill[i % 13] = (c + 1, s + i % 7)
+    assert out["spilled"] == [[g, *want_spill[g]] for g in sorted(want_spill)]
+    assert out["spill_passes"] >= 2, out["spill_passes"]

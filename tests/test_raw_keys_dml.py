@@ -171,8 +171,15 @@ def test_raw_dml_rollback_keeps_cursor(db):
         db.sql("retrieve all from endpoint 0 of keepcur")
 
 
-def test_raw_dml_tombstones_cursor(db):
+def test_raw_dml_cursor_survives_bitmap_delete(db):
+    # bitmap DELETE (visimap) never GCs the old blobs: an open cursor
+    # keeps serving its snapshot — strictly better than the republish
+    # behavior it replaced
     db.sql("declare cur parallel retrieve cursor for select a, c from r")
     db.sql("delete from r where c = 'pear'")
+    db.sql("retrieve all from endpoint 0 of cur")
+    # a truncating DELETE still republishes (and GCs blobs) -> tombstone
+    db.sql("declare cur2 parallel retrieve cursor for select a, c from r")
+    db.sql("delete from r")
     with pytest.raises(ValueError, match="invalidated"):
-        db.sql("retrieve all from endpoint 0 of cur")
+        db.sql("retrieve all from endpoint 0 of cur2")
