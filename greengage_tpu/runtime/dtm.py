@@ -100,6 +100,7 @@ class Transaction:
             self.state = "aborted"
             raise
         self.state = "committed"
+        faults.check("dtx_after_commit")   # crash here -> commit survives
         for table, rels in self._gc:
             self.store.gc_files(table, rels)
 
