@@ -67,6 +67,7 @@ class Database:
             self.store.manifest.recover()   # in-doubt resolution on startup
             self.store.reconcile_widths()   # expansion crash recovery
         self.settings = Settings()
+        self._mh_degraded: str | None = None
         # measured cost-model primitives, if `gg checkperf --device
         # --apply` ran against this cluster (planner/cost.set_calibration;
         # workers load the same file, keeping plan choices in lockstep)
@@ -229,11 +230,139 @@ class Database:
             return True   # the DECLARE runs the mesh program
         return isinstance(stmt, A.UpdateStmt)
 
+    def plan_hash(self, text_or_stmt) -> str | None:
+        """Deterministic digest of the plan a SELECT-shaped statement
+        produces here (structure + column ids + loci + row estimates):
+        the coordinator attaches it to every mesh broadcast and workers
+        verify theirs matches BEFORE entering the collectives — the
+        lockstep assertion VERDICT r3 #8 asked for. None when the
+        statement has no single pre-plannable query."""
+        import hashlib
+
+        from greengage_tpu.planner.logical import describe
+
+        stmt = (parse(text_or_stmt)[0] if isinstance(text_or_stmt, str)
+                else text_or_stmt)
+        if isinstance(stmt, A.DeclareCursorStmt):
+            stmt = stmt.query
+        if not isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
+            return None
+        if isinstance(stmt, A.SelectStmt) and not stmt.from_:
+            return None
+        # planning errors propagate: on the coordinator they fail the
+        # statement BEFORE the broadcast; on a worker they fail the
+        # readiness ack — swallowing them here would let a worker that
+        # cannot re-plan enter (and hang) the collectives
+        planned, _, _, _ = self._cached_plan(stmt)
+        return hashlib.sha1(describe(planned).encode()).hexdigest()[:16]
+
+    def _mh_degrade(self, reason: str) -> None:
+        """A worker died: the global device mesh can no longer rendezvous.
+        Mark the cluster degraded — every later mesh statement re-forms as
+        a single-process session over the SHARED cluster directory (which
+        holds every segment's storage) in a subprocess, the
+        mirror-failover analog for a lost compute host."""
+        self._mh_degraded = reason
+        self.log.error("multihost", f"worker lost; degraded to local: {reason}")
+        try:
+            self.multihost.channel.close()
+        except Exception:
+            pass
+        # detach the distributed runtime WITHOUT the shutdown barrier: it
+        # can never complete against a dead peer — calling shutdown()
+        # blocks for the barrier timeout, and leaving it for atexit turns
+        # a served degradation into a crash at interpreter exit. Dropping
+        # the handles makes both a no-op; the stashed references keep the
+        # C++ objects from running disconnect destructors mid-session.
+        try:
+            from jax._src import distributed as _dist
+
+            self._mh_detached = (_dist.global_state.client,
+                                 _dist.global_state.service)
+            _dist.global_state.client = None
+            _dist.global_state.service = None
+        except Exception:
+            pass
+
+    def _degraded_sql(self, text: str):
+        """Serve one statement from a fresh single-process subprocess over
+        the shared directory (all segments local). Transactions cannot
+        span subprocesses; everything else completes with full results."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+
+        if self.dtm.current is not None and self.dtm.current.state == "active":
+            raise SqlError("cluster is degraded (worker died); transactions "
+                           "cannot continue — ROLLBACK and retry")
+        if any(isinstance(st, A.DeclareCursorStmt) for st in parse(text)):
+            # a cursor declared in the throwaway subprocess would vanish
+            # before RETRIEVE: refuse instead of reporting false success
+            raise SqlError("parallel retrieve cursors are unavailable while "
+                           "the cluster is degraded")
+        child = (
+            "import os, sys, json\n"
+            "os.environ['GGTPU_PLATFORM'] = 'cpu'\n"
+            "flags = [f for f in os.environ.get('XLA_FLAGS', '').split()\n"
+            "         if 'host_platform_device_count' not in f]\n"
+            "flags.append('--xla_force_host_platform_device_count=%d')\n"
+            "os.environ['XLA_FLAGS'] = ' '.join(flags)\n"
+            "sys.path.insert(0, %r)\n"
+            "import greengage_tpu\n"
+            "db = greengage_tpu.connect(%r, numsegments=%d)\n"
+            "r = db.sql(sys.stdin.read())\n"
+            "def enc(x):\n"
+            "    try:\n"
+            "        import numpy as np\n"
+            "        if isinstance(x, np.generic): x = x.item()\n"
+            "    except Exception: pass\n"
+            "    return x if isinstance(x, (int, float, str, bool,\n"
+            "                               type(None))) else str(x)\n"
+            "if isinstance(r, str):\n"
+            "    print('DEGRADED:' + json.dumps({'status': r}), flush=True)\n"
+            "else:\n"
+            "    print('DEGRADED:' + json.dumps(\n"
+            "        {'columns': list(r.columns),\n"
+            "         'rows': [[enc(x) for x in row] for row in r.rows()]}),\n"
+            "        flush=True)\n"
+        ) % (self.numsegments,
+             os.path.dirname(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))),
+             self.path, self.numsegments)
+        proc = subprocess.run(
+            [_sys.executable, "-c", child], input=text, text=True,
+            capture_output=True, timeout=900)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("DEGRADED:")]
+        if proc.returncode != 0 or not lines:
+            raise QueryError(
+                f"degraded execution failed (rc={proc.returncode}): "
+                f"{proc.stderr[-800:]}")
+        payload = _json.loads(lines[-1][len("DEGRADED:"):])
+        if "status" in payload:
+            return payload["status"]
+        return _DegradedResult(payload["columns"], payload["rows"])
+
     def _coordinator_sql(self, text: str):
         """Host-only statements run locally (workers pick the effects up
         from the shared directory at their next refresh). Mesh statements
-        broadcast first, then execute here CONCURRENTLY with the workers
-        (the collectives rendezvous); worker acks gate the next statement."""
+        run a TWO-PHASE dispatch: broadcast with the coordinator's plan
+        hash, collect readiness acks (workers verified the hash and are
+        parked before the collectives), then 'go' and execute here
+        CONCURRENTLY with the workers. A dead worker surfaces on the
+        channel during the readiness round — BEFORE anyone enters a
+        collective that could never rendezvous — and the statement
+        retries on the degraded local path."""
+        from greengage_tpu.parallel.multihost import WorkerDied
+
+        if getattr(self, "_mh_degraded", None):
+            stmts = parse(text)
+            if any(self._needs_mesh(st) for st in stmts):
+                return self._degraded_sql(text)
+            out = None
+            for stmt in stmts:
+                out = self._execute(stmt)
+            return out
         stmts = parse(text)
         mesh_stmts = [st for st in stmts if self._needs_mesh(st)]
         if mesh_stmts and len(stmts) > 1:
@@ -253,11 +382,32 @@ class Database:
                     self._validate_declare(stmt)
                 with self._admission():
                     ch = self.multihost.channel
-                    ch.send({"op": "sql", "sql": text})
+                    try:
+                        ch.broadcast({"op": "sql", "sql": text,
+                                      "plan_hash": self.plan_hash(stmt)})
+                    except WorkerDied as e:
+                        self._mh_degrade(str(e))
+                        return self._degraded_sql(text)
+                    except RuntimeError as e:
+                        # a worker REFUSED (plan-hash mismatch or its
+                        # planning failed): nobody entered the mesh —
+                        # release the parked survivors and fail cleanly
+                        ch.post({"op": "skip"})
+                        raise QueryError(str(e))
+                    try:
+                        ch.send({"op": "go"})
+                    except WorkerDied as e:
+                        # death between readiness and go: nobody is in a
+                        # collective yet on OUR side; degrade and retry
+                        self._mh_degrade(str(e))
+                        return self._degraded_sql(text)
                     try:
                         out = self._execute(stmt)
                     finally:
-                        ch.collect_acks()
+                        try:
+                            ch.collect_acks()
+                        except WorkerDied as e:
+                            self._mh_degrade(str(e))
             else:
                 if isinstance(stmt, A.SetStmt):
                     # settings steer MESH decisions (spill passes, retry
@@ -2029,6 +2179,22 @@ class Database:
 
     def close(self):
         pass
+
+
+class _DegradedResult:
+    """Result façade for statements served by the degraded-mode
+    subprocess (worker death): rows come back JSON-decoded."""
+
+    def __init__(self, columns, rows):
+        self.columns = list(columns)
+        self._rows = [tuple(r) for r in rows]
+        self.stats = {"degraded": True}
+
+    def rows(self):
+        return self._rows
+
+    def __len__(self):
+        return len(self._rows)
 
 
 class _NullSlot:

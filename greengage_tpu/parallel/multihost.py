@@ -76,6 +76,11 @@ def local_segment_positions() -> tuple:
 # control channel: line-JSON over TCP
 # ---------------------------------------------------------------------------
 
+class WorkerDied(ConnectionError):
+    """A worker's control connection is gone (process death / network
+    partition): the statement channel cannot reach the full gang."""
+
+
 class CoordinatorChannel:
     """Accepts every worker once, then broadcasts statements and collects
     acks (the CdbDispatchCommand/checkDispatchResult roles)."""
@@ -98,16 +103,29 @@ class CoordinatorChannel:
             for w in self._workers:
                 w.write(line)
                 w.flush()
+        except OSError as e:
+            self._lock.release()
+            raise WorkerDied(f"worker connection lost on send: {e}")
         except BaseException:
             self._lock.release()
             raise
+
+    def post(self, msg: dict) -> None:
+        """Send a message that expects NO ack (go/skip control frames)."""
+        self.send(msg)
+        self._lock.release()
 
     def collect_acks(self) -> list[dict]:
         try:
             acks = []
             for w in self._workers:
-                resp = json.loads(w.readline())
-                acks.append(resp)
+                line = w.readline()
+                if not line:
+                    raise WorkerDied("worker connection closed (EOF) — "
+                                     "the process died mid-statement")
+                acks.append(json.loads(line))
+        except (OSError, ValueError) as e:
+            raise WorkerDied(f"worker connection lost: {e}")
         finally:
             self._lock.release()
         errs = [a for a in acks if not a.get("ok")]
@@ -168,20 +186,58 @@ class WorkerChannel:
 def worker_loop(db) -> None:
     """Follow the coordinator: execute each statement's DEVICE work in
     lockstep (the exec_mpp_query role, postgres.c:1057). Writes are the
-    coordinator's job; the shared-directory refresh picks them up."""
+    coordinator's job; the shared-directory refresh picks them up.
+
+    Mesh statements arrive as a TWO-PHASE exchange: the worker first
+    refreshes, re-plans, and acks readiness — verifying the coordinator's
+    plan hash when one is attached, so a nondeterminism bug fails the
+    statement on the channel instead of desyncing the collectives — and
+    only enters the mesh program after an explicit 'go'. The readiness
+    ack doubles as the liveness probe that keeps a dead worker from
+    hanging the coordinator inside a collective."""
     ch = db.multihost.channel
     while True:
         msg = ch.recv()
         if msg.get("op") == "stop":
             break
-        try:
-            if msg.get("op") == "sql":
-                db.refresh()
-                db.worker_sql(msg["sql"])
-            elif msg.get("op") == "set":
+        if msg.get("op") == "set":
+            try:
                 # mesh-steering settings stay in lockstep (spill passes,
                 # retry tiers) — applied singly, never as batch re-parse
                 db.settings.set(msg["name"], msg["value"])
+                ch.ack(True)
+            except Exception as e:
+                ch.ack(False, f"{type(e).__name__}: {e}")
+            continue
+        if msg.get("op") != "sql":
+            continue
+        # phase 1: refresh + plan + verify, ack readiness
+        try:
+            db.refresh()
+            want = msg.get("plan_hash")
+            if want:
+                # plan_hash raises if this worker cannot re-plan — that
+                # too must fail the readiness ack, not surface later
+                # inside a half-entered collective
+                got = db.plan_hash(msg["sql"])
+                if got != want:
+                    raise RuntimeError(
+                        f"plan-hash mismatch: coordinator {want} vs "
+                        f"worker {got} — nondeterministic planning would "
+                        "desync the mesh collectives")
+            ch.ack(True)
+        except Exception as e:
+            ch.ack(False, f"{type(e).__name__}: {e}")
+            continue
+        nxt = ch.recv()
+        if nxt.get("op") == "stop":
+            break
+        if nxt.get("op") != "go":
+            continue               # coordinator skipped the statement
+        # phase 2: the mesh program (collectives rendezvous with the
+        # coordinator's concurrent execution)
+        try:
+            db.worker_sql(msg["sql"])
             ch.ack(True)
         except Exception as e:
             ch.ack(False, f"{type(e).__name__}: {e}")

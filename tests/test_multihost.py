@@ -139,3 +139,114 @@ def test_two_process_cluster(tmp_path):
         want_spill[i % 13] = (c + 1, s + i % 7)
     assert out["spilled"] == [[g, *want_spill[g]] for g in sorted(want_spill)]
     assert out["spill_passes"] >= 2, out["spill_passes"]
+
+
+# ---------------------------------------------------------------------------
+# worker death: detection on the readiness round + degraded local service
+# ---------------------------------------------------------------------------
+
+COORD_DEATH_SCRIPT = r"""
+import json, os, sys, time
+port, cport, path, mark = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.environ["GGTPU_REPO"])
+from greengage_tpu.parallel.multihost import init_multihost
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport)
+import greengage_tpu
+db = greengage_tpu.connect(path, multihost=mh)
+out = {}
+db.sql("create table f (k bigint, v int) distributed by (k)")
+db.sql("insert into f values " + ",".join(f"({i}, {i % 7})" for i in range(2000)))
+db.sql("analyze")
+r = db.sql("select count(*), sum(v) from f")
+out["pre"] = [int(x) for x in r.rows()[0]]
+open(mark + ".phase1", "w").close()
+while not os.path.exists(mark + ".killed"):
+    time.sleep(0.05)
+# the worker is gone: the readiness round must detect it BEFORE any
+# collective, and the statement must still COMPLETE via the degraded
+# single-process re-formation over the shared directory
+r = db.sql("select count(*), sum(v) from f")
+out["post"] = [int(x) for x in r.rows()[0]]
+out["degraded"] = bool(db._mh_degraded)
+r = db.sql("select count(*) from f where k < 10")
+out["post2"] = int(r.rows()[0][0])
+out["status_after"] = db.sql("delete from f where k < 100")
+r = db.sql("select count(*) from f")
+out["post3"] = int(r.rows()[0][0])
+print("RESULT:" + json.dumps(out), flush=True)
+# the degraded runtime's grpc teardown may error at interpreter exit
+# (the dead peer can never complete its streams); results are already
+# flushed, so exit without running teardown hooks
+os._exit(0)
+"""
+
+
+def test_worker_death_detected_and_degraded_service(tmp_path):
+    port, cport = _free_port(), _free_port()
+    path = str(tmp_path / "cluster")
+    mark = str(tmp_path / "mark")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    })
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
+         "-d", path, "--coordinator", f"127.0.0.1:{port}",
+         "--control-port", str(cport), "--num-processes", "2",
+         "--process-id", "1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    coord = subprocess.Popen(
+        [sys.executable, "-c", COORD_DEATH_SCRIPT, str(port), str(cport),
+         path, mark],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    import signal
+    import time as _t
+    try:
+        deadline = _t.monotonic() + 300
+        while not os.path.exists(mark + ".phase1"):
+            assert _t.monotonic() < deadline, "coordinator never reached phase1"
+            assert coord.poll() is None, coord.stdout.read()
+            _t.sleep(0.05)
+        os.kill(worker.pid, signal.SIGKILL)
+        worker.wait(timeout=30)
+        open(mark + ".killed", "w").close()
+        cout, _ = coord.communicate(timeout=480)
+    except subprocess.TimeoutExpired:
+        coord.kill()
+        raise AssertionError(
+            f"coordinator hung after worker death:\n{coord.stdout.read()}")
+    assert coord.returncode == 0, cout
+    res = [ln for ln in cout.splitlines() if ln.startswith("RESULT:")]
+    assert res, cout
+    out = json.loads(res[0][len("RESULT:"):])
+    want_sum = sum(i % 7 for i in range(2000))
+    assert out["pre"] == [2000, want_sum]
+    assert out["post"] == [2000, want_sum]     # completed AFTER the death
+    assert out["degraded"] is True
+    assert out["post2"] == 10
+    assert out["status_after"] == "DELETE 100"  # degraded DML works too
+    assert out["post3"] == 1900
+
+
+def test_plan_hash_deterministic_across_sessions(devices8, tmp_path):
+    import numpy as np
+
+    import greengage_tpu
+    path = str(tmp_path / "c")
+    d1 = greengage_tpu.connect(path=path, numsegments=4)
+    d1.sql("create table t (k int, g int, v int) distributed by (k)")
+    d1.load_table("t", {"k": np.arange(1000), "g": np.arange(1000) % 7,
+                        "v": np.arange(1000)})
+    d1.sql("analyze")
+    q = "select g, sum(v) from t group by g order by g"
+    h1 = d1.plan_hash(q)
+    d2 = greengage_tpu.connect(path=path, numsegments=4)
+    h2 = d2.plan_hash(q)
+    assert h1 is not None and h1 == h2
+    assert d1.plan_hash("select 1") is None          # no FROM: host-side
