@@ -186,7 +186,8 @@ class Compiler:
             # no raw-text surrogates (their row numbering must stay whole)
             prune = self.scan_prune.get(t) or None
             if prune and (self.scan_count.get(t, 0) != 1 or any(
-                    c.startswith(("@hp:", "@rc:")) for c in cols)):
+                    c.startswith(("@hp:", "@rc:", "@rp:", "@rl:"))
+                    for c in cols)):
                 prune = None
             if prune:
                 schema_t = self.catalog.get(t)

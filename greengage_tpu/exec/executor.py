@@ -440,6 +440,10 @@ class Executor:
                         dt = np.dtype(bool)   # host-evaluated predicate col
                     elif c.startswith("@rc:"):
                         dt = np.dtype(np.int32)   # transient raw-dict codes
+                    elif c.startswith("@rp:"):
+                        dt = np.dtype(np.int64)   # packed raw prefix word
+                    elif c.startswith("@rl:"):
+                        dt = np.dtype(np.int32)   # raw byte length
                     else:
                         col_s = schema.column(c)
                         # raw TEXT stages int64 row surrogates, not the

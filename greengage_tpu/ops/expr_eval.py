@@ -164,6 +164,10 @@ class Evaluator:
             zero = ra == 0
             valid = zero_invalid(valid, zero)
             return res, valid
+        if e.op == "&":
+            # bitwise AND over integer lanes (device raw-TEXT prefix
+            # compares mask the straddling packed word)
+            return la & ra, valid
         if e.op == "%":
             safe = jnp.where(ra == 0, 1, ra)
             res = la - (jnp.abs(la) // jnp.abs(safe)) * jnp.sign(la) * jnp.abs(safe)

@@ -1362,12 +1362,7 @@ class Binder:
         scan = self._scan_for[base.name]
         vname = "@rc:" + rr[1]
         ref = self.store.raw_dictionary(rr[0], rr[1])
-        ci = next((c for c in scan.cols if c.name == vname), None)
-        if ci is None:
-            ci = ColInfo(self.new_id("rc"), T.TEXT, vname, dict_ref=ref)
-            scan.cols.append(ci)
-            self._scan_for[ci.id] = scan
-        coded: E.Expr = _colref(ci)
+        coded: E.Expr = self._raw_aux_col(scan, vname, T.TEXT, dict_ref=ref)
         for step in (_raw_chain_of(e) or ()):
             from greengage_tpu.utils import strfuncs
 
@@ -1540,6 +1535,11 @@ class Binder:
                     e = self._host_pred(arg, {
                         "op": "chain", "chain": [list(s) for s in arg.chain],
                         "cmp": "in", "value": vals})
+                elif vals and all(self._device_raw_eq_ok(arg, v)
+                                  for v in vals):
+                    devs = [self._device_raw_pred(arg, "eq", v) for v in vals]
+                    e = (devs[0] if len(devs) == 1
+                         else E.BoolOp("or", tuple(devs)))
                 else:
                     e = self._host_pred(arg, {"op": "in", "values": vals})
                 return E.Not(e) if ast.negate else e
@@ -1566,7 +1566,18 @@ class Binder:
                     "cmp": "like", "value": ast.pattern})
                 return E.Not(e) if ast.negate else e
             if _raw_ref_of(arg) is not None:
-                e = self._host_pred(arg, {"op": "like", "pattern": ast.pattern})
+                p = ast.pattern
+                e = None
+                if (p.endswith("%") and "%" not in p[:-1] and "_" not in p
+                        and "\\" not in p):
+                    # pure prefix pattern: device integer compares
+                    e = self._device_raw_pred(arg, "prefix", p[:-1])
+                elif "%" not in p and "_" not in p and "\\" not in p:
+                    # no wildcards at all: LIKE == equality
+                    e = self._device_raw_pred(arg, "eq", p)
+                if e is None:
+                    e = self._host_pred(arg,
+                                        {"op": "like", "pattern": ast.pattern})
                 return E.Not(e) if ast.negate else e
             d = _dict_ref_of(arg)
             if d is None:
@@ -1779,6 +1790,102 @@ class Binder:
         raise SqlError(f"function {fname} expects {want}, got {a.type}")
 
     # ---- raw-text host predicates --------------------------------------
+    def _raw_aux_col(self, scan, name: str, sqltype, dict_ref=None) -> E.Expr:
+        """Reuse-or-append a virtual staged column on a scan (the shared
+        mechanics of host predicates, device raw-prefix columns, and
+        transient raw-dictionary codes)."""
+        for c in scan.cols:
+            if c.name == name:
+                return _colref(c)
+        ci = ColInfo(self.new_id("rp"), sqltype, name, dict_ref=dict_ref)
+        scan.cols.append(ci)
+        self._scan_for[ci.id] = scan
+        return _colref(ci)
+
+    def _device_raw_eq_ok(self, arg: E.Expr, value) -> bool:
+        """Pure feasibility check for _device_raw_pred's eq lowering —
+        callers with SEVERAL values (IN lists) must check them ALL before
+        staging any aux column, or a partially-lowerable list leaves
+        orphan prefix columns that disable zone-map pruning for nothing."""
+        if isinstance(arg, E.RawChain) or not isinstance(arg, E.ColRef):
+            return False
+        if value is None or not isinstance(value, str):
+            return False
+        if _raw_ref_of(arg) is None or arg.name not in self._scan_for:
+            return False
+        from greengage_tpu.storage.table_store import RAW_PREFIX_BYTES
+
+        return len(value.encode("utf-8")) <= RAW_PREFIX_BYTES
+
+    def _device_raw_pred(self, arg: E.Expr, kind: str, value) -> E.Expr | None:
+        """DEVICE lowering for raw-TEXT predicates (VERDICT r3 #7): the
+        scan stages the column's packed 32-byte prefix (int64 lanes) and
+        exact length, and equality / LIKE-'prefix%' compile to integer
+        compares — one vectorized pass on the mesh instead of O(heap)
+        host python per statement. None -> caller falls back to the host
+        path (chains, long literals, general patterns).
+
+        Soundness: utf-8 packing is big-endian per word with zero padding,
+        so equal strings <=> equal (length, words); a literal longer than
+        the prefix cap can never fully compare on device. LIKE prefixes
+        mask the straddling word. Reference role: the varlena texteq /
+        text_like fast paths (varlena.c), vectorized."""
+        if isinstance(arg, E.RawChain) or not isinstance(arg, E.ColRef):
+            return None
+        if value is None or not isinstance(value, str):
+            return None
+        rr = _raw_ref_of(arg)
+        if rr is None or arg.name not in self._scan_for:
+            return None
+        from greengage_tpu.storage.table_store import (RAW_PREFIX_BYTES,
+                                                       RAW_PREFIX_WORDS)
+
+        bts = value.encode("utf-8")
+        if len(bts) > RAW_PREFIX_BYTES:
+            return None
+        scan = self._scan_for[arg.name]
+        col = rr[1]
+        rl = self._raw_aux_col(scan, f"@rl:{col}", T.INT32)
+
+        def word_lit(chunk: bytes) -> int:
+            return int.from_bytes(chunk.ljust(8, b"\0"), "big", signed=True)
+
+        conj: list = []
+        if kind == "eq":
+            conj.append(E.Cmp("=", rl, E.Literal(len(bts), T.INT32), T.BOOL))
+            # rows passing the exact-length check have zero padding beyond
+            # their bytes, identical to the literal's padding — compare
+            # every word the literal touches (others are zero on both
+            # sides only up to the row's length... which equals the
+            # literal's, so untouched words are zero for both)
+            for w in range(RAW_PREFIX_WORDS):
+                lit = word_lit(bts[w * 8:(w + 1) * 8])
+                if w * 8 >= len(bts) and lit == 0:
+                    break   # all remaining words are zero on both sides
+                wcol = self._raw_aux_col(scan, f"@rp:{col}:{w}", T.INT64)
+                conj.append(E.Cmp("=", wcol, E.Literal(lit, T.INT64), T.BOOL))
+        elif kind == "prefix":
+            conj.append(E.Cmp(">=", rl, E.Literal(len(bts), T.INT32), T.BOOL))
+            full, rem = divmod(len(bts), 8)
+            for w in range(full):
+                wcol = self._raw_aux_col(scan, f"@rp:{col}:{w}", T.INT64)
+                conj.append(E.Cmp(
+                    "=", wcol, E.Literal(word_lit(bts[w * 8:(w + 1) * 8]),
+                                         T.INT64), T.BOOL))
+            if rem:
+                mask = int.from_bytes(
+                    (b"\xff" * rem).ljust(8, b"\0"), "big", signed=True)
+                wcol = self._raw_aux_col(scan, f"@rp:{col}:{full}", T.INT64)
+                masked = E.BinOp("&", wcol, E.Literal(mask, T.INT64), T.INT64)
+                conj.append(E.Cmp(
+                    "=", masked, E.Literal(word_lit(bts[full * 8:]),
+                                           T.INT64), T.BOOL))
+            if not conj:
+                return None
+        else:
+            return None
+        return conj[0] if len(conj) == 1 else E.BoolOp("and", tuple(conj))
+
     def _host_pred(self, arg: E.Expr, payload: dict) -> E.Expr:
         """Lower a predicate over a raw TEXT column into a host-evaluated
         boolean staged with the scan (the dictionary-LUT strategy at
@@ -1791,13 +1898,7 @@ class Binder:
                 "on base-table columns")
         scan = self._scan_for[base.name]
         name = self.store.host_pred_name(rr[1], payload)
-        for c in scan.cols:   # reuse an identical predicate column
-            if c.name == name:
-                return _colref(c)
-        ci = ColInfo(self.new_id("hp"), T.BOOL, name)
-        scan.cols.append(ci)
-        self._scan_for[ci.id] = scan
-        return _colref(ci)
+        return self._raw_aux_col(scan, name, T.BOOL)
 
     # ---- comparisons with literal coercion ----------------------------
     def _bind_cmp(self, ast: A.Bin, scope) -> E.Expr:
@@ -1846,7 +1947,9 @@ class Binder:
                 raise SqlError(
                     "raw-encoded text supports only =/<> against string "
                     "literals, LIKE, and IN")
-            e = self._host_pred(a, {"op": "eq", "value": b.value})
+            e = self._device_raw_pred(a, "eq", b.value)
+            if e is None:
+                e = self._host_pred(a, {"op": "eq", "value": b.value})
             return E.Not(e) if ast.op == "<>" else e
         le, re_ = self._coerce_pair(le, re_)
         return E.Cmp(ast.op, le, re_)
