@@ -169,6 +169,35 @@ class TableSchema:
             out = np.where(out == -1, default_i, out)
         return out
 
+    def partitions_for_values(self, values) -> list[int]:
+        """Runtime partition selection from an explicit key-value set —
+        the EXECUTION-time half of the PartitionSelector role
+        (src/backend/executor/nodePartitionSelector.c): indices of
+        partitions that can hold ANY of ``values`` (storage
+        representation). Default partitions always survive."""
+        import numpy as np
+
+        kind, _col = self.partition_by
+        v = np.asarray(list(values) if not hasattr(values, "dtype")
+                       else values)
+        keep = []
+        for i, p in enumerate(self.partitions):
+            if p.default:
+                keep.append(i)
+                continue
+            if kind == "range":
+                m = np.ones(len(v), bool)
+                if p.lo is not None:
+                    m &= v >= p.lo
+                if p.hi is not None:
+                    m &= v < p.hi
+                if m.any():
+                    keep.append(i)
+            else:
+                if np.isin(v, np.asarray(list(p.values))).any():
+                    keep.append(i)
+        return keep
+
     def prune_partitions(self, conjuncts: list[tuple]) -> list[int]:
         """Static partition pruning: indices of partitions that can hold
         rows satisfying the pushed conjuncts [(col, op, value)] — the

@@ -123,6 +123,7 @@ class Compiler:
         self.scan_count: dict[str, int] = {}
         self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
         self.scan_parts: dict[str, tuple | None] = {}  # table -> child tables
+        self.scan_dyn: dict[str, tuple | None] = {}   # table -> dyn prune src
         self.instrument = instrument      # EXPLAIN ANALYZE per-node rows
         self.node_rows: dict[str, int] = {}   # metric name -> plan node id
         # multi-host: outputs/flags/metrics are device-reduced + replicated
@@ -194,9 +195,12 @@ class Compiler:
                 if any(col.type.kind == T.Kind.TEXT and col.encoding == "raw"
                        for col in schema_t.columns if col.name in self.scan_cols[t]):
                     prune = None
+            dyn = self.scan_dyn.get(t)
+            if not isinstance(dyn, tuple):
+                dyn = None
             input_spec.append((t, cols, self.scan_caps[t],
                                self.scan_direct.get(t), prune,
-                               self.scan_parts.get(t)))
+                               self.scan_parts.get(t), dyn))
 
         compiled = self._compile_node(below)   # closure: ctx -> Batch
         out_cols = below.out_cols()
@@ -240,7 +244,7 @@ class Compiler:
 
             ctx = {"tables": {}, "flags": []}
             i = 0
-            for tname, cols, cap, _direct, _prune, _parts in input_spec:
+            for tname, cols, cap, _direct, _prune, _parts, _dyn in input_spec:
                 entry = {}
                 for c in cols:
                     entry[c] = flat[i]
@@ -418,6 +422,12 @@ class Compiler:
                 self.scan_parts[plan.table] = merged
             else:
                 self.scan_parts.setdefault(plan.table, None)
+            # join-driven runtime pruning annotation; two scans with
+            # different sources cannot share one prune — disable
+            dyn = getattr(plan, "dyn_prune", None)
+            prev_dyn = self.scan_dyn.get(plan.table, "unset")
+            self.scan_dyn[plan.table] = (dyn if prev_dyn in ("unset", dyn)
+                                         else None)
         for c in plan.children:
             self._collect_scans(c)
 

@@ -303,9 +303,12 @@ class Executor:
                     "scan_tables": [t for t, *_ in comp.input_spec],
                     "direct_dispatch": {t: d for t, _, _, d, *_ in comp.input_spec
                                         if d is not None},
-                    "partitions": {t: len(p) for t, _, _, _, _, p
+                    "partitions": {t: len(p) for t, _, _, _, _, p, _
                                    in comp.input_spec if p is not None},
                     "zone_prune": dict(getattr(self, "_last_prune_stats", {})),
+                    # runtime PartitionSelector results: child partitions
+                    # kept / total after the build-side key-value probe
+                    "dynamic_prune": dict(getattr(self, "_last_dyn_stats", {})),
                     "below_gather_capacity": comp.capacity,
                     "rows_out": len(res),
                     # per-node row counters SUM across segments; capacity
@@ -384,12 +387,21 @@ class Executor:
         for k in [k for k in self._stage_cache if k[3] != version]:
             del self._stage_cache[k]
         self._last_prune_stats = {}
+        self._last_dyn_stats = {}
         aux = getattr(self, "_aux_tables", {})
         ranges = getattr(self, "_row_ranges", {})
-        for table, cols, cap, direct, prune, child_parts in comp.input_spec:
+        for table, cols, cap, direct, prune, child_parts, dyn in comp.input_spec:
             if table in aux:
                 arrays.extend(self._stage_aux(table, cols, cap, aux[table], shard))
                 continue
+            if child_parts is not None and dyn is not None:
+                # join-driven runtime partition elimination: evaluate the
+                # build side's pushed filter on the host, keep only the
+                # child partitions a surviving key value can land in
+                # (deterministic per manifest version — multihost
+                # processes compute the same set from shared storage)
+                child_parts = self._dyn_pruned_parts(
+                    table, child_parts, dyn, snapshot)
             key = (table, tuple(cols), cap, version, direct, prune,
                    child_parts, ranges.get(table))
             if table not in ranges and key in self._stage_cache:
@@ -464,6 +476,59 @@ class Executor:
                     staged, self._last_prune_stats.get(table))
             arrays.extend(staged)
         return arrays
+
+    def _dyn_pruned_parts(self, table, child_parts, dyn, snapshot) -> tuple:
+        """-> child partitions surviving the build-side key-value probe
+        (the execution-time PartitionSelector, nodePartitionSelector.c).
+        Manifest-version cached; falls back to the full set on any
+        irregularity (a missed prune is only a perf loss)."""
+        version = snapshot.get("version", 0)
+        ck = (table, child_parts, dyn, version)
+        cache = getattr(self, "_dyn_prune_cache", None)
+        if cache is None:
+            cache = self._dyn_prune_cache = {}
+        hit = cache.get(ck)
+        if hit is not None:
+            self._last_dyn_stats[table] = (len(hit), len(child_parts))
+            return hit
+        dim_table, preds, key_col = dyn
+        try:
+            schema = self.catalog.get(table)
+            dim_schema = self.catalog.get(dim_table)
+            need = {key_col} | {c for c, _, _ in preds}
+            from greengage_tpu.catalog.schema import PolicyKind
+
+            segs = ([0] if dim_schema.policy.kind is PolicyKind.REPLICATED
+                    else range(dim_schema.policy.numsegments))
+            vals_parts = []
+            for seg in segs:
+                c, v, n = self.store.read_segment(
+                    dim_table, seg, sorted(need), snapshot)
+                m = np.ones(n, dtype=bool)
+                for col, op, val in preds:
+                    arr = c[col]
+                    cv = v.get(col)
+                    if cv is not None:
+                        m &= np.asarray(cv, bool)
+                    m &= {"=": arr == val, "<": arr < val, "<=": arr <= val,
+                          ">": arr > val, ">=": arr >= val}[op]
+                kv = v.get(key_col)
+                if kv is not None:
+                    m &= np.asarray(kv, bool)   # NULL keys never join
+                vals_parts.append(c[key_col][m])
+            values = np.unique(np.concatenate(vals_parts)) if vals_parts \
+                else np.empty(0)
+            keep_idx = set(schema.partitions_for_values(values))
+            name_keep = {schema.partitions[i].storage_name(table)
+                         for i in keep_idx}
+            kept = tuple(p for p in child_parts if p in name_keep)
+        except Exception:
+            return child_parts   # never fail the query for a prune
+        self._last_dyn_stats[table] = (len(kept), len(child_parts))
+        if len(cache) > 64:
+            cache.pop(next(iter(cache)))
+        cache[ck] = kept
+        return kept
 
     def _read_segment_parts(self, table, child_parts, seg, storage_cols,
                             snapshot, prune):

@@ -71,6 +71,14 @@ class Scan(Plan):
     # parts_total remembers the pre-pruning count for EXPLAIN.
     parts: tuple | None = None
     parts_total: int = 0
+    # secondary indexes whose column appears in the pushed conjuncts:
+    # staging probes their block sidecars (EXPLAIN-visible access path)
+    index_hits: tuple = ()
+    # join-driven runtime partition elimination (PartitionSelector role):
+    # (build table, build pushable preds, build join-key storage col) —
+    # staging evaluates the build filter host-side and skips child
+    # partitions no surviving key value can land in
+    dyn_prune: tuple | None = None
 
     def out_cols(self):
         return self.cols
@@ -223,6 +231,8 @@ def describe(plan: Plan, indent: int = 0, annot: dict | None = None) -> str:
             extra += f" (partitions: {len(plan.parts)}/{total})"
         if plan.direct_seg is not None:
             extra += f" (direct dispatch: seg {plan.direct_seg})"
+        if plan.index_hits:
+            extra += f" (index: {', '.join(plan.index_hits)})"
     elif isinstance(plan, Join):
         extra = f" {plan.kind}"
     elif isinstance(plan, Motion):

@@ -135,6 +135,12 @@ class Planner:
                 prune.append((by_id[lhs.name], op, int(rhs.value)))
         if prune:
             child.prune_preds = tuple(prune)
+            # access-path visibility: the staged read probes these
+            # indexes' block sidecars (equality AND range ops)
+            pruned_cols = {c for c, _, _ in prune}
+            child.index_hits = tuple(sorted(
+                name for name, d in getattr(schema, "indexes", {}).items()
+                if d.get("column") in pruned_cols))
         if child.parts is not None and schema.is_partitioned:
             # static partition pruning from the same pushed conjuncts
             # (plan-time half of nodePartitionSelector.c)
@@ -345,7 +351,52 @@ class Planner:
         # bounds simply never match, so only the BUILD side's stats matter
         node.key_bounds = self._key_bounds(node.right, node.right_keys)
         self._maybe_direct_join(node)
+        self._maybe_dynamic_partition_prune(node)
         return node
+
+    def _maybe_dynamic_partition_prune(self, node: Join) -> None:
+        """Join-driven runtime partition elimination (the
+        PartitionSelector role, src/backend/executor/
+        nodePartitionSelector.c:1): when a partitioned probe joins a
+        small build table ON ITS PARTITION KEY, annotate the probe scan
+        so STAGING first evaluates the build's (pushable) filter on the
+        host, collects the surviving key values, and skips whole child
+        partitions no value can land in — partitions the static pruner
+        could never eliminate because the selecting predicate lives on
+        the other table. Inner/semi only: a left join keeps unmatched
+        probe rows, which pruned partitions would drop."""
+        if node.kind not in ("inner", "semi") or getattr(node, "null_aware",
+                                                         False):
+            return
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            if not (isinstance(lk, E.ColRef) and isinstance(rk, E.ColRef)):
+                continue
+            lorg = _origin(node.left, lk.name)
+            rorg = _origin(node.right, rk.name)
+            if lorg is None or rorg is None or lorg[0] == rorg[0]:
+                continue
+            try:
+                schema = self.catalog.get(lorg[0])
+            except Exception:
+                continue
+            if not schema.is_partitioned or schema.partition_by[1] != lorg[1]:
+                continue
+            scan = _find_single_scan(node.left, lorg[0])
+            dim_scan = _find_single_scan(node.right, rorg[0])
+            if scan is None or dim_scan is None or scan.parts is None \
+                    or dim_scan.parts is not None:
+                continue
+            if getattr(scan, "dyn_prune", None) is not None:
+                continue
+            try:
+                dim_rows = sum(self.store.segment_rowcounts(rorg[0]))
+            except Exception:
+                continue
+            if dim_rows > 200_000:   # host pre-pass must stay cheap
+                continue
+            scan.dyn_prune = (rorg[0], tuple(dim_scan.prune_preds or ()),
+                              rorg[1])
+            return
 
     def _maybe_direct_join(self, node: Join) -> None:
         """Dense integer build keys (sequence/surrogate PKs): address the
@@ -620,6 +671,21 @@ class Planner:
         m.locus = Locus.entry()
         m.est_rows = child.est_rows
         return m
+
+
+def _find_single_scan(plan: Plan, table: str):
+    """The unique Scan of ``table`` in the subtree, or None if absent or
+    scanned more than once (two scans must not share one prune)."""
+    found = None
+    stack = [plan]
+    while stack:
+        p = stack.pop()
+        if isinstance(p, Scan) and p.table == table:
+            if found is not None:
+                return None
+            found = p
+        stack.extend(p.children)
+    return found
 
 
 def _origin(plan: Plan, col_id: str):

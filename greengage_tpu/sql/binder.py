@@ -156,8 +156,23 @@ class Binder:
             stmt.from_, where, group_by=stmt.group_by or None,
             naggs=n_agg_items)
         if leftover is not None:
-            f = Filter(plan, self._predicate(leftover, scope))
-            plan = f
+            # sink each WHERE conjunct below the join sides it alone
+            # references (inner/cross either side, outer probe side only) —
+            # the qual-pushdown explicit JOIN ... ON syntax needs, which
+            # also feeds selectivity into join estimates and exposes
+            # pushable conjuncts to zone maps / dynamic partition pruning
+            rest = []
+            for c in _split_and(leftover):
+                pred = self._predicate(c, scope)
+                refs = _expr_col_ids(pred)
+                sunk = False
+                if refs:
+                    plan, sunk = _sink_pred(plan, pred, refs)
+                if not sunk:
+                    rest.append(pred)
+            if rest:
+                plan = Filter(plan, rest[0] if len(rest) == 1
+                              else E.BoolOp("and", tuple(rest)))
         for node, negate in subq:
             plan = self._bind_subquery_pred(node, negate, plan, scope)
         for cmp_ast in corr_scalar:
@@ -1111,9 +1126,21 @@ class Binder:
         return out_l, out_r
 
     def _push_filters(self, plan, scope, conjuncts):
-        if conjuncts:
-            pred = self._predicate(_join_and(conjuncts), scope)
-            plan = Filter(plan, pred)
+        """Bind WHERE conjuncts over a single FROM item, sinking each
+        below any explicit-JOIN sides it alone references (see
+        _sink_pred) — unsinkable conjuncts gather in one Filter on top."""
+        rest = []
+        for c in conjuncts:
+            pred = self._predicate(c, scope)
+            refs = _expr_col_ids(pred)
+            sunk = False
+            if refs:
+                plan, sunk = _sink_pred(plan, pred, refs)
+            if not sunk:
+                rest.append(pred)
+        if rest:
+            plan = Filter(plan, rest[0] if len(rest) == 1
+                          else E.BoolOp("and", tuple(rest)))
         return plan
 
     def _push_single_table(self, plan, scope, conds):
@@ -1535,13 +1562,21 @@ class Binder:
                     e = self._host_pred(arg, {
                         "op": "chain", "chain": [list(s) for s in arg.chain],
                         "cmp": "in", "value": vals})
-                elif vals and all(self._device_raw_eq_ok(arg, v)
-                                  for v in vals):
-                    devs = [self._device_raw_pred(arg, "eq", v) for v in vals]
-                    e = (devs[0] if len(devs) == 1
-                         else E.BoolOp("or", tuple(devs)))
                 else:
-                    e = self._host_pred(arg, {"op": "in", "values": vals})
+                    e = None
+                    if vals and all(self._device_raw_eq_ok(arg, v)
+                                    for v in vals):
+                        devs = [self._device_raw_pred(arg, "eq", v)
+                                for v in vals]
+                        # eq_ok pre-screens every value so no aux column
+                        # stages for a list the host path ends up serving;
+                        # the None check guards against the two predicates
+                        # ever drifting apart
+                        if all(d is not None for d in devs):
+                            e = (devs[0] if len(devs) == 1
+                                 else E.BoolOp("or", tuple(devs)))
+                    if e is None:
+                        e = self._host_pred(arg, {"op": "in", "values": vals})
                 return E.Not(e) if ast.negate else e
             d = _dict_ref_of(arg) if arg.type.kind is T.Kind.TEXT else None
             dictionary = self.store.dictionary(*d) if d else None
@@ -2052,6 +2087,63 @@ class Binder:
 # --------------------------------------------------------------------------
 # helpers
 # --------------------------------------------------------------------------
+
+def _expr_col_ids(e) -> set:
+    """Bound column ids a predicate references (generic expr walk)."""
+    import dataclasses
+
+    out: set = set()
+
+    def walk(x):
+        if isinstance(x, E.ColRef):
+            out.add(x.name)
+            return
+        if isinstance(x, E.Expr):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, (tuple, list)):
+            for y in x:
+                walk(y)
+
+    walk(e)
+    return out
+
+
+def _sink_pred(plan, pred, refs: set):
+    """Push a bound conjunct below join nodes whose one side covers every
+    referenced column: inner/cross sink either side, outer/semi/anti only
+    the probe side (a WHERE pred on a left join's nullable side must stay
+    above the join to reject null-extended rows). -> (plan, sunk?)."""
+    if isinstance(plan, Filter):
+        child, ok = _sink_pred(plan.child, pred, refs)
+        if ok:
+            plan.child = child
+            return plan, True
+        return plan, False
+    if isinstance(plan, Join):
+        lids = {c.id for c in plan.left.out_cols()}
+        if refs <= lids:
+            child, ok = _sink_pred(plan.left, pred, refs)
+            plan.left = child if ok else _merge_filter(plan.left, pred)
+            return plan, True
+        if plan.kind in ("inner", "cross"):
+            rids = {c.id for c in plan.right.out_cols()}
+            if refs <= rids:
+                child, ok = _sink_pred(plan.right, pred, refs)
+                plan.right = child if ok else _merge_filter(plan.right, pred)
+                return plan, True
+    return plan, False
+
+
+def _merge_filter(node, pred):
+    """AND into an existing Filter rather than stacking a second one —
+    the planner's scan-level pushdown (zone maps, direct dispatch) only
+    inspects the Filter DIRECTLY above a Scan."""
+    if isinstance(node, Filter):
+        node.predicate = E.BoolOp("and", (node.predicate, pred))
+        return node
+    return Filter(node, pred)
+
 
 def _colref(c: ColInfo) -> E.ColRef:
     e = E.ColRef(c.id, c.type)

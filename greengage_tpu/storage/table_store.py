@@ -509,10 +509,25 @@ class TableStore:
         return values, blocks
 
     @staticmethod
-    def _index_blocks_for(values, blocks, val) -> set:
-        """Blocks containing ``val`` (equality probe)."""
-        lo = np.searchsorted(values, val, side="left")
-        hi = np.searchsorted(values, val, side="right")
+    def _index_blocks_for(values, blocks, op, val) -> set:
+        """Blocks containing any value satisfying ``op val``: equality is
+        the point probe, range ops slice the sorted value run — the btree
+        range-scan analog (nbtsearch.c _bt_first) over block addresses.
+        On unclustered data a wide range keeps most blocks (honest); a
+        selective range keeps only the blocks its few values live in."""
+        if op == "=":
+            lo = np.searchsorted(values, val, side="left")
+            hi = np.searchsorted(values, val, side="right")
+        elif op == "<":
+            lo, hi = 0, np.searchsorted(values, val, side="left")
+        elif op == "<=":
+            lo, hi = 0, np.searchsorted(values, val, side="right")
+        elif op == ">":
+            lo, hi = np.searchsorted(values, val, side="right"), len(values)
+        elif op == ">=":
+            lo, hi = np.searchsorted(values, val, side="left"), len(values)
+        else:
+            return set(blocks.tolist())
         return set(blocks[lo:hi].tolist())
 
     def _kept_blocks(self, files, base, prune, indexed_cols=frozenset()):
@@ -541,14 +556,11 @@ class TableStore:
             blocks = read_footer(os.path.join(base, rel))["blocks"]
             by_fileno_nblocks[fileno] = len(blocks)
             idx_keep: set | None = None
-            if col in indexed_cols:
-                eq_vals = [v for op, v in preds if op == "="]
-                if eq_vals:
-                    vals, blks = self.block_index(base, rel)
-                    for v in eq_vals:
-                        hit = self._index_blocks_for(vals, blks, v)
-                        idx_keep = hit if idx_keep is None \
-                            else idx_keep & hit
+            if col in indexed_cols and preds:
+                vals, blks = self.block_index(base, rel)
+                for op, v in preds:
+                    hit = self._index_blocks_for(vals, blks, op, v)
+                    idx_keep = hit if idx_keep is None else idx_keep & hit
             ok = []
             for i, b in enumerate(blocks):
                 if idx_keep is not None and i not in idx_keep:
@@ -762,16 +774,21 @@ class TableStore:
         lengths = (ends - starts).astype(np.int32)
         words = np.zeros((n, RAW_PREFIX_WORDS), np.uint64)
         if n and len(blob):
-            idx = starts[:, None] + np.arange(RAW_PREFIX_BYTES,
-                                              dtype=np.int64)[None, :]
-            m = idx < ends[:, None]
-            data = np.where(m, blob[np.minimum(idx, len(blob) - 1)],
-                            np.uint8(0)).astype(np.uint64)
-            for w in range(RAW_PREFIX_WORDS):
-                acc = np.zeros(n, np.uint64)
-                for j in range(8):
-                    acc = (acc << np.uint64(8)) | data[:, w * 8 + j]
-                words[:, w] = acc
+            # chunk rows: the transient n x 32 gather matrices would
+            # otherwise spike ~800B/row of host memory on big segments
+            CH = 1 << 20
+            steps = np.arange(RAW_PREFIX_BYTES, dtype=np.int64)[None, :]
+            for a in range(0, n, CH):
+                b = min(a + CH, n)
+                idx = starts[a:b, None] + steps
+                m = idx < ends[a:b, None]
+                data = np.where(m, blob[np.minimum(idx, len(blob) - 1)],
+                                np.uint8(0)).astype(np.uint64)
+                for w in range(RAW_PREFIX_WORDS):
+                    acc = np.zeros(b - a, np.uint64)
+                    for j in range(8):
+                        acc = (acc << np.uint64(8)) | data[:, w * 8 + j]
+                    words[a:b, w] = acc
         out = (words.view(np.int64), lengths)
         self._rawprefix_cache[key] = out
         if len(self._rawprefix_cache) > 64:

@@ -1,0 +1,119 @@
+"""Join-driven runtime partition elimination — VERDICT r3 missing #8,
+the nodePartitionSelector.c execution-time role: a partitioned probe
+joined to a filtered small build ON THE PARTITION KEY stages only the
+child partitions a surviving build key can land in. Static pruning can
+never do this (the selecting predicate lives on the other table)."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("""create table fact (k int, pd int, v int) distributed by (k)
+             partition by range (pd)
+             (partition p0 start (0) end (100),
+              partition p1 start (100) end (200),
+              partition p2 start (200) end (300),
+              partition p3 start (300) end (400))""")
+    n = 80_000
+    rng = np.random.default_rng(5)
+    d.load_table("fact", {"k": np.arange(n),
+                          "pd": rng.integers(0, 400, n),
+                          "v": rng.integers(0, 100, n)})
+    # dim: 400 keys, category selects a narrow pd band
+    d.sql("create table dim (pk int, cat int) distributed by (pk)")
+    d.load_table("dim", {"pk": np.arange(400),
+                         "cat": np.arange(400) // 100})
+    d.sql("analyze")
+    return d
+
+
+def test_build_filter_prunes_probe_partitions(db):
+    # cat = 2 selects pk in [200, 300): only partition p2 can match
+    r = db.sql("select count(*), sum(f.v) from fact f, dim d "
+               "where f.pd = d.pk and d.cat = 2")
+    dyn = r.stats.get("dynamic_prune", {})
+    assert dyn.get("fact") == (1, 4), r.stats
+    # oracle
+    want = db.sql("select count(*), sum(v) from fact "
+                  "where pd >= 200 and pd < 300").rows()
+    assert r.rows() == want
+
+
+def test_no_build_filter_still_prunes_by_existing_keys(db):
+    d2 = greengage_tpu.connect(numsegments=4)
+    d2.sql("""create table f2 (k int, pd int) distributed by (k)
+              partition by range (pd)
+              (partition a start (0) end (50),
+               partition b start (50) end (100))""")
+    d2.load_table("f2", {"k": np.arange(1000),
+                         "pd": np.arange(1000) % 100})
+    d2.sql("create table d2 (pk int) distributed by (pk)")
+    d2.load_table("d2", {"pk": np.arange(10)})   # keys 0..9: partition a only
+    d2.sql("analyze")
+    r = d2.sql("select count(*) from f2, d2 where f2.pd = d2.pk")
+    assert r.stats.get("dynamic_prune", {}).get("f2") == (1, 2), r.stats
+    assert r.rows()[0][0] == 10 * 10
+
+
+def test_left_join_never_prunes_probe(db):
+    r = db.sql("select count(*) from fact f left join dim d "
+               "on f.pd = d.pk and d.cat = 2")
+    assert "fact" not in r.stats.get("dynamic_prune", {}), r.stats
+    assert r.rows()[0][0] == 80_000   # every probe row survives
+
+
+def test_semi_join_prunes(db):
+    r = db.sql("select count(*) from fact where pd in "
+               "(select pk from dim where cat = 0)")
+    dyn = r.stats.get("dynamic_prune", {})
+    want = db.sql("select count(*) from fact where pd < 100").rows()
+    assert r.rows() == want
+    if "fact" in dyn:          # semi-join shape reached the annotation
+        assert dyn["fact"] == (1, 4)
+
+
+def test_empty_build_filter_keeps_nothing_but_defaults(db):
+    r = db.sql("select count(*) from fact f, dim d "
+               "where f.pd = d.pk and d.cat = 99")
+    assert r.rows()[0][0] == 0
+    dyn = r.stats.get("dynamic_prune", {})
+    assert dyn.get("fact") == (0, 4), r.stats
+
+
+def test_static_and_dynamic_compose(db):
+    # static prune (pd < 200 keeps p0,p1) AND the build filter (cat=0
+    # keeps p0): the intersection stages one child
+    r = db.sql("select count(*) from fact f, dim d "
+               "where f.pd = d.pk and d.cat = 0 and f.pd < 200")
+    want = db.sql("select count(*) from fact where pd < 100").rows()
+    assert r.rows() == want
+    dyn = r.stats.get("dynamic_prune", {})
+    assert dyn.get("fact", (99, 99))[0] <= 1, r.stats
+
+
+def test_explicit_join_syntax_also_prunes(db):
+    """WHERE conjuncts sink below explicit JOIN ... ON sides (qual
+    pushdown), so the build filter reaches the dim scan and the runtime
+    partition selector fires for this syntax too."""
+    r = db.sql("select count(*), sum(f.v) from fact f join dim d "
+               "on f.pd = d.pk where d.cat = 2")
+    assert r.stats.get("dynamic_prune", {}).get("fact") == (1, 4), r.stats
+    want = db.sql("select count(*), sum(v) from fact "
+                  "where pd >= 200 and pd < 300").rows()
+    assert r.rows() == want
+
+
+def test_left_join_where_on_nullable_side_not_sunk(db):
+    # WHERE d.cat = 2 on the NULLABLE side of a left join rejects
+    # null-extended rows — it must stay ABOVE the join (inner-join
+    # equivalence is a rewrite we deliberately do not apply)
+    r = db.sql("select count(*) from fact f left join dim d "
+               "on f.pd = d.pk where d.cat = 2")
+    want = db.sql("select count(*) from fact where pd >= 200 and pd < 300"
+                  ).rows()
+    assert r.rows() == want
