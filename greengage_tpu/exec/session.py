@@ -723,6 +723,16 @@ class Database:
                     self.path, self.store)
             except Exception as e:
                 self.log.error("archive", f"archiving failed: {e}")
+        # standby master (gpinitstandby): ship the committed coordinator
+        # state; a failing sync logs and never fails the write
+        from greengage_tpu.runtime import standby as _standby
+
+        sb = _standby.registered_standby(self.path)
+        if sb is not None:
+            try:
+                _standby.sync(self.path, sb)
+            except Exception as e:
+                self.log.error("standby", f"standby sync failed: {e}")
         if self.replicator is None:
             return
         if self.settings.mirror_sync:
@@ -1315,6 +1325,16 @@ class Database:
                 for ci, blob in enumerate(
                         ingest.fetch_chunks(url, self.numsegments)):
                     chunks.append((blob, ci == 0))
+            elif url.startswith("s3://"):
+                # object store (gpcloud role): one external file per object
+                from greengage_tpu.runtime import s3
+
+                objs = s3.fetch(url)
+                if not objs:
+                    raise SqlError(f"external location {url!r} matches "
+                                   "no objects")
+                for _key, blob in objs:
+                    chunks.append((blob, True))
             else:
                 path = url[len("file://"):] if url.startswith("file://") else url
                 matches = sorted(_glob.glob(path))
@@ -1571,7 +1591,18 @@ class Database:
         url = ext["urls"][0]
         if url.startswith("gpfdist://"):
             raise SqlError("writing through a gpfdist URL is not supported; "
-                           "use file:// or EXECUTE")
+                           "use file://, s3://, or EXECUTE")
+        if url.startswith("s3://"):
+            # one object per INSERT batch (the gpcloud writable layout:
+            # unique keys so parallel writers never clobber)
+            import uuid as _uuid
+
+            from greengage_tpu.runtime import s3
+
+            key = s3.store(url, f"gg_{_uuid.uuid4().hex[:12]}.csv",
+                           payload.encode())
+            self.log.info("external", f"wrote s3 object {key}")
+            return f"INSERT 0 {len(res)}"
         path = url[len("file://"):] if url.startswith("file://") else url
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "a", encoding="utf-8") as f:

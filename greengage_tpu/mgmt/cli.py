@@ -42,6 +42,28 @@ def cmd_init(args):
     return 0
 
 
+def cmd_initstandby(args):
+    """gpinitstandby analog: seed a standby coordinator directory and
+    register it for continuous post-commit sync."""
+    from greengage_tpu.runtime import standby
+
+    marker = standby.init_standby(args.dir, args.standby)
+    print(f"standby initialized at {args.standby} "
+          f"(synced to manifest v{marker['synced_version']})")
+    return 0
+
+
+def cmd_activatestandby(args):
+    """gpactivatestandby analog: promote the standby's metadata copy to a
+    servable cluster directory, linked to the surviving data trees."""
+    from greengage_tpu.runtime import standby
+
+    st = standby.activate(args.standby, args.data)
+    print(f"standby activated (manifest v{st.get('synced_version', '?')}); "
+          f"connect to {args.standby}")
+    return 0
+
+
 def cmd_replicate(args):
     """gpaddmirrors/manual sync: bring every mirror to the current manifest
     version (normally automatic via the mirror_sync setting)."""
@@ -858,6 +880,18 @@ def main(argv=None):
     p.add_argument("-n", "--numsegments", type=int, default=None)
     p.add_argument("--mirrors", action="store_true")
     p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("initstandby")   # gpinitstandby analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-s", "--standby", required=True)
+    p.set_defaults(fn=cmd_initstandby)
+
+    p = sub.add_parser("activatestandby")   # gpactivatestandby analog
+    p.add_argument("-s", "--standby", required=True)
+    p.add_argument("--data", default=None,
+                   help="surviving data directory to link (defaults to the "
+                        "primary's if still reachable)")
+    p.set_defaults(fn=cmd_activatestandby)
 
     p = sub.add_parser("replicate")
     p.add_argument("-d", "--dir", required=True)

@@ -1,0 +1,168 @@
+"""Master standby — the gpinitstandby / gpactivatestandby analog
+(reference: gpMgmt/bin/gpinitstandby:1, gpactivatestandby:1).
+
+The coordinator's durable state is small and file-shaped: catalog.json
+(schemas/topology/stats), manifest.json (the distributed commit record),
+append-only dictionary files, and calibration.json. A standby is a
+directory holding a continuously-synced copy of exactly that state:
+``init_standby`` seeds it, every committed write ships the new
+manifest+catalog (``sync``, called from the session's post-commit hook,
+like WAL shipping to the standby master), and ``activate`` promotes the
+copy to a servable cluster directory — pointed at the surviving segment
+data trees, which mirrors (runtime/replication.py) protect separately.
+A failing sync logs and never fails the write (async-standby semantics);
+``gg state`` surfaces the lag."""
+
+from __future__ import annotations
+
+import json
+import os
+
+MARKER = "standby.json"
+PRIMARY_MARKER = "standby_registered.json"
+
+# manifest.json LAST: it is the commit record — if the sync dies midway,
+# the standby's manifest must never be newer than the dictionaries it
+# references (the WAL commit-point-last rule)
+_META_FILES = ("calibration.json", "catalog.json", "manifest.json")
+
+
+def _copy_file(src: str, dst: str) -> None:
+    from greengage_tpu.storage.archive import _atomic_copy
+
+    _atomic_copy(src, dst)
+
+
+def _sync_meta(cluster_path: str, standby_path: str) -> None:
+    # dictionaries first (append-only: re-copy only the ones that grew)
+    data = os.path.join(cluster_path, "data")
+    if os.path.isdir(data):
+        for tdir in os.listdir(data):
+            src_dir = os.path.join(data, tdir)
+            if not os.path.isdir(src_dir):
+                continue
+            for fn in os.listdir(src_dir):
+                if not fn.startswith("dict_"):
+                    continue
+                src = os.path.join(src_dir, fn)
+                dst = os.path.join(standby_path, "data", tdir, fn)
+                try:
+                    if (not os.path.exists(dst)
+                            or os.path.getsize(dst) != os.path.getsize(src)):
+                        _copy_file(src, dst)
+                except OSError:
+                    pass
+    for fn in _META_FILES:
+        src = os.path.join(cluster_path, fn)
+        if os.path.exists(src):
+            _copy_file(src, os.path.join(standby_path, fn))
+
+
+def init_standby(cluster_path: str, standby_path: str) -> dict:
+    """Seed the standby with the coordinator's current metadata and
+    register it on the primary so every future commit syncs."""
+    if os.path.abspath(standby_path) == os.path.abspath(cluster_path):
+        raise ValueError("standby path must differ from the cluster path")
+    os.makedirs(standby_path, exist_ok=True)
+    _sync_meta(cluster_path, standby_path)
+    with open(os.path.join(cluster_path, "manifest.json")) as f:
+        version = json.load(f).get("version", 0)
+    marker = {"role": "standby", "primary": os.path.abspath(cluster_path),
+              "synced_version": version}
+    with open(os.path.join(standby_path, MARKER), "w") as f:
+        json.dump(marker, f, indent=1)
+    with open(os.path.join(cluster_path, PRIMARY_MARKER), "w") as f:
+        json.dump({"standby_path": os.path.abspath(standby_path)}, f)
+    return marker
+
+
+def registered_standby(cluster_path: str) -> str | None:
+    p = os.path.join(cluster_path, PRIMARY_MARKER)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f).get("standby_path")
+    except (OSError, ValueError):
+        return None
+
+
+def sync(cluster_path: str, standby_path: str) -> int:
+    """Ship the newest committed state; -> synced manifest version.
+
+    Fenced two ways: the target must still carry its standby marker (a
+    dead/unmounted standby directory must FAIL the sync loudly, not be
+    silently resurrected as an empty local dir reporting itself synced),
+    and a target whose marker says 'activated' is a PROMOTED coordinator
+    — overwriting it would be split-brain data loss, exactly the state a
+    partitioned old primary would create."""
+    mp = os.path.join(standby_path, MARKER)
+    try:
+        with open(mp) as f:
+            marker = json.load(f)
+    except (OSError, ValueError) as e:
+        raise RuntimeError(
+            f"standby at {standby_path} has no readable marker "
+            f"(gone/unmounted?): {e}")
+    if marker.get("role") == "activated":
+        raise RuntimeError(
+            f"standby at {standby_path} was ACTIVATED; refusing to "
+            "overwrite a promoted coordinator (split-brain fence) — "
+            "remove this primary's standby registration")
+    _sync_meta(cluster_path, standby_path)
+    with open(os.path.join(standby_path, "manifest.json")) as f:
+        version = json.load(f).get("version", 0)
+    marker["synced_version"] = version
+    with open(mp, "w") as f:
+        json.dump(marker, f, indent=1)
+    return version
+
+
+def status(standby_path: str) -> dict:
+    with open(os.path.join(standby_path, MARKER)) as f:
+        return json.load(f)
+
+
+def activate(standby_path: str, data_path: str | None = None) -> dict:
+    """Promote the standby to a servable cluster directory
+    (gpactivatestandby): the metadata copy becomes authoritative; segment
+    data stays where it survived — ``data_path`` links the standby to it
+    (mirror trees / shared storage). In-doubt manifests resolve on the
+    first connect's recover()."""
+    st = status(standby_path)
+    if st.get("role") == "activated":
+        return st
+    data_dir = os.path.join(standby_path, "data")
+    if not os.path.isdir(data_dir):
+        if data_path is None:
+            primary_data = os.path.join(st.get("primary", ""), "data")
+            if os.path.isdir(primary_data):
+                data_path = primary_data
+            else:
+                raise ValueError(
+                    "standby has no data tree and the primary's is gone; "
+                    "pass the surviving data directory via data_path")
+        # dict files may already live under standby/data; a symlink would
+        # shadow them — only link when nothing was synced there yet
+        os.symlink(os.path.abspath(data_path), data_dir)
+    elif data_path is not None:
+        # merge: link each missing table dir into the synced data tree
+        for tdir in os.listdir(data_path):
+            src = os.path.join(data_path, tdir)
+            dst = os.path.join(data_dir, tdir)
+            if os.path.isdir(src) and not os.path.exists(dst):
+                os.symlink(os.path.abspath(src), dst)
+            elif os.path.isdir(src) and os.path.isdir(dst):
+                for fn in os.listdir(src):
+                    d2 = os.path.join(dst, fn)
+                    if not os.path.exists(d2):
+                        os.symlink(os.path.abspath(os.path.join(src, fn)), d2)
+    st["role"] = "activated"
+    with open(os.path.join(standby_path, MARKER), "w") as f:
+        json.dump(st, f, indent=1)
+    # the promoted coordinator must not keep syncing to itself
+    try:
+        os.remove(os.path.join(standby_path, PRIMARY_MARKER))
+    except OSError:
+        pass
+    return st

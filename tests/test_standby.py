@@ -1,0 +1,98 @@
+"""Master standby — gpinitstandby/gpactivatestandby analog (VERDICT r3
+missing #6): the coordinator's catalog+manifest+dictionaries are no
+longer a single point of failure. Continuous post-commit sync ships the
+metadata; activation promotes the copy against the surviving data trees."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.mgmt import cli
+from greengage_tpu.runtime import standby
+
+
+@pytest.fixture()
+def cluster(devices8, tmp_path):
+    path = str(tmp_path / "primary")
+    d = greengage_tpu.connect(path=path, numsegments=4)
+    d.sql("create table t (k int, name text, v int) distributed by (k)")
+    d.load_table("t", {"k": np.arange(100),
+                       "name": greengage_tpu.types.Coded(
+                           ["a", "b"], (np.arange(100) % 2).astype(np.int32)),
+                       "v": np.arange(100)})
+    return d, path, str(tmp_path / "standby")
+
+
+def test_init_sync_and_lag_tracking(cluster):
+    d, path, sb = cluster
+    rc = cli.main(["initstandby", "-d", path, "-s", sb])
+    assert rc == 0
+    v0 = standby.status(sb)["synced_version"]
+    # every committed write ships automatically from the post-commit hook
+    d.sql("insert into t values (1000, 'a', 1000)")
+    d.sql("insert into t values (1001, 'b', 1001)")
+    st = standby.status(sb)
+    assert st["synced_version"] >= v0 + 2
+    assert st["synced_version"] == \
+        d.store.manifest.snapshot()["version"]
+
+
+def test_activation_after_primary_loss(cluster):
+    d, path, sb = cluster
+    cli.main(["initstandby", "-d", path, "-s", sb])
+    d.sql("insert into t values (555, 'a', 555)")
+    d.sql("delete from t where k < 10")          # visimap bitmap too
+    d.close()
+    # simulate losing the coordinator metadata but not the data trees
+    # (disk holding catalog/manifest dies; shared/mirrored storage lives)
+    survived_data = path + "_surviving_data"
+    shutil.move(os.path.join(path, "data"), survived_data)
+    shutil.rmtree(path)
+    rc = cli.main(["activatestandby", "-s", sb, "--data", survived_data])
+    assert rc == 0
+    d2 = greengage_tpu.connect(path=sb, numsegments=4)
+    assert d2.sql("select count(*) from t").rows()[0][0] == 91
+    assert d2.sql("select v from t where k = 555").rows() == [(555,)]
+    # TEXT dictionaries came across in the sync
+    assert d2.sql("select count(*) from t where name = 'a'"
+                  ).rows()[0][0] == 46
+    # the promoted coordinator serves writes
+    d2.sql("insert into t values (777, 'b', 777)")
+    assert d2.sql("select count(*) from t").rows()[0][0] == 92
+
+
+def test_failed_sync_never_fails_the_write(cluster):
+    d, path, sb = cluster
+    cli.main(["initstandby", "-d", path, "-s", sb])
+    shutil.rmtree(sb)                      # standby host dies
+    d.sql("insert into t values (42, 'a', 42)")   # must still succeed
+    assert d.sql("select count(*) from t").rows()[0][0] == 101
+    # and the dead standby was NOT silently resurrected as an empty dir
+    # that claims to be synced (the sync must have genuinely failed)
+    assert not os.path.exists(os.path.join(sb, "manifest.json"))
+
+
+def test_activated_standby_fenced_from_old_primary(cluster):
+    """Split-brain fence: a partitioned old primary must never overwrite
+    a PROMOTED standby's committed state."""
+    d, path, sb = cluster
+    cli.main(["initstandby", "-d", path, "-s", sb])
+    standby.activate(sb, os.path.join(path, "data"))
+    with pytest.raises(RuntimeError, match="ACTIVATED|split-brain"):
+        standby.sync(path, sb)
+    # the old primary keeps serving its own writes (sync failure logged)
+    d.sql("insert into t values (42, 'a', 42)")
+    assert d.sql("select count(*) from t").rows()[0][0] == 101
+
+
+def test_activation_is_idempotent_and_stops_self_sync(cluster):
+    d, path, sb = cluster
+    cli.main(["initstandby", "-d", path, "-s", sb])
+    d.close()
+    standby.activate(sb, os.path.join(path, "data"))
+    st = standby.activate(sb)              # second call: no-op
+    assert st["role"] == "activated"
+    assert standby.registered_standby(sb) is None
