@@ -1,0 +1,62 @@
+"""Runtime cardinality feedback — VERDICT r3 weak #3: the exact counts
+the executor's overflow machinery already collects (join expansion
+totals, agg group counts) persist per statement, so a post-DML replan
+compiles right-sized instead of re-discovering the cardinality through
+capacity-tier recompiles (each tier is a full XLA recompile)."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture()
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table pr (k int, fk int) distributed by (k)")
+    d.load_table("pr", {"k": np.arange(2000),
+                        "fk": (np.arange(2000) % 100).astype(np.int64)})
+    d.sql("create table bl (pk int, m int) distributed by (m)")
+    # analyzed with 3000 UNIQUE build keys (high NDV)...
+    d.load_table("bl", {"pk": np.arange(3000), "m": np.arange(3000)})
+    d.sql("analyze")
+    # ...then rewritten as 30x duplicates of 100 keys WITHOUT
+    # re-analyzing: |L||R|/max(ndv) underestimates the join fanout 30x,
+    # so the CSR expansion capacity is far too small on the first run
+    d.sql("delete from bl")
+    reps = np.repeat(np.arange(100), 30)
+    d.load_table("bl", {"pk": reps, "m": 100 + np.arange(len(reps))})
+    return d
+
+
+Q = "select count(*) from pr, bl where pr.fk = bl.pk"
+
+
+def test_second_plan_uses_observed_cardinality(db):
+    r1 = db.sql(Q)
+    assert r1.rows()[0][0] == 2000 * 30
+    assert r1.stats["tiers_used"] > 1          # stale stats: paid retries
+    # DML bumps the manifest version: the statement replans and recompiles
+    db.sql("insert into pr values (999999, 999)")
+    r2 = db.sql(Q)
+    assert r2.rows()[0][0] == 2000 * 30
+    assert r2.stats["compiled"] is True        # fresh compile (new version)
+    assert r2.stats["tiers_used"] == 1         # ...sized by the feedback
+    # steady state stays cached
+    r3 = db.sql(Q)
+    assert r3.stats["compiled"] is False
+    assert r3.rows()[0][0] == 2000 * 30
+
+
+def test_hints_self_correct_when_data_grows_again(db):
+    db.sql(Q)
+    # triple the duplicates: the recorded hint is now too SMALL — the
+    # overflow retry self-heals and re-records
+    reps = np.repeat(np.arange(100), 60)
+    db.load_table("bl", {"pk": reps, "m": 5000 + np.arange(len(reps))})
+    r = db.sql(Q)
+    assert r.rows()[0][0] == 2000 * 90
+    db.sql("insert into pr values (999998, 998)")
+    r2 = db.sql(Q)
+    assert r2.rows()[0][0] == 2000 * 90
+    assert r2.stats["tiers_used"] == 1
