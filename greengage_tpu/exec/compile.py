@@ -447,7 +447,7 @@ class Compiler:
             return cap
         if isinstance(plan, Join):
             probe_cap = self._capacity_of(plan.left)
-            if getattr(plan, "multi", False):
+            if getattr(plan, "multi", False) and plan.kind in ("inner", "left"):
                 if self._nid(plan) in self.cap_overrides:
                     # exact cardinality reported by the overflowed run
                     return max(int(self.cap_overrides[self._nid(plan)]), 64)
@@ -729,12 +729,27 @@ class Compiler:
         return run
 
     def _c_join_multi(self, plan: Join):
-        """Duplicate-capable inner/left join via CSR expansion."""
+        """Duplicate-capable join via CSR expansion: inner/left emit the
+        matched pairs; semi/anti reduce the pairs back to PROBE rows with
+        an any-match scatter — the shape EXISTS correlation with residual
+        predicates needs (a probe row qualifies iff ANY duplicate build
+        row passes equality AND the residual; nodeSubplan's hashed-EXISTS
+        with non-hashable quals)."""
         left_fn = self._compile_node(plan.left)
         right_fn = self._compile_node(plan.right)
         build_cap = self._capacity_of(plan.right)
         M = self._join_table_size(build_cap)
-        out_cap = self._capacity_of(plan)
+        if plan.kind in ("semi", "anti"):
+            # output is probe-shaped (_capacity_of); the pair EXPANSION
+            # needs its own capacity, sized by the exact-total retry hint
+            probe_cap0 = self._capacity_of(plan.left)
+            if self._nid(plan) in self.cap_overrides:
+                out_cap = max(int(self.cap_overrides[self._nid(plan)]), 64)
+            else:
+                out_cap = probe_cap0 * 2 + 64
+            out_cap = int(out_cap * (4 ** self.tier))
+        else:
+            out_cap = self._capacity_of(plan)
         probes = self._join_probes()
         lkeys, rkeys = plan.left_keys, plan.right_keys
         kind = plan.kind
@@ -776,6 +791,31 @@ class Compiler:
             ctx["flags"].append((fid_ov, table.base.overflow | walk_ov))
             ctx["flags"].append((fid_exp, expand_ov))
             ctx["metrics"].append((mid_total, total))
+            if kind in ("semi", "anti"):
+                # evaluate the residual on the PAIR batch, then reduce to
+                # per-probe-row existence
+                keep = present & matched
+                if residual is not None:
+                    pcols, pvalids = {}, {}
+                    for c in left_cols:
+                        pcols[c.id] = lb.cols[c.id][prow]
+                        v = lb.valids.get(c.id)
+                        if v is not None:
+                            pvalids[c.id] = v[prow]
+                    for c in right_cols:
+                        pcols[c.id] = rb.cols[c.id][brow]
+                        v = rb.valids.get(c.id)
+                        gv = v[brow] if v is not None else jnp.ones_like(matched)
+                        pvalids[c.id] = gv & matched
+                    pair = Batch(pcols, pvalids, keep)
+                    keep = keep & Evaluator(pair, self.consts).predicate(residual)
+                P = lb.selection().shape[0]
+                any_kept = jnp.zeros((P + 1,), bool).at[
+                    jnp.where(present, prow, P)].max(keep)[:P]
+                lsel = lb.selection()
+                sel2 = (lsel & any_kept if kind == "semi"
+                        else lsel & ~any_kept)
+                return Batch(dict(lb.cols), dict(lb.valids), sel2)
             cols, valids = {}, {}
             for c in left_cols:
                 cols[c.id] = lb.cols[c.id][prow]

@@ -346,6 +346,10 @@ class Planner:
                 # duplicate fanout multiplies output rows; nudge the
                 # estimate so operators above size their tables for it
                 node.est_rows = max(node.est_rows, left.est_rows * 2.0)
+        elif node.kind in ("semi", "anti") and node.residual is not None:
+            # residual EXISTS correlation must test EVERY duplicate build
+            # row (any-match): route through the CSR expansion
+            node.multi = True
         # build-side key bounds for the packed/narrowed hash table
         # (ops/join.py pack_join_keys): probe values outside the build's
         # bounds simply never match, so only the BUILD side's stats matter

@@ -326,12 +326,16 @@ class Binder:
         # (any other LIMIT >= 1 can't change existence — ignored)
 
         subplan, sub_scope, _ = self._bind_from(q.from_, None)
-        inner_only, corr_pairs, outer_only, bad = _split_correlation(
-            _split_and(q.where), scope, sub_scope)
+        inner_only, corr_pairs, outer_only, residuals, bad = \
+            _split_correlation(_split_and(q.where), scope, sub_scope)
         if bad:
             raise SqlError(
-                "only equality correlation with the outer query is supported "
-                "in EXISTS subqueries")
+                "EXISTS correlation references columns visible in neither "
+                "the subquery nor the outer query")
+        if residuals and not corr_pairs:
+            raise SqlError(
+                "non-equality EXISTS correlation needs at least one "
+                "equality conjunct to join on")
         if outer_only and negate:
             # not exists(P_outer AND Q) = NOT P_outer OR NOT exists(Q):
             # not expressible as a filter + anti join; bail honestly
@@ -344,7 +348,14 @@ class Binder:
             lks = [self._expr(o, scope) for o, _ in corr_pairs]
             rks = [self._expr(i, sub_scope) for _, i in corr_pairs]
             lks, rks = self._align_join_keys(lks, rks)
-            joined = Join(kind, plan, subplan, lks, rks)
+            res_pred = None
+            if residuals:
+                # mixed-reference non-equality conjuncts (l2.x <> l1.x):
+                # evaluated per candidate pair over the CSR expansion —
+                # a probe row qualifies iff ANY pair passes (Q21 shape)
+                both = scope.merged(sub_scope)
+                res_pred = self._predicate(_join_and(residuals), both)
+            joined = Join(kind, plan, subplan, lks, rks, residual=res_pred)
         else:
             # uncorrelated EXISTS: constant-key semi join (matched iff sub
             # produced any row; duplicate constant keys are fine)
@@ -407,9 +418,9 @@ class Binder:
             sub_scope = probe[1]
         else:
             _, sub_scope, _ = self._bind_from(q.from_, None)
-        inner_only, corr_pairs, outer_only, bad = _split_correlation(
-            _split_and(q.where), scope, sub_scope)
-        if bad:
+        inner_only, corr_pairs, outer_only, residuals, bad = \
+            _split_correlation(_split_and(q.where), scope, sub_scope)
+        if bad or residuals:
             raise SqlError(
                 "only equality correlation is supported in scalar subqueries")
         if not corr_pairs:
@@ -2217,8 +2228,11 @@ def _contains_count(ast) -> bool:
 
 def _split_correlation(conjuncts, outer_scope, sub_scope):
     """Classify a subquery's WHERE conjuncts relative to the outer scope:
-    -> (inner_only, corr_pairs [(outer_ast, inner_ast)], outer_only, bad)."""
-    inner_only, corr_pairs, outer_only, bad = [], [], [], []
+    -> (inner_only, corr_pairs [(outer_ast, inner_ast)], outer_only,
+        residual, bad). ``residual`` = mixed-reference conjuncts that are
+    NOT plain equality correlation (e.g. l2.suppkey <> l1.suppkey): they
+    evaluate per candidate pair on the semi/anti join."""
+    inner_only, corr_pairs, outer_only, residual, bad = [], [], [], [], []
     for c in conjuncts:
         refs = _name_refs(c)
         # innermost scope wins (SQL scoping): anything resolvable fully
@@ -2244,8 +2258,12 @@ def _split_correlation(conjuncts, outer_scope, sub_scope):
         if refs and all(_in_scope(p, outer_scope) for p in refs):
             outer_only.append(c)
             continue
+        if all(_in_scope(p, sub_scope) or _in_scope(p, outer_scope)
+               for p in refs):
+            residual.append(c)
+            continue
         bad.append(c)
-    return inner_only, corr_pairs, outer_only, bad
+    return inner_only, corr_pairs, outer_only, residual, bad
 
 
 def _contains_window(ast) -> bool:

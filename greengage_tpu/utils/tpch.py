@@ -147,9 +147,23 @@ def generate(sf: float, seed: int = 19940801) -> dict[str, dict]:
         "l_shipmode": _choice(rng, n_line, SHIPMODES),
         "l_comment": _vocab(rng, n_line, "li comment ", 1000),
     }
+    # partsupp: each part stocked by 4 suppliers (dbgen's layout: supplier
+    # chosen by a part/index formula so pairs are unique)
+    ps_part = np.repeat(part["p_partkey"], 4)
+    idx4 = np.tile(np.arange(4, dtype=np.int64), n_part)
+    ps_supp = ((ps_part + idx4 * (n_supp // 4 + 1)) % n_supp) + 1
+    n_ps = len(ps_part)
+    partsupp = {
+        "ps_partkey": ps_part.astype(np.int64),
+        "ps_suppkey": ps_supp.astype(np.int64),
+        "ps_availqty": rng.integers(1, 10_000, n_ps).astype(np.int32),
+        "ps_supplycost": _dec(rng, n_ps, 1.0, 1000.0),
+        "ps_comment": _vocab(rng, n_ps, "ps comment ", 200),
+    }
     return {
         "nation": nation, "region": region, "supplier": supplier,
-        "customer": customer, "part": part, "orders": orders, "lineitem": lineitem,
+        "customer": customer, "part": part, "partsupp": partsupp,
+        "orders": orders, "lineitem": lineitem,
     }
 
 
@@ -172,6 +186,10 @@ create table if not exists part (
   p_partkey bigint, p_name text, p_mfgr text, p_brand text, p_type text,
   p_size int, p_container text, p_retailprice decimal(15,2), p_comment text
 ) distributed by (p_partkey);
+create table if not exists partsupp (
+  ps_partkey bigint, ps_suppkey bigint, ps_availqty int,
+  ps_supplycost decimal(15,2), ps_comment text
+) distributed by (ps_partkey);
 create table if not exists orders (
   o_orderkey bigint, o_custkey bigint, o_orderstatus text,
   o_totalprice decimal(15,2), o_orderdate date, o_orderpriority text,

@@ -1,0 +1,398 @@
+"""TPC-H queries 2, 7, 8, 9, 11, 15, 16, 18, 20, 21, 22 vs pandas
+oracles — completing 22/22 coverage (Q1/3/4/5/6/10/12/13/14/17/19 live
+in test_tpch_more.py / bench). Exercises partsupp, nested IN chains,
+HAVING-over-subquery, CTE self-reference with scalar subquery, mixed
+EXISTS / NOT EXISTS with non-equality correlation (residual semi/anti
+joins), count(distinct), and substring-based grouping."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.types import Coded
+from greengage_tpu.utils import tpch
+
+SF = 0.02
+DEC = {"s_acctbal", "c_acctbal", "o_totalprice", "l_quantity",
+       "l_extendedprice", "l_discount", "l_tax", "p_retailprice",
+       "ps_supplycost"}
+
+
+@pytest.fixture(scope="module")
+def env(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    tpch.load(d, SF)
+    d.sql("analyze")
+    data = tpch.generate(SF)
+    dfs = {}
+    for t, cols in data.items():
+        out = {}
+        for n, v in cols.items():
+            if isinstance(v, Coded):
+                out[n] = np.asarray(v.vocab, dtype=object)[v.codes]
+            elif isinstance(v, list):
+                out[n] = np.asarray(v, dtype=object)
+            elif n in DEC:
+                out[n] = np.asarray(v, dtype=np.int64) / 100.0
+            else:
+                out[n] = v
+        dfs[t] = pd.DataFrame(out)
+    return d, dfs
+
+
+def _day(s):
+    return (np.datetime64(s) - np.datetime64("1970-01-01")).astype(int)
+
+
+def test_q2_min_cost_supplier(env):
+    d, f = env
+    r = d.sql("""select s_acctbal, s_name, n_name, p_partkey, p_mfgr
+      from part, supplier, partsupp, nation, region
+      where p_partkey = ps_partkey and s_suppkey = ps_suppkey and p_size = 15
+        and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+        and r_name = 'EUROPE'
+        and ps_supplycost = (
+          select min(ps_supplycost) from partsupp, supplier, nation, region
+          where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+            and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+            and r_name = 'EUROPE')
+      order by s_acctbal desc, n_name, s_name, p_partkey limit 10""")
+    eu = f["nation"].merge(f["region"], left_on="n_regionkey",
+                           right_on="r_regionkey")
+    eu = eu[eu.r_name == "EUROPE"]
+    sup = f["supplier"].merge(eu, left_on="s_nationkey",
+                              right_on="n_nationkey")
+    ps = f["partsupp"].merge(sup, left_on="ps_suppkey", right_on="s_suppkey")
+    mc = ps.groupby("ps_partkey")["ps_supplycost"].min().rename("minc")
+    j = ps.merge(mc, left_on="ps_partkey", right_index=True)
+    j = j[j.ps_supplycost == j.minc].merge(
+        f["part"], left_on="ps_partkey", right_on="p_partkey")
+    j = j[j.p_size == 15]
+    want = j.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                         ascending=[False, True, True, True]).head(10)
+    got = r.rows()
+    assert len(got) == min(10, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert row[0] == pytest.approx(w.s_acctbal)
+        assert row[1] == w.s_name and row[3] == w.p_partkey
+
+
+def test_q7_volume_shipping(env):
+    d, f = env
+    r = d.sql("""select supp_nation, cust_nation, l_year, sum(volume) as revenue
+      from (select n1.n_name as supp_nation, n2.n_name as cust_nation,
+                   extract(year from l_shipdate) as l_year,
+                   l_extendedprice * (1 - l_discount) as volume
+            from supplier, lineitem, orders, customer, nation n1, nation n2
+            where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+              and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+              and c_nationkey = n2.n_nationkey
+              and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+                or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+              and l_shipdate between date '1995-01-01' and date '1996-12-31'
+           ) as shipping
+      group by supp_nation, cust_nation, l_year
+      order by supp_nation, cust_nation, l_year""")
+    li = f["lineitem"]
+    li = li[(li.l_shipdate >= _day("1995-01-01"))
+            & (li.l_shipdate <= _day("1996-12-31"))]
+    j = (li.merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+           .merge(f["customer"], left_on="o_custkey", right_on="c_custkey")
+           .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+           .merge(f["nation"].add_prefix("s1_"), left_on="s_nationkey",
+                  right_on="s1_n_nationkey")
+           .merge(f["nation"].add_prefix("c2_"), left_on="c_nationkey",
+                  right_on="c2_n_nationkey"))
+    j = j[((j.s1_n_name == "FRANCE") & (j.c2_n_name == "GERMANY"))
+          | ((j.s1_n_name == "GERMANY") & (j.c2_n_name == "FRANCE"))]
+    j["l_year"] = (pd.to_datetime(j.l_shipdate, unit="D")).dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (j.groupby(["s1_n_name", "c2_n_name", "l_year"])["volume"].sum()
+             .reset_index().sort_values(["s1_n_name", "c2_n_name", "l_year"]))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.s1_n_name, w.c2_n_name, w.l_year)
+        assert float(row[3]) == pytest.approx(w.volume, rel=1e-9)
+
+
+def test_q9_product_type_profit(env):
+    d, f = env
+    r = d.sql("""select nation, o_year, sum(amount) as sum_profit
+      from (select n_name as nation, extract(year from o_orderdate) as o_year,
+                   l_extendedprice * (1 - l_discount)
+                     - ps_supplycost * l_quantity as amount
+            from part, supplier, lineitem, partsupp, orders, nation
+            where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+              and ps_partkey = l_partkey and p_partkey = l_partkey
+              and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+              and p_name like '%name 1%') as profit
+      group by nation, o_year order by nation, o_year desc""")
+    part = f["part"][f["part"].p_name.str.contains("name 1")]
+    j = (f["lineitem"].merge(part, left_on="l_partkey", right_on="p_partkey")
+         .merge(f["partsupp"], left_on=["l_partkey", "l_suppkey"],
+                right_on=["ps_partkey", "ps_suppkey"])
+         .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    j["o_year"] = pd.to_datetime(j.o_orderdate, unit="D").dt.year
+    j["amount"] = (j.l_extendedprice * (1 - j.l_discount)
+                   - j.ps_supplycost * j.l_quantity)
+    want = (j.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+             .sort_values(["n_name", "o_year"], ascending=[True, False]))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1]) == (w.n_name, w.o_year)
+        assert float(row[2]) == pytest.approx(w.amount, rel=1e-9)
+
+
+def test_q11_important_stock(env):
+    d, f = env
+    r = d.sql("""select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+      from partsupp, supplier, nation
+      where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+        and n_name = 'GERMANY'
+      group by ps_partkey
+      having sum(ps_supplycost * ps_availqty) > (
+        select sum(ps_supplycost * ps_availqty) * 0.0001
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+          and n_name = 'GERMANY')
+      order by value desc, ps_partkey limit 20""")
+    de = f["supplier"].merge(f["nation"], left_on="s_nationkey",
+                             right_on="n_nationkey")
+    de = de[de.n_name == "GERMANY"]
+    ps = f["partsupp"].merge(de, left_on="ps_suppkey", right_on="s_suppkey")
+    ps["value"] = ps.ps_supplycost * ps.ps_availqty
+    g = ps.groupby("ps_partkey")["value"].sum()
+    thresh = ps["value"].sum() * 0.0001
+    want = (g[g > thresh].reset_index()
+             .sort_values(["value", "ps_partkey"], ascending=[False, True])
+             .head(20))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert row[0] == w.ps_partkey
+        assert float(row[1]) == pytest.approx(w.value, rel=1e-9)
+
+
+def test_q15_top_supplier_cte(env):
+    d, f = env
+    r = d.sql("""with revenue as (
+        select l_suppkey as supplier_no,
+               sum(l_extendedprice * (1 - l_discount)) as total_revenue
+        from lineitem
+        where l_shipdate >= date '1996-01-01'
+          and l_shipdate < date '1996-04-01'
+        group by l_suppkey)
+      select s_suppkey, s_name, total_revenue from supplier, revenue
+      where s_suppkey = supplier_no
+        and total_revenue = (select max(total_revenue) from revenue)
+      order by s_suppkey""")
+    li = f["lineitem"]
+    li = li[(li.l_shipdate >= _day("1996-01-01"))
+            & (li.l_shipdate < _day("1996-04-01"))]
+    li = li.assign(rev=li.l_extendedprice * (1 - li.l_discount))
+    g = li.groupby("l_suppkey")["rev"].sum()
+    top = g[g == g.max()]
+    got = r.rows()
+    assert len(got) == len(top)
+    for row, (sk, rev) in zip(got, sorted(top.items())):
+        assert row[0] == sk
+        assert float(row[2]) == pytest.approx(rev, rel=1e-9)
+
+
+def test_q16_supplier_count_distinct(env):
+    d, f = env
+    r = d.sql("""select p_brand, p_size, count(distinct ps_suppkey) as cnt
+      from partsupp, part
+      where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+        and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+      group by p_brand, p_size
+      order by cnt desc, p_brand, p_size limit 15""")
+    j = f["partsupp"].merge(f["part"], left_on="ps_partkey",
+                            right_on="p_partkey")
+    j = j[(j.p_brand != "Brand#45")
+          & j.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    want = (j.groupby(["p_brand", "p_size"])["ps_suppkey"].nunique()
+             .reset_index(name="cnt")
+             .sort_values(["cnt", "p_brand", "p_size"],
+                          ascending=[False, True, True]).head(15))
+    got = r.rows()
+    assert len(got) == min(15, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.p_brand, w.p_size, w.cnt)
+
+
+def test_q18_large_volume_customer(env):
+    d, f = env
+    r = d.sql("""select c_name, c_custkey, o_orderkey, o_totalprice,
+             sum(l_quantity)
+      from customer, orders, lineitem
+      where o_orderkey in (select l_orderkey from lineitem
+                           group by l_orderkey having sum(l_quantity) > 150)
+        and c_custkey = o_custkey and o_orderkey = l_orderkey
+      group by c_name, c_custkey, o_orderkey, o_totalprice
+      order by o_totalprice desc, o_orderkey limit 10""")
+    li = f["lineitem"]
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    big = big[big > 150]
+    j = (li[li.l_orderkey.isin(big.index)]
+         .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(f["customer"], left_on="o_custkey", right_on="c_custkey"))
+    want = (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_totalprice"])
+             ["l_quantity"].sum().reset_index()
+             .sort_values(["o_totalprice", "o_orderkey"],
+                          ascending=[False, True]).head(10))
+    got = r.rows()
+    assert len(got) == min(10, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[1], row[2]) == (w.c_custkey, w.o_orderkey)
+        assert float(row[4]) == pytest.approx(w.l_quantity, rel=1e-9)
+
+
+def test_q20_potential_part_promotion(env):
+    d, f = env
+    r = d.sql("""select s_name, s_address from supplier, nation
+      where s_suppkey in (
+        select ps_suppkey from partsupp
+        where ps_partkey in (select p_partkey from part
+                             where p_name like 'part name 1%')
+          and ps_availqty > (select 0.5 * sum(l_quantity) from lineitem
+              where l_partkey = ps_partkey and l_suppkey = ps_suppkey))
+        and s_nationkey = n_nationkey and n_name = 'CANADA'
+      order by s_name""")
+    parts = f["part"][f["part"].p_name.str.startswith("part name 1")]
+    ps = f["partsupp"][f["partsupp"].ps_partkey.isin(parts.p_partkey)]
+    liq = (f["lineitem"].groupby(["l_partkey", "l_suppkey"])
+           ["l_quantity"].sum())
+    ps = ps.merge(liq.reset_index(name="q"), how="left",
+                  left_on=["ps_partkey", "ps_suppkey"],
+                  right_on=["l_partkey", "l_suppkey"])
+    # NULL comparison: suppliers with no lineitem sales never qualify
+    ps = ps[ps.q.notna() & (ps.ps_availqty > 0.5 * ps.q)]
+    sup = f["supplier"].merge(f["nation"], left_on="s_nationkey",
+                              right_on="n_nationkey")
+    sup = sup[sup.n_name == "CANADA"]
+    want = (sup[sup.s_suppkey.isin(ps.ps_suppkey)]
+            .sort_values("s_name"))
+    got = r.rows()
+    assert [x[0] for x in got] == list(want.s_name)
+
+
+def test_q21_suppliers_who_kept_orders_waiting(env):
+    d, f = env
+    r = d.sql("""select s_name, count(*) as numwait
+      from supplier, lineitem l1, orders, nation
+      where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+        and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+        and exists (select 1 from lineitem l2
+                    where l2.l_orderkey = l1.l_orderkey
+                      and l2.l_suppkey <> l1.l_suppkey)
+        and not exists (select 1 from lineitem l3
+                        where l3.l_orderkey = l1.l_orderkey
+                          and l3.l_suppkey <> l1.l_suppkey
+                          and l3.l_receiptdate > l3.l_commitdate)
+        and s_nationkey = n_nationkey
+      group by s_name order by numwait desc, s_name limit 10""")
+    li = f["lineitem"]
+    late = li[li.l_receiptdate > li.l_commitdate]
+    # per l1 row: another supplier on the order exists / is late
+    per_order = li.groupby("l_orderkey")["l_suppkey"].agg(["nunique"])
+    late_per = late.groupby("l_orderkey")["l_suppkey"].agg(
+        lambda s: set(s))
+    j = (late.merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey"))
+    j = j[j.o_orderstatus == "F"]
+
+    def qualifies(row):
+        order = row.l_orderkey
+        others = set(li[li.l_orderkey == order].l_suppkey) - {row.l_suppkey}
+        if not others:
+            return False
+        late_others = late_per.get(order, set()) - {row.l_suppkey}
+        return len(late_others) == 0
+
+    j = j[j.apply(qualifies, axis=1)]
+    j = (j.merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+          .merge(f["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    want = (j.groupby("s_name").size().reset_index(name="numwait")
+             .sort_values(["numwait", "s_name"], ascending=[False, True])
+             .head(10))
+    got = r.rows()
+    assert len(got) == min(10, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1]) == (w.s_name, w.numwait)
+
+
+def test_q22_global_sales_opportunity(env):
+    d, f = env
+    # phone vocab here is synthetic ('phone N'); country code = a numeric
+    # prefix of the payload, so group on substring(8 for 1)
+    r = d.sql("""select cntrycode, count(*) as numcust,
+                        sum(c_acctbal) as totacctbal
+      from (select substring(c_phone from 7 for 1) as cntrycode, c_acctbal
+            from customer
+            where substring(c_phone from 7 for 1) in ('1','2','3')
+              and c_acctbal > (select avg(c_acctbal) from customer
+                               where c_acctbal > 0.00)
+              and not exists (select 1 from orders
+                              where o_custkey = c_custkey)) as custsale
+      group by cntrycode order by cntrycode""")
+    c = f["customer"].copy()
+    c["code"] = c.c_phone.str[6:7]
+    avg = c[c.c_acctbal > 0].c_acctbal.mean()
+    cand = c[c.code.isin(["1", "2", "3"]) & (c.c_acctbal > avg)]
+    cand = cand[~cand.c_custkey.isin(f["orders"].o_custkey)]
+    want = (cand.groupby("code")
+            .agg(numcust=("c_custkey", "size"), tot=("c_acctbal", "sum"))
+            .reset_index().sort_values("code"))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1]) == (w.code, w.numcust)
+        assert float(row[2]) == pytest.approx(w.tot, rel=1e-9)
+
+
+def test_q8_market_share(env):
+    d, f = env
+    r = d.sql("""select o_year,
+             sum(case when nation = 'BRAZIL' then volume else 0 end)
+               / sum(volume) as mkt_share
+      from (select extract(year from o_orderdate) as o_year,
+                   l_extendedprice * (1 - l_discount) as volume,
+                   n2.n_name as nation
+            from part, supplier, lineitem, orders, customer,
+                 nation n1, nation n2, region
+            where p_partkey = l_partkey and s_suppkey = l_suppkey
+              and l_orderkey = o_orderkey and o_custkey = c_custkey
+              and c_nationkey = n1.n_nationkey
+              and n1.n_regionkey = r_regionkey and r_name = 'AMERICA'
+              and s_nationkey = n2.n_nationkey
+              and o_orderdate between date '1995-01-01'
+                                  and date '1996-12-31') as all_nations
+      group by o_year order by o_year""")
+    am = f["nation"].merge(f["region"], left_on="n_regionkey",
+                           right_on="r_regionkey")
+    am = am[am.r_name == "AMERICA"]
+    j = (f["lineitem"]
+         .merge(f["part"], left_on="l_partkey", right_on="p_partkey")
+         .merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(f["customer"], left_on="o_custkey", right_on="c_custkey")
+         .merge(f["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(f["nation"].add_prefix("s2_"), left_on="s_nationkey",
+                right_on="s2_n_nationkey"))
+    j = j[j.c_nationkey.isin(am.n_nationkey)]
+    j = j[(j.o_orderdate >= _day("1995-01-01"))
+          & (j.o_orderdate <= _day("1996-12-31"))]
+    j["o_year"] = pd.to_datetime(j.o_orderdate, unit="D").dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["bra"] = np.where(j.s2_n_name == "BRAZIL", j.volume, 0.0)
+    want = (j.groupby("o_year").agg(bra=("bra", "sum"), v=("volume", "sum"))
+             .reset_index().sort_values("o_year"))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert row[0] == w.o_year
+        assert float(row[1]) == pytest.approx(w.bra / w.v, abs=1e-6)
