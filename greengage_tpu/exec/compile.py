@@ -741,12 +741,21 @@ class Compiler:
         M = self._join_table_size(build_cap)
         if plan.kind in ("semi", "anti"):
             # output is probe-shaped (_capacity_of); the pair EXPANSION
-            # needs its own capacity, sized by the exact-total retry hint
+            # needs its own capacity: the exact-total retry hint, else the
+            # planner's stats-driven pair estimate (|L||R|/NDV), else a
+            # blind multiple of the probe capacity
             probe_cap0 = self._capacity_of(plan.left)
             if self._nid(plan) in self.cap_overrides:
                 out_cap = max(int(self.cap_overrides[self._nid(plan)]), 64)
             else:
-                out_cap = probe_cap0 * 2 + 64
+                est = getattr(plan, "expand_est", None)
+                if est:
+                    if plan.locus is not None and plan.locus.is_partitioned \
+                            and self.nseg > 1:
+                        est /= self.nseg
+                    out_cap = int(est * 1.5) + 64
+                else:
+                    out_cap = probe_cap0 * 2 + 64
             out_cap = int(out_cap * (4 ** self.tier))
         else:
             out_cap = self._capacity_of(plan)

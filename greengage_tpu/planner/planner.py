@@ -348,8 +348,15 @@ class Planner:
                 node.est_rows = max(node.est_rows, left.est_rows * 2.0)
         elif node.kind in ("semi", "anti") and node.residual is not None:
             # residual EXISTS correlation must test EVERY duplicate build
-            # row (any-match): route through the CSR expansion
+            # row (any-match): route through the CSR expansion. Stash the
+            # PAIR estimate (|L||R|/max key NDV) so the compiler sizes the
+            # expansion from stats instead of overflowing the first run
             node.multi = True
+            if key_ndvs:
+                pairs = left.est_rows * right.est_rows
+                for nl, nr in key_ndvs:
+                    pairs /= max(max(nl, nr), 1.0)
+                node.expand_est = pairs
         # build-side key bounds for the packed/narrowed hash table
         # (ops/join.py pack_join_keys): probe values outside the build's
         # bounds simply never match, so only the BUILD side's stats matter

@@ -352,8 +352,10 @@ class Binder:
             if residuals:
                 # mixed-reference non-equality conjuncts (l2.x <> l1.x):
                 # evaluated per candidate pair over the CSR expansion —
-                # a probe row qualifies iff ANY pair passes (Q21 shape)
-                both = scope.merged(sub_scope)
+                # a probe row qualifies iff ANY pair passes (Q21 shape).
+                # SUB scope first: an alias shadowed by the subquery must
+                # resolve to the INNER table (SQL innermost-wins scoping)
+                both = sub_scope.merged(scope)
                 res_pred = self._predicate(_join_and(residuals), both)
             joined = Join(kind, plan, subplan, lks, rks, residual=res_pred)
         else:

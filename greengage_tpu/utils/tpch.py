@@ -224,6 +224,7 @@ def to_pandas(data: dict[str, dict], decimals_as_float: bool = True):
     scales = {
         "l_quantity": 2, "l_extendedprice": 2, "l_discount": 2, "l_tax": 2,
         "o_totalprice": 2, "c_acctbal": 2, "s_acctbal": 2, "p_retailprice": 2,
+        "ps_supplycost": 2,
     }
     out = {}
     for t, cols in data.items():

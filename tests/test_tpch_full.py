@@ -10,13 +10,9 @@ import pandas as pd
 import pytest
 
 import greengage_tpu
-from greengage_tpu.types import Coded
 from greengage_tpu.utils import tpch
 
 SF = 0.02
-DEC = {"s_acctbal", "c_acctbal", "o_totalprice", "l_quantity",
-       "l_extendedprice", "l_discount", "l_tax", "p_retailprice",
-       "ps_supplycost"}
 
 
 @pytest.fixture(scope="module")
@@ -24,20 +20,7 @@ def env(devices8):
     d = greengage_tpu.connect(numsegments=4)
     tpch.load(d, SF)
     d.sql("analyze")
-    data = tpch.generate(SF)
-    dfs = {}
-    for t, cols in data.items():
-        out = {}
-        for n, v in cols.items():
-            if isinstance(v, Coded):
-                out[n] = np.asarray(v.vocab, dtype=object)[v.codes]
-            elif isinstance(v, list):
-                out[n] = np.asarray(v, dtype=object)
-            elif n in DEC:
-                out[n] = np.asarray(v, dtype=np.int64) / 100.0
-            else:
-                out[n] = v
-        dfs[t] = pd.DataFrame(out)
+    dfs = tpch.to_pandas(tpch.generate(SF))
     return d, dfs
 
 
@@ -299,16 +282,15 @@ def test_q21_suppliers_who_kept_orders_waiting(env):
       group by s_name order by numwait desc, s_name limit 10""")
     li = f["lineitem"]
     late = li[li.l_receiptdate > li.l_commitdate]
-    # per l1 row: another supplier on the order exists / is late
-    per_order = li.groupby("l_orderkey")["l_suppkey"].agg(["nunique"])
-    late_per = late.groupby("l_orderkey")["l_suppkey"].agg(
-        lambda s: set(s))
+    # per l1 row: another supplier on the order exists / none is late
+    all_per = li.groupby("l_orderkey")["l_suppkey"].agg(set)
+    late_per = late.groupby("l_orderkey")["l_suppkey"].agg(set)
     j = (late.merge(f["orders"], left_on="l_orderkey", right_on="o_orderkey"))
     j = j[j.o_orderstatus == "F"]
 
     def qualifies(row):
         order = row.l_orderkey
-        others = set(li[li.l_orderkey == order].l_suppkey) - {row.l_suppkey}
+        others = all_per.get(order, set()) - {row.l_suppkey}
         if not others:
             return False
         late_others = late_per.get(order, set()) - {row.l_suppkey}
