@@ -1,0 +1,173 @@
+"""Star Schema Benchmark (SSB) query flights vs pandas oracles — the
+BASELINE.json "SSB wide GROUP BY + ORDER BY" config at test scale:
+lineorder fact + date/customer/supplier/part dimensions, one query per
+flight (Q1.1 filtered scan-agg, Q2.1 two-dim star join group-by, Q3.1
+three-dim group-by, Q4.1 profit roll-up)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.types import Coded
+
+
+N_LO = 120_000
+N_CUST, N_SUPP, N_PART = 2000, 400, 1500
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+MFGRS = [f"MFGR#{i}" for i in range(1, 6)]
+
+
+def _gen(rng):
+    years = rng.integers(1992, 1999, N_LO)
+    d = {
+        "lineorder": {
+            "lo_orderkey": np.arange(N_LO, dtype=np.int64),
+            "lo_custkey": rng.integers(0, N_CUST, N_LO),
+            "lo_suppkey": rng.integers(0, N_SUPP, N_LO),
+            "lo_partkey": rng.integers(0, N_PART, N_LO),
+            "lo_orderyear": years.astype(np.int32),
+            "lo_quantity": rng.integers(1, 51, N_LO),
+            "lo_extendedprice": rng.integers(100, 10_000, N_LO).astype(np.int64),
+            "lo_discount": rng.integers(0, 11, N_LO),
+            "lo_revenue": rng.integers(100, 10_000, N_LO).astype(np.int64),
+            "lo_supplycost": rng.integers(50, 5000, N_LO).astype(np.int64),
+        },
+        "customer": {
+            "c_custkey": np.arange(N_CUST, dtype=np.int64),
+            "c_region": Coded(REGIONS,
+                              rng.integers(0, 5, N_CUST).astype(np.int32)),
+            "c_nation": Coded([f"NATION{i}" for i in range(25)],
+                              rng.integers(0, 25, N_CUST).astype(np.int32)),
+        },
+        "supplier": {
+            "s_suppkey": np.arange(N_SUPP, dtype=np.int64),
+            "s_region": Coded(REGIONS,
+                              rng.integers(0, 5, N_SUPP).astype(np.int32)),
+            "s_nation": Coded([f"NATION{i}" for i in range(25)],
+                              rng.integers(0, 25, N_SUPP).astype(np.int32)),
+        },
+        "part": {
+            "p_partkey": np.arange(N_PART, dtype=np.int64),
+            "p_mfgr": Coded(MFGRS,
+                            rng.integers(0, 5, N_PART).astype(np.int32)),
+            "p_category": Coded([f"MFGR#{i}{j}" for i in range(1, 6)
+                                 for j in range(1, 6)],
+                                rng.integers(0, 25, N_PART).astype(np.int32)),
+            "p_brand": Coded([f"MFGR#{i}" for i in range(1000)],
+                             rng.integers(0, 1000, N_PART).astype(np.int32)),
+        },
+    }
+    return d
+
+
+@pytest.fixture(scope="module")
+def env(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    rng = np.random.default_rng(41)
+    data = _gen(rng)
+    d.sql("""create table lineorder (
+        lo_orderkey bigint, lo_custkey bigint, lo_suppkey bigint,
+        lo_partkey bigint, lo_orderyear int, lo_quantity int,
+        lo_extendedprice bigint, lo_discount int, lo_revenue bigint,
+        lo_supplycost bigint) distributed by (lo_orderkey)""")
+    d.sql("create table customer (c_custkey bigint, c_region text, "
+          "c_nation text) distributed by (c_custkey)")
+    d.sql("create table supplier (s_suppkey bigint, s_region text, "
+          "s_nation text) distributed by (s_suppkey)")
+    d.sql("create table part (p_partkey bigint, p_mfgr text, "
+          "p_category text, p_brand text) distributed by (p_partkey)")
+    for t, cols in data.items():
+        d.load_table(t, cols)
+    d.sql("analyze")
+    dfs = {}
+    for t, cols in data.items():
+        dfs[t] = pd.DataFrame({n: (v.decode() if isinstance(v, Coded) else v)
+                               for n, v in cols.items()})
+    return d, dfs
+
+
+def test_ssb_q1_1(env):
+    d, f = env
+    r = d.sql("""select sum(lo_extendedprice * lo_discount) as revenue
+      from lineorder
+      where lo_orderyear = 1993 and lo_discount between 1 and 3
+        and lo_quantity < 25""")
+    lo = f["lineorder"]
+    m = ((lo.lo_orderyear == 1993) & (lo.lo_discount >= 1)
+         & (lo.lo_discount <= 3) & (lo.lo_quantity < 25))
+    assert r.rows()[0][0] == (lo.lo_extendedprice[m] * lo.lo_discount[m]).sum()
+
+
+def test_ssb_q2_1(env):
+    d, f = env
+    r = d.sql("""select sum(lo_revenue), lo_orderyear, p_category
+      from lineorder, part, supplier
+      where lo_partkey = p_partkey and lo_suppkey = s_suppkey
+        and p_mfgr = 'MFGR#1' and s_region = 'AMERICA'
+      group by lo_orderyear, p_category
+      order by lo_orderyear, p_category""")
+    j = (f["lineorder"]
+         .merge(f["part"], left_on="lo_partkey", right_on="p_partkey")
+         .merge(f["supplier"], left_on="lo_suppkey", right_on="s_suppkey"))
+    j = j[(j.p_mfgr == "MFGR#1") & (j.s_region == "AMERICA")]
+    want = (j.groupby(["lo_orderyear", "p_category"])["lo_revenue"].sum()
+             .reset_index().sort_values(["lo_orderyear", "p_category"]))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[1], row[2], row[0]) == (w.lo_orderyear, w.p_category,
+                                            w.lo_revenue)
+
+
+def test_ssb_q3_1(env):
+    d, f = env
+    r = d.sql("""select c_nation, s_nation, lo_orderyear,
+             sum(lo_revenue) as revenue
+      from customer, lineorder, supplier
+      where lo_custkey = c_custkey and lo_suppkey = s_suppkey
+        and c_region = 'ASIA' and s_region = 'ASIA'
+        and lo_orderyear >= 1992 and lo_orderyear <= 1997
+      group by c_nation, s_nation, lo_orderyear
+      order by lo_orderyear, revenue desc, c_nation, s_nation limit 20""")
+    j = (f["lineorder"]
+         .merge(f["customer"], left_on="lo_custkey", right_on="c_custkey")
+         .merge(f["supplier"], left_on="lo_suppkey", right_on="s_suppkey"))
+    j = j[(j.c_region == "ASIA") & (j.s_region == "ASIA")
+          & (j.lo_orderyear >= 1992) & (j.lo_orderyear <= 1997)]
+    want = (j.groupby(["c_nation", "s_nation", "lo_orderyear"])
+             ["lo_revenue"].sum().reset_index(name="revenue")
+             .sort_values(["lo_orderyear", "revenue", "c_nation", "s_nation"],
+                          ascending=[True, False, True, True]).head(20))
+    got = r.rows()
+    assert len(got) == min(20, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2], row[3]) == \
+            (w.c_nation, w.s_nation, w.lo_orderyear, w.revenue)
+
+
+def test_ssb_q4_1(env):
+    d, f = env
+    r = d.sql("""select lo_orderyear, c_nation,
+             sum(lo_revenue - lo_supplycost) as profit
+      from customer, supplier, part, lineorder
+      where lo_custkey = c_custkey and lo_suppkey = s_suppkey
+        and lo_partkey = p_partkey and c_region = 'AMERICA'
+        and s_region = 'AMERICA'
+        and (p_mfgr = 'MFGR#1' or p_mfgr = 'MFGR#2')
+      group by lo_orderyear, c_nation
+      order by lo_orderyear, c_nation""")
+    j = (f["lineorder"]
+         .merge(f["customer"], left_on="lo_custkey", right_on="c_custkey")
+         .merge(f["supplier"], left_on="lo_suppkey", right_on="s_suppkey")
+         .merge(f["part"], left_on="lo_partkey", right_on="p_partkey"))
+    j = j[(j.c_region == "AMERICA") & (j.s_region == "AMERICA")
+          & j.p_mfgr.isin(["MFGR#1", "MFGR#2"])]
+    j["profit"] = j.lo_revenue - j.lo_supplycost
+    want = (j.groupby(["lo_orderyear", "c_nation"])["profit"].sum()
+             .reset_index().sort_values(["lo_orderyear", "c_nation"]))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.lo_orderyear, w.c_nation,
+                                            w.profit)
