@@ -1197,6 +1197,8 @@ class Compiler:
         cap = self._capacity_of(plan.child)
         wfuncs = plan.wfuncs
         nseg = self.nseg
+        if plan.global_mode == "ordered":
+            return self._c_window_global_ordered(plan, child_fn, cap)
 
         def run(ctx):
             from jax import lax
@@ -1261,6 +1263,69 @@ class Compiler:
                 c = lax.psum(jnp.sum(lv.astype(jnp.int64)), SEG_AXIS)
                 out_c[ci.id] = jnp.broadcast_to(glob, (cap,))
                 out_v[ci.id] = jnp.broadcast_to(c > 0, (cap,))
+            return Batch(out_c, out_v, sel)
+
+        return run
+
+    def _c_window_global_ordered(self, plan: Window, child_fn, cap: int):
+        """Distributed GLOBAL ranking over one NOT-NULL integer/date key:
+        each row's rank = (# rows with smaller key anywhere) computed IN
+        PLACE — per segment, encode the key order-preservingly into
+        uint64 (sign-bit flip; DESC complements; no stats bounds, so no
+        violation path exists), locally sort, all_gather the sorted runs
+        [nseg, cap] + live counts, and per row sum searchsorted counts
+        across segments. row_number() breaks ties deterministically by
+        (segment, local sorted position). ~8B x rows of gathered keys vs
+        moving every row AND its payload to one chip."""
+        wfuncs = plan.wfuncs
+        nseg = self.nseg
+        e, desc, _nf = plan.order_keys[0]
+
+        def run(ctx):
+            from jax import lax
+
+            b = child_fn(ctx)
+            sel = b.selection()
+            v, valid = Evaluator(b, self.consts).value(e)
+            enc = (v.astype(jnp.int64).astype(jnp.uint64)
+                   ^ (jnp.uint64(1) << jnp.uint64(63)))
+            if desc:
+                enc = ~enc
+            dead = ~sel if valid is None else ~(sel & valid)
+            # dead rows park at the top of the sorted run (dead flag is
+            # the primary sort key) and their counted contributions are
+            # clamped away by the live counts below
+            enc_d = jnp.where(dead, jnp.uint64(0xFFFFFFFFFFFFFFFF), enc)
+            rid = jnp.arange(cap, dtype=jnp.int32)
+            _d, sorted_enc, sorted_rid = lax.sort(
+                (dead.astype(jnp.uint8), enc_d, rid), num_keys=2,
+                is_stable=True)
+            live_n = jnp.sum((~dead).astype(jnp.int64))
+            g_sorted = lax.all_gather(sorted_enc, SEG_AXIS)   # [nseg, cap]
+            g_live = lax.all_gather(live_n, SEG_AXIS)         # [nseg]
+            left = jax.vmap(
+                lambda a: jnp.searchsorted(a, enc_d, side="left"))(g_sorted)
+            right = jax.vmap(
+                lambda a: jnp.searchsorted(a, enc_d, side="right"))(g_sorted)
+            left = jnp.minimum(left, g_live[:, None])
+            right = jnp.minimum(right, g_live[:, None])
+            less_g = jnp.sum(left, axis=0)
+            seg = lax.axis_index(SEG_AXIS)
+            prior = jnp.arange(nseg)[:, None] < seg
+            eq_prior = jnp.sum(jnp.where(prior, right - left, 0), axis=0)
+            # local tie position (stable by original row order)
+            pos = jnp.zeros((cap,), jnp.int32).at[sorted_rid].set(rid)
+            first_eq = jnp.minimum(
+                jnp.searchsorted(sorted_enc, enc_d, side="left"), live_n)
+            local_eq_before = pos.astype(jnp.int64) - first_eq
+            out_c = dict(b.cols)
+            out_v = dict(b.valids)
+            for ci, fname, _arg, _ordered, _param in wfuncs:
+                if fname == "row_number":
+                    out_c[ci.id] = less_g + eq_prior + local_eq_before + 1
+                else:   # rank
+                    out_c[ci.id] = less_g + 1
+                out_v.pop(ci.id, None)
             return Batch(out_c, out_v, sel)
 
         return run

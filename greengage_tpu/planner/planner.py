@@ -606,6 +606,7 @@ class Planner:
         key_ids = tuple(e.name for e in node.partition_keys
                         if isinstance(e, E.ColRef))
         GLOBAL_DIST = {"row_number", "count", "sum", "avg", "min", "max"}
+        ORDERED_GLOBAL = {"row_number", "rank"}
         if not node.partition_keys:
             if (not node.order_keys and node.frame is None
                     and child.locus.is_partitioned
@@ -618,6 +619,24 @@ class Planner:
                 node.locus = child.locus
                 node.est_rows = child.est_rows
                 return node
+            if (node.order_keys and node.frame is None
+                    and len(node.order_keys) == 1
+                    and child.locus.is_partitioned
+                    and all(f[1] in ORDERED_GLOBAL for f in node.wfuncs)):
+                # ordered global ranking over ONE integer/date key with no
+                # NULLs: the 64-bit order-preserving encoding needs no
+                # stats bounds (it can never "violate"), so each row's
+                # global rank is computable IN PLACE from all-gathered
+                # per-segment sorted key runs — no funnel, no row motion
+                e, _desc, _nf = node.order_keys[0]
+                if isinstance(e, E.ColRef) and e.type.kind in (
+                        T.Kind.INT32, T.Kind.INT64, T.Kind.DATE):
+                    org = _origin(child, e.name)
+                    if org is not None and not self.store.has_nulls(*org):
+                        node.global_mode = "ordered"
+                        node.locus = child.locus
+                        node.est_rows = child.est_rows
+                        return node
             # ordered / exotic global window: all rows to a single segment
             if child.locus.is_partitioned:
                 const = E.Literal(0, T.INT64)

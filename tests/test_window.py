@@ -280,3 +280,56 @@ def test_global_ordered_window_still_exact(wdb):
                 "from serie order by g, t")
     rows = r.rows()
     assert [x[2] for x in rows] == list(range(1, len(rows) + 1))
+
+
+def test_global_ordered_row_number_distributed(wdb):
+    """row_number()/rank() over (order by k) on an int key with no NULLs
+    computes IN PLACE (all-gathered sorted key runs), no one-chip funnel."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    q = ("select g, t, v, row_number() over (order by v) as rn, "
+         "rank() over (order by v) as rk from serie")
+    planned, _, _ = wdb._plan(parse(q)[0])
+    txt = describe(planned)
+    assert "SingleQE" not in txt, txt
+    r = wdb.sql(q)
+    rows = sorted(r.rows(), key=lambda x: x[3])
+    # row_number is a dense 1..N permutation consistent with v-order
+    assert [x[3] for x in rows] == list(range(1, len(rows) + 1))
+    vs = [x[2] for x in rows]
+    assert vs == sorted(vs)
+    # rank: 1 + count of strictly smaller values (ties share rank)
+    import collections
+    cnt = collections.Counter(x[2] for x in rows)
+    smaller = {}
+    acc = 0
+    for val in sorted(cnt):
+        smaller[val] = acc
+        acc += cnt[val]
+    for _, _, v, rn, rk in rows:
+        assert rk == smaller[v] + 1
+
+
+def test_global_ordered_row_number_desc(wdb):
+    q = "select v, row_number() over (order by v desc) as rn from serie"
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" not in describe(planned)
+    rows = sorted(wdb.sql(q).rows(), key=lambda x: x[1])
+    assert [x[1] for x in rows] == list(range(1, len(rows) + 1))
+    vs = [x[0] for x in rows]
+    assert vs == sorted(vs, reverse=True)
+
+
+def test_global_ordered_rank_matches_funnel(wdb):
+    # the distributed result must equal the single-segment path's result
+    # (force the funnel via a float order key... use an expression key,
+    # which stays on the funnel path)
+    dist = sorted(wdb.sql(
+        "select t, rank() over (order by v) as rk from serie").rows())
+    funneled = sorted(wdb.sql(
+        "select t, rank() over (order by v + 0) as rk from serie").rows())
+    assert dist == funneled
