@@ -632,7 +632,13 @@ class Planner:
                 if isinstance(e, E.ColRef) and e.type.kind in (
                         T.Kind.INT32, T.Kind.INT64, T.Kind.DATE):
                     org = _origin(child, e.name)
-                    if org is not None and not self.store.has_nulls(*org):
+                    # base storage must be NULL-free AND the path must not
+                    # null-EXTEND the column (a left join's build side
+                    # manufactures NULL keys the in-place encoding cannot
+                    # order; those shapes keep the funnel, whose sort
+                    # handles NULL placement correctly)
+                    if (org is not None and not self.store.has_nulls(*org)
+                            and not _null_extended(child, e.name)):
                         node.global_mode = "ordered"
                         node.locus = child.locus
                         node.est_rows = child.est_rows
@@ -713,6 +719,29 @@ class Planner:
         m.locus = Locus.entry()
         m.est_rows = child.est_rows
         return m
+
+
+def _null_extended(plan: Plan, col_id: str) -> bool:
+    """Can ``col_id`` carry NULLs INTRODUCED on the path (outer-join
+    null-extension), even though its base storage is NULL-free?
+    Conservative: unknown shapes answer True."""
+    if isinstance(plan, Scan):
+        return False
+    if isinstance(plan, Join):
+        if any(c.id == col_id for c in plan.left.out_cols()):
+            return _null_extended(plan.left, col_id)
+        # the right (build) side of a LEFT join null-extends its columns
+        return plan.kind == "left" or _null_extended(plan.right, col_id)
+    if isinstance(plan, (Filter, Motion, Limit, Sort, Window)):
+        return _null_extended(plan.children[0], col_id)
+    if isinstance(plan, Project):
+        for c, e in plan.exprs:
+            if c.id == col_id:
+                if isinstance(e, E.ColRef):
+                    return _null_extended(plan.child, e.name)
+                return True
+        return True
+    return True
 
 
 def _find_single_scan(plan: Plan, table: str):

@@ -333,3 +333,24 @@ def test_global_ordered_rank_matches_funnel(wdb):
     funneled = sorted(wdb.sql(
         "select t, rank() over (order by v + 0) as rk from serie").rows())
     assert dist == funneled
+
+
+def test_left_join_null_extended_key_keeps_funnel(wdb):
+    """NULL keys manufactured by a left join defeat the in-place ranking
+    premise: the planner must keep the funnel (review r4), whose sort
+    places NULLs per PG defaults (last for ASC)."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    wdb.sql("create table dim5 (pk int, w int) distributed by (pk)")
+    wdb.sql("insert into dim5 values (0, 100), (1, 101)")
+    q = ("select serie.t, dim5.w, rank() over (order by dim5.w) as rk "
+         "from serie left join dim5 on serie.g = dim5.pk")
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" in describe(planned)   # funnel kept
+    rows = wdb.sql(q).rows()
+    nn = [r for r in rows if r[1] is not None]
+    nulls = [r for r in rows if r[1] is None]
+    assert nulls, "fixture must produce null-extended rows"
+    # non-null ranks: ties share; nulls rank after ALL non-nulls (ASC)
+    assert max(r[2] for r in nn) < min(r[2] for r in nulls)
