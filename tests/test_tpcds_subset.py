@@ -1,0 +1,160 @@
+"""TPC-DS star-join subset vs pandas oracles — the BASELINE.json
+"TPC-DS star-join subset (Broadcast Motion + semi-join bitmap filter)"
+config at test scale: store_sales fact with date_dim/item/store
+dimensions. Q3 (brand revenue for a manufacturer by year), Q42
+(category rollup for one month), Q52-analog (brand extended price), and
+a semi-join bitmap-filter shape (fact rows restricted by a filtered
+dimension subquery)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.types import Coded
+
+N_SS = 150_000
+N_DATE, N_ITEM, N_STORE = 2000, 1200, 30
+
+
+@pytest.fixture(scope="module")
+def env(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    rng = np.random.default_rng(77)
+    date_dim = {
+        "d_date_sk": np.arange(N_DATE, dtype=np.int64),
+        "d_year": (1998 + np.arange(N_DATE) // 365).astype(np.int32),
+        "d_moy": (1 + (np.arange(N_DATE) // 30) % 12).astype(np.int32),
+    }
+    item = {
+        "i_item_sk": np.arange(N_ITEM, dtype=np.int64),
+        "i_brand_id": rng.integers(1, 60, N_ITEM).astype(np.int32),
+        "i_category": Coded([f"Cat{i}" for i in range(10)],
+                            rng.integers(0, 10, N_ITEM).astype(np.int32)),
+        "i_manufact_id": rng.integers(1, 100, N_ITEM).astype(np.int32),
+        "i_manager_id": rng.integers(1, 40, N_ITEM).astype(np.int32),
+    }
+    store = {
+        "s_store_sk": np.arange(N_STORE, dtype=np.int64),
+        "s_state": Coded(["CA", "NY", "TX", "WA"],
+                         rng.integers(0, 4, N_STORE).astype(np.int32)),
+    }
+    ss = {
+        "ss_sold_date_sk": rng.integers(0, N_DATE, N_SS),
+        "ss_item_sk": rng.integers(0, N_ITEM, N_SS),
+        "ss_store_sk": rng.integers(0, N_STORE, N_SS),
+        "ss_quantity": rng.integers(1, 100, N_SS).astype(np.int32),
+        "ss_ext_sales_price": rng.integers(100, 100_000, N_SS).astype(np.int64),
+    }
+    d.sql("create table date_dim (d_date_sk bigint, d_year int, d_moy int) "
+          "distributed replicated")
+    d.sql("create table item (i_item_sk bigint, i_brand_id int, "
+          "i_category text, i_manufact_id int, i_manager_id int) "
+          "distributed by (i_item_sk)")
+    d.sql("create table store (s_store_sk bigint, s_state text) "
+          "distributed replicated")
+    d.sql("create table store_sales (ss_sold_date_sk bigint, "
+          "ss_item_sk bigint, ss_store_sk bigint, ss_quantity int, "
+          "ss_ext_sales_price bigint) distributed by (ss_item_sk)")
+    for t, cols in (("date_dim", date_dim), ("item", item),
+                    ("store", store), ("store_sales", ss)):
+        d.load_table(t, cols)
+    d.sql("analyze")
+    dfs = {
+        "date_dim": pd.DataFrame(date_dim),
+        "item": pd.DataFrame({k: (v.decode() if isinstance(v, Coded) else v)
+                              for k, v in item.items()}),
+        "store": pd.DataFrame({k: (v.decode() if isinstance(v, Coded) else v)
+                               for k, v in store.items()}),
+        "store_sales": pd.DataFrame(ss),
+    }
+    return d, dfs
+
+
+def test_ds_q3_brand_revenue(env):
+    d, f = env
+    r = d.sql("""select d_year, i_brand_id, sum(ss_ext_sales_price) as rev
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and i_manufact_id = 28 and d_moy = 11
+      group by d_year, i_brand_id
+      order by d_year, rev desc, i_brand_id limit 25""")
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manufact_id == 28) & (j.d_moy == 11)]
+    want = (j.groupby(["d_year", "i_brand_id"])["ss_ext_sales_price"].sum()
+             .reset_index(name="rev")
+             .sort_values(["d_year", "rev", "i_brand_id"],
+                          ascending=[True, False, True]).head(25))
+    got = r.rows()
+    assert len(got) == min(25, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.d_year, w.i_brand_id, w.rev)
+
+
+def test_ds_q42_category_rollup(env):
+    d, f = env
+    r = d.sql("""select d_year, i_category, sum(ss_ext_sales_price) as rev
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 1 and d_moy = 11 and d_year = 1999
+      group by d_year, i_category order by rev desc, i_category""")
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 1999)]
+    want = (j.groupby(["d_year", "i_category"])["ss_ext_sales_price"].sum()
+             .reset_index(name="rev")
+             .sort_values(["rev", "i_category"], ascending=[False, True]))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[1], row[2]) == (w.i_category, w.rev)
+
+
+def test_ds_semi_bitmap_filter(env):
+    d, f = env
+    # the star-join "bitmap filter" shape: fact rows restricted by a
+    # filtered dimension through IN (semi join), aggregated by store state
+    r = d.sql("""select s_state, count(*) as cnt, sum(ss_quantity) as q
+      from store_sales, store
+      where ss_store_sk = s_store_sk
+        and ss_item_sk in (select i_item_sk from item where i_brand_id < 5)
+        and ss_sold_date_sk in (select d_date_sk from date_dim
+                                where d_year = 2000)
+      group by s_state order by s_state""")
+    items = set(f["item"][f["item"].i_brand_id < 5].i_item_sk)
+    dates = set(f["date_dim"][f["date_dim"].d_year == 2000].d_date_sk)
+    j = f["store_sales"]
+    j = j[j.ss_item_sk.isin(items) & j.ss_sold_date_sk.isin(dates)]
+    j = j.merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    want = (j.groupby("s_state")
+            .agg(cnt=("ss_quantity", "size"), q=("ss_quantity", "sum"))
+            .reset_index().sort_values("s_state"))
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.s_state, w.cnt, w.q)
+
+
+def test_ds_q52_brand_by_month(env):
+    d, f = env
+    r = d.sql("""select d_year, i_brand_id, sum(ss_ext_sales_price) as p
+      from date_dim, store_sales, item
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 1 and d_moy = 12 and d_year = 1998
+      group by d_year, i_brand_id order by d_year, p desc, i_brand_id
+      limit 10""")
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 12) & (j.d_year == 1998)]
+    want = (j.groupby(["d_year", "i_brand_id"])["ss_ext_sales_price"].sum()
+             .reset_index(name="p")
+             .sort_values(["d_year", "p", "i_brand_id"],
+                          ascending=[True, False, True]).head(10))
+    got = r.rows()
+    assert len(got) == min(10, len(want))
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert (row[0], row[1], row[2]) == (w.d_year, w.i_brand_id, w.p)
