@@ -1690,6 +1690,30 @@ class Database:
                 self.dtm.abort()
             raise
 
+    def cluster_exec(self, cmd: str, timeout: float = 60.0) -> list[dict]:
+        """gpssh analog: run a shell command on every host of the cluster
+        — workers over the control channel, the coordinator locally.
+        -> [{'host': id, 'ok': bool, 'output': str}]."""
+        import subprocess
+
+        out = []
+        local = subprocess.run(cmd, shell=True, capture_output=True,
+                               timeout=timeout)
+        out.append({"host": 0, "ok": local.returncode == 0,
+                    "output": (local.stdout + local.stderr).decode(
+                        errors="replace")[-2000:]})
+        if self.multihost is not None and self.multihost.is_coordinator \
+                and not getattr(self, "_mh_degraded", None):
+            ch = self.multihost.channel
+            try:
+                ch.send({"op": "exec", "cmd": cmd, "timeout": timeout})
+                for i, a in enumerate(ch.collect_raw()):
+                    out.append({"host": i + 1, "ok": bool(a.get("ok")),
+                                "output": (a.get("error") or "")[:2000]})
+            except Exception as e:
+                out.append({"host": "?", "ok": False, "output": str(e)})
+        return out
+
     def vacuum(self, table: str | None = None) -> dict:
         """Compact deletion bitmaps away (the lazy-VACUUM role for the
         visimap analog): every table carrying a bitmap is rewritten

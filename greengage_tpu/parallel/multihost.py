@@ -133,6 +133,22 @@ class CoordinatorChannel:
             raise RuntimeError(f"worker error: {errs[0].get('error')}")
         return acks
 
+    def collect_raw(self) -> list[dict]:
+        """Collect one ack per worker WITHOUT raising on not-ok — for
+        ops whose ack 'error' slot carries payload (exec/gpssh output)."""
+        try:
+            acks = []
+            for w in self._workers:
+                line = w.readline()
+                if not line:
+                    raise WorkerDied("worker connection closed (EOF)")
+                acks.append(json.loads(line))
+            return acks
+        except (OSError, ValueError) as e:
+            raise WorkerDied(f"worker connection lost: {e}")
+        finally:
+            self._lock.release()
+
     def broadcast(self, msg: dict) -> list[dict]:
         """Send to all workers and wait for every ack."""
         self.send(msg)
@@ -206,6 +222,21 @@ def worker_loop(db) -> None:
                 # retry tiers) — applied singly, never as batch re-parse
                 db.settings.set(msg["name"], msg["value"])
                 ch.ack(True)
+            except Exception as e:
+                ch.ack(False, f"{type(e).__name__}: {e}")
+            continue
+        if msg.get("op") == "exec":
+            # gpssh role: run a shell command on every worker host over
+            # the control plane; the ack's error slot carries the output
+            import subprocess
+
+            try:
+                out = subprocess.run(
+                    msg["cmd"], shell=True, capture_output=True,
+                    timeout=float(msg.get("timeout", 60)))
+                ch.ack(out.returncode == 0,
+                       (out.stdout + out.stderr).decode(
+                           errors="replace")[-2000:])
             except Exception as e:
                 ch.ack(False, f"{type(e).__name__}: {e}")
             continue

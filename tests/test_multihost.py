@@ -71,6 +71,12 @@ r = db.sql("select g, count(*), sum(v) from f2 group by g order by g")
 out["spilled"] = [[int(x) for x in row] for row in r.rows()]
 out["spill_passes"] = int(r.stats.get("spill_passes", 0))
 db.sql("set vmem_protect_limit_mb = 12288")
+# gpssh analog: run a command on every host over the control plane
+ex = db.cluster_exec("echo host-$GGTPU_X; true")
+out["exec_hosts"] = [e["ok"] for e in ex]
+out["exec_n"] = len(ex)
+ex2 = db.cluster_exec("exit 3")
+out["exec_fail"] = [e["ok"] for e in ex2]
 mh.channel.close()
 print("RESULT:" + json.dumps(out), flush=True)
 """
@@ -139,6 +145,8 @@ def test_two_process_cluster(tmp_path):
         want_spill[i % 13] = (c + 1, s + i % 7)
     assert out["spilled"] == [[g, *want_spill[g]] for g in sorted(want_spill)]
     assert out["spill_passes"] >= 2, out["spill_passes"]
+    assert out["exec_n"] == 2 and out["exec_hosts"] == [True, True]
+    assert out["exec_fail"] == [False, False]
 
 
 # ---------------------------------------------------------------------------
