@@ -1087,6 +1087,15 @@ class Database:
                 f"{s.get('tiers_used')}, result capacity/segment: "
                 f"{s.get('below_gather_capacity')}"
                 f"\n Tables scanned: {', '.join(s.get('scan_tables', []))}")
+            if s.get("fused_kernel"):
+                text += "\n Fused dense-agg pallas kernel: yes"
+            for t, (kept, total) in (s.get("zone_prune") or {}).items():
+                text += f"\n Zone-map prune {t}: {kept}/{total} blocks"
+            for t, (kept, total) in (s.get("dynamic_prune") or {}).items():
+                text += (f"\n Dynamic partition selector {t}: "
+                         f"{kept}/{total} children staged")
+            if s.get("spill_passes"):
+                text += f"\n Spill passes: {s['spill_passes']}"
             for k, v in (s.get("metrics") or {}).items():
                 if not k.startswith("nrows_"):
                     text += f"\n {k}: {v}"

@@ -117,3 +117,10 @@ def test_left_join_where_on_nullable_side_not_sunk(db):
     want = db.sql("select count(*) from fact where pd >= 200 and pd < 300"
                   ).rows()
     assert r.rows() == want
+
+
+def test_explain_analyze_surfaces_runtime_pruning(db):
+    r = db.sql("explain analyze select count(*) from fact f, dim d "
+               "where f.pd = d.pk and d.cat = 2")
+    txt = r.plan_text
+    assert "Dynamic partition selector fact: 1/4 children staged" in txt, txt
