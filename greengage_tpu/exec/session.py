@@ -67,6 +67,24 @@ class Database:
             self.store.manifest.recover()   # in-doubt resolution on startup
             self.store.reconcile_widths()   # expansion crash recovery
         self.settings = Settings()
+        # persisted cluster GUCs (the gpconfig role): settings.json holds
+        # operator-set values every process (coordinator AND workers)
+        # adopts at connect — the per-segment-config-file parity without
+        # per-segment files, since settings steer lockstep mesh decisions
+        # and must be identical everywhere anyway
+        sp = os.path.join(path, "settings.json")
+        if os.path.exists(sp):
+            import json as _json
+
+            try:
+                with open(sp) as f:
+                    for k, v in _json.load(f).items():
+                        try:
+                            self.settings.set(k, v)
+                        except ValueError:
+                            pass   # unknown name from a newer/older build
+            except (OSError, ValueError):
+                pass
         self._mh_degraded: str | None = None
         # measured cost-model primitives, if `gg checkperf --device
         # --apply` ran against this cluster (planner/cost.set_calibration;

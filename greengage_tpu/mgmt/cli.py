@@ -42,6 +42,48 @@ def cmd_init(args):
     return 0
 
 
+def cmd_config(args):
+    """gpconfig analog: show or persist cluster-level settings
+    (settings.json, adopted by every connect on every process)."""
+    import json
+
+    sp = os.path.join(args.dir, "settings.json")
+    vals = {}
+    if os.path.exists(sp):
+        with open(sp) as f:
+            vals = json.load(f)
+    if args.change is None:
+        from greengage_tpu.config import Settings
+
+        base = Settings()
+        for k, v in vals.items():
+            try:
+                base.set(k, v)
+            except ValueError:
+                pass
+        for k in sorted(vars(base)):
+            if k.startswith("_"):
+                continue
+            mark = " (persisted)" if k in vals else ""
+            print(f"{k:<32} {getattr(base, k)}{mark}")
+        return 0
+    if args.value is None:   # --remove
+        vals.pop(args.change, None)
+        what = f"removed {args.change}"
+    else:
+        from greengage_tpu.config import Settings
+
+        Settings().set(args.change, args.value)   # validate name + coercion
+        vals[args.change] = args.value
+        what = f"{args.change} = {args.value}"
+    tmp = sp + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(vals, f, indent=1)
+    os.replace(tmp, sp)
+    print(f"config: {what} (takes effect at next connect/restart)")
+    return 0
+
+
 def cmd_initstandby(args):
     """gpinitstandby analog: seed a standby coordinator directory and
     register it for continuous post-commit sync."""
@@ -880,6 +922,12 @@ def main(argv=None):
     p.add_argument("-n", "--numsegments", type=int, default=None)
     p.add_argument("--mirrors", action="store_true")
     p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("config")   # gpconfig analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-c", "--change", default=None)
+    p.add_argument("-v", "--value", default=None)
+    p.set_defaults(fn=cmd_config)
 
     p = sub.add_parser("initstandby")   # gpinitstandby analog
     p.add_argument("-d", "--dir", required=True)

@@ -187,3 +187,31 @@ def test_start_stop_lifecycle(clu):
              "-d", clu], env=env, capture_output=True, text=True, timeout=60)
     assert "server stopped" in r.stdout
     assert not os.path.exists(os.path.join(clu, "server.pid"))
+
+
+def test_gpconfig_persisted_settings(devices8, tmp_path, capsys):
+    """gpconfig analog: persisted cluster GUCs adopted at every connect."""
+    import greengage_tpu
+    from greengage_tpu.mgmt import cli
+
+    path = str(tmp_path / "c")
+    greengage_tpu.connect(path=path, numsegments=2).close()
+    rc = cli.main(["config", "-d", path,
+                   "-c", "vmem_protect_limit_mb", "-v", "777"])
+    assert rc == 0
+    rc = cli.main(["config", "-d", path,
+                   "-c", "fused_dense_agg", "-v", "off"])
+    assert rc == 0
+    d = greengage_tpu.connect(path=path, numsegments=2)
+    assert d.settings.vmem_protect_limit_mb == 777
+    assert d.settings.fused_dense_agg is False
+    assert "777" in str(d.sql("show vmem_protect_limit_mb"))
+    # listing marks persisted values
+    capsys.readouterr()
+    cli.main(["config", "-d", path])
+    out = capsys.readouterr().out
+    assert "vmem_protect_limit_mb            777 (persisted)" in out
+    # unknown names are rejected at write time
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        cli.main(["config", "-d", path, "-c", "no_such_guc", "-v", "1"])
