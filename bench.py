@@ -59,6 +59,7 @@ DEADLINE_S = float(os.environ.get("GGTPU_BENCH_DEADLINE_S", "1650"))
 PROBE_S = float(os.environ.get("GGTPU_BENCH_PROBE_S", "480"))
 FALLBACK_SF = float(os.environ.get("GGTPU_BENCH_FALLBACK_SF", "1"))
 HBM_PEAK_GBS = 819.0   # v5e HBM bandwidth roofline
+BASELINE_V = 1         # bump when any baseline_qN implementation changes
 
 Q1 = """
 select l_returnflag, l_linestatus,
@@ -423,6 +424,37 @@ def baseline_q5(data) -> float:
     return best
 
 
+def _meta_path(bench_dir):
+    # sidecar NEXT TO the cluster dir, not inside it: the store owns its
+    # tree (gpcheckcat walks it) and ensure_loaded may wipe it wholesale
+    return bench_dir.rstrip("/") + ".meta.json"
+
+
+def _load_meta(bench_dir):
+    try:
+        with open(_meta_path(bench_dir)) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _save_meta(bench_dir, meta):
+    tmp = _meta_path(bench_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, _meta_path(bench_dir))
+
+
+def _counts_match(db, counts) -> bool:
+    for t, want in counts.items():
+        try:
+            if sum(db.store.segment_rowcounts(t)) != want:
+                return False
+        except Exception:
+            return False
+    return True
+
+
 def ensure_loaded(db, data, counts_want):
     """Reuse the bench dir only when it holds EXACTLY the expected rows; a
     partial/mismatched dir (killed prior run, different SF) is wiped and
@@ -471,6 +503,96 @@ def timed(db, sql, runs):
     return best, first, r
 
 
+class _Setup:
+    """Shared by --run (measurement) and --prewarm (cache population):
+    connect / validate-or-load the bench cluster, expose sidecar-cached
+    CPU baselines."""
+
+    def __init__(self, sf: float):
+        from greengage_tpu.utils import tpch
+
+        import greengage_tpu
+
+        self.sf = sf
+        self.tpch = tpch
+        t_setup = time.monotonic()
+        # dir name keyed by segment count (always 1 here), NOT device
+        # count: the stored cluster is identical regardless of platform,
+        # which is what lets a CPU --prewarm warm the dir a TPU --run reads
+        self.bench_dir = os.environ.get(
+            "GGTPU_BENCH_DIR", f"/tmp/ggtpu_bench_sf{sf:g}_1seg")
+        db = greengage_tpu.connect(path=self.bench_dir, numsegments=1)
+        # warm path (the time-to-first-number fix): a bench dir already
+        # loaded at this SF — validated row-exact against the sidecar —
+        # goes straight to measurement; generation (minutes at SF10) and
+        # the CPU baselines are skipped or served from the sidecar cache
+        meta = _load_meta(self.bench_dir)
+        self.data = None
+        # baseline_v invalidates sidecar-cached baselines whenever a
+        # baseline_qN implementation changes — bump on edit, or stale
+        # numbers silently skew vs_baseline across rounds
+        if meta and meta.get("baseline_v") != BASELINE_V:
+            meta["baselines"] = {}
+            meta["baseline_v"] = BASELINE_V
+        if meta and meta.get("sf") == sf and _counts_match(db, meta["counts"]):
+            counts = meta["counts"]
+            loaded = False
+            log(f"bench dir warm at SF{sf:g} — skipping generation")
+        else:
+            log(f"generating SF{sf:g}")
+            self.data = tpch.generate_cached(sf)
+            counts = {t: len(next(iter(v.values())))
+                      for t, v in self.data.items()}
+            log("loading")
+            db = ensure_loaded(db, self.data, counts)
+            loaded = getattr(db, "_loaded_now", False)
+            meta = {"sf": sf, "counts": counts, "baselines": {},
+                    "baseline_v": BASELINE_V}
+            _save_meta(self.bench_dir, meta)
+        self.db, self.meta, self.counts, self.loaded = db, meta, counts, loaded
+        if loaded or db.catalog.get("lineitem").stats is None:
+            log("analyzing")
+            db.sql("analyze")   # NDV-accurate capacities avoid recompiles
+        self.setup_s = time.monotonic() - t_setup
+        log(f"setup done ({self.setup_s:.0f}s, loaded_now={loaded})")
+
+    def get_baseline(self, qname: str) -> float:
+        """CPU baseline seconds, from the sidecar when already measured —
+        the generated arrays are only materialized if a baseline is
+        actually missing."""
+        if qname in self.meta.get("baselines", {}):
+            return self.meta["baselines"][qname]
+        if self.data is None:
+            self.data = self.tpch.generate_cached(self.sf)
+        s = globals()["baseline_" + qname](self.data)
+        self.meta.setdefault("baselines", {})[qname] = s
+        _save_meta(self.bench_dir, self.meta)
+        return s
+
+
+def prewarm_child():
+    """Populate every cache the measurement path reads — dataset pickle,
+    loaded cluster, stats, baseline sidecar — WITHOUT touching a TPU
+    backend (forced CPU platform, 1 device, same dir name the real run
+    computes). Run during the build round so the end-of-round bench's
+    first probe window goes straight to Q1."""
+    os.environ.setdefault("GGTPU_BENCH_PLATFORM", "cpu")
+    import jax
+
+    _apply_platform_override()
+    assert jax.devices()[0].platform == "cpu"
+    sf = float(os.environ.get("GGTPU_BENCH_SF", "10"))
+    s = _Setup(sf)
+    for q in QUERIES:
+        q = q.strip()
+        if "baseline_" + q not in globals():
+            log(f"prewarm: no baseline for {q!r} — skipped")
+            continue
+        log(f"prewarm baseline {q}")
+        s.get_baseline(q)
+    log(f"prewarm complete: {s.bench_dir}")
+
+
 def run_child():
     import numpy as np  # noqa: F401
 
@@ -478,30 +600,14 @@ def run_child():
 
     _apply_platform_override()
 
-    import greengage_tpu
-    from greengage_tpu.utils import tpch
-
     sf = float(os.environ.get("GGTPU_BENCH_SF", "10"))
     headline_file = os.environ.get("GGTPU_HEADLINE_FILE", "")
 
-    t_setup = time.monotonic()
-    log(f"generating SF{sf:g}")
-    data = tpch.generate(sf)
-    n_rows = len(data["lineitem"]["l_orderkey"])
-    counts = {t: len(next(iter(v.values()))) for t, v in data.items()}
-
     dev = jax.devices()[0]
-    bench_dir = os.environ.get(
-        "GGTPU_BENCH_DIR", f"/tmp/ggtpu_bench_sf{sf:g}_{len(jax.devices())}d")
-    db = greengage_tpu.connect(path=bench_dir, numsegments=1)
-    log("loading")
-    db = ensure_loaded(db, data, counts)
-    loaded = getattr(db, "_loaded_now", False)
-    if loaded or db.catalog.get("lineitem").stats is None:
-        log("analyzing")
-        db.sql("analyze")   # NDV-accurate capacities avoid recompile tiers
-    setup_s = time.monotonic() - t_setup
-    log(f"setup done ({setup_s:.0f}s, loaded_now={loaded})")
+    s = _Setup(sf)
+    db, get_baseline = s.db, s.get_baseline
+    n_rows = s.counts["lineitem"]
+    loaded, setup_s = s.loaded, s.setup_s
 
     detail = {"sf": sf, "rows": n_rows, "device": str(dev.device_kind),
               "loaded_now": loaded, "setup_s": round(setup_s, 1)}
@@ -523,9 +629,7 @@ def run_child():
         os.replace(tmp, headline_file)
         log(f"headline recorded: {line}")
 
-    for qname, sql, nbase in (("q1", Q1, "baseline_q1"),
-                              ("q3", Q3, "baseline_q3"),
-                              ("q5", Q5, "baseline_q5")):
+    for qname, sql in (("q1", Q1), ("q3", Q3), ("q5", Q5)):
         if qname not in QUERIES:
             continue
         try:
@@ -534,7 +638,7 @@ def run_child():
             # the three queries' column sets together exceed HBM
             db.executor._stage_cache.clear()
             best, first, r = timed(db, sql, RUNS)
-            cpu_s = globals()[nbase](data)
+            cpu_s = get_baseline(qname)
             value = n_rows / best
             base = n_rows / cpu_s
             detail[qname] = {
@@ -583,6 +687,8 @@ def run_child():
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         probe_child()
+    elif "--prewarm" in sys.argv:
+        prewarm_child()
     elif "--run" in sys.argv:
         run_child()
     else:
