@@ -73,6 +73,11 @@ class Database:
         # per-segment files, since settings steer lockstep mesh decisions
         # and must be identical everywhere anyway
         sp = os.path.join(path, "settings.json")
+        # adoption failures are COLLECTED, never swallowed (guc.c rejects
+        # bad values at SET; the deferred analog is a visible warning):
+        # `gg state` prints these, so an operator typo in `gg config`
+        # can't become silent divergence between set and running values
+        self.settings_warnings: list[str] = []
         if os.path.exists(sp):
             import json as _json
 
@@ -81,10 +86,11 @@ class Database:
                     for k, v in _json.load(f).items():
                         try:
                             self.settings.set(k, v)
-                        except ValueError:
-                            pass   # unknown name from a newer/older build
-            except (OSError, ValueError):
-                pass
+                        except ValueError as e:
+                            self.settings_warnings.append(
+                                f"persisted setting {k!r}={v!r} not adopted: {e}")
+            except (OSError, ValueError) as e:
+                self.settings_warnings.append(f"settings.json unreadable: {e}")
         self._mh_degraded: str | None = None
         # measured cost-model primitives, if `gg checkperf --device
         # --apply` ran against this cluster (planner/cost.set_calibration;
@@ -141,6 +147,8 @@ class Database:
         self.log = ClusterLog(self.path, enabled=not is_worker)
         self.log.info("lifecycle", f"database ready: {numsegments} segments, "
                       f"{len(devs)} devices")
+        for w in self.settings_warnings:
+            self.log.log("WARNING", "settings", w)
         self.stat_activity: list[dict] = []   # recent-query ring (gpperfmon analog)
         self._cursors: dict[str, object] = {}  # parallel retrieve cursors
         self._cursor_owner: dict[str, int] = {}  # cursor -> thread ident

@@ -510,6 +510,8 @@ def cmd_state(args):
               f"{row['status']:>7} {str(row['device']):>7} {str(row['synced']):>7}")
     if needs_rebalance(db.catalog.segments):
         print("NOTE: segments are not on their preferred roles (run gg recover)")
+    for w in db.settings_warnings:
+        print(f"WARNING: {w}")
     print("tables:")
     for name, schema in sorted(db.catalog.tables.items()):
         counts = db.store.segment_rowcounts(name)

@@ -122,6 +122,12 @@ class Binder:
 
     # ------------------------------------------------------------------
     def _bind_select(self, stmt: A.SelectStmt) -> tuple[Plan, list[ColInfo]]:
+        # statistics aggregates (stddev/variance/covar/corr/regr_*) expand
+        # into sum/count moment algebra before anything else sees them
+        # (sql/stataggs.py; pg_aggregate.h:246 family)
+        from greengage_tpu.sql.stataggs import expand_stat_aggs
+
+        expand_stat_aggs(stmt)
         # peel subquery predicates (IN/EXISTS) off the WHERE — they become
         # semi/anti joins around the FROM plan (cdbsubselect.c pull-up)
         conjs = _split_and(stmt.where)
@@ -252,9 +258,17 @@ class Binder:
                     try:
                         e = self._bind_order_expr(oi.expr, proj_cols, out_scope)
                     except SqlError:
-                        if stmt.distinct or has_aggs:
+                        if stmt.distinct:
                             raise
-                        e = self._expr(oi.expr, scope)
+                        if has_aggs:
+                            # expression OVER aggregates/keys not in the
+                            # output (order by sum(x)/count(*), expanded
+                            # stddev): bind against the agg rewrites and
+                            # carry it as a hidden sort column
+                            e = self._rewritten_expr(
+                                oi.expr, agg_rewrites, scope)
+                        else:
+                            e = self._expr(oi.expr, scope)
                         ci = ColInfo(self.new_id("ord"), e.type, "?order?",
                                      _dict_ref_of(e), hidden=True,
                                      raw_ref=_raw_ref_of(e),
@@ -2297,8 +2311,16 @@ def _ast_key(ast) -> str:
         return "#" + ast.text
     if isinstance(ast, A.Str):
         return "s:" + ast.value
+    # every value-bearing attribute that changes semantics must enter the
+    # key — a missed one silently MERGES distinct aggregates via dup_map
+    # (e.g. sum(cast(x as bigint)) vs sum(cast(x as double precision)))
     parts = [type(ast).__name__, getattr(ast, "op", ""), getattr(ast, "name", ""),
-             getattr(ast, "field", "")]
+             getattr(ast, "field", ""), getattr(ast, "type_name", ""),
+             str(getattr(ast, "typmod", "")),
+             str(getattr(ast, "negate", "")), str(getattr(ast, "distinct", "")),
+             str(getattr(ast, "star", "")), str(getattr(ast, "desc", "")),
+             str(getattr(ast, "value", "")), getattr(ast, "pattern", ""),
+             getattr(ast, "unit", "")]
     for c in _ast_children(ast):
         parts.append(_ast_key(c))
     return "(" + " ".join(parts) + ")"
@@ -2326,6 +2348,19 @@ def _ast_rebind(ast, rec):
         return E.BinOp("-", E.Literal(0, a.type), a, a.type)
     if isinstance(ast, A.IsNullTest):
         return E.IsNull(rec(ast.arg), ast.negate)
+    if isinstance(ast, A.CaseExpr):
+        # CASE over aggregate results (the stat-agg expansion emits these:
+        # negative-residue clamps, pairwise NULL restriction)
+        whens = [(rec(c), rec(v)) for c, v in ast.whens]
+        else_e = rec(ast.else_) if ast.else_ is not None else None
+        out_t = whens[0][1].type
+        for _, v in whens[1:]:
+            out_t = T.promote(out_t, v.type)
+        if else_e is not None and else_e.type != out_t:
+            out_t = T.promote(out_t, else_e.type)
+        return E.Case(tuple(whens), else_e, out_t)
+    if isinstance(ast, A.CastExpr):
+        return E.Cast(rec(ast.arg), type_from_name(ast.type_name, ast.typmod))
     return None
 
 

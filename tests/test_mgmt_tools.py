@@ -215,3 +215,32 @@ def test_gpconfig_persisted_settings(devices8, tmp_path, capsys):
     import pytest as _pytest
     with _pytest.raises(ValueError):
         cli.main(["config", "-d", path, "-c", "no_such_guc", "-v", "1"])
+    d.close()
+
+
+def test_settings_adoption_failures_surface(devices8, tmp_path, capsys):
+    """A persisted GUC this build can't adopt (operator typo, version skew)
+    must surface as a warning in `gg state` and the cluster log — never a
+    silent divergence (guc.c validation analog)."""
+    import json
+
+    import greengage_tpu
+    from greengage_tpu.mgmt import cli
+
+    path = str(tmp_path / "c")
+    greengage_tpu.connect(path=path, numsegments=2).close()
+    with open(os.path.join(path, "settings.json"), "w") as f:
+        json.dump({"vmem_protect_limit_mb": 512, "no_such_guc": 1}, f)
+    d = greengage_tpu.connect(path=path, numsegments=2)
+    assert d.settings.vmem_protect_limit_mb == 512   # good one adopted
+    assert any("no_such_guc" in w for w in d.settings_warnings)
+    d.close()
+    capsys.readouterr()
+    cli.main(["state", "-d", path])
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "no_such_guc" in out
+    # and it reached the cluster log for logfilter forensics
+    logdir = os.path.join(path, "log")
+    blob = "".join(open(os.path.join(logdir, p)).read()
+                   for p in os.listdir(logdir))
+    assert "no_such_guc" in blob

@@ -1,0 +1,124 @@
+"""Statistics aggregate family (stddev/variance/covar/corr/regr_*).
+
+Reference parity: pg_aggregate.h:246 float8 stat aggregates; semantics
+checked against pandas/numpy oracles including PG's pair restriction for
+two-argument forms (only rows with BOTH sides non-null contribute) and
+var_samp(single row) -> NULL."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8, tmp_path_factory):
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(7)
+    n = 400
+    g = rng.integers(0, 3, n).astype(np.int32)
+    x = rng.normal(50, 12, n)
+    y = 3.5 * x + rng.normal(0, 5, n)
+    xnull = rng.random(n) < 0.15          # x NULL pattern
+    ynull = rng.random(n) < 0.10          # y NULL pattern (overlaps)
+    d.sql("create table st (g int, x double precision, y double precision, "
+          "k bigint) distributed by (k)")
+    d.load_table("st", {
+        "g": g, "x": x, "y": y, "k": np.arange(n, dtype=np.int64)})
+    d.sql("update st set x = null where k in (%s)" %
+          ",".join(str(i) for i in np.flatnonzero(xnull)))
+    d.sql("update st set y = null where k in (%s)" %
+          ",".join(str(i) for i in np.flatnonzero(ynull)))
+    d.df = pd.DataFrame({
+        "g": g,
+        "x": np.where(xnull, np.nan, x),
+        "y": np.where(ynull, np.nan, y)})
+    yield d
+    d.close()
+
+
+def _vals(r, name):
+    for cid in r._order:
+        if cid.startswith(name + "#") or cid == name:
+            return np.asarray(r.cols[cid])
+    raise KeyError(name)
+
+
+def test_one_arg_family(db):
+    r = db.sql("select g, stddev(x) sd, stddev_samp(x) sds, stddev_pop(x) sdp,"
+               " variance(x) v, var_samp(x) vs, var_pop(x) vp"
+               " from st group by g order by g")
+    gg = db.df.groupby("g").x
+    np.testing.assert_allclose(_vals(r, "sd"), gg.std().values, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "sds"), gg.std().values, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "sdp"), gg.std(ddof=0).values, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "v"), gg.var().values, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "vs"), gg.var().values, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "vp"), gg.var(ddof=0).values, rtol=1e-9)
+
+
+def test_two_arg_pair_semantics(db):
+    """covar/corr/regr must use only rows where BOTH x and y are non-null —
+    the discriminating case vs naive per-column sums."""
+    r = db.sql("select covar_pop(y, x) cp, covar_samp(y, x) cs, corr(y, x) c,"
+               " regr_count(y, x) n, regr_slope(y, x) m,"
+               " regr_intercept(y, x) b, regr_r2(y, x) r2,"
+               " regr_avgx(y, x) ax, regr_avgy(y, x) ay from st")
+    p = db.df.dropna(subset=["x", "y"])
+    n = len(p)
+    sx, sy = p.x.sum(), p.y.sum()
+    sxx = (p.x * p.x).sum() - sx * sx / n
+    syy = (p.y * p.y).sum() - sy * sy / n
+    sxy = (p.x * p.y).sum() - sx * sy / n
+    assert int(_vals(r, "n")[0]) == n
+    np.testing.assert_allclose(_vals(r, "cp")[0], sxy / n, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "cs")[0], sxy / (n - 1), rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "c")[0], sxy / np.sqrt(sxx * syy),
+                               rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "m")[0], sxy / sxx, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "b")[0],
+                               sy / n - (sxy / sxx) * (sx / n), rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "r2")[0], sxy * sxy / (sxx * syy),
+                               rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "ax")[0], sx / n, rtol=1e-9)
+    np.testing.assert_allclose(_vals(r, "ay")[0], sy / n, rtol=1e-9)
+
+
+def test_var_samp_single_row_null(db):
+    """n=1 -> division by zero -> NULL (PG: var_samp of one row is NULL)."""
+    r = db.sql("select var_samp(x) v, stddev(x) s from st where k = 1")
+    for name in ("v", "s"):
+        cid = next(c for c in r._order if c.startswith(name + "#"))
+        valid = r.valids[cid]
+        assert valid is not None and not bool(np.asarray(valid)[0])
+
+
+def test_stat_aggs_in_having_and_order(db):
+    r = db.sql("select g from st group by g having stddev(x) > 0"
+               " order by variance(x) desc")
+    gg = db.df.groupby("g").x.var().sort_values(ascending=False)
+    assert list(_vals(r, "g")) == list(gg.index)
+
+
+def test_stddev_distinct_rejected(db):
+    with pytest.raises(ValueError):
+        db.sql("select stddev(distinct x) from st")
+
+
+def test_cast_dedup_no_collision(db):
+    """sum(cast(x as bigint)) must NOT merge with the expansion's
+    sum(cast(x as double precision)) — _ast_key keys on the cast target
+    (regression: structural dedup ignored type_name)."""
+    r = db.sql("select sum(cast(x as bigint)) s, variance(x) v from st"
+               " where k < 50")
+    p = db.df.iloc[:50].x.dropna()
+    np.testing.assert_allclose(_vals(r, "v")[0], p.var(), rtol=1e-9)
+    assert _vals(r, "s")[0] == np.floor(p).astype(np.int64).sum()
+
+
+def test_order_by_agg_expression(db):
+    """ORDER BY over an aggregate expression not in the output list."""
+    r = db.sql("select g from st group by g order by sum(x)/count(x) desc")
+    m = db.df.groupby("g").x.mean().sort_values(ascending=False)
+    assert list(_vals(r, "g")) == list(m.index)
