@@ -215,6 +215,22 @@ class SelectStmt(ANode):
     limit: int | None = None
     offset: int = 0
     distinct: bool = False
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS, normalized by the parser into an
+    # explicit list of grouping sets (each a list of key exprs); group_by
+    # stays empty when set (gram.y:12457 group_clause extensions)
+    grouping_sets: "list[list[ANode]] | None" = None
+    # set on desugared grouping-set branches: bind as a grouped select
+    # even when this branch's key set is empty (GROUP BY () -> one row)
+    forced_group: bool = False
+
+
+@dataclass
+class TypedNullOf(ANode):
+    """NULL carrying the type (and TEXT dictionary) of another expression —
+    the grouping-sets desugar emits these for keys absent from a set so
+    UNION branch schemas line up without guessing types."""
+
+    arg: ANode
 
 
 # ---- DDL / DML / utility --------------------------------------------------

@@ -10,6 +10,7 @@ codes, LIKE -> LUTs, cross-dictionary equality -> translation LUTs).
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import re
 
@@ -128,6 +129,8 @@ class Binder:
         from greengage_tpu.sql.stataggs import expand_stat_aggs
 
         expand_stat_aggs(stmt)
+        if stmt.grouping_sets is not None:
+            return self._bind_grouping_sets(stmt)
         # peel subquery predicates (IN/EXISTS) off the WHERE — they become
         # semi/anti joins around the FROM plan (cdbsubselect.c pull-up)
         conjs = _split_and(stmt.where)
@@ -184,10 +187,23 @@ class Binder:
         for cmp_ast in corr_scalar:
             plan = self._bind_corr_scalar(cmp_ast, plan, scope)
 
+        # grouping-set branches: typed NULLs resolve against this FROM
+        # scope; grouping() in a PLAIN grouped select folds to 0 (PG)
+        self._resolve_typed_nulls(stmt, scope)
+        if stmt.group_by and _contains_grouping(stmt):
+            keys = {_ast_key(g) for g in stmt.group_by}
+            for it in stmt.items:
+                it.expr = _gs_rewrite(it.expr, keys, keys)
+            if stmt.having is not None:
+                stmt.having = _gs_rewrite(stmt.having, keys, keys)
+            for oi in stmt.order_by:
+                oi.expr = _gs_rewrite(oi.expr, keys, keys)
+
         # aggregate / window detection
         has_aggs = any(
             _contains_agg(it.expr) for it in stmt.items
-        ) or (stmt.having is not None and _contains_agg(stmt.having)) or stmt.group_by
+        ) or (stmt.having is not None and _contains_agg(stmt.having)) \
+            or stmt.group_by or stmt.forced_group
         has_windows = any(_contains_window(it.expr) for it in stmt.items)
         if has_aggs and has_windows:
             raise SqlError(
@@ -628,6 +644,85 @@ class Binder:
 
     # ------------------------------------------------------------------
     # UNION
+    # ------------------------------------------------------------------
+    # GROUPING SETS / ROLLUP / CUBE
+    # ------------------------------------------------------------------
+    def _bind_grouping_sets(self, stmt: A.SelectStmt):
+        """Desugar to UNION ALL of per-set grouped selects — the MPP-honest
+        translation (each branch is an independent distributed aggregate;
+        the reference executes the same shape via its own Append-of-Agg
+        plans for grouping extensions, gram.y:12457 -> planner groupingsets
+        paths). Keys absent from a set project as typed NULLs; grouping()
+        folds to a per-branch constant bitmask."""
+        import copy as _copy
+
+        universe: dict[str, A.ANode] = {}
+        for s in stmt.grouping_sets:
+            for e in s:
+                universe.setdefault(_ast_key(e), e)
+        # ORDER BY exprs containing aggregates or grouping() cannot bind at
+        # the union level (they reference branch-internal state): lift each
+        # into a hidden helper select item ordered by name
+        order_by = list(stmt.order_by)
+        helpers = []
+        for i, oi in enumerate(order_by):
+            if _contains_agg(oi.expr) or _has_grouping_call(oi.expr):
+                name = f"?gsord{i}?"
+                stmt.items.append(A.SelectItem(oi.expr, alias=name))
+                helpers.append(name)
+                order_by[i] = A.OrderItem(A.Name((name,)), oi.desc,
+                                          oi.nulls_first)
+        selects = []
+        for s in stmt.grouping_sets:
+            sub = _copy.deepcopy(stmt)
+            sub.grouping_sets = None
+            sub.group_by = _copy.deepcopy(s)
+            sub.order_by = []
+            sub.limit = None
+            sub.offset = 0
+            sub.distinct = False
+            sub.forced_group = True
+            present = {_ast_key(e) for e in s}
+            for it in sub.items:
+                it.expr = _gs_rewrite(it.expr, present, set(universe))
+            if sub.having is not None:
+                sub.having = _gs_rewrite(sub.having, present, set(universe))
+            selects.append(sub)
+        u = A.UnionStmt(selects=selects, all=not stmt.distinct,
+                        order_by=order_by, limit=stmt.limit,
+                        offset=stmt.offset)
+        plan, outs = self._bind_union(u)
+        if helpers:
+            for c in outs:
+                if c.name in helpers:
+                    c.hidden = True
+        return plan, outs
+
+    def _resolve_typed_nulls(self, stmt, scope) -> None:
+        """Pre-resolve TypedNullOf nodes against the FROM scope (the agg
+        output scope their bind position sees no longer has the source
+        columns). Raw TEXT keys resolve through their transient dictionary
+        so NULL branches stay dictionary-compatible across the union."""
+        def walk(n):
+            if isinstance(n, A.TypedNullOf):
+                if getattr(n, "rtype", None) is None:
+                    inner = self._expr(n.arg, scope)
+                    conv = self._raw_to_codes(inner)
+                    if conv is not None:
+                        inner = conv
+                    n.rtype = inner.type
+                    n.rdict = _dict_ref_of(inner)
+                return
+            if isinstance(n, A.SelectStmt):
+                return
+            for c in _ast_children(n):
+                walk(c)
+
+        for it in stmt.items:
+            walk(it.expr)
+        if stmt.having is not None:
+            walk(stmt.having)
+
     # ------------------------------------------------------------------
     def _bind_union(self, stmt: A.UnionStmt):
         from greengage_tpu.planner.logical import Aggregate, Limit, Sort, Union
@@ -1291,6 +1386,16 @@ class Binder:
                 distinct_args.append(
                     ColInfo(ci_in.id, ci_in.type, ci_in.name, ci_in.dict_ref))
 
+        if not agg_nodes and not group_exprs:
+            # GROUP BY () with no aggregate calls (grouping-sets desugar
+            # branch, forced_group): anchor the global one-row group with
+            # an internal count(*) no output references — the executor's
+            # scalar-aggregate path then applies unchanged
+            synth = ColInfo(self.new_id("count"), T.INT64, "count")
+            aggs.append((synth, E.Agg("count_star", None, False, T.INT64)))
+        if not proj:
+            dummy = ColInfo(self.new_id("one"), T.INT32, "one")
+            proj.append((dummy, E.Literal(1, T.INT32)))
         plan = Project(plan, proj)
         plain_aggs = [(ci, a) for ci, a in aggs if not a.distinct]
         dist_aggs = [(ci, a) for ci, a in aggs if a.distinct]
@@ -1537,6 +1642,14 @@ class Binder:
             return E.Literal(ast.value, T.TEXT)  # coerced by context
         if isinstance(ast, A.Null):
             return E.Literal(None, T.INT32)
+        if isinstance(ast, A.TypedNullOf):
+            if getattr(ast, "rtype", None) is None:
+                raise SqlError("internal: TypedNullOf reached binding "
+                               "without pre-resolution")
+            lit = E.Literal(None, ast.rtype)
+            if ast.rdict is not None:
+                object.__setattr__(lit, "_dict_ref", ast.rdict)
+            return lit
         if isinstance(ast, A.Bool):
             return E.Literal(ast.value, T.BOOL)
         if isinstance(ast, A.DateLit):
@@ -2324,6 +2437,59 @@ def _ast_key(ast) -> str:
     for c in _ast_children(ast):
         parts.append(_ast_key(c))
     return "(" + " ".join(parts) + ")"
+
+
+_PLAIN_AGGS = ("count", "sum", "avg", "min", "max")
+
+
+def _has_grouping_call(n) -> bool:
+    if isinstance(n, A.FuncCall) and n.name == "grouping" and n.over is None:
+        return True
+    return any(_has_grouping_call(c) for c in _ast_children(n))
+
+
+def _contains_grouping(stmt) -> bool:
+    return any(_has_grouping_call(it.expr) for it in stmt.items) or (
+        stmt.having is not None and _has_grouping_call(stmt.having)) or any(
+        _has_grouping_call(oi.expr) for oi in stmt.order_by)
+
+
+def _gs_rewrite(node, present: set, universe: set):
+    """Grouping-sets branch rewrite: keys absent from this set become
+    TypedNullOf, grouping(...) folds to its per-branch bitmask constant
+    (PG bit order: first argument = most significant). Aggregate arguments
+    are left untouched — they see real rows, not key NULLs."""
+    if not isinstance(node, A.ANode):
+        if isinstance(node, list):
+            return [_gs_rewrite(v, present, universe) for v in node]
+        if isinstance(node, tuple):
+            return tuple(_gs_rewrite(v, present, universe) for v in node)
+        return node
+    if isinstance(node, A.SelectStmt):
+        return node
+    if isinstance(node, A.FuncCall) and node.over is None:
+        if node.name == "grouping":
+            if not node.args:
+                raise SqlError("grouping() requires arguments")
+            mask = 0
+            n = len(node.args)
+            for i, a in enumerate(node.args):
+                k = _ast_key(a)
+                if k not in universe:
+                    raise SqlError(
+                        "grouping() arguments must be grouping keys")
+                if k not in present:
+                    mask |= 1 << (n - 1 - i)
+            return A.Num(str(mask))
+        if node.name in _PLAIN_AGGS:
+            return node
+    k = _ast_key(node)
+    if k in universe:
+        return node if k in present else A.TypedNullOf(node)
+    for f in dataclasses.fields(node):
+        setattr(node, f.name,
+                _gs_rewrite(getattr(node, f.name), present, universe))
+    return node
 
 
 def _ast_rebind(ast, rec):

@@ -317,14 +317,77 @@ class Parser:
             s.where = self.expr()
         if self.accept("kw", "group"):
             self.expect("kw", "by")
-            s.group_by.append(self.expr())
-            while self.accept("op", ","):
-                s.group_by.append(self.expr())
+            self._group_by_clause(s)
         if self.accept("kw", "having"):
             s.having = self.expr()
         if not stop_at_setops:
             self._select_tail(s)
         return s
+
+    def _group_by_clause(self, s: A.SelectStmt) -> None:
+        """GROUP BY items: plain exprs mixed with ROLLUP/CUBE/GROUPING SETS
+        constructs (gram.y:12457 group_clause). Normalized here into either
+        s.group_by (plain only) or s.grouping_sets (the cross product of
+        every item's set list, PG semantics)."""
+        sets: list[list] = [[]]
+        saw_construct = False
+
+        def cross(item_sets: list[list]) -> None:
+            nonlocal sets
+            sets = [s0 + s1 for s0 in sets for s1 in item_sets]
+            if len(sets) > 128:
+                raise SqlError("too many grouping sets (max 128)")
+
+        while True:
+            t = self.peek()
+            if t[0] == "name" and t[1] in ("rollup", "cube") \
+                    and self.peek(1) == ("op", "("):
+                kind = self.next()[1]
+                saw_construct = True
+                exprs = self._paren_expr_list()
+                if kind == "rollup":
+                    item = [exprs[:i] for i in range(len(exprs), -1, -1)]
+                else:                      # cube: all subsets
+                    if len(exprs) > 7:
+                        raise SqlError("cube() supports at most 7 columns")
+                    item = [[e for j, e in enumerate(exprs) if m >> j & 1]
+                            for m in range((1 << len(exprs)) - 1, -1, -1)]
+                cross(item)
+            elif t[0] == "name" and t[1] == "grouping" \
+                    and self.peek(1) == ("name", "sets"):
+                self.next()
+                self.next()
+                saw_construct = True
+                self.expect("op", "(")
+                item = []
+                while True:
+                    if self.peek() == ("op", "("):
+                        item.append(self._paren_expr_list(allow_empty=True))
+                    else:
+                        item.append([self.expr()])
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                cross(item)
+            else:
+                e = self.expr()
+                cross([[e]])
+            if not self.accept("op", ","):
+                break
+        if saw_construct:
+            s.grouping_sets = sets
+        else:
+            s.group_by = sets[0]
+
+    def _paren_expr_list(self, allow_empty: bool = False) -> list:
+        self.expect("op", "(")
+        if allow_empty and self.accept("op", ")"):
+            return []
+        out = [self.expr()]
+        while self.accept("op", ","):
+            out.append(self.expr())
+        self.expect("op", ")")
+        return out
 
     def select_item(self) -> A.SelectItem:
         if self.peek() == ("op", "*"):

@@ -1,0 +1,184 @@
+"""GROUP BY ROLLUP / CUBE / GROUPING SETS + grouping().
+
+Reference parity: the grouping-extension grammar
+(/root/reference/src/backend/parser/gram.y:12457 group_clause) and its
+Append-of-Agg execution. Here each grouping set is an independent
+distributed aggregate UNION ALLed (sql/binder._bind_grouping_sets);
+absent keys project typed NULLs, grouping() folds per branch."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.types import Coded
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(5)
+    n = 300
+    d.sql("create table gs (cat text, brand text, region int, qty int, "
+          "price double precision, k bigint) distributed by (k)")
+    d.load_table("gs", {
+        "cat": Coded(["books", "food", "toys"],
+                     rng.integers(0, 3, n).astype(np.int32)),
+        "brand": Coded([f"b{i}" for i in range(5)],
+                       rng.integers(0, 5, n).astype(np.int32)),
+        "region": rng.integers(0, 4, n).astype(np.int32),
+        "qty": rng.integers(1, 50, n).astype(np.int32),
+        "price": rng.uniform(1, 100, n),
+        "k": np.arange(n, dtype=np.int64)})
+    d.sql("analyze")
+    # oracle frame rebuilt directly from the same RNG draws
+    rng = np.random.default_rng(5)
+    d.df = pd.DataFrame({
+        "cat": np.array(["books", "food", "toys"])[rng.integers(0, 3, n)],
+        "brand": np.array([f"b{i}" for i in range(5)])[rng.integers(0, 5, n)],
+        "region": rng.integers(0, 4, n),
+        "qty": rng.integers(1, 50, n),
+        "price": rng.uniform(1, 100, n)})
+    yield d
+    d.close()
+
+
+def _rollup_oracle(df, keys, val="qty"):
+    """pandas oracle: concatenated group-bys for each rollup prefix."""
+    frames = []
+    for i in range(len(keys), -1, -1):
+        ks = keys[:i]
+        if ks:
+            g = df.groupby(ks, as_index=False)[val].sum()
+        else:
+            g = pd.DataFrame({val: [df[val].sum()]})
+        for missing in keys[i:]:
+            g[missing] = None
+        frames.append(g[keys + [val]])
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_rollup_totals(db):
+    r = db.sql("select cat, brand, sum(qty) q from gs "
+               "group by rollup(cat, brand) order by cat, brand")
+    want = _rollup_oracle(db.df, ["cat", "brand"])
+    got = r.rows()
+    assert len(got) == len(want)
+    # leaf rows + per-cat subtotals + grand total all present and correct
+    m = {(a, b): q for a, b, q in got}
+    for _, w in want.iterrows():
+        key = (w["cat"], w["brand"])
+        assert m[key] == w["qty"], key
+
+
+def test_cube_counts(db):
+    r = db.sql("select cat, region, count(*) c from gs group by cube(cat, region)")
+    got = r.rows()
+    ncat, nreg = db.df.cat.nunique(), db.df.region.nunique()
+    assert len(got) == ncat * nreg + ncat + nreg + 1
+    total = next(c for a, b, c in got if a is None and b is None)
+    assert total == len(db.df)
+
+
+def test_grouping_sets_explicit(db):
+    r = db.sql("select cat, region, sum(qty) q from gs "
+               "group by grouping sets ((cat), (region), ())")
+    got = r.rows()
+    assert len(got) == db.df.cat.nunique() + db.df.region.nunique() + 1
+    by_cat = db.df.groupby("cat").qty.sum()
+    for a, b, q in got:
+        if a is not None:
+            assert b is None and q == by_cat[a]
+
+
+def test_grouping_function_bitmask(db):
+    r = db.sql("select grouping(cat, brand) g, count(*) c from gs "
+               "group by rollup(cat, brand) order by g")
+    masks = sorted({row[0] for row in r.rows()})
+    assert masks == [0, 1, 3]      # leaf, brand-rolled, both-rolled
+
+
+def test_mixed_plain_and_rollup(db):
+    r = db.sql("select region, cat, sum(qty) q from gs "
+               "group by region, rollup(cat)")
+    got = r.rows()
+    nreg = db.df.region.nunique()
+    assert len(got) == nreg * db.df.cat.nunique() + nreg
+    by_reg = db.df.groupby("region").qty.sum()
+    for reg, cat, q in got:
+        assert reg is not None          # region is always grouped
+        if cat is None:
+            assert q == by_reg[reg]
+
+
+def test_having_on_grouping(db):
+    r = db.sql("select cat, sum(qty) q from gs group by rollup(cat) "
+               "having grouping(cat) = 1")
+    got = r.rows()
+    assert len(got) == 1 and got[0][0] is None
+    assert got[0][1] == db.df.qty.sum()
+
+
+def test_rollup_no_aggregates(db):
+    """SELECT key only (no aggregate calls): the () branch still yields
+    exactly one all-NULL row (keyless Aggregate anchored internally)."""
+    r = db.sql("select cat from gs group by rollup(cat)")
+    got = [row[0] for row in r.rows()]
+    assert sorted(x for x in got if x is not None) == ["books", "food", "toys"]
+    assert got.count(None) == 1
+
+
+def test_rollup_with_stat_aggs(db):
+    """Composition: the stat-agg expansion rides inside each grouping-set
+    branch."""
+    r = db.sql("select cat, stddev(price) s from gs group by rollup(cat) "
+               "order by cat nulls last")
+    want = db.df.groupby("cat").price.std()
+    got = r.rows()
+    for cat, s in got:
+        ref = want[cat] if cat is not None else db.df.price.std()
+        np.testing.assert_allclose(s, ref, rtol=1e-9)
+
+
+def test_order_by_agg_expr_over_rollup(db):
+    """ORDER BY sum(qty) / grouping() on a grouping-sets query (lifted as
+    hidden helper columns across the union)."""
+    r = db.sql("select cat, sum(qty) from gs group by rollup(cat) "
+               "order by grouping(cat), sum(qty) desc")
+    got = r.rows()
+    assert len(got[0]) == 2                       # helpers stay hidden
+    assert got[-1][0] is None                     # grand total last
+    leaf = [q for c, q in got if c is not None]
+    assert leaf == sorted(leaf, reverse=True)
+
+
+def test_grouping_in_order_by_plain_group(db):
+    """grouping() in ORDER BY of a PLAIN grouped select folds to 0 (PG)."""
+    r = db.sql("select cat from gs group by cat order by grouping(cat), cat")
+    assert [row[0] for row in r.rows()] == ["books", "food", "toys"]
+
+
+def test_ds_q22_shape(db):
+    """TPC-DS Q22 shape: joined fact + rollup over two dim attributes with
+    avg, ordered; checked against a pandas oracle."""
+    r = db.sql("select cat, brand, avg(qty) aq from gs "
+               "where region < 3 group by rollup(cat, brand) "
+               "order by aq desc, cat, brand limit 10")
+    f = db.df[db.df.region < 3]
+    frames = []
+    for ks in (["cat", "brand"], ["cat"], []):
+        if ks:
+            g = f.groupby(ks, as_index=False).qty.mean()
+        else:
+            g = pd.DataFrame({"qty": [f.qty.mean()]})
+        for missing in ("cat", "brand"):
+            if missing not in ks:
+                g[missing] = None
+        frames.append(g[["cat", "brand", "qty"]])
+    want = pd.concat(frames, ignore_index=True).sort_values(
+        ["qty", "cat", "brand"], ascending=[False, True, True],
+        na_position="first").head(10)
+    got = r.rows()
+    assert len(got) == 10
+    for row, (_, w) in zip(got, want.iterrows()):
+        np.testing.assert_allclose(row[2], w["qty"], rtol=1e-12)
