@@ -1268,30 +1268,78 @@ class Compiler:
         return run
 
     def _c_window_global_ordered(self, plan: Window, child_fn, cap: int):
-        """Distributed GLOBAL ranking over one NOT-NULL integer/date key:
-        each row's rank = (# rows with smaller key anywhere) computed IN
-        PLACE — per segment, encode the key order-preservingly into
-        uint64 (sign-bit flip; DESC complements; no stats bounds, so no
-        violation path exists), locally sort, all_gather the sorted runs
-        [nseg, cap] + live counts, and per row sum searchsorted counts
-        across segments. row_number() breaks ties deterministically by
-        (segment, local sorted position). ~8B x rows of gathered keys vs
-        moving every row AND its payload to one chip."""
+        """Distributed GLOBAL ranking (row_number/rank/dense_rank) over
+        integer/date ORDER BY keys: each row's rank = (# rows ordered
+        before it anywhere) computed IN PLACE — per segment, encode the
+        keys order-preservingly into one uint64, locally sort, all_gather
+        the sorted runs [nseg, cap] + live counts, and per row sum
+        searchsorted counts across segments. No funnel, no row motion:
+        ~8B x rows of gathered keys vs moving every row AND its payload
+        to one chip (reference shape: nodeWindowAgg.c over a distributed
+        tuplesort).
+
+        Encodings (planner._ordered_global_spec):
+          packed — every key maps to (null_bit, value - lo) fields using
+            EXACT zone-map bounds; DESC complements within the field,
+            NULLS FIRST/LAST picks the null bit polarity. NULLs are
+            ordinary key values here, so one code path serves all shapes.
+          full64 — one key, no bounds: sign-flip encoding over the full
+            64-bit domain; NULL keys form a separate runtime class
+            counted via psum (all NULLs tie; placed per nulls_first).
+        row_number() breaks ties deterministically by (segment, local
+        sorted position); dense_rank counts distinct keys via a global
+        two-key sort of the gathered runs + boundary cumsum."""
         wfuncs = plan.wfuncs
         nseg = self.nseg
-        e, desc, _nf = plan.order_keys[0]
+        spec = plan.gkey_spec
+        need_dense = any(f[1] == "dense_rank" for f in wfuncs)
 
         def run(ctx):
             from jax import lax
 
             b = child_fn(ctx)
             sel = b.selection()
-            v, valid = Evaluator(b, self.consts).value(e)
-            enc = (v.astype(jnp.int64).astype(jnp.uint64)
-                   ^ (jnp.uint64(1) << jnp.uint64(63)))
-            if desc:
-                enc = ~enc
-            dead = ~sel if valid is None else ~(sel & valid)
+            ev = Evaluator(b, self.consts)
+            U1 = jnp.uint64(1)
+            if spec["mode"] == "packed":
+                shift = 64
+                enc = jnp.zeros((cap,), jnp.uint64)
+                for f in spec["fields"]:
+                    v, valid = ev.value(f["expr"])
+                    v64 = v.astype(jnp.int64)
+                    ve = ((jnp.int64(f["hi"]) - v64) if f["desc"]
+                          else (v64 - jnp.int64(f["lo"])))
+                    # clamp defends against out-of-zone garbage at dead
+                    # rows (fillers); live values are inside by soundness
+                    ve = jnp.clip(ve, 0, (1 << f["bits"]) - 1).astype(jnp.uint64)
+                    if valid is None:
+                        # non-null bit: 1 under NULLS FIRST (nulls=0
+                        # sort first), 0 under NULLS LAST
+                        fe = ((U1 << jnp.uint64(f["bits"])) | ve
+                              if f["nulls_first"] else ve)
+                    else:
+                        isnull = ~valid
+                        nn_bit = U1 if f["nulls_first"] else jnp.uint64(0)
+                        nl_bit = jnp.uint64(0) if f["nulls_first"] else U1
+                        flag = jnp.where(isnull, nl_bit, nn_bit)
+                        fe = (flag << jnp.uint64(f["bits"])) | jnp.where(
+                            isnull, jnp.uint64(0), ve)
+                    shift -= f["bits"] + 1
+                    enc = enc | (fe << jnp.uint64(shift))
+                isnull_cls = jnp.zeros((cap,), bool)
+                nulls_first = False
+                dead = ~sel
+            else:                                   # full64, one key
+                v, valid = ev.value(spec["expr"])
+                enc = (v.astype(jnp.int64).astype(jnp.uint64)
+                       ^ (U1 << jnp.uint64(63)))
+                if spec["desc"]:
+                    enc = ~enc
+                isnull_cls = (sel & ~valid) if valid is not None \
+                    else jnp.zeros((cap,), bool)
+                nulls_first = spec["nulls_first"]
+                dead = ~sel | isnull_cls
+
             # dead rows park at the top of the sorted run (dead flag is
             # the primary sort key) and their counted contributions are
             # clamped away by the live counts below
@@ -1318,13 +1366,54 @@ class Compiler:
             first_eq = jnp.minimum(
                 jnp.searchsorted(sorted_enc, enc_d, side="left"), live_n)
             local_eq_before = pos.astype(jnp.int64) - first_eq
+
+            # NULL class (full64 only): all NULL-key rows tie; placed
+            # before or after every valued row per nulls_first
+            n_null_local = jnp.sum(isnull_cls.astype(jnp.int64))
+            g_null = lax.all_gather(n_null_local, SEG_AXIS)   # [nseg]
+            n_null_total = jnp.sum(g_null)
+            total_valued = jnp.sum(g_live)
+            null_prior_segs = jnp.sum(jnp.where(jnp.arange(nseg) < seg,
+                                                g_null, 0))
+            local_null_idx = jnp.cumsum(isnull_cls.astype(jnp.int64)) - 1
+            valued_base = jnp.where(nulls_first, n_null_total, 0)
+            null_base = jnp.where(nulls_first, 0, total_valued)
+
+            dense_b = total_distinct = None
+            if need_dense:
+                # distinct count: one global sort of the gathered runs by
+                # (enc, live-first) + boundary flags on live key changes.
+                # Dead entries carry 0xFF..FF; a LIVE max-value row sorts
+                # before them (secondary key) so its boundary still counts
+                flat = g_sorted.reshape(nseg * cap)
+                flive = (jnp.arange(cap)[None, :] < g_live[:, None]) \
+                    .reshape(nseg * cap)
+                s_enc, s_dead, s_live = lax.sort(
+                    (flat, (~flive).astype(jnp.uint8), flive), num_keys=2,
+                    is_stable=True)
+                first = jnp.concatenate([
+                    jnp.array([True]), s_enc[1:] != s_enc[:-1]])
+                d = (s_live & first).astype(jnp.int64)
+                cum_excl = jnp.cumsum(d) - d
+                idx = jnp.searchsorted(s_enc, enc_d, side="left")
+                dense_b = cum_excl[jnp.clip(idx, 0, nseg * cap - 1)]
+                total_distinct = jnp.sum(d)
+
             out_c = dict(b.cols)
             out_v = dict(b.valids)
             for ci, fname, _arg, _ordered, _param in wfuncs:
                 if fname == "row_number":
-                    out_c[ci.id] = less_g + eq_prior + local_eq_before + 1
-                else:   # rank
-                    out_c[ci.id] = less_g + 1
+                    valued = valued_base + less_g + eq_prior + local_eq_before
+                    nullv = null_base + null_prior_segs + local_null_idx
+                elif fname == "rank":
+                    valued = valued_base + less_g
+                    nullv = null_base
+                else:                               # dense_rank
+                    has_nulls_first = (n_null_total > 0) & nulls_first
+                    valued = dense_b + has_nulls_first.astype(jnp.int64)
+                    nullv = jnp.where(nulls_first, 0, total_distinct)
+                    nullv = jnp.broadcast_to(nullv, (cap,))
+                out_c[ci.id] = jnp.where(isnull_cls, nullv, valued) + 1
                 out_v.pop(ci.id, None)
             return Batch(out_c, out_v, sel)
 

@@ -606,7 +606,7 @@ class Planner:
         key_ids = tuple(e.name for e in node.partition_keys
                         if isinstance(e, E.ColRef))
         GLOBAL_DIST = {"row_number", "count", "sum", "avg", "min", "max"}
-        ORDERED_GLOBAL = {"row_number", "rank"}
+        ORDERED_GLOBAL = {"row_number", "rank", "dense_rank"}
         if not node.partition_keys:
             if (not node.order_keys and node.frame is None
                     and child.locus.is_partitioned
@@ -620,29 +620,23 @@ class Planner:
                 node.est_rows = child.est_rows
                 return node
             if (node.order_keys and node.frame is None
-                    and len(node.order_keys) == 1
                     and child.locus.is_partitioned
                     and all(f[1] in ORDERED_GLOBAL for f in node.wfuncs)):
-                # ordered global ranking over ONE integer/date key with no
-                # NULLs: the 64-bit order-preserving encoding needs no
-                # stats bounds (it can never "violate"), so each row's
-                # global rank is computable IN PLACE from all-gathered
-                # per-segment sorted key runs — no funnel, no row motion
-                e, _desc, _nf = node.order_keys[0]
-                if isinstance(e, E.ColRef) and e.type.kind in (
-                        T.Kind.INT32, T.Kind.INT64, T.Kind.DATE):
-                    org = _origin(child, e.name)
-                    # base storage must be NULL-free AND the path must not
-                    # null-EXTEND the column (a left join's build side
-                    # manufactures NULL keys the in-place encoding cannot
-                    # order; those shapes keep the funnel, whose sort
-                    # handles NULL placement correctly)
-                    if (org is not None and not self.store.has_nulls(*org)
-                            and not _null_extended(child, e.name)):
-                        node.global_mode = "ordered"
-                        node.locus = child.locus
-                        node.est_rows = child.est_rows
-                        return node
+                # ordered global ranking (row_number/rank/dense_rank) over
+                # integer/date keys: each row's global rank is computable
+                # IN PLACE from all-gathered per-segment sorted key runs —
+                # no funnel, no row motion. Multi-key and nullable shapes
+                # pack keys into one uint64 using EXACT storage bounds
+                # from block zone maps (+1 null bit per key); a single key
+                # without usable bounds falls back to the full-64-bit
+                # encoding with runtime NULL classes (see compile)
+                spec = self._ordered_global_spec(child, node.order_keys)
+                if spec is not None:
+                    node.global_mode = "ordered"
+                    node.gkey_spec = spec
+                    node.locus = child.locus
+                    node.est_rows = child.est_rows
+                    return node
             # ordered / exotic global window: all rows to a single segment
             if child.locus.is_partitioned:
                 const = E.Literal(0, T.INT64)
@@ -658,6 +652,47 @@ class Planner:
         node.locus = node.child.locus
         node.est_rows = child.est_rows
         return node
+
+    def _ordered_global_spec(self, child: Plan, order_keys):
+        """Distribution spec for in-place global ranking, or None (-> the
+        one-chip funnel). Reference never funnels — it sorts distributed
+        (nodeWindowAgg.c + tuplesort); this is the TPU-first equivalent:
+        pack the ORDER BY keys order-preservingly into one uint64 so rank
+        = a counted position over all-gathered sorted key runs.
+
+        PG null placement applies: NULLS LAST asc / FIRST desc unless
+        explicit. `packed` needs every key to be an INT32/INT64/DATE
+        ColRef with exact zone-map bounds and total width <= 64 bits;
+        `full64` handles ONE key of any such expression with no bounds at
+        all (runtime NULL classes)."""
+        INTISH = (T.Kind.INT32, T.Kind.INT64, T.Kind.DATE)
+        resolved = []
+        for e, desc, nf in order_keys:
+            if e.type.kind not in INTISH:
+                return None
+            if nf is None:
+                nf = bool(desc)
+            resolved.append((e, bool(desc), bool(nf)))
+        fields: list | None = []
+        total = 0
+        for e, desc, nf in resolved:
+            org = _origin(child, e.name) if isinstance(e, E.ColRef) else None
+            bounds = self.store.column_bounds(*org) if org else None
+            if bounds is None:
+                fields = None
+                break
+            lo, hi = int(bounds[0]), int(bounds[1])
+            bits = max((hi - lo).bit_length(), 1)
+            total += bits + 1       # +1 null flag per field
+            fields.append({"expr": e, "desc": desc, "nulls_first": nf,
+                           "lo": lo, "hi": hi, "bits": bits})
+        if fields is not None and total <= 64:
+            return {"mode": "packed", "fields": fields}
+        if len(resolved) == 1:
+            e, desc, nf = resolved[0]
+            return {"mode": "full64", "expr": e, "desc": desc,
+                    "nulls_first": nf}
+        return None
 
     def _plan_sort(self, node: Sort) -> Plan:
         node.child = self._rec(node.child)

@@ -1295,6 +1295,40 @@ class TableStore:
                         return True
         return False
 
+    def column_bounds(self, table: str, col: str,
+                      snapshot: dict | None = None):
+        """Exact global [min, max] over every committed block of a stored
+        column, from block zone maps (blockfile.write_column_file) — the
+        sound key-packing bounds the distributed ordered-window path needs
+        (values at NULL positions are fillers inside the same zones, so
+        the result is a superset of live values; never an underestimate).
+        None when any block lacks a zone (TEXT/all-NaN) or no rows."""
+        snap = snapshot or self.manifest.snapshot()
+        schema = self.catalog.get(table) if table in self.catalog else None
+        names = (schema.storage_tables()
+                 if schema is not None and schema.name == table else [table])
+        from greengage_tpu.storage.blockfile import read_footer
+
+        lo = hi = None
+        for name in names:
+            tmeta = snap["tables"].get(name, {"segfiles": {}})
+            for seg, files in tmeta["segfiles"].items():
+                base = os.path.join(self.data_root(int(seg)), name)
+                for rel in files:
+                    fn = os.path.basename(rel)
+                    parts = fn.split(".")
+                    if (len(parts) != 3 or not fn.endswith(".ggb")
+                            or parts[0] != col):
+                        continue
+                    for b in read_footer(os.path.join(base, rel))["blocks"]:
+                        if not b["nrows"]:
+                            continue
+                        if "zmin" not in b:
+                            return None
+                        lo = b["zmin"] if lo is None else min(lo, b["zmin"])
+                        hi = b["zmax"] if hi is None else max(hi, b["zmax"])
+        return None if lo is None else (lo, hi)
+
     def segment_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
         schema = self.catalog.get(table)
         snap = snapshot or self.manifest.snapshot()

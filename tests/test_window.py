@@ -335,10 +335,10 @@ def test_global_ordered_rank_matches_funnel(wdb):
     assert dist == funneled
 
 
-def test_left_join_null_extended_key_keeps_funnel(wdb):
-    """NULL keys manufactured by a left join defeat the in-place ranking
-    premise: the planner must keep the funnel (review r4), whose sort
-    places NULLs per PG defaults (last for ASC)."""
+def test_left_join_null_extended_key_distributed(wdb):
+    """NULL keys manufactured by a left join used to force the funnel
+    (review r4); the generalized in-place ranking now counts NULL rows as
+    a runtime class — distributed, with PG placement (last for ASC)."""
     from greengage_tpu.planner.logical import describe
     from greengage_tpu.sql.parser import parse
 
@@ -347,10 +347,101 @@ def test_left_join_null_extended_key_keeps_funnel(wdb):
     q = ("select serie.t, dim5.w, rank() over (order by dim5.w) as rk "
          "from serie left join dim5 on serie.g = dim5.pk")
     planned, _, _ = wdb._plan(parse(q)[0])
-    assert "SingleQE" in describe(planned)   # funnel kept
+    assert "SingleQE" not in describe(planned)   # no funnel
     rows = wdb.sql(q).rows()
     nn = [r for r in rows if r[1] is not None]
     nulls = [r for r in rows if r[1] is None]
     assert nulls, "fixture must produce null-extended rows"
     # non-null ranks: ties share; nulls rank after ALL non-nulls (ASC)
     assert max(r[2] for r in nn) < min(r[2] for r in nulls)
+
+
+def test_global_ordered_multikey_distributed(wdb):
+    """Multi-key ordered global ranking packs keys via exact zone-map
+    bounds — distributed (no funnel), results equal pandas lexsort."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    q = ("select g, t, v, row_number() over (order by v, t desc) rn, "
+         "rank() over (order by v, t desc) rk, "
+         "dense_rank() over (order by v, t desc) dr from serie")
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" not in describe(planned)
+    df = _oracle_df(wdb)
+    want = df.sort_values(["v", "t"], ascending=[True, False])
+    rows = wdb.sql(q).rows()
+    assert sorted(r[3] for r in rows) == list(range(1, len(rows) + 1))
+    # where (v, t) is unique, row_number is fully determined: pin it
+    key_counts = df.groupby(["v", "t"]).size()
+    want_rn = {(r.v, r.t): i + 1 for i, (_, r) in enumerate(want.iterrows())}
+    for g, t, v, rn, rk, dr in rows:
+        if key_counts[(v, t)] == 1:
+            assert rn == want_rn[(v, t)]
+    # rank/dense_rank against pandas
+    key = want[["v", "t"]].apply(tuple, axis=1)
+    uniq = sorted(set(key), key=lambda x: (x[0], -x[1]))
+    dense_of = {k: i + 1 for i, k in enumerate(uniq)}
+    import collections
+    cnt = collections.Counter(key)
+    rank_of, acc = {}, 0
+    for k in uniq:
+        rank_of[k] = acc + 1
+        acc += cnt[k]
+    for g, t, v, rn, rk, dr in rows:
+        assert rk == rank_of[(v, t)]
+        assert dr == dense_of[(v, t)]
+
+
+def test_global_ordered_dense_rank_single_key(wdb):
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    q = "select v, dense_rank() over (order by v) dr from serie"
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" not in describe(planned)
+    rows = wdb.sql(q).rows()
+    uniq = sorted({r[0] for r in rows})
+    dense_of = {v: i + 1 for i, v in enumerate(uniq)}
+    for v, dr in rows:
+        assert dr == dense_of[v]
+
+
+def test_global_ordered_nullable_key_classes(wdb):
+    """Stored NULL keys (not just null-extended) rank as one tied class,
+    placed per NULLS FIRST/LAST, all in place."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    _ensure_nk(wdb)
+    for q, first in (
+            ("select k, rank() over (order by v) rk from nk", False),
+            ("select k, rank() over (order by v desc) rk from nk", True),
+            ("select k, rank() over (order by v nulls first) rk from nk",
+             True)):
+        planned, _, _ = wdb._plan(parse(q)[0])
+        assert "SingleQE" not in describe(planned), q
+        rows = wdb.sql(q).rows()
+        nulls = [rk for k, rk in rows if k in (2, 4)]
+        vals = [rk for k, rk in rows if k not in (2, 4)]
+        assert nulls[0] == nulls[1]
+        if first:
+            assert nulls[0] == 1 and min(vals) == 3
+        else:
+            assert min(vals) == 1 and nulls[0] == 4
+
+
+def _ensure_nk(wdb):
+    if "nk" not in wdb.catalog.tables:
+        wdb.sql("create table nk (k int, v int) distributed by (k)")
+        wdb.sql("insert into nk values (1, 10), (2, null), (3, 7), "
+                "(4, null), (5, 42)")
+
+
+def test_global_ordered_dense_rank_with_nulls(wdb):
+    _ensure_nk(wdb)
+    rows = wdb.sql("select k, dense_rank() over (order by v) dr "
+                   "from nk").rows()
+    by_k = dict(rows)
+    # values 7,10,42 -> dense 1,2,3; nulls last as one extra class
+    assert by_k[3] == 1 and by_k[1] == 2 and by_k[5] == 3
+    assert by_k[2] == by_k[4] == 4
