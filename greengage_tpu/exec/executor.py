@@ -32,6 +32,12 @@ class QueryError(RuntimeError):
     pass
 
 
+class AdmissionError(QueryError):
+    """Raised ONLY for the vmem admission rejection (est_bytes > limit) —
+    the signal the spill machinery keys its escalation on."""
+    pass
+
+
 def effective_limit_bytes(settings) -> int:
     """Per-query device-memory ceiling: the tighter of the hardware vmem
     guard and the resource queue's cap (queue-capped queries spill rather
@@ -244,16 +250,25 @@ class Executor:
                         res, npasses = spill.spill_run(
                             self, plan, consts, out_cols, raw)
                     except spill.NotSpillable:
-                        raise QueryError(
-                            f"query would allocate ~{comp.est_bytes >> 20} MB "
-                            f"per segment, above vmem_protect_limit_mb="
-                            f"{self.settings.vmem_protect_limit_mb}, and its "
-                            "shape is not spillable (no partial-aggregate "
-                            "cut over a single-scan probe table)")
+                        try:
+                            # external-merge sort spill (tuplesort role):
+                            # ORDER BY results merge on the host from
+                            # per-pass device-sorted runs
+                            res, npasses = spill.spill_sort_run(
+                                self, plan, consts, out_cols, raw)
+                        except spill.NotSpillable:
+                            raise QueryError(
+                                f"query would allocate ~"
+                                f"{comp.est_bytes >> 20} MB "
+                                f"per segment, above vmem_protect_limit_mb="
+                                f"{self.settings.vmem_protect_limit_mb}, and "
+                                "its shape is not spillable (no "
+                                "partial-aggregate cut or sort over a "
+                                "single-scan probe table)")
                     res.stats = dict(res.stats or {})
                     res.stats["spill_passes"] = npasses
                     return res
-                raise QueryError(
+                raise AdmissionError(
                     f"query would allocate ~{comp.est_bytes >> 20} MB per "
                     f"segment, above the {limit >> 20} MB memory ceiling "
                     "(vmem protection / resource queue; raise the limit or "

@@ -167,6 +167,29 @@ def count_scans(plan: Plan, table: str) -> int:
     return n
 
 
+
+def _collect_passes(cols_spec, results):
+    """Concatenate per-pass Result columns on the host with shared
+    validity defaulting: -> (cols, valids) where valids[c] is None when
+    every pass reported the column all-valid."""
+    host_cols = {c.id: [] for c in cols_spec}
+    host_valids = {c.id: [] for c in cols_spec}
+    any_invalid = {c.id: False for c in cols_spec}
+    for res in results:
+        for c in cols_spec:
+            host_cols[c.id].append(np.asarray(res.cols[c.id]))
+            v = res.valids.get(c.id)
+            if v is None:
+                v = np.ones(len(res.cols[c.id]), dtype=bool)
+            else:
+                any_invalid[c.id] = True
+            host_valids[c.id].append(np.asarray(v, bool))
+    cols = {c.id: np.concatenate(host_cols[c.id]) for c in cols_spec}
+    valids = {c.id: (np.concatenate(host_valids[c.id])
+                     if any_invalid[c.id] else None) for c in cols_spec}
+    return cols, valids
+
+
 def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
     """Execute ``plan`` in partitioned passes. Raises ValueError when the
     plan shape is not spillable (caller surfaces the vmem rejection)."""
@@ -252,27 +275,12 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
              for t, c, n in per_table]
     caps = {t: c for t, c, _ in per_table}
     partial_cols = state_cols
-    host_cols = {c.id: [] for c in partial_cols}
-    host_valids = {c.id: [] for c in partial_cols}
-    any_invalid = {c.id: False for c in partial_cols}
-    for combo in itertools.product(*grids):
-        res = executor.run_single(
-            pass_plan, consts, partial_cols, raw=True,
-            scan_cap_override=caps,
-            row_ranges=dict(combo), no_direct=True)
-        for c in partial_cols:
-            host_cols[c.id].append(np.asarray(res.cols[c.id]))
-            v = res.valids.get(c.id)
-            if v is None:
-                v = np.ones(len(res.cols[c.id]), dtype=bool)
-            else:
-                any_invalid[c.id] = True
-            host_valids[c.id].append(np.asarray(v, bool))
-
-    aux_cols = {c.id: np.concatenate(host_cols[c.id]) for c in partial_cols}
-    aux_valids = {c.id: (np.concatenate(host_valids[c.id])
-                         if any_invalid[c.id] else None)
-                  for c in partial_cols}
+    pass_results = [executor.run_single(
+        pass_plan, consts, partial_cols, raw=True,
+        scan_cap_override=caps,
+        row_ranges=dict(combo), no_direct=True)
+        for combo in itertools.product(*grids)]
+    aux_cols, aux_valids = _collect_passes(partial_cols, pass_results)
 
     # merge program: the original plan with the replace target swapped for
     # a host input of the concatenated captured rows. Partial case: the
@@ -295,10 +303,288 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
         m.est_rows = host_scan.est_rows
         repl = m
     merged = _replace_child(plan, replace_target, repl)
-    return executor.run_single(
-        merged, consts, out_cols, raw=raw,
-        aux_tables={aux_name: (aux_cols, aux_valids)},
-        no_direct=True), npasses
+    from greengage_tpu.exec.executor import AdmissionError
+
+    try:
+        return executor.run_single(
+            merged, consts, out_cols, raw=raw,
+            aux_tables={aux_name: (aux_cols, aux_valids)},
+            no_direct=True), npasses
+    except AdmissionError:
+        if capture_agg.aggs:          # partial-state merges never regress
+            raise
+        # recursive-merge level (execHHashagg.c batch recursion): the
+        # dedupe working set (~the full key domain for near-unique keys)
+        # exceeds HBM even after pass capture. Partition the captured
+        # keys BY KEY HASH into disjoint buckets — dedupe is exact per
+        # bucket, and the additive partial states above the dedupe sum
+        # exactly across buckets.
+        res, extra = _bucketed_dedupe_merge(
+            executor, merged, capture_agg, host_scan, aux_name, aux_cols,
+            aux_valids, consts, out_cols, raw, limit_bytes)
+        return res, npasses + extra
+
+
+def _find_partial_above(plan: Plan, target: Plan):
+    """DEEPEST final->Motion->partial aggregate pattern whose partial
+    subtree contains ``target``."""
+    found = None
+
+    def walk(node):
+        nonlocal found
+        if (isinstance(node, Aggregate) and node.phase == "final"
+                and isinstance(node.child, Motion)
+                and isinstance(node.child.child, Aggregate)
+                and node.child.child.phase == "partial"
+                and _contains(node.child.child, target)):
+            found = node.child.child
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return found
+
+
+def _bucket_hash(aux_cols, aux_valids, key_ids) -> np.ndarray:
+    from greengage_tpu.storage import native
+
+    n = len(next(iter(aux_cols.values())))
+    h = np.full(n, 0x9E3779B9, np.uint32)
+    for kid in key_ids:
+        a = np.asarray(aux_cols[kid])
+        if a.dtype.kind == "f":
+            # hashfloat8 parity (ops/hashing._canon_f64): -0.0 -> 0.0 and
+            # all NaN payloads -> one pattern, or equal keys split buckets
+            a = a.astype(np.float64)
+            a = np.where(np.isnan(a), np.float64("nan"), a + 0.0)
+            a = a.view(np.int64)
+        hk = native.hash_i64(a.astype(np.int64))
+        v = aux_valids.get(kid)
+        if v is not None:
+            hk = np.where(np.asarray(v, bool), hk, np.uint32(0x27D4EB2F))
+        h = native.hash_combine(h, hk)
+    return h
+
+
+def _bucketed_dedupe_merge(executor, merged, dedupe, host_scan, aux_name,
+                           aux_cols, aux_valids, consts, out_cols, raw,
+                           limit_bytes):
+    """Run the merge in key-hash buckets, capturing the outer partial
+    aggregate's states per bucket; one small final pass merges them."""
+    # anchor on the host scan: _replace_child shallow-copied every node on
+    # the path, so the dedupe OBJECT from the original tree is not in
+    # ``merged`` — but the inserted host scan is (by reference)
+    outer_partial = _find_partial_above(merged, host_scan)
+    if outer_partial is None:
+        raise NotSpillable(
+            "dedupe working set exceeds the limit and no additive "
+            "aggregate sits above the distinct level to merge buckets")
+    key_ids = [ci.id for ci, _ in dedupe.group_keys]
+    h = _bucket_hash(aux_cols, aux_valids, key_ids)
+
+    state_cols = partial_state_cols(outer_partial)
+    capture = PartialState(outer_partial, state_cols)
+    capture.locus = outer_partial.locus
+    capture.est_rows = outer_partial.est_rows
+    bucket_plan = Motion(MotionKind.GATHER, capture)
+    bucket_plan.locus = Locus.entry()
+
+    # size K against the COMPILED per-bucket estimate (bucket 0 as the
+    # representative subset; the hash is uniform)
+    from greengage_tpu.exec.compile import Compiler
+
+    K = 2
+    while True:
+        m0 = (h % np.uint32(K)) == 0
+        sub = {k: np.asarray(v)[m0] for k, v in aux_cols.items()}
+        subv = {k: (np.asarray(v, bool)[m0] if v is not None else None)
+                for k, v in aux_valids.items()}
+        comp = Compiler(executor.catalog, executor.store, executor.mesh,
+                        executor.nseg, consts, executor.settings,
+                        aux_tables={aux_name: (sub, subv)},
+                        no_direct=True).compile(bucket_plan)
+        if comp.est_bytes <= max(limit_bytes, 1) * 0.9 or K >= 64:
+            break
+        K *= 2
+    if comp.est_bytes > limit_bytes:
+        raise NotSpillable(
+            "per-bucket dedupe working set still exceeds the limit at 64 "
+            "merge buckets")
+    bucket = h % np.uint32(K)
+
+    bucket_results = []
+    for bkt in range(K):
+        m = bucket == bkt
+        if not m.any():
+            continue
+        sub_cols = {k: np.asarray(v)[m] for k, v in aux_cols.items()}
+        sub_valids = {k: (np.asarray(v, bool)[m] if v is not None else None)
+                      for k, v in aux_valids.items()}
+        bucket_results.append(executor.run_single(
+            bucket_plan, consts, state_cols, raw=True,
+            aux_tables={aux_name: (sub_cols, sub_valids)}, no_direct=True))
+    s_cols, s_valids = _collect_passes(state_cols, bucket_results)
+    aux2 = "@spill:partials2"
+    host_scan = Scan(aux2, list(state_cols))
+    host_scan.locus = outer_partial.locus
+    host_scan.est_rows = float(len(next(iter(s_cols.values()), [])))
+    final_plan = _replace_child(merged, outer_partial, host_scan)
+    res = executor.run_single(
+        final_plan, consts, out_cols, raw=raw,
+        aux_tables={aux2: (s_cols, s_valids)}, no_direct=True)
+    res.stats = dict(res.stats or {})
+    res.stats["spill_merge_buckets"] = K
+    return res, K
+
+
+def _sortable_host_key(arr: np.ndarray, valid, desc: bool,
+                       nulls_first: bool):
+    """-> list of numpy arrays (minor->major within this key) whose
+    ascending np.lexsort order equals the engine's order for this key.
+    None when the host representation does not order (raw surrogates)."""
+    a = np.asarray(arr)
+    if a.dtype.kind in ("i", "u", "b"):
+        enc = a.astype(np.int64)
+        enc = (enc ^ np.int64(-0x8000000000000000)).astype(np.uint64)
+        if desc:
+            enc = ~enc
+    elif a.dtype.kind == "f":
+        bits = a.astype(np.float64).view(np.uint64)
+        enc = np.where(bits >> np.uint64(63),
+                       ~bits, bits | np.uint64(1 << 63))
+        if desc:
+            enc = ~enc
+    elif a.dtype.kind in ("U", "S"):
+        # C-locale string order == the dictionary rank order the device
+        # sorts by; numpy cannot complement strings, so DESC strings use
+        # a negated RANK over the merged domain instead
+        uniq, inv = np.unique(a, return_inverse=True)
+        enc = inv.astype(np.int64)
+        if desc:
+            enc = -enc
+        enc = (enc ^ np.int64(-0x8000000000000000)).astype(np.uint64)
+    else:
+        return None
+    nul = (np.zeros(len(a), np.uint8) if valid is None
+           else (~np.asarray(valid, bool)).astype(np.uint8))
+    if nulls_first:
+        nul = 1 - nul
+    # major key: null class; minor: encoded value (lexsort order)
+    return [enc, nul]
+
+
+def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
+    """External-merge sort spill (tuplesort.c role,
+    /root/reference/src/backend/utils/sort/tuplesort.c:1): an ORDER BY
+    whose input exceeds HBM runs as partitioned passes of the ORIGINAL
+    plan — each pass sorts its chunk on device and arrives on the host
+    already globally ordered (merge-sorted gather) — then the host merges
+    the sorted runs with one stable lexsort over order-preserving key
+    encodings (the k-way merge step, with host RAM as the workfile)."""
+    if not isinstance(plan, Motion) or plan.kind is not MotionKind.GATHER:
+        raise NotSpillable("sort spill needs a gathered result")
+    node = plan.child
+    limit_node = None
+    if isinstance(node, Limit):
+        limit_node = node
+        node = node.child
+    if not isinstance(node, Sort):
+        raise NotSpillable("no sort at the gather point")
+    sort = node
+    by_id = {c.id: c for c in out_cols}
+    keyspec = []
+    for e, desc, nf in sort.keys:
+        if not isinstance(e, E.ColRef) or e.name not in by_id:
+            raise NotSpillable("sort key is not a gathered output column")
+        kc = by_id[e.name]
+        # raw TEXT arrives as int64 row surrogates whose numeric order is
+        # row id, not string order — and any key type must be known
+        # host-orderable BEFORE paying the pass loop
+        if getattr(kc, "raw_ref", None) is not None \
+                or getattr(kc, "raw_chain", None) is not None:
+            raise NotSpillable("sort key is raw-encoded text")
+        keyspec.append((e.name, bool(desc),
+                        bool(desc) if nf is None else bool(nf)))
+    candidates = [t for t in spill_candidate_tables(sort.child)
+                  if not t.startswith("@") and count_scans(plan, t) == 1]
+    if not candidates:
+        raise NotSpillable("no partitionable table below the sort")
+    # passes must NOT carry the Limit: its host re-limit would drop each
+    # CHUNK's first `offset` rows; offset/limit apply once after the merge
+    if limit_node is not None:
+        import copy as _copy
+
+        pass_plan = _copy.copy(plan)
+        pass_plan.child = sort
+    else:
+        pass_plan = plan
+    store = executor.store
+
+    from greengage_tpu.exec.compile import Compiler
+    from greengage_tpu.exec.executor import effective_limit_bytes
+
+    settings = executor.settings
+    limit_bytes = effective_limit_bytes(settings)
+    candidates.sort(key=lambda t: -max(store.segment_rowcounts(t), default=0))
+    cand = candidates[0]
+    max_rows = max(store.segment_rowcounts(cand), default=0)
+    if max_rows == 0:
+        raise NotSpillable("empty partition candidate")
+    floor = 1 << 12
+    chunk = max_rows
+    comp = None
+    while True:
+        chunk = max(chunk // 2, floor)
+        comp = Compiler(executor.catalog, store, executor.mesh,
+                        executor.nseg, consts, settings,
+                        scan_cap_override={cand: chunk},
+                        no_direct=True).compile(pass_plan)
+        if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
+            break
+    if comp.est_bytes > limit_bytes:
+        raise NotSpillable("per-pass working set still exceeds the limit")
+    npasses = -(-max_rows // chunk)
+    if npasses > 256:
+        raise NotSpillable(f"sort spill would need {npasses} passes (> 256)")
+
+    runs = []
+    for p in range(npasses):
+        res = executor.run_single(
+            pass_plan, consts, out_cols, raw=raw,
+            scan_cap_override={cand: chunk},
+            row_ranges={cand: (p * chunk, (p + 1) * chunk)},
+            no_direct=True)
+        runs.append(res)
+
+    cols, valids = _collect_passes(out_cols, runs)
+
+    # one stable ascending lexsort; keys minor->major, so reverse the SQL
+    # key order and emit each key's (enc, null-class) pair in that order
+    lex: list[np.ndarray] = []
+    for name, desc, nf in reversed(keyspec):
+        enc = _sortable_host_key(cols[name], valids[name], desc, nf)
+        if enc is None:
+            raise NotSpillable("sort key host representation does not order")
+        lex.extend(enc)
+    perm = np.lexsort(lex)
+    cols = {k: v[perm] for k, v in cols.items()}
+    valids = {k: (v[perm] if v is not None else None)
+              for k, v in valids.items()}
+    if limit_node is not None:
+        lo = limit_node.offset
+        hi = None if limit_node.limit is None else lo + limit_node.limit
+        cols = {k: v[lo:hi] for k, v in cols.items()}
+        valids = {k: (v[lo:hi] if v is not None else None)
+                  for k, v in valids.items()}
+
+    from greengage_tpu.exec.executor import Result
+
+    base = runs[0]
+    res = Result(columns=base.columns, cols=cols, valids=valids,
+                 _order=list(base._order),
+                 stats=dict(base.stats or {}))
+    res.stats["spill_kind"] = "sort"
+    return res, npasses
 
 
 def _replace_child(plan: Plan, target: Plan, repl: Plan) -> Plan:

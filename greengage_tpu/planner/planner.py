@@ -756,29 +756,6 @@ class Planner:
         return m
 
 
-def _null_extended(plan: Plan, col_id: str) -> bool:
-    """Can ``col_id`` carry NULLs INTRODUCED on the path (outer-join
-    null-extension), even though its base storage is NULL-free?
-    Conservative: unknown shapes answer True."""
-    if isinstance(plan, Scan):
-        return False
-    if isinstance(plan, Join):
-        if any(c.id == col_id for c in plan.left.out_cols()):
-            return _null_extended(plan.left, col_id)
-        # the right (build) side of a LEFT join null-extends its columns
-        return plan.kind == "left" or _null_extended(plan.right, col_id)
-    if isinstance(plan, (Filter, Motion, Limit, Sort, Window)):
-        return _null_extended(plan.children[0], col_id)
-    if isinstance(plan, Project):
-        for c, e in plan.exprs:
-            if c.id == col_id:
-                if isinstance(e, E.ColRef):
-                    return _null_extended(plan.child, e.name)
-                return True
-        return True
-    return True
-
-
 def _find_single_scan(plan: Plan, table: str):
     """The unique Scan of ``table`` in the subtree, or None if absent or
     scanned more than once (two scans must not share one prune)."""

@@ -54,11 +54,41 @@ def test_spill_scalar_aggregate(db):
 
 
 def test_unspillable_shape_still_rejected(db):
-    # plain full-table select (no aggregate cut): honest rejection
+    # per-partition window over the whole table: no reduction point, no
+    # sort at the gather — honest rejection
     db.sql("set vmem_protect_limit_mb = 1")
     try:
         with pytest.raises(QueryError, match="not spillable|above vmem"):
-            db.sql("select k, v from big where v >= 0 order by k")
+            db.sql("select k, sum(v) over (partition by k) from big")
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+
+
+def test_sort_spill_matches_in_memory(db):
+    """External-merge sort spill (tuplesort.c role): a full ORDER BY over
+    a table above the admission limit completes via per-pass device sorts
+    + host merge, matching the in-memory result exactly."""
+    q = "select k, v from big where v >= 50 order by v desc, k"
+    want = db.sql(q).rows()
+    db.sql("set vmem_protect_limit_mb = 1")
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_passes", 0) >= 2, r.stats
+        assert r.stats.get("spill_kind") == "sort"
+        assert r.rows() == want
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+
+
+def test_sort_spill_with_limit_offset(db):
+    q = "select k, v from big order by v, k limit 7 offset 3"
+    want = db.sql(q).rows()
+    db.sql("set vmem_protect_limit_mb = 1")
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "sort", r.stats
+        assert r.stats.get("spill_passes", 0) >= 2, r.stats
+        assert r.rows() == want
     finally:
         db.sql("set vmem_protect_limit_mb = 12288")
 
@@ -103,17 +133,19 @@ def test_distinct_colocated_dedupe_spills_exact(devices8):
         d.sql("set vmem_protect_limit_mb = 12288")
 
 
-def test_distinct_unique_key_honest_rejection(db):
-    # distinct over a ~unique key reduces nothing: the merge's working
-    # set is the full domain, so past the limit the query must be
-    # REJECTED (not silently wrong) — recursion into a second spill
-    # level is future work, matching the single-level workfile design
+def test_distinct_unique_key_recursive_merge(db):
+    """DISTINCT over a ~unique key reduces nothing per pass, so the merge
+    working set is the full domain: the recursive merge level partitions
+    the captured keys BY KEY HASH into disjoint buckets and sums the
+    additive partial states across buckets (execHHashagg.c batch
+    recursion analog) — exact, where r4 rejected honestly."""
     q = "select count(distinct k) from big"
     assert db.sql(q).rows() == [(400_000,)]
     db.sql("set vmem_protect_limit_mb = 1")
     try:
-        with pytest.raises(QueryError, match="above"):
-            db.sql(q)
+        r = db.sql(q)
+        assert r.rows() == [(400_000,)]
+        assert r.stats.get("spill_merge_buckets", 0) >= 2, r.stats
     finally:
         db.sql("set vmem_protect_limit_mb = 12288")
 
