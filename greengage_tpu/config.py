@@ -45,6 +45,14 @@ class Settings:
     # memory protection (gp_vmem_protect_limit analog): estimated device
     # bytes a single query may allocate; 0 disables the check
     vmem_protect_limit_mb: int = 12288
+    # mid-flight enforcement (vmem_tracker.c + redzone_handler.c +
+    # runaway_cleaner.c analog): cluster-wide ceiling on the SUM of
+    # in-flight statements' compiled estimates; crossing
+    # runaway_red_zone x this flags the heaviest statement, which
+    # terminates at its next cancellation point (retry-tier or spill-pass
+    # boundary). 0 disables cross-statement enforcement.
+    vmem_global_limit_mb: int = 0
+    runaway_red_zone: float = 0.9
     # synchronous mirror replication after each committed write (the
     # synchronous_standby_names / syncrep gate analog); off = mirrors go
     # stale and are barred from promotion until `gg replicate`

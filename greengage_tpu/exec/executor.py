@@ -26,6 +26,7 @@ from greengage_tpu import types as T
 from greengage_tpu.exec.compile import VALID_PREFIX, Compiler, CompileResult
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
+from greengage_tpu.runtime.runaway import TRACKER
 
 
 class QueryError(RuntimeError):
@@ -182,6 +183,22 @@ class Executor:
         cap_overrides: dict = dict(hints)
         pack_disabled: set = set()
         fused_disabled = cache_key is not None and cache_key in self._fused_failed
+        TRACKER.enter()   # nested spill passes share the statement entry
+        try:
+            return self._run_tiers(
+                plan, consts, out_cols, cache_key, raw, instrument,
+                scan_cap_override, row_ranges, aux_tables, allow_spill,
+                deferred, no_direct, t0, snapshot, version,
+                hints, cap_overrides, pack_disabled, fused_disabled)
+        finally:
+            TRACKER.release()
+
+    def _run_tiers(self, plan, consts, out_cols, cache_key, raw, instrument,
+                   scan_cap_override, row_ranges, aux_tables, allow_spill,
+                   deferred, no_direct, t0, snapshot, version,
+                   hints, cap_overrides, pack_disabled,
+                   fused_disabled) -> Result:
+        last_err = None
         tier = 0
         attempts = 0
         # tiers grow capacities; a key-packing bounds violation (stale
@@ -273,6 +290,22 @@ class Executor:
                     f"segment, above the {limit >> 20} MB memory ceiling "
                     "(vmem protection / resource queue; raise the limit or "
                     "reduce the data)")
+            # mid-flight enforcement (runaway_cleaner.c analog): ledger
+            # what this statement will ACTUALLY hold (post-spill-decision
+            # estimate), run the red-zone scan, and take any cancellation
+            # aimed at us — a tier or spill-pass boundary is the XLA
+            # CHECK_FOR_INTERRUPTS. Multihost: DISABLED — a per-process
+            # tracker cancels nondeterministically across the mesh, and a
+            # one-sided cancel desyncs the lockstep collectives (the
+            # plan-hash invariant, parallel/multihost.py); the reference's
+            # cleaner is likewise per-host vmem, not cluster-coordinated
+            if self.multihost is None:
+                TRACKER.reprice(
+                    comp.est_bytes,
+                    int(getattr(self.settings,
+                                "vmem_global_limit_mb", 0)) << 20,
+                    float(getattr(self.settings, "runaway_red_zone", 0.9)))
+                TRACKER.check()
             inputs = self._stage(comp, snapshot)
             try:
                 flat = comp.device_fn(*inputs)

@@ -21,6 +21,7 @@ from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.catalog import PolicyKind
 from greengage_tpu.planner import cost as C
+from greengage_tpu.planner import stats as S
 from greengage_tpu.planner.locus import Locus, LocusKind
 from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Motion, MotionKind, Plan, Project,
@@ -323,15 +324,22 @@ class Planner:
         # fallback to the round-1 max() guess
         llook = self._stats_lookup(left)
         rlook = self._stats_lookup(right)
-        key_ndvs = []
+        est = None
+        sel = 1.0
         for lk, rk in zip(node.left_keys, node.right_keys):
             ls = llook(lk.name) if isinstance(lk, E.ColRef) else None
             rs = rlook(rk.name) if isinstance(rk, E.ColRef) else None
-            if ls is None or rs is None:
-                key_ndvs = None
+            if ls is None or rs is None or ls.ndv <= 0 or rs.ndv <= 0:
+                sel = None
                 break
-            key_ndvs.append((ls.ndv, rs.ndv))
-        est = C.join_rows(left.est_rows, right.est_rows, key_ndvs)
+            # histogram join calculus (MCV x MCV + aligned-histogram
+            # remainder, stats.join_selectivity); NDV division fallback
+            ksel = S.join_selectivity(ls, rs)
+            if ksel is None:
+                ksel = 1.0 / max(ls.ndv, rs.ndv)
+            sel *= ksel * (1.0 - ls.null_frac) * (1.0 - rs.null_frac)
+        if sel is not None:
+            est = max(left.est_rows * right.est_rows * sel, 1.0)
         node.est_rows = est if est is not None else max(left.est_rows, right.est_rows)
         if node.kind in ("semi", "anti"):
             node.est_rows = left.est_rows * 0.5
@@ -352,11 +360,9 @@ class Planner:
             # PAIR estimate (|L||R|/max key NDV) so the compiler sizes the
             # expansion from stats instead of overflowing the first run
             node.multi = True
-            if key_ndvs:
-                pairs = left.est_rows * right.est_rows
-                for nl, nr in key_ndvs:
-                    pairs /= max(max(nl, nr), 1.0)
-                node.expand_est = pairs
+            if sel is not None:
+                node.expand_est = max(
+                    left.est_rows * right.est_rows * sel, 1.0)
         # build-side key bounds for the packed/narrowed hash table
         # (ops/join.py pack_join_keys): probe values outside the build's
         # bounds simply never match, so only the BUILD side's stats matter

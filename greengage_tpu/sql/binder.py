@@ -19,6 +19,7 @@ import numpy as np
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.catalog import PolicyKind
+from greengage_tpu.planner import stats as _stats
 from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Plan, Project, Scan, Sort,
 )
@@ -904,7 +905,12 @@ class Binder:
                 e = edges[key] = M.EdgeInfo(key[0], key[1])
             pair = (li, ri) if i == key[0] else (ri, li)
             e.pairs.append(pair)
-            e.sel /= max(si.ndv, sj.ndv)
+            # histogram join calculus with NDV-division fallback — memo
+            # edge costs see the same estimate the parallelizer uses
+            ksel = _stats.join_selectivity(si, sj)
+            if ksel is None:
+                ksel = 1.0 / max(si.ndv, sj.ndv)
+            e.sel *= ksel * (1.0 - si.null_frac) * (1.0 - sj.null_frac)
         if not edges:
             return None
         nseg = self.catalog.segments.numsegments

@@ -131,3 +131,102 @@ def test_skewed_filter_execution_exact(db):
     fk = db.sql("select fk from fact").rows()
     want = sum(1 for (x,) in fk if x in keep)
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# histogram JOIN calculus (CJoinStatsProcessor.cpp role, VERDICT r4 #6)
+# ---------------------------------------------------------------------------
+
+def test_join_selectivity_uniform_matches_ndv_division():
+    from greengage_tpu.planner.stats import ColumnStats, join_selectivity
+
+    hist = [float(x) for x in range(0, 1001, 125)]   # uniform 0..1000
+    ls = ColumnStats(ndv=1000, hist=list(hist))
+    rs = ColumnStats(ndv=500, hist=list(hist))
+    sel = join_selectivity(ls, rs)
+    assert abs(sel - 1.0 / 1000) / (1.0 / 1000) < 0.2
+
+
+def test_join_selectivity_disjoint_ranges_near_zero():
+    from greengage_tpu.planner.stats import ColumnStats, join_selectivity
+
+    ls = ColumnStats(ndv=1000, hist=[0.0, 250.0, 500.0, 750.0, 1000.0])
+    rs = ColumnStats(ndv=1000, hist=[5000.0, 5250.0, 5500.0, 5750.0, 6000.0])
+    assert join_selectivity(ls, rs) < 1e-9
+
+
+def test_join_selectivity_partial_overlap_scales_down():
+    from greengage_tpu.planner.stats import ColumnStats, join_selectivity
+
+    full = [float(x) for x in range(0, 1001, 250)]
+    shifted = [float(x) for x in range(500, 1501, 250)]   # half overlap
+    ls = ColumnStats(ndv=1000, hist=full)
+    rs = ColumnStats(ndv=1000, hist=shifted)
+    sel = join_selectivity(ls, rs)
+    # ~half of each side participates: 500 shared values at 1e-3 each
+    assert abs(sel - 0.5 / 1000) / (0.5 / 1000) < 0.2
+
+
+def test_join_selectivity_point_mass_skew():
+    from greengage_tpu.planner.stats import ColumnStats, join_selectivity
+
+    # 70% of mass on value 1 shows as repeated boundaries (zero-width
+    # buckets); both sides skewed -> sel ~= 0.49, where NDV division
+    # says 1/199
+    B = 32
+    heavy = int(B * 0.7)
+    hist = [1.0] * (heavy + 1) + [
+        float(2 + i * (200 - 2) / (B - heavy - 1)) for i in range(B - heavy)]
+    ls = ColumnStats(ndv=199, hist=list(hist))
+    rs = ColumnStats(ndv=199, hist=list(hist))
+    sel = join_selectivity(ls, rs)
+    assert 0.3 < sel < 0.7
+
+
+def test_skewed_fk_join_order_plan_golden(devices8):
+    """The VERDICT criterion: a skew-skew join NDV division underestimates
+    25x must be ordered LAST — the unique-key join runs first (deepest)."""
+    import numpy as np
+
+    import greengage_tpu
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(11)
+    nf, ns, nt = 40_000, 3_000, 5_000
+    fa = np.where(rng.random(nf) < 0.7, 1,
+                  rng.integers(2, 200, nf)).astype(np.int64)
+    sa = np.where(rng.random(ns) < 0.7, 1,
+                  rng.integers(2, 200, ns)).astype(np.int64)
+    d.sql("create table f (a bigint, b bigint, v int) distributed by (b)")
+    d.sql("create table s (a bigint, w int) distributed by (a)")
+    d.sql("create table t (b bigint, u int) distributed by (b)")
+    d.load_table("f", {"a": fa, "b": rng.integers(0, nt, nf),
+                       "v": rng.integers(0, 9, nf).astype(np.int32)})
+    d.load_table("s", {"a": sa, "w": rng.integers(0, 9, ns).astype(np.int32)})
+    d.load_table("t", {"b": np.arange(nt, dtype=np.int64),
+                       "u": rng.integers(0, 9, nt).astype(np.int32)})
+    d.sql("analyze")
+    planned, _, _ = d._plan(parse(
+        "select count(*) from f, s, t where f.a = s.a and f.b = t.b")[0])
+    txt = describe(planned)
+    lines = txt.split("\n")
+    depth = {}
+    for ln in lines:
+        for tbl in ("s", "t"):
+            if f"Scan {tbl} " in ln:
+                depth[tbl] = len(ln) - len(ln.lstrip())
+    # t joins first (deeper in the left-deep tree); s joins last
+    assert depth["t"] > depth["s"], txt
+    # and the skew join estimate is within 3x of the true ~58.6M rows
+    import re
+    import collections
+    ca = collections.Counter(fa)
+    cs = collections.Counter(sa)
+    true_fs = sum(ca[k] * cs.get(k, 0) for k in ca)
+    ests = [int(m.group(1)) for m in re.finditer(r"Join inner.*rows=(\d+)",
+                                                 txt)]
+    top_join = max(ests)
+    assert true_fs / 3 < top_join < true_fs * 3, (top_join, true_fs)
+    d.close()

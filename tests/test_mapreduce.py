@@ -1,0 +1,98 @@
+"""gpmapreduce analog (gpcontrib/gpmapreduce): YAML MAP/REDUCE jobs —
+python mappers on the host, builtin reducers as distributed GROUP BY."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.mgmt.mapreduce import MapReduceError, run_job
+
+WORDCOUNT = """
+VERSION: 1.0.0.1
+DEFINE:
+  - INPUT:
+      NAME: book
+      FILE:
+        - localhost:{path}
+  - MAP:
+      NAME: wordsplit_python
+      FUNCTION: |
+        for word in value.split():
+          yield [word, 1]
+      LANGUAGE: python
+      PARAMETERS: value text
+      RETURNS:
+        - key text
+        - value integer
+EXECUTE:
+  - RUN:
+      SOURCE: book
+      MAP: wordsplit_python
+      REDUCE: SUM
+"""
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    yield d
+    d.close()
+
+
+def test_wordcount_from_file(db, tmp_path):
+    p = tmp_path / "book.txt"
+    p.write_text("the quick brown fox\nthe lazy dog\nthe end\n")
+    printed = []
+    rows = run_job(db, WORDCOUNT.format(path=p), out=printed.append)
+    got = dict(rows)
+    assert got["the"] == 3
+    assert got["quick"] == 1 and got["dog"] == 1
+    assert len(printed) == len(rows)
+
+
+def test_table_source_reduce_to_target(db):
+    db.sql("create table mr_src (k text, v int) distributed by (v)")
+    from greengage_tpu.types import Coded
+
+    codes = np.array([0, 1, 0, 2, 1, 0], dtype=np.int32)
+    db.load_table("mr_src", {
+        "k": Coded(["a", "b", "c"], codes),
+        "v": np.arange(6, dtype=np.int32)})
+    job = """
+DEFINE:
+  - INPUT:
+      NAME: src
+      TABLE: mr_src
+EXECUTE:
+  - RUN:
+      SOURCE: src
+      REDUCE: SUM
+      TARGET: mr_out
+"""
+    run_job(db, job, out=lambda *_: None)
+    got = dict(db.sql("select k, v from mr_out order by k").rows())
+    assert got == {"a": 0 + 2 + 5, "b": 1 + 4, "c": 3}
+
+
+def test_identity_and_errors(db):
+    with pytest.raises(MapReduceError, match="python only"):
+        run_job(db, """
+DEFINE:
+  - INPUT:
+      NAME: x
+      TABLE: mr_src
+  - MAP:
+      NAME: m
+      LANGUAGE: perl
+      FUNCTION: "return [];"
+EXECUTE:
+  - RUN: {SOURCE: x, MAP: m}
+""")
+    with pytest.raises(MapReduceError, match="TRANSITION"):
+        run_job(db, """
+DEFINE:
+  - INPUT: {NAME: x, TABLE: mr_src}
+  - REDUCE: {NAME: r, TRANSITION: t}
+EXECUTE:
+  - RUN: {SOURCE: x}
+""")

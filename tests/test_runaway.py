@@ -1,0 +1,110 @@
+"""Mid-flight memory enforcement — the vmem tracker / red-zone handler /
+runaway cleaner roles (vmem_tracker.c, redzone_handler.c,
+runaway_cleaner.c). Cross-statement: per-statement admission cannot see
+the cluster-wide in-flight total; the tracker flags the heaviest
+statement at red zone and it dies at its next cancellation point (tier /
+spill-pass boundary), while lighter concurrent statements complete."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.runtime.runaway import TRACKER, RunawayCancelled
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    n = 1_200_000
+    rng = np.random.default_rng(4)
+    d.sql("create table heavy (k int, g int, v int) distributed by (k)")
+    d.load_table("heavy", {"k": np.arange(n),
+                           "g": (np.arange(n) % 23).astype(np.int32),
+                           "v": rng.integers(0, 100, n)})
+    d.sql("create table light (a int, v int) distributed by (a)")
+    d.load_table("light", {"a": np.arange(100_000, dtype=np.int32),
+                           "v": rng.integers(0, 9, 100_000).astype(np.int32)})
+    d.sql("analyze")
+    yield d
+    d.close()
+
+
+def test_tracker_red_zone_picks_heaviest():
+    done = threading.Event()
+    picked = {}
+
+    def heavy():
+        TRACKER.enter()
+        try:
+            TRACKER.reprice(100 << 20, 64 << 20, 0.9)
+            done.wait(5)
+            try:
+                TRACKER.check()
+                picked["heavy"] = False
+            except RunawayCancelled:
+                picked["heavy"] = True
+        finally:
+            TRACKER.release()
+
+    t = threading.Thread(target=heavy)
+    t.start()
+    time.sleep(0.2)
+    TRACKER.enter()
+    try:
+        # 100MB + 10MB > 0.9 * 64MB: the 100MB statement is the runaway
+        TRACKER.reprice(10 << 20, 64 << 20, 0.9)
+        TRACKER.check()          # the light statement survives
+    finally:
+        TRACKER.release()
+        done.set()
+        t.join()
+    assert picked["heavy"] is True
+
+
+def test_runaway_spill_query_canceled_while_small_completes(db):
+    """A spilling statement (many passes = many cancellation points) is
+    flagged when concurrent admissions cross the red zone; it dies with
+    the cleaner's message while the small statements finish."""
+    db.sql("set vmem_protect_limit_mb = 1")     # heavy query must spill
+    db.sql("set vmem_global_limit_mb = 1")
+    db.sql("set runaway_red_zone = 0.6")        # red zone: 0.6 MB total
+    err: dict = {}
+
+    def heavy():
+        try:
+            db.sql("select g, count(*), sum(v) from heavy group by g")
+            err["heavy"] = None
+        except Exception as e:
+            err["heavy"] = str(e)
+
+    t = threading.Thread(target=heavy)
+    try:
+        t.start()
+        time.sleep(0.5)          # let it enter the spill pass loop
+        for _ in range(200):     # small statements keep being admitted
+            r = db.sql("select sum(v) from light")
+            assert len(r.rows()) == 1
+            if not t.is_alive():
+                break
+            time.sleep(0.05)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert err["heavy"] is not None, "heavy statement should be canceled"
+        assert "runaway cleaner" in err["heavy"], err["heavy"]
+    finally:
+        db.sql("set vmem_global_limit_mb = 0")
+        db.sql("set runaway_red_zone = 0.9")
+        db.sql("set vmem_protect_limit_mb = 12288")
+
+
+def test_no_global_limit_means_no_enforcement(db):
+    db.sql("set vmem_protect_limit_mb = 1")
+    try:
+        r = db.sql("select g, count(*) from heavy group by g")
+        assert r.stats.get("spill_passes", 0) >= 2
+        assert len(r.rows()) == 23
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
