@@ -178,7 +178,6 @@ class Executor:
         t0 = time.monotonic()
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
-        last_err = None
         hints = dict(self._cap_hints.get(cache_key) or {})
         cap_overrides: dict = dict(hints)
         pack_disabled: set = set()

@@ -774,6 +774,122 @@ class Database:
             self.catalog._save()
 
     # ------------------------------------------------------------------
+    # ---- WITH RECURSIVE (nodeRecursiveunion.c / WorkTableScan role) ----
+    def _select_recursive(self, stmt, rctes: dict) -> Result:
+        """Session-level fixpoint iteration: materialize each recursive
+        CTE by running the base term, then re-running the recursive term
+        against a worktable of the previous iteration's NEW rows until
+        none appear. Every term executes as an ordinary distributed
+        statement; accumulation tables are real (ephemeral) tables, so
+        the final query plans/distributes normally. UNION (not ALL)
+        dedupes rows across iterations — which is also the cycle guard."""
+        import copy as _copy
+
+        MAX_ITER = 500
+        mapping: dict[str, str] = {}
+        created: list[str] = []
+        # unique scratch names: concurrent statements (and any user table
+        # that happens to share a prefix) must never collide
+        uid = next(_REC_COUNTER)
+        try:
+            for name, rc in rctes.items():
+                acc = f"__rec_{uid}_{name}"
+                wtbl = f"__recw_{uid}_{name}"
+                base = _rename_base_tables(_copy.deepcopy(rc.base), mapping)
+                # bind once for exact output types (constant-only base
+                # terms skip the binder and infer from the result), then
+                # execute
+                try:
+                    _, outs0 = Binder(
+                        self.catalog, self.store,
+                        subquery_executor=self._scalar_subquery,
+                        optimizer=self.settings.optimizer).bind_select(base)
+                    r = self._execute(base)
+                except SqlError:
+                    r = self._execute(base)
+                    outs0 = [_inferred_col(nm, np.asarray(r.cols[cid]))
+                             for nm, cid in zip(r.columns, r._order)]
+                coldefs = ", ".join(
+                    f"{c.name} {_ddl_type(c.type)}" for c in outs0)
+                for t in (acc, wtbl):
+                    self.sql(f"drop table if exists {t}")
+                    self.sql(f"create table {t} ({coldefs}) "
+                             "distributed randomly")
+                    created.append(t)
+                rows = r.rows()
+                seen = set(rows) if not rc.union_all else None
+                if seen is not None:
+                    rows = list(seen)
+                self._load_rows(acc, outs0, rows)
+                cur = rows
+                it = 0
+                while cur:
+                    it += 1
+                    if it > MAX_ITER:
+                        raise QueryError(
+                            f'recursive CTE "{name}" exceeded {MAX_ITER} '
+                            "iterations (cycle? use UNION instead of "
+                            "UNION ALL, or add a bound)")
+                    self.sql(f"drop table if exists {wtbl}")
+                    self.sql(f"create table {wtbl} ({coldefs}) "
+                             "distributed randomly")
+                    self._load_rows(wtbl, outs0, cur)
+                    rec = _rename_base_tables(
+                        _copy.deepcopy(rc.rec), {**mapping, name: wtbl})
+                    nr = self._execute(rec).rows()
+                    if seen is not None:
+                        fresh = []
+                        for t in nr:
+                            if t not in seen:
+                                seen.add(t)
+                                fresh.append(t)
+                        nr = fresh
+                    if nr:
+                        self._load_rows(acc, outs0, nr)
+                    cur = nr
+                mapping[name] = acc
+            final = _rename_base_tables(_copy.deepcopy(stmt), mapping)
+            if hasattr(final, "_recursive_ctes"):
+                del final._recursive_ctes
+            return self._execute(final)
+        finally:
+            for t in created:
+                try:
+                    self.sql(f"drop table if exists {t}")
+                except Exception:
+                    pass
+
+    def _load_rows(self, table: str, outs, rows: list) -> None:
+        """Host row tuples -> bulk column load matching ``outs`` types
+        (DECIMAL results arrive descaled as float64 and reload as double
+        precision — see _ddl_type)."""
+        cols: dict = {}
+        valids: dict = {}
+        epoch = np.datetime64("1970-01-01")
+        for i, c in enumerate(outs):
+            vals = [r[i] for r in rows]
+            mask = np.array([v is not None for v in vals], bool)
+            kind = c.type.kind
+            if kind is T.Kind.TEXT:
+                cols[c.name] = ["" if v is None else str(v) for v in vals]
+            elif kind in (T.Kind.FLOAT64, T.Kind.DECIMAL):
+                cols[c.name] = np.array(
+                    [0.0 if v is None else float(v) for v in vals],
+                    np.float64)
+            elif kind is T.Kind.DATE:
+                cols[c.name] = np.array(
+                    [0 if v is None else
+                     int((np.datetime64(v, "D") - epoch)
+                         .astype("timedelta64[D]").astype(np.int64))
+                     for v in vals], np.int32)
+            else:
+                cols[c.name] = np.array(
+                    [0 if v is None else int(v) for v in vals],
+                    c.type.np_dtype)
+            valids[c.name] = None if mask.all() else mask
+        if rows:
+            self.load_table(table, cols, valids)
+
     def _plan(self, stmt, force_multi_join: bool = False, info: dict | None = None):
         binder = Binder(self.catalog, self.store,
                         subquery_executor=self._scalar_subquery,
@@ -1036,6 +1152,9 @@ class Database:
         return cached
 
     def _select(self, stmt: A.SelectStmt) -> Result:
+        rctes = getattr(stmt, "_recursive_ctes", None)
+        if rctes:
+            return self._select_recursive(stmt, rctes)
         if isinstance(stmt, A.SelectStmt) and not stmt.from_:
             return self._const_select(stmt)
         planned, consts, outs, exec_key = self._cached_plan(stmt)
@@ -2347,3 +2466,68 @@ def _sql_type_name(t: T.SqlType) -> tuple[str, tuple[int, ...]]:
         T.Kind.BOOL: ("bool", ()),
         T.Kind.TEXT: ("text", ()),
     }[k]
+
+
+_REC_COUNTER = __import__("itertools").count()
+
+
+def _ddl_type(t) -> str:
+    """SqlType -> DDL text for recursive-CTE materialization (DECIMAL
+    degrades to double precision: host accumulation sees descaled
+    floats)."""
+    k = t.kind
+    if k is T.Kind.INT32:
+        return "int"
+    if k is T.Kind.INT64:
+        return "bigint"
+    if k in (T.Kind.FLOAT64, T.Kind.DECIMAL):
+        return "double precision"
+    if k is T.Kind.BOOL:
+        return "bool"
+    if k is T.Kind.DATE:
+        return "date"
+    return "text"
+
+
+def _rename_base_tables(node, mapping: dict):
+    """Rewrite BaseTable references per ``mapping`` everywhere in the AST
+    (including subqueries) — the worktable substitution."""
+    import dataclasses as _dc
+
+    if isinstance(node, A.BaseTable):
+        if node.name in mapping:
+            if node.alias is None:
+                node.alias = node.name       # keep qualified refs valid
+            node.name = mapping[node.name]
+        return node
+    if isinstance(node, A.ANode):
+        for f in _dc.fields(node):
+            v = getattr(node, f.name)
+            setattr(node, f.name, _rename_base_tables(v, mapping))
+        return node
+    if isinstance(node, list):
+        return [_rename_base_tables(v, mapping) for v in node]
+    if isinstance(node, tuple):
+        return tuple(_rename_base_tables(v, mapping) for v in node)
+    return node
+
+
+def _inferred_col(name: str, arr):
+    """ColInfo-lite (name+type) from a host result array — the typing
+    fallback for constant-only recursive base terms."""
+    from types import SimpleNamespace
+
+    k = arr.dtype.kind
+    if k == "M":
+        t = T.DATE
+    elif k == "b":
+        t = T.BOOL
+    elif k == "i" and arr.dtype.itemsize <= 4:
+        t = T.INT32
+    elif k in ("i", "u"):
+        t = T.INT64
+    elif k == "f":
+        t = T.FLOAT64
+    else:
+        t = T.TEXT
+    return SimpleNamespace(name=name, type=t)

@@ -42,6 +42,17 @@ def cmd_init(args):
     return 0
 
 
+def cmd_mapreduce(args):
+    """gpmapreduce analog: run a YAML MAP/REDUCE job (mgmt/mapreduce.py)."""
+    from greengage_tpu.mgmt.mapreduce import run_job
+
+    db = _open(args.dir)
+    with open(args.file) as f:
+        run_job(db, f.read())
+    db.close()
+    return 0
+
+
 def cmd_config(args):
     """gpconfig analog: show or persist cluster-level settings
     (settings.json, adopted by every connect on every process)."""
@@ -930,6 +941,11 @@ def main(argv=None):
     p.add_argument("-c", "--change", default=None)
     p.add_argument("-v", "--value", default=None)
     p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("mapreduce")   # gpmapreduce analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-f", "--file", required=True, help="YAML job spec")
+    p.set_defaults(fn=cmd_mapreduce)
 
     p = sub.add_parser("initstandby")   # gpinitstandby analog
     p.add_argument("-d", "--dir", required=True)

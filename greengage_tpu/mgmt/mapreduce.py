@@ -146,7 +146,6 @@ def run_job(db, yaml_text: str, out=print) -> list:
                 if got is None:
                     continue
                 out_rows.extend(list(row) for row in got)
-            names = [nm for nm, _ in rets]
         else:
             def _sql_type(a) -> str:
                 k = np.asarray(a).dtype.kind
@@ -157,7 +156,6 @@ def run_job(db, yaml_text: str, out=print) -> list:
                 return "text"
 
             rets = [(c, _sql_type(a)) for c, a in zip(cols, arrays)]
-            names = cols
             out_rows = [list(t) for t in zip(*arrays)] if arrays else []
 
         reduce_name = str(run.get("REDUCE", "IDENTITY")).upper()
@@ -189,6 +187,10 @@ def run_job(db, yaml_text: str, out=print) -> list:
             r = db.sql(f"select {key}, {agg}({val}) as {val} from {tmp} "
                        f"group by {key} order by {key}")
         target = run.get("TARGET")
+        if target and agg is not None and len(rets) != 2:
+            raise MapReduceError(
+                "TARGET with an aggregate reducer needs exactly two "
+                "RETURNS columns (key, value)")
         if target:
             tdefs = ", ".join(
                 f"{nm} {'bigint' if agg in ('sum', 'count') and nm == val else ty}"

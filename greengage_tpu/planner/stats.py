@@ -180,7 +180,8 @@ def _point_masses(hist: list) -> dict:
     return pm
 
 
-def join_selectivity(ls: ColumnStats, rs: ColumnStats) -> float | None:
+def join_selectivity(ls: ColumnStats, rs: ColumnStats,
+                     kind=None) -> float | None:
     """Equi-join selectivity per NON-NULL row pair via MCV x MCV exact
     matching + aligned-histogram remainder — the CJoinStatsProcessor role
     (/root/reference/src/backend/gporca/libnaucrates/src/statistics/
@@ -197,6 +198,13 @@ def join_selectivity(ls: ColumnStats, rs: ColumnStats) -> float | None:
     rows (the reference excludes them); the residual-mass scaling keeps
     the double-count second-order."""
     if ls is None or rs is None:
+        return None
+    # only VALUE-comparable storage encodings may align across tables:
+    # TEXT stats hold per-column dictionary codes (code 3 is a different
+    # string in each table) and DECIMAL values are scale-encoded — both
+    # fall back to NDV division, which is encoding-independent
+    if kind is not None and kind not in (T.Kind.INT32, T.Kind.INT64,
+                                         T.Kind.DATE, T.Kind.FLOAT64):
         return None
     have_hist = len(ls.hist) > 1 and len(rs.hist) > 1
     # sampled MCVs, augmented with the point masses zero-width histogram

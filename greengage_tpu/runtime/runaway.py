@@ -102,6 +102,10 @@ class VmemTracker:
                     # lone statement; the cleaner only arbitrates between
                     # statements
                     return
+                if cur.bytes < max(e.bytes for e in self._active.values()):
+                    # the true top consumer already carries a (stale)
+                    # flag; cancelling a lighter newcomer frees nothing
+                    return
                 victim = cur   # newcomer is the top consumer under
                 # contention: it takes the cancellation (runaway_cleaner
                 # picks the largest)

@@ -225,6 +225,19 @@ class SelectStmt(ANode):
 
 
 @dataclass
+class RecursiveCTE(ANode):
+    """WITH RECURSIVE r AS (base UNION [ALL] recursive): split at parse
+    time; the session iterates the recursive term against a worktable
+    until fixpoint (nodeRecursiveunion.c / WorkTableScan role,
+    gram.y:12190)."""
+
+    name: str
+    base: ANode                  # branches not referencing ``name``
+    rec: ANode                   # branches referencing ``name``
+    union_all: bool              # False -> dedupe rows across iterations
+
+
+@dataclass
 class TypedNullOf(ANode):
     """NULL carrying the type (and TEXT dictionary) of another expression —
     the grouping-sets desugar emits these for keys absent from a set so

@@ -895,7 +895,7 @@ class Binder:
             hit = self._edge_of(c, items)
             if hit is None:
                 continue
-            i, j, li, ri = hit
+            i, j, li, ri, kind = hit
             si, sj = col_stats[i].get(li), col_stats[j].get(ri)
             if si is None or sj is None or si.ndv <= 0 or sj.ndv <= 0:
                 return None
@@ -907,7 +907,7 @@ class Binder:
             e.pairs.append(pair)
             # histogram join calculus with NDV-division fallback — memo
             # edge costs see the same estimate the parallelizer uses
-            ksel = _stats.join_selectivity(si, sj)
+            ksel = _stats.join_selectivity(si, sj, kind)
             if ksel is None:
                 ksel = 1.0 / max(si.ndv, sj.ndv)
             e.sel *= ksel * (1.0 - si.null_frac) * (1.0 - sj.null_frac)
@@ -995,7 +995,7 @@ class Binder:
             pair = self._edge_of(c, items)
             if pair is None:
                 continue
-            i, j, li, ri = pair
+            i, j, li, ri, _kind = pair
             si = col_stats[i].get(li)
             sj = col_stats[j].get(ri)
             if si is None or sj is None or si.ndv <= 0 or sj.ndv <= 0:
@@ -1082,7 +1082,7 @@ class Binder:
             for idx, (_, scope) in enumerate(items):
                 try:
                     ci = scope.resolve(ast.parts)
-                    return idx, ci.id
+                    return idx, ci.id, ci.type.kind
                 except SqlError:
                     continue
             return None
@@ -1090,7 +1090,7 @@ class Binder:
         a, b = side(cond.left), side(cond.right)
         if a is None or b is None or a[0] == b[0]:
             return None
-        return a[0], b[0], a[1], b[1]
+        return a[0], b[0], a[1], b[1], a[2]
 
     def _bind_table_ref(self, t: A.TableRef):
         if isinstance(t, A.BaseTable):

@@ -79,6 +79,7 @@ class Parser:
     def __init__(self, text: str):
         self.toks = Lexer(text).tokens
         self.i = 0
+        self._recursive_ctes: dict = {}
 
     # ---- token helpers -------------------------------------------------
     def peek(self, k: int = 0):
@@ -141,12 +142,19 @@ class Parser:
             # reference inlines the subplan and XLA's common-subexpression
             # elimination dedupes identical subprograms within the single
             # compiled SPMD program — the TPU-native sharing analog.
-            ctes = self.with_prefix()
+            ctes = self.with_prefix(allow_recursive=True)
             if self.at_kw("insert"):
                 stmt = self.insert_stmt()
             else:
                 stmt = self.select_or_union()
-            return _substitute_ctes(stmt, ctes)
+            stmt = _substitute_ctes(stmt, ctes)
+            if self._recursive_ctes:
+                if isinstance(stmt, A.InsertStmt):
+                    raise SqlError(
+                        "WITH RECURSIVE over INSERT is not supported")
+                stmt._recursive_ctes = self._recursive_ctes
+                self._recursive_ctes = {}
+            return stmt
         if self.at_kw("select"):
             return self.select_or_union()
         if self.at_word("declare"):
@@ -231,15 +239,22 @@ class Parser:
         raise SqlError(f"unexpected {self.peek()[1]!r}")
 
     # ---- WITH (common table expressions) ------------------------------
-    def with_prefix(self) -> dict:
-        """Parse `WITH name [(cols)] AS (query) [, ...]` -> {name: query}.
+    def with_prefix(self, allow_recursive: bool = False) -> dict:
+        """Parse `WITH [RECURSIVE] name [(cols)] AS (query) [, ...]`
+        -> {name: query}.
 
         Later CTEs may reference earlier ones (expanded eagerly, so the
-        returned queries are self-contained). WITH RECURSIVE is rejected.
-        """
+        returned queries are self-contained). Self-referencing CTEs under
+        RECURSIVE are NOT substituted: they land in
+        ``self._recursive_ctes`` as RecursiveCTE (base/recursive split)
+        and the name stays a plain table reference the session resolves
+        to the materialized worktable result (gram.y:12190 semantics via
+        session-level iteration)."""
         self.expect("kw", "with")
-        if self.at_word("recursive"):
-            raise SqlError("WITH RECURSIVE is not supported")
+        recursive = bool(self.at_word("recursive") and self.next())
+        if recursive and not allow_recursive:
+            raise SqlError(
+                "WITH RECURSIVE is only supported at statement level")
         ctes: dict = {}
         while True:
             name = self.expect("name")[1]
@@ -255,9 +270,13 @@ class Parser:
             q = self.select_or_union()
             self.expect("op", ")")
             q = _substitute_ctes(q, {**ctes, **inner})
-            if colnames:
-                _apply_cte_column_aliases(q, colnames, name)
-            ctes[name] = q
+            if recursive and _references_table(q, name):
+                self._recursive_ctes[name] = _split_recursive_cte(
+                    name, q, colnames)
+            else:
+                if colnames:
+                    _apply_cte_column_aliases(q, colnames, name)
+                ctes[name] = q
             if not self.accept("op", ","):
                 break
         return ctes
@@ -1048,6 +1067,50 @@ class Parser:
                     break
             self.expect("op", ")")
         return A.CopyStmt(table, path, options)
+
+
+def _references_table(node, name: str) -> bool:
+    if isinstance(node, A.BaseTable):
+        return node.name == name
+    if isinstance(node, A.ANode):
+        import dataclasses as _dc
+
+        for f in _dc.fields(node):
+            if _references_table(getattr(node, f.name), name):
+                return True
+        return False
+    if isinstance(node, (list, tuple)):
+        return any(_references_table(v, name) for v in node)
+    return False
+
+
+def _split_recursive_cte(name: str, q, colnames):
+    """base UNION [ALL] recursive -> RecursiveCTE: branches that scan
+    ``name`` are recursive terms, the rest the base."""
+    if not isinstance(q, A.UnionStmt):
+        raise SqlError(
+            f'recursive CTE "{name}" must be <base> UNION [ALL] <recursive>')
+    if q.order_by or q.limit is not None:
+        raise SqlError(
+            f'recursive CTE "{name}" cannot carry ORDER BY/LIMIT')
+    base, rec = [], []
+    for b in q.selects:
+        (rec if _references_table(b, name) else base).append(b)
+    if not base:
+        raise SqlError(f'recursive CTE "{name}" has no non-recursive term')
+    if not rec:
+        raise SqlError(f'recursive CTE "{name}" has no recursive term')
+
+    def pack(bs):
+        if len(bs) == 1:
+            return bs[0]
+        return A.UnionStmt(selects=bs, all=True)
+
+    bq, rq = pack(base), pack(rec)
+    if colnames:
+        for part in (base + rec):
+            _apply_cte_column_aliases(part, colnames, name)
+    return A.RecursiveCTE(name, bq, rq, union_all=q.all)
 
 
 def _substitute_ctes(node, ctes: dict):

@@ -96,3 +96,16 @@ DEFINE:
 EXECUTE:
   - RUN: {SOURCE: x}
 """)
+
+
+def test_cli_mapreduce(db, tmp_path, capsys):
+    from greengage_tpu.mgmt import cli
+
+    book = tmp_path / "b.txt"
+    book.write_text("x y x\n")
+    job = tmp_path / "job.yml"
+    job.write_text(WORDCOUNT.format(path=book))
+    rc = cli.main(["mapreduce", "-d", db.path, "-f", str(job)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "x\t2" in out and "y\t1" in out
