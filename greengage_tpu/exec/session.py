@@ -290,6 +290,17 @@ class Database:
         mirror-failover analog for a lost compute host."""
         self._mh_degraded = reason
         self.log.error("multihost", f"worker lost; degraded to local: {reason}")
+        # re-form the topology over surviving storage (ftsprobe.c:968
+        # role): probe every content NOW — a content whose primary tree
+        # died with the worker's host gets its in-sync mirror promoted,
+        # so the re-formed service answers from the mirror trees (which
+        # cross-host placement keeps on surviving roots)
+        try:
+            if self.catalog.segments.has_mirrors():
+                self.fts.probe_once()
+                self.catalog._save()
+        except Exception as e:
+            self.log.error("multihost", f"post-death FTS probe failed: {e}")
         try:
             self.multihost.channel.close()
         except Exception:
@@ -390,6 +401,10 @@ class Database:
                 out = self._execute(stmt)
             return out
         stmts = parse(text)
+        if any(getattr(st, "_recursive_ctes", None) for st in stmts):
+            raise SqlError(
+                "WITH RECURSIVE is not supported in multi-host mode yet "
+                "(the fixpoint iteration cannot run under mesh lockstep)")
         mesh_stmts = [st for st in stmts if self._needs_mesh(st)]
         if mesh_stmts and len(stmts) > 1:
             raise SqlError(
@@ -788,9 +803,9 @@ class Database:
         MAX_ITER = 500
         mapping: dict[str, str] = {}
         created: list[str] = []
-        # unique scratch names: concurrent statements (and any user table
-        # that happens to share a prefix) must never collide
-        uid = next(_REC_COUNTER)
+        # unique scratch names: concurrent statements — including OTHER
+        # PROCESSES sharing this cluster directory — must never collide
+        uid = f"{os.getpid():x}_{next(_REC_COUNTER)}"
         try:
             for name, rc in rctes.items():
                 acc = f"__rec_{uid}_{name}"
@@ -799,14 +814,17 @@ class Database:
                 # bind once for exact output types (constant-only base
                 # terms skip the binder and infer from the result), then
                 # execute
+                outs0 = None
                 try:
                     _, outs0 = Binder(
                         self.catalog, self.store,
                         subquery_executor=self._scalar_subquery,
-                        optimizer=self.settings.optimizer).bind_select(base)
-                    r = self._execute(base)
+                        optimizer=self.settings.optimizer).bind_select(
+                            _copy.deepcopy(base))
                 except SqlError:
-                    r = self._execute(base)
+                    pass      # constant-only base: infer from the result
+                r = self._execute(base)
+                if outs0 is None:
                     outs0 = [_inferred_col(nm, np.asarray(r.cols[cid]))
                              for nm, cid in zip(r.columns, r._order)]
                 coldefs = ", ".join(
@@ -830,9 +848,7 @@ class Database:
                             f'recursive CTE "{name}" exceeded {MAX_ITER} '
                             "iterations (cycle? use UNION instead of "
                             "UNION ALL, or add a bound)")
-                    self.sql(f"drop table if exists {wtbl}")
-                    self.sql(f"create table {wtbl} ({coldefs}) "
-                             "distributed randomly")
+                    self.sql(f"delete from {wtbl}")
                     self._load_rows(wtbl, outs0, cur)
                     rec = _rename_base_tables(
                         _copy.deepcopy(rc.rec), {**mapping, name: wtbl})

@@ -42,6 +42,43 @@ def cmd_init(args):
     return 0
 
 
+def cmd_mirrorroots(args):
+    """Cross-host mirror placement (gpaddmirrors spread analog): place
+    content k's mirror tree under roots[(k+1) % n] — offset so a content
+    never mirrors onto its own root when roots are per-host mounts — and
+    move any already-replicated trees there."""
+    import shutil
+
+    from greengage_tpu.storage.table_store import mirror_root
+
+    db = _open(args.dir)
+    roots = [os.path.abspath(r) for r in args.roots.split(",") if r]
+    if not roots:
+        raise ValueError("--roots needs at least one directory")
+    nseg = db.numsegments
+    old = {k: mirror_root(db.path, k) for k in range(nseg)}
+    mapping = {str(k): roots[(k + 1) % len(roots)] for k in range(nseg)}
+    mp = os.path.join(db.path, "mirror_roots.json")
+    with open(mp + ".tmp", "w") as f:
+        json.dump(mapping, f, indent=1)
+    os.replace(mp + ".tmp", mp)
+    for k in range(nseg):
+        new = os.path.join(mapping[str(k)], f"content{k}")
+        if os.path.abspath(old[k]) != os.path.abspath(new) \
+                and os.path.isdir(old[k]):
+            os.makedirs(os.path.dirname(new), exist_ok=True)
+            if os.path.exists(new):
+                shutil.rmtree(new)
+            shutil.move(old[k], new)
+        print(f"  content {k}: mirror tree at {new}")
+    if db.replicator is not None:
+        db.replicator.sync()
+        db.catalog._save()
+        print("mirrors re-synced at the new roots")
+    db.close()
+    return 0
+
+
 def cmd_mapreduce(args):
     """gpmapreduce analog: run a YAML MAP/REDUCE job (mgmt/mapreduce.py)."""
     from greengage_tpu.mgmt.mapreduce import run_job
@@ -941,6 +978,12 @@ def main(argv=None):
     p.add_argument("-c", "--change", default=None)
     p.add_argument("-v", "--value", default=None)
     p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("mirrorroots")   # gpaddmirrors spread placement
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("--roots", required=True,
+                   help="comma-separated per-host mirror root directories")
+    p.set_defaults(fn=cmd_mirrorroots)
 
     p = sub.add_parser("mapreduce")   # gpmapreduce analog
     p.add_argument("-d", "--dir", required=True)

@@ -334,7 +334,8 @@ class Planner:
                 break
             # histogram join calculus (MCV x MCV + aligned-histogram
             # remainder, stats.join_selectivity); NDV division fallback
-            ksel = S.join_selectivity(ls, rs, lk.type.kind)
+            ksel = S.join_selectivity(ls, rs,
+                                      (lk.type.kind, rk.type.kind))
             if ksel is None:
                 ksel = 1.0 / max(ls.ndv, rs.ndv)
             sel *= ksel * (1.0 - ls.null_frac) * (1.0 - rs.null_frac)

@@ -181,7 +181,7 @@ def _point_masses(hist: list) -> dict:
 
 
 def join_selectivity(ls: ColumnStats, rs: ColumnStats,
-                     kind=None) -> float | None:
+                     kinds=None) -> float | None:
     """Equi-join selectivity per NON-NULL row pair via MCV x MCV exact
     matching + aligned-histogram remainder — the CJoinStatsProcessor role
     (/root/reference/src/backend/gporca/libnaucrates/src/statistics/
@@ -202,10 +202,15 @@ def join_selectivity(ls: ColumnStats, rs: ColumnStats,
     # only VALUE-comparable storage encodings may align across tables:
     # TEXT stats hold per-column dictionary codes (code 3 is a different
     # string in each table) and DECIMAL values are scale-encoded — both
-    # fall back to NDV division, which is encoding-independent
-    if kind is not None and kind not in (T.Kind.INT32, T.Kind.INT64,
-                                         T.Kind.DATE, T.Kind.FLOAT64):
-        return None
+    # fall back to NDV division, which is encoding-independent. BOTH
+    # sides must be plainly-encoded (a single kind, or an int/int pair)
+    if kinds is not None:
+        kl, kr = kinds if isinstance(kinds, tuple) else (kinds, kinds)
+        ints = (T.Kind.INT32, T.Kind.INT64)
+        ok = (kl in ints and kr in ints) or (
+            kl == kr and kl in (T.Kind.DATE, T.Kind.FLOAT64))
+        if not ok:
+            return None
     have_hist = len(ls.hist) > 1 and len(rs.hist) > 1
     # sampled MCVs, augmented with the point masses zero-width histogram
     # buckets expose (explicit MCV frequencies win on overlap)

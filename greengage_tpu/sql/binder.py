@@ -895,7 +895,7 @@ class Binder:
             hit = self._edge_of(c, items)
             if hit is None:
                 continue
-            i, j, li, ri, kind = hit
+            i, j, li, ri, kinds = hit
             si, sj = col_stats[i].get(li), col_stats[j].get(ri)
             if si is None or sj is None or si.ndv <= 0 or sj.ndv <= 0:
                 return None
@@ -907,7 +907,7 @@ class Binder:
             e.pairs.append(pair)
             # histogram join calculus with NDV-division fallback — memo
             # edge costs see the same estimate the parallelizer uses
-            ksel = _stats.join_selectivity(si, sj, kind)
+            ksel = _stats.join_selectivity(si, sj, kinds)
             if ksel is None:
                 ksel = 1.0 / max(si.ndv, sj.ndv)
             e.sel *= ksel * (1.0 - si.null_frac) * (1.0 - sj.null_frac)
@@ -1090,7 +1090,7 @@ class Binder:
         a, b = side(cond.left), side(cond.right)
         if a is None or b is None or a[0] == b[0]:
             return None
-        return a[0], b[0], a[1], b[1], a[2]
+        return a[0], b[0], a[1], b[1], (a[2], b[2])
 
     def _bind_table_ref(self, t: A.TableRef):
         if isinstance(t, A.BaseTable):

@@ -16,6 +16,7 @@ dictionary code, so placement is stable across dictionary growth.
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 import uuid
@@ -67,10 +68,33 @@ def merge_segfile_records(tx: dict, table: str, records: list) -> None:
         tmeta["nrows"][str(seg)] = tmeta["nrows"].get(str(seg), 0) + n
 
 
+_MIRROR_MAP_CACHE: dict = {}   # root -> (mtime, {content: dir})
+
+
 def mirror_root(root: str, content: int) -> str:
     """Directory tree holding content ``content``'s replicated files (the
-    mirror segment's data directory — on a real deployment a different
-    disk/host; see runtime/replication.py)."""
+    mirror segment's data directory). Default: <root>/mirror/content<k>.
+    An operator-placed ``<root>/mirror_roots.json`` overrides per content
+    with ABSOLUTE paths on other disks/hosts (`gg mirrorroots --roots`) —
+    the cross-host spread placement of gpaddmirrors/gpinitsystem, so a
+    lost data disk cannot take a content's primary AND mirror together
+    (gp_segment_configuration hostname/address separation)."""
+    mp = os.path.join(root, "mirror_roots.json")
+    try:
+        mtime = os.stat(mp).st_mtime_ns
+        cached = _MIRROR_MAP_CACHE.get(root)
+        if cached is None or cached[0] != mtime:
+            with open(mp) as f:
+                _MIRROR_MAP_CACHE[root] = (mtime, json.load(f))
+        override = _MIRROR_MAP_CACHE[root][1].get(str(content))
+        if override:
+            return os.path.join(override, f"content{content}")
+    except OSError:
+        _MIRROR_MAP_CACHE.pop(root, None)
+    except ValueError:
+        # malformed operator edit: fall back to the default placement
+        # rather than taking down every mirror-maintenance path
+        _MIRROR_MAP_CACHE.pop(root, None)
     return os.path.join(root, "mirror", f"content{content}")
 
 
