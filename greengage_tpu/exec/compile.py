@@ -187,7 +187,7 @@ class Compiler:
             # no raw-text surrogates (their row numbering must stay whole)
             prune = self.scan_prune.get(t) or None
             if prune and (self.scan_count.get(t, 0) != 1 or any(
-                    c.startswith(("@hp:", "@rc:", "@rp:", "@rl:"))
+                    c.startswith(("@hp:", "@rc:", "@rp:", "@rl:", "@rw:"))
                     for c in cols)):
                 prune = None
             if prune:

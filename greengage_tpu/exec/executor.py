@@ -526,7 +526,7 @@ class Executor:
                         dt = np.dtype(bool)   # host-evaluated predicate col
                     elif c.startswith("@rc:"):
                         dt = np.dtype(np.int32)   # transient raw-dict codes
-                    elif c.startswith("@rp:"):
+                    elif c.startswith(("@rp:", "@rw:")):
                         dt = np.dtype(np.int64)   # packed raw prefix word
                     elif c.startswith("@rl:"):
                         dt = np.dtype(np.int32)   # raw byte length

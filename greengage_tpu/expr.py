@@ -138,6 +138,23 @@ class Func(Expr):
 
 
 @dataclass(frozen=True)
+class RawLike(Expr):
+    """General LIKE over a raw TEXT column, evaluated ON DEVICE from the
+    staged wide byte window (@rw word lanes + @rl length): the pattern's
+    literal parts (split on %) match greedily left-to-right over an
+    unpacked [rows, W] byte matrix — varlena.c text_like vectorized
+    (VERDICT r4 #7). The planner only emits this when every committed row
+    fits the window, so device results are exact."""
+
+    words: tuple          # ColRefs of @rw:<col>:<w> int64 lanes, in order
+    length: "Expr"        # ColRef of @rl:<col>
+    parts: tuple          # literal parts as bytes, in pattern order
+    anchored_start: bool
+    anchored_end: bool
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
 class Agg(Expr):
     func: str           # count | count_star | sum | min | max | avg
     arg: Expr | None
@@ -167,11 +184,13 @@ def walk(e: Expr):
     yield e
     for f in (
         getattr(e, "left", None), getattr(e, "right", None), getattr(e, "arg", None),
-        getattr(e, "else_", None),
+        getattr(e, "else_", None), getattr(e, "length", None),
     ):
         if isinstance(f, Expr):
             yield from walk(f)
     for a in getattr(e, "args", ()) or ():
+        yield from walk(a)
+    for a in getattr(e, "words", ()) or ():
         yield from walk(a)
     for c, v in getattr(e, "whens", ()):
         yield from walk(c)

@@ -52,6 +52,11 @@ def cmd_mirrorroots(args):
     from greengage_tpu.storage.table_store import mirror_root
 
     db = _open(args.dir)
+    if db.replicator is None:
+        print("cluster has no mirrors (re-init with --mirrors)",
+              file=sys.stderr)
+        db.close()
+        return 1
     roots = [os.path.abspath(r) for r in args.roots.split(",") if r]
     if not roots:
         raise ValueError("--roots needs at least one directory")

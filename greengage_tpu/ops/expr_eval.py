@@ -279,6 +279,56 @@ class Evaluator:
             res = res | (v == c)
         return res, val
 
+    def _eval_rawlike(self, e: E.RawLike):
+        """General device LIKE over the staged wide byte window: unpack
+        the int64 word lanes to a [rows, W] byte matrix, then match the
+        pattern's literal parts greedily left-to-right with rolling
+        byte-window equality (pure VPU elementwise/reduce work — no
+        gather/scatter). Greedy-leftmost is exact for %-separated literal
+        parts; END anchors pin the last part at length-L."""
+        word_vals = []
+        valid = None
+        for wref in e.words:
+            v, wv = self.value(wref)
+            word_vals.append(v.astype(jnp.uint64))
+            valid = _and_valid(valid, wv)
+        lens, lv = self.value(e.length)
+        valid = _and_valid(valid, lv)
+        lens = lens.astype(jnp.int32)
+        n = self.n
+        W = 8 * len(word_vals)
+        # [n, W] byte matrix, big-endian within each word
+        cols = []
+        for wv64 in word_vals:
+            for j in range(8):
+                cols.append(((wv64 >> jnp.uint64(56 - 8 * j))
+                             & jnp.uint64(0xFF)).astype(jnp.uint8))
+        B = jnp.stack(cols, axis=1)
+        ok = jnp.ones((n,), bool)
+        prev_end = jnp.zeros((n,), jnp.int32)
+        parts = e.parts
+        for idx, part in enumerate(parts):
+            L = len(part)
+            nwin = W - L + 1
+            if nwin <= 0:
+                ok = jnp.zeros((n,), bool)
+                break
+            m = jnp.ones((n, nwin), bool)
+            for k, byte in enumerate(part):
+                m = m & (B[:, k:k + nwin] == jnp.uint8(byte))
+            s_idx = jnp.arange(nwin, dtype=jnp.int32)
+            m = m & (s_idx[None, :] >= prev_end[:, None])
+            m = m & (s_idx[None, :] + L <= lens[:, None])
+            if idx == 0 and e.anchored_start:
+                m = m & (s_idx[None, :] == 0)
+            if idx == len(parts) - 1 and e.anchored_end:
+                m = m & (s_idx[None, :] + L == lens[:, None])
+            ok = ok & m.any(axis=1)
+            prev_end = jnp.argmax(m, axis=1).astype(jnp.int32) + L
+        if not parts:
+            ok = jnp.ones((n,), bool)
+        return ok, valid
+
     def _eval_func(self, e: E.Func):
         args = [self.value(a) for a in e.args]
         valid = None

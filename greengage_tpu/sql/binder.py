@@ -1756,6 +1756,10 @@ class Binder:
                 elif "%" not in p and "_" not in p and "\\" not in p:
                     # no wildcards at all: LIKE == equality
                     e = self._device_raw_pred(arg, "eq", p)
+                if e is None and "_" not in p and "\\" not in p:
+                    # general %-pattern (contains/suffix/multi-part):
+                    # byte-matrix matching over the staged wide window
+                    e = self._device_raw_like(arg, p)
                 if e is None:
                     e = self._host_pred(arg,
                                         {"op": "like", "pattern": ast.pattern})
@@ -2066,6 +2070,41 @@ class Binder:
         else:
             return None
         return conj[0] if len(conj) == 1 else E.BoolOp("and", tuple(conj))
+
+    def _device_raw_like(self, arg: E.Expr, pattern: str) -> E.Expr | None:
+        """GENERAL device LIKE for raw TEXT (VERDICT r4 #7): any pattern
+        of literal parts separated by % lowers to byte-matrix matching
+        over the staged RAW_WIDE_BYTES window (E.RawLike). Sound only
+        when EVERY committed row fits the window — a longer row could
+        match past it — so the column's exact max length gates the
+        lowering; None falls back to the host path."""
+        if isinstance(arg, E.RawChain) or not isinstance(arg, E.ColRef):
+            return None
+        rr = _raw_ref_of(arg)
+        if rr is None or arg.name not in self._scan_for:
+            return None
+        from greengage_tpu.storage.table_store import (RAW_WIDE_BYTES,
+                                                       RAW_WIDE_WORDS)
+
+        parts = [s.encode("utf-8") for s in pattern.split("%") if s]
+        if any(len(b) > RAW_WIDE_BYTES for b in parts):
+            return None
+        table, col = rr
+        max_len = self.store.raw_max_len(table, col)
+        if max_len > RAW_WIDE_BYTES:
+            return None
+        scan = self._scan_for[arg.name]
+        rl = self._raw_aux_col(scan, f"@rl:{col}", T.INT32)
+        # stage only the lanes the column's rows can occupy — matches can
+        # never extend past max_len (the evaluator sizes W from the lanes)
+        nlanes = min(max(-(-max_len // 8), 1), RAW_WIDE_WORDS)
+        words = tuple(
+            self._raw_aux_col(scan, f"@rw:{col}:{w}", T.INT64)
+            for w in range(nlanes))
+        return E.RawLike(
+            words=words, length=rl, parts=tuple(parts),
+            anchored_start=not pattern.startswith("%"),
+            anchored_end=not pattern.endswith("%"))
 
     def _host_pred(self, arg: E.Expr, payload: dict) -> E.Expr:
         """Lower a predicate over a raw TEXT column into a host-evaluated

@@ -103,6 +103,11 @@ def mirror_root(root: str, content: int) -> str:
 # host path
 RAW_PREFIX_BYTES = 32
 RAW_PREFIX_WORDS = RAW_PREFIX_BYTES // 8
+# wide byte window for GENERAL device LIKE (contains/suffix/multi-part):
+# covers every TPC-H comment-class column; columns with longer rows fall
+# back to the host path (decidability needs the whole string on device)
+RAW_WIDE_BYTES = 128
+RAW_WIDE_WORDS = RAW_WIDE_BYTES // 8
 
 
 def _as_i64(arr: np.ndarray) -> np.ndarray:
@@ -667,6 +672,17 @@ class TableStore:
                 cols[name] = words[:, int(w)]
                 valids[name] = self.raw_chunk(table, seg, rcol, snap).valid
                 continue
+            if name.startswith("@rw:"):
+                # one WIDE packed word (general device LIKE byte window);
+                # pack only the lanes the column's max length needs
+                _, rcol, w = name.split(":", 2)
+                nw = max(-(-self.raw_max_len(table, rcol, snap) // 8),
+                         int(w) + 1)
+                words, _l = self.raw_prefix(table, seg, rcol, snap,
+                                            nwords=min(nw, RAW_WIDE_WORDS))
+                cols[name] = words[:, int(w)]
+                valids[name] = self.raw_chunk(table, seg, rcol, snap).valid
+                continue
             if name.startswith("@rl:"):
                 rcol = name[4:]
                 _w, lens = self.raw_prefix(table, seg, rcol, snap)
@@ -771,7 +787,41 @@ class TableStore:
             self._raw_cache.pop(next(iter(self._raw_cache)))
         return chunk
 
-    def raw_prefix(self, table: str, seg: int, col: str, snapshot=None):
+    def _rawprefix_insert(self, key, val) -> None:
+        """Insert with a BYTE budget (wide word matrices are 4x the old
+        prefix entries, so an entry-count cap alone under-bounds memory)."""
+        cache = self._rawprefix_cache
+        cache[key] = val
+        budget = 512 << 20
+        total = sum(getattr(v, "nbytes", 64) for v in cache.values())
+        while total > budget and len(cache) > 1:
+            k0 = next(iter(cache))
+            total -= getattr(cache[k0], "nbytes", 64)
+            del cache[k0]
+
+    def raw_max_len(self, table: str, col: str, snapshot=None) -> int:
+        """Max utf-8 byte length over every committed row of a raw column
+        (cached per version) — gates device-decidability of general LIKE:
+        rows longer than the staged window could match past it."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = ("@maxlen", table, col, version)
+        hit = self._rawprefix_cache.get(key)
+        if hit is not None:
+            return hit
+        schema = self.catalog.get(table)
+        best = 0
+        for seg in range(schema.policy.numsegments):
+            chunk = self.raw_chunk(table, seg, col, snap)
+            ends = chunk.ends
+            if len(ends):
+                starts = np.concatenate([np.zeros(1, np.int64), ends[:-1]])
+                best = max(best, int((ends - starts).max()))
+        self._rawprefix_insert(key, best)
+        return best
+
+    def raw_prefix(self, table: str, seg: int, col: str, snapshot=None,
+                   nwords: int = RAW_PREFIX_WORDS):
         """Packed fixed-width byte prefix of a raw TEXT column, the device
         representation for on-device equality/LIKE-prefix predicates
         (VERDICT r3 #7): the first RAW_PREFIX_BYTES utf-8 bytes of every
@@ -783,10 +833,13 @@ class TableStore:
         -> (words [n, RAW_PREFIX_WORDS] int64, lengths [n] int32)."""
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
-        key = (table, col, seg, version)
+        key = (table, col, seg, version, nwords)
+        lkey = ("@len", table, col, seg, version)
         hit = self._rawprefix_cache.get(key)
         if hit is not None:
-            return hit
+            lens_hit = self._rawprefix_cache.get(lkey)
+            if lens_hit is not None:    # may be independently evicted
+                return hit, lens_hit
         chunk = self.raw_chunk(table, seg, col, snap)
         ends = chunk.ends
         n = len(ends)
@@ -796,28 +849,28 @@ class TableStore:
         starts = (np.concatenate([np.zeros(1, np.int64), ends[:-1]])
                   if n else np.zeros(0, np.int64))
         lengths = (ends - starts).astype(np.int32)
-        words = np.zeros((n, RAW_PREFIX_WORDS), np.uint64)
+        words = np.zeros((n, nwords), np.uint64)
         if n and len(blob):
-            # chunk rows: the transient n x 32 gather matrices would
-            # otherwise spike ~800B/row of host memory on big segments
-            CH = 1 << 20
-            steps = np.arange(RAW_PREFIX_BYTES, dtype=np.int64)[None, :]
+            # chunk rows: the transient n x width gather matrices would
+            # otherwise spike ~KB/row of host memory on big segments
+            # scale the chunk inversely with the window so the transient
+            # gather matrices stay ~bounded regardless of nwords
+            CH = max((1 << 22) // max(nwords, 1), 1 << 16)
+            steps = np.arange(nwords * 8, dtype=np.int64)[None, :]
             for a in range(0, n, CH):
                 b = min(a + CH, n)
                 idx = starts[a:b, None] + steps
                 m = idx < ends[a:b, None]
                 data = np.where(m, blob[np.minimum(idx, len(blob) - 1)],
                                 np.uint8(0)).astype(np.uint64)
-                for w in range(RAW_PREFIX_WORDS):
+                for w in range(nwords):
                     acc = np.zeros(b - a, np.uint64)
                     for j in range(8):
                         acc = (acc << np.uint64(8)) | data[:, w * 8 + j]
                     words[a:b, w] = acc
-        out = (words.view(np.int64), lengths)
-        self._rawprefix_cache[key] = out
-        if len(self._rawprefix_cache) > 64:
-            self._rawprefix_cache.pop(next(iter(self._rawprefix_cache)))
-        return out
+        self._rawprefix_insert(key, words.view(np.int64))
+        self._rawprefix_insert(lkey, lengths)
+        return words.view(np.int64), lengths
 
     @staticmethod
     def host_pred_name(col: str, payload: dict) -> str:
@@ -1301,7 +1354,7 @@ class TableStore:
     def has_nulls(self, table: str, col: str, snapshot: dict | None = None) -> bool:
         """True if any committed segfile of this column has a validity file
         (compile-time schema for the executor's input staging)."""
-        if col.startswith("@hp:") or col.startswith("@rp:"):
+        if col.startswith(("@hp:", "@rp:", "@rw:")):
             col = col.split(":", 2)[1]   # predicate nullability = column's
         elif col.startswith("@rc:") or col.startswith("@rl:"):
             col = col[4:]                # code/length nullability = column's

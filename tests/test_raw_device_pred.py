@@ -89,8 +89,18 @@ def test_wildcard_free_like_is_equality(db):
     assert db.sql(q).rows() == [(11,)]
 
 
-def test_general_pattern_still_host(db):
+def test_general_pattern_now_on_device(db):
+    # '%contains%' moved on-device via the wide byte window (r5); only
+    # _-wildcards and escapes still take the host path
     q = "select count(*) from m where s like '%payload%'"
+    cols = _scan_cols(db, q)
+    assert any(c.startswith("@rw:") for c in cols), cols
+    assert not any(c.startswith("@hp:") for c in cols), cols
+    assert db.sql(q).rows()[0][0] == 8996
+
+
+def test_underscore_pattern_still_host(db):
+    q = "select count(*) from m where s like '%payl_ad%'"
     cols = _scan_cols(db, q)
     assert any(c.startswith("@hp:") for c in cols), cols
     assert db.sql(q).rows()[0][0] == 8996
