@@ -76,10 +76,9 @@ def cmd_mirrorroots(args):
                 shutil.rmtree(new)
             shutil.move(old[k], new)
         print(f"  content {k}: mirror tree at {new}")
-    if db.replicator is not None:
-        db.replicator.sync()
-        db.catalog._save()
-        print("mirrors re-synced at the new roots")
+    db.replicator.sync()
+    db.catalog._save()
+    print("mirrors re-synced at the new roots")
     db.close()
     return 0
 

@@ -147,6 +147,8 @@ class FuncCall(ANode):
     star: bool = False            # count(*)
     distinct: bool = False
     over: "WindowSpec | None" = None
+    # ordered-set aggregates: percentile_cont(q) WITHIN GROUP (ORDER BY e)
+    within_order: "ANode | None" = None
 
 
 @dataclass

@@ -130,6 +130,13 @@ class Binder:
         from greengage_tpu.sql.stataggs import expand_stat_aggs
 
         expand_stat_aggs(stmt)
+        # ordered-set aggregates rewrite the WHOLE statement (windowed
+        # inner + order-statistic outer, sql/orderedset.py)
+        from greengage_tpu.sql.orderedset import expand_ordered_set
+
+        repl = expand_ordered_set(stmt)
+        if repl is not None:
+            return self._bind_select(repl)
         if stmt.grouping_sets is not None:
             return self._bind_grouping_sets(stmt)
         # peel subquery predicates (IN/EXISTS) off the WHERE — they become

@@ -1,0 +1,241 @@
+"""Ordered-set aggregates — percentile_cont / percentile_disc / median.
+
+Reference parity: the WITHIN GROUP ordered-set aggregates of
+pg_aggregate.h:246 (percentile_* executed by sorting each group,
+nodeAgg.c ordered-set path). The TPU-first translation avoids a new
+executor mode entirely: the statement rewrites pre-bind into
+
+    inner:  select *, row_number() over (partition by <group keys>
+                                         order by <e>)   as __osrn_i,
+                      count(<e>)  over (partition by ...) as __oscnt_i
+    outer:  the original select over (inner), each percentile replaced
+            by MAX(CASE WHEN __osrn = <order statistic position> ...)
+
+so the heavy work is the engine's existing distributed window sort, and
+the order statistic itself is an ordinary grouped aggregate — dense /
+sort paths, spill, and multihost lockstep all apply unchanged.
+
+Position math (PG semantics): cont: pos = 1 + q*(n-1), linear
+interpolation between floor/ceil rows, result double precision; disc:
+the first value at cumulative fraction >= q (position max(ceil(q*n), 1)),
+original type. NULL order keys sort last with row numbers past count(e),
+so they never select — PG's NULL-ignoring behavior for free. median(x)
+is percentile_cont(0.5). DESC within-group order is rejected at parse."""
+
+from __future__ import annotations
+
+import copy
+
+from greengage_tpu.sql import ast as A
+from greengage_tpu.sql.parser import SqlError
+
+ORDERED_SET = {"percentile_cont", "percentile_disc", "median"}
+
+
+def _collect(stmt) -> list:
+    calls: list = []
+
+    def walk(n):
+        if isinstance(n, A.SelectStmt):
+            return
+        if isinstance(n, A.FuncCall):
+            if n.within_order is not None and n.name not in ORDERED_SET:
+                raise SqlError(
+                    f"WITHIN GROUP is not supported for {n.name}()")
+            if n.name in ORDERED_SET and n.over is None:
+                calls.append(n)
+                return
+        import dataclasses
+
+        if isinstance(n, A.ANode):
+            for f in dataclasses.fields(n):
+                walk(getattr(n, f.name))
+        elif isinstance(n, (list, tuple)):
+            for v in n:
+                walk(v)
+
+    for it in stmt.items:
+        walk(it.expr)
+    if stmt.having is not None:
+        walk(stmt.having)
+    for oi in stmt.order_by:
+        walk(oi.expr)
+    return calls
+
+
+def _resolved_group_keys(stmt) -> list:
+    """GROUP BY entries with ordinals resolved to their select-item
+    expressions (the binder's own ordinal rule) — a verbatim A.Num copied
+    into a window PARTITION BY would bind as a constant instead."""
+    out = []
+    for g in stmt.group_by:
+        if isinstance(g, A.Num) and "." not in g.text:
+            idx = int(g.text) - 1
+            if not 0 <= idx < len(stmt.items):
+                raise SqlError(f"GROUP BY position {g.text} out of range")
+            out.append(stmt.items[idx].expr)
+        else:
+            out.append(g)
+    return out
+
+
+def _strip_qualifiers(n):
+    """Rewrite table-qualified Names to bare columns: the outer statement
+    reads the flattened __os subquery, where the original table aliases
+    no longer exist (PG would keep them; the Star-flattening loses them
+    by construction)."""
+    import dataclasses
+
+    if isinstance(n, A.SelectStmt):
+        return n
+    if isinstance(n, A.Name) and len(n.parts) > 1:
+        return A.Name((n.parts[-1],))
+    if isinstance(n, A.ANode):
+        for f in dataclasses.fields(n):
+            setattr(n, f.name, _strip_qualifiers(getattr(n, f.name)))
+        return n
+    if isinstance(n, list):
+        return [_strip_qualifiers(v) for v in n]
+    if isinstance(n, tuple):
+        return tuple(_strip_qualifiers(v) for v in n)
+    return n
+
+
+def _qfrac(call: A.FuncCall) -> float:
+    if call.name == "median":
+        if len(call.args) != 1:
+            raise SqlError("median() takes exactly one argument")
+        return 0.5
+    if len(call.args) != 1 or not isinstance(call.args[0], A.Num):
+        raise SqlError(f"{call.name}() needs a literal fraction argument")
+    q = float(call.args[0].text)
+    if not 0.0 <= q <= 1.0:
+        raise SqlError(f"{call.name}() fraction must be in [0, 1]")
+    return q
+
+
+def _order_expr(call: A.FuncCall):
+    if call.name == "median":
+        return call.args[0]
+    if call.within_order is None:
+        raise SqlError(
+            f"{call.name}() requires WITHIN GROUP (ORDER BY ...)")
+    return call.within_order
+
+
+def _num(v) -> A.ANode:
+    return A.Num(repr(float(v)) if isinstance(v, float) else str(v))
+
+
+def expand_ordered_set(stmt: A.SelectStmt):
+    """-> replacement SelectStmt, or None when no ordered-set aggregates
+    appear."""
+    from greengage_tpu.sql.binder import _ast_key
+
+    calls = _collect(stmt)
+    if not calls:
+        return None
+    if stmt.grouping_sets is not None:
+        raise SqlError(
+            "percentile aggregates cannot combine with ROLLUP/CUBE/"
+            "GROUPING SETS yet")
+    if not stmt.from_:
+        raise SqlError("percentile aggregates need a FROM clause")
+
+    group_keys = _resolved_group_keys(stmt)
+    # one window pair per DISTINCT order expression
+    order_of: dict[str, tuple[int, A.ANode]] = {}
+    for c in calls:
+        e = _order_expr(c)
+        k = _ast_key(e)
+        if k not in order_of:
+            order_of[k] = (len(order_of), e)
+
+    inner = A.SelectStmt()
+    inner.from_ = stmt.from_
+    inner.where = stmt.where
+    inner.items = [A.SelectItem(A.Star())]
+    for k, (i, e) in order_of.items():
+        over_rank = A.WindowSpec(
+            partition_by=[copy.deepcopy(g) for g in group_keys],
+            order_by=[A.OrderItem(copy.deepcopy(e))])
+        # the count window must NOT carry the order key: an ordered count
+        # is a RUNNING count up to peers, not the group size
+        over_cnt = A.WindowSpec(
+            partition_by=[copy.deepcopy(g) for g in group_keys])
+        inner.items.append(A.SelectItem(
+            A.FuncCall("row_number", [], over=over_rank),
+            alias=f"__osrn{i}"))
+        inner.items.append(A.SelectItem(
+            A.FuncCall("count", [copy.deepcopy(e)], over=over_cnt),
+            alias=f"__oscnt{i}"))
+
+    def replacement(call: A.FuncCall) -> A.ANode:
+        q = _qfrac(call)
+        e = _order_expr(call)
+        i = order_of[_ast_key(e)][0]
+        rn = A.Name((f"__osrn{i}",))
+        cnt = A.Name((f"__oscnt{i}",))
+
+        def mx(arg):
+            return A.FuncCall("max", [arg])
+
+        def when(cond, val):
+            return A.CaseExpr(whens=[(cond, val)], else_=None)
+
+        if call.name == "percentile_disc":
+            posd = A.FuncCall("ceiling", [
+                A.Bin("*", _num(q), copy.deepcopy(cnt))])
+            posd = A.CaseExpr(
+                whens=[(A.Bin("<", posd, _num(1)), _num(1))],
+                else_=copy.deepcopy(posd))
+            return mx(when(A.Bin("=", rn, posd), copy.deepcopy(e)))
+        # cont / median: interpolate between the floor/ceil positions
+        xf = A.CastExpr(copy.deepcopy(e), "double precision")
+
+        def pos_over(cnt_node):
+            return A.Bin("+", _num(1), A.Bin(
+                "*", _num(q), A.Bin("-", cnt_node, _num(1))))
+
+        vlo = mx(when(A.Bin("=", copy.deepcopy(rn), A.FuncCall(
+            "floor", [pos_over(copy.deepcopy(cnt))])), xf))
+        vhi = mx(when(A.Bin("=", copy.deepcopy(rn), A.FuncCall(
+            "ceiling", [pos_over(copy.deepcopy(cnt))])),
+            copy.deepcopy(xf)))
+        pos_g = pos_over(mx(copy.deepcopy(cnt)))
+        frac = A.Bin("-", pos_g, A.FuncCall(
+            "floor", [copy.deepcopy(pos_g)]))
+        return A.Bin("+", vlo, A.Bin("*", frac, A.Bin("-", vhi,
+                                                      copy.deepcopy(vlo))))
+
+    def rewrite(n):
+        import dataclasses
+
+        if isinstance(n, A.SelectStmt):
+            return n
+        if isinstance(n, A.FuncCall) and n.name in ORDERED_SET \
+                and n.over is None:
+            return replacement(n)
+        if isinstance(n, A.ANode):
+            for f in dataclasses.fields(n):
+                setattr(n, f.name, rewrite(getattr(n, f.name)))
+            return n
+        if isinstance(n, list):
+            return [rewrite(v) for v in n]
+        if isinstance(n, tuple):
+            return tuple(rewrite(v) for v in n)
+        return n
+
+    outer = A.SelectStmt(
+        items=stmt.items, from_=[A.SubqueryRef(inner, "__os")],
+        where=None, group_by=stmt.group_by, having=stmt.having,
+        order_by=stmt.order_by, limit=stmt.limit, offset=stmt.offset,
+        distinct=stmt.distinct)
+    for it in outer.items:
+        it.expr = _strip_qualifiers(rewrite(it.expr))
+    outer.group_by = [_strip_qualifiers(g) for g in outer.group_by]
+    if outer.having is not None:
+        outer.having = _strip_qualifiers(rewrite(outer.having))
+    for oi in outer.order_by:
+        oi.expr = _strip_qualifiers(rewrite(oi.expr))
+    return outer

@@ -702,6 +702,20 @@ class Parser:
                         while self.accept("op", ","):
                             args.append(self.expr())
                 self.expect("op", ")")
+                within = None
+                if self.at_word("within") and self.peek(1) == ("kw", "group"):
+                    self.next()
+                    self.next()
+                    self.expect("op", "(")
+                    self.expect("kw", "order")
+                    self.expect("kw", "by")
+                    within = self.expr()
+                    if self.accept("kw", "desc"):
+                        raise SqlError(
+                            "WITHIN GROUP (ORDER BY ... DESC) is not "
+                            "supported; use 1-q with ascending order")
+                    self.accept("kw", "asc")
+                    self.expect("op", ")")
                 over = None
                 if self.accept("kw", "over"):
                     self.expect("op", "(")
@@ -729,7 +743,7 @@ class Parser:
                         over.frame = (mode, lo, hi)
                     self.expect("op", ")")
                 return A.FuncCall(fname, args, star=star, distinct=distinct,
-                                  over=over)
+                                  over=over, within_order=within)
             parts = [self.next()[1]]
             while self.peek() == ("op", ".") and self.peek(1)[0] == "name":
                 self.next()

@@ -1,0 +1,106 @@
+"""Ordered-set aggregates: percentile_cont / percentile_disc / median
+WITHIN GROUP (pg_aggregate.h:246 ordered-set family) — rewritten onto
+the engine's distributed window sort + grouped order statistics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.sql.parser import SqlError
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(21)
+    n = 400
+    g = rng.integers(0, 4, n).astype(np.int32)
+    x = rng.normal(100, 25, n)
+    nulls = rng.random(n) < 0.1
+    d.sql("create table ps (g int, x double precision, w int, k int) "
+          "distributed by (k)")
+    d.load_table("ps", {"g": g, "x": x,
+                        "w": rng.integers(0, 9, n).astype(np.int32),
+                        "k": np.arange(n, dtype=np.int32)})
+    d.sql("update ps set x = null where k in (%s)" %
+          ",".join(str(i) for i in np.flatnonzero(nulls)))
+    d.df = pd.DataFrame({"g": g, "x": np.where(nulls, np.nan, x)})
+    yield d
+    d.close()
+
+
+def test_percentile_cont_grouped(db):
+    r = db.sql("select g, percentile_cont(0.5) within group (order by x) m,"
+               " percentile_cont(0.9) within group (order by x) p90"
+               " from ps group by g order by g")
+    for grp, m, p90 in r.rows():
+        vals = db.df[db.df.g == grp].x.dropna()
+        np.testing.assert_allclose(m, np.percentile(vals, 50), rtol=1e-12)
+        np.testing.assert_allclose(p90, np.percentile(vals, 90), rtol=1e-12)
+
+
+def test_percentile_disc_and_median(db):
+    r = db.sql("select g, percentile_disc(0.25) within group (order by x) d,"
+               " median(x) med from ps group by g order by g")
+    for grp, dv, med in r.rows():
+        vals = db.df[db.df.g == grp].x.dropna().sort_values()
+        want_d = vals.iloc[max(int(np.ceil(0.25 * len(vals))), 1) - 1]
+        np.testing.assert_allclose(dv, want_d, rtol=1e-12)
+        np.testing.assert_allclose(med, np.percentile(vals, 50), rtol=1e-12)
+
+
+def test_scalar_percentile_with_other_aggs(db):
+    r = db.sql("select count(*), percentile_cont(0.5) within group "
+               "(order by x), sum(w) from ps")
+    n, med, sw = r.rows()[0]
+    assert n == len(db.df)
+    np.testing.assert_allclose(
+        med, np.percentile(db.df.x.dropna(), 50), rtol=1e-12)
+
+
+def test_percentile_edge_fractions(db):
+    r = db.sql("select percentile_cont(0) within group (order by x) lo,"
+               " percentile_cont(1) within group (order by x) hi,"
+               " percentile_disc(0) within group (order by x) dlo"
+               " from ps")
+    lo, hi, dlo = r.rows()[0]
+    vals = db.df.x.dropna()
+    np.testing.assert_allclose(lo, vals.min(), rtol=1e-12)
+    np.testing.assert_allclose(hi, vals.max(), rtol=1e-12)
+    np.testing.assert_allclose(dlo, vals.min(), rtol=1e-12)
+
+
+def test_percentile_in_expression_and_filter(db):
+    r = db.sql("select g from ps group by g "
+               "having percentile_cont(0.5) within group (order by x) > 95 "
+               "order by g")
+    want = [g for g in range(4)
+            if np.percentile(db.df[db.df.g == g].x.dropna(), 50) > 95]
+    assert [row[0] for row in r.rows()] == want
+
+
+def test_errors(db):
+    with pytest.raises(SqlError, match="WITHIN GROUP"):
+        db.sql("select percentile_cont(0.5) from ps")
+    with pytest.raises(SqlError, match="fraction"):
+        db.sql("select percentile_cont(1.5) within group (order by x) from ps")
+    with pytest.raises(SqlError, match="DESC"):
+        db.sql("select percentile_cont(0.5) within group (order by x desc) "
+               "from ps")
+
+
+def test_group_by_ordinal_and_qualified_names(db):
+    r1 = db.sql("select g, percentile_cont(0.5) within group (order by x) "
+                "from ps group by 1 order by 1")
+    r2 = db.sql("select ps.g, percentile_cont(0.5) within group "
+                "(order by ps.x) from ps group by ps.g order by ps.g")
+    assert r1.rows() == r2.rows()
+    for grp, m in r1.rows():
+        vals = db.df[db.df.g == grp].x.dropna()
+        np.testing.assert_allclose(m, np.percentile(vals, 50), rtol=1e-12)
+
+
+def test_within_group_rejected_for_plain_aggs(db):
+    with pytest.raises(SqlError, match="not supported for sum"):
+        db.sql("select sum(x) within group (order by x) from ps")
