@@ -37,7 +37,7 @@ from greengage_tpu.parallel import SEG_AXIS
 from greengage_tpu.parallel import motion as motion_ops
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.planner.logical import (
-    Aggregate, Filter, Join, Limit, Motion, MotionKind, PartialState, Plan,
+    Aggregate, ConstRel, Filter, Join, Limit, Motion, MotionKind, PartialState, Plan,
     Project, Scan, Sort, Union, Window,
 )
 
@@ -433,6 +433,8 @@ class Compiler:
 
     def _capacity_of(self, plan: Plan) -> int:
         """Static per-segment row capacity of a node's output batch."""
+        if isinstance(plan, ConstRel):
+            return 1
         if isinstance(plan, Scan):
             if plan.table in self.scan_caps:
                 return self.scan_caps[plan.table]
@@ -447,6 +449,8 @@ class Compiler:
             return cap
         if isinstance(plan, Join):
             probe_cap = self._capacity_of(plan.left)
+            if plan.kind == "cross":
+                return probe_cap * max(self._capacity_of(plan.right), 1)
             if getattr(plan, "multi", False) and plan.kind in ("inner", "left"):
                 if self._nid(plan) in self.cap_overrides:
                     # exact cardinality reported by the overflowed run
@@ -552,6 +556,15 @@ class Compiler:
 
         return counted
 
+    def _c_constrel(self, plan):
+        def run(ctx):
+            from jax import lax
+
+            sel = (lax.axis_index(SEG_AXIS) == 0)[None]   # [1], seg0 only
+            return Batch({}, {}, sel)
+
+        return run
+
     def _c_scan(self, plan: Scan):
         table = plan.table
         id_by_store = [(c.id, c.name) for c in plan.cols]
@@ -615,9 +628,33 @@ class Compiler:
     def _dict_for_col(self, col_id: str):
         return self._dict_refs.get(col_id)
 
+    def _c_join_cross(self, plan: Join):
+        """Cartesian pairing by repeat/tile index expansion — practical for
+        the small (usually broadcast single-row ConstRel) build sides the
+        planner produces; capacity = |L| x |B| keeps it honest under the
+        vmem admission estimate for anything bigger."""
+        left_fn = self._compile_node(plan.left)
+        right_fn = self._compile_node(plan.right)
+        Lcap = self._capacity_of(plan.left)
+        Bcap = max(self._capacity_of(plan.right), 1)
+
+        def run(ctx):
+            lb = left_fn(ctx)
+            rb = right_fn(ctx)
+            li = jnp.repeat(jnp.arange(Lcap), Bcap)
+            ri = jnp.tile(jnp.arange(Bcap), Lcap)
+            cols = {cid: a[li] for cid, a in lb.cols.items()}
+            cols.update({cid: a[ri] for cid, a in rb.cols.items()})
+            valids = {cid: v[li] for cid, v in lb.valids.items()}
+            valids.update({cid: v[ri] for cid, v in rb.valids.items()})
+            sel = lb.selection()[li] & rb.selection()[ri]
+            return Batch(cols, valids, sel)
+
+        return run
+
     def _c_join(self, plan: Join):
         if plan.kind == "cross":
-            raise NotImplementedError("cross join execution")
+            return self._c_join_cross(plan)
         if getattr(plan, "multi", False):
             return self._c_join_multi(plan)
         left_fn = self._compile_node(plan.left)

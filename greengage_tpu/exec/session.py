@@ -1172,7 +1172,11 @@ class Database:
         if rctes:
             return self._select_recursive(stmt, rctes)
         if isinstance(stmt, A.SelectStmt) and not stmt.from_:
-            return self._const_select(stmt)
+            try:
+                return self._const_select(stmt)
+            except SqlError:
+                pass   # shapes the host fast path can't do (aggregates,
+                # subqueries) fall through to the ConstRel device path
         planned, consts, outs, exec_key = self._cached_plan(stmt)
         # external tables materialize to host arrays before execution
         # (fileam external_beginscan role); first-seen strings grow the

@@ -30,6 +30,9 @@ class Batch:
     def capacity(self) -> int:
         for a in self.cols.values():
             return int(a.shape[0])
+        # columnless batch (ConstRel): the selection mask carries the shape
+        if self.sel is not None:
+            return int(self.sel.shape[0])
         return 0
 
     def selection(self) -> jax.Array:

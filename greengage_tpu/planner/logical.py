@@ -55,6 +55,16 @@ class Plan:
 
 
 @dataclass
+class ConstRel(Plan):
+    """One-row constant relation — the FROM-less SELECT leaf (PG's
+    degenerate RangeTblEntry-free Result plan). Live on segment 0 only,
+    so the single logical row exists exactly once on the mesh."""
+
+    def out_cols(self) -> list:
+        return []
+
+
+@dataclass
 class Scan(Plan):
     table: str
     cols: list[ColInfo]            # id = unique, name = storage column name

@@ -77,6 +77,11 @@ class Planner:
             node.locus = Locus.strewn(nseg)
         return node
 
+    def _plan_constrel(self, node) -> Plan:
+        node.locus = Locus.strewn(self.nseg)
+        node.est_rows = 1.0
+        return node
+
     def _plan_filter(self, node: Filter) -> Plan:
         node.child = self._rec(node.child)
         node.locus = node.child.locus
@@ -269,7 +274,15 @@ class Planner:
                     and all(k in side_map for k in locus.keys))
 
         if node.kind == "cross":
-            # broadcast the (smaller) right side
+            # broadcast the SMALLER side (cross-join outputs are selected
+            # by id, so the sides may swap freely); without the swap a
+            # 1-row constant relation on the left would broadcast the
+            # whole table on the right
+            if left.est_rows < right.est_rows \
+                    and left.locus.kind is not LocusKind.SEGMENT_GENERAL \
+                    and right.locus.is_partitioned:
+                node.left, node.right = node.right, node.left
+                left, right = right, left
             if right.locus.kind is not LocusKind.SEGMENT_GENERAL:
                 node.right = self._broadcast(right)
             node.locus = left.locus

@@ -80,6 +80,8 @@ class Scope:
         return hits[0]
 
     def all_cols(self) -> list[ColInfo]:
+        if getattr(self, "empty_from", False):
+            raise SqlError("SELECT * with no tables specified")
         return [c for _, cols in self.tables for c in cols.values()]
 
     def table_cols(self, alias: str) -> list[ColInfo]:
@@ -795,7 +797,17 @@ class Binder:
         above this FROM (when the caller is a grouped SELECT): the memo
         search folds its completion cost into join-order selection."""
         if not from_:
-            raise SqlError("SELECT without FROM is not supported")
+            # FROM-less SELECT (PG's Result node): one-row constant
+            # relation, live on segment 0 — lets `select 1` work as a
+            # subquery / union branch / recursive base term
+            from greengage_tpu.planner.logical import ConstRel
+
+            plan = ConstRel()
+            scope = Scope()
+            scope.add("", {})
+            scope.empty_from = True   # Star over this scope must error
+            leftover = where
+            return plan, scope, leftover
         items = [self._bind_table_ref(t) for t in from_]
 
         conjuncts = _split_and(where) if where is not None else []
@@ -1506,6 +1518,7 @@ class Binder:
                     sel_exprs.append((ci, E.ColRef(c.id, c.type)))
                 continue
             e = self._rewritten_expr(it.expr, rewrites, scope, allow_plain)
+            e = self._text_literal_to_dict(e)
             name = it.alias or _ast_name(it.expr)
             if isinstance(e, E.RawChain) and e.type.kind is not T.Kind.TEXT:
                 raise SqlError(
@@ -1515,6 +1528,18 @@ class Binder:
                          raw_ref=_raw_ref_of(e), raw_chain=_raw_chain_of(e))
             sel_exprs.append((ci, e))
         return scope, sel_exprs
+
+    def _text_literal_to_dict(self, e: E.Expr) -> E.Expr:
+        """A projected TEXT constant has no device representation of its
+        own: lower it to code 0 of a one-entry derived dictionary (the
+        same mechanism string-function results ride)."""
+        if isinstance(e, E.Literal) and e.type.kind is T.Kind.TEXT \
+                and isinstance(e.value, str):
+            ref = self.store.derived_dictionary([e.value])
+            lit = E.Literal(0, T.TEXT)
+            object.__setattr__(lit, "_dict_ref", ref)
+            return lit
+        return e
 
     def _raw_to_codes(self, e: E.Expr):
         """Raw-TEXT expression -> dictionary-coded expression under the

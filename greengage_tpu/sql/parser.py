@@ -480,7 +480,7 @@ class Parser:
                 ctes = self.with_prefix()
                 q = _substitute_ctes(self.select_or_union(), ctes)
             else:
-                q = self.select_stmt()
+                q = self.select_or_union()
             self.expect("op", ")")
             self.accept("kw", "as")
             alias = self.expect("name")[1]

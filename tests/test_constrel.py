@@ -1,0 +1,57 @@
+"""FROM-less SELECT as a relation (PG Result node / ConstRel leaf) +
+cartesian joins against small relations + UNION in derived tables."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table t (a int, b int) distributed by (a)")
+    d.sql("insert into t values (5, 1), (6, 2), (7, 3)")
+    yield d
+    d.close()
+
+
+def test_constant_subquery(db):
+    assert db.sql("select q.x from (select 1 as x) q").rows() == [(1,)]
+    assert db.sql("select x + y from (select 2 as x, 3 as y) q").rows() \
+        == [(5,)]
+
+
+def test_cross_join_constants_onto_table(db):
+    r = db.sql("select a, s.x from t, (select 41 as x) s order by a")
+    assert r.rows() == [(5, 41), (6, 41), (7, 41)]
+
+
+def test_plain_cte_constant_body(db):
+    r = db.sql("with c as (select 7 as v) select a + c.v from t, c "
+               "order by 1")
+    assert r.rows() == [(12,), (13,), (14,)]
+
+
+def test_union_in_derived_table(db):
+    r = db.sql("select x from (select 1 as x union all select 2) u "
+               "order by x")
+    assert r.rows() == [(1,), (2,)]
+
+
+def test_small_cartesian_product(db):
+    r = db.sql("select a, u.y from t, (select 1 as y union all select 2) u "
+               "order by a, y")
+    assert r.rows() == [(5, 1), (5, 2), (6, 1), (6, 2), (7, 1), (7, 2)]
+
+
+def test_cartesian_with_aggregate(db):
+    r = db.sql("select count(*), sum(a + u.y) from t, "
+               "(select 10 as y union all select 20) u")
+    assert r.rows() == [(6, (5 + 6 + 7) * 2 + 3 * 30)]
+
+
+def test_recursive_cte_constant_base(db):
+    r = db.sql("with recursive s(n) as (select 1 union all "
+               "select n + 1 from s where n < 6) select sum(n) from s")
+    assert r.rows() == [(21,)]
