@@ -25,7 +25,8 @@ from greengage_tpu.parallel import make_mesh
 from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
 from greengage_tpu.sql import ast as A
-from greengage_tpu.sql.binder import Binder, type_from_name
+from greengage_tpu.sql.binder import (Binder, _contains_agg,
+                                       type_from_name)
 from greengage_tpu.sql.parser import SqlError, parse
 from greengage_tpu.storage import TableStore
 
@@ -1172,11 +1173,17 @@ class Database:
         if rctes:
             return self._select_recursive(stmt, rctes)
         if isinstance(stmt, A.SelectStmt) and not stmt.from_:
-            try:
+            # pre-screen BEFORE attempting the host fast path: a bind-time
+            # failure after an InitPlan scalar subquery already executed
+            # would re-run that subquery on the device-path retry
+            fastpath = (not stmt.group_by and not stmt.having
+                        and not stmt.distinct
+                        and not any(_contains_agg(it.expr)
+                                    for it in stmt.items)
+                        and not any(isinstance(it.expr, A.Star)
+                                    for it in stmt.items))
+            if fastpath:
                 return self._const_select(stmt)
-            except SqlError:
-                pass   # shapes the host fast path can't do (aggregates,
-                # subqueries) fall through to the ConstRel device path
         planned, consts, outs, exec_key = self._cached_plan(stmt)
         # external tables materialize to host arrays before execution
         # (fileam external_beginscan role); first-seen strings grow the

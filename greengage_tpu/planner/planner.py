@@ -278,9 +278,12 @@ class Planner:
             # by id, so the sides may swap freely); without the swap a
             # 1-row constant relation on the left would broadcast the
             # whole table on the right
-            if left.est_rows < right.est_rows \
-                    and left.locus.kind is not LocusKind.SEGMENT_GENERAL \
-                    and right.locus.is_partitioned:
+            if right.locus.is_partitioned and (
+                    left.est_rows < right.est_rows
+                    or left.locus.kind is LocusKind.SEGMENT_GENERAL):
+                # also swap a REPLICATED left: as the build side it needs
+                # no motion at all, where keeping it on the left forces a
+                # broadcast of the partitioned right
                 node.left, node.right = node.right, node.left
                 left, right = right, left
             if right.locus.kind is not LocusKind.SEGMENT_GENERAL:

@@ -158,3 +158,57 @@ def test_ds_q52_brand_by_month(env):
     assert len(got) == min(10, len(want))
     for row, (_, w) in zip(got, want.iterrows()):
         assert (row[0], row[1], row[2]) == (w.d_year, w.i_brand_id, w.p)
+
+
+def test_ds_q27_rollup_with_grouping(env):
+    """TPC-DS Q27 shape: fact joined to dims, GROUP BY ROLLUP over two
+    attributes with avg + grouping(), vs a pandas oracle."""
+    d, f = env
+    r = d.sql("""select i_category, s_state, grouping(i_category, s_state) g,
+        avg(ss_quantity) aq, count(*) c
+      from store_sales, item, store
+      where ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+        and i_manager_id < 10
+      group by rollup(i_category, s_state)
+      order by g, i_category, s_state""")
+    j = (f["store_sales"]
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[j.i_manager_id < 10]
+    got = r.rows()
+    # leaf level
+    leaf = (j.groupby(["i_category", "s_state"])
+             .ss_quantity.agg(["mean", "size"]))
+    for cat, st, g, aq, c in got:
+        if g == 0:
+            np.testing.assert_allclose(aq, leaf.loc[(cat, st), "mean"],
+                                       rtol=1e-12)
+            assert c == leaf.loc[(cat, st), "size"]
+        elif g == 1:
+            assert st is None
+            np.testing.assert_allclose(
+                aq, j[j.i_category == cat].ss_quantity.mean(), rtol=1e-12)
+        else:
+            assert cat is None and st is None
+            np.testing.assert_allclose(aq, j.ss_quantity.mean(), rtol=1e-12)
+    n_leaf = j.groupby(["i_category", "s_state"]).ngroups
+    assert len(got) == n_leaf + j.i_category.nunique() + 1
+
+
+def test_ds_q22_style_percentile_by_category(env):
+    """TPC-DS-style order statistics per category: median + p90 of fact
+    quantities through the ordered-set path at join scale."""
+    d, f = env
+    r = d.sql("""select i_category,
+        percentile_cont(0.5) within group (order by ss_quantity) med,
+        percentile_cont(0.9) within group (order by ss_quantity) p90
+      from store_sales, item
+      where ss_item_sk = i_item_sk and i_brand_id < 20
+      group by i_category order by i_category""")
+    j = (f["store_sales"]
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[j.i_brand_id < 20]
+    for cat, med, p90 in r.rows():
+        vals = j[j.i_category == cat].ss_quantity
+        np.testing.assert_allclose(med, np.percentile(vals, 50), rtol=1e-12)
+        np.testing.assert_allclose(p90, np.percentile(vals, 90), rtol=1e-12)
