@@ -1177,13 +1177,20 @@ class Database:
             # failure after an InitPlan scalar subquery already executed
             # would re-run that subquery on the device-path retry
             fastpath = (not stmt.group_by and not stmt.having
-                        and not stmt.distinct
+                        and not stmt.distinct and not stmt.order_by
                         and not any(_contains_agg(it.expr)
                                     for it in stmt.items)
                         and not any(isinstance(it.expr, A.Star)
                                     for it in stmt.items))
             if fastpath:
-                return self._const_select(stmt)
+                try:
+                    return self._const_select(stmt)
+                except SqlError:
+                    pass   # residual host-path rejections (non-constant
+                    # text exprs, stat aggregates the screen can't see)
+                    # fall through to the ConstRel device path; the
+                    # screen above keeps InitPlan subqueries from running
+                    # twice for the COMMON fallthrough shapes
         planned, consts, outs, exec_key = self._cached_plan(stmt)
         # external tables materialize to host arrays before execution
         # (fileam external_beginscan role); first-seen strings grow the

@@ -139,6 +139,13 @@ class Binder:
         repl = expand_ordered_set(stmt)
         if repl is not None:
             return self._bind_select(repl)
+        # windows over grouped aggregates: two-level rewrite (inner agg,
+        # outer windows — sql/winagg.py, the WindowAgg-over-Agg stack)
+        from greengage_tpu.sql.winagg import expand_windows_over_aggs
+
+        repl = expand_windows_over_aggs(stmt)
+        if repl is not None:
+            return self._bind_select(repl)
         if stmt.grouping_sets is not None:
             return self._bind_grouping_sets(stmt)
         # peel subquery predicates (IN/EXISTS) off the WHERE — they become

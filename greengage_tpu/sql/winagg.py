@@ -1,0 +1,118 @@
+"""Window functions over grouped aggregates.
+
+Reference parity: WindowAgg stacked above Agg in one plan
+(nodeWindowAgg.c over nodeAgg.c) — the TPC-DS staple
+`rank() over (order by sum(v) desc)`. Here the statement rewrites
+pre-bind into two levels:
+
+    inner:  the grouped aggregate select (group keys + every aggregate
+            expression any window component references, aliased)
+    outer:  the window functions over the inner's columns
+
+so each level uses the engine's existing machinery (distributed two-phase
+aggregation below, distributed windows above). HAVING stays with the
+inner; DISTINCT/ORDER BY/LIMIT stay with the outer, their aggregate
+references rewritten to the inner aliases."""
+
+from __future__ import annotations
+
+import copy
+
+from greengage_tpu.sql import ast as A
+from greengage_tpu.sql.parser import SqlError
+
+_LITERALS = (A.Num, A.Str, A.Null, A.Bool, A.DateLit, A.IntervalLit)
+
+
+def expand_windows_over_aggs(stmt: A.SelectStmt):
+    """-> replacement SelectStmt, or None when the statement doesn't mix
+    grouped aggregation with window functions."""
+    from greengage_tpu.sql.binder import (_ast_key, _contains_agg,
+                                          _contains_window)
+
+    has_aggs = bool(stmt.group_by) or any(
+        _contains_agg(it.expr) for it in stmt.items) or (
+        stmt.having is not None and _contains_agg(stmt.having))
+    has_win = any(_contains_window(it.expr) for it in stmt.items)
+    if not (has_aggs and has_win):
+        return None
+    if stmt.grouping_sets is not None:
+        raise SqlError(
+            "window functions cannot combine with ROLLUP/CUBE/GROUPING "
+            "SETS yet")
+
+    inner_items: list[A.SelectItem] = []
+    by_key: dict[str, str] = {}
+
+    def ref(e: A.ANode) -> A.ANode:
+        """Map a window-free expression to an inner alias reference."""
+        if isinstance(e, _LITERALS):
+            return copy.deepcopy(e)
+        k = _ast_key(e)
+        alias = by_key.get(k)
+        if alias is None:
+            alias = f"__wa{len(by_key)}"
+            by_key[k] = alias
+            inner_items.append(A.SelectItem(copy.deepcopy(e), alias))
+        return A.Name((alias,))
+
+    def conv(n):
+        """Rewrite an outer expression: window calls keep their structure
+        with every component mapped through ref(); window-free subtrees
+        map whole (they evaluate in the grouped inner)."""
+        if isinstance(n, A.FuncCall) and n.over is not None:
+            spec = A.WindowSpec(
+                partition_by=[ref(p) for p in n.over.partition_by],
+                order_by=[A.OrderItem(ref(oi.expr), oi.desc, oi.nulls_first)
+                          for oi in n.over.order_by],
+                frame=copy.deepcopy(n.over.frame))
+            return A.FuncCall(n.name, [ref(a) for a in n.args],
+                              star=n.star, distinct=n.distinct, over=spec)
+        if isinstance(n, A.ANode) and not _contains_window(n):
+            return ref(n)
+        import dataclasses
+
+        if isinstance(n, A.ANode):
+            for f in dataclasses.fields(n):
+                setattr(n, f.name, conv(getattr(n, f.name)))
+            return n
+        if isinstance(n, list):
+            return [conv(v) for v in n]
+        if isinstance(n, tuple):
+            return tuple(conv(v) for v in n)
+        return n
+
+    outer_items = []
+    for it in stmt.items:
+        name = it.alias or _item_name(it.expr)
+        outer_items.append(A.SelectItem(conv(it.expr), name))
+    aliases = {it.alias for it in outer_items if it.alias}
+    outer_order = []
+    for oi in stmt.order_by:
+        # bare output aliases and ordinals resolve against the OUTER
+        # outputs (`order by rnk` names a window column); everything
+        # else — group keys not in the select list, aggregate exprs —
+        # routes through the inner via conv() and rides as a hidden
+        # pass-through
+        if (isinstance(oi.expr, A.Name) and oi.expr.parts[-1] in aliases) \
+                or isinstance(oi.expr, A.Num):
+            e = oi.expr
+        else:
+            e = conv(oi.expr)
+        outer_order.append(A.OrderItem(e, oi.desc, oi.nulls_first))
+
+    inner = A.SelectStmt(
+        items=inner_items, from_=stmt.from_, where=stmt.where,
+        group_by=stmt.group_by, having=stmt.having)
+    return A.SelectStmt(
+        items=outer_items, from_=[A.SubqueryRef(inner, "__w")],
+        order_by=outer_order, limit=stmt.limit, offset=stmt.offset,
+        distinct=stmt.distinct)
+
+
+def _item_name(e) -> str | None:
+    if isinstance(e, A.Name):
+        return e.parts[-1]
+    if isinstance(e, A.FuncCall):
+        return e.name
+    return None

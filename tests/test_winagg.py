@@ -1,0 +1,79 @@
+"""Window functions over grouped aggregates (WindowAgg-over-Agg stack,
+nodeWindowAgg.c above nodeAgg.c) — the TPC-DS staple
+`rank() over (order by sum(v) desc)` via the two-level rewrite."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(3)
+    n = 300
+    g = rng.integers(0, 6, n).astype(np.int32)
+    h = rng.integers(0, 2, n).astype(np.int32)
+    v = rng.integers(0, 100, n).astype(np.int32)
+    d.sql("create table t (g int, h int, v int, k int) distributed by (k)")
+    d.load_table("t", {"g": g, "h": h, "v": v,
+                       "k": np.arange(n, dtype=np.int32)})
+    d.df = pd.DataFrame({"g": g, "h": h, "v": v})
+    yield d
+    d.close()
+
+
+def test_rank_over_sum(db):
+    r = db.sql("select g, sum(v) s, rank() over (order by sum(v) desc) rnk "
+               "from t group by g order by rnk, g")
+    agg = db.df.groupby("g", as_index=False).v.sum()
+    agg["rnk"] = agg.v.rank(ascending=False, method="min").astype(int)
+    want = sorted(agg[["g", "v", "rnk"]].values.tolist(),
+                  key=lambda x: (x[2], x[0]))
+    assert [list(map(int, row)) for row in r.rows()] == want
+
+
+def test_percent_of_total(db):
+    r = db.sql("select g, sum(v) s, sum(v) * 100.0 / sum(sum(v)) over () p "
+               "from t group by g order by g")
+    tot = db.df.v.sum()
+    for g, s, p in r.rows():
+        np.testing.assert_allclose(p, s * 100.0 / tot, rtol=1e-4)
+
+
+def test_partitioned_window_over_agg(db):
+    """TPC-DS Q36/Q70 shape: rank within a partition of the grouped
+    result."""
+    r = db.sql("select g, h, sum(v) s, "
+               "rank() over (partition by h order by sum(v) desc) rnk "
+               "from t group by g, h order by h, rnk, g")
+    agg = db.df.groupby(["g", "h"], as_index=False).v.sum()
+    agg["rnk"] = agg.groupby("h").v.rank(
+        ascending=False, method="min").astype(int)
+    want = sorted(agg[["g", "h", "v", "rnk"]].values.tolist(),
+                  key=lambda x: (x[1], x[3], x[0]))
+    assert [list(map(int, row)) for row in r.rows()] == want
+
+
+def test_window_over_count_star_with_having(db):
+    r = db.sql("select g, count(*) c, "
+               "row_number() over (order by count(*) desc, g) rn "
+               "from t group by g having count(*) > 10 order by rn")
+    agg = db.df.groupby("g", as_index=False).size()
+    agg = agg[agg["size"] > 10].sort_values(["size", "g"],
+                                            ascending=[False, True])
+    got = [list(map(int, row)) for row in r.rows()]
+    assert [row[:2] for row in got] == agg[["g", "size"]].values.tolist()
+    assert [row[2] for row in got] == list(range(1, len(got) + 1))
+
+
+def test_window_over_stat_agg(db):
+    """Composition: stddev (itself an expansion) inside the window order."""
+    r = db.sql("select g, rank() over (order by stddev(v) desc) rnk "
+               "from t group by g")
+    sd = db.df.groupby("g").v.std()
+    want_order = sd.rank(ascending=False, method="min").astype(int)
+    for g, rnk in r.rows():
+        assert rnk == want_order[g]
