@@ -27,8 +27,8 @@ _LITERALS = (A.Num, A.Str, A.Null, A.Bool, A.DateLit, A.IntervalLit)
 def expand_windows_over_aggs(stmt: A.SelectStmt):
     """-> replacement SelectStmt, or None when the statement doesn't mix
     grouped aggregation with window functions."""
-    from greengage_tpu.sql.binder import (_ast_key, _contains_agg,
-                                          _contains_window)
+    from greengage_tpu.sql.binder import (_ast_key, _ast_name,
+                                          _contains_agg, _contains_window)
 
     has_aggs = bool(stmt.group_by) or any(
         _contains_agg(it.expr) for it in stmt.items) or (
@@ -84,7 +84,7 @@ def expand_windows_over_aggs(stmt: A.SelectStmt):
 
     outer_items = []
     for it in stmt.items:
-        name = it.alias or _item_name(it.expr)
+        name = it.alias or _ast_name(it.expr)
         outer_items.append(A.SelectItem(conv(it.expr), name))
     aliases = {it.alias for it in outer_items if it.alias}
     outer_order = []
@@ -109,10 +109,3 @@ def expand_windows_over_aggs(stmt: A.SelectStmt):
         order_by=outer_order, limit=stmt.limit, offset=stmt.offset,
         distinct=stmt.distinct)
 
-
-def _item_name(e) -> str | None:
-    if isinstance(e, A.Name):
-        return e.parts[-1]
-    if isinstance(e, A.FuncCall):
-        return e.name
-    return None

@@ -212,3 +212,44 @@ def test_ds_q22_style_percentile_by_category(env):
         vals = j[j.i_category == cat].ss_quantity
         np.testing.assert_allclose(med, np.percentile(vals, 50), rtol=1e-12)
         np.testing.assert_allclose(p90, np.percentile(vals, 90), rtol=1e-12)
+
+
+def test_ds_q70_style_grouped_rank(env):
+    """TPC-DS Q70 shape: rank states by total revenue (window over the
+    grouped aggregate), top-k by rank."""
+    d, f = env
+    r = d.sql("""select s_state, sum(ss_ext_sales_price) rev,
+        rank() over (order by sum(ss_ext_sales_price) desc) rnk
+      from store_sales, store
+      where ss_store_sk = s_store_sk
+      group by s_state order by rnk""")
+    j = f["store_sales"].merge(f["store"], left_on="ss_store_sk",
+                               right_on="s_store_sk")
+    agg = j.groupby("s_state", as_index=False).ss_ext_sales_price.sum()
+    agg["rnk"] = agg.ss_ext_sales_price.rank(
+        ascending=False, method="min").astype(int)
+    want = agg.sort_values("rnk")
+    got = r.rows()
+    assert len(got) == len(want)
+    for row, (_, w) in zip(got, want.iterrows()):
+        assert row[0] == w.s_state and row[1] == w.ss_ext_sales_price \
+            and row[2] == w.rnk
+
+
+def test_ds_q86_style_share_within_parent(env):
+    """TPC-DS Q86 flavor: each category's share of the overall total via
+    sum(sum()) over ()."""
+    d, f = env
+    r = d.sql("""select i_category, sum(ss_ext_sales_price) rev,
+        sum(ss_ext_sales_price) * 100.0
+          / sum(sum(ss_ext_sales_price)) over () share
+      from store_sales, item
+      where ss_item_sk = i_item_sk
+      group by i_category order by i_category""")
+    j = f["store_sales"].merge(f["item"], left_on="ss_item_sk",
+                               right_on="i_item_sk")
+    tot = j.ss_ext_sales_price.sum()
+    for cat, rev, share in r.rows():
+        want = j[j.i_category == cat].ss_ext_sales_price.sum()
+        assert rev == want
+        np.testing.assert_allclose(share, want * 100.0 / tot, rtol=1e-4)
