@@ -19,7 +19,6 @@ from __future__ import annotations
 import copy
 
 from greengage_tpu.sql import ast as A
-from greengage_tpu.sql.parser import SqlError
 
 _LITERALS = (A.Num, A.Str, A.Null, A.Bool, A.DateLit, A.IntervalLit)
 
@@ -30,16 +29,12 @@ def expand_windows_over_aggs(stmt: A.SelectStmt):
     from greengage_tpu.sql.binder import (_ast_key, _ast_name,
                                           _contains_agg, _contains_window)
 
-    has_aggs = bool(stmt.group_by) or any(
+    has_aggs = bool(stmt.group_by) or stmt.grouping_sets is not None or any(
         _contains_agg(it.expr) for it in stmt.items) or (
         stmt.having is not None and _contains_agg(stmt.having))
     has_win = any(_contains_window(it.expr) for it in stmt.items)
     if not (has_aggs and has_win):
         return None
-    if stmt.grouping_sets is not None:
-        raise SqlError(
-            "window functions cannot combine with ROLLUP/CUBE/GROUPING "
-            "SETS yet")
 
     inner_items: list[A.SelectItem] = []
     by_key: dict[str, str] = {}
@@ -103,7 +98,8 @@ def expand_windows_over_aggs(stmt: A.SelectStmt):
 
     inner = A.SelectStmt(
         items=inner_items, from_=stmt.from_, where=stmt.where,
-        group_by=stmt.group_by, having=stmt.having)
+        group_by=stmt.group_by, having=stmt.having,
+        grouping_sets=stmt.grouping_sets)
     return A.SelectStmt(
         items=outer_items, from_=[A.SubqueryRef(inner, "__w")],
         order_by=outer_order, limit=stmt.limit, offset=stmt.offset,

@@ -77,3 +77,23 @@ def test_window_over_stat_agg(db):
     want_order = sd.rank(ascending=False, method="min").astype(int)
     for g, rnk in r.rows():
         assert rnk == want_order[g]
+
+
+def test_rank_within_rollup_levels(db):
+    """TPC-DS Q36 composition: windows over grouped aggregates ALSO
+    composes with ROLLUP — rank within each grouping level."""
+    r = db.sql("select g, h, sum(v) rev, grouping(g, h) lvl, "
+               "rank() over (partition by grouping(g, h) "
+               "order by sum(v) desc) rnk "
+               "from t group by rollup(g, h) order by lvl, rnk")
+    rows = r.rows()
+    leaf = db.df.groupby(["g", "h"]).v.sum().sort_values(ascending=False)
+    byg = db.df.groupby("g").v.sum().sort_values(ascending=False)
+    lvl0 = [x for x in rows if x[3] == 0]
+    lvl1 = [x for x in rows if x[3] == 1]
+    lvl3 = [x for x in rows if x[3] == 3]
+    assert (lvl0[0][0], lvl0[0][1]) == leaf.index[0]
+    assert lvl0[0][2] == leaf.iloc[0] and lvl0[0][4] == 1
+    assert lvl1[0][0] == byg.index[0] and lvl1[0][2] == byg.iloc[0]
+    assert lvl3 == [(None, None, int(db.df.v.sum()), 3, 1)]
+    assert len(rows) == len(leaf) + len(byg) + 1
