@@ -839,8 +839,16 @@ class Binder:
         orig_scopes = [sc for _, sc in remaining]
 
         if self.optimizer:
-            # Cascades-lite memo: bushy trees + distribution-property DP
-            tree = self._memo_join_tree(remaining, conds, group_by, naggs)
+            # Cascades-lite memo: bushy trees + distribution-property DP.
+            # ORCA's fallback-on-failure semantics (optimizer_trace_fallback
+            # / planner takes over when ORCA errors): ANY memo failure
+            # degrades to the left-deep DP/greedy order below instead of
+            # failing the statement
+            try:
+                tree = self._memo_join_tree(remaining, conds, group_by,
+                                            naggs)
+            except Exception:
+                tree = None
             if tree is not None:
                 self.memo_used = True
                 plan, scope, conds = self._build_join_tree(

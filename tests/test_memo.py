@@ -157,3 +157,17 @@ def test_three_way_same_results_small(db):
     finally:
         db.sql("set optimizer to on")
     assert on == off
+
+
+def test_memo_failure_falls_back_to_greedy(db, monkeypatch):
+    """ORCA fallback-on-failure semantics: a crashing memo search must
+    degrade to the left-deep order, never fail the statement."""
+    from greengage_tpu.planner import memo
+
+    def boom(*a, **k):
+        raise RuntimeError("injected memo crash")
+
+    monkeypatch.setattr(memo, "optimize", boom)
+    r = db.sql("select count(*) from fa join da on fa.k1 = da.k1 "
+               "join fb on da.link = fb.link")
+    assert len(r.rows()) == 1 and r.rows()[0][0] >= 0
