@@ -2434,9 +2434,13 @@ def _raw_ref_of(e: E.Expr):
     return getattr(e, "_raw_ref", None)
 
 
+_ORDERED_SET_AGGS = ("percentile_cont", "percentile_disc", "median")
+
+
 def _contains_agg(ast) -> bool:
     if isinstance(ast, A.FuncCall) and ast.over is None and \
-            ast.name in ("count", "sum", "avg", "min", "max"):
+            ast.name in ("count", "sum", "avg", "min", "max",
+                         *_ORDERED_SET_AGGS):
         return True
     return any(_contains_agg(c) for c in _ast_children(ast))
 
@@ -2573,7 +2577,9 @@ def _gs_rewrite(node, present: set, universe: set):
                 if k not in present:
                     mask |= 1 << (n - 1 - i)
             return A.Num(str(mask))
-        if node.name in _PLAIN_AGGS:
+        if node.name in _PLAIN_AGGS or node.name in _ORDERED_SET_AGGS:
+            # aggregate args (incl. WITHIN GROUP order exprs) see real
+            # rows, never key NULLs
             return node
     k = _ast_key(node)
     if k in universe:

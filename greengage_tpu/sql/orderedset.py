@@ -29,7 +29,11 @@ import copy
 from greengage_tpu.sql import ast as A
 from greengage_tpu.sql.parser import SqlError
 
-ORDERED_SET = {"percentile_cont", "percentile_disc", "median"}
+# authoritative name list lives in the binder (grouping-sets rewrite and
+# aggregate detection consult it too)
+from greengage_tpu.sql import binder as _b  # noqa: E402  (cycle-safe: names only)
+
+ORDERED_SET = set(_b._ORDERED_SET_AGGS)
 
 
 def _collect(stmt) -> list:
@@ -136,9 +140,10 @@ def expand_ordered_set(stmt: A.SelectStmt):
     if not calls:
         return None
     if stmt.grouping_sets is not None:
-        raise SqlError(
-            "percentile aggregates cannot combine with ROLLUP/CUBE/"
-            "GROUPING SETS yet")
+        # defer: the grouping-sets desugar re-enters _bind_select per
+        # branch with that branch's concrete group_by, and THIS expansion
+        # then applies with the right window partition keys
+        return None
     if not stmt.from_:
         raise SqlError("percentile aggregates need a FROM clause")
 

@@ -104,3 +104,34 @@ def test_group_by_ordinal_and_qualified_names(db):
 def test_within_group_rejected_for_plain_aggs(db):
     with pytest.raises(SqlError, match="not supported for sum"):
         db.sql("select sum(x) within group (order by x) from ps")
+
+
+def test_percentile_under_rollup(db):
+    """Composition with grouping sets: each ROLLUP branch re-enters the
+    ordered-set expansion with its own group keys."""
+    r = db.sql("select g, percentile_cont(0.5) within group (order by x) m "
+               "from ps group by rollup(g) order by g nulls last")
+    rows = r.rows()
+    assert len(rows) == db.df.g.nunique() + 1
+    for g, m in rows:
+        vals = (db.df[db.df.g == g] if g is not None else db.df).x.dropna()
+        np.testing.assert_allclose(m, np.percentile(vals, 50), rtol=1e-12)
+
+
+def test_percentile_of_grouping_key_under_rollup(db):
+    """WITHIN GROUP (ORDER BY <grouping key>): the key inside the
+    aggregate must see real rows in every branch, not the branch NULL."""
+    r = db.sql("select g, percentile_cont(0.5) within group (order by g) m "
+               "from ps group by rollup(g) order by g nulls last")
+    total = r.rows()[-1]
+    assert total[0] is None
+    np.testing.assert_allclose(total[1], np.percentile(db.df.g, 50),
+                               rtol=1e-12)
+
+
+def test_order_by_percentile_under_rollup(db):
+    r = db.sql("select g, percentile_cont(0.5) within group (order by x) m "
+               "from ps group by rollup(g) "
+               "order by percentile_cont(0.5) within group (order by x)")
+    meds = [m for _, m in r.rows()]
+    assert meds == sorted(meds)
