@@ -594,14 +594,34 @@ def cmd_worker(args):
     return 0
 
 
+def cmd_useradd(args):
+    """createuser analog: add/update a remote user in gg_hba.json (salted
+    sha256 at rest, file mode 0600)."""
+    from greengage_tpu.runtime import auth
+
+    auth.add_user(args.dir, args.user, args.password)
+    print(f"user {args.user!r} ready for TCP connections")
+    return 0
+
+
 def cmd_server(args):
-    """gpstart-style serving mode: listen on a unix socket until killed."""
+    """gpstart-style serving mode: listen on a unix socket (and, with
+    --host/--port, on TCP with gg_hba.json authentication) until
+    killed."""
     from greengage_tpu.runtime.server import SqlServer
 
+    host = getattr(args, "host", None)
+    port = getattr(args, "port", None)
+    if (host is None) != (port is None):
+        print("error: --host and --port must be given together",
+              file=sys.stderr)
+        return 1
     db = _open(args.dir)
-    srv = SqlServer(db, args.socket)
+    srv = SqlServer(db, args.socket, host=host, port=port)
     srv.start()
-    print(f"serving {args.dir} on {args.socket} (ctrl-c to stop)")
+    where = args.socket + (
+        f" and {host}:{srv.port}" if srv._tcp_server is not None else "")
+    print(f"serving {args.dir} on {where} (ctrl-c to stop)")
     import signal
 
     try:
@@ -1062,7 +1082,16 @@ def main(argv=None):
     p = sub.add_parser("server")
     p.add_argument("-d", "--dir", required=True)
     p.add_argument("-s", "--socket", required=True)
+    p.add_argument("--host", default=None,
+                   help="also listen on TCP (requires gg_hba.json users)")
+    p.add_argument("--port", type=int, default=None)
     p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("useradd")   # createuser + pg_hba analog
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-u", "--user", required=True)
+    p.add_argument("-P", "--password", required=True)
+    p.set_defaults(fn=cmd_useradd)
 
     p = sub.add_parser("start")   # gpstart analog
     p.add_argument("-d", "--dir", required=True)

@@ -42,11 +42,15 @@ def _encode_value(v):
 
 
 class SqlServer:
-    def __init__(self, db, socket_path: str):
+    def __init__(self, db, socket_path: str, host: str | None = None,
+                 port: int | None = None):
         self.db = db
         self.socket_path = socket_path
+        self.host, self.port = host, port
         self._server = None
+        self._tcp_server = None
         self._thread = None
+        self._tcp_thread = None
         self.connections_served = 0
 
     # ------------------------------------------------------------------
@@ -56,15 +60,49 @@ class SqlServer:
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
+            REMOTE = False   # TCP subclass flips this: remote => auth
+
             def handle(self):
                 outer.connections_served += 1
                 try:
+                    if self.REMOTE and not self._authenticate():
+                        return
                     self._serve()
                 finally:
                     # a connection dropping mid-transaction rolls back, and
                     # its cursors close, like a libpq backend exiting
                     outer.db.abort_if_active()
                     outer.db.close_thread_cursors()
+
+            def _authenticate(self) -> bool:
+                """Challenge-response over TCP (auth.c role): unix-socket
+                peers are trusted, remote peers must prove a gg_hba.json
+                password without sending it (runtime/auth.py)."""
+                from greengage_tpu.runtime import auth
+
+                users = auth.load_users(outer.db.path)
+                ok = False
+                try:
+                    hello = json.loads(self.rfile.readline() or b"{}")
+                    user = str(hello.get("user", ""))
+                    ch = auth.challenge(users, user, outer.db.path)
+                    self.wfile.write((json.dumps(ch) + "\n").encode())
+                    self.wfile.flush()
+                    resp = json.loads(self.rfile.readline() or b"{}")
+                    ok = auth.verify(users, user, ch["nonce"],
+                                     str(resp.get("proof", "")))
+                    self.wfile.write((json.dumps(
+                        {"ok": ok, "error": None if ok
+                         else "authentication failed"}) + "\n").encode())
+                    self.wfile.flush()
+                except Exception:
+                    # dropped peers and malformed handshakes must not
+                    # traceback per port-scan probe
+                    ok = False
+                if not ok:
+                    outer.db.log.log("WARNING", "auth",
+                                     "remote authentication failed")
+                return ok
 
             def _serve(self):
                 for line in self.rfile:
@@ -100,22 +138,61 @@ class SqlServer:
             target=self._server.serve_forever, name="gg-server", daemon=True)
         self._thread.start()
 
+        if self.host is not None and self.port is not None:
+            class TcpHandler(Handler):
+                REMOTE = True
+
+            class TcpServer(socketserver.ThreadingTCPServer):
+                daemon_threads = True
+                allow_reuse_address = True
+
+            self._tcp_server = TcpServer((self.host, self.port), TcpHandler)
+            self.port = self._tcp_server.server_address[1]  # resolve port 0
+            self._tcp_thread = threading.Thread(
+                target=self._tcp_server.serve_forever, name="gg-server-tcp",
+                daemon=True)
+            self._tcp_thread.start()
+
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self._tcp_server is not None:
+            self._tcp_server.shutdown()
+            self._tcp_server.server_close()
+            self._tcp_server = None
         if os.path.exists(self.socket_path):
             os.remove(self.socket_path)
 
 
 class SqlClient:
-    """Tiny client for the line protocol (the psql/libpq stand-in)."""
+    """Tiny client for the line protocol (the psql/libpq stand-in).
+    Local: SqlClient(path). Remote: SqlClient(host=..., port=...,
+    user=..., password=...) — challenge-response, password never sent."""
 
-    def __init__(self, socket_path: str):
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.connect(socket_path)
-        self._f = self._sock.makefile("rwb")
+    def __init__(self, socket_path: str | None = None, *,
+                 host: str | None = None, port: int | None = None,
+                 user: str = "", password: str = ""):
+        if socket_path is not None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.connect(socket_path)
+            self._f = self._sock.makefile("rwb")
+        else:
+            from greengage_tpu.runtime import auth
+
+            self._sock = socket.create_connection((host, port))
+            self._f = self._sock.makefile("rwb")
+            self._f.write((json.dumps({"user": user}) + "\n").encode())
+            self._f.flush()
+            ch = json.loads(self._f.readline())
+            proof = auth.prove(ch["salt"], ch["nonce"], password)
+            self._f.write((json.dumps({"proof": proof}) + "\n").encode())
+            self._f.flush()
+            resp = json.loads(self._f.readline())
+            if not resp.get("ok"):
+                self._sock.close()
+                raise PermissionError(resp.get("error", "auth failed"))
 
     def sql(self, text: str):
         self._f.write((json.dumps({"sql": text}) + "\n").encode())

@@ -1,0 +1,120 @@
+"""Remote TCP connections with authentication — the libpq auth.c /
+pg_hba.conf role: unix-socket peers stay trusted, TCP peers prove a
+gg_hba.json password via challenge-response (never sent on the wire)."""
+
+import json
+import socket
+
+import pytest
+
+import greengage_tpu
+from greengage_tpu.runtime import auth
+from greengage_tpu.runtime.server import SqlClient, SqlServer
+
+
+@pytest.fixture()
+def served(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=2)
+    d.sql("create table t (a int) distributed by (a)")
+    d.sql("insert into t values (1), (2), (3)")
+    auth.add_user(d.path, "alice", "s3cret")
+    srv = SqlServer(d, str(tmp_path / "s.sock"), host="127.0.0.1", port=0)
+    srv.start()
+    yield d, srv, str(tmp_path / "s.sock")
+    srv.stop()
+    d.close()
+
+
+def test_tcp_auth_roundtrip(served):
+    d, srv, _ = served
+    c = SqlClient(host="127.0.0.1", port=srv.port,
+                  user="alice", password="s3cret")
+    r = c.sql("select count(*), sum(a) from t")
+    assert r["rows"] == [[3, 6]]
+    c.sql("insert into t values (10)")
+    assert c.sql("select count(*) from t")["rows"] == [[4]]
+    c.close()
+
+
+def test_wrong_password_rejected(served):
+    _, srv, _ = served
+    with pytest.raises(PermissionError, match="authentication failed"):
+        SqlClient(host="127.0.0.1", port=srv.port,
+                  user="alice", password="nope")
+
+
+def test_unknown_user_rejected_without_leaking(served):
+    _, srv, _ = served
+    # the challenge for an unknown user must look like any other (no
+    # user-existence oracle); the proof still fails
+    s = socket.create_connection(("127.0.0.1", srv.port))
+    f = s.makefile("rwb")
+    f.write((json.dumps({"user": "mallory"}) + "\n").encode())
+    f.flush()
+    ch = json.loads(f.readline())
+    assert set(ch) == {"auth", "salt", "nonce"}
+    f.write((json.dumps({"proof": "0" * 64}) + "\n").encode())
+    f.flush()
+    assert json.loads(f.readline())["ok"] is False
+    s.close()
+
+
+def test_password_never_on_wire(served):
+    """The handshake carries user/salt/nonce/proof only."""
+    _, srv, _ = served
+    s = socket.create_connection(("127.0.0.1", srv.port))
+    f = s.makefile("rwb")
+    f.write((json.dumps({"user": "alice"}) + "\n").encode())
+    f.flush()
+    ch = json.loads(f.readline())
+    proof = auth.prove(ch["salt"], ch["nonce"], "s3cret")
+    assert "s3cret" not in proof
+    f.write((json.dumps({"proof": proof}) + "\n").encode())
+    f.flush()
+    assert json.loads(f.readline())["ok"] is True
+    s.close()
+
+
+def test_unix_socket_stays_trusted(served):
+    _, _, sock = served
+    c = SqlClient(sock)
+    assert c.sql("select 1 + 1")["rows"] == [[2]]
+    c.close()
+
+
+def test_useradd_cli(devices8, tmp_path):
+    from greengage_tpu.mgmt import cli
+
+    path = str(tmp_path / "c2")
+    greengage_tpu.connect(path, numsegments=2).close()
+    rc = cli.main(["useradd", "-d", path, "-u", "bob", "-P", "pw"])
+    assert rc == 0
+    users = auth.load_users(path)
+    assert "bob" in users and users["bob"]["hash"] != "pw"
+    import os
+    assert (os.stat(auth._hba_path(path)).st_mode & 0o777) == 0o600
+
+
+def test_unknown_user_salt_is_stable(served):
+    """No user-existence oracle via salt stability: unknown users get the
+    SAME deterministic mock salt across connections."""
+    _, srv, _ = served
+    salts = []
+    for _ in range(2):
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        f = s.makefile("rwb")
+        f.write((json.dumps({"user": "ghost"}) + "\n").encode())
+        f.flush()
+        salts.append(json.loads(f.readline())["salt"])
+        s.close()
+    assert salts[0] == salts[1]
+
+
+def test_dropped_handshake_no_traceback(served):
+    _, srv, _ = served
+    s = socket.create_connection(("127.0.0.1", srv.port))
+    s.close()          # drop before the hello; server must not traceback
+    c = SqlClient(host="127.0.0.1", port=srv.port,
+                  user="alice", password="s3cret")
+    assert c.sql("select 1")["rows"] == [[1]]
+    c.close()
