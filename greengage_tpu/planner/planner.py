@@ -691,16 +691,21 @@ class Planner:
         INTISH = (T.Kind.INT32, T.Kind.INT64, T.Kind.DATE)
         resolved = []
         for e, desc, nf in order_keys:
-            if e.type.kind not in INTISH:
-                return None
+            if e.type.kind not in INTISH \
+                    and not getattr(e, "_rank_space", False):
+                return None   # rank-space TEXT keys are bounded ints
             if nf is None:
                 nf = bool(desc)
             resolved.append((e, bool(desc), bool(nf)))
         fields: list | None = []
         total = 0
         for e, desc, nf in resolved:
-            org = _origin(child, e.name) if isinstance(e, E.ColRef) else None
-            bounds = self.store.column_bounds(*org) if org else None
+            if getattr(e, "_rank_space", False):
+                bounds = (0, (1 << e._rank_bits) - 1)
+            else:
+                org = _origin(child, e.name) if isinstance(e, E.ColRef) \
+                    else None
+                bounds = self.store.column_bounds(*org) if org else None
             if bounds is None:
                 fields = None
                 break

@@ -548,8 +548,10 @@ class Binder:
             spec = fcs[0].over
             pkeys = [self._no_raw(self._expr(p, scope), "window partition key")
                      for p in spec.partition_by]
-            okeys = [(self._no_raw(self._expr(oi.expr, scope),
-                                   "window order key"), oi.desc, oi.nulls_first)
+            okeys = [(self._win_order_key(
+                          self._no_raw(self._expr(oi.expr, scope),
+                                       "window order key")),
+                      oi.desc, oi.nulls_first)
                      for oi in spec.order_by]
             frame = self._bind_frame(spec.frame)
             wfuncs = []
@@ -1581,6 +1583,24 @@ class Binder:
             kind = strfuncs.SPECS[step[0]][2]
             coded = self._lower_str_step(coded, tuple(step), kind)
         return coded
+
+    def _win_order_key(self, e: E.Expr) -> E.Expr:
+        """Dict-TEXT window order keys re-code into RANK space at bind
+        time: ranks order lexicographically AND are small bounded ints,
+        which lets the planner's in-place global ranking pack them
+        (planner._ordered_global_spec) instead of funneling TEXT keys."""
+        if e.type.kind is T.Kind.TEXT and _dict_ref_of(e) is not None \
+                and not isinstance(e, E.RawChain) \
+                and _raw_ref_of(e) is None:
+            n = len(self.store.dictionary(*_dict_ref_of(e)))
+            r = self._text_rank_expr(e)
+            object.__setattr__(r, "_rank_space", True)
+            # ranks span [0, n-1]; a power-of-two dictionary must not
+            # burn an extra bit of the 64-bit packing budget
+            object.__setattr__(r, "_rank_bits",
+                               max((n - 1).bit_length(), 1))
+            return r
+        return e
 
     def _text_rank_expr(self, ae: E.Expr) -> E.Expr:
         """min/max over TEXT: first-seen dictionary codes do not order

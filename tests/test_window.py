@@ -445,3 +445,28 @@ def test_global_ordered_dense_rank_with_nulls(wdb):
     # values 7,10,42 -> dense 1,2,3; nulls last as one extra class
     assert by_k[3] == 1 and by_k[1] == 2 and by_k[5] == 3
     assert by_k[2] == by_k[4] == 4
+
+
+def test_global_ordered_text_keys_distributed(wdb):
+    """Dict-TEXT ORDER BY keys re-code into rank space at bind, so global
+    rankings over text distribute (packed bounded ints) — and order
+    LEXICOGRAPHICALLY, not by first-seen dictionary codes."""
+    from greengage_tpu.planner.logical import describe
+    from greengage_tpu.sql.parser import parse
+
+    wdb.sql("create table wt (s text, v int, k int) distributed by (k)")
+    wdb.sql("insert into wt values ('zebra', 5, 0), ('apple', 3, 1), "
+            "('mango', 9, 2), ('apple', 7, 3), ('zebra', 1, 4)")
+    q = "select s, row_number() over (order by s) rn from wt"
+    planned, _, _ = wdb._plan(parse(q)[0])
+    assert "SingleQE" not in describe(planned)
+    rows = sorted(wdb.sql(q).rows(), key=lambda x: x[1])
+    assert [r[0] for r in rows] == ["apple", "apple", "mango",
+                                    "zebra", "zebra"]
+    # mixed TEXT + int multi-key packs too
+    q2 = "select s, v, rank() over (order by s, v desc) rk from wt"
+    planned2, _, _ = wdb._plan(parse(q2)[0])
+    assert "SingleQE" not in describe(planned2)
+    rows2 = sorted(wdb.sql(q2).rows(), key=lambda x: x[2])
+    assert [(r[0], r[1]) for r in rows2] == [
+        ("apple", 7), ("apple", 3), ("mango", 9), ("zebra", 5), ("zebra", 1)]
