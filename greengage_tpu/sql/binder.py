@@ -115,6 +115,14 @@ class Binder:
     # entry
     # ------------------------------------------------------------------
     def bind_select(self, stmt) -> tuple[Plan, list[ColInfo]]:
+        # bind NEVER mutates the caller's AST: the pre-bind expanders
+        # (stat aggs, ordered sets, winagg, grouping sets) rewrite in
+        # place, and callers bind the same statement twice (multihost
+        # plan-hash + execute; plan caches keyed on the AST) — one
+        # defensive copy here establishes the invariant for all of them
+        import copy as _copy
+
+        stmt = _copy.deepcopy(stmt)
         if isinstance(stmt, A.UnionStmt):
             plan, outs = self._bind_union(stmt)
         else:

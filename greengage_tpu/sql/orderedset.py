@@ -136,13 +136,14 @@ def expand_ordered_set(stmt: A.SelectStmt):
     appear."""
     from greengage_tpu.sql.binder import _ast_key
 
-    calls = _collect(stmt)
-    if not calls:
-        return None
     if stmt.grouping_sets is not None:
         # defer: the grouping-sets desugar re-enters _bind_select per
         # branch with that branch's concrete group_by, and THIS expansion
-        # then applies with the right window partition keys
+        # then applies with the right window partition keys (the
+        # grouping() validation in _collect still runs per branch)
+        return None
+    calls = _collect(stmt)
+    if not calls:
         return None
     if not stmt.from_:
         raise SqlError("percentile aggregates need a FROM clause")

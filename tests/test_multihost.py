@@ -71,6 +71,17 @@ r = db.sql("select g, count(*), sum(v) from f2 group by g order by g")
 out["spilled"] = [[int(x) for x in row] for row in r.rows()]
 out["spill_passes"] = int(r.stats.get("spill_passes", 0))
 db.sql("set vmem_protect_limit_mb = 12288")
+# round-5 analytic surface under lockstep: ROLLUP branches + the
+# stat-agg moment expansion + percentile windows are deterministic
+# rewrites, so both processes compile identical SPMD programs
+r = db.sql("select g, count(*) c, grouping(g) lvl from f "
+           "group by rollup(g) order by lvl, g")
+out["rollup_total"] = [int(x) for x in r.rows()[-1][1:2]]
+out["rollup_rows"] = len(r.rows())
+r = db.sql("select stddev(v) from f")
+out["stddev"] = round(float(r.rows()[0][0]), 9)
+r = db.sql("select percentile_cont(0.5) within group (order by v) from f")
+out["median"] = float(r.rows()[0][0])
 # gpssh analog: run a command on every host over the control plane
 ex = db.cluster_exec("echo host-$GGTPU_X; true")
 out["exec_hosts"] = [e["ok"] for e in ex]
@@ -145,7 +156,19 @@ def test_two_process_cluster(tmp_path):
         want_spill[i % 13] = (c + 1, s + i % 7)
     assert out["spilled"] == [[g, *want_spill[g]] for g in sorted(want_spill)]
     assert out["spill_passes"] >= 2, out["spill_passes"]
-    assert out["exec_n"] == 2 and out["exec_hosts"] == [True, True]
+    assert out["exec_n"] == 2
+    # the round-5 analytic rewrites under lockstep: compare against the
+    # same data computed locally
+    import numpy as np
+
+    ks = np.arange(4000)
+    alive = (ks % 13) != 12
+    v = np.where(ks < 10, 99, ks % 7)[alive]
+    assert out["rollup_total"] == [int(alive.sum())]
+    assert out["rollup_rows"] == 12 + 1
+    assert abs(out["stddev"] - float(np.std(v, ddof=1))) < 1e-6
+    assert out["median"] == float(np.percentile(v, 50))
+    assert out["exec_hosts"] == [True, True]
     assert out["exec_fail"] == [False, False]
 
 
