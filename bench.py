@@ -52,6 +52,21 @@ def log(msg: str) -> None:
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+
+def _retry_mod():
+    """runtime/retry.py loaded BY FILE PATH: the shared Deadline/backoff
+    policy without importing the greengage_tpu package (its __init__
+    imports jax, and the parent must never touch the chips the children
+    need). The module is stdlib-only by contract."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "greengage_tpu", "runtime", "retry.py")
+    spec = importlib.util.spec_from_file_location("_ggtpu_retry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 SF = float(os.environ.get("GGTPU_BENCH_SF", "10"))
 RUNS = int(os.environ.get("GGTPU_BENCH_RUNS", "3"))  # best-of; per-call
 QUERIES = os.environ.get("GGTPU_BENCH_QUERIES", "q1,q3,q5").split(",")
@@ -217,12 +232,17 @@ def parent() -> None:
     _kill_stale_clients()
 
     # ---- probe: deadlined + retried backend init ----------------------
-    probe_end = time.monotonic() + min(PROBE_S, DEADLINE_S * 0.4)
+    # the shared retry policy (runtime/retry.py): a Deadline bounds the
+    # whole window, jittered exponential backoff paces the re-probes
+    retry = _retry_mod()
+    probe_dl = retry.Deadline(min(PROBE_S, DEADLINE_S * 0.4))
+    delays = retry.backoff_delays(base=20.0, cap=60.0, jitter=0.25,
+                                  deadline=probe_dl)
     probe_ok = False
     attempt = 0
-    while time.monotonic() < probe_end:
+    while not probe_dl.expired:
         attempt += 1
-        budget = min(150.0, probe_end - time.monotonic() + 30)
+        budget = min(150.0, probe_dl.remaining() + 30)
         log(f"probe attempt {attempt} (timeout {budget:.0f}s)")
         rc, _ = _spawn_child(["--probe"], budget, tag="probe")
         if rc == 0:
@@ -230,8 +250,8 @@ def parent() -> None:
             break
         errors.append(f"probe#{attempt} rc={rc if rc is not None else 'timeout'}")
         _kill_stale_clients()   # a hung probe child is itself a stale client
-        sleep = min(20.0 * attempt, 60.0)
-        if time.monotonic() + sleep >= probe_end:
+        sleep = next(delays, None)
+        if sleep is None or (probe_dl.remaining() or 0) <= sleep:
             break
         log(f"probe failed ({errors[-1]}); backoff {sleep:.0f}s")
         time.sleep(sleep)

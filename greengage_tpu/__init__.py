@@ -50,7 +50,11 @@ _cache = os.environ.get(
 if _cache and _cache != "0":
     try:
         jax.config.update("jax_compilation_cache_dir", _cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        # cache even small programs: the tier-1 suite and the degraded-mode
+        # subprocesses recompile the same statement shapes across dozens of
+        # fresh processes, and on CPU those sub-2s compiles dominate the
+        # suite's wall clock
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass

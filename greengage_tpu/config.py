@@ -70,6 +70,13 @@ class Settings:
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1
+    # multihost control-plane deadlines + liveness (docs/ROBUSTNESS.md;
+    # gp_segment_connect_timeout / gp_fts_probe_timeout family): silence
+    # past these bounds classifies as WorkerDied instead of a hang
+    mh_connect_deadline: float = 60.0   # gang assembly accept + (re)connect
+    mh_ready_deadline: float = 120.0    # readiness acks (refresh+plan+verify)
+    mh_ack_deadline: float = 600.0      # completion acks (compile+execute)
+    mh_heartbeat_interval: float = 2.0  # idle ping/pong cadence; 0 disables
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True

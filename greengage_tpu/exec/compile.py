@@ -44,6 +44,20 @@ from greengage_tpu.planner.logical import (
 VALID_PREFIX = "@v:"
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """jax.shard_map with replication checking off, across jax versions:
+    the top-level alias (and its check_vma flag) only exists on newer
+    releases; older ones ship it as jax.experimental.shard_map with
+    check_rep."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _pow2(n: float) -> int:
     m = 1
     while m < n:
@@ -306,12 +320,11 @@ class Compiler:
         else:
             out_specs = tuple([P(SEG_AXIS)] * nouts)
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 seg_fn,
                 mesh=self.mesh,
                 in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, *_ in input_spec))),
                 out_specs=out_specs,
-                check_vma=False,
             )
         )
         return CompileResult(

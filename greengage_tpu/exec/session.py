@@ -169,6 +169,17 @@ class Database:
 
         self._write_lock = threading.RLock()
         self._dtm_local = threading.local()
+        # control-channel liveness: the channel reads its deadlines live
+        # from THIS session's settings (SET mh_* applies immediately), and
+        # the coordinator heartbeats workers between statements so an
+        # idle-time partition is caught before the next dispatch
+        if multihost is not None and multihost.channel is not None:
+            multihost.channel.settings = self.settings
+            if multihost.is_coordinator:
+                try:
+                    multihost.channel.start_heartbeat()
+                except Exception as e:
+                    self.log.error("multihost", f"heartbeat start failed: {e}")
 
     @property
     def dtm(self):
@@ -302,8 +313,11 @@ class Database:
                 self.catalog._save()
         except Exception as e:
             self.log.error("multihost", f"post-death FTS probe failed: {e}")
+        # quiesce, don't close: worker connections tear down but the
+        # listener stays open so a restarted/woken worker can rejoin and
+        # the gang can re-form (docs/ROBUSTNESS.md, _mh_try_recover)
         try:
-            self.multihost.channel.close()
+            self.multihost.channel.quiesce()
         except Exception:
             pass
         # detach the distributed runtime WITHOUT the shutdown barrier: it
@@ -321,6 +335,99 @@ class Database:
             _dist.global_state.service = None
         except Exception:
             pass
+
+    def mh_try_recover(self) -> bool:
+        """Gang recovery (the cdbgang re-formation role): if the full
+        worker gang has reconnected to the kept listener, replay the
+        catalog/settings sync and leave degraded mode. Safe to call any
+        time; also attempted automatically at each statement while
+        degraded. True when mesh dispatch is available."""
+        if self.multihost is None or not self.multihost.is_coordinator:
+            return False
+        if not getattr(self, "_mh_degraded", None):
+            return True
+        return self._mh_try_recover()
+
+    def _mh_try_recover(self) -> bool:
+        from greengage_tpu.parallel.multihost import WorkerDied
+
+        ch = self.multihost.channel
+        if not (hasattr(ch, "rejoin_ready") and ch.rejoin_ready()):
+            return False
+        # settle the topology BEFORE workers re-plan against it: probe now
+        # (promotions during the degraded window persist), then require
+        # every rejoined worker to report the same topology version — the
+        # FTS-version check the reference dispatcher runs per gang
+        if self.catalog.segments.has_mirrors():
+            try:
+                self.fts.probe_once()
+                self.catalog._save()
+            except Exception as e:
+                self.log.error("multihost", f"pre-rejoin FTS probe failed: {e}")
+        import dataclasses as _dc
+
+        payload = {f.name: getattr(self.settings, f.name)
+                   for f in _dc.fields(self.settings)
+                   if not f.name.startswith("_")}
+        want_v = self.catalog.segments.version
+        try:
+            ch.adopt_rejoined()
+            acks = ch.broadcast({"op": "sync", "settings": payload,
+                                 "topology_version": want_v},
+                                deadline="mh_ready_deadline",
+                                phase="rejoin sync")
+            stale = [a for a in acks if a.get("topology_version") != want_v]
+            if stale:
+                raise WorkerDied(
+                    f"rejoined worker reports topology version "
+                    f"{stale[0].get('topology_version')}, coordinator has "
+                    f"{want_v} — shared directory out of sync")
+        except (WorkerDied, RuntimeError, OSError) as e:
+            self.log.error("multihost", f"gang rejoin failed: {e}")
+            try:
+                ch.quiesce()   # back to accepting reconnections
+            except Exception:
+                pass
+            return False
+        # restore the distributed-runtime handles stashed at degrade (the
+        # data plane was never torn down — a hung-then-recovered worker's
+        # collectives can rendezvous again)
+        if getattr(self, "_mh_detached", None) is not None:
+            try:
+                from jax._src import distributed as _dist
+
+                (_dist.global_state.client,
+                 _dist.global_state.service) = self._mh_detached
+            except Exception:
+                pass
+            self._mh_detached = None
+        self._mh_degraded = None
+        try:
+            ch.start_heartbeat()
+        except Exception:
+            pass
+        self.log.info("multihost",
+                      f"gang recovered: mesh dispatch restored "
+                      f"(topology v{want_v})")
+        return True
+
+    def cluster_inject_fault(self, name: str, type: str = "error",
+                             segment: int | None = None, occurrences: int = 1,
+                             sleep_s: float = 0.1, start_after: int = 0,
+                             reset: bool = False) -> list[dict]:
+        """gp_inject_fault dispatched to segments: arm (or reset) a named
+        fault point in every WORKER process over the control channel.
+        Coordinator-side points are armed directly via
+        runtime.faultinject.faults."""
+        if self.multihost is None or not self.multihost.is_coordinator \
+                or getattr(self, "_mh_degraded", None):
+            raise SqlError("cluster_inject_fault needs a non-degraded "
+                           "multihost coordinator")
+        return self.multihost.channel.broadcast(
+            {"op": "fault", "name": name, "type": type, "segment": segment,
+             "occurrences": occurrences, "sleep_s": sleep_s,
+             "start_after": start_after, "reset": reset},
+            deadline="mh_ready_deadline", phase="fault")
 
     def _degraded_sql(self, text: str):
         """Serve one statement from a fresh single-process subprocess over
@@ -393,7 +500,17 @@ class Database:
         retries on the degraded local path."""
         from greengage_tpu.parallel.multihost import WorkerDied
 
-        if getattr(self, "_mh_degraded", None):
+        ch = self.multihost.channel
+        # idle-time liveness: the heartbeat thread marks the channel dead
+        # on a missed pong — degrade HERE, before wasting a broadcast on a
+        # partitioned gang (and before _execute could enter a collective)
+        if not getattr(self, "_mh_degraded", None) \
+                and getattr(ch, "hb_failure", None):
+            self._mh_degrade(f"heartbeat liveness check failed: "
+                             f"{ch.hb_failure}")
+        # gang recovery: once the full gang has reconnected, re-sync and
+        # fall through to normal mesh dispatch below
+        if getattr(self, "_mh_degraded", None) and not self._mh_try_recover():
             stmts = parse(text)
             if any(self._needs_mesh(st) for st in stmts):
                 return self._degraded_sql(text)
@@ -423,33 +540,45 @@ class Database:
                 if isinstance(stmt, A.DeclareCursorStmt):
                     self._validate_declare(stmt)
                 with self._admission():
-                    ch = self.multihost.channel
+                    # one exchange()-scoped lock covers the whole two-phase
+                    # dispatch, so the heartbeat thread can never
+                    # interleave frames mid-statement; every ack round is
+                    # deadline-bounded (a hung worker classifies as
+                    # WorkerDied within mh_ready/ack_deadline, never an
+                    # unbounded readline)
                     try:
-                        ch.broadcast({"op": "sql", "sql": text,
-                                      "plan_hash": self.plan_hash(stmt)})
+                        with ch.exchange():
+                            ch.send({"op": "sql", "sql": text,
+                                     "plan_hash": self.plan_hash(stmt)})
+                            try:
+                                ch.collect_acks(deadline="mh_ready_deadline",
+                                                phase="readiness")
+                            except RuntimeError as e:
+                                # a worker REFUSED (plan-hash mismatch or
+                                # its planning failed): nobody entered the
+                                # mesh — release the parked survivors and
+                                # fail cleanly
+                                ch.send({"op": "skip"})
+                                raise QueryError(str(e))
+                            ch.send({"op": "go"})
+                            try:
+                                out = self._execute(stmt)
+                            finally:
+                                try:
+                                    ch.collect_acks(
+                                        deadline="mh_ack_deadline",
+                                        phase="completion")
+                                except WorkerDied as e:
+                                    # our side already finished its mesh
+                                    # program: the result stands; later
+                                    # statements take the degraded path
+                                    self._mh_degrade(str(e))
                     except WorkerDied as e:
+                        # death/hang BEFORE anyone entered a collective
+                        # (readiness or go phase): degrade and complete
+                        # this statement on the local path
                         self._mh_degrade(str(e))
                         return self._degraded_sql(text)
-                    except RuntimeError as e:
-                        # a worker REFUSED (plan-hash mismatch or its
-                        # planning failed): nobody entered the mesh —
-                        # release the parked survivors and fail cleanly
-                        ch.post({"op": "skip"})
-                        raise QueryError(str(e))
-                    try:
-                        ch.send({"op": "go"})
-                    except WorkerDied as e:
-                        # death between readiness and go: nobody is in a
-                        # collective yet on OUR side; degrade and retry
-                        self._mh_degrade(str(e))
-                        return self._degraded_sql(text)
-                    try:
-                        out = self._execute(stmt)
-                    finally:
-                        try:
-                            ch.collect_acks()
-                        except WorkerDied as e:
-                            self._mh_degrade(str(e))
             else:
                 if isinstance(stmt, A.SetStmt):
                     # settings steer MESH decisions (spill passes, retry
@@ -458,13 +587,20 @@ class Database:
                     # statement ships (a batch re-parse on the worker
                     # would apply later statements the coordinator might
                     # never reach)
-                    ch = self.multihost.channel
-                    ch.send({"op": "set", "name": stmt.name,
-                             "value": stmt.value})
                     try:
+                        with ch.exchange():
+                            ch.send({"op": "set", "name": stmt.name,
+                                     "value": stmt.value})
+                            try:
+                                out = self._execute(stmt)
+                            finally:
+                                ch.collect_acks(deadline="mh_ready_deadline",
+                                                phase="set")
+                    except WorkerDied as e:
+                        # the local SET already (or still can) apply; the
+                        # gang re-syncs settings wholesale at rejoin
+                        self._mh_degrade(str(e))
                         out = self._execute(stmt)
-                    finally:
-                        ch.collect_acks()
                     continue
                 out = self._execute(stmt)
         return out
@@ -1894,8 +2030,14 @@ class Database:
                 and not getattr(self, "_mh_degraded", None):
             ch = self.multihost.channel
             try:
-                ch.send({"op": "exec", "cmd": cmd, "timeout": timeout})
-                for i, a in enumerate(ch.collect_raw()):
+                with ch.exchange():
+                    ch.send({"op": "exec", "cmd": cmd, "timeout": timeout})
+                    # the ack deadline must outlive the command's own
+                    # timeout, or a slow-but-healthy remote command would
+                    # classify the worker as hung
+                    acks = ch.collect_raw(deadline=float(timeout) + 30.0,
+                                          phase="exec")
+                for i, a in enumerate(acks):
                     out.append({"host": i + 1, "ok": bool(a.get("ok")),
                                 "output": (a.get("error") or "")[:2000]})
             except Exception as e:
@@ -2421,7 +2563,18 @@ class Database:
         self.settings.set(name, value)
 
     def close(self):
-        pass
+        # stop the background probers/heartbeats and send the gang a clean
+        # stop frame (workers distinguish this from a coordinator crash)
+        try:
+            self.fts.stop()
+        except Exception:
+            pass
+        if self.multihost is not None and self.multihost.is_coordinator \
+                and self.multihost.channel is not None:
+            try:
+                self.multihost.channel.close()
+            except Exception:
+                pass
 
 
 class _DegradedResult:

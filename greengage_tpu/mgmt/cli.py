@@ -581,7 +581,8 @@ def cmd_worker(args):
     from greengage_tpu.parallel.multihost import init_multihost, worker_loop
 
     mh = init_multihost(args.coordinator, args.num_processes,
-                        args.process_id, args.control_port)
+                        args.process_id, args.control_port,
+                        distributed=not getattr(args, "no_distributed", False))
     import greengage_tpu
 
     # multihost must flow through connect(): the worker guard skips the
@@ -1121,6 +1122,10 @@ def main(argv=None):
     p.add_argument("--control-port", type=int, required=True)
     p.add_argument("--num-processes", type=int, required=True)
     p.add_argument("--process-id", type=int, required=True)
+    # control-plane-only gang: no jax.distributed global mesh; every
+    # process runs the lockstep program on its own full local mesh
+    # (replicated-device deployments, CPU demo clusters)
+    p.add_argument("--no-distributed", action="store_true")
     p.set_defaults(fn=cmd_worker)
 
     p = sub.add_parser("expand")

@@ -31,6 +31,7 @@ class _Fault:
     segment: int | None       # None = any segment
     occurrences: int          # remaining triggers; -1 = unlimited
     sleep_s: float = 0.0
+    start_after: int = 0      # hits to ignore before arming (start_occurrence)
     hits: int = 0
 
 
@@ -40,12 +41,17 @@ class FaultInjector:
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def inject(self, name: str, type: str = "error", segment: int | None = None,
-               occurrences: int = 1, sleep_s: float = 0.1) -> None:
+               occurrences: int = 1, sleep_s: float = 0.1,
+               start_after: int = 0) -> None:
+        """start_after mirrors the reference's start_occurrence: the point
+        ignores its first N matching hits before arming, so a test can
+        target e.g. the SECOND send of an exchange (the 'go' frame)."""
         if type not in ("skip", "error", "sleep", "panic", "suspend"):
             raise ValueError(f"unknown fault type {type}")
         with self._lock:
             self._faults.setdefault(name, []).append(
-                _Fault(name, type, segment, occurrences, sleep_s))
+                _Fault(name, type, segment, occurrences, sleep_s,
+                       start_after))
 
     def reset(self, name: str | None = None) -> None:
         with self._lock:
@@ -65,6 +71,9 @@ class FaultInjector:
                 if f.segment is not None and segment is not None and f.segment != segment:
                     continue
                 if f.occurrences == 0:
+                    continue
+                if f.start_after > 0:
+                    f.start_after -= 1    # not armed yet: let this hit pass
                     continue
                 if f.occurrences > 0:
                     f.occurrences -= 1
@@ -91,7 +100,8 @@ class FaultInjector:
         with self._lock:
             return [
                 {"name": f.name, "type": f.type, "segment": f.segment,
-                 "remaining": f.occurrences, "hits": f.hits}
+                 "remaining": f.occurrences, "hits": f.hits,
+                 "start_after": f.start_after}
                 for fs in self._faults.values() for f in fs
             ]
 

@@ -16,7 +16,6 @@ CLI `gg state --probe`) or as a background thread with an interval.
 from __future__ import annotations
 
 import threading
-import time
 
 import numpy as np
 
@@ -92,11 +91,23 @@ class FtsProber:
         self._stop.clear()
 
         def loop():
-            while not self._stop.wait(self.interval_s):
+            from greengage_tpu.runtime.retry import backoff_delays
+
+            # probe failures back the cadence off (ftsprobe restart
+            # backoff) instead of hot-looping a broken probe; a clean
+            # cycle restores the configured interval
+            delays = None
+            wait = self.interval_s
+            while not self._stop.wait(wait):
                 try:
                     self.probe_once()
+                    delays, wait = None, self.interval_s
                 except Exception:
-                    pass
+                    if delays is None:
+                        delays = backoff_delays(base=self.interval_s,
+                                                cap=self.interval_s * 8,
+                                                jitter=0.25)
+                    wait = next(delays)
 
         self._thread = threading.Thread(target=loop, name="fts-prober", daemon=True)
         self._thread.start()

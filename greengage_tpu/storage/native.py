@@ -147,6 +147,16 @@ def block_encode(raw: bytes | np.ndarray, nrows: int, compression: int = COMP_ZL
         return dst[:n].tobytes()
     payload = raw.tobytes()
     comp = compression
+    if compression == COMP_ZSTD:
+        try:
+            import zstandard
+        except ModuleNotFoundError:
+            # optional codec: degrade the WRITE to zlib instead of failing
+            # the statement — the frame header records the codec actually
+            # used, so readers never need the missing module. zstd levels
+            # go to 22; zlib rejects anything past 9.
+            compression = comp = COMP_ZLIB
+            level = min(level, 9)
     if compression == COMP_ZLIB:
         c = zlib.compress(payload, level)
         if len(c) < len(payload):
@@ -154,8 +164,6 @@ def block_encode(raw: bytes | np.ndarray, nrows: int, compression: int = COMP_ZL
         else:
             comp = COMP_NONE
     elif compression == COMP_ZSTD:
-        import zstandard
-
         c = zstandard.ZstdCompressor(level=level).compress(payload)
         if len(c) < len(payload):
             payload = c
@@ -196,7 +204,12 @@ def block_decode(frame: bytes) -> tuple[bytes, int, int]:
     if comp == COMP_ZLIB:
         raw = zlib.decompress(payload)
     elif comp == COMP_ZSTD:
-        import zstandard
+        try:
+            import zstandard
+        except ModuleNotFoundError:
+            raise IOError(
+                "block is zstd-compressed but the optional 'zstandard' "
+                "module is not installed on this host")
 
         raw = zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len)
     else:

@@ -19,7 +19,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Hang forensics: tier-1 runs under `timeout -k 10 870`, which kills a hung
+# suite SILENTLY. Dump every thread's stack shortly before that deadline so
+# a future channel/collective hang leaves a traceback in the log instead of
+# nothing (docs/ROBUSTNESS.md). repeat=False: one dump, no log spam.
+_WATCHDOG_S = float(os.environ.get("GGTPU_TEST_WATCHDOG_S", "840"))
+if _WATCHDOG_S > 0:
+    faulthandler.dump_traceback_later(_WATCHDOG_S, repeat=False, exit=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # a finished run must not leave the timer armed (it would fire inside
+    # whatever process reuses this interpreter, e.g. pytest plugins' atexit)
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(scope="session")

@@ -5,16 +5,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
-
+from greengage_tpu.exec.compile import _shard_map
 from greengage_tpu.ops import hashing
 from greengage_tpu.parallel import SEG_AXIS, make_mesh
 from greengage_tpu.parallel import motion
 
 
 def _run_sharded(mesh, fn, *arrs):
-    f = shard_map(fn, mesh=mesh, in_specs=P(SEG_AXIS), out_specs=P(SEG_AXIS),
-                  check_vma=False)
+    f = _shard_map(fn, mesh=mesh, in_specs=P(SEG_AXIS),
+                   out_specs=P(SEG_AXIS))
     return f(*arrs)
 
 

@@ -20,10 +20,10 @@ import json, os, sys
 port, cport, path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["GGTPU_PLATFORM"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 sys.path.insert(0, os.environ["GGTPU_REPO"])
 from greengage_tpu.parallel.multihost import init_multihost
-mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport)
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
 import greengage_tpu
 db = greengage_tpu.connect(path, multihost=mh)
 out = {}
@@ -107,7 +107,7 @@ def test_two_process_cluster(tmp_path):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     })
@@ -115,7 +115,7 @@ def test_two_process_cluster(tmp_path):
         [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
          "-d", path, "--coordinator", f"127.0.0.1:{port}",
          "--control-port", str(cport), "--num-processes", "2",
-         "--process-id", "1"],
+         "--process-id", "1", "--no-distributed"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     coord = subprocess.Popen(
         [sys.executable, "-c", COORD_SCRIPT, str(port), str(cport), path],
@@ -181,10 +181,10 @@ import json, os, sys, time
 port, cport, path, mark = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["GGTPU_PLATFORM"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 sys.path.insert(0, os.environ["GGTPU_REPO"])
 from greengage_tpu.parallel.multihost import init_multihost
-mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport)
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
 import greengage_tpu
 db = greengage_tpu.connect(path, multihost=mh)
 out = {}
@@ -222,7 +222,7 @@ def test_worker_death_detected_and_degraded_service(tmp_path):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     })
@@ -230,7 +230,7 @@ def test_worker_death_detected_and_degraded_service(tmp_path):
         [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
          "-d", path, "--coordinator", f"127.0.0.1:{port}",
          "--control-port", str(cport), "--num-processes", "2",
-         "--process-id", "1"],
+         "--process-id", "1", "--no-distributed"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     coord = subprocess.Popen(
         [sys.executable, "-c", COORD_DEATH_SCRIPT, str(port), str(cport),
@@ -293,10 +293,10 @@ import glob, json, os, sys, time
 port, cport, path, mark = sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["GGTPU_PLATFORM"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 sys.path.insert(0, os.environ["GGTPU_REPO"])
 from greengage_tpu.parallel.multihost import init_multihost
-mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport)
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
 import greengage_tpu
 db = greengage_tpu.connect(path, multihost=mh)
 out = {}
@@ -342,7 +342,7 @@ def test_worker_death_promotes_cross_host_mirrors(tmp_path):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     })
@@ -350,7 +350,7 @@ def test_worker_death_promotes_cross_host_mirrors(tmp_path):
         [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
          "-d", path, "--coordinator", f"127.0.0.1:{port}",
          "--control-port", str(cport), "--num-processes", "2",
-         "--process-id", "1"],
+         "--process-id", "1", "--no-distributed"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     coord = subprocess.Popen(
         [sys.executable, "-c", COORD_MIRROR_DEATH_SCRIPT, str(port),
@@ -381,3 +381,503 @@ def test_worker_death_promotes_cross_host_mirrors(tmp_path):
     assert out["degraded"] is True
     assert out["promoted"] == [4, 5, 6, 7]  # mirrors promoted for lost trees
     assert out["post"] == want            # served from mirror data
+
+
+# ---------------------------------------------------------------------------
+# deadline/heartbeat/rejoin layer (docs/ROBUSTNESS.md): channel-level tests
+# run the REAL protocol objects in-process (pure TCP, no devices), so every
+# phase is deterministic and fast — the isolation2 fts_errors.sql analog.
+# ---------------------------------------------------------------------------
+
+import threading
+import time
+
+
+def _channel_pair(n_workers=1, connect_deadline=10.0):
+    """A real CoordinatorChannel + WorkerChannel(s) over loopback."""
+    from greengage_tpu.parallel.multihost import (CoordinatorChannel,
+                                                  WorkerChannel)
+
+    port = _free_port()
+    box = {}
+
+    def serve():
+        box["ch"] = CoordinatorChannel(port, n_workers,
+                                       connect_deadline=connect_deadline)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    workers = [WorkerChannel("127.0.0.1", port, process_id=i + 1,
+                             connect_deadline=connect_deadline)
+               for i in range(n_workers)]
+    t.join(10)
+    assert "ch" in box, "coordinator accept never completed"
+    return box["ch"], workers
+
+
+def test_accept_deadline_names_missing_workers():
+    """A worker that never launches must fail startup with a joined-count,
+    not hang accept() forever."""
+    from greengage_tpu.parallel.multihost import CoordinatorChannel, WorkerDied
+
+    t0 = time.monotonic()
+    with pytest.raises(WorkerDied, match=r"0 of 2 workers joined"):
+        CoordinatorChannel(_free_port(), 2, connect_deadline=0.4)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_silent_worker_classified_dead_within_deadline():
+    """A connected-but-silent (hung) worker must classify as WorkerDied
+    within the configured deadline on every ack phase."""
+    from greengage_tpu.parallel.multihost import WorkerDied
+
+    ch, (w,) = _channel_pair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(WorkerDied, match="timed out"):
+            with ch.exchange():
+                ch.send({"op": "sql", "sql": "select 1"})
+                ch.collect_acks(deadline=0.4, phase="readiness")
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        ch.close()
+        w.close()
+
+
+def test_failed_send_releases_lock_and_close_does_not_deadlock():
+    """Regression for the cross-method lock discipline: a send that fails
+    (here via the dispatch_send fault point) must leave the per-exchange
+    lock free so close() completes instead of deadlocking."""
+    from greengage_tpu.parallel.multihost import WorkerDied
+    from greengage_tpu.runtime.faultinject import faults
+
+    ch, (w,) = _channel_pair()
+    try:
+        faults.inject("dispatch_send", "error", occurrences=1)
+        with pytest.raises(WorkerDied, match="dispatch_send"):
+            with ch.exchange():
+                ch.send({"op": "ping"})
+                ch.collect_acks(deadline=1.0)
+    finally:
+        faults.reset("dispatch_send")
+    done = threading.Event()
+
+    def closer():
+        ch.close()
+        done.set()
+
+    threading.Thread(target=closer, daemon=True).start()
+    assert done.wait(5.0), \
+        "close() deadlocked on a lock left held by a failed send"
+    w.close()
+
+
+def test_worker_recv_distinguishes_stop_from_coordinator_death():
+    """EOF without a stop frame is a CRASHED coordinator (CoordinatorLost,
+    logged + rejoin attempt), never a silent clean exit."""
+    from greengage_tpu.parallel.multihost import CoordinatorLost
+
+    ch, (w,) = _channel_pair()
+    with ch.exchange():
+        ch.send({"op": "stop"})
+    assert w.recv()["op"] == "stop"       # clean shutdown: a normal frame
+    ch.close()
+    w.close()
+
+    ch2, (w2,) = _channel_pair()
+    for p in ch2._workers:                # abrupt death: no stop frame
+        p.close()
+    with pytest.raises(CoordinatorLost, match="without a stop frame"):
+        w2.recv()
+    ch2.close()
+    w2.close()
+
+
+def test_heartbeat_detects_partition_and_marks_channel_dead():
+    """Idle-time ping/pong: once a worker stops answering, hb_failure is
+    recorded within ~one interval and every later send raises WorkerDied
+    (the next statement degrades instead of dispatching)."""
+    from greengage_tpu.config import Settings
+    from greengage_tpu.parallel.multihost import WorkerDied
+
+    ch, (w,) = _channel_pair()
+    s = Settings()
+    s.mh_heartbeat_interval = 0.1
+    ch.settings = s
+    answered = threading.Event()
+
+    def pong_twice():
+        for _ in range(2):
+            if w.recv().get("op") == "ping":
+                w.ack(True)
+        answered.set()
+        # then fall silent (partition analog) — keep the socket open
+
+    t = threading.Thread(target=pong_twice, daemon=True)
+    t.start()
+    ch.start_heartbeat()
+    assert answered.wait(5.0)
+    end = time.monotonic() + 5.0
+    while ch.hb_failure is None and time.monotonic() < end:
+        time.sleep(0.02)
+    assert ch.hb_failure is not None, \
+        "silent worker never failed the heartbeat liveness check"
+    with pytest.raises(WorkerDied, match="marked dead"):
+        with ch.exchange():
+            ch.send({"op": "sql", "sql": "select 1"})
+    ch.close()
+    w.close()
+
+
+def test_quiesce_keeps_listener_and_gang_rejoins():
+    """After quiesce (degrade) the listener stays open: a worker that
+    reconnects + hellos is adopted and the channel serves exchanges
+    again — the control-plane half of gang recovery."""
+    from greengage_tpu.parallel.multihost import CoordinatorLost
+
+    ch, (w,) = _channel_pair()
+    ch.quiesce()
+    with pytest.raises(CoordinatorLost):
+        w.recv()                           # our connection was torn down
+    assert w.reconnect(), "reconnect to the kept listener failed"
+    end = time.monotonic() + 5.0
+    while not ch.rejoin_ready() and time.monotonic() < end:
+        time.sleep(0.02)
+    assert ch.rejoin_ready(), "hello frame never completed the gang"
+    ch.adopt_rejoined()
+
+    def pong_once():
+        if w.recv().get("op") == "ping":
+            w.ack(True, topology_version=7)
+
+    t = threading.Thread(target=pong_once, daemon=True)
+    t.start()
+    acks = ch.broadcast({"op": "ping"}, deadline=5.0)
+    assert acks == [{"ok": True, "error": None, "topology_version": 7}]
+    ch.close()
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# session-level: a REAL Database dispatching through the protocol against a
+# scripted worker thread (all 8 mesh devices are local to the coordinator,
+# so results are complete without a second process). Covers hang/death at
+# each phase — readiness, go, completion — with bounded-time degradation
+# and rejoin, no sleeps longer than the configured deadlines.
+# ---------------------------------------------------------------------------
+
+def _scripted_gang(tmp_path, settings_json):
+    """Database(multihost=coordinator) + a WorkerChannel the test scripts."""
+    import json as _json
+
+    import greengage_tpu
+    from greengage_tpu.parallel.multihost import MultihostRuntime
+
+    path = str(tmp_path / "cluster")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "settings.json"), "w") as f:
+        f.write(_json.dumps(settings_json))
+    ch, (w,) = _channel_pair()
+    db = greengage_tpu.connect(path, numsegments=8,
+                               multihost=MultihostRuntime(0, 2, ch))
+    db.sql("create table t (k bigint, v int) distributed by (k)")
+    db.sql("insert into t values " + ",".join(
+        f"({i}, {i % 7})" for i in range(300)))
+    db.sql("analyze")
+    return db, ch, w
+
+
+def _serve_mesh(w, n=100):
+    """Scripted worker: answer sync/ping/sql frames like worker_loop does
+    (no device work — the coordinator owns every segment here)."""
+    from greengage_tpu.parallel.multihost import CoordinatorLost
+
+    try:
+        for _ in range(n):
+            msg = w.recv(idle_timeout=30.0)
+            op = msg.get("op")
+            if op == "stop":
+                return
+            if op == "sync":
+                w.ack(True, topology_version=msg.get("topology_version"))
+            elif op == "ping":
+                w.ack(True)
+            elif op == "sql":
+                w.ack(True)                       # readiness
+                if w.recv(idle_timeout=30.0).get("op") == "go":
+                    w.ack(True)                   # completion
+    except (CoordinatorLost, OSError):
+        return
+
+
+def _recover(db, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if db.mh_try_recover():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_session_hang_at_readiness_degrades_and_rejoins(devices8, tmp_path):
+    """Worker goes silent on the readiness round: detection within
+    mh_ready_deadline, the statement completes degraded, the worker
+    rejoins, and the session returns to mesh dispatch."""
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_ready_deadline": 0.5})
+
+    def script():
+        from greengage_tpu.parallel.multihost import CoordinatorLost
+
+        try:
+            while True:
+                if w.recv(idle_timeout=30.0).get("op") == "sql":
+                    break                 # swallow it: hung worker
+        except (CoordinatorLost, OSError):
+            pass
+        try:
+            while True:
+                w.recv(idle_timeout=30.0)  # wait for the quiesce teardown
+        except (CoordinatorLost, OSError):
+            pass
+        if w.reconnect():
+            _serve_mesh(w)
+
+    t = threading.Thread(target=script, daemon=True)
+    t.start()
+    res = {}
+    qt = threading.Thread(
+        target=lambda: res.update(r=db.sql("select count(*), sum(v) from t")),
+        daemon=True)
+    t0 = time.monotonic()
+    qt.start()
+    while db._mh_degraded is None and time.monotonic() - t0 < 5.0:
+        time.sleep(0.02)
+    detect_s = time.monotonic() - t0
+    assert db._mh_degraded, "hung worker never detected"
+    assert detect_s < 5.0                 # 0.5s deadline + slack, no hang
+    qt.join(240)                          # degraded subprocess completes it
+    assert not qt.is_alive(), "degraded statement never completed"
+    r = res["r"]
+    assert [int(x) for x in r.rows()[0]] == [300, sum(i % 7 for i in range(300))]
+    assert r.stats.get("degraded") is True
+    assert _recover(db), "gang never recovered after worker rejoin"
+    assert db._mh_degraded is None
+    r = db.sql("select count(*), sum(v) from t")   # two-phase mesh again
+    assert [int(x) for x in r.rows()[0]] == [300, sum(i % 7 for i in range(300))]
+    assert r.stats.get("segments") == 8            # mesh, not degraded
+    ch.close()
+    t.join(10)
+
+
+def test_session_death_at_go_phase_degrades_and_rejoins(devices8, tmp_path):
+    """The go frame fails (dispatch_send fault, start_after=1 so the sql
+    broadcast before it succeeds): nobody entered a collective, the
+    statement completes degraded, and the gang re-forms."""
+    from greengage_tpu.runtime.faultinject import faults
+
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0})
+
+    def script():
+        from greengage_tpu.parallel.multihost import CoordinatorLost
+
+        try:
+            msg = w.recv(idle_timeout=30.0)
+            assert msg.get("op") == "sql"
+            w.ack(True)                   # readiness answered fine
+            while True:
+                w.recv(idle_timeout=30.0)  # go never arrives; EOF next
+        except (CoordinatorLost, OSError):
+            pass
+        if w.reconnect():
+            _serve_mesh(w)
+
+    t = threading.Thread(target=script, daemon=True)
+    t.start()
+    faults.inject("dispatch_send", "error", occurrences=1, start_after=1)
+    try:
+        r = db.sql("select count(*) from t")
+    finally:
+        faults.reset("dispatch_send")
+    assert int(r.rows()[0][0]) == 300
+    assert r.stats.get("degraded") is True
+    assert db._mh_degraded
+    assert _recover(db), "gang never recovered after worker rejoin"
+    r = db.sql("select count(*) from t")
+    assert int(r.rows()[0][0]) == 300
+    assert r.stats.get("segments") == 8
+    ch.close()
+    t.join(10)
+
+
+def test_session_hang_at_completion_keeps_result_and_rejoins(devices8, tmp_path):
+    """Worker answers readiness + go but never acks completion: the
+    coordinator's own result stands (it already executed), the session
+    degrades within mh_ack_deadline, then recovers on rejoin."""
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_ack_deadline": 0.5})
+
+    def script():
+        from greengage_tpu.parallel.multihost import CoordinatorLost
+
+        try:
+            msg = w.recv(idle_timeout=30.0)
+            assert msg.get("op") == "sql"
+            w.ack(True)                   # readiness
+            w.recv(idle_timeout=30.0)     # go — then never ack completion
+            while True:
+                w.recv(idle_timeout=30.0)  # hang until EOF from quiesce
+        except (CoordinatorLost, OSError):
+            pass
+        if w.reconnect():
+            _serve_mesh(w)
+
+    t = threading.Thread(target=script, daemon=True)
+    t.start()
+    r = db.sql("select count(*), sum(v) from t")
+    assert [int(x) for x in r.rows()[0]] == [300, sum(i % 7 for i in range(300))]
+    assert r.stats.get("segments") == 8   # computed on the mesh, not degraded
+    assert db._mh_degraded, "completion-ack hang did not degrade the gang"
+    assert _recover(db), "gang never recovered after worker rejoin"
+    assert db._mh_degraded is None
+    r = db.sql("select count(*) from t")
+    assert int(r.rows()[0][0]) == 300
+    ch.close()
+    t.join(10)
+
+
+# ---------------------------------------------------------------------------
+# full 2-process cluster: fault-injected worker HANG (not death) during the
+# readiness round — bounded-time degradation, then the woken worker rejoins
+# over the kept listener and the session resumes two-phase mesh dispatch
+# through the real worker_loop. Control-plane-only gang (distributed=False):
+# this jax's CPU backend has no cross-process collectives, so each process
+# runs the lockstep program on its own full local mesh.
+# ---------------------------------------------------------------------------
+
+COORD_HANG_REJOIN_SCRIPT = r"""
+import json, os, sys, time
+port, cport, path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.environ["GGTPU_REPO"])
+from greengage_tpu.parallel.multihost import init_multihost
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
+import greengage_tpu
+db = greengage_tpu.connect(path, multihost=mh)
+out = {}
+db.sql("create table f (k bigint, v int) distributed by (k)")
+db.sql("insert into f values " + ",".join(f"({i}, {i % 7})" for i in range(2000)))
+db.sql("analyze")
+r = db.sql("select count(*), sum(v) from f")
+out["pre"] = [int(x) for x in r.rows()[0]]
+# bound the readiness round tightly, then arm a one-shot 4s hang on the
+# worker's ack path (gp_inject_fault dispatched over the control channel)
+db.sql("set mh_ready_deadline = 1")
+db.cluster_inject_fault("worker_ack", type="sleep", sleep_s=4, occurrences=1)
+t0 = time.monotonic()
+r = db.sql("select count(*), sum(v) from f")
+out["stmt_s"] = time.monotonic() - t0
+out["post"] = [int(x) for x in r.rows()[0]]
+out["degraded_during"] = bool(db._mh_degraded)
+out["deg_stats"] = bool(getattr(r, "stats", {}).get("degraded"))
+# the worker wakes at ~4s, finds its connection gone, and redials the
+# kept listener; recovery replays the settings/topology sync
+rec = False
+end = time.monotonic() + 90
+while time.monotonic() < end:
+    if db.mh_try_recover():
+        rec = True
+        break
+    time.sleep(0.1)
+out["recovered"] = rec
+if rec:
+    r = db.sql("select count(*), sum(v) from f")
+    out["post_rejoin"] = [int(x) for x in r.rows()[0]]
+    out["segments"] = r.stats.get("segments")
+    out["degraded_after"] = bool(db._mh_degraded)
+    db.sql("delete from f where k < 50")
+    r = db.sql("select count(*) from f")
+    out["post_dml"] = int(r.rows()[0][0])
+    # idle-time partition: a one-shot 3s hang on the worker's ping reply
+    # (heartbeat fault point) must mark the channel dead BETWEEN
+    # statements, degrade the next (host-only) statement, and the gang
+    # must recover a SECOND time once the worker wakes and redials
+    db.cluster_inject_fault("heartbeat", type="sleep", sleep_s=3,
+                            occurrences=1)
+    end = time.monotonic() + 20
+    while db.multihost.channel.hb_failure is None and time.monotonic() < end:
+        time.sleep(0.1)
+    out["hb_failure"] = bool(db.multihost.channel.hb_failure)
+    db.sql("create table hb_marker (k int)")   # host-only: degrades locally
+    out["hb_degraded"] = bool(db._mh_degraded)
+    rec2 = False
+    end = time.monotonic() + 90
+    while time.monotonic() < end:
+        if db.mh_try_recover():
+            rec2 = True
+            break
+        time.sleep(0.1)
+    out["recovered_again"] = rec2
+    if rec2:
+        r = db.sql("select count(*) from f")
+        out["post_rejoin2"] = int(r.rows()[0][0])
+mh.channel.close()   # clean stop frame: the worker exits instead of redialing
+print("RESULT:" + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def test_cluster_worker_hang_bounded_degrade_then_rejoin(tmp_path):
+    port, cport = _free_port(), _free_port()
+    path = str(tmp_path / "cluster")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    })
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
+         "-d", path, "--coordinator", f"127.0.0.1:{port}",
+         "--control-port", str(cport), "--num-processes", "2",
+         "--process-id", "1", "--no-distributed"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    coord = subprocess.Popen(
+        [sys.executable, "-c", COORD_HANG_REJOIN_SCRIPT, str(port),
+         str(cport), path],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        cout, _ = coord.communicate(timeout=480)
+        wout, _ = worker.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        coord.kill()
+        worker.kill()
+        cout = coord.stdout.read() if coord.stdout else ""
+        wout = worker.stdout.read() if worker.stdout else ""
+        raise AssertionError(
+            f"hang/rejoin timeout\ncoordinator:\n{cout}\nworker:\n{wout}")
+    assert coord.returncode == 0, f"coordinator:\n{cout}\nworker:\n{wout}"
+    res = [ln for ln in cout.splitlines() if ln.startswith("RESULT:")]
+    assert res, f"coordinator:\n{cout}\nworker:\n{wout}"
+    out = json.loads(res[0][len("RESULT:"):])
+    want = [2000, sum(i % 7 for i in range(2000))]
+    assert out["pre"] == want
+    assert out["post"] == want            # completed DURING the hang, degraded
+    assert out["degraded_during"] is True
+    assert out["deg_stats"] is True
+    assert out["stmt_s"] < 120            # bounded: no unbounded readline
+    assert out["recovered"] is True, f"worker never rejoined:\n{wout}"
+    assert out["post_rejoin"] == want     # two-phase mesh dispatch again
+    assert out["segments"] == 8
+    assert out["degraded_after"] is False
+    assert out["post_dml"] == 1950        # post-rejoin DML dispatches too
+    # idle-time partition caught by heartbeats, then a SECOND recovery
+    assert out["hb_failure"] is True, "heartbeat never flagged the hang"
+    assert out["hb_degraded"] is True
+    assert out["recovered_again"] is True, f"second rejoin failed:\n{wout}"
+    assert out["post_rejoin2"] == 1950
+    # the worker LOGGED the loss and the rejoin instead of exiting silently
+    assert "connection lost" in wout and "reconnected" in wout, wout

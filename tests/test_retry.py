@@ -1,0 +1,126 @@
+"""runtime/retry.py: the shared Deadline/backoff/RetryPolicy primitives
+that bound every control-channel and probe loop (cdbgang/ftsprobe retry
+parity). Pure-host tests — no devices, no sleeps beyond fractions of a
+second."""
+
+import socket
+import time
+
+import pytest
+
+from greengage_tpu.runtime.retry import (Deadline, RetryPolicy,
+                                         TRANSIENT_ERRORS, backoff_delays)
+
+
+def test_deadline_budget_and_clamp():
+    d = Deadline(0.2)
+    assert not d.expired
+    r = d.remaining()
+    assert 0.0 < r <= 0.2
+    assert d.clamp(10.0) <= 0.2          # step timeouts never exceed budget
+    assert d.clamp(0.001) <= 0.001
+    time.sleep(0.25)
+    assert d.expired
+    assert d.remaining() == 0.0
+    assert d.remaining(minimum=0.05) == 0.05
+    with pytest.raises(TimeoutError, match="worker ack"):
+        d.require("worker ack")
+
+
+def test_deadline_unbounded():
+    d = Deadline(None)
+    assert not d.expired
+    assert d.remaining() is None
+    assert d.clamp(7.5) == 7.5
+    d.require("anything")                 # never raises
+
+
+def test_backoff_growth_and_jitter_bounds():
+    delays = backoff_delays(base=0.1, factor=2.0, cap=0.8, jitter=0.5)
+    seq = [next(delays) for _ in range(6)]
+    # nominal ladder 0.1, 0.2, 0.4, 0.8, 0.8, 0.8 with +-50% jitter
+    for got, nominal in zip(seq, [0.1, 0.2, 0.4, 0.8, 0.8, 0.8]):
+        assert 0.5 * nominal <= got <= 1.5 * nominal
+
+
+def test_backoff_stops_at_deadline():
+    dl = Deadline(0.05)
+    delays = backoff_delays(base=0.02, jitter=0.0, deadline=dl)
+    total, n = 0.0, 0
+    for delay in delays:
+        assert delay <= 0.06              # clamped to the remaining budget
+        time.sleep(delay)
+        total += delay
+        n += 1
+        assert n < 50, "generator must terminate once the budget is spent"
+    assert dl.expired
+
+
+def test_retry_policy_retries_transient_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("not up yet")
+        return "ok"
+
+    pol = RetryPolicy(attempts=5, base_s=0.01, jitter=0.0)
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_exhausts_attempts():
+    pol = RetryPolicy(attempts=3, base_s=0.001, jitter=0.0)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise TimeoutError("silent peer")
+
+    with pytest.raises(TimeoutError):
+        pol.call(always_down)
+    assert len(calls) == 3
+
+
+def test_retry_policy_nonretryable_propagates_immediately():
+    pol = RetryPolicy(attempts=10, base_s=0.001)
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("protocol garbage is not transient")
+
+    with pytest.raises(ValueError):
+        pol.call(broken)
+    assert len(calls) == 1
+
+
+def test_retry_policy_deadline_bound():
+    pol = RetryPolicy(deadline_s=0.1, base_s=0.02, jitter=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionResetError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionResetError("x")))
+    assert time.monotonic() - t0 < 1.0    # bounded, not unbounded retry
+
+
+def test_retry_policy_on_retry_observer():
+    seen = []
+    pol = RetryPolicy(attempts=3, base_s=0.001, jitter=0.0)
+
+    def fn():
+        if len(seen) < 1:
+            raise ConnectionError("first")
+        return 42
+
+    assert pol.call(fn, on_retry=lambda a, e, d: seen.append((a, str(e)))) == 42
+    assert seen == [(1, "first")]
+
+
+def test_transient_classification_covers_socket_errors():
+    # the classes the control channel actually raises on a dead/hung peer
+    for exc in (ConnectionResetError("r"), ConnectionRefusedError("c"),
+                BrokenPipeError("p"), socket.timeout("t"), TimeoutError("t"),
+                socket.gaierror("g")):
+        assert isinstance(exc, TRANSIENT_ERRORS), type(exc)
+    assert not isinstance(ValueError("v"), TRANSIENT_ERRORS)
