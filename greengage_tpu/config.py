@@ -70,6 +70,11 @@ class Settings:
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1
+    # read-path self-heal (docs/ROBUSTNESS.md storage failure model): a
+    # corrupt/missing block file is repaired from the IN-SYNC standby tree
+    # and the read retried once; off = detect-and-quarantine only (the
+    # file still quarantines, storage_ok fails, FTS failover takes over)
+    storage_autorepair: bool = True
     # multihost control-plane deadlines + liveness (docs/ROBUSTNESS.md;
     # gp_segment_connect_timeout / gp_fts_probe_timeout family): silence
     # past these bounds classifies as WorkerDied instead of a hang

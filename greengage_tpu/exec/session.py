@@ -111,6 +111,8 @@ class Database:
         # always (re)install — an uncalibrated cluster opened after a
         # calibrated one in the same process must get the defaults back
         _cost.set_calibration(cal)
+        # the store's read-path self-heal honors storage_autorepair live
+        self.store.settings = self.settings
         self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
@@ -146,6 +148,7 @@ class Database:
         # elog/syslogger analog: CSV logs under <cluster>/log (mined by
         # `gg logfilter`); workers stay quiet (the coordinator logs)
         self.log = ClusterLog(self.path, enabled=not is_worker)
+        self.store.log = self.log   # repair/quarantine events land in the log
         self.log.info("lifecycle", f"database ready: {numsegments} segments, "
                       f"{len(devs)} devices")
         for w in self.settings_warnings:
@@ -835,7 +838,7 @@ class Database:
                     parts = fn.split(".")
                     if len(parts) == 3 and fn.endswith(".ggb") \
                             and parts[0] in cols:
-                        self.store.block_index(base, rel)
+                        self.store.block_index(base, rel, table=storage)
 
     def _drop_index(self, stmt: A.DropIndexStmt) -> str:
         for schema in (self.catalog.get(t) for t in self.catalog.tables):

@@ -948,6 +948,48 @@ def cmd_restore(args):
     return 0
 
 
+def cmd_scrub(args):
+    """Storage scrub (AO verify_block_checksums + gprecoverseg repair
+    analog): verify the footer and every frame checksum of every
+    manifest-referenced block file; repair corrupt/missing files from the
+    in-sync standby tree or quarantine them (storage/scrub.py)."""
+    from greengage_tpu.storage.scrub import Scrubber
+
+    db = _open(args.dir)
+    try:
+        # (Scrubber.scrub logs the summary through the cluster log)
+        rep = Scrubber(db.store, repair=not args.no_repair).scrub(
+            tables=[args.table] if args.table else None,
+            mirrors=args.mirrors)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(f"scanned     {rep['files_scanned']} files "
+              f"({rep['bytes_scanned']} bytes)")
+        print(f"verified    {rep['files_verified']}")
+        print(f"repaired    {rep['files_repaired']}")
+        print(f"quarantined {rep['files_quarantined']}")
+        if rep["files_corrupt"]:
+            print(f"corrupt     {rep['files_corrupt']} (--no-repair)")
+        if rep["files_missing"]:
+            print(f"missing     {rep['files_missing']}")
+        if args.mirrors:
+            print(f"standby     {rep['standby_verified']} verified, "
+                  f"{rep['standby_repaired']} repaired")
+        for p in rep["problems"]:
+            print(f"  {p.get('status', '?'):<12} {p.get('table')}/"
+                  f"{p.get('relpath')} [{p.get('cause', '?')}]")
+    bad = (rep["files_quarantined"] + rep["files_missing"]
+           + rep["files_corrupt"]
+           + sum(1 for p in rep["problems"]
+                 if str(p.get("status", "")).startswith(
+                     ("standby_corrupt", "standby_refresh"))))
+    return 1 if bad else 0
+
+
 def cmd_checkcat(args):
     db = _open(args.dir)
     problems = []
@@ -1140,6 +1182,17 @@ def main(argv=None):
     p = sub.add_parser("checkcat")
     p.add_argument("-d", "--dir", required=True)
     p.set_defaults(fn=cmd_checkcat)
+
+    p = sub.add_parser("scrub")     # storage verify + repair-or-quarantine
+    p.add_argument("-d", "--dir", required=True)
+    p.add_argument("-t", "--table", default=None)
+    p.add_argument("--mirrors", action="store_true",
+                   help="also verify (and refresh) standby-tree copies")
+    p.add_argument("--no-repair", action="store_true",
+                   help="report only; do not repair or quarantine")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=cmd_scrub)
 
     p = sub.add_parser("archive")       # WAL-archive analog
     p.add_argument("-d", "--dir", required=True)

@@ -26,6 +26,36 @@ import threading
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL", "PANIC")
 
 
+class Counters:
+    """Process-wide monotonic event counters (the pg_stat counter surface):
+    storage repair/quarantine/scrub events land here so tests and `gg
+    scrub`/`gg state` can assert on behavior without parsing log text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+            return self._c[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._c.clear()
+
+
+counters = Counters()   # shared registry (shmem stats analog)
+
+
 class ClusterLog:
     def __init__(self, root: str, enabled: bool = True):
         self.dir = os.path.join(root, "log")

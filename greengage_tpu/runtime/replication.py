@@ -35,7 +35,24 @@ import shutil
 import tempfile
 
 from greengage_tpu.catalog.segments import SegmentRole, SegmentStatus
+from greengage_tpu.storage.blockfile import fsync_dir
 from greengage_tpu.storage.table_store import mirror_root
+
+
+def copy_durable(src: str, dst: str, tmp: str | None = None) -> None:
+    """Copy src -> dst with the data fsynced BEFORE the atomic rename and
+    the containing directory fsynced after. The repair path and the FTS
+    sync-state check both TRUST files under a synced marker, so a crash
+    must never leave torn mirror bytes behind a marker that says synced.
+    ``tmp`` overrides the staging name (repair passes a unique one so
+    concurrent repairers never interleave writes)."""
+    tmp = tmp or dst + ".tmp"
+    with open(src, "rb") as s, open(tmp, "wb") as d:
+        shutil.copyfileobj(s, d)
+        d.flush()
+        os.fsync(d.fileno())
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(dst))
 
 
 def _tree_root(store_root: str, content: int, preferred_role) -> str:
@@ -72,6 +89,7 @@ def _write_marker(tree: str, content: int, version: int) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, _marker_path(tree, content))
+    fsync_dir(tree)
 
 
 class Replicator:
@@ -89,13 +107,18 @@ class Replicator:
                 out.append((e.content, e))
         return sorted(out, key=lambda p: p[0])
 
-    def _copy_content(self, snap: dict, content: int, dst_tree: str) -> int:
+    def _copy_content(self, snap: dict, content: int,
+                      dst_tree: str) -> tuple[int, int]:
         """Copy every manifest-referenced file + dictionaries of this
         content from the acting tree into dst_tree. Committed files are
-        immutable, so copy-if-absent is a complete incremental protocol."""
+        immutable, so copy-if-absent is a complete incremental protocol.
+        -> (copied, missing): a quarantined/lost source is SKIPPED, not an
+        error — FTS and the scrubber own that failure, and one content's
+        corruption must not fail unrelated statements' post-commit sync —
+        but the caller must not mark a tree with missing files synced."""
         src_tree = self.store.data_root(content)
         data_tree = os.path.join(self.store.root, "data")
-        copied = 0
+        copied = missing = 0
         for tname, tmeta in snap.get("tables", {}).items():
             src_t = os.path.join(src_tree, tname)
             # dictionaries: table-global and AUTHORITATIVE in the data tree
@@ -110,17 +133,23 @@ class Replicator:
                         if fn.startswith("dict_"):
                             dst_t = os.path.join(dst_tree, tname)
                             os.makedirs(dst_t, exist_ok=True)
-                            shutil.copy(os.path.join(dict_src, fn),
-                                        os.path.join(dst_t, fn))
+                            copy_durable(os.path.join(dict_src, fn),
+                                         os.path.join(dst_t, fn))
             for rel in tmeta.get("segfiles", {}).get(str(content), []):
                 dst = os.path.join(dst_tree, tname, rel)
                 if os.path.exists(dst):
                     continue
                 os.makedirs(os.path.dirname(dst), exist_ok=True)
-                shutil.copy(os.path.join(src_t, rel), dst + ".tmp")
-                os.replace(dst + ".tmp", dst)
+                # fsync BEFORE _write_marker stamps the tree as synced: a
+                # crash must not leave a synced marker over torn files
+                # that FTS promotion and block-file repair would trust
+                try:
+                    copy_durable(os.path.join(src_t, rel), dst)
+                except FileNotFoundError:
+                    missing += 1
+                    continue
                 copied += 1
-        return copied
+        return copied, missing
 
     def sync(self) -> dict[int, int]:
         """Bring every standby tree up to the current manifest version.
@@ -133,7 +162,12 @@ class Replicator:
             if os.path.normpath(dst_tree) == os.path.normpath(
                     self.store.data_root(content)):
                 continue   # standby tree IS the acting tree (misconfig guard)
-            self._copy_content(snap, content, dst_tree)
+            _copied, miss = self._copy_content(snap, content, dst_tree)
+            if miss:
+                # quarantined/lost acting files: the standby cannot reach
+                # this version — leave its old marker, bar promotion past it
+                standby.mode_synced = False
+                continue
             _write_marker(dst_tree, content, version)
             out[content] = version
             standby.mode_synced = True
@@ -159,7 +193,12 @@ class Replicator:
                         if acting.preferred_role is SegmentRole.MIRROR
                         else SegmentRole.MIRROR)
         dst_tree = _tree_root(self.store.root, content, standby_pref)
-        copied = self._copy_content(snap, content, dst_tree)
+        copied, miss = self._copy_content(snap, content, dst_tree)
+        if miss:
+            # the acting tree itself is incomplete (quarantined files):
+            # an honest rebuild is impossible — leave the standby unsynced
+            # and the content's down markers in place for the operator
+            return copied
         # dictionaries live authoritatively in the data tree and are not
         # deleted by a seg-file loss; nothing to rebuild for them
         _write_marker(dst_tree, content, snap.get("version", 0))

@@ -4,12 +4,18 @@ Reference parity: one segment file set per column with block-level
 compression and checksummed headers (src/backend/access/aocs/aocsam.c,
 src/backend/cdb/cdbappendonlystorageformat.c). Layout:
 
-    [frame]* [footer-json] [u64 footer_len] [u32 magic "GGBF"]
+    [frame]* [footer-json] [u32 footer-crc] [u64 footer_len] [u32 magic "GGBF"]
 
 Each frame is ggcodec's checksummed block (native.block_encode). The footer
 records per-block (offset, nrows) so scans can do block-level skipping
 (block directory analog) and projection reads only touch requested columns'
-files.
+files. The footer JSON carries its own crc32 in the tail so footer damage
+(including a bit flip inside a valid-JSON value) classifies as corruption
+instead of silently mis-describing the frames.
+
+All verification failures raise the typed ``CorruptionError``
+(storage/corruption.py) carrying the path, block index, and cause — the
+contract the read-path self-heal and the scrubber dispatch on.
 """
 
 from __future__ import annotations
@@ -17,15 +23,36 @@ from __future__ import annotations
 import json
 import os
 import warnings
+import zlib
 
 import numpy as np
 
 from greengage_tpu.storage import native
+from greengage_tpu.storage.corruption import CorruptionError
 
-FOOTER_MAGIC = 0x47474246  # "GGBF"
+# bumped with the checksummed-footer format change ("GGBF" -> "GGF2") so
+# files written by the 12-byte-tail layout fail with a CLEAR bad_footer
+# classification, never a misparse of JSON bytes as a CRC
+FOOTER_MAGIC = 0x32464747  # "GGF2"
+FOOTER_TAIL = 16           # u32 crc + u64 footer_len + u32 magic
 DEFAULT_BLOCK_ROWS = 1 << 16
 
 _COMP_BY_NAME = {"none": native.COMP_NONE, "zlib": native.COMP_ZLIB, "zstd": native.COMP_ZSTD}
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed/created entry survives a crash
+    (rename durability needs the parent's metadata flushed too)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def write_column_file(path: str, values: np.ndarray, compresstype: str = "zlib",
@@ -70,6 +97,7 @@ def write_column_file(path: str, values: np.ndarray, compresstype: str = "zlib",
         }
         fj = json.dumps(footer).encode()
         f.write(fj)
+        f.write((zlib.crc32(fj) & 0xFFFFFFFF).to_bytes(4, "little"))
         f.write(len(fj).to_bytes(8, "little"))
         f.write(FOOTER_MAGIC.to_bytes(4, "little"))
         f.flush()
@@ -79,35 +107,147 @@ def write_column_file(path: str, values: np.ndarray, compresstype: str = "zlib",
 
 
 def read_footer(path: str) -> dict:
+    """Parse + verify the footer. Short/truncated/garbage-tail/damaged
+    footers classify as CorruptionError with the path and cause."""
     with open(path, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
-        f.seek(size - 12)
-        tail = f.read(12)
-        if int.from_bytes(tail[8:12], "little") != FOOTER_MAGIC:
-            raise IOError(f"{path}: bad footer magic")
-        flen = int.from_bytes(tail[:8], "little")
-        f.seek(size - 12 - flen)
-        return json.loads(f.read(flen))
+        if size < FOOTER_TAIL:
+            raise CorruptionError(
+                "truncated",
+                f"file is {size} bytes, smaller than the {FOOTER_TAIL}-byte "
+                "footer tail", path=path)
+        f.seek(size - FOOTER_TAIL)
+        tail = f.read(FOOTER_TAIL)
+        tail_magic = int.from_bytes(tail[12:16], "little")
+        if tail_magic == 0x47474246:   # "GGBF": the pre-CRC 12-byte tail
+            raise IOError(
+                f"{path}: unsupported block-file format GGBF (written by an "
+                "older, incompatible version) — re-ingest from original "
+                "sources")
+        if tail_magic != FOOTER_MAGIC:
+            raise CorruptionError(
+                "bad_footer", "bad footer magic (garbage tail or not a "
+                "block file)", path=path)
+        flen = int.from_bytes(tail[4:12], "little")
+        if flen > size - FOOTER_TAIL:
+            raise CorruptionError(
+                "truncated",
+                f"footer length {flen} exceeds file size {size}", path=path)
+        f.seek(size - FOOTER_TAIL - flen)
+        fj = f.read(flen)
+        if (zlib.crc32(fj) & 0xFFFFFFFF) != int.from_bytes(tail[:4], "little"):
+            raise CorruptionError(
+                "bad_footer", "footer checksum mismatch", path=path)
+        try:
+            footer = json.loads(fj)
+        except ValueError as e:
+            raise CorruptionError(
+                "bad_footer", f"footer is not valid JSON ({e})", path=path)
+        if not isinstance(footer, dict) or not isinstance(
+                footer.get("blocks"), list) or "dtype" not in footer:
+            raise CorruptionError(
+                "bad_footer", "footer missing dtype/blocks", path=path)
+        try:
+            np.dtype(footer["dtype"])
+        except TypeError as e:
+            raise CorruptionError(
+                "bad_footer", f"footer dtype unparseable ({e})", path=path)
+        return footer
 
 
-def read_column_file(path: str, block_indices: list[int] | None = None) -> np.ndarray:
-    """Read all (or selected) blocks back into one numpy array."""
+def _maybe_inject_corruption(frame: bytes, segment: int | None) -> bytes:
+    """The storage_corrupt_block fault point: a 'skip'-type fault flips one
+    payload byte of the frame AT READ TIME (occurrence/start_after
+    targeting picks which frame of which read) — the gp_inject_fault
+    AppendOnlyStorageRead corruption analog."""
+    from greengage_tpu.runtime.faultinject import faults
+
+    if not faults.check("storage_corrupt_block", segment=segment):
+        return frame
+    bad = bytearray(frame)
+    if bad:
+        pos = native.HDR_LEN + max(0, (len(bad) - native.HDR_LEN) // 2) \
+            if len(bad) > native.HDR_LEN else len(bad) // 2
+        bad[min(pos, len(bad) - 1)] ^= 0xFF
+    return bytes(bad)
+
+
+def read_column_file(path: str, block_indices: list[int] | None = None,
+                     segment: int | None = None) -> np.ndarray:
+    """Read all (or selected) blocks back into one numpy array. ``segment``
+    only targets the storage_corrupt_block fault point."""
     footer = read_footer(path)
     dtype = np.dtype(footer["dtype"])
-    blocks = footer["blocks"]
+    blocks = list(enumerate(footer["blocks"]))
     if block_indices is not None:
         blocks = [blocks[i] for i in block_indices]
     parts = []
     with open(path, "rb") as f:
-        for b in blocks:
+        for i, b in blocks:
             f.seek(b["offset"])
             frame = f.read(b["bytes"])
-            raw, nrows, _ = native.block_decode(frame)
-            arr = np.frombuffer(raw, dtype=dtype)
-            if len(arr) != nrows:
-                raise IOError(f"{path}: block row count mismatch")
+            frame = _maybe_inject_corruption(frame, segment)
+            try:
+                raw, nrows, _ = native.block_decode(frame)
+            except CorruptionError as e:
+                raise e.locate(path=path, block=i)
+            try:
+                arr = np.frombuffer(raw, dtype=dtype)
+            except ValueError as e:
+                raise CorruptionError(
+                    "decode_failed", f"block payload not {dtype}-shaped ({e})",
+                    path=path, block=i)
+            if len(arr) != nrows or nrows != b["nrows"]:
+                raise CorruptionError(
+                    "rowcount_mismatch",
+                    f"block decoded {len(arr)} rows, frame header says "
+                    f"{nrows}, footer says {b['nrows']}", path=path, block=i)
             parts.append(arr)
     if not parts:
         return np.empty(0, dtype=dtype)
     return np.concatenate(parts)
+
+
+def verify_column_file(path: str, segment: int | None = None,
+                       inject: bool = True) -> dict:
+    """Verify the footer and EVERY frame (checksums, decode, row counts)
+    without materializing the column. Raises CorruptionError (with path +
+    block) on the first failure; returns {bytes, blocks, nrows} scanned —
+    the scrub/repair verification primitive. ``inject=False`` exempts the
+    read from the storage_corrupt_block fault point: repair's own
+    verification must judge the REAL bytes, or an armed fault would
+    quarantine healthy files."""
+    footer = read_footer(path)
+    dtype = np.dtype(footer["dtype"])
+    total_rows = 0
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        for i, b in enumerate(footer["blocks"]):
+            f.seek(b["offset"])
+            frame = f.read(b["bytes"])
+            if inject:
+                frame = _maybe_inject_corruption(frame, segment)
+            try:
+                raw, nrows, consumed = native.block_decode(frame)
+            except CorruptionError as e:
+                raise e.locate(path=path, block=i)
+            if consumed != b["bytes"]:
+                raise CorruptionError(
+                    "truncated",
+                    f"frame consumed {consumed} bytes, footer says "
+                    f"{b['bytes']}", path=path, block=i)
+            if nrows != b["nrows"] or len(raw) != nrows * dtype.itemsize:
+                raise CorruptionError(
+                    "rowcount_mismatch",
+                    f"block holds {len(raw) // max(dtype.itemsize, 1)} rows, "
+                    f"frame header says {nrows}, footer says {b['nrows']}",
+                    path=path, block=i)
+            total_rows += nrows
+    if total_rows != footer["nrows"]:
+        raise CorruptionError(
+            "rowcount_mismatch",
+            f"frames hold {total_rows} rows, footer says {footer['nrows']}",
+            path=path)
+    return {"bytes": size, "blocks": len(footer["blocks"]), "nrows": total_rows}

@@ -22,6 +22,13 @@ import os
 import tempfile
 
 
+class ManifestError(RuntimeError):
+    """FATAL: the cluster's commit record is unreadable. Nothing can be
+    repaired from segment mirrors (the manifest IS the thing that says
+    which files exist) — recover from the standby coordinator, a backup,
+    or the archive (docs/ROBUSTNESS.md)."""
+
+
 class Manifest:
     def __init__(self, root: str):
         self.root = root
@@ -33,7 +40,15 @@ class Manifest:
         if not os.path.exists(self.path):
             return {"version": 0, "tables": {}}
         with open(self.path) as f:
-            return json.load(f)
+            try:
+                return json.load(f)
+            except ValueError as e:
+                # never let a bare JSONDecodeError escape: this is the
+                # cluster's commit record, name it and say what to do
+                raise ManifestError(
+                    f"corrupt manifest at {self.path}: {e} — restore from "
+                    "the standby coordinator, a backup, or the archive"
+                ) from e
 
     # ---- transactions --------------------------------------------------
     def begin(self) -> dict:
@@ -104,7 +119,11 @@ class Manifest:
         """In-doubt resolution (cdbdtxrecovery.c analog): roll back any
         prepared-but-uncommitted manifests (version ABOVE the committed
         head) found after a crash; claims at or below the head are the
-        committed versions' permanent markers (GC'd once far behind)."""
+        committed versions' permanent markers (GC'd once far behind).
+
+        A corrupt manifest.json SURFACES here as ManifestError (startup
+        must refuse to open, not quietly roll back live versions against
+        a half-read head)."""
         current = self.snapshot().get("version", 0)
         rolled = []
         for fn in os.listdir(self.root):

@@ -16,12 +16,17 @@ import zlib
 
 import numpy as np
 
+from greengage_tpu.storage.corruption import CorruptionError
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
 _SO = os.path.join(_NATIVE_DIR, "libggcodec.so")
 
 HASH_INIT = np.uint32(0x9E3779B9)
 COMBINE_MUL = np.uint32(0x01000193)
-BLOCK_MAGIC = 0x47474231
+# "GGB2": bumped with the CRC-covers-header format change so files written
+# by the old frame layout fail with a CLEAR bad_magic, not a confusing
+# checksum mismatch (must match GG_BLOCK_MAGIC in native/ggcodec.cpp)
+BLOCK_MAGIC = 0x47474232
 HDR_LEN = 32
 
 _lib = None
@@ -169,23 +174,53 @@ def block_encode(raw: bytes | np.ndarray, nrows: int, compression: int = COMP_ZL
             payload = c
         else:
             comp = COMP_NONE
-    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    # the CRC covers the header fields as well as the payload, so flipped
+    # metadata (nrows/raw_len/comp_len/codec byte) is caught at decode —
+    # bit-identical to gg_block_encode in native/ggcodec.cpp
     hdr = (BLOCK_MAGIC.to_bytes(4, "little") + int(nrows).to_bytes(4, "little")
            + bytes([comp, 0]) + b"\0\0" + len(raw).to_bytes(8, "little")
-           + len(payload).to_bytes(8, "little") + crc.to_bytes(4, "little"))
-    return hdr + payload
+           + len(payload).to_bytes(8, "little"))
+    crc = zlib.crc32(payload, zlib.crc32(hdr)) & 0xFFFFFFFF
+    return hdr + crc.to_bytes(4, "little") + payload
 
 
 def block_decode(frame: bytes) -> tuple[bytes, int, int]:
-    """-> (raw bytes, nrows, frame length consumed). Verifies checksum."""
-    if len(frame) < HDR_LEN or int.from_bytes(frame[:4], "little") != BLOCK_MAGIC:
-        raise IOError("bad block magic")
+    """-> (raw bytes, nrows, frame length consumed). Verifies the frame
+    checksum (header + payload); all failures raise the typed
+    CorruptionError so readers can classify repair vs quarantine."""
+    if len(frame) < HDR_LEN:
+        raise CorruptionError(
+            "truncated", f"frame is {len(frame)} bytes, header needs {HDR_LEN}")
+    magic = int.from_bytes(frame[:4], "little")
+    if magic == 0x47474231:   # "GGB1": the pre-header-CRC layout
+        # NOT corruption: old-format data must refuse loudly, never feed
+        # the repair/quarantine machinery (which would eat valid files)
+        raise IOError(
+            "unsupported block format GGB1 (written by an older, "
+            "incompatible version) — re-ingest from original sources")
+    if magic != BLOCK_MAGIC:
+        raise CorruptionError("bad_magic", "bad block magic")
     nrows = int.from_bytes(frame[4:8], "little")
     comp = frame[8]
     raw_len = int.from_bytes(frame[12:20], "little")
     comp_len = int.from_bytes(frame[20:28], "little")
     want_crc = int.from_bytes(frame[28:32], "little")
     total = HDR_LEN + comp_len
+    if len(frame) < total:
+        raise CorruptionError(
+            "truncated",
+            f"frame payload truncated ({len(frame)} bytes, header claims {total})")
+    # bound raw_len BEFORE any allocation: the native fast path allocates
+    # its output buffer ahead of the CRC check, so a flipped length must
+    # not drive a huge malloc first. zlib expands at most ~1032:1 and
+    # stored-raw is 1:1; zstd frames never reach a pre-CRC allocation
+    # (python path checks the CRC before decompressing), so a legitimate
+    # high-ratio zstd frame is NOT rejected here.
+    if raw_len < 0 or (comp == COMP_NONE and raw_len != comp_len) \
+            or (comp == COMP_ZLIB and raw_len > comp_len * 1032 + 4096):
+        raise CorruptionError(
+            "decode_failed",
+            f"implausible frame lengths (raw {raw_len}, stored {comp_len})")
     lib = _load()
     if lib and comp in (COMP_NONE, COMP_ZLIB):
         src = np.frombuffer(frame[:total], dtype=np.uint8)
@@ -194,15 +229,21 @@ def block_decode(frame: bytes) -> tuple[bytes, int, int]:
         n = lib.gg_block_decode(src.ctypes.data, len(src), dst.ctypes.data, len(dst),
                                 ctypes.byref(nrows_out))
         if n == -2:
-            raise IOError("block checksum mismatch")
+            raise CorruptionError("crc_mismatch", "block checksum mismatch")
+        if n == -1:
+            raise CorruptionError("bad_magic", "bad block magic")
         if n < 0:
-            raise IOError(f"block decode failed ({n})")
+            raise CorruptionError("decode_failed", f"block decode failed ({n})")
         return dst[:n].tobytes(), nrows_out.value, total
     payload = frame[HDR_LEN:total]
-    if (zlib.crc32(payload) & 0xFFFFFFFF) != want_crc:
-        raise IOError("block checksum mismatch")
+    crc = zlib.crc32(payload, zlib.crc32(frame[: HDR_LEN - 4])) & 0xFFFFFFFF
+    if crc != want_crc:
+        raise CorruptionError("crc_mismatch", "block checksum mismatch")
     if comp == COMP_ZLIB:
-        raw = zlib.decompress(payload)
+        try:
+            raw = zlib.decompress(payload)
+        except zlib.error as e:
+            raise CorruptionError("decode_failed", f"zlib decompress failed: {e}")
     elif comp == COMP_ZSTD:
         try:
             import zstandard
@@ -211,7 +252,20 @@ def block_decode(frame: bytes) -> tuple[bytes, int, int]:
                 "block is zstd-compressed but the optional 'zstandard' "
                 "module is not installed on this host")
 
-        raw = zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len)
-    else:
+        try:
+            raw = zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len)
+        except zstandard.ZstdError as e:
+            raise CorruptionError("decode_failed", f"zstd decompress failed: {e}")
+    elif comp == COMP_NONE:
+        if raw_len != comp_len:
+            raise CorruptionError(
+                "decode_failed",
+                f"stored-raw frame length mismatch ({comp_len} != {raw_len})")
         raw = bytes(payload)
+    else:
+        raise CorruptionError("decode_failed", f"unknown compression {comp}")
+    if len(raw) != raw_len:
+        raise CorruptionError(
+            "decode_failed",
+            f"decoded {len(raw)} bytes, header claims {raw_len}")
     return raw, nrows, total

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import tempfile
 import uuid
 
@@ -25,8 +26,13 @@ import numpy as np
 
 from greengage_tpu import types as T
 from greengage_tpu.catalog import Catalog, PolicyKind, TableSchema
+from greengage_tpu.runtime.faultinject import FaultError, faults
+from greengage_tpu.runtime.logger import counters
 from greengage_tpu.storage import native
-from greengage_tpu.storage.blockfile import read_column_file, write_column_file
+from greengage_tpu.storage.blockfile import (fsync_dir, read_column_file,
+                                             verify_column_file,
+                                             write_column_file)
+from greengage_tpu.storage.corruption import CorruptionError
 from greengage_tpu.storage.dictionary import Dictionary
 from greengage_tpu.storage.manifest import Manifest
 
@@ -34,24 +40,31 @@ from greengage_tpu.storage.manifest import Manifest
 class _RawChunk:
     """One segment's raw TEXT column: per-row END offsets + validity, with
     the byte blob loaded LAZILY — scans/ANALYZE only need offsets/validity
-    (small files); predicates and projections pull the blob on demand."""
+    (small files); predicates and projections pull the blob on demand.
+
+    ``blob_paths`` are manifest relpaths when ``reader`` is given (the
+    store's checked, self-healing read), else filesystem paths."""
 
     def __init__(self, ends: np.ndarray, valid: np.ndarray | None,
-                 blob_paths: list[str]):
+                 blob_paths: list[str], reader=None):
         self.ends = ends
         self.valid = valid
         self._blob_paths = blob_paths
+        self._reader = reader
         self._strs: list[str] | None = None
 
     def __len__(self):
         return len(self.ends)
 
+    def blob(self) -> np.ndarray:
+        """Concatenated utf-8 byte blob across this segment's files."""
+        read = self._reader or read_column_file
+        blobs = [read(p).astype(np.uint8) for p in self._blob_paths]
+        return np.concatenate(blobs) if blobs else np.zeros(0, np.uint8)
+
     def strings(self) -> list[str]:
         if self._strs is None:
-            blobs = [read_column_file(p).astype(np.uint8)
-                     for p in self._blob_paths]
-            b = (np.concatenate(blobs) if blobs
-                 else np.zeros(0, np.uint8)).tobytes()
+            b = self.blob().tobytes()
             starts = np.concatenate([np.zeros(1, np.int64), self.ends[:-1]]) \
                 if len(self.ends) else np.zeros(0, np.int64)
             self._strs = [b[s:e].decode("utf-8")
@@ -128,6 +141,11 @@ class TableStore:
         self.root = root
         self.catalog = catalog
         self.manifest = Manifest(root)
+        # wired by the session after construction: the settings registry
+        # (storage_autorepair) and the cluster logger (repair/quarantine
+        # events); both optional so bare TableStore use keeps defaults
+        self.settings = None
+        self.log = None
         self._dicts: dict[tuple[str, str], Dictionary] = {}
         # in-memory dictionaries for string-function results over
         # dictionary columns (("@expr", sha) refs); deterministic content
@@ -161,14 +179,20 @@ class TableStore:
                 return mirror_root(self.root, content)
         return os.path.join(self.root, "data")
 
+    @staticmethod
+    def rel_content(rel: str) -> int:
+        """Content id encoded in a manifest relpath ('seg<k>/<file>')."""
+        return int(rel.split(os.sep, 1)[0][3:])
+
     def seg_file_path(self, table: str, rel: str) -> str:
         """rel is 'seg<k>/<file>' as stored in the manifest."""
-        content = int(rel.split(os.sep, 1)[0][3:])
-        return os.path.join(self.data_root(content), table, rel)
+        return os.path.join(self.data_root(self.rel_content(rel)), table, rel)
 
     def storage_ok(self, content: int) -> bool:
         """Every manifest-referenced file of this content is present on its
-        acting root (the FTS storage-health probe)."""
+        acting root (the FTS storage-health probe). Quarantine RENAMES bad
+        files out of the tree, so an unrepairable corruption fails this
+        probe and FTS failover takes over."""
         snap = self.manifest.snapshot()
         root = self.data_root(content)
         for tname, tmeta in snap.get("tables", {}).items():
@@ -176,6 +200,180 @@ class TableStore:
                 if not os.path.exists(os.path.join(root, tname, rel)):
                     return False
         return True
+
+    # ---- corruption handling: self-heal, quarantine, checked reads -----
+    # The storage-side twin of gang recovery (docs/ROBUSTNESS.md): committed
+    # block files are immutable and (with mirrors) exist twice, so a read
+    # that trips a frame/footer checksum repairs from the IN-SYNC standby
+    # tree and retries ONCE; a file with no healthy copy is renamed into
+    # <root>/.quarantine/ with a JSON sidecar, which fails storage_ok and
+    # hands the content to FTS failover. Reference: AO block checksums +
+    # gprecoverseg full recovery (cdbappendonlystorageformat.c).
+
+    def _log_event(self, severity: str, message: str) -> None:
+        log = getattr(self, "log", None)
+        if log is not None:
+            try:
+                log.log(severity, "storage", message)
+            except Exception:
+                pass   # observability must never fail the read
+
+    def standby_root(self, content: int) -> str | None:
+        """The tree holding the OTHER copy of this content's files (mirror
+        tree while the preferred primary acts; data tree after failover).
+        None when the content has no mirror pair."""
+        segs = getattr(self.catalog, "segments", None)
+        if segs is None:
+            return None
+        from greengage_tpu.catalog.segments import SegmentRole
+
+        try:
+            segs.entry(content, SegmentRole.MIRROR)
+        except KeyError:
+            return None
+        data = os.path.join(self.root, "data")
+        if os.path.normpath(self.data_root(content)) == os.path.normpath(data):
+            return mirror_root(self.root, content)
+        return data
+
+    def repair_file(self, table: str, content: int, rel: str,
+                    path: str) -> bool:
+        """Copy ``rel`` from the in-sync standby tree over the bad acting
+        copy (fsynced), then re-verify EVERY frame of the repaired file.
+        False when no trustworthy standby copy exists (no mirror, stale
+        sync marker, or the file is absent there); raises CorruptionError
+        when the standby copy is itself corrupt."""
+        from greengage_tpu.runtime.replication import copy_durable, tree_version
+
+        standby = self.standby_root(content)
+        if standby is None:
+            return False
+        if tree_version(standby, content) != self.manifest.snapshot().get(
+                "version", 0):
+            return False   # stale standby: copying could resurrect old data
+        src = os.path.join(standby, table, rel)
+        if not os.path.exists(src):
+            return False
+        faults.check("repair_copy", segment=content)
+        # inject=False: repair judges the REAL bytes of both copies — an
+        # armed read-time fault must not condemn healthy files
+        verify_column_file(src, inject=False)   # corrupt standby raises
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # tmp is repairer-unique: concurrent readers racing the same bad
+        # file must not interleave writes into one tmp (each atomic
+        # replace then publishes a complete, re-verified copy)
+        copy_durable(src, path, tmp=f"{path}.repair.{uuid.uuid4().hex[:8]}")
+        verify_column_file(path, inject=False)  # repaired copy must be clean
+        self._drop_bidx(path)     # sidecar may index the bad bytes
+        return True
+
+    def _drop_bidx(self, path: str) -> None:
+        if path.endswith(".ggb"):
+            try:
+                os.remove(path[: -len(".ggb")] + ".bidx.npz")
+            except OSError:
+                pass
+
+    def quarantine_file(self, path: str, err: CorruptionError) -> str | None:
+        """Rename a bad file into <root>/.quarantine/ with a JSON sidecar
+        recording the cause — preserved for forensics, and its absence
+        fails storage_ok so FTS can fail the segment over."""
+        import datetime
+
+        qdir = os.path.join(self.root, ".quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        qname = f"{uuid.uuid4().hex[:8]}.{os.path.basename(path)}"
+        qpath: str | None = os.path.join(qdir, qname)
+        try:
+            os.replace(path, qpath)
+        except OSError:
+            try:
+                shutil.move(path, qpath)   # mirror roots may be other disks
+            except OSError:
+                qpath = None   # cannot move (already gone?): sidecar only
+        self._drop_bidx(path)
+        sidecar = dict(err.to_dict(),
+                       quarantined_from=path, quarantined_to=qpath,
+                       time=datetime.datetime.now(datetime.timezone.utc)
+                       .isoformat(timespec="seconds"))
+        try:
+            with open(os.path.join(qdir, qname + ".json"), "w") as f:
+                json.dump(sidecar, f, indent=1)
+        except OSError:
+            pass
+        counters.inc("storage_quarantine")
+        self._log_event("ERROR",
+                        f"quarantined {path} -> {qpath}: {err.cause} "
+                        f"({err.message})")
+        return qpath
+
+    def handle_corruption(self, table: str, content: int, rel: str,
+                          path: str, err: CorruptionError) -> None:
+        """Decide repair vs quarantine for one located corruption. Returns
+        after a verified repair; otherwise quarantines the acting file
+        (and a corrupt standby copy, so nothing ever trusts it) and
+        re-raises the typed error."""
+        settings = getattr(self, "settings", None)
+        autorepair = settings is None or getattr(settings,
+                                                 "storage_autorepair", True)
+        if autorepair:
+            try:
+                if self.repair_file(table, content, rel, path):
+                    counters.inc("storage_repair")
+                    self._log_event(
+                        "WARNING",
+                        f"repaired {table}/{rel} (content {content}) from "
+                        f"standby tree after {err.cause}")
+                    return
+            except FaultError:
+                pass   # injected repair_copy failure: fall through
+            except CorruptionError as e2:   # before OSError: its subclass
+                # both copies corrupt: quarantine the standby copy too so
+                # rebuild/promotion never trusts it (unless the failure
+                # was the post-repair re-verify of the ACTING file, which
+                # the fall-through below already quarantines once)
+                spath = getattr(e2, "path", None)
+                if spath and spath != path and os.path.exists(spath):
+                    self.quarantine_file(
+                        spath, e2.locate(table=table, content=content,
+                                         relpath=rel))
+            except OSError:
+                # EIO/ENOSPC mid-copy or mid-verify: a failed repair, not
+                # a new error class — the detected-bad file must still
+                # quarantine (and fail storage_ok) below
+                pass
+        if err.cause != "missing":
+            self.quarantine_file(path, err)
+        raise err
+
+    def _read_checked(self, table: str, rel: str, reader):
+        """Run ``reader(path)`` with read-path self-heal: corruption (or a
+        vanished manifest-referenced file) repairs from the standby tree
+        and retries ONCE; unrepairable damage quarantines and raises."""
+        content = self.rel_content(rel)
+        path = self.seg_file_path(table, rel)
+        try:
+            return reader(path, content)
+        except FileNotFoundError:
+            err = CorruptionError(
+                "missing", "manifest-referenced file is missing", path=path)
+        except CorruptionError as e:
+            err = e
+        err.locate(table=table, content=content, relpath=rel)
+        self.handle_corruption(table, content, rel, path, err)
+        return reader(path, content)
+
+    def read_file(self, table: str, rel: str,
+                  block_indices: list[int] | None = None) -> np.ndarray:
+        """Checked read of one manifest-referenced block file."""
+        return self._read_checked(
+            table, rel,
+            lambda p, c: read_column_file(p, block_indices, segment=c))
+
+    def read_footer_checked(self, table: str, rel: str) -> dict:
+        from greengage_tpu.storage.blockfile import read_footer
+
+        return self._read_checked(table, rel, lambda p, c: read_footer(p))
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
@@ -490,7 +688,7 @@ class TableStore:
     # ---- read path -----------------------------------------------------
     last_prune: tuple | None = None   # (blocks kept, blocks total) of last read
 
-    def block_index(self, base: str, rel: str):
+    def block_index(self, base: str, rel: str, table: str | None = None):
         """Per-segfile block-value index (the btree/bitmap AM analog for
         append-only block storage): sorted (value, block) pairs, deduped
         per block, as a rebuildable .bidx.npz sidecar next to the data
@@ -500,7 +698,8 @@ class TableStore:
         everything. Low-NDV columns degenerate to few (value, block)
         runs — the bitmap-index shape; high-NDV to a dense sorted list —
         the btree shape. Sidecars are derived data: built lazily, not in
-        the manifest, reaped with their data file."""
+        the manifest, reaped with their data file. ``table`` (the storage
+        table owning ``rel``) enables checked self-healing reads."""
         from greengage_tpu.storage.blockfile import (read_column_file,
                                                      read_footer)
 
@@ -512,8 +711,12 @@ class TableStore:
                     return z["values"], z["blocks"]
         except (OSError, ValueError, KeyError):
             pass
-        footer = read_footer(path)
-        data = read_column_file(path)
+        if table is not None:
+            footer = self.read_footer_checked(table, rel)
+            data = self.read_file(table, rel)
+        else:
+            footer = read_footer(path)
+            data = read_column_file(path)
         vals_parts, blk_parts = [], []
         row = 0
         for i, b in enumerate(footer["blocks"]):
@@ -559,14 +762,12 @@ class TableStore:
             return set(blocks.tolist())
         return set(blocks[lo:hi].tolist())
 
-    def _kept_blocks(self, files, base, prune, indexed_cols=frozenset()):
+    def _kept_blocks(self, table, files, base, prune, indexed_cols=frozenset()):
         """Per data-fileno block keep-list: a block survives only if EVERY
         pushed predicate could match its zone map [zmin, zmax] AND, for
         equality predicates on indexed columns, the block index says the
         key is present. -> ({fileno: [block idx]}, kept, total); filenos
         absent from the dict keep all blocks."""
-        from greengage_tpu.storage.blockfile import read_footer
-
         keep: dict[str, list[int]] = {}
         kept = total = 0
         by_fileno_nblocks: dict[str, int] = {}
@@ -582,11 +783,11 @@ class TableStore:
             preds = by_col.get(col)
             if not preds:
                 continue
-            blocks = read_footer(os.path.join(base, rel))["blocks"]
+            blocks = self.read_footer_checked(table, rel)["blocks"]
             by_fileno_nblocks[fileno] = len(blocks)
             idx_keep: set | None = None
             if col in indexed_cols and preds:
-                vals, blks = self.block_index(base, rel)
+                vals, blks = self.block_index(base, rel, table=table)
                 for op, v in preds:
                     hit = self._index_blocks_for(vals, blks, op, v)
                     idx_keep = hit if idx_keep is None else idx_keep & hit
@@ -646,8 +847,8 @@ class TableStore:
         if prune and keep_rows is None:
             idx_cols = frozenset(
                 d["column"] for d in getattr(schema, "indexes", {}).values())
-            keep, kept_n, total_n = self._kept_blocks(files, base, prune,
-                                                      idx_cols)
+            keep, kept_n, total_n = self._kept_blocks(table, files, base,
+                                                      prune, idx_cols)
             self.last_prune = (kept_n, total_n)
         for name in want:
             if name.startswith("@rc:"):
@@ -713,7 +914,7 @@ class TableStore:
                         parts = fn.split(".")
                         fileno = parts[1] if len(parts) >= 3 else None
                         bidx = keep.get(fileno)
-                    arr = read_column_file(os.path.join(base, rel), bidx)
+                    arr = self.read_file(table, rel, bidx)
                     if fn.endswith(".valid.ggb"):
                         valid_parts.append((rel, arr))
                     else:
@@ -758,30 +959,29 @@ class TableStore:
             return self._raw_cache[key]
         tmeta = snap["tables"].get(table, {"segfiles": {}})
         files = tmeta["segfiles"].get(str(seg), [])
-        base = os.path.join(self.data_root(seg), table)
-        blob_paths, offs_parts, valid_parts = [], [], []
+        blob_rels, offs_parts, valid_parts = [], [], []
         bytes_base = 0
         valid_for = {}
         for rel in files:
             fn = os.path.basename(rel)
             if fn.startswith(col + ".") and fn.endswith(".valid.ggb"):
-                valid_for[fn.replace(".valid.ggb", "")] = read_column_file(
-                    os.path.join(base, rel))
+                valid_for[fn.replace(".valid.ggb", "")] = self.read_file(
+                    table, rel)
         for rel in files:
             fn = os.path.basename(rel)
             if fn.startswith(col + ".") and fn.endswith(".rawoffs.ggb"):
-                offs = read_column_file(os.path.join(base, rel)).astype(np.int64)
+                offs = self.read_file(table, rel).astype(np.int64)
                 n = len(offs) - 1
                 offs_parts.append(offs[1:] + bytes_base)   # per-row END offsets
-                blob_paths.append(os.path.join(
-                    base, rel.replace(".rawoffs.ggb", ".rawbytes.ggb")))
+                blob_rels.append(rel.replace(".rawoffs.ggb", ".rawbytes.ggb"))
                 v = valid_for.get(fn.replace(".rawoffs.ggb", ""))
                 valid_parts.append(np.asarray(v, bool) if v is not None
                                    else np.ones(n, dtype=bool))
                 bytes_base += int(offs[-1])
         ends = np.concatenate(offs_parts) if offs_parts else np.zeros(0, np.int64)
         valid = np.concatenate(valid_parts) if valid_parts else np.zeros(0, bool)
-        chunk = _RawChunk(ends, None if valid.all() else valid, blob_paths)
+        chunk = _RawChunk(ends, None if valid.all() else valid, blob_rels,
+                          reader=lambda rel: self.read_file(table, rel))
         self._raw_cache[key] = chunk
         if len(self._raw_cache) > 64:
             self._raw_cache.pop(next(iter(self._raw_cache)))
@@ -843,9 +1043,7 @@ class TableStore:
         chunk = self.raw_chunk(table, seg, col, snap)
         ends = chunk.ends
         n = len(ends)
-        blobs = [read_column_file(p).astype(np.uint8)
-                 for p in chunk._blob_paths]
-        blob = (np.concatenate(blobs) if blobs else np.zeros(0, np.uint8))
+        blob = chunk.blob()
         starts = (np.concatenate([np.zeros(1, np.int64), ends[:-1]])
                   if n else np.zeros(0, np.int64))
         lengths = (ends - starts).astype(np.int32)
@@ -1145,7 +1343,15 @@ class TableStore:
                     referenced.add((tname, os.path.basename(rel)))
         removed = 0
         now = _time.time()
-        for root in {os.path.join(self.root, "data")}:
+        # sweep the mirror trees too: replication/repair stage (.tmp /
+        # .repair.) there, and GC'd files' mirror copies are just as
+        # unreachable as the acting copies
+        roots = {os.path.join(self.root, "data")}
+        segs = getattr(self.catalog, "segments", None)
+        if segs is not None:
+            for c in range(segs.numsegments):
+                roots.add(mirror_root(self.root, c))
+        for root in sorted(roots):
             if not os.path.isdir(root):
                 continue
             for tname in os.listdir(root):
@@ -1158,8 +1364,11 @@ class TableStore:
                         continue
                     for fn in os.listdir(sdir):
                         if not fn.endswith(".ggb"):
-                            continue
-                        if (tname, fn) in referenced:
+                            if ".repair." not in fn and not \
+                                    fn.endswith(".tmp"):
+                                continue
+                            # crashed repair/copy staging: age out below
+                        elif (tname, fn) in referenced:
                             continue
                         p = os.path.join(sdir, fn)
                         try:
@@ -1203,7 +1412,7 @@ class TableStore:
         rel = tmeta.get("delmask", {}).get(str(seg))
         keep = None
         if rel is not None:
-            deleted = read_column_file(self.seg_file_path(table, rel))
+            deleted = self.read_file(table, rel)
             nrows = tmeta.get("nrows", {}).get(str(seg), 0)
             keep = np.ones(nrows, dtype=bool)
             keep[: len(deleted)] = ~deleted.astype(bool)
@@ -1384,20 +1593,17 @@ class TableStore:
         schema = self.catalog.get(table) if table in self.catalog else None
         names = (schema.storage_tables()
                  if schema is not None and schema.name == table else [table])
-        from greengage_tpu.storage.blockfile import read_footer
-
         lo = hi = None
         for name in names:
             tmeta = snap["tables"].get(name, {"segfiles": {}})
             for seg, files in tmeta["segfiles"].items():
-                base = os.path.join(self.data_root(int(seg)), name)
                 for rel in files:
                     fn = os.path.basename(rel)
                     parts = fn.split(".")
                     if (len(parts) != 3 or not fn.endswith(".ggb")
                             or parts[0] != col):
                         continue
-                    for b in read_footer(os.path.join(base, rel))["blocks"]:
+                    for b in self.read_footer_checked(name, rel)["blocks"]:
                         if not b["nrows"]:
                             continue
                         if "zmin" not in b:

@@ -77,14 +77,20 @@ uint32_t gg_hash_bytes(const uint8_t* data, int64_t len, uint32_t seed) {
 // Block frame codec (cdbappendonlystorageformat.c analog)
 //
 // Frame layout (little endian):
-//   u32 magic 0x47474231 ("GGB1")  u32 nrows  u8 compression  u8 encoding
-//   u16 reserved  u64 raw_len  u64 comp_len  u32 crc32(payload)
+//   u32 magic 0x47474232 ("GGB2")  u32 nrows  u8 compression  u8 encoding
+//   u16 reserved  u64 raw_len  u64 comp_len  u32 crc32(header[0:28] || payload)
 // followed by comp_len payload bytes. compression: 0=none 1=zlib. encoding:
 // 0=plain. (zstd frames are produced on the Python side; the native path
 // covers the zlib fast path for bulk ingest.)
+//
+// The CRC covers the 28 header bytes BEFORE the crc field as well as the
+// payload, so a flipped nrows/raw_len/comp_len/compression byte is caught
+// at decode like payload damage (the reference checksums its AO block
+// headers separately for the same reason). Must stay bit-identical to the
+// numpy fallback in greengage_tpu/storage/native.py.
 // ---------------------------------------------------------------------------
 
-static const uint32_t GG_BLOCK_MAGIC = 0x47474231u;
+static const uint32_t GG_BLOCK_MAGIC = 0x47474232u;
 static const int64_t GG_HDR_LEN = 4 + 4 + 1 + 1 + 2 + 8 + 8 + 4;
 
 int64_t gg_block_header_len(void) { return GG_HDR_LEN; }
@@ -111,7 +117,6 @@ int64_t gg_block_encode(const uint8_t* src, int64_t raw_len, uint32_t nrows,
     memcpy(payload, src, (size_t)raw_len);
     comp_len = raw_len;
   }
-  uint32_t crc = (uint32_t)crc32(0L, payload, (uInt)comp_len);
   uint8_t* p = dst;
   memcpy(p, &GG_BLOCK_MAGIC, 4); p += 4;
   memcpy(p, &nrows, 4); p += 4;
@@ -120,6 +125,8 @@ int64_t gg_block_encode(const uint8_t* src, int64_t raw_len, uint32_t nrows,
   uint16_t rsv = 0; memcpy(p, &rsv, 2); p += 2;
   memcpy(p, &raw_len, 8); p += 8;
   memcpy(p, &comp_len, 8); p += 8;
+  uint32_t crc = (uint32_t)crc32(0L, dst, (uInt)(GG_HDR_LEN - 4));
+  crc = (uint32_t)crc32(crc, payload, (uInt)comp_len);
   memcpy(p, &crc, 4);
   return GG_HDR_LEN + comp_len;
 }
@@ -136,9 +143,11 @@ int64_t gg_block_decode(const uint8_t* src, int64_t srclen, uint8_t* dst,
   int64_t raw_len, comp_len;
   memcpy(&raw_len, src + 12, 8);
   memcpy(&comp_len, src + 20, 8);
+  if (raw_len < 0 || comp_len < 0) return -3;
   if (srclen < GG_HDR_LEN + comp_len || dstcap < raw_len) return -3;
   const uint8_t* payload = src + GG_HDR_LEN;
-  uint32_t crc = (uint32_t)crc32(0L, payload, (uInt)comp_len);
+  uint32_t crc = (uint32_t)crc32(0L, src, (uInt)(GG_HDR_LEN - 4));
+  crc = (uint32_t)crc32(crc, payload, (uInt)comp_len);
   uint32_t want; memcpy(&want, src + 28, 4);
   if (crc != want) return -2;
   if (compression == 1) {
@@ -146,6 +155,7 @@ int64_t gg_block_decode(const uint8_t* src, int64_t srclen, uint8_t* dst,
     if (uncompress(dst, &out_len, payload, (uLong)comp_len) != Z_OK) return -3;
     if ((int64_t)out_len != raw_len) return -3;
   } else {
+    if (raw_len != comp_len) return -3;  // stored-raw frames are 1:1
     memcpy(dst, payload, (size_t)raw_len);
   }
   if (nrows_out) *nrows_out = nrows;

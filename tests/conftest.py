@@ -32,6 +32,12 @@ if _WATCHDOG_S > 0:
     faulthandler.dump_traceback_later(_WATCHDOG_S, repeat=False, exit=False)
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the slow tier holds the long fuzz loops
+    config.addinivalue_line(
+        "markers", "slow: long fuzz/stress variants excluded from tier-1")
+
+
 def pytest_sessionfinish(session, exitstatus):
     # a finished run must not leave the timer armed (it would fire inside
     # whatever process reuses this interpreter, e.g. pytest plugins' atexit)

@@ -1,0 +1,229 @@
+"""Corruption fuzz over the block codec — both paths (native .so and the
+numpy fallback): bit flips and truncation anywhere in a frame or a column
+file must either return the EXACT original bytes or raise the typed
+CorruptionError. Never silently wrong data.
+
+The frame CRC covers the header fields as well as the payload, so this
+holds for every byte of the frame (a flipped nrows/raw_len/codec byte is a
+checksum mismatch, not a misread). Footer damage is covered by the footer
+CRC in the file tail.
+
+Tier-1 runs small deterministic variants; the exhaustive every-bit loops
+are marked slow."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from greengage_tpu.storage import native
+from greengage_tpu.storage.blockfile import (FOOTER_TAIL, read_column_file,
+                                             write_column_file)
+from greengage_tpu.storage.corruption import CorruptionError
+
+
+@pytest.fixture(params=["native", "numpy"])
+def codec(request, monkeypatch):
+    """Run the SAME fuzz under the .so and the numpy fallback."""
+    if request.param == "numpy":
+        monkeypatch.setattr(native, "_lib", False)
+    elif not native.have_native():
+        pytest.skip("native codec unavailable")
+    return request.param
+
+
+def _frame(comp, n=2048, seed=3):
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 7, n, dtype=np.int64).tobytes()   # compressible
+    return raw, native.block_encode(raw, n, comp), n
+
+
+def _assert_exact_or_typed(frame, raw, nrows):
+    try:
+        out, rows, _ = native.block_decode(bytes(frame))
+    except CorruptionError:
+        return False
+    assert out == raw and rows == nrows, "silently wrong data"
+    return True
+
+
+# ---------------------------------------------------------------------------
+# frame level
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("comp", [native.COMP_NONE, native.COMP_ZLIB])
+def test_frame_byte_flips_detected(codec, comp):
+    """Deterministic tier-1 variant: every header byte at every bit, plus
+    every payload byte at one bit — all must raise (CRC covers both)."""
+    raw, frame, n = _frame(comp)
+    for pos in range(native.HDR_LEN):
+        for bit in range(8):
+            bad = bytearray(frame)
+            bad[pos] ^= 1 << bit
+            assert not _assert_exact_or_typed(bad, raw, n), \
+                f"header flip undetected at {pos}.{bit}"
+    for pos in range(native.HDR_LEN, len(frame)):
+        bad = bytearray(frame)
+        bad[pos] ^= 0xFF
+        assert not _assert_exact_or_typed(bad, raw, n), \
+            f"payload flip undetected at {pos}"
+
+
+@pytest.mark.parametrize("comp", [native.COMP_NONE, native.COMP_ZLIB])
+def test_frame_truncation_detected(codec, comp):
+    raw, frame, n = _frame(comp)
+    for k in sorted({0, 1, 4, 31, 32, 33, len(frame) // 2, len(frame) - 1}):
+        with pytest.raises(CorruptionError):
+            native.block_decode(frame[:k])
+
+
+def test_frame_roundtrip_unmodified(codec):
+    for comp in (native.COMP_NONE, native.COMP_ZLIB, native.COMP_ZSTD):
+        raw, frame, n = _frame(comp)
+        out, rows, consumed = native.block_decode(frame)
+        assert out == raw and rows == n and consumed == len(frame)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", [native.COMP_NONE, native.COMP_ZLIB])
+def test_frame_every_bit_flip_slow(codec, comp):
+    """Exhaustive: EVERY bit of the frame, multiple seeds/sizes."""
+    for seed, n in [(0, 512), (1, 4096), (2, 16384)]:
+        raw, frame, nrows = _frame(comp, n=n, seed=seed)
+        for pos in range(len(frame)):
+            for bit in range(8):
+                bad = bytearray(frame)
+                bad[pos] ^= 1 << bit
+                assert not _assert_exact_or_typed(bad, raw, nrows), \
+                    f"flip undetected at seed={seed} {pos}.{bit}"
+
+
+@pytest.mark.slow
+def test_frame_every_truncation_slow(codec):
+    raw, frame, n = _frame(native.COMP_ZLIB)
+    for k in range(len(frame)):
+        with pytest.raises(CorruptionError):
+            native.block_decode(frame[:k])
+
+
+# ---------------------------------------------------------------------------
+# file level (footer + frames; the shape reads actually take)
+# ---------------------------------------------------------------------------
+
+def _file(tmp_path, comp="zlib", n=6000, seed=9):
+    vals = np.random.default_rng(seed).integers(0, 100, n).astype(np.int64)
+    path = str(tmp_path / "fuzz.ggb")
+    write_column_file(path, vals, comp, block_rows=2048)
+    return path, vals
+
+
+def _assert_file_exact_or_typed(path, vals):
+    try:
+        back = read_column_file(path)
+    except CorruptionError:
+        return False
+    assert np.array_equal(back, vals), "silently wrong data"
+    return True
+
+
+def test_file_flip_fuzz_deterministic(tmp_path, codec):
+    """200 deterministic positions across the file + the whole footer
+    tail region: exact data or typed error, never garbage."""
+    path, vals = _file(tmp_path)
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pristine = f.read()
+    rng = np.random.default_rng(7)
+    positions = sorted(set(rng.integers(0, size, 200).tolist())
+                       | set(range(size - FOOTER_TAIL - 64, size)))
+    for pos in positions:
+        bad = bytearray(pristine)
+        bad[pos] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bad)
+        _assert_file_exact_or_typed(path, vals)
+    with open(path, "wb") as f:
+        f.write(pristine)
+    assert np.array_equal(read_column_file(path), vals)
+
+
+def test_file_truncations_classified(tmp_path, codec):
+    path, vals = _file(tmp_path)
+    with open(path, "rb") as f:
+        pristine = f.read()
+    for k in [0, 5, FOOTER_TAIL - 1, len(pristine) // 2, len(pristine) - 1]:
+        with open(path, "wb") as f:
+            f.write(pristine[:k])
+        with pytest.raises(CorruptionError) as ei:
+            read_column_file(path)
+        assert ei.value.cause in ("truncated", "bad_footer", "crc_mismatch")
+        assert path in str(ei.value)
+
+
+@pytest.mark.slow
+def test_file_flip_every_byte_slow(tmp_path, codec):
+    path, vals = _file(tmp_path, n=2000)
+    with open(path, "rb") as f:
+        pristine = f.read()
+    for pos in range(len(pristine)):
+        bad = bytearray(pristine)
+        bad[pos] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bad)
+        _assert_file_exact_or_typed(path, vals)
+
+
+# ---------------------------------------------------------------------------
+# footer classification (satellite: short/truncated/garbage-tail files)
+# ---------------------------------------------------------------------------
+
+def test_footer_short_file_classified(tmp_path):
+    from greengage_tpu.storage.blockfile import read_footer
+
+    p = str(tmp_path / "short.ggb")
+    with open(p, "wb") as f:
+        f.write(b"tiny")
+    with pytest.raises(CorruptionError) as ei:
+        read_footer(p)
+    assert ei.value.cause == "truncated" and p in str(ei.value)
+
+
+def test_footer_garbage_tail_classified(tmp_path):
+    from greengage_tpu.storage.blockfile import read_footer
+
+    path, _vals = _file(tmp_path)
+    with open(path, "ab") as f:
+        f.write(b"\x00" * 64)   # garbage appended past the footer
+    with pytest.raises(CorruptionError) as ei:
+        read_footer(path)
+    assert ei.value.cause == "bad_footer"
+
+
+def test_footer_json_damage_classified(tmp_path):
+    """A flip INSIDE the footer json (still valid length/magic) must trip
+    the footer CRC, not silently change dtype/offsets."""
+    path, _vals = _file(tmp_path)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - FOOTER_TAIL - 10)
+        b = f.read(1)
+        f.seek(size - FOOTER_TAIL - 10)
+        f.write(bytes([b[0] ^ 0x01]))
+    with pytest.raises(CorruptionError) as ei:
+        read_column_file(path)
+    assert ei.value.cause == "bad_footer"
+    assert "checksum" in str(ei.value)
+
+
+def test_footer_crc_matches_spec(tmp_path):
+    """The tail layout is [json][crc32(json) u32][len u64][magic u32]."""
+    path, _vals = _file(tmp_path)
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        f.seek(size - FOOTER_TAIL)
+        tail = f.read(FOOTER_TAIL)
+        flen = int.from_bytes(tail[4:12], "little")
+        f.seek(size - FOOTER_TAIL - flen)
+        fj = f.read(flen)
+    assert int.from_bytes(tail[:4], "little") == (zlib.crc32(fj) & 0xFFFFFFFF)

@@ -27,6 +27,51 @@ def clu(tmp_path, devices8):
 
 
 # ---------------------------------------------------------------------------
+# gg scrub (storage verify + repair; the full behavior matrix lives in
+# test_scrub.py — this keeps the COMMAND itself wired)
+# ---------------------------------------------------------------------------
+
+def test_scrub_smoke_clean_cluster(clu, capsys):
+    import json
+
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table st (a int, b int) distributed by (a)")
+    db.sql("insert into st values " + ",".join(
+        f"({i},{i})" for i in range(32)))
+    db.close()
+    assert run_cli("scrub", "-d", clu, "--json") == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["files_scanned"] > 0
+    assert rep["files_verified"] == rep["files_scanned"]
+    assert rep["files_repaired"] == rep["files_quarantined"] == 0
+    assert rep["bytes_scanned"] > 0
+    # human-readable variant + the scrub event lands in the cluster log
+    assert run_cli("scrub", "-d", clu) == 0
+    assert "verified" in capsys.readouterr().out
+    assert any(e["kind"] == "scrub" for e in read_entries(clu))
+
+
+def test_scrub_smoke_reports_corruption(clu, capsys):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table st (a int) distributed by (a)")
+    db.sql("insert into st values " + ",".join(f"({i})" for i in range(32)))
+    snap = db.store.manifest.snapshot()
+    rel = next(rels[0] for rels in
+               snap["tables"]["st"]["segfiles"].values() if rels)
+    db.close()
+    path = os.path.join(clu, "data", "st", rel)
+    with open(path, "r+b") as f:
+        f.seek(40)
+        b = f.read(1)
+        f.seek(40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    # no mirrors: the bad file quarantines and the command reports failure
+    assert run_cli("scrub", "-d", clu) == 1
+    out = capsys.readouterr().out
+    assert "quarantined 1" in out
+
+
+# ---------------------------------------------------------------------------
 # logging + logfilter
 # ---------------------------------------------------------------------------
 

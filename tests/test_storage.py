@@ -109,6 +109,24 @@ def test_manifest_two_phase(tmp_path):
     assert m.snapshot()["tables"]["t"]["nrows"]["0"] == 10
 
 
+def test_manifest_corruption_is_fatal_and_named(tmp_path):
+    """A corrupt manifest.json must surface as a clear fatal error naming
+    the path (never a bare JSONDecodeError), from snapshot() AND from
+    startup recovery."""
+    from greengage_tpu.storage.manifest import ManifestError
+
+    m = Manifest(str(tmp_path))
+    tx = m.begin()
+    tx["tables"]["t"] = {"segfiles": {}, "nrows": {"0": 1}}
+    m.commit(m.prepare(tx))
+    with open(m.path, "w") as f:
+        f.write('{"version": 1, "tables": {TRUNCATED')
+    with pytest.raises(ManifestError, match="manifest.json"):
+        m.snapshot()
+    with pytest.raises(ManifestError, match="manifest.json"):
+        m.recover()
+
+
 def test_manifest_conflict_and_recover(tmp_path):
     m = Manifest(str(tmp_path))
     tx1, tx2 = m.begin(), m.begin()
