@@ -70,6 +70,17 @@ class Settings:
     # storage
     default_compresstype: str = "zlib"
     default_compresslevel: int = 1
+    # host data path (docs/PERF.md; the bufmgr/smgr pipeline analog):
+    # scan_threads sizes the staging read+decode pool (0 = auto:
+    # min(8, cpu count)); 1 disables concurrency entirely.
+    scan_threads: int = 0
+    # one byte budget for every block cache (decoded blocks, footers, raw
+    # chunks, host predicates, deletion masks, staged device inputs) —
+    # the shared_buffers analog, LRU-evicted across all of them
+    scan_cache_limit_mb: int = 1024
+    # spill passes warm the next pass's cold block reads on a background
+    # thread while the current pass's jitted program runs
+    spill_prefetch: bool = True
     # read-path self-heal (docs/ROBUSTNESS.md storage failure model): a
     # corrupt/missing block file is repaired from the IN-SYNC standby tree
     # and the read retried once; off = detect-and-quarantine only (the

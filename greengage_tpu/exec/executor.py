@@ -23,10 +23,18 @@ import numpy as np
 import jax
 
 from greengage_tpu import types as T
+from greengage_tpu.exec import staging
 from greengage_tpu.exec.compile import VALID_PREFIX, Compiler, CompileResult
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
+from greengage_tpu.runtime.logger import counters
 from greengage_tpu.runtime.runaway import TRACKER
+
+# per-statement I/O accounting reported in Result.stats["scan_io"] and the
+# EXPLAIN ANALYZE host-data-path lines (counter deltas, never wall clocks,
+# so tests can assert them deterministically)
+SCAN_COUNTERS = ("scan_files_read", "scan_bytes_decoded", "scan_cache_hit",
+                 "scan_cache_miss", "scan_cache_evict")
 
 
 class QueryError(RuntimeError):
@@ -151,7 +159,10 @@ class Executor:
         self.nseg = nseg
         self.settings = settings
         self.multihost = multihost    # parallel.multihost.MultihostRuntime
-        self._stage_cache: dict = {}
+        # staged device inputs live in the store's byte-accounted LRU
+        # registry (storage/blockcache.py): bounded within a manifest
+        # version, evicted by recency against scan_cache_limit_mb
+        self._stage_cache = store.blockcache.cache("stage")
         # (cache_key, version, tier, fused_disabled) -> CompileResult
         self._plan_cache: dict = {}
         # statements whose fused pallas kernel failed to lower on this
@@ -305,9 +316,21 @@ class Executor:
                                 "vmem_global_limit_mb", 0)) << 20,
                     float(getattr(self.settings, "runaway_red_zone", 0.9)))
                 TRACKER.check()
+            # host-data-path breakdown (EXPLAIN ANALYZE + bench microbench):
+            # staging wall vs device compute vs result fetch, plus the scan
+            # I/O counter deltas this statement caused
+            io0 = {k: counters.get(k) for k in SCAN_COUNTERS}
+            t_stage = time.monotonic()
             inputs = self._stage(comp, snapshot)
+            t_compute = time.monotonic()
+            stage_ms = (t_compute - t_stage) * 1e3
+            scan_io = {k: counters.get(k) - io0[k] for k in SCAN_COUNTERS}
             try:
                 flat = comp.device_fn(*inputs)
+                # resolve async dispatch here so compute_ms is the device
+                # program (and a deferred pallas failure still lands in
+                # the retry logic below, not in device_get)
+                jax.block_until_ready(flat)
             except Exception as e:
                 # a pallas lowering/compile failure on this backend must
                 # not fail the query: retry the SAME tier on the pure-XLA
@@ -327,9 +350,12 @@ class Executor:
                 if ck is not None:
                     self._plan_cache.pop(ck, None)
                 continue
+            t_fetch = time.monotonic()
+            compute_ms = (t_fetch - t_compute) * 1e3
             # ONE device->host fetch for every output (per-transfer latency
             # through tunneled/remote device paths dwarfs per-byte cost)
             flat = jax.device_get(list(flat))
+            fetch_ms = (time.monotonic() - t_fetch) * 1e3
             ncols = len(comp.out_cols)
             nflags = len(comp.flag_names)
             flags = dict(zip(comp.flag_names,
@@ -369,6 +395,11 @@ class Executor:
                 res.stats = {
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
+                    # host-data-path breakdown of the SUCCESSFUL attempt
+                    "stage_ms": round(stage_ms, 2),
+                    "compute_ms": round(compute_ms, 2),
+                    "fetch_ms": round(fetch_ms, 2),
+                    "scan_io": scan_io,
                     # True when the program embeds the fused pallas kernel
                     # (bench reports this: a silent XLA fallback must not
                     # masquerade as a pallas measurement)
@@ -451,22 +482,39 @@ class Executor:
         return set(s for s in self.multihost.local_segments if s < self.nseg)
 
     def _stage(self, comp: CompileResult, snapshot) -> list:
+        """Pipelined input staging (exec/staging.py, docs/PERF.md): submit
+        every (table, segment) read+decode unit of the WHOLE input spec to
+        the staging pool first, then assemble tables in spec order into
+        preallocated [nseg*cap] buffers and issue each table's device
+        transfer as soon as its buffers fill — later tables' disk reads
+        overlap earlier tables' assembly and host->device transfer, and
+        (with JAX async dispatch) the device program itself."""
         arrays = []
         shard = seg_sharding(self.mesh)
         local_segs = self._local_segments()
-        # evict staged arrays from older manifest versions (any write bumps
-        # the version, so stale device copies are unreachable and only waste
-        # HBM — the dispatcher's CdbComponentDatabases invalidation analog)
+        # evict staged arrays + store cache entries from older manifest
+        # versions (any write bumps the version, so stale device copies are
+        # unreachable and only waste HBM — the dispatcher's
+        # CdbComponentDatabases invalidation analog)
         version = snapshot.get("version", 0)
-        for k in [k for k in self._stage_cache if k[3] != version]:
-            del self._stage_cache[k]
+        self.store.blockcache.invalidate_versions(version)
         self._last_prune_stats = {}
         self._last_dyn_stats = {}
         aux = getattr(self, "_aux_tables", {})
         ranges = getattr(self, "_row_ranges", {})
+        rpool = staging.pool(self.settings)
+
+        # plan phase: resolve per-table staging decisions. Read units are
+        # submitted through a bounded LOOKAHEAD window (the table being
+        # assembled plus one ahead): later tables' reads overlap earlier
+        # tables' assembly and transfer WITHOUT holding every table's
+        # decoded columns in flight at once — peak host memory stays at
+        # ~two tables, like the old serial loop's one.
+        plans = []   # [kind, table, cols, cap, key, prune, payload]
+        staged_local: dict = {}   # key -> (staged, pstats) THIS statement
         for table, cols, cap, direct, prune, child_parts, dyn in comp.input_spec:
             if table in aux:
-                arrays.extend(self._stage_aux(table, cols, cap, aux[table], shard))
+                plans.append(("aux", table, cols, cap, None, None, None))
                 continue
             if child_parts is not None and dyn is not None:
                 # join-driven runtime partition elimination: evaluate the
@@ -478,78 +526,200 @@ class Executor:
                     table, child_parts, dyn, snapshot)
             key = (table, tuple(cols), cap, version, direct, prune,
                    child_parts, ranges.get(table))
-            if table not in ranges and key in self._stage_cache:
-                staged, pstats = self._stage_cache[key]
+            if table not in ranges:
+                hit = self._stage_cache.get(key, staging.MISS)
+                if hit is not staging.MISS:
+                    plans.append(("hit", table, cols, cap, key, prune, hit))
+                    continue
+            if key in staged_local:
+                # same scan twice in ONE input spec (self-join): reuse the
+                # first occurrence's staged arrays instead of reading and
+                # transferring the identical inputs again
+                plans.append(("dup", table, cols, cap, key, prune, None))
+                continue
+            staged_local[key] = None   # first occurrence claims the key
+            plans.append(("read", table, cols, cap, key, prune, {
+                "storage_cols": [c for c in cols
+                                 if not c.startswith(VALID_PREFIX)],
+                "child_parts": child_parts, "direct": direct,
+                "rng": ranges.get(table), "futs": None, "buffers": None}))
+
+        read_plans = [p for p in plans if p[0] == "read"]
+
+        def _submit(p):
+            _, table, cols, cap, _key, prune, st = p
+            if st["futs"] is not None:
+                return
+            # preallocate the [nseg*cap] staging buffers so eligible
+            # columns decode straight into their slots inside the pool
+            # (read_segment's in-place fast path); ranged/partitioned
+            # scans slice after the read and keep the copy path, and so
+            # do scans that fill only SOME segments (direct dispatch,
+            # multihost remotes) — a cached view of a partially-used
+            # buffer would pin far more memory than its byte accounting
+            buffers = None
+            if st["rng"] is None and st["child_parts"] is None \
+                    and st["direct"] is None \
+                    and len(local_segs) == self.nseg:
+                schema = self.catalog.get(table)
+                buffers = {c: np.empty(self.nseg * cap,
+                                       self._stage_dtype(schema, c))
+                           for c in st["storage_cols"]}
+            futs = []
+            for seg in range(self.nseg):
+                if seg not in local_segs or (st["direct"] is not None
+                                            and seg != st["direct"]):
+                    # direct dispatch: only the owning segment's storage
+                    # is read/staged (cdbtargeteddispatch.c analog)
+                    futs.append(None)
+                else:
+                    dest = ({c: buf[seg * cap: (seg + 1) * cap]
+                             for c, buf in buffers.items()}
+                            if buffers is not None else None)
+                    futs.append(rpool.submit(
+                        self._read_unit, table, st["child_parts"], seg,
+                        st["storage_cols"], snapshot, prune, st["rng"],
+                        dest))
+            st["buffers"] = buffers
+            st["futs"] = futs
+
+        # assemble phase (spec order, deterministic): fill staging buffers
+        # in place and put each table on the mesh as soon as it completes
+        done_reads = 0
+        for kind, table, cols, cap, key, prune, payload in plans:
+            if kind == "aux":
+                arrays.extend(
+                    self._stage_aux(table, cols, cap, aux[table], shard))
+                continue
+            if kind == "hit":
+                staged, pstats = payload
                 arrays.extend(staged)
                 if pstats is not None:
                     self._last_prune_stats[table] = pstats
                 continue
-            storage_cols = [c for c in cols if not c.startswith(VALID_PREFIX)]
+            if kind == "dup":
+                # eviction-immune within the statement: the first
+                # occurrence stored its result here whatever the cache
+                # budget did since
+                staged, pstats = staged_local[key]
+                arrays.extend(staged)
+                if pstats is not None:
+                    self._last_prune_stats[table] = pstats
+                continue
+            for j in range(done_reads, min(done_reads + 2,
+                                           len(read_plans))):
+                _submit(read_plans[j])   # this table + one of lookahead
+            st = payload
+            storage_cols, futs, buffers = \
+                st["storage_cols"], st["futs"], st["buffers"]
             per_seg = []
             kept = total_blocks = 0
-            for seg in range(self.nseg):
-                if seg not in local_segs or (direct is not None and seg != direct):
-                    # direct dispatch: only the owning segment's storage is
-                    # read/staged (cdbtargeteddispatch.c analog)
+            for fut in futs:
+                if fut is None:
                     per_seg.append(({c: np.empty(0, dtype=np.int64)
                                      for c in storage_cols}, {}, 0))
                     continue
-                c, v, n = self._read_segment_parts(
-                    table, child_parts, seg, storage_cols, snapshot, prune)
-                if table in ranges:
-                    a, b = ranges[table]
-                    c = {k: arr[a:b] for k, arr in c.items()}
-                    v = {k: (arr[a:b] if arr is not None else None)
-                         for k, arr in v.items()}
-                    n = max(min(n, b) - a, 0)
+                c, v, n, pstat = fut.result()
                 per_seg.append((c, v, n))
-                st = self.store.last_prune
-                if prune and st is not None:
-                    kept += st[0]
-                    total_blocks += st[1]
+                if pstat is not None:
+                    kept += pstat[0]
+                    total_blocks += pstat[1]
             if prune and total_blocks:
                 self._last_prune_stats[table] = (kept, total_blocks)
-            staged = []
-            schema = self.catalog.get(table)
-            for c in cols:
-                if c.startswith(VALID_PREFIX):
-                    name = c[len(VALID_PREFIX):]
-                    parts = []
-                    for cc, vv, n in per_seg:
-                        val = vv.get(name)
-                        if val is None:
-                            val = np.ones(n, dtype=bool)
-                        parts.append(_pad(val, cap, False))
-                    host = np.concatenate(parts) if parts else np.zeros(0, bool)
-                else:
-                    if c.startswith("@hp:"):
-                        dt = np.dtype(bool)   # host-evaluated predicate col
-                    elif c.startswith("@rc:"):
-                        dt = np.dtype(np.int32)   # transient raw-dict codes
-                    elif c.startswith(("@rp:", "@rw:")):
-                        dt = np.dtype(np.int64)   # packed raw prefix word
-                    elif c.startswith("@rl:"):
-                        dt = np.dtype(np.int32)   # raw byte length
-                    else:
-                        col_s = schema.column(c)
-                        # raw TEXT stages int64 row surrogates, not the
-                        # int32 dict-code dtype (segment bits live above 40)
-                        dt = (np.dtype(np.int64)
-                              if col_s.type.kind == T.Kind.TEXT
-                              and col_s.encoding == "raw"
-                              else col_s.type.np_dtype)
-                    parts = [_pad(cc.get(c, np.zeros(0, dt)).astype(dt, copy=False), cap)
-                             for cc, _, _ in per_seg]
-                    host = np.concatenate(parts)
-                staged.append(self._put(host, shard, cap))
-            present = np.concatenate(
-                [_pad(np.ones(n, dtype=bool), cap, False) for _, _, n in per_seg])
-            staged.append(self._put(present, shard, cap))
-            if table not in ranges:
-                self._stage_cache[key] = (
-                    staged, self._last_prune_stats.get(table))
+            staged = self._assemble(table, cols, cap, per_seg, shard,
+                                    buffers)
+            staged_local[key] = (staged, self._last_prune_stats.get(table))
+            if st["rng"] is None:
+                self._stage_cache.put(
+                    key, (staged, self._last_prune_stats.get(table)),
+                    nbytes=sum(int(getattr(a, "nbytes", 64)) for a in staged),
+                    version=version)
             arrays.extend(staged)
+            done_reads += 1
         return arrays
+
+    def _read_unit(self, table, child_parts, seg, storage_cols, snapshot,
+                   prune, rng, dest=None):
+        """One pooled staging unit: one segment's decoded columns (+ this
+        thread's zone-prune stats). Runs concurrently with other units —
+        the store's caches and read-path self-heal are thread-safe.
+        ``dest`` carries this segment's staging-buffer slots for the
+        in-place decode fast path."""
+        c, v, n = self._read_segment_parts(
+            table, child_parts, seg, storage_cols, snapshot, prune,
+            dest=dest)
+        if rng is not None:
+            a, b = rng
+            c = {k: arr[a:b] for k, arr in c.items()}
+            v = {k: (arr[a:b] if arr is not None else None)
+                 for k, arr in v.items()}
+            n = max(min(n, b) - a, 0)
+        return c, v, n, (self.store.last_prune if prune else None)
+
+    @staticmethod
+    def _stage_dtype(schema, c) -> np.dtype:
+        """The dtype a column STAGES as (may differ from storage)."""
+        if c.startswith("@hp:"):
+            return np.dtype(bool)         # host-evaluated predicate col
+        if c.startswith("@rc:"):
+            return np.dtype(np.int32)     # transient raw-dict codes
+        if c.startswith(("@rp:", "@rw:")):
+            return np.dtype(np.int64)     # packed raw prefix word
+        if c.startswith("@rl:"):
+            return np.dtype(np.int32)     # raw byte length
+        col_s = schema.column(c)
+        # raw TEXT stages int64 row surrogates, not the int32 dict-code
+        # dtype (segment bits live above 40)
+        return (np.dtype(np.int64)
+                if col_s.type.kind == T.Kind.TEXT
+                and col_s.encoding == "raw"
+                else col_s.type.np_dtype)
+
+    def _assemble(self, table, cols, cap, per_seg, shard,
+                  buffers=None) -> list:
+        """Fill one preallocated [nseg*cap] staging buffer per column IN
+        PLACE from the per-segment decoded arrays (no pad-then-concatenate
+        copy pair) and place each on the mesh. Columns whose segments
+        already decoded into their buffer slots (read_segment's dest fast
+        path) skip even that one copy — only their padding tails are
+        written."""
+        schema = self.catalog.get(table)
+        staged = []
+        nseg = self.nseg
+        booldt = np.dtype(bool)
+        for c in cols:
+            if c.startswith(VALID_PREFIX):
+                name = c[len(VALID_PREFIX):]
+                host = staging.fill_buffer(
+                    nseg, cap, booldt,
+                    ((s, vv[name] if vv.get(name) is not None
+                      else np.ones(n, dtype=bool))
+                     for s, (_, vv, n) in enumerate(per_seg)), False)
+            else:
+                dt = self._stage_dtype(schema, c)
+                buf = buffers.get(c) if buffers is not None else None
+                if buf is None:
+                    host = staging.fill_buffer(
+                        nseg, cap, dt,
+                        ((s, cc.get(c, np.zeros(0, dt))
+                          .astype(dt, copy=False))
+                         for s, (cc, _, _) in enumerate(per_seg)), 0)
+                else:
+                    for s, (cc, _, _) in enumerate(per_seg):
+                        arr = cc.get(c)
+                        n = 0 if arr is None else len(arr)
+                        if n and getattr(arr, "base", None) is not buf:
+                            buf[s * cap: s * cap + n] = arr
+                        if n < cap:
+                            buf[s * cap + n: (s + 1) * cap] = 0
+                    host = buf
+            staged.append(self._put(host, shard, cap))
+        present = staging.fill_buffer(
+            nseg, cap, booldt,
+            ((s, np.ones(n, dtype=bool))
+             for s, (_, _, n) in enumerate(per_seg)), False)
+        staged.append(self._put(present, shard, cap))
+        return staged
 
     def _dyn_pruned_parts(self, table, child_parts, dyn, snapshot) -> tuple:
         """-> child partitions surviving the build-side key-value probe
@@ -605,13 +775,13 @@ class Executor:
         return kept
 
     def _read_segment_parts(self, table, child_parts, seg, storage_cols,
-                            snapshot, prune):
+                            snapshot, prune, dest=None):
         """Read one segment's rows — for a partitioned scan, the (pruned)
         child tables' rows concatenated in partition order. Zone-map
         pruning applies per child; block stats sum across children."""
         if child_parts is None:
             return self.store.read_segment(table, seg, storage_cols,
-                                           snapshot, prune=prune)
+                                           snapshot, prune=prune, dest=dest)
         per = []
         kept = total = 0
         any_prune = False

@@ -111,8 +111,10 @@ class Database:
         # always (re)install — an uncalibrated cluster opened after a
         # calibrated one in the same process must get the defaults back
         _cost.set_calibration(cal)
-        # the store's read-path self-heal honors storage_autorepair live
+        # the store's read-path self-heal honors storage_autorepair live,
+        # and the block-cache registry reads scan_cache_limit_mb live
         self.store.settings = self.settings
+        self.store.blockcache.settings = self.settings
         self._select_cache: dict = {}
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
@@ -1405,6 +1407,20 @@ class Database:
                 f"{s.get('tiers_used')}, result capacity/segment: "
                 f"{s.get('below_gather_capacity')}"
                 f"\n Tables scanned: {', '.join(s.get('scan_tables', []))}")
+            if s.get("stage_ms") is not None:
+                # host-data-path breakdown (docs/PERF.md): where the wall
+                # time went — host staging vs device program vs fetch
+                text += (f"\n Host data path: staging {s['stage_ms']:.2f} ms"
+                         f", device compute {s['compute_ms']:.2f} ms, "
+                         f"result fetch {s['fetch_ms']:.2f} ms")
+            io = s.get("scan_io") or {}
+            if io:
+                text += (f"\n Scan I/O: {io.get('scan_files_read', 0)} files"
+                         f" read, {io.get('scan_bytes_decoded', 0)} bytes "
+                         f"decoded, block cache "
+                         f"{io.get('scan_cache_hit', 0)} hit / "
+                         f"{io.get('scan_cache_miss', 0)} miss / "
+                         f"{io.get('scan_cache_evict', 0)} evicted")
             if s.get("fused_kernel"):
                 text += "\n Fused dense-agg pallas kernel: yes"
             for t, (kept, total) in (s.get("zone_prune") or {}).items():

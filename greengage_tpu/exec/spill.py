@@ -268,18 +268,33 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
         raise NotSpillable(
             f"spill would need {npasses} passes (> {MAX_PASSES})")
 
-    # run the passes, collecting partial rows on the host (the workfile)
+    # run the passes, collecting partial rows on the host (the workfile).
+    # While pass k's jitted program runs, a background thread warms pass
+    # k+1's cold block reads into the block cache (exec/staging.py; all
+    # passes share the same committed files, so after the budget-resident
+    # first pass this is a cheap cache probe)
     import itertools
+
+    from greengage_tpu.exec import staging as _staging
 
     grids = [[(t, (i * c, (i + 1) * c)) for i in range(n)]
              for t, c, n in per_table]
     caps = {t: c for t, c, _ in per_table}
     partial_cols = state_cols
-    pass_results = [executor.run_single(
-        pass_plan, consts, partial_cols, raw=True,
-        scan_cap_override=caps,
-        row_ranges=dict(combo), no_direct=True)
-        for combo in itertools.product(*grids)]
+    combos = list(itertools.product(*grids))
+    prefetcher = _staging.PassPrefetcher(
+        executor, comp.input_spec, store.manifest.snapshot())
+    pass_results = []
+    try:
+        for i, combo in enumerate(combos):
+            if i + 1 < len(combos):
+                prefetcher.kick()
+            pass_results.append(executor.run_single(
+                pass_plan, consts, partial_cols, raw=True,
+                scan_cap_override=caps,
+                row_ranges=dict(combo), no_direct=True))
+    finally:
+        prefetcher.close()
     aux_cols, aux_valids = _collect_passes(partial_cols, pass_results)
 
     # merge program: the original plan with the replace target swapped for
@@ -547,14 +562,25 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
     if npasses > 256:
         raise NotSpillable(f"sort spill would need {npasses} passes (> 256)")
 
+    from greengage_tpu.exec import staging as _staging
+
+    prefetcher = _staging.PassPrefetcher(
+        executor, comp.input_spec, store.manifest.snapshot())
     runs = []
-    for p in range(npasses):
-        res = executor.run_single(
-            pass_plan, consts, out_cols, raw=raw,
-            scan_cap_override={cand: chunk},
-            row_ranges={cand: (p * chunk, (p + 1) * chunk)},
-            no_direct=True)
-        runs.append(res)
+    try:
+        for p in range(npasses):
+            if p + 1 < npasses:
+                # warm the next sorted run's cold reads while this pass's
+                # device sort executes (same files, later row range)
+                prefetcher.kick()
+            res = executor.run_single(
+                pass_plan, consts, out_cols, raw=raw,
+                scan_cap_override={cand: chunk},
+                row_ranges={cand: (p * chunk, (p + 1) * chunk)},
+                no_direct=True)
+            runs.append(res)
+    finally:
+        prefetcher.close()
 
     cols, valids = _collect_passes(out_cols, runs)
 

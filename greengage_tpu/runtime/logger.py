@@ -48,6 +48,17 @@ class Counters:
         with self._lock:
             return dict(self._c)
 
+    def since(self, base: dict[str, int],
+              prefix: str | None = None) -> dict[str, int]:
+        """Delta vs an earlier snapshot() — the per-statement accounting
+        the scan I/O counters (scan_files_read / scan_bytes_decoded /
+        scan_cache_*) are read through; deterministic, so tests assert on
+        it instead of wall clocks."""
+        with self._lock:
+            return {k: v - base.get(k, 0) for k, v in self._c.items()
+                    if (prefix is None or k.startswith(prefix))
+                    and v != base.get(k, 0)}
+
     def reset(self) -> None:
         with self._lock:
             self._c.clear()

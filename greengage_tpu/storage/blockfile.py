@@ -110,50 +110,56 @@ def read_footer(path: str) -> dict:
     """Parse + verify the footer. Short/truncated/garbage-tail/damaged
     footers classify as CorruptionError with the path and cause."""
     with open(path, "rb") as f:
-        f.seek(0, os.SEEK_END)
-        size = f.tell()
-        if size < FOOTER_TAIL:
-            raise CorruptionError(
-                "truncated",
-                f"file is {size} bytes, smaller than the {FOOTER_TAIL}-byte "
-                "footer tail", path=path)
-        f.seek(size - FOOTER_TAIL)
-        tail = f.read(FOOTER_TAIL)
-        tail_magic = int.from_bytes(tail[12:16], "little")
-        if tail_magic == 0x47474246:   # "GGBF": the pre-CRC 12-byte tail
-            raise IOError(
-                f"{path}: unsupported block-file format GGBF (written by an "
-                "older, incompatible version) — re-ingest from original "
-                "sources")
-        if tail_magic != FOOTER_MAGIC:
-            raise CorruptionError(
-                "bad_footer", "bad footer magic (garbage tail or not a "
-                "block file)", path=path)
-        flen = int.from_bytes(tail[4:12], "little")
-        if flen > size - FOOTER_TAIL:
-            raise CorruptionError(
-                "truncated",
-                f"footer length {flen} exceeds file size {size}", path=path)
-        f.seek(size - FOOTER_TAIL - flen)
-        fj = f.read(flen)
-        if (zlib.crc32(fj) & 0xFFFFFFFF) != int.from_bytes(tail[:4], "little"):
-            raise CorruptionError(
-                "bad_footer", "footer checksum mismatch", path=path)
-        try:
-            footer = json.loads(fj)
-        except ValueError as e:
-            raise CorruptionError(
-                "bad_footer", f"footer is not valid JSON ({e})", path=path)
-        if not isinstance(footer, dict) or not isinstance(
-                footer.get("blocks"), list) or "dtype" not in footer:
-            raise CorruptionError(
-                "bad_footer", "footer missing dtype/blocks", path=path)
-        try:
-            np.dtype(footer["dtype"])
-        except TypeError as e:
-            raise CorruptionError(
-                "bad_footer", f"footer dtype unparseable ({e})", path=path)
-        return footer
+        return _read_footer_fh(f, path)
+
+
+def _read_footer_fh(f, path: str) -> dict:
+    """read_footer against an already-open handle (single-open read path:
+    the column read parses the footer and decodes frames from ONE open)."""
+    f.seek(0, os.SEEK_END)
+    size = f.tell()
+    if size < FOOTER_TAIL:
+        raise CorruptionError(
+            "truncated",
+            f"file is {size} bytes, smaller than the {FOOTER_TAIL}-byte "
+            "footer tail", path=path)
+    f.seek(size - FOOTER_TAIL)
+    tail = f.read(FOOTER_TAIL)
+    tail_magic = int.from_bytes(tail[12:16], "little")
+    if tail_magic == 0x47474246:   # "GGBF": the pre-CRC 12-byte tail
+        raise IOError(
+            f"{path}: unsupported block-file format GGBF (written by an "
+            "older, incompatible version) — re-ingest from original "
+            "sources")
+    if tail_magic != FOOTER_MAGIC:
+        raise CorruptionError(
+            "bad_footer", "bad footer magic (garbage tail or not a "
+            "block file)", path=path)
+    flen = int.from_bytes(tail[4:12], "little")
+    if flen > size - FOOTER_TAIL:
+        raise CorruptionError(
+            "truncated",
+            f"footer length {flen} exceeds file size {size}", path=path)
+    f.seek(size - FOOTER_TAIL - flen)
+    fj = f.read(flen)
+    if (zlib.crc32(fj) & 0xFFFFFFFF) != int.from_bytes(tail[:4], "little"):
+        raise CorruptionError(
+            "bad_footer", "footer checksum mismatch", path=path)
+    try:
+        footer = json.loads(fj)
+    except ValueError as e:
+        raise CorruptionError(
+            "bad_footer", f"footer is not valid JSON ({e})", path=path)
+    if not isinstance(footer, dict) or not isinstance(
+            footer.get("blocks"), list) or "dtype" not in footer:
+        raise CorruptionError(
+            "bad_footer", "footer missing dtype/blocks", path=path)
+    try:
+        np.dtype(footer["dtype"])
+    except TypeError as e:
+        raise CorruptionError(
+            "bad_footer", f"footer dtype unparseable ({e})", path=path)
+    return footer
 
 
 def _maybe_inject_corruption(frame: bytes, segment: int | None) -> bytes:
@@ -174,39 +180,53 @@ def _maybe_inject_corruption(frame: bytes, segment: int | None) -> bytes:
 
 
 def read_column_file(path: str, block_indices: list[int] | None = None,
-                     segment: int | None = None) -> np.ndarray:
+                     segment: int | None = None,
+                     out: np.ndarray | None = None) -> np.ndarray:
     """Read all (or selected) blocks back into one numpy array. ``segment``
-    only targets the storage_corrupt_block fault point."""
-    footer = read_footer(path)
-    dtype = np.dtype(footer["dtype"])
-    blocks = list(enumerate(footer["blocks"]))
-    if block_indices is not None:
-        blocks = [blocks[i] for i in block_indices]
-    parts = []
+    only targets the storage_corrupt_block fault point.
+
+    Frames decode IN PLACE into one preallocated output array sized from
+    the footer (native.block_decode_into): no per-block bytes objects and
+    no final concatenate — the copy count the pipelined staging path is
+    built around. ``out`` lets the caller provide that destination (e.g.
+    a slot of the executor's [nseg*cap] staging buffer, dtype- and
+    capacity-compatible); the return value is then a view of it."""
     with open(path, "rb") as f:
+        footer = _read_footer_fh(f, path)   # one open serves footer + frames
+        dtype = np.dtype(footer["dtype"])
+        blocks = list(enumerate(footer["blocks"]))
+        if block_indices is not None:
+            blocks = [blocks[i] for i in block_indices]
+        total_rows = sum(b["nrows"] for _, b in blocks)
+        if out is not None and (out.dtype != dtype or len(out) < total_rows
+                                or not out.flags.c_contiguous):
+            out = None   # incompatible destination: decode a fresh array
+        if out is None:
+            out = np.empty(total_rows, dtype=dtype)
+        else:
+            out = out[:total_rows]
+        if not blocks:
+            return out
+        u8 = out.view(np.uint8)
+        itemsize = dtype.itemsize
+        row = 0
         for i, b in blocks:
             f.seek(b["offset"])
             frame = f.read(b["bytes"])
             frame = _maybe_inject_corruption(frame, segment)
+            slot = u8[row * itemsize: (row + b["nrows"]) * itemsize]
             try:
-                raw, nrows, _ = native.block_decode(frame)
+                nbytes, nrows = native.block_decode_into(frame, slot)
             except CorruptionError as e:
                 raise e.locate(path=path, block=i)
-            try:
-                arr = np.frombuffer(raw, dtype=dtype)
-            except ValueError as e:
-                raise CorruptionError(
-                    "decode_failed", f"block payload not {dtype}-shaped ({e})",
-                    path=path, block=i)
-            if len(arr) != nrows or nrows != b["nrows"]:
+            if nrows != b["nrows"] or nbytes != nrows * itemsize:
                 raise CorruptionError(
                     "rowcount_mismatch",
-                    f"block decoded {len(arr)} rows, frame header says "
-                    f"{nrows}, footer says {b['nrows']}", path=path, block=i)
-            parts.append(arr)
-    if not parts:
-        return np.empty(0, dtype=dtype)
-    return np.concatenate(parts)
+                    f"block decoded {nbytes} bytes / {nrows} rows, footer "
+                    f"says {b['nrows']} rows of {itemsize} bytes",
+                    path=path, block=i)
+            row += nrows
+    return out
 
 
 def verify_column_file(path: str, segment: int | None = None,

@@ -184,10 +184,9 @@ def block_encode(raw: bytes | np.ndarray, nrows: int, compression: int = COMP_ZL
     return hdr + crc.to_bytes(4, "little") + payload
 
 
-def block_decode(frame: bytes) -> tuple[bytes, int, int]:
-    """-> (raw bytes, nrows, frame length consumed). Verifies the frame
-    checksum (header + payload); all failures raise the typed
-    CorruptionError so readers can classify repair vs quarantine."""
+def _check_frame_header(frame: bytes) -> tuple[int, int, int, int, int, int]:
+    """Validate a frame's header WITHOUT touching the payload.
+    -> (nrows, comp, raw_len, comp_len, want_crc, total_len)."""
     if len(frame) < HDR_LEN:
         raise CorruptionError(
             "truncated", f"frame is {len(frame)} bytes, header needs {HDR_LEN}")
@@ -221,6 +220,15 @@ def block_decode(frame: bytes) -> tuple[bytes, int, int]:
         raise CorruptionError(
             "decode_failed",
             f"implausible frame lengths (raw {raw_len}, stored {comp_len})")
+    return nrows, comp, raw_len, comp_len, want_crc, total
+
+
+def block_decode(frame: bytes) -> tuple[bytes, int, int]:
+    """-> (raw bytes, nrows, frame length consumed). Verifies the frame
+    checksum (header + payload); all failures raise the typed
+    CorruptionError so readers can classify repair vs quarantine."""
+    nrows, comp, raw_len, comp_len, want_crc, total = \
+        _check_frame_header(frame)
     lib = _load()
     if lib and comp in (COMP_NONE, COMP_ZLIB):
         src = np.frombuffer(frame[:total], dtype=np.uint8)
@@ -269,3 +277,38 @@ def block_decode(frame: bytes) -> tuple[bytes, int, int]:
             "decode_failed",
             f"decoded {len(raw)} bytes, header claims {raw_len}")
     return raw, nrows, total
+
+
+def block_decode_into(frame: bytes, dst: np.ndarray) -> tuple[int, int]:
+    """Decode one frame's rows DIRECTLY into ``dst`` (a contiguous uint8
+    view of the destination slot) — the in-place staging path: no
+    intermediate bytes object, no post-decode copy. Same verification and
+    CorruptionError classification as block_decode. -> (bytes written,
+    nrows)."""
+    nrows, comp, raw_len, comp_len, want_crc, total = \
+        _check_frame_header(frame)
+    if raw_len > len(dst):
+        raise CorruptionError(
+            "rowcount_mismatch",
+            f"frame holds {raw_len} bytes, destination slot is {len(dst)}")
+    lib = _load()
+    if lib and comp in (COMP_NONE, COMP_ZLIB):
+        src = np.frombuffer(frame[:total], dtype=np.uint8)
+        nrows_out = ctypes.c_uint32()
+        n = lib.gg_block_decode(src.ctypes.data, len(src),
+                                dst.ctypes.data, len(dst),
+                                ctypes.byref(nrows_out))
+        if n == -2:
+            raise CorruptionError("crc_mismatch", "block checksum mismatch")
+        if n == -1:
+            raise CorruptionError("bad_magic", "bad block magic")
+        if n < 0:
+            raise CorruptionError("decode_failed", f"block decode failed ({n})")
+        return int(n), int(nrows_out.value)
+    raw, nrows, _total = block_decode(frame)
+    if len(raw) > len(dst):
+        raise CorruptionError(
+            "rowcount_mismatch",
+            f"block holds {len(raw)} bytes, destination slot is {len(dst)}")
+    dst[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    return len(raw), nrows

@@ -20,6 +20,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import uuid
 
 import numpy as np
@@ -29,6 +30,7 @@ from greengage_tpu.catalog import Catalog, PolicyKind, TableSchema
 from greengage_tpu.runtime.faultinject import FaultError, faults
 from greengage_tpu.runtime.logger import counters
 from greengage_tpu.storage import native
+from greengage_tpu.storage.blockcache import MISS, CacheRegistry
 from greengage_tpu.storage.blockfile import (fsync_dir, read_column_file,
                                              verify_column_file,
                                              write_column_file)
@@ -152,19 +154,43 @@ class TableStore:
         # hash keys them so concurrent binders and multihost lockstep
         # binding agree without persistence
         self._derived: dict[tuple[str, str], Dictionary] = {}
-        self._raw_cache: dict = {}    # (table, col, seg, version) -> RawChunk
-        self._hp_cache: dict = {}     # (table, seg, name, version) -> result
+        # every read-path cache lives in ONE byte-accounted LRU registry
+        # (storage/blockcache.py, the bufmgr analog): shared budget
+        # (scan_cache_limit_mb), global recency eviction, manifest-version
+        # invalidation, hit/miss/evict counters. Thread-safe — the
+        # executor's staging pool reads through these concurrently.
+        self.blockcache = CacheRegistry()
+        # decoded block files: (table, rel, block_indices|None) -> ndarray
+        self._block_cache = self.blockcache.cache("blocks")
+        # parsed + verified footers: (table, rel) -> footer dict
+        self._footer_cache = self.blockcache.cache("footers")
+        self._raw_cache = self.blockcache.cache("raw")
+        # (table, col, seg, version) -> RawChunk
+        self._hp_cache = self.blockcache.cache("hostpred")
+        # (table, seg, name, version) -> result
         # transient per-version dictionaries over raw columns (group/sort/
         # join keys on raw TEXT): ref registry + per-segment code arrays
         self._rawdict_refs: dict = {}   # (table, col, version) -> ref
-        self._rawcode_cache: dict = {}  # (storage, seg, col, version) -> (codes, valid)
+        self._rawcode_cache = self.blockcache.cache("rawcode")
+        # (storage, seg, col, version) -> (codes, valid)
         # deletion-bitmap keep masks (visimap analog): (table, seg, version)
         # -> bool[manifest nrows] keep mask, or None when nothing deleted
-        self._delmask_cache: dict = {}
+        self._delmask_cache = self.blockcache.cache("delmask")
         # packed fixed-width prefixes of raw TEXT columns for DEVICE
         # predicates: (table, col, seg, version) -> (words[n,K] int64,
         # lengths[n] int32)
-        self._rawprefix_cache: dict = {}
+        self._rawprefix_cache = self.blockcache.cache("rawprefix")
+        # dictionary load/build serialization: concurrent staging threads
+        # must agree on ONE code space (raw_dictionary assigns first-seen
+        # codes; two racing builders would mint divergent codes)
+        self._dict_lock = threading.RLock()
+        # read-path self-heal under concurrency: per-(table, rel) repair
+        # locks + a repair generation, so parallel readers tripping the
+        # same bad file repair-or-quarantine it exactly once
+        self._repair_mu = threading.Lock()
+        self._repair_locks: dict = {}
+        self._repair_gen: dict = {}
+        self._tl = threading.local()   # per-thread last_prune
 
     # ---- per-content data roots (mirror failover) ----------------------
     def data_root(self, content: int) -> str:
@@ -320,6 +346,7 @@ class TableStore:
             try:
                 if self.repair_file(table, content, rel, path):
                     counters.inc("storage_repair")
+                    self._mark_rel_changed(table, rel)
                     self._log_event(
                         "WARNING",
                         f"repaired {table}/{rel} (content {content}) from "
@@ -344,14 +371,42 @@ class TableStore:
                 pass
         if err.cause != "missing":
             self.quarantine_file(path, err)
+            self._mark_rel_changed(table, rel)
         raise err
+
+    # -- repair concurrency helpers --------------------------------------
+    def _repair_lock_for(self, table: str, rel: str) -> threading.Lock:
+        with self._repair_mu:
+            lk = self._repair_locks.get((table, rel))
+            if lk is None:
+                lk = self._repair_locks[(table, rel)] = threading.Lock()
+            return lk
+
+    def _mark_rel_changed(self, table: str, rel: str) -> None:
+        """A repair or quarantine replaced/removed this rel's bytes: bump
+        the repair generation (waiting readers re-judge the NEW bytes
+        instead of acting on a stale failure) and drop cached blocks."""
+        with self._repair_mu:
+            self._repair_gen[(table, rel)] = \
+                self._repair_gen.get((table, rel), 0) + 1
+        self._block_cache.drop(lambda k: k[0] == table and k[1] == rel)
+        self._footer_cache.pop((table, rel), None)
 
     def _read_checked(self, table: str, rel: str, reader):
         """Run ``reader(path)`` with read-path self-heal: corruption (or a
         vanished manifest-referenced file) repairs from the standby tree
-        and retries ONCE; unrepairable damage quarantines and raises."""
+        and retries ONCE; unrepairable damage quarantines and raises.
+
+        Concurrency contract (the staging thread pool reads through this):
+        parallel readers tripping the same bad file serialize on a per-rel
+        lock and repair-or-quarantine EXACTLY once — a reader that waited
+        out another thread's repair re-reads the healed bytes instead of
+        double-repairing, and one that waited out a quarantine surfaces
+        'missing' instead of double-quarantining."""
         content = self.rel_content(rel)
         path = self.seg_file_path(table, rel)
+        with self._repair_mu:
+            gen0 = self._repair_gen.get((table, rel), 0)
         try:
             return reader(path, content)
         except FileNotFoundError:
@@ -359,21 +414,67 @@ class TableStore:
                 "missing", "manifest-referenced file is missing", path=path)
         except CorruptionError as e:
             err = e
-        err.locate(table=table, content=content, relpath=rel)
-        self.handle_corruption(table, content, rel, path, err)
-        return reader(path, content)
+        with self._repair_lock_for(table, rel):
+            with self._repair_mu:
+                changed = self._repair_gen.get((table, rel), 0) != gen0
+            if changed:
+                # another thread already repaired (or quarantined) this
+                # file while we waited: judge the CURRENT bytes
+                try:
+                    return reader(path, content)
+                except FileNotFoundError:
+                    err = CorruptionError(
+                        "missing", "manifest-referenced file is missing",
+                        path=path)
+                except CorruptionError as e:
+                    err = e
+            err.locate(table=table, content=content, relpath=rel)
+            self.handle_corruption(table, content, rel, path, err)
+            return reader(path, content)
 
     def read_file(self, table: str, rel: str,
-                  block_indices: list[int] | None = None) -> np.ndarray:
-        """Checked read of one manifest-referenced block file."""
-        return self._read_checked(
+                  block_indices: list[int] | None = None,
+                  out: np.ndarray | None = None) -> np.ndarray:
+        """Checked read of one manifest-referenced block file, served from
+        the byte-accounted block cache when resident (committed block
+        files are immutable; repair/quarantine invalidates explicitly).
+        Cache misses count scan_files_read / scan_bytes_decoded.
+
+        ``out``: optional preallocated destination (a staging-buffer slot)
+        the frames decode straight into on a miss — the cached value is
+        then a view of it, and the caller skips its own copy. Cache hits
+        ignore ``out`` (the caller copies from the returned array)."""
+        key = (table, rel,
+               None if block_indices is None else tuple(block_indices))
+        hit = self._block_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
+        arr = self._read_checked(
             table, rel,
-            lambda p, c: read_column_file(p, block_indices, segment=c))
+            lambda p, c: read_column_file(p, block_indices, segment=c,
+                                          out=out))
+        counters.inc("scan_files_read")
+        counters.inc("scan_bytes_decoded", int(arr.nbytes))
+        # a dest-decoded result is a VIEW of the caller's staging buffer,
+        # whose memory stays pinned until the buffer's LAST view evicts:
+        # charge the full padded slot we were handed (the per-segment
+        # views of one buffer then sum to its true footprint), never just
+        # the view's own rows
+        nb = arr.nbytes
+        if out is not None and getattr(arr, "base", None) is not None:
+            nb = max(nb, out.nbytes)
+        self._block_cache.put(key, arr, nbytes=nb)
+        return arr
 
     def read_footer_checked(self, table: str, rel: str) -> dict:
         from greengage_tpu.storage.blockfile import read_footer
 
-        return self._read_checked(table, rel, lambda p, c: read_footer(p))
+        hit = self._footer_cache.get((table, rel), MISS)
+        if hit is not MISS:
+            return hit
+        footer = self._read_checked(table, rel, lambda p, c: read_footer(p))
+        self._footer_cache.put((table, rel), footer, nbytes=512)
+        return footer
 
     # ---- dictionaries --------------------------------------------------
     def dictionary(self, table: str, col: str) -> Dictionary:
@@ -396,9 +497,14 @@ class TableStore:
         # per logical table, so codes compare/join across partitions
         table = table.split("#", 1)[0]
         key = (table, col)
-        if key not in self._dicts:
-            self._dicts[key] = Dictionary.load(self._dict_path(table, col))
-        return self._dicts[key]
+        d = self._dicts.get(key)
+        if d is None:
+            with self._dict_lock:   # one load per dict under parallel staging
+                d = self._dicts.get(key)
+                if d is None:
+                    d = self._dicts[key] = Dictionary.load(
+                        self._dict_path(table, col))
+        return d
 
     def derived_dictionary(self, values: list[str]) -> tuple[str, str]:
         """Register (or reuse) an in-memory dictionary for a string-function
@@ -424,30 +530,34 @@ class TableStore:
         version = snap.get("version", 0)
         parent = table.split("#", 1)[0]
         key = (parent, col, version)
-        hit = self._rawdict_refs.get(key)
-        if hit is not None:
-            return hit
-        schema = self.catalog.get(parent)
-        d = Dictionary()
-        nseg = schema.policy.numsegments
-        for storage in schema.storage_tables():
-            for seg in range(nseg):
-                chunk = self.raw_chunk(storage, seg, col, snap)
-                codes = d.encode(chunk.strings())
-                self._rawcode_cache[(storage, seg, col, version)] = (
-                    codes.astype(np.int32), chunk.valid)
-        ref = ("@rawdict", f"{parent}:{col}:{version}")
-        self._derived[ref] = d
-        self._rawdict_refs[key] = ref
-        if len(self._rawdict_refs) > 16:   # bound transient memory
-            old_key = next(iter(self._rawdict_refs))   # (parent, col, ver)
-            old_ref = self._rawdict_refs.pop(old_key)
-            self._derived.pop(old_ref, None)
-            for k in [k for k in self._rawcode_cache
-                      if k[0].split("#", 1)[0] == old_key[0]
-                      and k[2] == old_key[1] and k[3] == old_key[2]]:
-                self._rawcode_cache.pop(k, None)
-        return ref
+        with self._dict_lock:
+            # serialized: two staging threads racing this build would mint
+            # DIVERGENT first-seen code spaces for the same column
+            hit = self._rawdict_refs.get(key)
+            if hit is not None:
+                return hit
+            schema = self.catalog.get(parent)
+            d = Dictionary()
+            nseg = schema.policy.numsegments
+            for storage in schema.storage_tables():
+                for seg in range(nseg):
+                    chunk = self.raw_chunk(storage, seg, col, snap)
+                    codes = d.encode(chunk.strings())
+                    self._rawcode_cache.put(
+                        (storage, seg, col, version),
+                        (codes.astype(np.int32), chunk.valid),
+                        version=version)
+            ref = ("@rawdict", f"{parent}:{col}:{version}")
+            self._derived[ref] = d
+            self._rawdict_refs[key] = ref
+            if len(self._rawdict_refs) > 16:   # bound transient memory
+                old_key = next(iter(self._rawdict_refs))  # (parent, col, ver)
+                old_ref = self._rawdict_refs.pop(old_key)
+                self._derived.pop(old_ref, None)
+                self._rawcode_cache.drop(
+                    lambda k: k[0].split("#", 1)[0] == old_key[0]
+                    and k[2] == old_key[1] and k[3] == old_key[2])
+            return ref
 
     def raw_codes(self, table: str, seg: int, col: str, snapshot=None):
         """-> (int32 codes, valid|None) for one segment of a raw column
@@ -455,9 +565,31 @@ class TableStore:
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
         key = (table, seg, col, version)
-        if key not in self._rawcode_cache:
-            self.raw_dictionary(table, col, snap)
-        return self._rawcode_cache[key]
+        hit = self._rawcode_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
+        ref = self.raw_dictionary(table, col, snap)
+        hit = self._rawcode_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
+        # the code entry was byte-evicted while its dictionary survived:
+        # re-encode just this segment (every string already has a code, so
+        # encode() cannot grow the dictionary here)
+        with self._dict_lock:
+            d = self._derived.get(ref)
+            if d is None:
+                # the >16 transient-dict bound evicted OUR ref between
+                # raw_dictionary() returning and this lock: rebuild (the
+                # registry miss makes raw_dictionary re-encode every
+                # segment, repopulating the code cache too)
+                self._rawdict_refs.pop(
+                    (table.split("#", 1)[0], col, version), None)
+                ref = self.raw_dictionary(table, col, snap)
+                d = self._derived[ref]
+            chunk = self.raw_chunk(table, seg, col, snap)
+            res = (d.encode(chunk.strings()).astype(np.int32), chunk.valid)
+            self._rawcode_cache.put(key, res, version=version)
+            return res
 
     def _dict_path(self, table: str, col: str) -> str:
         table = table.split("#", 1)[0]
@@ -686,7 +818,16 @@ class TableStore:
         self._dicts.clear()
 
     # ---- read path -----------------------------------------------------
-    last_prune: tuple | None = None   # (blocks kept, blocks total) of last read
+    @property
+    def last_prune(self):
+        """(blocks kept, blocks total) of THIS THREAD's last read — the
+        staging pool runs read_segment concurrently, so the stat is
+        thread-local; each worker reads its own right after its read."""
+        return getattr(self._tl, "last_prune", None)
+
+    @last_prune.setter
+    def last_prune(self, value) -> None:
+        self._tl.last_prune = value
 
     def block_index(self, base: str, rel: str, table: str | None = None):
         """Per-segfile block-value index (the btree/bitmap AM analog for
@@ -822,12 +963,18 @@ class TableStore:
         return keep, kept, total
 
     def read_segment(self, table: str, seg: int, columns: list[str] | None = None,
-                     snapshot: dict | None = None, prune: tuple | None = None):
+                     snapshot: dict | None = None, prune: tuple | None = None,
+                     dest: dict | None = None):
         """-> (cols: {name: np.ndarray}, valids: {name: np.ndarray|None}, nrows).
 
         ``prune``: zone-map predicates [(col, op, value)] — blocks they rule
         out are skipped for EVERY requested column (block partitioning is
-        identical across a fileno's columns), shrinking the staged rows."""
+        identical across a fileno's columns), shrinking the staged rows.
+
+        ``dest``: optional {col: preallocated array} destinations (the
+        executor's staging-buffer slots). A plain single-file column with
+        no pruning/deletions decodes STRAIGHT into its slot (the returned
+        array is a view of it), skipping the staging copy entirely."""
         schema = self.catalog.get(table)
         snap = snapshot or self.manifest.snapshot()
         tmeta = snap["tables"].get(table, {"segfiles": {}, "nrows": {}})
@@ -906,20 +1053,42 @@ class TableStore:
                 valids[name] = self.raw_chunk(table, seg, name, snap).valid
                 continue
             data_parts, valid_parts = [], []
+            data_rels, valid_rels = [], []
             for rel in files:
                 fn = os.path.basename(rel)
                 if fn.startswith(name + ".") and fn.endswith(".ggb"):
-                    bidx = None
-                    if keep is not None:
-                        parts = fn.split(".")
-                        fileno = parts[1] if len(parts) >= 3 else None
-                        bidx = keep.get(fileno)
-                    arr = self.read_file(table, rel, bidx)
                     if fn.endswith(".valid.ggb"):
-                        valid_parts.append((rel, arr))
+                        valid_rels.append(rel)
                     else:
-                        data_parts.append((rel, arr))
-            if data_parts:
+                        data_rels.append(rel)
+            # in-place fast path: one data file, no block pruning, no
+            # deletion bitmap — decode straight into the caller's slot
+            d = None
+            if dest is not None and keep is None and keep_rows is None \
+                    and len(data_rels) == 1:
+                d = dest.get(name)
+
+            def _bidx(rel):
+                # the kept-block slice applies to data AND valid files of
+                # a fileno alike (block partitioning is identical), or the
+                # two would misalign after pruning
+                if keep is None:
+                    return None
+                parts = os.path.basename(rel).split(".")
+                return keep.get(parts[1] if len(parts) >= 3 else None)
+
+            for rel in valid_rels:
+                valid_parts.append((rel, self.read_file(table, rel,
+                                                        _bidx(rel))))
+            for rel in data_rels:
+                data_parts.append((rel, self.read_file(table, rel,
+                                                       _bidx(rel), out=d)))
+            if len(data_parts) == 1:
+                # single segfile (the common post-load shape): hand the
+                # cache-resident array through as-is — staging copies it
+                # into its own buffer, so nothing downstream mutates it
+                cols[name] = data_parts[0][1]
+            elif data_parts:
                 cols[name] = np.concatenate([a for _, a in data_parts])
             else:
                 cols[name] = np.empty(0, dtype=c.type.np_dtype)
@@ -955,8 +1124,9 @@ class TableStore:
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
         key = (table, col, seg, version)
-        if key in self._raw_cache:
-            return self._raw_cache[key]
+        hit = self._raw_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
         tmeta = snap["tables"].get(table, {"segfiles": {}})
         files = tmeta["segfiles"].get(str(seg), [])
         blob_rels, offs_parts, valid_parts = [], [], []
@@ -982,22 +1152,8 @@ class TableStore:
         valid = np.concatenate(valid_parts) if valid_parts else np.zeros(0, bool)
         chunk = _RawChunk(ends, None if valid.all() else valid, blob_rels,
                           reader=lambda rel: self.read_file(table, rel))
-        self._raw_cache[key] = chunk
-        if len(self._raw_cache) > 64:
-            self._raw_cache.pop(next(iter(self._raw_cache)))
+        self._raw_cache.put(key, chunk, version=version)
         return chunk
-
-    def _rawprefix_insert(self, key, val) -> None:
-        """Insert with a BYTE budget (wide word matrices are 4x the old
-        prefix entries, so an entry-count cap alone under-bounds memory)."""
-        cache = self._rawprefix_cache
-        cache[key] = val
-        budget = 512 << 20
-        total = sum(getattr(v, "nbytes", 64) for v in cache.values())
-        while total > budget and len(cache) > 1:
-            k0 = next(iter(cache))
-            total -= getattr(cache[k0], "nbytes", 64)
-            del cache[k0]
 
     def raw_max_len(self, table: str, col: str, snapshot=None) -> int:
         """Max utf-8 byte length over every committed row of a raw column
@@ -1006,8 +1162,8 @@ class TableStore:
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
         key = ("@maxlen", table, col, version)
-        hit = self._rawprefix_cache.get(key)
-        if hit is not None:
+        hit = self._rawprefix_cache.get(key, MISS)
+        if hit is not MISS:
             return hit
         schema = self.catalog.get(table)
         best = 0
@@ -1017,7 +1173,7 @@ class TableStore:
             if len(ends):
                 starts = np.concatenate([np.zeros(1, np.int64), ends[:-1]])
                 best = max(best, int((ends - starts).max()))
-        self._rawprefix_insert(key, best)
+        self._rawprefix_cache.put(key, best, version=version)
         return best
 
     def raw_prefix(self, table: str, seg: int, col: str, snapshot=None,
@@ -1035,10 +1191,10 @@ class TableStore:
         version = snap.get("version", 0)
         key = (table, col, seg, version, nwords)
         lkey = ("@len", table, col, seg, version)
-        hit = self._rawprefix_cache.get(key)
-        if hit is not None:
-            lens_hit = self._rawprefix_cache.get(lkey)
-            if lens_hit is not None:    # may be independently evicted
+        hit = self._rawprefix_cache.get(key, MISS)
+        if hit is not MISS:
+            lens_hit = self._rawprefix_cache.get(lkey, MISS)
+            if lens_hit is not MISS:    # may be independently evicted
                 return hit, lens_hit
         chunk = self.raw_chunk(table, seg, col, snap)
         ends = chunk.ends
@@ -1066,8 +1222,8 @@ class TableStore:
                     for j in range(8):
                         acc = (acc << np.uint64(8)) | data[:, w * 8 + j]
                     words[a:b, w] = acc
-        self._rawprefix_insert(key, words.view(np.int64))
-        self._rawprefix_insert(lkey, lengths)
+        self._rawprefix_cache.put(key, words.view(np.int64), version=version)
+        self._rawprefix_cache.put(lkey, lengths, version=version)
         return words.view(np.int64), lengths
 
     @staticmethod
@@ -1085,8 +1241,9 @@ class TableStore:
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
         key = (table, seg, name, version)
-        if key in self._hp_cache:
-            return self._hp_cache[key]
+        hit = self._hp_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
         _, col, hexpayload = name.split(":", 2)
         payload = json.loads(bytes.fromhex(hexpayload))
         chunk = self.raw_chunk(table, seg, col, snap)
@@ -1130,9 +1287,7 @@ class TableStore:
         else:
             raise ValueError(f"unknown host predicate op {op}")
         res = (out, chunk.valid)
-        self._hp_cache[key] = res
-        if len(self._hp_cache) > 256:
-            self._hp_cache.pop(next(iter(self._hp_cache)))
+        self._hp_cache.put(key, res, version=version)
         return res
 
     def fetch_raw(self, table: str, col: str, surrogates: np.ndarray,
@@ -1406,8 +1561,9 @@ class TableStore:
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
         key = (table, seg, version)
-        if key in self._delmask_cache:
-            return self._delmask_cache[key]
+        hit = self._delmask_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
         tmeta = snap["tables"].get(table, {})
         rel = tmeta.get("delmask", {}).get(str(seg))
         keep = None
@@ -1418,9 +1574,7 @@ class TableStore:
             keep[: len(deleted)] = ~deleted.astype(bool)
             if keep.all():
                 keep = None
-        self._delmask_cache[key] = keep
-        if len(self._delmask_cache) > 256:
-            self._delmask_cache.pop(next(iter(self._delmask_cache)))
+        self._delmask_cache.put(key, keep, version=version)
         return keep
 
     def live_rowcounts(self, table: str, snapshot: dict | None = None) -> list[int]:
