@@ -93,6 +93,15 @@ class Settings:
     mh_ready_deadline: float = 120.0    # readiness acks (refresh+plan+verify)
     mh_ack_deadline: float = 600.0      # completion acks (compile+execute)
     mh_heartbeat_interval: float = 2.0  # idle ping/pong cadence; 0 disables
+    # statement lifecycle (docs/ROBUSTNESS.md): statement_timeout arms a
+    # deadline at statement start; the statement dies at its next
+    # cancellation point (boundary-granular — a dispatched XLA program
+    # runs to its boundary). 0 disables.
+    statement_timeout_s: float = 0.0
+    # read-only dispatch retry: after WorkerDied mid-dispatch, how long
+    # the coordinator waits for the gang to re-form before serving the
+    # statement on the degraded local path instead (writes never retry)
+    mh_retry_window_s: float = 1.0
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True

@@ -27,6 +27,8 @@ from greengage_tpu.exec import staging
 from greengage_tpu.exec.compile import VALID_PREFIX, Compiler, CompileResult
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
+from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime.faultinject import faults
 from greengage_tpu.runtime.logger import counters
 from greengage_tpu.runtime.runaway import TRACKER
 
@@ -217,6 +219,10 @@ class Executor:
         while tier < self.settings.motion_retry_tiers \
                 and attempts < self.settings.motion_retry_tiers + 4:
             attempts += 1
+            # retry-tier boundary = a CHECK_FOR_INTERRUPTS site: a flag
+            # set while the previous attempt ran (user cancel, statement
+            # timeout, runaway cleaner) terminates the statement here
+            interrupt.check_interrupts()
             # fused_disabled programs cache under their own key: a backend
             # that can't lower the pallas kernel still gets gang reuse of
             # the working XLA fallback program (advisor r3). Feedback
@@ -325,6 +331,11 @@ class Executor:
             t_compute = time.monotonic()
             stage_ms = (t_compute - t_stage) * 1e3
             scan_io = {k: counters.get(k) - io0[k] for k in SCAN_COUNTERS}
+            # last cancellation point before dispatch: once the program
+            # is on the device it runs to this boundary (the documented
+            # semantic — XLA programs cannot be preempted mid-flight)
+            faults.check("cancel_before_dispatch")
+            interrupt.check_interrupts()
             try:
                 flat = comp.device_fn(*inputs)
                 # resolve async dispatch here so compute_ms is the device
@@ -503,6 +514,11 @@ class Executor:
         aux = getattr(self, "_aux_tables", {})
         ranges = getattr(self, "_row_ranges", {})
         rpool = staging.pool(self.settings)
+        # the statement's interrupt context, captured HERE because read
+        # units run on pool threads (interrupt.current() is thread-keyed):
+        # each unit checks the flag before its read, so a multi-second
+        # cold stage cancels mid-flight instead of at the next boundary
+        stmt_ctx = interrupt.REGISTRY.current()
 
         # plan phase: resolve per-table staging decisions. Read units are
         # submitted through a bounded LOOKAHEAD window (the table being
@@ -579,7 +595,7 @@ class Executor:
                     futs.append(rpool.submit(
                         self._read_unit, table, st["child_parts"], seg,
                         st["storage_cols"], snapshot, prune, st["rng"],
-                        dest))
+                        dest, stmt_ctx))
             st["buffers"] = buffers
             st["futs"] = futs
 
@@ -587,6 +603,7 @@ class Executor:
         # in place and put each table on the mesh as soon as it completes
         done_reads = 0
         for kind, table, cols, cap, key, prune, payload in plans:
+            interrupt.check_interrupts()   # between per-table assemblies
             if kind == "aux":
                 arrays.extend(
                     self._stage_aux(table, cols, cap, aux[table], shard))
@@ -639,12 +656,17 @@ class Executor:
         return arrays
 
     def _read_unit(self, table, child_parts, seg, storage_cols, snapshot,
-                   prune, rng, dest=None):
+                   prune, rng, dest=None, stmt_ctx=None):
         """One pooled staging unit: one segment's decoded columns (+ this
         thread's zone-prune stats). Runs concurrently with other units —
         the store's caches and read-path self-heal are thread-safe.
         ``dest`` carries this segment's staging-buffer slots for the
-        in-place decode fast path."""
+        in-place decode fast path. ``stmt_ctx`` is the owning statement's
+        interrupt context: each unit is a cancellation point, and the
+        raise travels back to the statement thread via fut.result()."""
+        faults.check("cancel_in_staging", segment=seg)
+        if stmt_ctx is not None:
+            stmt_ctx.check()
         c, v, n = self._read_segment_parts(
             table, child_parts, seg, storage_cols, snapshot, prune,
             dest=dest)

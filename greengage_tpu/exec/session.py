@@ -24,6 +24,9 @@ from greengage_tpu.exec.executor import Executor, QueryError, Result
 from greengage_tpu.parallel import make_mesh
 from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
+from greengage_tpu.runtime.interrupt import (REGISTRY as _INTERRUPTS,
+                                             StatementCancelled)
+from greengage_tpu.runtime.logger import counters as _counters
 from greengage_tpu.sql import ast as A
 from greengage_tpu.sql.binder import (Binder, _contains_agg,
                                        type_from_name)
@@ -220,7 +223,36 @@ class Database:
     # ------------------------------------------------------------------
     def sql(self, text: str):
         """Execute one or more statements; returns the last statement's
-        Result (or a status string for DDL/DML)."""
+        Result (or a status string for DDL/DML).
+
+        Every call registers a StatementContext in the process-wide
+        interrupt registry (runtime/interrupt.py) — the backend-entry
+        CHECK_FOR_INTERRUPTS arming: `gg cancel`, statement_timeout_s,
+        the runaway cleaner, and client disconnects all set its flag, and
+        the statement dies at its next cancellation point with a typed
+        cause. Nested calls (recursive-CTE fixpoints, retry redispatch)
+        share the outermost statement's context."""
+        ctx, _outer = _INTERRUPTS.enter(
+            text, timeout_s=float(self.settings.statement_timeout_s))
+        try:
+            return self._sql_inner(text)
+        except StatementCancelled as e:
+            # one count (and one log line) per cancelled statement,
+            # whichever cancellation point raised — the
+            # statements_cancelled_<cause> family; _sql_inner's generic
+            # error logging skips cancellations so this is the only row
+            if not ctx.counted:
+                ctx.counted = True
+                _counters.inc(f"statements_cancelled_{e.cause}")
+                if self.settings.log_statement:
+                    self.log.error("statement",
+                                   f"{e} [cause={e.cause}] -- in: "
+                                   f"{text.strip()[:200]}")
+            raise
+        finally:
+            _INTERRUPTS.exit(ctx)
+
+    def _sql_inner(self, text: str):
         if self.multihost is not None and self.multihost.is_coordinator:
             return self._coordinator_sql(text)
         out = None
@@ -233,7 +265,9 @@ class Database:
             try:
                 out = self._execute(stmt)
             except Exception as e:
-                if self.settings.log_statement:
+                # cancellations log once in sql()'s handler, with cause
+                if self.settings.log_statement \
+                        and not isinstance(e, StatementCancelled):
                     self.log.error("statement", f"{e} -- in: {what}",
                                    duration_ms=(time.monotonic() - t0) * 1e3)
                 raise
@@ -493,7 +527,55 @@ class Database:
             return payload["status"]
         return _DegradedResult(payload["columns"], payload["rows"])
 
-    def _coordinator_sql(self, text: str):
+    @staticmethod
+    def _is_read_only(stmt) -> bool:
+        """The dispatcher's retryable classification: statements that
+        never touch the manifest/catalog may be transparently redispatched
+        after a dispatch failure; anything else is a write and the DTM's
+        exactly-once guarantee decides (= no auto-retry)."""
+        return isinstance(stmt, (A.SelectStmt, A.UnionStmt, A.ExplainStmt,
+                                 A.DeclareCursorStmt))
+
+    def _dispatch_failover(self, stmt, text: str, err, is_retry: bool):
+        """A worker died/hung BEFORE anyone entered a collective, so the
+        statement never ran. Read-only statements retry transparently
+        ONCE: wait up to mh_retry_window_s for the gang to re-form (a
+        hung-then-woken worker redials within seconds) and redispatch —
+        counted in statements_retried; if the gang stays down, complete
+        on the degraded local path as before. Write statements surface
+        the error without re-execution: the manifest CAS never ran, so
+        nothing committed, and only an explicit client retry (or the
+        degraded path on a LATER statement) may run it — exactly-once is
+        the DTM's to keep, never the dispatcher's to gamble."""
+        from greengage_tpu.runtime.faultinject import faults
+        from greengage_tpu.runtime.retry import Deadline
+
+        if not self._is_read_only(stmt):
+            raise QueryError(
+                f"worker died mid-dispatch; write statement was NOT "
+                f"auto-retried (nothing committed — retry explicitly if "
+                f"desired): {err}")
+        window = float(self.settings.mh_retry_window_s)
+        if not is_retry and window > 0:     # 0 disables redispatch entirely
+            dl = Deadline(window)
+            while True:
+                if self.mh_try_recover():
+                    # the window a test can force open/shut: sleep widens
+                    # the race, error fails the redispatch path itself
+                    faults.check("retry_redispatch")
+                    _counters.inc("statements_retried")
+                    self.log.info(
+                        "statement",
+                        f"gang re-formed; redispatching read-only "
+                        f"statement after dispatch failure: "
+                        f"{text.strip()[:160]}")
+                    return self._coordinator_sql(text, _is_retry=True)
+                if dl.expired:
+                    break
+                time.sleep(0.05)
+        return self._degraded_sql(text)
+
+    def _coordinator_sql(self, text: str, _is_retry: bool = False):
         """Host-only statements run locally (workers pick the effects up
         from the shared directory at their next refresh). Mesh statements
         run a TWO-PHASE dispatch: broadcast with the coordinator's plan
@@ -501,8 +583,10 @@ class Database:
         parked before the collectives), then 'go' and execute here
         CONCURRENTLY with the workers. A dead worker surfaces on the
         channel during the readiness round — BEFORE anyone enters a
-        collective that could never rendezvous — and the statement
-        retries on the degraded local path."""
+        collective that could never rendezvous — and the statement fails
+        over by class: read-only statements transparently redispatch once
+        after gang re-formation (else complete on the degraded local
+        path); writes surface the error (_dispatch_failover)."""
         from greengage_tpu.parallel.multihost import WorkerDied
 
         ch = self.multihost.channel
@@ -544,20 +628,28 @@ class Database:
                     self._tx_for_dml(stmt.table, type(stmt).__name__[:6].upper())
                 if isinstance(stmt, A.DeclareCursorStmt):
                     self._validate_declare(stmt)
-                with self._admission():
-                    # one exchange()-scoped lock covers the whole two-phase
-                    # dispatch, so the heartbeat thread can never
-                    # interleave frames mid-statement; every ack round is
-                    # deadline-bounded (a hung worker classifies as
-                    # WorkerDied within mh_ready/ack_deadline, never an
-                    # unbounded readline)
-                    try:
+                # one exchange()-scoped lock covers the whole two-phase
+                # dispatch, so the heartbeat thread can never interleave
+                # frames mid-statement; every ack round is deadline-
+                # bounded (a hung worker classifies as WorkerDied within
+                # mh_ready/ack_deadline, never an unbounded readline).
+                # The WorkerDied handler sits OUTSIDE the admission scope
+                # so a retry redispatch re-admits on a released slot.
+                try:
+                    with self._admission():
                         with ch.exchange():
                             ch.send({"op": "sql", "sql": text,
                                      "plan_hash": self.plan_hash(stmt)})
                             try:
                                 ch.collect_acks(deadline="mh_ready_deadline",
                                                 phase="readiness")
+                            except StatementCancelled:
+                                # cancelled while parked on readiness:
+                                # nobody entered the mesh — release the
+                                # parked workers and surface the typed
+                                # cancellation
+                                ch.send({"op": "skip"})
+                                raise
                             except RuntimeError as e:
                                 # a worker REFUSED (plan-hash mismatch or
                                 # its planning failed): nobody entered the
@@ -578,12 +670,24 @@ class Database:
                                     # program: the result stands; later
                                     # statements take the degraded path
                                     self._mh_degrade(str(e))
-                    except WorkerDied as e:
-                        # death/hang BEFORE anyone entered a collective
-                        # (readiness or go phase): degrade and complete
-                        # this statement on the local path
-                        self._mh_degrade(str(e))
-                        return self._degraded_sql(text)
+                                except StatementCancelled:
+                                    # a half-collected exchange cannot be
+                                    # resumed (workers are still running
+                                    # their program and will ack into the
+                                    # teardown): quiesce so stale acks
+                                    # never leak into the next statement;
+                                    # the gang re-forms via rejoin
+                                    self._mh_degrade(
+                                        "statement cancelled while "
+                                        "collecting completion acks")
+                                    raise
+                except WorkerDied as e:
+                    # death/hang BEFORE anyone entered a collective
+                    # (readiness or go phase): degrade, then fail over by
+                    # statement class (reads redispatch/degrade, writes
+                    # surface the error — exactly-once)
+                    self._mh_degrade(str(e))
+                    return self._dispatch_failover(stmt, text, e, _is_retry)
             else:
                 if isinstance(stmt, A.SetStmt):
                     # settings steer MESH decisions (spill passes, retry

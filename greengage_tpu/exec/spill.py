@@ -29,6 +29,7 @@ import numpy as np
 
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
+from greengage_tpu.runtime import interrupt
 from greengage_tpu.planner.locus import Locus
 from greengage_tpu.planner.logical import (Aggregate, ColInfo, Filter, Join,
                                            Limit, Motion, MotionKind,
@@ -287,6 +288,9 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
     pass_results = []
     try:
         for i, combo in enumerate(combos):
+            # spill pass boundary = CHECK_FOR_INTERRUPTS (the cleaner's
+            # documented cancellation point; user cancels land here too)
+            interrupt.check_interrupts()
             if i + 1 < len(combos):
                 prefetcher.kick()
             pass_results.append(executor.run_single(
@@ -429,6 +433,7 @@ def _bucketed_dedupe_merge(executor, merged, dedupe, host_scan, aux_name,
 
     bucket_results = []
     for bkt in range(K):
+        interrupt.check_interrupts()   # merge-bucket boundary
         m = bucket == bkt
         if not m.any():
             continue
@@ -569,6 +574,7 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
     runs = []
     try:
         for p in range(npasses):
+            interrupt.check_interrupts()   # sorted-run pass boundary
             if p + 1 < npasses:
                 # warm the next sorted run's cold reads while this pass's
                 # device sort executes (same files, later row range)

@@ -624,11 +624,26 @@ def cmd_server(args):
         f" and {host}:{srv.port}" if srv._tcp_server is not None else "")
     print(f"serving {args.dir} on {where} (ctrl-c to stop)")
     import signal
+    import time as _t
 
     try:
-        signal.pause()
-    except (KeyboardInterrupt, AttributeError):
-        pass
+        if hasattr(signal, "pause"):
+            signal.pause()
+        else:
+            # platforms without signal.pause: sleep-wait for the ctrl-c
+            # (the old blanket AttributeError handler silently swallowed
+            # REAL AttributeError bugs from anywhere in the wait path)
+            while True:
+                _t.sleep(3600)
+    except KeyboardInterrupt:
+        # flag every in-flight statement before tearing the listener
+        # down, so blocked connections die with a typed cause instead of
+        # a connection reset
+        from greengage_tpu.runtime.interrupt import REGISTRY
+
+        n = REGISTRY.cancel_all("shutdown")
+        if n:
+            print(f"cancelled {n} in-flight statement(s)")
     finally:
         srv.stop()
     return 0
@@ -799,6 +814,65 @@ def cmd_sql(args):
             print("\t".join("" if v is None else str(v) for v in row))
         print(f"({len(out)} rows)")
     return 0
+
+
+def _activity_socket(args):
+    """Resolve the serving socket for ps/cancel: explicit -s, or the
+    running daemon's server.pid in -d DIR (the postmaster.pid analog)."""
+    if getattr(args, "socket", None):
+        return args.socket
+    if getattr(args, "dir", None):
+        info = _read_pidfile(args.dir)
+        if info and _pid_alive(info[0]):
+            return info[1]
+    return None
+
+
+def cmd_ps(args):
+    """pg_stat_activity analog: in-flight statements of a running server
+    (id, elapsed, cancel state, sql) for `gg cancel` to target."""
+    from greengage_tpu.runtime.server import SqlClient
+
+    sock = _activity_socket(args)
+    if sock is None:
+        print("error: ps needs -s SOCKET or -d DIR with a running server",
+              file=sys.stderr)
+        return 1
+    c = SqlClient(sock)
+    try:
+        resp = c.op({"op": "ps"})
+    finally:
+        c.close()
+    rows = resp.get("rows") or []
+    print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} SQL")
+    for r in rows:
+        state = f"cancel:{r['cancelled']}" if r.get("cancelled") else "active"
+        print(f"{r['id']:>6} {r['elapsed_s']:>10.3f} {state:>12} "
+              f"{r['sql']}")
+    print(f"({len(rows)} statements)", file=sys.stderr)
+    return 0
+
+
+def cmd_cancel(args):
+    """pg_cancel_backend analog: flag one in-flight statement; it dies at
+    its next cancellation point with cause 'user'."""
+    from greengage_tpu.runtime.server import SqlClient
+
+    sock = _activity_socket(args)
+    if sock is None:
+        print("error: cancel needs -s SOCKET or -d DIR with a running "
+              "server", file=sys.stderr)
+        return 1
+    c = SqlClient(sock)
+    try:
+        resp = c.op({"op": "cancel", "id": args.id})
+    finally:
+        c.close()
+    if resp.get("ok"):
+        print(f"statement {args.id} cancelled")
+        return 0
+    print(f"error: {resp.get('error')}", file=sys.stderr)
+    return 1
 
 
 def cmd_expand(args):
@@ -1121,6 +1195,17 @@ def main(argv=None):
     p.add_argument("-s", "--socket", default=None)
     p.add_argument("query")
     p.set_defaults(fn=cmd_sql)
+
+    p = sub.add_parser("ps")      # pg_stat_activity analog
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
+    p.set_defaults(fn=cmd_ps)
+
+    p = sub.add_parser("cancel")  # pg_cancel_backend analog
+    p.add_argument("id", type=int)
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
+    p.set_defaults(fn=cmd_cancel)
 
     p = sub.add_parser("server")
     p.add_argument("-d", "--dir", required=True)

@@ -46,6 +46,7 @@ import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
 
+from greengage_tpu.runtime import interrupt
 from greengage_tpu.runtime.faultinject import FaultError, faults
 from greengage_tpu.runtime.retry import (Deadline, RetryPolicy,
                                          TRANSIENT_ERRORS)
@@ -272,7 +273,26 @@ class CoordinatorChannel:
             limit = _limit(self.settings, deadline)
             dl = Deadline(limit)
             acks = []
+            cancelled = None
             for p in self._workers:
+                # per-worker read boundary = cancellation point, ONLY for
+                # the statement phases whose callers handle the unwind —
+                # a raise during set/sync/fault exchanges would strand
+                # buffered acks for the next exchange to misread. A no-op
+                # for the heartbeat thread (no registered statement).
+                # Completion raises EARLY (the wait IS workers running
+                # their program; the session degrades the gang, and the
+                # quiesce clears any already-buffered acks). Readiness
+                # DRAINS the round first — workers ack readiness
+                # promptly, so finishing the reads is cheap and leaves
+                # the ack stream clean for the session's 'skip' release.
+                if phase == "completion":
+                    interrupt.check_interrupts()
+                elif phase == "readiness" and cancelled is None:
+                    try:
+                        interrupt.check_interrupts()
+                    except Exception as e:
+                        cancelled = e
                 try:
                     p.sock.settimeout(dl.remaining(minimum=0.001))
                     line = p.f.readline()
@@ -289,6 +309,8 @@ class CoordinatorChannel:
                     acks.append(json.loads(line))
                 except ValueError as e:
                     raise WorkerDied(f"garbled ack frame: {e}")
+            if cancelled is not None:
+                raise cancelled   # after the drain: no stale acks remain
             return acks
 
     def broadcast(self, msg: dict, deadline="mh_ack_deadline",

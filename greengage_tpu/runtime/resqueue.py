@@ -9,6 +9,11 @@ a time per mesh) and HBM, so a queue bounds concurrent mesh statements and
 per-query estimated device bytes, and queues excess statements FIFO with a
 timeout instead of failing them.
 
+A queued statement is a cancellation point (runtime/interrupt.py): a
+cancelled waiter leaves the queue immediately — its cancel() wakes the
+wait via a registered listener, and the abandoning waiter re-notifies so
+a racing release is never lost (the same discipline as the timeout path).
+
 Usage (session-level):
     SET resource_queue_active = 2        -- concurrent mesh statements
     SET resource_queue_memory_mb = 4096  -- per-query est ceiling (0 = off)
@@ -18,6 +23,10 @@ Usage (session-level):
 from __future__ import annotations
 
 import threading
+import time
+
+from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime.logger import counters
 
 
 class QueueTimeout(RuntimeError):
@@ -29,21 +38,24 @@ class ResourceQueue:
 
     def __init__(self, settings):
         self.settings = settings
-        self._lock = threading.Lock()
+        # RLock: add_listener fires the waker INLINE when the flag is
+        # already set, on the admitting thread, while it holds this lock
+        self._lock = threading.RLock()
         self._slots = threading.Condition(self._lock)
         self.active = 0
         self.waiting = 0
         self.admitted_total = 0
         self.timed_out_total = 0
+        self.cancelled_total = 0
 
     def admit(self):
         """Blocks until a slot frees; raises QueueTimeout once
         resource_queue_timeout_s of TOTAL wait has elapsed (deadline-based:
-        wakeups don't restart the clock). A waiter abandoning on timeout
-        re-notifies so a racing release is never lost."""
-        import time
-
+        wakeups don't restart the clock), or StatementCancelled the moment
+        the waiter's statement is cancelled. A waiter abandoning for either
+        reason re-notifies so a racing release is never lost."""
         limit = int(self.settings.resource_queue_active)
+        ctx = interrupt.REGISTRY.current()
         with self._slots:
             if limit <= 0:
                 self.admitted_total += 1
@@ -51,10 +63,36 @@ class ResourceQueue:
             timeout = float(self.settings.resource_queue_timeout_s)
             deadline = time.monotonic() + timeout
             self.waiting += 1
+            # cancel() from another thread must WAKE this wait, not be
+            # discovered at the next timeout slice
+            waker = None
+            if ctx is not None:
+                def waker():
+                    with self._slots:
+                        self._slots.notify_all()
+                ctx.add_listener(waker)
             try:
                 while self.active >= limit:
+                    if ctx is not None and ctx.cancelled:
+                        # leave the queue NOW; re-notify so a release
+                        # that raced our abandonment is never lost
+                        self._slots.notify()
+                        self.cancelled_total += 1
+                        counters.inc("queue_cancelled_total")
+                        ctx.check()   # raises StatementCancelled
                     remaining = deadline - time.monotonic()
+                    if ctx is not None:
+                        # wake at the statement deadline too, so a
+                        # statement_timeout_s shorter than the queue
+                        # timeout still fires on time
+                        sr = ctx.remaining()
+                        if sr is not None:
+                            remaining = min(remaining, sr + 0.001)
                     if remaining <= 0 or not self._slots.wait(remaining):
+                        if ctx is not None and ctx.cancelled:
+                            continue   # classify at the loop head
+                        if deadline - time.monotonic() > 0:
+                            continue   # woken by a cancel-listener ping
                         # final predicate re-check: a notify that raced our
                         # timeout must not be swallowed
                         if self.active < limit:
@@ -67,6 +105,8 @@ class ResourceQueue:
                             f"({self.active} active, limit {limit})")
             finally:
                 self.waiting -= 1
+                if waker is not None:
+                    ctx.remove_listener(waker)
             self.active += 1
             self.admitted_total += 1
         return _Slot(self, counted=True)
@@ -80,6 +120,7 @@ class ResourceQueue:
         return {"active": self.active, "waiting": self.waiting,
                 "admitted": self.admitted_total,
                 "timed_out": self.timed_out_total,
+                "cancelled": self.cancelled_total,
                 "limit": int(self.settings.resource_queue_active)}
 
 

@@ -25,19 +25,32 @@ from __future__ import annotations
 
 import threading
 
+from greengage_tpu.runtime.interrupt import REGISTRY, StatementCancelled
 
-class RunawayCancelled(RuntimeError):
-    """The statement was chosen by the runaway cleaner."""
+
+class RunawayCancelled(StatementCancelled):
+    """The statement was chosen by the runaway cleaner. A
+    StatementCancelled with cause 'runaway': the cleaner is one producer
+    of the unified per-statement interrupt flag (runtime/interrupt.py),
+    so sessions count and surface it like every other cancellation."""
+
+    def __init__(self, message: str):
+        super().__init__(message, "runaway")
 
 
 class _Entry:
-    __slots__ = ("bytes", "cancel_reason", "depth", "flag_time")
+    __slots__ = ("bytes", "cancel_reason", "depth", "flag_time", "ctx")
 
-    def __init__(self, nbytes: int):
+    def __init__(self, nbytes: int, ctx=None):
         self.bytes = nbytes
         self.cancel_reason: str | None = None
         self.depth = 1          # nested executor runs (spill passes)
         self.flag_time = 0.0
+        # the statement's interrupt context (when one is registered):
+        # flagging the victim ALSO sets the unified cancel flag, so every
+        # cancellation point (staging, queue, spill) observes it — not
+        # just the tracker's own check()
+        self.ctx = ctx
 
 
 class VmemTracker:
@@ -52,12 +65,13 @@ class VmemTracker:
         """Register (or re-enter, for nested spill-pass runs) the calling
         thread's statement."""
         tid = threading.get_ident()
+        ctx = REGISTRY.current()
         with self._lock:
             cur = self._active.get(tid)
             if cur is not None:
                 cur.depth += 1
             else:
-                self._active[tid] = _Entry(0)
+                self._active[tid] = _Entry(0, ctx)
 
     def reprice(self, est_bytes: int, global_limit_bytes: int,
                 red_zone: float) -> None:
@@ -116,6 +130,11 @@ class VmemTracker:
                 f"memory ~{total >> 20} MB crossed the red zone "
                 f"({red_zone:.0%} of {global_limit_bytes >> 20} MB) and this "
                 f"statement was the top consumer (~{target.bytes >> 20} MB)")
+            if target.ctx is not None:
+                # unified cancellation: the victim dies at ANY of its
+                # cancellation points (staging unit, queue wait, spill
+                # boundary), not only at the tracker's own check()
+                target.ctx.cancel("runaway", target.cancel_reason)
 
     def check(self) -> None:
         """Cancellation point: raise if this thread's statement was picked

@@ -7,6 +7,13 @@ socket (or TCP port) and exchange newline-delimited JSON:
     <- {"ok": true, "columns": [...], "rows": [[...], ...], "tag": null}
     <- {"ok": false, "error": "..."}
 
+Control frames ride the same protocol (the pg_stat_activity /
+pg_cancel_backend surface, served from ANOTHER connection since the
+executing one is blocked in its statement):
+
+    -> {"op": "ps"}            <- {"ok": true, "rows": [activity...]}
+    -> {"op": "cancel", "id": N}  <- {"ok": true/false}
+
 Reference parity: exec_simple_query serving many clients
 (src/backend/tcop/postgres.c:1622). Each connection gets a thread; SELECTs
 run lock-free on manifest snapshots, write statements serialize on the
@@ -14,8 +21,11 @@ session write lock (one writer gang at a time), so concurrent COPY +
 SELECT + UPDATE interleave safely. Transaction state is per connection
 (the Database keeps one DtmSession per thread, and each connection is a
 thread), so BEGIN/COMMIT/ROLLBACK work over the wire; a connection that
-drops mid-transaction is rolled back, like a backend exiting. Conflicting
-commits fail at the manifest CAS with a serialization error.
+drops mid-transaction is rolled back, like a backend exiting — and a
+disconnect observed mid-exchange cancels the connection's in-flight
+statement with cause ``client_gone`` instead of letting the broken-pipe
+error escape into socketserver. Conflicting commits fail at the manifest
+CAS with a serialization error.
 """
 
 from __future__ import annotations
@@ -25,6 +35,33 @@ import os
 import socket
 import socketserver
 import threading
+
+from greengage_tpu.runtime.interrupt import REGISTRY, StatementCancelled
+
+
+def _watch_client(sock, thread_ident: int, stop: "threading.Event") -> None:
+    """Per-statement disconnect watcher: while the handler thread is
+    blocked inside db.sql(), peek the client socket — an EOF means the
+    client is gone, and the in-flight statement is flagged client_gone so
+    it dies at its next cancellation point instead of running to
+    completion for nobody. A readable socket with DATA is a pipelined
+    request (client alive): stop watching, never consume it."""
+    import select
+
+    while not stop.wait(0.1):
+        try:
+            r, _, _ = select.select([sock], [], [], 0)
+            if not r:
+                continue
+            if sock.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT) == b"":
+                REGISTRY.cancel_thread(thread_ident, "client_gone")
+                return
+            return            # buffered pipelined request: still alive
+        except (BlockingIOError, InterruptedError):
+            continue
+        except OSError:
+            REGISTRY.cancel_thread(thread_ident, "client_gone")
+            return
 
 
 def _encode_value(v):
@@ -105,29 +142,84 @@ class SqlServer:
                 return ok
 
             def _serve(self):
-                for line in self.rfile:
-                    line = line.strip()
-                    if not line:
-                        continue
+                me = threading.get_ident()
+                try:
+                    for line in self.rfile:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            req = json.loads(line)
+                            if "op" in req and "sql" not in req:
+                                resp = self._control(req)
+                            else:
+                                # watch for a mid-statement disconnect:
+                                # this thread is blocked in db.sql(), so
+                                # only a peeker can observe the EOF and
+                                # flag the statement client_gone
+                                stop = threading.Event()
+                                wt = threading.Thread(
+                                    target=_watch_client,
+                                    args=(self.connection, me, stop),
+                                    daemon=True, name="gg-client-watch")
+                                wt.start()
+                                try:
+                                    out = outer.db.sql(req["sql"])
+                                finally:
+                                    stop.set()
+                                    wt.join(timeout=2)
+                                if isinstance(out, str) or out is None:
+                                    resp = {"ok": True, "columns": None,
+                                            "rows": None, "tag": out}
+                                else:
+                                    resp = {
+                                        "ok": True,
+                                        "columns": list(out.columns),
+                                        "rows": [[_encode_value(v)
+                                                  for v in row]
+                                                 for row in out.rows()],
+                                        "tag": None,
+                                    }
+                        except StatementCancelled as e:
+                            # surface the typed cause to the client (the
+                            # '57014 query_canceled' SQLSTATE analog)
+                            resp = {"ok": False, "error": f"{e}",
+                                    "cancelled": e.cause}
+                        except Exception as e:  # per-statement isolation
+                            resp = {"ok": False, "error": f"{e}"}
+                        self.wfile.write((json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client vanished mid-exchange: flag whatever this
+                    # connection still has in flight as client_gone and
+                    # end the handler cleanly — a disconnect must never
+                    # traceback into socketserver (the statement dies at
+                    # its next cancellation point and nobody reads the
+                    # error)
+                    REGISTRY.cancel_thread(me, "client_gone")
+                    outer.db.log.log("WARNING", "connection",
+                                     "client disconnected mid-exchange")
+
+            def _control(self, req: dict) -> dict:
+                """Protocol control ops (never parsed as SQL): 'ps' lists
+                in-flight statements, 'cancel' flags one by id."""
+                op = req.get("op")
+                if op == "ps":
+                    return {"ok": True, "rows": REGISTRY.snapshot()}
+                if op == "cancel":
                     try:
-                        req = json.loads(line)
-                        sql = req["sql"]
-                        out = outer.db.sql(sql)
-                        if isinstance(out, str) or out is None:
-                            resp = {"ok": True, "columns": None,
-                                    "rows": None, "tag": out}
-                        else:
-                            resp = {
-                                "ok": True,
-                                "columns": list(out.columns),
-                                "rows": [[_encode_value(v) for v in row]
-                                         for row in out.rows()],
-                                "tag": None,
-                            }
-                    except Exception as e:   # per-statement error isolation
-                        resp = {"ok": False, "error": f"{e}"}
-                    self.wfile.write((json.dumps(resp) + "\n").encode())
-                    self.wfile.flush()
+                        sid = int(req.get("id"))
+                    except (TypeError, ValueError):
+                        return {"ok": False,
+                                "error": "cancel needs a numeric id"}
+                    if REGISTRY.cancel(sid, "user"):
+                        outer.db.log.info(
+                            "cancel", f"statement {sid} cancelled by "
+                            "operator request")
+                        return {"ok": True}
+                    return {"ok": False,
+                            "error": f"no in-flight statement {sid}"}
+                return {"ok": False, "error": f"unknown op {op!r}"}
 
         class Server(socketserver.ThreadingUnixStreamServer):
             daemon_threads = True
@@ -201,6 +293,13 @@ class SqlClient:
         if not resp.get("ok"):
             raise RuntimeError(resp.get("error", "server error"))
         return resp
+
+    def op(self, payload: dict) -> dict:
+        """Send a control frame (ps/cancel) and return the raw response
+        (not raising on ok=false — 'no such statement' is an answer)."""
+        self._f.write((json.dumps(payload) + "\n").encode())
+        self._f.flush()
+        return json.loads(self._f.readline())
 
     def close(self):
         self._f.close()

@@ -190,6 +190,70 @@ def test_pkg_install_and_create_extension(clu, tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# gg ps / gg cancel (pg_stat_activity / pg_cancel_backend analogs; the
+# full wait-state cancellation matrix lives in test_interrupt.py)
+# ---------------------------------------------------------------------------
+
+def test_ps_and_cancel_smoke(clu, tmp_path, capsys):
+    import threading
+    import time
+
+    from greengage_tpu.runtime.faultinject import faults
+    from greengage_tpu.runtime.interrupt import StatementCancelled
+    from greengage_tpu.runtime.server import SqlServer
+
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table pt (a int) distributed by (a)")
+    db.sql("insert into pt values " + ",".join(f"({i})" for i in range(64)))
+    sock = str(tmp_path / "ps.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    faults.inject("cancel_before_dispatch", "sleep", sleep_s=3.0,
+                  occurrences=1)
+    err = {}
+
+    def victim():
+        try:
+            db.sql("select count(*) from pt -- ps-victim")
+            err["e"] = None
+        except Exception as e:
+            err["e"] = e
+
+    t = threading.Thread(target=victim)
+    t.start()
+    try:
+        # poll gg ps until the in-flight statement shows
+        line = None
+        end = time.monotonic() + 5
+        while line is None and time.monotonic() < end:
+            assert run_cli("ps", "-s", sock) == 0
+            out = capsys.readouterr().out
+            line = next((ln for ln in out.splitlines()
+                         if "ps-victim" in ln), None)
+            if line is None:
+                time.sleep(0.05)
+        assert line is not None, "gg ps never showed the statement"
+        sid = line.split()[0]
+        assert run_cli("cancel", sid, "-s", sock) == 0
+        assert f"statement {sid} cancelled" in capsys.readouterr().out
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert isinstance(err["e"], StatementCancelled), err["e"]
+        assert err["e"].cause == "user"
+        # cancelling a finished id is a clean error, not a crash
+        assert run_cli("cancel", sid, "-s", sock) == 1
+    finally:
+        faults.reset("cancel_before_dispatch")
+        srv.stop()
+        t.join(timeout=15)
+
+
+def test_ps_requires_running_server(tmp_path, capsys):
+    assert run_cli("ps", "-d", str(tmp_path / "nowhere")) == 1
+    assert "running server" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
 # daemon lifecycle (subprocess: fork conflicts with pytest/jax state)
 # ---------------------------------------------------------------------------
 

@@ -623,8 +623,12 @@ def test_session_hang_at_readiness_degrades_and_rejoins(devices8, tmp_path):
     """Worker goes silent on the readiness round: detection within
     mh_ready_deadline, the statement completes degraded, the worker
     rejoins, and the session returns to mesh dispatch."""
+    # mh_retry_window_s = 0: this test asserts the DEGRADED fallback, so
+    # the transparent read-only redispatch (test_dispatch_retry_*) must
+    # not win the race against the instantly-reconnecting scripted worker
     db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
-                                          "mh_ready_deadline": 0.5})
+                                          "mh_ready_deadline": 0.5,
+                                          "mh_retry_window_s": 0})
 
     def script():
         from greengage_tpu.parallel.multihost import CoordinatorLost
@@ -676,7 +680,9 @@ def test_session_death_at_go_phase_degrades_and_rejoins(devices8, tmp_path):
     statement completes degraded, and the gang re-forms."""
     from greengage_tpu.runtime.faultinject import faults
 
-    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0})
+    # retry window 0: assert the degraded fallback (see above)
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_retry_window_s": 0})
 
     def script():
         from greengage_tpu.parallel.multihost import CoordinatorLost
@@ -881,3 +887,74 @@ def test_cluster_worker_hang_bounded_degrade_then_rejoin(tmp_path):
     assert out["post_rejoin2"] == 1950
     # the worker LOGGED the loss and the rejoin instead of exiting silently
     assert "connection lost" in wout and "reconnected" in wout, wout
+
+
+# ---------------------------------------------------------------------------
+# dispatch-failure retry matrix (docs/ROBUSTNESS.md statement lifecycle):
+# read-only statements redispatch transparently once the gang re-forms;
+# writes surface the error without re-execution (exactly-once)
+# ---------------------------------------------------------------------------
+
+def _die_then_rejoin(w):
+    """Scripted worker: die on the first sql frame (close mid-dispatch),
+    then redial the kept listener and serve mesh exchanges normally."""
+    from greengage_tpu.parallel.multihost import CoordinatorLost
+
+    try:
+        msg = w.recv(idle_timeout=30.0)
+        assert msg.get("op") == "sql"
+    except (CoordinatorLost, OSError):
+        pass
+    w.close()
+    end = time.monotonic() + 15
+    while time.monotonic() < end:
+        if w.reconnect():
+            break
+        time.sleep(0.05)
+    else:
+        return
+    _serve_mesh(w)
+
+
+def test_dispatch_retry_readonly_redispatches_after_rejoin(devices8, tmp_path):
+    """A read-only statement that loses its worker mid-dispatch succeeds
+    TRANSPARENTLY on the re-formed mesh — statements_retried == 1, no
+    degraded subprocess, no client-visible error."""
+    from greengage_tpu.runtime.logger import counters
+
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_retry_window_s": 15})
+    t = threading.Thread(target=_die_then_rejoin, args=(w,), daemon=True)
+    t.start()
+    base = counters.get("statements_retried")
+    r = db.sql("select count(*), sum(v) from t")
+    assert [int(x) for x in r.rows()[0]] == \
+        [300, sum(i % 7 for i in range(300))]
+    assert r.stats.get("segments") == 8       # mesh result, not degraded
+    assert not r.stats.get("degraded")
+    assert counters.get("statements_retried") == base + 1
+    assert db._mh_degraded is None            # gang recovered in-line
+    ch.close()
+    t.join(10)
+
+
+def test_dispatch_failure_write_not_retried(devices8, tmp_path):
+    """The same mid-dispatch worker death on a WRITE surfaces the error
+    without re-execution: nothing committed (row count unchanged by
+    assertion), statements_retried untouched — exactly-once stays the
+    DTM's decision, never the dispatcher's."""
+    from greengage_tpu.runtime.logger import counters
+
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_retry_window_s": 15})
+    t = threading.Thread(target=_die_then_rejoin, args=(w,), daemon=True)
+    t.start()
+    base = counters.get("statements_retried")
+    with pytest.raises(Exception, match="auto-retried"):
+        db.sql("delete from t where k < 10")
+    assert counters.get("statements_retried") == base
+    assert _recover(db), "gang never recovered after worker rejoin"
+    r = db.sql("select count(*) from t")      # exactly-once: no row lost
+    assert int(r.rows()[0][0]) == 300
+    ch.close()
+    t.join(10)
