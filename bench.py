@@ -407,6 +407,107 @@ def microbench_staging() -> None:
         shutil.rmtree(path, ignore_errors=True)
 
 
+def microbench_plan_cache() -> None:
+    """Repeated-shape statement throughput (ISSUE 5, docs/PERF.md "Plan
+    cache"): dashboard-style SELECTs that differ only in literal values.
+    Cold = every statement re-plans and recompiles (plan_cache_params off,
+    caches cleared per statement — the seed behavior); warm = the
+    parameterized plan + executable cache serves every value from ONE
+    compiled program. CPU-only by design (XLA compile cost dominates on
+    every backend). Prints the standard one-line JSON:
+
+        {"metric": "plan_cache_stmts_per_sec", "value": N, "unit":
+         "stmts/s", "vs_baseline": <speedup vs cold-compile-every-time>,
+         "recompiles_avoided": ..., ...}
+
+    Env: GGTPU_MB_ROWS (default 200000), GGTPU_MB_SEGS (4),
+         GGTPU_MB_WARM (30 statements), GGTPU_MB_COLD (3 statements)."""
+    os.environ.setdefault("GGTPU_BENCH_PLATFORM", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax  # noqa: F401  (platform pinning below)
+
+    _apply_platform_override()
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import greengage_tpu
+    from greengage_tpu.runtime.logger import counters
+
+    rows = int(os.environ.get("GGTPU_MB_ROWS", "200000"))
+    nseg = int(os.environ.get("GGTPU_MB_SEGS", "4"))
+    nwarm = int(os.environ.get("GGTPU_MB_WARM", "30"))
+    ncold = int(os.environ.get("GGTPU_MB_COLD", "3"))
+    path = tempfile.mkdtemp(prefix="ggtpu_plancache_mb_")
+    try:
+        # the persistent XLA disk cache would hide recompile cost: point it
+        # at a throwaway dir so cold statements pay the real compile
+        os.environ["GGTPU_XLA_CACHE"] = os.path.join(path, "xla")
+        import jax as _j
+
+        _j.config.update("jax_compilation_cache_dir",
+                         os.path.join(path, "xla"))
+        db = greengage_tpu.connect(path, numsegments=nseg)
+        db.sql("create table d (k int, grp int, v double precision) "
+               "distributed by (k)")
+        rng = np.random.default_rng(11)
+        db.load_table("d", {
+            "k": np.arange(rows, dtype=np.int32),
+            "grp": rng.integers(0, 50, rows, dtype=np.int32),
+            "v": rng.random(rows)})
+
+        def q(i: int) -> str:
+            return (f"select count(*), sum(v), min(grp) from d "
+                    f"where grp >= {i % 40} and v < 0.{51 + i % 37}")
+
+        def clear_all() -> None:
+            db._select_cache.clear()
+            db.executor._plan_cache.clear()
+            _j.clear_caches()   # in-memory jit cache, not just ours
+
+        # cold: the seed behavior — every literal change replans+recompiles
+        db.sql("set plan_cache_params = off")
+        cold_s = 0.0
+        for i in range(ncold):
+            clear_all()
+            t0 = time.monotonic()
+            db.sql(q(i))
+            cold_s += time.monotonic() - t0
+        cold_per = cold_s / max(ncold, 1)
+
+        # warm: parameterized cache — one compile serves every value
+        db.sql("set plan_cache_params = on")
+        clear_all()
+        db.sql(q(0))   # populate
+        c0 = counters.snapshot()
+        t0 = time.monotonic()
+        for i in range(1, nwarm + 1):
+            db.sql(q(i))
+        warm_s = time.monotonic() - t0
+        delta = counters.since(c0)
+        warm_per = warm_s / max(nwarm, 1)
+        line = {
+            "metric": "plan_cache_stmts_per_sec",
+            "value": round(1.0 / max(warm_per, 1e-9), 1),
+            "unit": "stmts/s",
+            "vs_baseline": round(cold_per / max(warm_per, 1e-9), 2),
+            "cold_stmt_ms": round(cold_per * 1e3, 1),
+            "warm_stmt_ms": round(warm_per * 1e3, 1),
+            "recompiles_avoided": nwarm - delta.get("program_cache_miss", 0),
+            "plan_cache_hits": delta.get("plan_cache_hit", 0),
+            "program_cache_hits": delta.get("program_cache_hit", 0),
+            "params_hoisted": delta.get("params_hoisted", 0),
+            "rows": rows, "segments": nseg,
+        }
+        print(json.dumps(line), flush=True)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+
+
 def microbench(name: str) -> None:
     fn = globals().get("microbench_" + name)
     if fn is None:

@@ -102,6 +102,23 @@ class Settings:
     # the coordinator waits for the gang to re-form before serving the
     # statement on the degraded local path instead (writes never retry)
     mh_retry_window_s: float = 1.0
+    # plan / executable cache (plancache.c prepared-statement analog;
+    # docs/PERF.md "Plan cache"): plan_cache_params hoists plan-safe
+    # literals into runtime parameters so one XLA executable serves every
+    # value of a query shape (off = classic value-pinned plans);
+    # plan_cache_size bounds BOTH the session's bound-plan LRU and the
+    # executor's compiled-program LRU (each program entry pins an XLA
+    # executable)
+    plan_cache_params: bool = True
+    plan_cache_size: int = 256
+    # persistent XLA compilation cache directory, applied at Database init
+    # (the warm-cache requirement in docs/PERF.md — a cold cache
+    # recompiles every query shape once per process). Empty = leave the
+    # process default; the GGTPU_XLA_CACHE env var overrides when set.
+    xla_cache_dir: str = "~/.cache/ggtpu_xla"
+    # jax's persistent cache never evicts (0.4.x), so init prunes the
+    # active platform subdir oldest-first past this bound; 0 = unbounded
+    xla_cache_limit_mb: int = 2048
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True

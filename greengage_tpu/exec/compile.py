@@ -99,6 +99,9 @@ class CompileResult:
     # the executor only treats a device failure as "pallas couldn't lower"
     # (and retries on the pure-XLA path) for such programs
     uses_fused: bool = False
+    # hoisted-literal parameter slots, in slot order: the executor appends
+    # one replicated (1,)-array per slot after the staged table inputs
+    param_dtypes: tuple = ()
 
 
 class Compiler:
@@ -113,7 +116,14 @@ class Compiler:
         self.store = store
         self.mesh = mesh
         self.nseg = nseg
-        self.consts = consts
+        # own copy: the session caches the binder's consts dict across
+        # executions, and compile stashes per-trace state (runtime param
+        # tracers) into its view
+        self.consts = dict(consts)
+        # hoisted-literal vector (sql/paramize.py): values become traced
+        # scalar inputs of the program, so the executable is value-generic
+        self.params = self.consts.pop("@params@", None)
+        self._consts_digest = self.consts.pop("@consts_digest@", None)
         self.s = settings
         self.tier = tier
         self.cap_overrides = cap_overrides or {}   # plan node id -> capacity
@@ -131,13 +141,7 @@ class Compiler:
         # build allocates its FULL key domain regardless of how small the
         # chunked build scan is, defeating the pass-size search
         self.no_direct = no_direct
-        self.scan_caps: dict[str, int] = {}
-        self.scan_cols: dict[str, set] = {}
-        self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
-        self.scan_count: dict[str, int] = {}
-        self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
-        self.scan_parts: dict[str, tuple | None] = {}  # table -> child tables
-        self.scan_dyn: dict[str, tuple | None] = {}   # table -> dyn prune src
+        self._reset_scan_state()
         self.instrument = instrument      # EXPLAIN ANALYZE per-node rows
         self.node_rows: dict[str, int] = {}   # metric name -> plan node id
         # multi-host: outputs/flags/metrics are device-reduced + replicated
@@ -149,9 +153,38 @@ class Compiler:
         self.scan_cap_override = scan_cap_override or {}
         self.aux_tables = aux_tables or {}
 
+    def _reset_scan_state(self) -> None:
+        """Fresh per-walk scan collection: compile() re-resets so ONE
+        Compiler can run shape_signature() and then compile() (the
+        executor's miss path) without double-counting scan_count, which
+        would silently disable single-scan zone pruning."""
+        self.scan_caps: dict[str, int] = {}
+        self.scan_cols: dict[str, set] = {}
+        self.scan_direct: dict[str, int | None] = {}  # table -> pinned seg
+        self.scan_count: dict[str, int] = {}
+        self.scan_prune: dict[str, tuple] = {}        # table -> pushed preds
+        self.scan_parts: dict[str, tuple | None] = {}  # table -> child tables
+        self.scan_dyn: dict[str, tuple | None] = {}   # table -> dyn prune src
+
+    def _merge_unpinned_scan_caps(self) -> None:
+        """No (consistent) direct pin: the staged capacity must cover EVERY
+        segment, not just the pinned ones two conflicting point-scans named
+        (their caps were merged into scan_caps). Runs in BOTH compile() and
+        shape_signature() so the signature digests the same post-merge caps
+        the trace allocates — otherwise DML growing a NON-pinned segment
+        past its bucket could leave the signature equal and reuse a
+        too-small executable."""
+        for t in sorted(self.scan_caps):
+            if self.scan_direct.get(t) is None and t not in self.aux_tables:
+                counts = self._seg_counts(t, self.scan_parts.get(t))
+                self.scan_caps[t] = max(
+                    self.scan_caps[t],
+                    self._bucket_cap(t, max(counts, default=0)))
+
     # ------------------------------------------------------------------
     def compile(self, plan: Motion) -> CompileResult:
         assert isinstance(plan, Motion) and plan.kind is MotionKind.GATHER
+        self._reset_scan_state()
         # Stable plan-node identity: preorder ordinals over the plan tree.
         # cap_overrides / pack_disabled / flag_caps / flag_packs cross
         # compile invocations through the executor's retry loop and plan
@@ -179,15 +212,9 @@ class Compiler:
             self._host_limit_node = id(node)
 
         self._collect_scans(below)
+        self._merge_unpinned_scan_caps()
         input_spec = []
         for t in sorted(self.scan_caps):
-            if self.scan_direct.get(t) is None and t not in self.aux_tables:
-                # no (consistent) direct pin: the staged capacity must cover
-                # EVERY segment, not just the pinned ones two conflicting
-                # point-scans named (their caps were merged into scan_caps)
-                counts = self._seg_counts(t, self.scan_parts.get(t))
-                self.scan_caps[t] = max(self.scan_caps[t],
-                                        max(counts, default=0), 1)
             cols = []
             for c in sorted(self.scan_cols[t]):
                 cols.append(c)
@@ -228,30 +255,27 @@ class Compiler:
         # retry. Sorts/Limits already compact; Aggregate outputs are dense
         # domains or group tables numbered live-first.
         cap_below = self._capacity_of(below)
-        compact_k = None
+        compact_k = self._gather_compact_k(plan, below)
         fid_cmp = mid_cmp = None
-        if (not isinstance(below, (Sort, Limit, Aggregate, PartialState))
-                and cap_below >= (1 << 14)):
-            est = max(getattr(below, "est_rows", 0.0) or 0.0, 1.0)
-            if below.locus is not None and below.locus.is_partitioned \
-                    and self.nseg > 1:
-                est /= self.nseg
-            k = _pow2(int(est * 1.5) + 64) * (4 ** self.tier)
-            if self._nid(plan) in self.cap_overrides:
-                k = _pow2(int(self.cap_overrides[self._nid(plan)]))
-            if k * 2 <= cap_below:
-                compact_k = min(k, cap_below)
-                fid_cmp = f"gather_compact_overflow_{len(self.flags)}"
-                self.flags.append(fid_cmp)
-                mid_cmp = f"gather_compact_total_{len(self.metrics)}"
-                self.metrics.append(mid_cmp)
-                self.flag_caps[fid_cmp] = (self._nid(plan), mid_cmp)
+        if compact_k is not None:
+            fid_cmp = f"gather_compact_overflow_{len(self.flags)}"
+            self.flags.append(fid_cmp)
+            mid_cmp = f"gather_compact_total_{len(self.metrics)}"
+            self.metrics.append(mid_cmp)
+            self.flag_caps[fid_cmp] = (self._nid(plan), mid_cmp)
 
         flag_names = list(self.flags)
         nseg = self.nseg
 
         mh = self.multihost
         metric_names = list(self.metrics)
+        # hoisted-literal parameters (sql/paramize.py): one replicated
+        # (1,)-scalar input per slot, read by Evaluator._eval_param — the
+        # executable stays value-generic, values bind per dispatch
+        param_dtypes = ()
+        if self.params is not None and self.params.values:
+            param_dtypes = tuple(t.np_dtype for t in self.params.types)
+        nparams = len(param_dtypes)
 
         def seg_fn(*flat):
             from jax import lax
@@ -266,6 +290,13 @@ class Compiler:
                 entry["@present"] = flat[i]
                 i += 1
                 ctx["tables"][tname] = entry
+            if nparams:
+                # visible to every Evaluator(b, self.consts) in the
+                # compiled closures; self.consts is this Compiler's copy,
+                # so the tracers never leak into the session's cached pool
+                self.consts["@params@rt"] = {
+                    k: flat[i + k] for k in range(nparams)}
+                i += nparams
             ctx["metrics"] = []
             batch = compiled(ctx)
             sel = batch.selection()
@@ -323,7 +354,9 @@ class Compiler:
             _shard_map(
                 seg_fn,
                 mesh=self.mesh,
-                in_specs=tuple(P(SEG_AXIS) for _ in range(sum(len(c) + 1 for _, c, *_ in input_spec))),
+                in_specs=tuple(P(SEG_AXIS) for _ in range(
+                    sum(len(c) + 1 for _, c, *_ in input_spec)))
+                + tuple(P() for _ in range(nparams)),
                 out_specs=out_specs,
             )
         )
@@ -343,11 +376,123 @@ class Compiler:
             node_rows=dict(self.node_rows),
             flag_packs=dict(self.flag_packs),
             uses_fused=self.uses_fused,
+            param_dtypes=param_dtypes,
         )
 
     def _nid(self, plan) -> int:
         """Stable preorder ordinal of a plan node (see compile())."""
         return self._nids[id(plan)]
+
+    def _gather_compact_k(self, plan, below) -> int | None:
+        """Device-side result-compaction slot count before the Gather, or
+        None when the result ships uncompacted (shared by compile() and
+        shape_signature — the decision is part of the program's shape)."""
+        cap_below = self._capacity_of(below)
+        if isinstance(below, (Sort, Limit, Aggregate, PartialState)) \
+                or cap_below < (1 << 14):
+            return None
+        est = max(getattr(below, "est_rows", 0.0) or 0.0, 1.0)
+        if below.locus is not None and below.locus.is_partitioned \
+                and self.nseg > 1:
+            est /= self.nseg
+        k = _pow2(int(est * 1.5) + 64) * (4 ** self.tier)
+        if self._nid(plan) in self.cap_overrides:
+            k = _pow2(int(self.cap_overrides[self._nid(plan)]))
+        if k * 2 <= cap_below:
+            return min(k, cap_below)
+        return None
+
+    # ------------------------------------------------------------------
+    # shape signature: the executable-reuse key half (docs/PERF.md)
+    # ------------------------------------------------------------------
+    _SIG_SKIP_FIELDS = frozenset((
+        # tree edges (walked explicitly) and estimate-only fields — the
+        # estimates' influence on the program is via the BUCKETED
+        # capacities, which the signature captures separately
+        "child", "left", "right", "inputs", "est_rows", "expand_est",
+        "locus", "parts_total", "index_hits",
+    ))
+
+    def shape_signature(self, plan: Motion, snapshot=None) -> str:
+        """Digest of EVERYTHING the traced program reads at compile time:
+        plan structure + expression trees (pinned literal values and Param
+        slots included), pow2-bucketed per-node capacities, referenced
+        dictionary contents (fingerprints), the binder's consts pool
+        digest, parameter dtypes, and the codegen-relevant settings.
+
+        Equal signature => compiling this plan would produce an identical
+        XLA program, so the executor's program cache can reuse the
+        compiled executable ACROSS manifest versions: a DML that stays
+        inside every capacity bucket and grows no dictionary re-dispatches
+        the hot executable instead of recompiling."""
+        import hashlib
+
+        self._snap = snapshot
+        self._nids = {}
+        stack = [plan]
+        while stack:
+            p = stack.pop()
+            self._nids[id(p)] = len(self._nids)
+            stack.extend(reversed(p.children))
+        below = plan.child
+        self._dict_refs = {}
+        _collect_dict_refs(plan, self._dict_refs)
+        self._host_limit_node = id(below) if isinstance(below, Limit) else None
+        self._collect_scans(below)
+        self._merge_unpinned_scan_caps()
+        nodes = []
+        dict_refs: dict = dict(self._dict_refs)
+        stack = [plan]
+        while stack:
+            p = stack.pop()
+            stack.extend(reversed(p.children))
+            fields = []
+            for name, v in vars(p).items():
+                if name in self._SIG_SKIP_FIELDS:
+                    continue
+                fields.append((name, repr(v)))
+                _collect_value_dict_refs(v, dict_refs)
+            try:
+                cap = self._capacity_of(p)
+            except NotImplementedError:
+                cap = -1
+            extra = []
+            if isinstance(p, Join) and getattr(p, "multi", False) \
+                    and p.kind in ("semi", "anti"):
+                extra.append(self._join_multi_expand_cap(p))
+            nodes.append((type(p).__name__,
+                          p.locus.kind.name if p.locus is not None else None,
+                          cap, tuple(extra), tuple(fields)))
+        dicts = []
+        for ref in sorted(set(dict_refs.values())):
+            try:
+                dicts.append((ref, self.store.dictionary(*ref).fingerprint()))
+            except Exception:
+                # unresolved ref (e.g. evicted transient raw dict): the
+                # caller treats a failed signature as uncacheable
+                raise LookupError(f"dictionary {ref} unavailable")
+        s = self.s
+        settings_sig = (self.nseg, self.multihost, self.tier,
+                        self.fused_disabled, tuple(sorted(self.pack_disabled)),
+                        self.no_direct) + self.codegen_settings_sig(s)
+        pdtypes = ()
+        if self.params is not None:
+            pdtypes = tuple(str(t.np_dtype) for t in self.params.types)
+        gather_k = self._gather_compact_k(plan, below)
+        payload = repr((tuple(nodes), tuple(dicts), self._consts_digest,
+                        pdtypes, gather_k, settings_sig))
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    @staticmethod
+    def codegen_settings_sig(s) -> tuple:
+        """Every Settings field shape_signature digests. The executor keys
+        its per-dispatch signature memo on this same tuple, so a SET that
+        changes codegen invalidates memoized signatures, never a stale
+        executable lookup."""
+        return (s.dense_group_limit, s.fused_dense_agg,
+                s.fused_dense_min_rows, s.fused_dense_max_domain,
+                s.fused_dense_max_scratch_mb, s.motion_capacity_slack,
+                s.hash_num_probes, s.hash_table_min, s.hash_table_max)
 
     def _estimate_bytes(self, plan: Plan) -> int:
         """Rough per-segment device allocation for the whole program
@@ -384,19 +529,30 @@ class Compiler:
         """Per-segment row counts, clamped by any spill chunk override.
         A partitioned scan sums its (pruned) child tables — pruning
         therefore shrinks the staged capacity, not just the IO."""
+        snap = getattr(self, "_snap", None)
         if parts is not None:
             # one manifest snapshot for all children (it is a full-file
             # JSON parse; per-child reads would be O(parts) disk parses)
-            snap = self.store.manifest.snapshot()
+            snap = snap or self.store.manifest.snapshot()
             per = [self.store.segment_rowcounts(p, snap) for p in parts]
             counts = [sum(c[s] for c in per)
                       for s in range(self.nseg)] if per else [0] * self.nseg
         else:
-            counts = self.store.segment_rowcounts(table)
+            counts = self.store.segment_rowcounts(table, snap)
         cap = self.scan_cap_override.get(table)
         if cap is not None:
             counts = [min(c, cap) for c in counts]
         return counts
+
+    def _bucket_cap(self, table: str, cap: int) -> int:
+        """Round a scan capacity up to its pow2 bucket: a DML that stays
+        within the bucket compiles to the SAME program shape, so the
+        executor's executable cache survives manifest-version bumps
+        (docs/PERF.md "plan cache"). Spill chunk overrides are exact pass
+        boundaries — growing them would double-read rows across passes."""
+        if table in self.scan_cap_override:
+            return max(cap, 1)
+        return _pow2(max(cap, 1))
 
     def _collect_scans(self, plan: Plan):
         if isinstance(plan, Scan):
@@ -420,6 +576,7 @@ class Compiler:
                 cap = max(counts[ds], 1)
             else:
                 cap = max(max(counts, default=0), 1)
+            cap = self._bucket_cap(plan.table, cap)
             self.scan_caps[plan.table] = max(self.scan_caps.get(plan.table, 0), cap)
             self.scan_cols.setdefault(plan.table, set()).update(c.name for c in plan.cols)
             # direct dispatch only holds if EVERY scan of the table agrees
@@ -451,8 +608,9 @@ class Compiler:
         if isinstance(plan, Scan):
             if plan.table in self.scan_caps:
                 return self.scan_caps[plan.table]
-            return max(max(self._seg_counts(plan.table, plan.parts),
-                           default=0), 1)
+            return self._bucket_cap(
+                plan.table,
+                max(self._seg_counts(plan.table, plan.parts), default=0))
         if isinstance(plan, (Filter, Project, Sort, Window)):
             return self._capacity_of(plan.child)
         if isinstance(plan, Limit):
@@ -467,7 +625,9 @@ class Compiler:
             if getattr(plan, "multi", False) and plan.kind in ("inner", "left"):
                 if self._nid(plan) in self.cap_overrides:
                     # exact cardinality reported by the overflowed run
-                    return max(int(self.cap_overrides[self._nid(plan)]), 64)
+                    # (pow2 bucket: shape-stable across small DML)
+                    return _pow2(max(int(self.cap_overrides[self._nid(plan)]),
+                                     64))
                 # CSR expansion output capacity from the (stats-driven)
                 # cardinality estimate; est_rows is CLUSTER-GLOBAL, the
                 # batch is per segment — divide by width for partitioned
@@ -477,7 +637,7 @@ class Compiler:
                         and self.nseg > 1:
                     est /= self.nseg
                 base = max(int(est) + 64, probe_cap // 4)
-                return int(base * (4 ** self.tier)) + 64
+                return _pow2(base) * (4 ** self.tier)
             return probe_cap
         if isinstance(plan, Aggregate):
             if not plan.group_keys:
@@ -493,10 +653,11 @@ class Compiler:
             # an exact-count retry tightens it after overflow
             child_cap = self._capacity_of(plan.child)
             if self._nid(plan) in self.cap_overrides:
-                return min(max(int(self.cap_overrides[self._nid(plan)]), 64),
+                return min(_pow2(max(int(self.cap_overrides[self._nid(plan)]),
+                                     64)),
                            child_cap)
             est = int(max(plan.est_rows, 16.0) * 1.3) + 64
-            return min(est * (4 ** self.tier), child_cap)
+            return min(_pow2(est) * (4 ** self.tier), child_cap)
         if isinstance(plan, PartialState):
             return self._capacity_of(plan.child)
         if isinstance(plan, Union):
@@ -512,7 +673,7 @@ class Compiler:
 
     def _motion_bucket(self, child_cap: int) -> int:
         c = int(child_cap * self.s.motion_capacity_slack / self.nseg) + 64
-        c *= 4 ** self.tier
+        c = _pow2(c) * (4 ** self.tier)
         return min(c, child_cap)
 
     def _dense_domains(self, plan: Aggregate) -> list[int] | None:
@@ -778,6 +939,27 @@ class Compiler:
 
         return run
 
+    def _join_multi_expand_cap(self, plan: Join) -> int:
+        """Semi/anti multi-join pair-EXPANSION capacity: the output is
+        probe-shaped (_capacity_of), but the matched-pair expansion needs
+        its own slot count — the exact-total retry hint, else the
+        planner's stats-driven pair estimate (|L||R|/NDV), else a blind
+        multiple of the probe capacity. pow2-bucketed for shape-stable
+        executable reuse (shape_signature walks this too)."""
+        probe_cap0 = self._capacity_of(plan.left)
+        if self._nid(plan) in self.cap_overrides:
+            out_cap = _pow2(max(int(self.cap_overrides[self._nid(plan)]), 64))
+        else:
+            est = getattr(plan, "expand_est", None)
+            if est:
+                if plan.locus is not None and plan.locus.is_partitioned \
+                        and self.nseg > 1:
+                    est /= self.nseg
+                out_cap = _pow2(int(est * 1.5) + 64)
+            else:
+                out_cap = _pow2(probe_cap0 * 2 + 64)
+        return int(out_cap * (4 ** self.tier))
+
     def _c_join_multi(self, plan: Join):
         """Duplicate-capable join via CSR expansion: inner/left emit the
         matched pairs; semi/anti reduce the pairs back to PROBE rows with
@@ -790,23 +972,7 @@ class Compiler:
         build_cap = self._capacity_of(plan.right)
         M = self._join_table_size(build_cap)
         if plan.kind in ("semi", "anti"):
-            # output is probe-shaped (_capacity_of); the pair EXPANSION
-            # needs its own capacity: the exact-total retry hint, else the
-            # planner's stats-driven pair estimate (|L||R|/NDV), else a
-            # blind multiple of the probe capacity
-            probe_cap0 = self._capacity_of(plan.left)
-            if self._nid(plan) in self.cap_overrides:
-                out_cap = max(int(self.cap_overrides[self._nid(plan)]), 64)
-            else:
-                est = getattr(plan, "expand_est", None)
-                if est:
-                    if plan.locus is not None and plan.locus.is_partitioned \
-                            and self.nseg > 1:
-                        est /= self.nseg
-                    out_cap = int(est * 1.5) + 64
-                else:
-                    out_cap = probe_cap0 * 2 + 64
-            out_cap = int(out_cap * (4 ** self.tier))
+            out_cap = self._join_multi_expand_cap(plan)
         else:
             out_cap = self._capacity_of(plan)
         probes = self._join_probes()
@@ -1590,3 +1756,20 @@ def _collect_dict_refs(plan: Plan, out: dict):
             out[c.id] = c.dict_ref
     for ch in plan.children:
         _collect_dict_refs(ch, out)
+
+
+def _collect_value_dict_refs(v, out: dict):
+    """Dictionary refs reachable from an arbitrary plan-node field value:
+    expression trees carry them as ``_dict_ref`` attributes (hash LUTs,
+    sort-rank LUTs bake that dictionary's CONTENT into the program),
+    ColInfos as their ``dict_ref`` field. Feeds shape_signature."""
+    if isinstance(v, E.Expr):
+        for n in E.walk(v):
+            d = getattr(n, "_dict_ref", None)
+            if d is not None:
+                out[("expr", id(n))] = tuple(d)
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            _collect_value_dict_refs(x, out)
+    elif getattr(v, "dict_ref", None) is not None:
+        out[("ci", id(v))] = tuple(v.dict_ref)

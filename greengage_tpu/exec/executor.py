@@ -16,15 +16,18 @@ Gather Motion receive (nodeMotion.c:378) in one place:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
 
 import jax
 
+from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.exec import staging
-from greengage_tpu.exec.compile import VALID_PREFIX, Compiler, CompileResult
+from greengage_tpu.exec.compile import (VALID_PREFIX, Compiler, CompileResult,
+                                        _pow2)
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.runtime import interrupt
@@ -165,8 +168,15 @@ class Executor:
         # registry (storage/blockcache.py): bounded within a manifest
         # version, evicted by recency against scan_cache_limit_mb
         self._stage_cache = store.blockcache.cache("stage")
-        # (cache_key, version, tier, fused_disabled) -> CompileResult
-        self._plan_cache: dict = {}
+        # compiled-program cache (the gang-reuse analog), REAL LRU:
+        # (statement signature, shape signature, fused_disabled) ->
+        # CompileResult. The shape signature (Compiler.shape_signature)
+        # captures everything the trace reads — bucketed capacities,
+        # dictionary fingerprints, consts digest, param dtypes — so a
+        # manifest-version bump that stays inside every capacity bucket
+        # and grows no dictionary REUSES the hot XLA executable instead
+        # of recompiling. Bounded by the plan_cache_size GUC.
+        self._plan_cache: OrderedDict = OrderedDict()
         # statements whose fused pallas kernel failed to lower on this
         # backend: later runs skip the pallas attempt entirely instead of
         # paying a failed compile + XLA recompile every execution
@@ -177,8 +187,13 @@ class Executor:
         # expansion totals, agg group counts, gather live rows) persist
         # per statement, so after DML bumps the manifest version the NEXT
         # compile sizes those capacities right instead of re-discovering
-        # them through overflow-retry recompiles. cache_key -> {nid: cap}
-        self._cap_hints: dict = {}
+        # them through overflow-retry recompiles. cache_key -> {nid: cap},
+        # LRU (recency = last record OR last use) under a fixed backstop
+        # bound; the primary lifetime tie is _on_program_evicted
+        self._cap_hints: OrderedDict = OrderedDict()
+        # memoized shape signatures (see the dispatch loop in run());
+        # insertion-order bounded — entries for dead versions age out
+        self._sig_memo: OrderedDict = OrderedDict()
 
     # ------------------------------------------------------------------
     def run(self, plan, consts: dict, out_cols, cache_key=None,
@@ -192,6 +207,11 @@ class Executor:
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
         hints = dict(self._cap_hints.get(cache_key) or {})
+        if hints:
+            try:
+                self._cap_hints.move_to_end(cache_key)
+            except KeyError:
+                pass   # concurrent statement evicted it; `hints` is ours
         cap_overrides: dict = dict(hints)
         pack_disabled: set = set()
         fused_disabled = cache_key is not None and cache_key in self._fused_failed
@@ -213,6 +233,9 @@ class Executor:
         last_err = None
         tier = 0
         attempts = 0
+        # hoisted-literal parameter vector (sql/paramize.py): values feed
+        # the program as traced inputs and resolve pushed prune predicates
+        pvec = (consts or {}).get("@params@")
         # tiers grow capacities; a key-packing bounds violation (stale
         # ANALYZE stats) instead re-runs the SAME tier unpacked, so the
         # attempt bound covers both kinds of retry
@@ -226,40 +249,95 @@ class Executor:
             # fused_disabled programs cache under their own key: a backend
             # that can't lower the pallas kernel still gets gang reuse of
             # the working XLA fallback program (advisor r3). Feedback
-            # hints are deterministic inputs, so hint-sized programs cache
-            # under their hint signature; only RUNTIME overrides (an
+            # hints are deterministic inputs folded into the shape
+            # signature (they size capacities); only RUNTIME overrides (an
             # overflow retry in flight) disable caching.
-            ck = ((cache_key, version, tier, fused_disabled,
-                   tuple(sorted(hints.items())))
-                  if cache_key is not None
-                  and cap_overrides == hints and not instrument
-                  and not scan_cap_override and not row_ranges
-                  and not aux_tables and not pack_disabled else None)
-            was_cached = ck is not None and ck in self._plan_cache
+            ck = None
+            sig_comp = None
+            if cache_key is not None and cap_overrides == hints \
+                    and not instrument and not scan_cap_override \
+                    and not row_ranges and not aux_tables \
+                    and not pack_disabled:
+                # signature memo: the digest is a pure function of these
+                # inputs (seg counts and dictionary growth always bump the
+                # manifest version; the bound plan is version-keyed in the
+                # session cache), so steady-state program-cache hits skip
+                # the whole-plan signature walk
+                mk = (cache_key, version, tier,
+                      tuple(sorted(cap_overrides.items())),
+                      fused_disabled, no_direct,
+                      Compiler.codegen_settings_sig(self.settings))
+                sig = self._sig_memo.get(mk)
+                if sig is None:
+                    try:
+                        sig_comp = Compiler(self.catalog, self.store,
+                                            self.mesh, self.nseg, consts,
+                                            self.settings, tier=tier,
+                                            cap_overrides=cap_overrides,
+                                            multihost=self.multihost is not None,
+                                            fused_disabled=fused_disabled,
+                                            no_direct=no_direct)
+                        sig = sig_comp.shape_signature(plan, snapshot)
+                        self._sig_memo[mk] = sig
+                        while len(self._sig_memo) > 2048:
+                            try:
+                                self._sig_memo.popitem(last=False)
+                            except KeyError:
+                                break
+                    except Exception:
+                        # unsignable shape (e.g. evicted transient raw
+                        # dict): compile uncached; counted so a signature
+                        # bug shows up as a visible reuse regression, not
+                        # silence
+                        counters.inc("program_cache_unsignable")
+                        sig, sig_comp = None, None
+                if sig is not None:
+                    ck = (cache_key, sig, fused_disabled)
+            # single fetch: a concurrent statement's eviction between a
+            # membership test and the read must not KeyError (threaded
+            # SQL server; the value object stays alive once fetched)
+            comp = self._plan_cache.get(ck) if ck is not None else None
+            was_cached = comp is not None
+            compile_ms = 0.0
             if was_cached:
-                comp = self._plan_cache[ck]
+                try:
+                    self._plan_cache.move_to_end(ck)
+                except KeyError:
+                    pass
+                counters.inc("program_cache_hit")
             else:
-                comp = Compiler(self.catalog, self.store, self.mesh, self.nseg,
-                                consts, self.settings, tier=tier,
-                                cap_overrides=cap_overrides,
-                                instrument=instrument,
-                                multihost=self.multihost is not None,
-                                scan_cap_override=scan_cap_override,
-                                aux_tables=aux_tables,
-                                pack_disabled=pack_disabled,
-                                fused_disabled=fused_disabled,
-                                no_direct=no_direct).compile(plan)
                 if ck is not None:
-                    # gang-reuse analog: keep the compiled SPMD program for
-                    # repeated dispatch of the same statement; drop programs
-                    # compiled against older manifest versions, and bound
-                    # the cache (each entry pins an XLA executable)
-                    for stale in [k for k in self._plan_cache
-                                  if k[0] == cache_key and k[1] != version]:
-                        del self._plan_cache[stale]
+                    counters.inc("program_cache_miss")
+                t_comp = time.monotonic()
+                if sig_comp is not None:
+                    # reuse the signature walk's Compiler (same args by
+                    # construction on this branch: the cacheable gate above
+                    # pins instrument/overrides/aux off)
+                    comp = sig_comp.compile(plan)
+                else:
+                    comp = Compiler(self.catalog, self.store, self.mesh,
+                                    self.nseg, consts, self.settings,
+                                    tier=tier, cap_overrides=cap_overrides,
+                                    instrument=instrument,
+                                    multihost=self.multihost is not None,
+                                    scan_cap_override=scan_cap_override,
+                                    aux_tables=aux_tables,
+                                    pack_disabled=pack_disabled,
+                                    fused_disabled=fused_disabled,
+                                    no_direct=no_direct).compile(plan)
+                compile_ms = (time.monotonic() - t_comp) * 1e3
+                if ck is not None:
+                    # keep the compiled SPMD program for repeated dispatch
+                    # of the same statement shape; LRU-bounded (each entry
+                    # pins an XLA executable), with cap-hint / fused-failed
+                    # bookkeeping evicted alongside the last program of a
+                    # statement (unbounded-growth fix, ISSUE 5)
                     self._plan_cache[ck] = comp
-                    if len(self._plan_cache) > 128:
-                        self._plan_cache.pop(next(iter(self._plan_cache)))
+                    limit_n = max(int(getattr(self.settings,
+                                              "plan_cache_size", 128)), 1)
+                    while len(self._plan_cache) > limit_n:
+                        old_k, _old = self._plan_cache.popitem(last=False)
+                        self._on_program_evicted(old_k)
             limit = effective_limit_bytes(self.settings)
             if limit and comp.est_bytes > limit:
                 if deferred:
@@ -327,7 +405,11 @@ class Executor:
             # I/O counter deltas this statement caused
             io0 = {k: counters.get(k) for k in SCAN_COUNTERS}
             t_stage = time.monotonic()
-            inputs = self._stage(comp, snapshot)
+            inputs = self._stage(comp, snapshot, pvec)
+            if comp.param_dtypes:
+                inputs = list(inputs) + [
+                    self._put_param(np.asarray([v], dtype=dt))
+                    for v, dt in zip(pvec.values, comp.param_dtypes)]
             t_compute = time.monotonic()
             stage_ms = (t_compute - t_stage) * 1e3
             scan_io = {k: counters.get(k) - io0[k] for k in SCAN_COUNTERS}
@@ -359,6 +441,10 @@ class Executor:
                 if cache_key is not None:
                     self._fused_failed.add(cache_key)
                 if ck is not None:
+                    # plain pop, NOT _on_program_evicted: that would discard
+                    # the fused-failed memo just recorded; the retry below
+                    # immediately caches the unfused program for this
+                    # statement, re-tying the bookkeeping to a live entry
                     self._plan_cache.pop(ck, None)
                 continue
             t_fetch = time.monotonic()
@@ -388,14 +474,21 @@ class Executor:
                 # record identical hints and stay in lockstep
                 if cache_key is not None and comp.flag_caps:
                     rec = self._cap_hints.setdefault(cache_key, {})
+                    try:
+                        self._cap_hints.move_to_end(cache_key)
+                    except KeyError:
+                        pass   # concurrent eviction between setdefault/move
                     for _f, (nid, metric) in comp.flag_caps.items():
                         if metric in metrics:
                             need = (int(metrics[metric].flat[0])
                                     if self.multihost
                                     else int(np.max(metrics[metric])))
-                            rec[nid] = need + max(need // 16, 64)
-                    if len(self._cap_hints) > 512:
-                        self._cap_hints.pop(next(iter(self._cap_hints)))
+                            # pow2 bucket: small data drift re-records the
+                            # SAME hint, so hint-sized programs keep their
+                            # executable-cache entry across DML
+                            rec[nid] = _pow2(need + max(need // 16, 64))
+                    while len(self._cap_hints) > 512:
+                        self._cap_hints.popitem(last=False)
                 if deferred:
                     # parallel retrieve cursor: the program already ran and
                     # every segment's shard is on the host — finalization
@@ -403,9 +496,16 @@ class Executor:
                     return EndpointBatch(comp, flat, snapshot, raw, self.nseg)
                 res = self._finalize(comp, flat, snapshot, raw=raw)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
+                if not was_cached:
+                    # the first dispatch of a fresh program carries the
+                    # XLA compile; fold it into the statement's compile
+                    # cost (EXPLAIN ANALYZE "Plan cache" line, bench)
+                    compile_ms += compute_ms
+                    counters.inc("compile_ms", int(compile_ms))
                 res.stats = {
                     "tiers_used": tier + 1,
                     "compiled": not was_cached,
+                    "compile_ms": round(compile_ms, 1),
                     # host-data-path breakdown of the SUCCESSFUL attempt
                     "stage_ms": round(stage_ms, 2),
                     "compute_ms": round(compute_ms, 2),
@@ -492,7 +592,68 @@ class Executor:
             self.multihost.local_segments = local_segment_positions()
         return set(s for s in self.multihost.local_segments if s < self.nseg)
 
-    def _stage(self, comp: CompileResult, snapshot) -> list:
+    def _on_program_evicted(self, key) -> None:
+        """A compiled program left the LRU: when it was the LAST program
+        of its statement, drop the statement's cap-hint and fused-failed
+        bookkeeping too — their lifetime is tied to the plan cache
+        (unbounded-growth fix, ISSUE 5)."""
+        cache_key = key[0]
+        # snapshot: a concurrent statement's insert/evict must not break
+        # the membership scan (threaded SQL server)
+        if any(k[0] == cache_key for k in list(self._plan_cache)):
+            return
+        self._cap_hints.pop(cache_key, None)
+        self._fused_failed.discard(cache_key)
+
+    def invalidate_table(self, table: str) -> None:
+        """Drop compiled programs scanning ``table`` (DROP TABLE / DROP
+        PARTITION): a same-named recreated table could otherwise alias a
+        stale executable whose shape signature coincides."""
+        base = table.split("#", 1)[0]
+        # snapshot + pop(None): concurrent statements mutate the LRU
+        stale = [k for k, c in list(self._plan_cache.items())
+                 if any(t == table or t.split("#", 1)[0] == base
+                        for t, *_ in c.input_spec)]
+        for k in stale:
+            self._plan_cache.pop(k, None)
+        for k in stale:
+            self._on_program_evicted(k)
+
+    @staticmethod
+    def _resolve_prune(prune, pvec):
+        """Substitute hoisted-parameter operands in pushed zone-map prune
+        predicates with the statement's CURRENT values (planner
+        _param_value / sql/paramize.resolve_param_value): pruning stays
+        value-exact while the compiled program stays value-generic."""
+        if not prune or not any(isinstance(v, E.Expr) for _, _, v in prune):
+            return prune
+        from greengage_tpu.sql.paramize import resolve_param_value
+
+        out = []
+        for col, op, v in prune:
+            if isinstance(v, E.Expr):
+                if pvec is None:
+                    continue   # no vector bound: skip only this predicate
+                val = resolve_param_value(v, pvec)
+                v = (float(val) if isinstance(val, (float, np.floating))
+                     else int(val))
+            out.append((col, op, v))
+        return tuple(out)
+
+    def _put_param(self, host: np.ndarray):
+        """Place one parameter scalar on the mesh, replicated (multi-host:
+        every process binds the same values from the same statement text,
+        keeping the lockstep invariant)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P())
+        if self.multihost is None:
+            return jax.device_put(host, sh)
+        return jax.make_array_from_callback(host.shape, sh,
+                                            lambda idx: host[idx])
+
+    def _stage(self, comp: CompileResult, snapshot, pvec=None) -> list:
         """Pipelined input staging (exec/staging.py, docs/PERF.md): submit
         every (table, segment) read+decode unit of the WHOLE input spec to
         the staging pool first, then assemble tables in spec order into
@@ -529,6 +690,15 @@ class Executor:
         plans = []   # [kind, table, cols, cap, key, prune, payload]
         staged_local: dict = {}   # key -> (staged, pstats) THIS statement
         for table, cols, cap, direct, prune, child_parts, dyn in comp.input_spec:
+            # hoisted parameters resolve HERE — staging decisions (zone
+            # maps, block indexes, dynamic partition pruning) see the
+            # statement's current values, and the stage-cache key below
+            # carries the resolved predicate so different values never
+            # share a pruned staging
+            prune = self._resolve_prune(prune, pvec)
+            if dyn is not None and isinstance(dyn, tuple):
+                dyn = (dyn[0], self._resolve_prune(dyn[1], pvec) or (),
+                       dyn[2])
             if table in aux:
                 plans.append(("aux", table, cols, cap, None, None, None))
                 continue

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import csv as _csv
 import os
+import threading as _threading
 import time
 from contextlib import contextmanager as _contextmanager
 
@@ -118,7 +119,24 @@ class Database:
         # and the block-cache registry reads scan_cache_limit_mb live
         self.store.settings = self.settings
         self.store.blockcache.settings = self.settings
-        self._select_cache: dict = {}
+        # persistent XLA compilation cache (docs/PERF.md warm-cache note):
+        # wired HERE from the xla_cache_dir GUC instead of relying on the
+        # ambient environment; an explicit GGTPU_XLA_CACHE env (incl. "0"
+        # = off) still wins for operators who set it
+        self._apply_xla_cache_dir()
+        # bound-plan LRU (plancache.c analog): (statement signature,
+        # manifest version) -> (planned, consts, outs, exec_key, param
+        # types). Literal-parameterized keys via sql/paramize.py; bounded
+        # by the plan_cache_size GUC (_cached_plan)
+        from collections import OrderedDict as _OD
+
+        self._select_cache: dict = _OD()
+        # per-thread (threaded SQL server): see the _plan_cache_info property
+        self._pc_info_local = _threading.local()
+        # statement signatures the binder proved unparameterizable: later
+        # literal variants of the shape skip the doomed normalized bind
+        # and go straight to the value-pinned plan (bounded backstop)
+        self._paramize_fallback: set = set()
         self.mesh = make_mesh(numsegments, devs)
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings,
@@ -188,6 +206,58 @@ class Database:
                     multihost.channel.start_heartbeat()
                 except Exception as e:
                     self.log.error("multihost", f"heartbeat start failed: {e}")
+
+    def _apply_xla_cache_dir(self) -> None:
+        """Arm jax's persistent compilation cache from the xla_cache_dir
+        GUC (per-platform subdirs keep TPU/CPU AOT entries apart — mixed
+        entries trip feature-mismatch loads). No-op when the operator set
+        GGTPU_XLA_CACHE explicitly (the import-time default honored it)."""
+        if os.environ.get("GGTPU_XLA_CACHE") is not None:
+            return
+        path = (getattr(self.settings, "xla_cache_dir", "") or "").strip()
+        if not path:
+            return
+        plat = (os.environ.get("GGTPU_PLATFORM")
+                or os.environ.get("JAX_PLATFORMS") or "default")
+        full = os.path.join(os.path.expanduser(path), plat)
+        try:
+            import jax
+
+            if getattr(jax.config, "jax_compilation_cache_dir", None) != full:
+                jax.config.update("jax_compilation_cache_dir", full)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.2)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception as e:
+            self.settings_warnings.append(f"xla_cache_dir not applied: {e}")
+            return
+        self._prune_xla_cache(full)
+
+    def _prune_xla_cache(self, full: str) -> None:
+        """jax's persistent cache (0.4.x) never evicts, and with
+        min_entry_size=0 nearly every program persists — without a bound a
+        long-lived workstation grows ~/.cache/ggtpu_xla indefinitely.
+        Evict oldest-used first until under xla_cache_limit_mb."""
+        limit_mb = int(getattr(self.settings, "xla_cache_limit_mb", 2048))
+        if limit_mb <= 0:
+            return
+        try:
+            with os.scandir(full) as it:
+                ents = [(e.path, e.stat()) for e in it if e.is_file()]
+        except OSError:
+            return
+        total = sum(st.st_size for _p, st in ents)
+        if total <= limit_mb * (1 << 20):
+            return
+        ents.sort(key=lambda ps: max(ps[1].st_atime, ps[1].st_mtime))
+        for p, st in ents:
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            total -= st.st_size
+            if total <= limit_mb * (1 << 20):
+                break
 
     @property
     def dtm(self):
@@ -822,6 +892,9 @@ class Database:
                 if touched:
                     self.store.manifest.commit_tx(tx)
                 self.store._invalidate_dicts(stmt.name)
+                # compiled programs scanning this table must not survive a
+                # same-named recreate (the shape signature could coincide)
+                self.executor.invalidate_table(stmt.name)
                 import shutil
 
                 for st in storage:
@@ -875,8 +948,10 @@ class Database:
                 # wake blocked waiters: a lowered/disabled cap must admit
                 # them now, not at their timeout
                 self.resgroups.kick()
-            if stmt.name == "optimizer":
-                self._select_cache.clear()   # planner selection changed
+            if stmt.name in ("optimizer", "plan_cache_params"):
+                # planner selection / literal-hoisting changed: cached
+                # bound plans were produced under the other regime
+                self._select_cache.clear()
             return "SET"
         if isinstance(stmt, A.ResourceGroupStmt):
             return self._resource_group(stmt)
@@ -1161,6 +1236,19 @@ class Database:
                              force_multi_join=force_multi_join)
         if info is not None:
             info["memo_used"] = binder.memo_used
+        # content digest of the LUT pool, computed once per bind: part of
+        # the executor's executable-reuse shape signature (the compiled
+        # program bakes these arrays)
+        import hashlib
+
+        h = hashlib.sha1()
+        for k in sorted(binder.consts):
+            a = np.asarray(binder.consts[k])
+            h.update(k.encode())
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        binder.consts["@consts_digest@"] = h.hexdigest()[:16]
         return planned, binder.consts, outs
 
     def _const_select(self, stmt: A.SelectStmt) -> Result:
@@ -1390,28 +1478,124 @@ class Database:
         return [{"cursor": cursor, "endpoint": k,
                  "state": "READY"} for k in range(batch.nendpoints)]
 
+    @property
+    def _plan_cache_info(self) -> dict:
+        """Last _cached_plan outcome, PER THREAD: concurrent statements on
+        the threaded SQL server must not clobber each other's plan-cache
+        reporting (Result.stats["plan_cache"], EXPLAIN ANALYZE)."""
+        return getattr(self._pc_info_local, "info", {})
+
+    @_plan_cache_info.setter
+    def _plan_cache_info(self, info: dict) -> None:
+        self._pc_info_local.info = info
+
+    @staticmethod
+    def _attach_params(consts, pv, ptypes, info):
+        """Bind the statement's CURRENT hoisted values into a fresh consts
+        dict (the cached plan's pool is shared across statements); shared
+        tail of _cached_plan's hit and miss paths."""
+        from greengage_tpu.sql.paramize import ParamVector
+
+        consts = dict(consts)
+        if ptypes is not None:
+            consts["@params@"] = ParamVector(pv.values, ptypes)
+            _counters.inc("params_hoisted", len(pv.values))
+        else:
+            info["params"] = 0
+        return consts
+
     def _cached_plan(self, stmt, force_multi_join: bool = False):
         """Memoized planning for SELECT-shaped statements (plain SELECT
-        and the DECLARE CURSOR body). Cache key: structural statement
-        identity (dataclass repr is deep + deterministic) + manifest
-        version (bound plans embed dictionary codes/LUTs, which can grow
-        with new data). A force_multi_join re-plan is remembered under the
-        PLAIN key so repeats skip the failing unique-join program.
+        and the DECLARE CURSOR body) — the plancache.c prepared-statement
+        role. Plan-safe literals are hoisted into a parameter vector
+        (sql/paramize.py) and the cache key becomes the literal-STRIPPED
+        statement signature plus the hoisted literals' exact types:
+        `WHERE x > 5` and `WHERE x > 6` share one bound plan and, through
+        the executor's program cache, one XLA executable. Unsafe literals
+        (partition keys, distribution-key equality, strings, LIMIT) stay
+        pinned in the key so planning-relevant values never silently
+        generalize. The key also carries the manifest version (bound
+        plans embed dictionary codes/LUTs that grow with data); the
+        executor's SHAPE signature decides executable reuse across
+        versions. A force_multi_join re-plan is remembered under the
+        PLAIN key so repeats skip the failing unique-join program. Real
+        LRU, bounded by the plan_cache_size GUC.
         -> (planned, consts, outs, exec_key)."""
-        stmt_key = repr(stmt)
-        key = (stmt_key, self.store.manifest.snapshot().get("version", 0))
-        if force_multi_join:
-            cached = (*self._plan(stmt, force_multi_join=True),
-                      stmt_key + "#multi")
-            self._select_cache[key] = cached
-            return cached
-        cached = self._select_cache.get(key)
-        if cached is None:
-            cached = (*self._plan(stmt), stmt_key)
-            self._select_cache[key] = cached
-            if len(self._select_cache) > 256:
-                self._select_cache.pop(next(iter(self._select_cache)))
-        return cached
+        from greengage_tpu.sql.paramize import ParamVector, paramize
+
+        version = self.store.manifest.snapshot().get("version", 0)
+        norm, pv, sig = (paramize(stmt, self.catalog)
+                         if self.settings.plan_cache_params
+                         else (stmt, None, None))
+        if sig is not None and sig in self._paramize_fallback:
+            # this shape is known-unparameterizable: plan value-pinned
+            # directly instead of re-paying the doomed normalized bind
+            # for every new literal combination
+            norm, pv, sig = stmt, None, None
+        info = {"hit": False, "params": 0, "fallback": False}
+        self._plan_cache_info = info
+        if pv is not None:
+            info["params"] = len(pv.values)
+        key_sig = sig if sig is not None else repr(stmt)
+        key = (key_sig, version)
+        cache = self._select_cache
+        if not force_multi_join:
+            hit = cache.get(key)
+            if hit is None and sig is not None:
+                # this shape previously fell back to a value-pinned plan
+                # (binder cannot parameterize it): look it up under the
+                # full repr so the fallback is paid once, not per call
+                fbk = (repr(stmt), version)
+                fb = cache.get(fbk)
+                if fb is not None and fb[4] is None:
+                    key, hit = fbk, fb
+            if hit is not None:
+                try:
+                    cache.move_to_end(key)
+                except KeyError:
+                    pass   # concurrent statement evicted it; `hit` is ours
+                _counters.inc("plan_cache_hit")
+                info["hit"] = True
+                planned, consts, outs, ek, ptypes = hit
+                return planned, self._attach_params(consts, pv, ptypes,
+                                                    info), outs, ek
+        _counters.inc("plan_cache_miss")
+        ptypes = pv.types if (pv is not None and norm is not stmt) else None
+        try:
+            planned, consts, outs = self._plan(
+                norm, force_multi_join=force_multi_join)
+        except (SqlError, NotImplementedError, TypeError):
+            if ptypes is None:
+                raise
+            # a shape the binder cannot parameterize (raw-text predicates,
+            # exotic coercions): pin every value and re-plan classically —
+            # a genuine user error surfaces identically from the re-plan.
+            # Memoize the signature so later literal variants of the shape
+            # skip the doomed normalized bind entirely
+            _counters.inc("plan_cache_fallback")
+            info.update(fallback=True, params=0)
+            if len(self._paramize_fallback) > 1024:
+                self._paramize_fallback.clear()
+            self._paramize_fallback.add(key_sig)
+            ptypes = None
+            key_sig = repr(stmt)
+            key = (key_sig, version)
+            planned, consts, outs = self._plan(
+                stmt, force_multi_join=force_multi_join)
+        ek = key_sig + ("#multi" if force_multi_join else "")
+        cache[key] = (planned, consts, outs, ek, ptypes)
+        try:
+            cache.move_to_end(key)
+        except KeyError:
+            pass
+        bound = max(int(getattr(self.settings, "plan_cache_size", 256)), 1)
+        while len(cache) > bound:
+            try:
+                cache.popitem(last=False)
+            except KeyError:   # concurrent statement emptied it
+                break
+        return planned, self._attach_params(consts, pv, ptypes,
+                                            info), outs, ek
 
     def _select(self, stmt: A.SelectStmt) -> Result:
         rctes = getattr(stmt, "_recursive_ctes", None)
@@ -1437,12 +1621,14 @@ class Database:
                     # screen above keeps InitPlan subqueries from running
                     # twice for the COMMON fallthrough shapes
         planned, consts, outs, exec_key = self._cached_plan(stmt)
+        pc_info = self._plan_cache_info
         # external tables materialize to host arrays before execution
         # (fileam external_beginscan role); first-seen strings grow the
         # dictionary, so the bound plan refreshes afterwards
         aux, dirty = self._load_external_aux(planned)
         if dirty:
             planned, consts, outs, exec_key = self._cached_plan(stmt)
+            pc_info = self._plan_cache_info
         # resource-queue admission (ResLockPortal analog): bound concurrent
         # mesh statements; excess statements queue or time out. Multi-host
         # admission happens on the COORDINATOR before the broadcast (a
@@ -1455,6 +1641,8 @@ class Database:
                 res = self.executor.run(planned, consts, outs,
                                         cache_key=exec_key,
                                         aux_tables=aux or None)
+                if isinstance(res.stats, dict):
+                    res.stats["plan_cache"] = dict(pc_info)
                 self._record_stats(res)
                 return res
             except QueryError as e:
@@ -1468,6 +1656,8 @@ class Database:
                 res = self.executor.run(planned, consts, outs,
                                         cache_key=exec_key,
                                         aux_tables=aux or None)
+                if isinstance(res.stats, dict):
+                    res.stats["plan_cache"] = dict(self._plan_cache_info)
                 self._record_stats(res)
                 return res
 
@@ -1486,17 +1676,26 @@ class Database:
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, (A.SelectStmt, A.UnionStmt)):
             raise SqlError("EXPLAIN supports SELECT only")
-        info: dict = {}
-        planned, consts, outs = self._plan(stmt.query, info=info)
-        # report the planner that actually produced the join order (the
-        # memo bails without stats / on >10 rels / explicit JOIN syntax)
-        text = ("Optimizer: %s\n" % (
-            "memo (Cascades-lite)" if info.get("memo_used")
-            else "fallback (left-deep DP/greedy)")) + describe(planned)
+        text = ""
+        if not stmt.analyze:
+            info: dict = {}
+            planned, consts, outs = self._plan(stmt.query, info=info)
+            # report the planner that actually produced the join order (the
+            # memo bails without stats / on >10 rels / explicit JOIN syntax)
+            text = ("Optimizer: %s\n" % (
+                "memo (Cascades-lite)" if info.get("memo_used")
+                else "fallback (left-deep DP/greedy)")) + describe(planned)
         if stmt.analyze:
+            # ANALYZE goes through the plan cache (plancache exercise +
+            # reporting); the instrumented program itself never enters the
+            # executor's program cache, so compile_ms below is a real
+            # fresh-compile measurement
+            planned, consts, outs, _ek = self._cached_plan(stmt.query)
+            pc_info = dict(self._plan_cache_info)
             aux, dirty = self._load_external_aux(planned)
             if dirty:
-                planned, consts, outs = self._plan(stmt.query)
+                planned, consts, outs, _ek = self._cached_plan(stmt.query)
+                pc_info = dict(self._plan_cache_info)
             # per-node instrumentation (explain_gp.c's Instrumentation
             # tree analog): every operator reports its actual output rows
             res = self.executor.run(planned, consts, outs, instrument=True,
@@ -1505,6 +1704,10 @@ class Database:
             annot = {pid: f"actual rows={n}"
                      for pid, n in (s.get("node_rows") or {}).items()}
             text = describe(planned, annot=annot)
+            text += (f"\n Plan cache: {'hit' if pc_info.get('hit') else 'miss'}"
+                     f"{' (fallback: unparameterizable shape)' if pc_info.get('fallback') else ''}"
+                     f", {pc_info.get('params', 0)} params hoisted, "
+                     f"compile {s.get('compile_ms', 0)} ms")
             text += (
                 f"\n Execution time: {res.wall_ms:.2f} ms, rows: {len(res)}"
                 f"\n Segments: {s.get('segments')}, capacity tiers used: "
@@ -1914,6 +2117,7 @@ class Database:
         shutil.rmtree(os.path.join(self.path, "data", child),
                       ignore_errors=True)
         self._select_cache.clear()
+        self.executor.invalidate_table(stmt.table)
         self._post_commit()
         return "ALTER TABLE"
 

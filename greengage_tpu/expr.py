@@ -45,6 +45,20 @@ class Literal(Expr):
 
 
 @dataclass(frozen=True)
+class Param(Expr):
+    """A hoisted literal — a runtime parameter of the compiled program.
+
+    The paramize pass (sql/paramize.py) replaces plan-safe literals with
+    Params so one XLA executable serves every value of a query shape; the
+    executor feeds each slot's value as a traced scalar input. Params are
+    never NULL and never TEXT (string literals stay pinned: dictionary
+    codes and LIKE lowering are bind-time value rewrites)."""
+
+    slot: int
+    type: T.SqlType
+
+
+@dataclass(frozen=True)
 class BinOp(Expr):
     op: str            # + - * / %
     left: Expr

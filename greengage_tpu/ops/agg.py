@@ -20,6 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax.numpy as jnp
+import numpy as np
 
 BIG = jnp.iinfo(jnp.int32).max   # scatter-min identity (used by ops/join)
 
@@ -144,11 +145,16 @@ def _run_aggs(aggs: list[AggSpec], sel, seg_sum, seg_minmax):
                 out_vals[spec.name] = avg
                 out_valid[spec.name] = cnt > 0
         elif spec.func in ("min", "max"):
+            # the identity must stay HOST-concrete (numpy, not jnp): under a
+            # jit trace jnp.array() yields a tracer, and the fused kernel
+            # needs ident.item() for pad/scratch-init constants
             if vals.dtype.kind == "f":
-                ident = jnp.array(jnp.inf if spec.func == "min" else -jnp.inf, vals.dtype)
+                ident = np.array(np.inf if spec.func == "min" else -np.inf,
+                                 vals.dtype)
             else:
                 info = jnp.iinfo(vals.dtype)
-                ident = jnp.array(info.max if spec.func == "min" else info.min, vals.dtype)
+                ident = np.array(info.max if spec.func == "min" else info.min,
+                                 vals.dtype)
             filled = jnp.where(lv, vals, ident)
             out_vals[spec.name] = seg_minmax(filled, spec.func, ident)
             out_valid[spec.name] = live_count(spec) > 0

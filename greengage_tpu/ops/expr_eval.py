@@ -106,6 +106,22 @@ class Evaluator:
     def _eval_literal(self, e: E.Literal):
         return _lit_array(e, self.n)
 
+    def _eval_param(self, e: E.Param):
+        # hoisted literal (sql/paramize.py): read the slot's traced scalar
+        # input — the compiler stashes the per-slot (1,)-arrays under
+        # "@params@rt" at trace time, so ONE executable serves every value
+        rt = self.consts.get("@params@rt")
+        if rt is not None and e.slot in rt:
+            return jnp.broadcast_to(rt[e.slot][0], (self.n,)), None
+        # host path (no compiled program in play): bake the current value
+        vec = self.consts.get("@params@")
+        if vec is None:
+            raise RuntimeError(
+                f"parameter slot {e.slot} has no bound value (plan cache "
+                "entry executed without its parameter vector)")
+        return jnp.full((self.n,), vec.values[e.slot],
+                        dtype=e.type.np_dtype), None
+
     # ---- arithmetic ----------------------------------------------------
     def _eval_binop(self, e: E.BinOp):
         lv, lval = self.value(e.left)

@@ -71,18 +71,41 @@ def current_calibration() -> dict:
 set_calibration(None)   # establish NS_GATHER_ROW .. NS_HOST_CALL globals
 
 
+def _value_of(e):
+    """Estimation value of a comparison operand: a literal's value, or a
+    hoisted parameter's est_value (sql/paramize.py — the value the
+    statement that seeded the generic plan carried), unwrapping the
+    binder's numeric-coercion Cast. None when unknown."""
+    if isinstance(e, E.Literal):
+        return e.value
+    if isinstance(e, E.Param):
+        return getattr(e, "_est_value", None)
+    if isinstance(e, E.Cast) and isinstance(e.arg, E.Param):
+        v = getattr(e.arg, "_est_value", None)
+        if v is None:
+            return None
+        from greengage_tpu.sql.paramize import coerce_storage_value
+
+        try:
+            return coerce_storage_value(v, e.arg.type, e.type)
+        except Exception:
+            return None
+    return None
+
+
 def _col_and_lit(pred: E.Cmp):
-    """-> (col_id, literal value, op oriented col-op-lit) or None."""
+    """-> (col_id, literal/param value, op oriented col-op-lit) or None."""
     left, right, op = pred.left, pred.right, pred.op
     flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<="}
-    if isinstance(left, E.Literal) and isinstance(right, E.ColRef):
+    if _value_of(left) is not None and isinstance(right, E.ColRef):
         left, right, op = right, left, flip.get(op, op)
-    if isinstance(left, E.ColRef) and isinstance(right, E.Literal) \
-            and right.value is not None:
-        try:
-            return left.name, float(right.value), op
-        except (TypeError, ValueError):
-            return None
+    if isinstance(left, E.ColRef):
+        v = _value_of(right)
+        if v is not None:
+            try:
+                return left.name, float(v), op
+            except (TypeError, ValueError):
+                return None
     return None
 
 

@@ -29,6 +29,18 @@ from greengage_tpu.planner.logical import (
 )
 
 
+def _param_value(e) -> E.Expr | None:
+    """A comparison operand whose VALUE is a hoisted parameter — a bare
+    Param or the binder's numeric coercion Cast around one. The returned
+    expression is stored in the pushed prune predicate and resolved to a
+    concrete storage value at staging time (exec/executor._resolve_prune)."""
+    if isinstance(e, E.Param):
+        return e
+    if isinstance(e, E.Cast) and isinstance(e.arg, E.Param):
+        return e
+    return None
+
+
 class Planner:
     def __init__(self, catalog, store, numsegments: int, force_multi_join: bool = False):
         self.catalog = catalog
@@ -111,8 +123,23 @@ class Planner:
             if not isinstance(c, E.Cmp):
                 continue
             lhs, rhs, op = c.left, c.right, c.op
-            if isinstance(rhs, E.ColRef) and isinstance(lhs, E.Literal):
+            if isinstance(rhs, E.ColRef) and (isinstance(lhs, E.Literal)
+                                              or _param_value(lhs)):
                 lhs, rhs, op = rhs, lhs, flip.get(op, op)
+            # hoisted literal (sql/paramize.py): the pushed predicate
+            # carries the Param expression; the executor substitutes the
+            # statement's current value at STAGING time, so zone-map /
+            # block-index pruning stays value-exact while the compiled
+            # program stays value-generic
+            pp = _param_value(rhs)
+            if pp is not None and isinstance(lhs, E.ColRef) \
+                    and lhs.name in by_id \
+                    and op in ("=", "<", "<=", ">", ">=") \
+                    and lhs.type.kind in (T.Kind.INT32, T.Kind.INT64,
+                                          T.Kind.DATE, T.Kind.DECIMAL,
+                                          T.Kind.FLOAT64):
+                prune.append((by_id[lhs.name], op, pp))
+                continue
             if not (isinstance(lhs, E.ColRef) and isinstance(rhs, E.Literal)
                     and lhs.name in by_id):
                 continue
@@ -149,10 +176,13 @@ class Planner:
                 if d.get("column") in pruned_cols))
         if child.parts is not None and schema.is_partitioned:
             # static partition pruning from the same pushed conjuncts
-            # (plan-time half of nodePartitionSelector.c)
+            # (plan-time half of nodePartitionSelector.c); Param-valued
+            # predicates have no value yet and cannot prune partitions
+            # (paramize pins partition-key literals so this stays rare)
             child.parts_total = len(schema.partitions)
             keep = schema.prune_partitions(
-                [(c, op, v) for c, op, v in prune])
+                [(c, op, v) for c, op, v in prune
+                 if not isinstance(v, E.Expr)])
             name_keep = {schema.partitions[i].storage_name(child.table)
                          for i in keep}
             child.parts = tuple(p for p in child.parts if p in name_keep)

@@ -55,6 +55,22 @@ class Bool(ANode):
 
 
 @dataclass
+class ParamRef(ANode):
+    """A literal hoisted out of the statement by sql/paramize.py: the
+    binder lowers it to a typed expr.Param read from the statement's
+    parameter vector at execution. ``ptype`` is the exact SqlType the
+    original literal would have bound to — it stays in the cache key, so
+    only same-typed shapes share a plan. ``est_value`` carries the
+    hoisted value for ESTIMATION only (selectivity/capacity sizing —
+    the custom-plan seeding of a generic plan); it is excluded from repr
+    so the cache signature stays value-free."""
+
+    idx: int
+    ptype: object                 # types.SqlType
+    est_value: object = field(default=None, repr=False, compare=False)
+
+
+@dataclass
 class Star(ANode):
     table: str | None = None      # t.* or *
 

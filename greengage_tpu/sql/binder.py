@@ -1694,7 +1694,8 @@ class Binder:
                     return self._expr(ast, scope)
                 raise SqlError(
                     f'column "{".".join(ast.parts)}" must appear in GROUP BY')
-            if isinstance(ast, (A.Num, A.Str, A.Null, A.Bool, A.DateLit)):
+            if isinstance(ast, (A.Num, A.Str, A.Null, A.Bool, A.DateLit,
+                                A.ParamRef)):
                 return self._expr(ast, scope)
             clone = _ast_rebind(ast, lambda ch: self._rewritten_expr(
                 ch, rewrites, scope, allow_plain))
@@ -1719,6 +1720,15 @@ class Binder:
                 return E.Literal(T.decimal_to_int(ast.text, frac), T.decimal(frac))
             v = int(ast.text)
             return E.Literal(v, T.literal_type(v))
+        if isinstance(ast, A.ParamRef):
+            # hoisted literal (sql/paramize.py): typed slot read from the
+            # statement's parameter vector at execution; the hoisted value
+            # rides along for ESTIMATION only (planner/cost.py) — the
+            # generic plan is seeded by the statement that populated it
+            p = E.Param(ast.idx, ast.ptype)
+            if ast.est_value is not None:
+                object.__setattr__(p, "_est_value", ast.est_value)
+            return p
         if isinstance(ast, A.Str):
             return E.Literal(ast.value, T.TEXT)  # coerced by context
         if isinstance(ast, A.Null):

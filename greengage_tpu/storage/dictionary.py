@@ -23,9 +23,28 @@ class Dictionary:
     def __init__(self, values: list[str] | None = None):
         self.values: list[str] = list(values or [])
         self._index: dict[str, int] = {v: i for i, v in enumerate(self.values)}
+        self._digest: str | None = None
+        self._digest_len = -1
 
     def __len__(self) -> int:
         return len(self.values)
+
+    def fingerprint(self) -> str:
+        """Content digest for the executor's executable-cache shape
+        signature: compiled programs bake this dictionary's hash/rank LUTs,
+        so an executable is reusable only while the content is identical.
+        Dictionaries are append-only, which makes the cached digest
+        invalidatable by length alone."""
+        if self._digest is None or self._digest_len != len(self.values):
+            import hashlib
+
+            h = hashlib.sha1()
+            for v in self.values:
+                h.update(v.encode("utf-8", "surrogatepass"))
+                h.update(b"\x00")
+            self._digest = h.hexdigest()[:16]
+            self._digest_len = len(self.values)
+        return self._digest
 
     def encode(self, strings) -> np.ndarray:
         """Map strings -> int32 codes, appending unseen values."""
