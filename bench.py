@@ -122,13 +122,15 @@ order by revenue desc
 # parent: orchestration without ever touching a jax backend
 # ======================================================================
 
-def _kill_stale_clients() -> None:
+def _kill_stale_clients() -> int:
     """Kill leftover bench children from a previous (timed-out) round: the
     driver's `timeout` kills only the parent, orphaning children that still
     hold the chip client — exactly the state that wedges the next backend
     init. Identified by the GGTPU_BENCH_CHILD env marker or a bench.py
-    cmdline; never this process or its ancestors."""
+    cmdline; never this process or its ancestors. Returns the kill count
+    (recorded in the preflight's wedge report)."""
     me = os.getpid()
+    killed = 0
     ancestors = set()
     pid = me
     for _ in range(16):
@@ -163,32 +165,42 @@ def _kill_stale_clients() -> None:
             log(f"remediation: killing stale bench process {pid}: {cmd[:120]}")
             try:
                 os.kill(pid, signal.SIGKILL)
+                killed += 1
             except Exception:
                 pass
+    return killed
 
 
-def _spawn_child(args, timeout_s, headline_file=None, tag="child"):
+def _spawn_child(args, timeout_s, headline_file=None, tag="child",
+                 capture=None):
     """Run a child with its own process group and a hard deadline; stdout
-    is redirected to stderr (the parent owns the real stdout). Polls the
-    headline file while waiting and prints the headline the moment it
-    appears — a later driver kill can then never discard it.
+    is redirected to stderr (the parent owns the real stdout), or to
+    ``capture`` so the preflight can classify a wedge from the output.
+    Polls the headline file while waiting, caching the LATEST headline
+    (the child enriches it with Q3/Q5 once they complete), and prints it
+    when the child finishes — the parent's SIGTERM handler flushes the
+    cached line, so a driver kill still never discards it.
     -> (rc | None on timeout, headline_printed)."""
     env = dict(os.environ)
     env["GGTPU_BENCH_CHILD"] = "1"
     if headline_file:
         env["GGTPU_HEADLINE_FILE"] = headline_file
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)] + args,
-        env=env, stdout=sys.stderr, stderr=sys.stderr,
-        start_new_session=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
-    printed = False
+    out = open(capture, "wb") if capture else sys.stderr
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            env=env, stdout=out, stderr=out,
+            start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    finally:
+        if capture:
+            out.close()
     end = time.monotonic() + timeout_s
     rc = None
     while time.monotonic() < end:
         rc = proc.poll()
-        if headline_file and not printed:
-            printed = _try_print_headline(headline_file)
+        if headline_file:
+            _note_headline(headline_file)
         if rc is not None:
             break
         time.sleep(2)
@@ -204,53 +216,109 @@ def _spawn_child(args, timeout_s, headline_file=None, tag="child"):
                 break
             except Exception:
                 continue
-    if headline_file and not printed:
-        printed = _try_print_headline(headline_file)
+    printed = False
+    if headline_file:
+        _note_headline(headline_file)
+        printed = _flush_headline()
     return rc, printed
 
 
 _HEADLINE_DONE = False
+_PENDING_HEADLINE = None
 
 
-def _try_print_headline(path) -> bool:
-    """Print the child's recorded headline (once) if it exists."""
+def _note_headline(path) -> None:
+    """Cache the latest recorded headline (the child atomically replaces
+    the file as later queries complete)."""
+    global _PENDING_HEADLINE
+    try:
+        with open(path) as f:
+            _PENDING_HEADLINE = json.loads(f.read())
+    except Exception:
+        pass
+
+
+def _flush_headline() -> bool:
+    """Print the cached headline exactly once."""
     global _HEADLINE_DONE
     if _HEADLINE_DONE:
         return True
-    try:
-        with open(path) as f:
-            line = json.loads(f.read())
-    except Exception:
+    if _PENDING_HEADLINE is None:
         return False
-    print(json.dumps(line), flush=True)
+    print(json.dumps(_PENDING_HEADLINE), flush=True)
     _HEADLINE_DONE = True
     return True
 
 
+def _tail_file(path, n=4000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def _classify_wedge(rc, tail: str) -> str:
+    """Name the wedge mode from the probe child's output, so BENCH_*.json
+    records WHY there is no number instead of a bare 0 (VERDICT r5
+    standing order). The three observed modes: init hang (backend plugin
+    bootstrap never returns — the r2-r5 state), compile hang (devices
+    list but the tiny jit never completes — the r3 state), and a typed
+    probe error."""
+    if rc is None:
+        if "probe:" in tail:
+            return ("backend_compile_hang: devices listed but the probe "
+                    "computation never completed inside the window")
+        return ("backend_init_hang: jax backend init produced no devices "
+                "inside the window")
+    for line in reversed([ln for ln in tail.splitlines() if ln.strip()]):
+        if any(k in line for k in ("Error", "error", "FAILED", "Traceback",
+                                   "assert")):
+            return f"probe_error rc={rc}: {line.strip()[:200]}"
+    return f"probe_exit rc={rc}"
+
+
 def parent() -> None:
     errors = []
-    _kill_stale_clients()
+    wedges = []
+    stale_killed = _kill_stale_clients()
+    # a driver kill (SIGTERM from `timeout`) must still emit whatever
+    # headline the child has recorded so far — Q1-only beats nothing
+    signal.signal(signal.SIGTERM,
+                  lambda *a: (_flush_headline(), os._exit(124)))
 
-    # ---- probe: deadlined + retried backend init ----------------------
-    # the shared retry policy (runtime/retry.py): a Deadline bounds the
-    # whole window, jittered exponential backoff paces the re-probes
+    # ---- preflight: deadlined + retried backend init, with the wedge
+    # mode CLASSIFIED from captured probe output (VERDICT r5 standing
+    # order: record WHY there is no number, never a bare 0). The shared
+    # retry policy (runtime/retry.py): a Deadline bounds the whole
+    # window, jittered exponential backoff paces the re-probes.
     retry = _retry_mod()
     probe_dl = retry.Deadline(min(PROBE_S, DEADLINE_S * 0.4))
     delays = retry.backoff_delays(base=20.0, cap=60.0, jitter=0.25,
                                   deadline=probe_dl)
+    probe_cap = f"/tmp/ggtpu_bench_probe_{os.getpid()}.log"
     probe_ok = False
     attempt = 0
     while not probe_dl.expired:
         attempt += 1
         budget = min(150.0, probe_dl.remaining() + 30)
         log(f"probe attempt {attempt} (timeout {budget:.0f}s)")
-        rc, _ = _spawn_child(["--probe"], budget, tag="probe")
+        rc, _ = _spawn_child(["--probe"], budget, tag="probe",
+                             capture=probe_cap)
+        tail = _tail_file(probe_cap)
+        if tail.strip():
+            log("probe output tail:\n" + tail[-800:])
         if rc == 0:
             probe_ok = True
             break
         errors.append(f"probe#{attempt} rc={rc if rc is not None else 'timeout'}")
-        _kill_stale_clients()   # a hung probe child is itself a stale client
-        sleep = next(delays, None)
+        wedges.append(_classify_wedge(rc, tail))
+        log(f"wedge classified: {wedges[-1]}")
+        stale_killed += _kill_stale_clients()   # a hung probe child is
+        sleep = next(delays, None)              # itself a stale client
         if sleep is None or (probe_dl.remaining() or 0) <= sleep:
             break
         log(f"probe failed ({errors[-1]}); backoff {sleep:.0f}s")
@@ -260,7 +328,11 @@ def parent() -> None:
         print(json.dumps({
             "metric": "tpch_q1_rows_per_sec_per_chip", "value": 0,
             "unit": "rows/s", "vs_baseline": 0.0,
-            "error": "TPU backend unavailable: " + "; ".join(errors[-4:])}),
+            "error": "TPU backend unavailable: " + "; ".join(errors[-4:]),
+            "wedge": {"reason": wedges[-1] if wedges else "unknown",
+                      "probe_attempts": attempt,
+                      "stale_clients_killed": stale_killed,
+                      "history": wedges[-4:]}}),
             flush=True)
         return
 
@@ -865,6 +937,12 @@ def run_child():
         os.replace(tmp, headline_file)
         log(f"headline recorded: {line}")
 
+    # ONE headline object, re-recorded (atomic replace) as each query
+    # lands: the Q1 number is the cross-round metric, and Q3/Q5 ride the
+    # same line so a single unwedged run captures all three (VERDICT r5
+    # standing order) — the parent prints whatever the latest recording
+    # holds, even if the driver kills it between queries
+    headline = None
     for qname, sql in (("q1", Q1), ("q3", Q3), ("q5", Q5)):
         if qname not in QUERIES:
             continue
@@ -897,12 +975,19 @@ def run_child():
                     r.stats.get("fused_kernel"))
                 if db.executor.last_fused_error:
                     detail[qname]["fused_error"] = db.executor.last_fused_error
-                record_headline({
+                headline = {
                     "metric": "tpch_q1_rows_per_sec_per_chip",
                     "value": round(value),
                     "unit": "rows/s",
                     "vs_baseline": round(value / base, 3),
-                })
+                }
+                record_headline(headline)
+            elif headline is not None:
+                headline[qname] = {
+                    "rows_per_sec_per_chip": round(value),
+                    "vs_baseline": round(value / base, 3),
+                }
+                record_headline(headline)
         except Exception as e:  # one failing query must not kill the rest
             detail[qname] = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps({qname: detail.get(qname)}), file=sys.stderr,

@@ -102,6 +102,18 @@ class Settings:
     # the coordinator waits for the gang to re-form before serving the
     # statement on the degraded local path instead (writes never retry)
     mh_retry_window_s: float = 1.0
+    # N-1 mesh re-formation (docs/ROBUSTNESS.md "Topology re-formation"):
+    # on worker death the coordinator rebuilds the gang over the SURVIVORS
+    # (mirror-promoted contents served from surviving roots) instead of
+    # falling to the single-process degraded path; off = legacy degrade.
+    # The deadline bounds how long re-formation waits for survivors to
+    # redial the kept listener before adopting whoever arrived.
+    mh_reform_enabled: bool = True
+    mh_reform_deadline_s: float = 10.0
+    # per-table delta manifests (storage/manifest.py): fold the delta
+    # backlog into the root snapshot once it reaches this many commits
+    # (the checkpoint_segments analog); 0 folds on every commit
+    manifest_delta_fold_threshold: int = 64
     # plan / executable cache (plancache.c prepared-statement analog;
     # docs/PERF.md "Plan cache"): plan_cache_params hoists plan-safe
     # literals into runtime parameters so one XLA executable serves every

@@ -516,6 +516,14 @@ class Executor:
                     # masquerade as a pallas measurement)
                     "fused_kernel": bool(comp.uses_fused),
                     "segments": self.nseg,
+                    # FTS/topology version the dispatch was bound against
+                    # (bumped by mesh re-formation and mirror promotion;
+                    # pjit resolves the mesh at call site, so a cached
+                    # executable re-binds to the current topology without
+                    # recompiling)
+                    "topology_version": getattr(
+                        getattr(self.catalog, "segments", None),
+                        "version", 0),
                     "scan_tables": [t for t, *_ in comp.input_spec],
                     "direct_dispatch": {t: d for t, _, _, d, *_ in comp.input_spec
                                         if d is not None},

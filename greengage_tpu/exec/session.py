@@ -166,6 +166,9 @@ class Database:
                            if self.catalog.segments.has_mirrors() else None)
         self.fts = FtsProber(self.catalog.segments, self.mesh, store=self.store,
                              on_change=self.catalog._save)
+        if not is_worker:
+            # topology gauge (asserted by the reform tests; `gg ps` shows it)
+            _counters.set("mh_topology_version", self.catalog.segments.version)
         from greengage_tpu.runtime.logger import ClusterLog
 
         # elog/syslogger analog: CSV logs under <cluster>/log (mined by
@@ -190,10 +193,18 @@ class Database:
         self._load_extensions()
         # serializes write/DDL statements across threads sharing this
         # Database (server connections); readers stay lock-free on
-        # manifest snapshots
+        # manifest snapshots. Autocommit single-table appends take the
+        # SHARED mode plus a per-table lock, so appenders to different
+        # tables run concurrently end-to-end (per-table delta manifests
+        # make their commits contention-free too — docs/ROBUSTNESS.md)
         import threading
 
-        self._write_lock = threading.RLock()
+        self._write_lock = _RWLock()
+        self._table_locks: dict[str, threading.RLock] = {}
+        self._table_locks_mu = threading.Lock()
+        # post-commit replication/archive is not reentrancy-safe for
+        # concurrent shared appenders: serialize it separately
+        self._pc_lock = threading.Lock()
         self._dtm_local = threading.local()
         # control-channel liveness: the channel reads its deadlines live
         # from THIS session's settings (SET mh_* applies immediately), and
@@ -403,6 +414,196 @@ class Database:
         planned, _, _, _ = self._cached_plan(stmt)
         return hashlib.sha1(describe(planned).encode()).hexdigest()[:16]
 
+    # ---- topology state (degraded <-> N-1 <-> full) --------------------
+    def mh_state(self) -> dict:
+        """The dispatch topology as `gg ps` / the server status frame show
+        it: full (whole gang serving), n-1 (re-formed over survivors),
+        degraded (single-process fallback), or local (no multihost)."""
+        segs = self.catalog.segments
+        if self.multihost is None or self.multihost.channel is None \
+                or not self.multihost.is_coordinator:
+            return {"state": "local", "topology_version": segs.version}
+        ch = self.multihost.channel
+        if getattr(self, "_mh_degraded", None):
+            state = "degraded"
+        elif hasattr(ch, "is_partial") and ch.is_partial():
+            state = "n-1"
+        else:
+            state = "full"
+        out = {"state": state, "topology_version": segs.version,
+               "expected_workers": getattr(ch, "expected_workers", None),
+               "active_workers": (len(ch.active_ids())
+                                  if hasattr(ch, "active_ids") else None)}
+        if getattr(self, "_mh_degraded", None):
+            out["reason"] = self._mh_degraded
+        return out
+
+    def _mh_distributed_active(self) -> bool:
+        """True when a jax.distributed data plane is live: its global mesh
+        cannot re-form over survivors without a runtime re-init, so worker
+        death must take the degraded path there. Control-plane-only gangs
+        (each process owns its full local mesh; this environment's mode)
+        re-form freely — pjit resolves the mesh at call site, so cached
+        executables re-bind without recompiling."""
+        try:
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client is not None
+        except Exception:
+            return False
+
+    def _mh_worker_lost(self, reason: str, dead_pid=None) -> None:
+        """Topology failover entry: a worker died/hung. Prefer N-1 mesh
+        re-formation over the survivors (the cdbgang shrink + mirror
+        promotion the reference performs); fall back to the degraded
+        single-process path when re-formation is disabled, impossible
+        (live jax.distributed data plane), or fails."""
+        if getattr(self, "_mh_degraded", None):
+            return
+        if self.settings.mh_reform_enabled \
+                and not self._mh_distributed_active():
+            if self._mh_reform(reason, dead_pid):
+                return
+        self._mh_degrade(reason)
+
+    def _mh_reform(self, reason: str, dead_pid=None) -> bool:
+        """Re-form the gang over the SURVIVORS (N-1): quiesce the channel
+        (survivors redial the kept listener within seconds — worker_loop
+        treats the teardown as a lost coordinator and reconnects), promote
+        cross-host mirror roots for contents whose storage died with the
+        worker, bump the topology version, adopt whoever redialed before
+        mh_reform_deadline_s, and replay the settings/topology sync. The
+        re-formed gang serves every later statement — DML included, since
+        manifest commits are coordinator-local — and the kept listener
+        plus the rejoin accept loop restore full strength when the lost
+        worker returns."""
+        from greengage_tpu.parallel.multihost import WorkerDied
+        from greengage_tpu.runtime.faultinject import FaultError, faults
+        from greengage_tpu.runtime.retry import Deadline
+
+        ch = self.multihost.channel
+        if not hasattr(ch, "adopt_pending"):
+            return False
+        try:
+            faults.check("mesh_reform")
+        except FaultError as e:
+            self.log.error("multihost", f"mesh re-formation failed "
+                                        f"(fault injected): {e}")
+            return False
+        who = f"worker {dead_pid}" if dead_pid is not None else "a worker"
+        self.log.error("multihost",
+                       f"{who} lost; re-forming the gang over survivors: "
+                       f"{reason}")
+        survivors_want = max(0, len(ch.active_ids()) - 1)
+        try:
+            ch.quiesce()
+        except Exception as e:
+            self.log.error("multihost", f"quiesce failed: {e}")
+            return False
+        # mirror promotion over surviving storage (ftsprobe.c:968 role):
+        # probe every content NOW — one whose primary tree died with the
+        # worker's host gets its in-sync cross-host mirror promoted, so
+        # the N-1 topology serves every content from a surviving root
+        try:
+            faults.check("mirror_promote_during_reform")
+            if self.catalog.segments.has_mirrors():
+                self.fts.probe_once()
+        except FaultError as e:
+            self.log.error("multihost",
+                           f"mirror promotion during re-formation failed "
+                           f"(fault injected): {e}")
+            return False
+        except Exception as e:
+            self.log.error("multihost", f"re-formation FTS probe failed: {e}")
+        # the FTS-version bump: cached dispatch topology is invalid, and
+        # rejoining workers must observe this exact version in the sync
+        self.catalog.segments.version += 1
+        try:
+            self.catalog._save()
+        except Exception as e:
+            self.log.error("multihost", f"topology save failed: {e}")
+        dl = Deadline(float(self.settings.mh_reform_deadline_s))
+        while ch.pending_count() < survivors_want and not dl.expired:
+            time.sleep(0.02)
+        ch.adopt_pending()
+        try:
+            self._mh_sync_gang(phase="reform sync")
+        except (WorkerDied, RuntimeError, OSError) as e:
+            self.log.error("multihost", f"gang re-formation failed: {e}")
+            try:
+                ch.quiesce()
+            except Exception:
+                pass
+            return False
+        self._mh_degraded = None
+        _counters.inc("mh_reform_total")
+        _counters.set("mh_topology_version", self.catalog.segments.version)
+        try:
+            ch.start_heartbeat()
+        except Exception:
+            pass
+        st = self.mh_state()
+        self.log.info(
+            "multihost",
+            f"gang re-formed: {st['state']} with "
+            f"{st['active_workers']}/{st['expected_workers']} workers "
+            f"(topology v{st['topology_version']})")
+        return True
+
+    def _mh_sync_gang(self, phase: str = "rejoin sync") -> None:
+        """Replay the settings + topology sync against the current gang;
+        raises WorkerDied/RuntimeError when any member is gone or reports
+        a stale topology version (shared directory out of sync)."""
+        import dataclasses as _dc
+
+        from greengage_tpu.parallel.multihost import WorkerDied
+
+        payload = {f.name: getattr(self.settings, f.name)
+                   for f in _dc.fields(self.settings)
+                   if not f.name.startswith("_")}
+        want_v = self.catalog.segments.version
+        acks = self.multihost.channel.broadcast(
+            {"op": "sync", "settings": payload, "topology_version": want_v},
+            deadline="mh_ready_deadline", phase=phase)
+        stale = [a for a in acks if a.get("topology_version") != want_v]
+        if stale:
+            raise WorkerDied(
+                f"rejoined worker reports topology version "
+                f"{stale[0].get('topology_version')}, coordinator has "
+                f"{want_v} — shared directory out of sync")
+
+    def _mh_try_restore_full(self) -> None:
+        """While an N-1 gang serves, the lost worker may redial the kept
+        listener at any time; adopting it restores the full topology.
+        Called at each statement boundary — cheap (one lock + len)."""
+        from greengage_tpu.parallel.multihost import WorkerDied
+
+        ch = self.multihost.channel
+        if not hasattr(ch, "pending_count") or not hasattr(ch, "is_partial"):
+            return
+        if not ch.is_partial() or ch.pending_count() == 0:
+            return
+        ch.adopt_pending()
+        self.catalog.segments.version += 1
+        try:
+            self.catalog._save()
+        except Exception:
+            pass
+        try:
+            self._mh_sync_gang(phase="restore sync")
+        except (WorkerDied, RuntimeError, OSError) as e:
+            # the rejoiner (or a survivor) is unusable: fall back to a
+            # fresh re-formation over whoever still answers
+            self._mh_worker_lost(f"gang restore failed: {e}")
+            return
+        _counters.set("mh_topology_version", self.catalog.segments.version)
+        st = self.mh_state()
+        self.log.info(
+            "multihost",
+            f"gang restored: {st['state']} with "
+            f"{st['active_workers']}/{st['expected_workers']} workers "
+            f"(topology v{st['topology_version']})")
+
     def _mh_degrade(self, reason: str) -> None:
         """A worker died: the global device mesh can no longer rendezvous.
         Mark the cluster degraded — every later mesh statement re-forms as
@@ -446,14 +647,16 @@ class Database:
             pass
 
     def mh_try_recover(self) -> bool:
-        """Gang recovery (the cdbgang re-formation role): if the full
-        worker gang has reconnected to the kept listener, replay the
-        catalog/settings sync and leave degraded mode. Safe to call any
-        time; also attempted automatically at each statement while
-        degraded. True when mesh dispatch is available."""
+        """Gang recovery (the cdbgang re-formation role): while DEGRADED,
+        adopt the fully-reconnected gang and leave degraded mode; while an
+        N-1 partial gang serves, adopt a rejoined worker back to full
+        strength. Safe to call any time; also attempted automatically at
+        each statement. True when mesh dispatch is available (full or
+        N-1)."""
         if self.multihost is None or not self.multihost.is_coordinator:
             return False
         if not getattr(self, "_mh_degraded", None):
+            self._mh_try_restore_full()
             return True
         return self._mh_try_recover()
 
@@ -473,24 +676,9 @@ class Database:
                 self.catalog._save()
             except Exception as e:
                 self.log.error("multihost", f"pre-rejoin FTS probe failed: {e}")
-        import dataclasses as _dc
-
-        payload = {f.name: getattr(self.settings, f.name)
-                   for f in _dc.fields(self.settings)
-                   if not f.name.startswith("_")}
-        want_v = self.catalog.segments.version
         try:
             ch.adopt_rejoined()
-            acks = ch.broadcast({"op": "sync", "settings": payload,
-                                 "topology_version": want_v},
-                                deadline="mh_ready_deadline",
-                                phase="rejoin sync")
-            stale = [a for a in acks if a.get("topology_version") != want_v]
-            if stale:
-                raise WorkerDied(
-                    f"rejoined worker reports topology version "
-                    f"{stale[0].get('topology_version')}, coordinator has "
-                    f"{want_v} — shared directory out of sync")
+            self._mh_sync_gang(phase="rejoin sync")
         except (WorkerDied, RuntimeError, OSError) as e:
             self.log.error("multihost", f"gang rejoin failed: {e}")
             try:
@@ -515,9 +703,10 @@ class Database:
             ch.start_heartbeat()
         except Exception:
             pass
+        _counters.set("mh_topology_version", self.catalog.segments.version)
         self.log.info("multihost",
                       f"gang recovered: mesh dispatch restored "
-                      f"(topology v{want_v})")
+                      f"(topology v{self.catalog.segments.version})")
         return True
 
     def cluster_inject_fault(self, name: str, type: str = "error",
@@ -609,14 +798,14 @@ class Database:
     def _dispatch_failover(self, stmt, text: str, err, is_retry: bool):
         """A worker died/hung BEFORE anyone entered a collective, so the
         statement never ran. Read-only statements retry transparently
-        ONCE: wait up to mh_retry_window_s for the gang to re-form (a
-        hung-then-woken worker redials within seconds) and redispatch —
-        counted in statements_retried; if the gang stays down, complete
-        on the degraded local path as before. Write statements surface
-        the error without re-execution: the manifest CAS never ran, so
-        nothing committed, and only an explicit client retry (or the
-        degraded path on a LATER statement) may run it — exactly-once is
-        the DTM's to keep, never the dispatcher's to gamble."""
+        ONCE: when the gang already re-formed over survivors (N-1 path),
+        redispatch immediately; while DEGRADED, wait up to
+        mh_retry_window_s for recovery first and otherwise complete on
+        the degraded local path as before. Write statements surface the
+        error without re-execution: the commit record was never written,
+        so nothing committed, and only an explicit client retry (or a
+        LATER statement) may run it — exactly-once is the DTM's to keep,
+        never the dispatcher's to gamble."""
         from greengage_tpu.runtime.faultinject import faults
         from greengage_tpu.runtime.retry import Deadline
 
@@ -626,20 +815,30 @@ class Database:
                 f"auto-retried (nothing committed — retry explicitly if "
                 f"desired): {err}")
         window = float(self.settings.mh_retry_window_s)
-        if not is_retry and window > 0:     # 0 disables redispatch entirely
+
+        def redispatch():
+            # the window a test can force open/shut: sleep widens
+            # the race, error fails the redispatch path itself
+            faults.check("retry_redispatch")
+            _counters.inc("statements_retried")
+            self.log.info(
+                "statement",
+                f"gang re-formed; redispatching read-only "
+                f"statement after dispatch failure: "
+                f"{text.strip()[:160]}")
+            return self._coordinator_sql(text, _is_retry=True)
+
+        # window 0 disables transparent redispatch ENTIRELY — even when an
+        # N-1 re-formation already re-bound the gang (the operator opted
+        # out of re-executing reads, not just out of waiting)
+        if not is_retry and window > 0 \
+                and not getattr(self, "_mh_degraded", None):
+            return redispatch()     # N-1 re-formation already re-bound
+        if not is_retry and window > 0:
             dl = Deadline(window)
             while True:
                 if self.mh_try_recover():
-                    # the window a test can force open/shut: sleep widens
-                    # the race, error fails the redispatch path itself
-                    faults.check("retry_redispatch")
-                    _counters.inc("statements_retried")
-                    self.log.info(
-                        "statement",
-                        f"gang re-formed; redispatching read-only "
-                        f"statement after dispatch failure: "
-                        f"{text.strip()[:160]}")
-                    return self._coordinator_sql(text, _is_retry=True)
+                    return redispatch()
                 if dl.expired:
                     break
                 time.sleep(0.05)
@@ -661,12 +860,13 @@ class Database:
 
         ch = self.multihost.channel
         # idle-time liveness: the heartbeat thread marks the channel dead
-        # on a missed pong — degrade HERE, before wasting a broadcast on a
-        # partitioned gang (and before _execute could enter a collective)
+        # on a missed pong — re-form/degrade HERE, before wasting a
+        # broadcast on a partitioned gang (and before _execute could
+        # enter a collective)
         if not getattr(self, "_mh_degraded", None) \
                 and getattr(ch, "hb_failure", None):
-            self._mh_degrade(f"heartbeat liveness check failed: "
-                             f"{ch.hb_failure}")
+            self._mh_worker_lost(f"heartbeat liveness check failed: "
+                                 f"{ch.hb_failure}")
         # gang recovery: once the full gang has reconnected, re-sync and
         # fall through to normal mesh dispatch below
         if getattr(self, "_mh_degraded", None) and not self._mh_try_recover():
@@ -677,6 +877,10 @@ class Database:
             for stmt in stmts:
                 out = self._execute(stmt)
             return out
+        # N-1 partial gang: adopt the lost worker back the moment it has
+        # redialed the kept listener (full-strength restoration)
+        if not getattr(self, "_mh_degraded", None):
+            self._mh_try_restore_full()
         stmts = parse(text)
         if any(getattr(st, "_recursive_ctes", None) for st in stmts):
             raise SqlError(
@@ -738,8 +942,11 @@ class Database:
                                 except WorkerDied as e:
                                     # our side already finished its mesh
                                     # program: the result stands; later
-                                    # statements take the degraded path
-                                    self._mh_degrade(str(e))
+                                    # statements run on the re-formed N-1
+                                    # gang (or the degraded path)
+                                    self._mh_worker_lost(
+                                        str(e),
+                                        getattr(e, "process_id", None))
                                 except StatementCancelled:
                                     # a half-collected exchange cannot be
                                     # resumed (workers are still running
@@ -753,10 +960,12 @@ class Database:
                                     raise
                 except WorkerDied as e:
                     # death/hang BEFORE anyone entered a collective
-                    # (readiness or go phase): degrade, then fail over by
-                    # statement class (reads redispatch/degrade, writes
-                    # surface the error — exactly-once)
-                    self._mh_degrade(str(e))
+                    # (readiness or go phase): re-form over the survivors
+                    # (or degrade), then fail over by statement class
+                    # (reads redispatch, writes surface the error —
+                    # exactly-once)
+                    self._mh_worker_lost(str(e),
+                                         getattr(e, "process_id", None))
                     return self._dispatch_failover(stmt, text, e, _is_retry)
             else:
                 if isinstance(stmt, A.SetStmt):
@@ -776,10 +985,12 @@ class Database:
                                 ch.collect_acks(deadline="mh_ready_deadline",
                                                 phase="set")
                     except WorkerDied as e:
-                        # the local SET already (or still can) apply; the
-                        # gang re-syncs settings wholesale at rejoin
-                        self._mh_degrade(str(e))
+                        # apply the SET locally FIRST, then re-form: the
+                        # re-formation (or later rejoin) sync re-ships the
+                        # whole settings payload, new value included
                         out = self._execute(stmt)
+                        self._mh_worker_lost(str(e),
+                                             getattr(e, "process_id", None))
                     continue
                 out = self._execute(stmt)
         return out
@@ -851,10 +1062,38 @@ class Database:
             # lock (inside _declare_cursor) — a multi-second DECLARE must
             # not stall every concurrent writer
             return self._declare_cursor(stmt)
+        # autocommit single-table appends take the SHARED write mode plus
+        # a per-table lock: appenders to DIFFERENT tables stage and commit
+        # concurrently (per-table delta manifests make the commit path
+        # contention-free across tables), while structural statements
+        # below still drain them through the exclusive mode
+        if isinstance(stmt, (A.InsertStmt, A.CopyStmt)) \
+                and not (self.dtm.current is not None
+                         and self.dtm.current.state == "active"):
+            with self._write_lock.shared(), self._table_lock(stmt.table):
+                if isinstance(stmt, A.InsertStmt):
+                    out = self._insert(stmt)
+                else:
+                    out = self._copy(stmt)
+                self._post_commit()
+                return out
         # every other statement mutates shared state (catalog, manifest,
         # dictionaries, settings, tx) — one writer at a time per process
         with self._write_lock:
             return self._execute_write(stmt)
+
+    def _table_lock(self, table: str):
+        """Per-table append serializer (same-table appenders queue; the
+        base storage table keys the lock so partition children share their
+        parent's)."""
+        import threading
+
+        base = table.split("#", 1)[0]
+        with self._table_locks_mu:
+            lk = self._table_locks.get(base)
+            if lk is None:
+                lk = self._table_locks[base] = threading.RLock()
+            return lk
 
     def _execute_write(self, stmt):
         if isinstance(stmt, A.CreateTableStmt):
@@ -891,6 +1130,11 @@ class Database:
                         touched = True
                 if touched:
                     self.store.manifest.commit_tx(tx)
+                    # the dead delta chains go NOW (we hold the exclusive
+                    # write mode): a same-named CREATE restarts at seq 1
+                    # and must not collide with stale claims
+                    for st in storage:
+                        self.store.manifest.drop_table_deltas(st)
                 self.store._invalidate_dicts(stmt.name)
                 # compiled programs scanning this table must not survive a
                 # same-named recreate (the shape signature could coincide)
@@ -1074,6 +1318,10 @@ class Database:
         refresh_sync_state() blocks their promotion."""
         if self.dtm.current is not None and getattr(self.dtm.current, "state", "") == "active":
             return   # still invisible; replicate/archive at COMMIT
+        with self._pc_lock:
+            self._post_commit_locked()
+
+    def _post_commit_locked(self) -> None:
         if self.settings.archive_mode and self.settings.archive_dir:
             # continuous archiving: ship the committed version before the
             # statement returns (archive_command semantics); a failing
@@ -2112,6 +2360,7 @@ class Database:
         if child in tx["tables"]:
             del tx["tables"][child]
             self.store.manifest.commit_tx(tx)
+            self.store.manifest.drop_table_deltas(child)
         import shutil
 
         shutil.rmtree(os.path.join(self.path, "data", child),
@@ -2902,6 +3151,82 @@ class Database:
                 self.multihost.channel.close()
             except Exception:
                 pass
+
+
+class _RWLock:
+    """Write-path lock with a SHARED mode for per-table appenders.
+
+    Exclusive = the classic session write lock (DDL, transactions,
+    catalog moves, DELETE/UPDATE): one holder, re-entrant per thread.
+    Shared = autocommit single-table appends (INSERT/COPY): any number of
+    holders, each additionally serialized per TABLE by the session's
+    table-lock map — so hot appenders to DIFFERENT tables stage and
+    commit concurrently (their manifest commits are per-table delta CAS,
+    storage/manifest.py) while anything structural still drains them.
+    A waiting exclusive holder gates NEW shared entrants (no writer
+    starvation); a thread holding exclusive may take shared (nested
+    statement paths)."""
+
+    def __init__(self):
+        import threading
+
+        self._c = threading.Condition()
+        self._excl: int | None = None     # owning thread ident
+        self._depth = 0
+        self._excl_waiting = 0
+        self._shared: dict[int, int] = {}  # thread ident -> hold depth
+
+    # exclusive (context manager: `with db._write_lock:`)
+    def __enter__(self):
+        import threading
+
+        me = threading.get_ident()
+        with self._c:
+            self._excl_waiting += 1
+            try:
+                while not (self._excl in (None, me)
+                           and all(t == me for t in self._shared)):
+                    self._c.wait()
+            finally:
+                self._excl_waiting -= 1
+            self._excl = me
+            self._depth += 1
+        return self
+
+    def __exit__(self, *a):
+        with self._c:
+            self._depth -= 1
+            if self._depth == 0:
+                self._excl = None
+            self._c.notify_all()
+        return False
+
+    def shared(self):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _shared_cm():
+            import threading
+
+            me = threading.get_ident()
+            with self._c:
+                while (self._excl not in (None, me)
+                       or (self._excl_waiting and self._excl is None
+                           and me not in self._shared)):
+                    self._c.wait()
+                self._shared[me] = self._shared.get(me, 0) + 1
+            try:
+                yield self
+            finally:
+                with self._c:
+                    n = self._shared.get(me, 1) - 1
+                    if n:
+                        self._shared[me] = n
+                    else:
+                        self._shared.pop(me, None)
+                    self._c.notify_all()
+
+        return _shared_cm()
 
 
 class _DegradedResult:

@@ -844,6 +844,14 @@ def cmd_ps(args):
     finally:
         c.close()
     rows = resp.get("rows") or []
+    cl = resp.get("cluster") or {}
+    if cl:
+        gang = ""
+        if cl.get("expected_workers") is not None:
+            gang = (f"  workers: {cl.get('active_workers')}/"
+                    f"{cl.get('expected_workers')}")
+        print(f"cluster: {cl.get('state', '?')}  "
+              f"topology v{cl.get('topology_version', '?')}{gang}")
     print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} SQL")
     for r in rows:
         state = f"cancel:{r['cancelled']}" if r.get("cancelled") else "active"

@@ -109,7 +109,13 @@ def local_segment_positions() -> tuple:
 class WorkerDied(ConnectionError):
     """A worker's control connection is gone OR silent past its deadline
     (process death / network partition / wedged process): the statement
-    channel cannot reach the full gang."""
+    channel cannot reach the full gang. ``process_id`` carries the peer
+    the failure was observed on (None when unattributable) so mesh
+    re-formation can name the lost worker."""
+
+    def __init__(self, msg: str, process_id: int | None = None):
+        super().__init__(msg)
+        self.process_id = process_id
 
 
 class CoordinatorLost(ConnectionError):
@@ -244,16 +250,20 @@ class CoordinatorChannel:
             except FaultError as e:
                 raise WorkerDied(str(e))
             line = (json.dumps(msg) + "\n").encode()
+            pid = None
             try:
                 for p in self._workers:
+                    pid = p.process_id
                     p.sock.settimeout(
                         _limit(self.settings, "mh_ready_deadline"))
                     p.f.write(line)
                     p.f.flush()
             except (socket.timeout, TimeoutError) as e:
-                raise WorkerDied(f"worker send timed out: {e}")
+                raise WorkerDied(f"worker send timed out: {e}",
+                                 process_id=pid)
             except OSError as e:
-                raise WorkerDied(f"worker connection lost on send: {e}")
+                raise WorkerDied(f"worker connection lost on send: {e}",
+                                 process_id=pid)
 
     def collect_acks(self, deadline="mh_ack_deadline",
                      phase: str = "ack") -> list[dict]:
@@ -299,16 +309,21 @@ class CoordinatorChannel:
                 except (socket.timeout, TimeoutError):
                     raise WorkerDied(
                         f"{phase} ack from worker {p.process_id} timed out "
-                        f"after {limit:.1f}s — hung or partitioned")
+                        f"after {limit:.1f}s — hung or partitioned",
+                        process_id=p.process_id)
                 except OSError as e:
-                    raise WorkerDied(f"worker connection lost: {e}")
+                    raise WorkerDied(f"worker connection lost: {e}",
+                                     process_id=p.process_id)
                 if not line:
-                    raise WorkerDied("worker connection closed (EOF) — "
-                                     "the process died mid-statement")
+                    raise WorkerDied(
+                        f"worker {p.process_id} connection closed (EOF) — "
+                        "the process died mid-statement",
+                        process_id=p.process_id)
                 try:
                     acks.append(json.loads(line))
                 except ValueError as e:
-                    raise WorkerDied(f"garbled ack frame: {e}")
+                    raise WorkerDied(f"garbled ack frame: {e}",
+                                     process_id=p.process_id)
             if cancelled is not None:
                 raise cancelled   # after the drain: no stale acks remain
             return acks
@@ -381,6 +396,7 @@ class CoordinatorChannel:
             return
         self._quiesced = True
         self._stop_heartbeat()
+        self._stop_accept_loop()   # a partial gang keeps one running
         with self._lock:
             for p in self._workers:
                 p.close()
@@ -411,7 +427,10 @@ class CoordinatorChannel:
                     if old is not None:
                         old.close()   # a worker re-dialing replaces itself
                     self._pending[peer.process_id] = peer
-                    if len(self._pending) >= self._expected:
+                    # ready = the missing complement has reconnected (the
+                    # whole gang when quiesced; the dead worker when a
+                    # partial N-1 gang is serving)
+                    if len(self._pending) >= self._expected - len(self._workers):
                         self._rejoin_ready.set()
 
         self._rejoin_thread = threading.Thread(
@@ -419,18 +438,73 @@ class CoordinatorChannel:
         self._rejoin_thread.start()
 
     def rejoin_ready(self) -> bool:
-        """True once the FULL gang has reconnected and said hello."""
+        """True once every MISSING worker has reconnected and said hello
+        (the full gang after a quiesce; the dead member while an N-1
+        partial gang serves)."""
         return self._rejoin_ready.is_set()
+
+    # ---- partial gangs (N-1 mesh re-formation) -------------------------
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def active_ids(self) -> list:
+        with self._lock:
+            return sorted((p.process_id for p in self._workers),
+                          key=lambda x: (x is None, x))
+
+    def is_partial(self) -> bool:
+        with self._lock:
+            return len(self._workers) < self._expected
+
+    @property
+    def expected_workers(self) -> int:
+        return self._expected
+
+    def adopt_pending(self) -> int:
+        """Fold every reconnected worker into the serving gang — the
+        re-bind step of mesh re-formation. Works from quiesced (adopt the
+        survivors into an N-1 gang) and from a partial gang (the dead
+        member rejoined: restore full strength). The rejoin accept loop
+        stays up while the gang is still short so a late rejoiner is never
+        locked out; it stops once the gang is whole. Returns the number of
+        workers adopted."""
+        with self._lock:
+            adopted = 0
+            for pid in sorted(self._pending, key=lambda x: (x is None, x)):
+                peer = self._pending[pid]
+                stale = [p for p in self._workers if p.process_id == pid]
+                for p in stale:
+                    p.close()
+                    self._workers.remove(p)
+                self._workers.append(peer)
+                adopted += 1
+            self._pending = {}
+            self._workers.sort(key=lambda p: (p.process_id is None,
+                                              p.process_id))
+            self._quiesced = False
+            self.hb_failure = None
+            self._rejoin_ready.clear()
+            full = len(self._workers) >= self._expected
+        if full:
+            self._stop_accept_loop()
+        return adopted
+
+    def _stop_accept_loop(self) -> None:
+        self._rejoin_stop.set()
+        t = self._rejoin_thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=2)
+        self._rejoin_thread = None
 
     def adopt_rejoined(self) -> None:
         """Swap the reconnected gang in; the caller then replays the
         sync handshake before clearing degraded mode."""
-        self._rejoin_stop.set()
-        t = self._rejoin_thread
-        if t is not None and t.is_alive():
-            t.join(timeout=2)
-        self._rejoin_thread = None
+        self._stop_accept_loop()
         with self._lock:
+            for p in self._workers:
+                p.close()   # a full swap replaces any partial remnants
             self._workers = [self._pending[k]
                              for k in sorted(self._pending,
                                              key=lambda x: (x is None, x))]

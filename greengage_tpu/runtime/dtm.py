@@ -74,6 +74,12 @@ class Transaction:
         self.tables_written.add(table)
 
     def commit(self) -> None:
+        """Two-phase commit over the PER-TABLE delta path: stage one delta
+        per written table (each claimed on its table's own sequence — the
+        per-table CAS, so transactions touching different tables never
+        conflict), then append the single fsynced commit-log line that
+        makes every table's delta visible atomically. Fault points bracket
+        the same phases the reference's crash_recovery_dtm kills at."""
         if self.state != "active":
             raise TransactionError(f"transaction is {self.state}")
         if not self.tables_written:     # one-phase: nothing to publish
@@ -81,34 +87,40 @@ class Transaction:
             return
         faults.check("dtx_before_prepare")
         try:
-            version = self.store.manifest.prepare(self.tx)
+            handle = self.store.manifest.prepare_delta(
+                self.tx, sorted(self.tables_written))
         except RuntimeError as e:
             self.abort()
             raise TransactionError(str(e))
         self.state = "prepared"
-        self._prepared_version = version
+        self._prepared_handle = handle
         faults.check("dtx_after_prepare")       # crash here -> recover() rolls back
         try:
             for t in self.tables_written:
                 self.store.flush_dicts(t)
             faults.check("dtx_before_commit")
-            self.store.manifest.commit(version)
+            # a reform racing the commit path (tests park a committer here
+            # while the mesh re-forms: the manifest is coordinator-local,
+            # so the commit must complete regardless of gang state)
+            faults.check("commit_during_reform")
+            self.store.manifest.commit_delta(handle)
         except BaseException:
-            # release the version claim: a stale claim would block every
-            # writer until recover() (r2 review finding)
-            self.store.manifest.abort(version)
+            # release the per-table claims: stale claims would block every
+            # same-table writer until recover() (r2 review finding)
+            self.store.manifest.abort_delta(handle)
             self.state = "aborted"
             raise
         self.state = "committed"
         faults.check("dtx_after_commit")   # crash here -> commit survives
         for table, rels in self._gc:
             self.store.gc_files(table, rels)
+        self.store.maybe_fold_manifest()
 
     def abort(self) -> None:
         if self.state in ("committed",):
             raise TransactionError("already committed")
-        if self.state == "prepared" and getattr(self, "_prepared_version", None):
-            self.store.manifest.abort(self._prepared_version)
+        if self.state == "prepared" and getattr(self, "_prepared_handle", None):
+            self.store.manifest.abort_delta(self._prepared_handle)
         self.state = "aborted"
         for t in self.tables_written:
             self.store._invalidate_dicts(t)

@@ -21,6 +21,7 @@ import numpy as np
 
 from greengage_tpu.catalog.segments import SegmentConfig, SegmentRole, SegmentStatus
 from greengage_tpu.runtime.faultinject import FaultError, faults
+from greengage_tpu.runtime.logger import counters
 
 
 class FtsProber:
@@ -53,11 +54,15 @@ class FtsProber:
                     Replicator(self.store, self.config).refresh_sync_state()
                 self.config.mark_down(entry.content)
         self.probe_count += 1
-        if self.config.version != before and self.on_change is not None:
-            try:
-                self.on_change()
-            except Exception:
-                pass
+        if self.config.version != before:
+            # dispatch consumes the FTS version (mesh re-formation, cached
+            # topology invalidation): keep the gauge current on promotion
+            counters.set("mh_topology_version", self.config.version)
+            if self.on_change is not None:
+                try:
+                    self.on_change()
+                except Exception:
+                    pass
         return results
 
     def _probe_segment(self, entry) -> bool:

@@ -40,6 +40,13 @@ class Counters:
             self._c[name] = self._c.get(name, 0) + n
             return self._c[name]
 
+    def set(self, name: str, value: int) -> int:
+        """Gauge-style assignment (e.g. mh_topology_version): the counter
+        surface also carries a few level values tests assert on."""
+        with self._lock:
+            self._c[name] = int(value)
+            return self._c[name]
+
     def get(self, name: str) -> int:
         with self._lock:
             return self._c.get(name, 0)

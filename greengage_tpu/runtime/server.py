@@ -64,6 +64,15 @@ def _watch_client(sock, thread_ident: int, stop: "threading.Event") -> None:
             return
 
 
+def _cluster_status(db) -> dict:
+    """Topology state for the ps/status control frames; resilient to a
+    Database predating mh_state (bare test doubles)."""
+    try:
+        return db.mh_state()
+    except Exception:
+        return {"state": "unknown", "topology_version": None}
+
+
 def _encode_value(v):
     import numpy as np
 
@@ -205,7 +214,19 @@ class SqlServer:
                 in-flight statements, 'cancel' flags one by id."""
                 op = req.get("op")
                 if op == "ps":
-                    return {"ok": True, "rows": REGISTRY.snapshot()}
+                    return {"ok": True, "rows": REGISTRY.snapshot(),
+                            "cluster": _cluster_status(outer.db)}
+                if op == "status":
+                    # the server status frame: dispatch topology state
+                    # (full / n-1 / degraded), FTS topology version, and
+                    # the reform/commit-path counter family
+                    from greengage_tpu.runtime.logger import counters
+
+                    st = _cluster_status(outer.db)
+                    st["counters"] = {
+                        k: v for k, v in counters.snapshot().items()
+                        if k.startswith(("mh_", "manifest_"))}
+                    return {"ok": True, "cluster": st}
                 if op == "cancel":
                     try:
                         sid = int(req.get("id"))

@@ -54,8 +54,48 @@ def _sync_meta(cluster_path: str, standby_path: str) -> None:
                     pass
     for fn in _META_FILES:
         src = os.path.join(cluster_path, fn)
-        if os.path.exists(src):
+        if fn == "manifest.json":
+            # ship the COMPOSED snapshot (root + committed per-table
+            # deltas), not the raw root file: an activated standby opens a
+            # plain root and must not lose delta commits folded only on
+            # the primary (storage/manifest.py)
+            _write_composed_manifest(cluster_path, standby_path)
+        elif os.path.exists(src):
             _copy_file(src, os.path.join(standby_path, fn))
+
+
+_MANIFESTS: dict = {}
+
+
+def _composed_snapshot(cluster_path: str) -> dict:
+    """Composed (root + committed deltas) snapshot for a cluster dir. The
+    Manifest instance is reused across syncs so its file-signature memo
+    serves the hot path — every post-commit standby sync would otherwise
+    re-read the log plus one file per unfolded delta."""
+    from greengage_tpu.storage.manifest import Manifest
+
+    m = _MANIFESTS.get(cluster_path)
+    if m is None:
+        if len(_MANIFESTS) > 8:
+            _MANIFESTS.clear()      # tests churn many tmp cluster dirs
+        m = _MANIFESTS[cluster_path] = Manifest(cluster_path)
+    return m.snapshot()
+
+
+def _write_composed_manifest(cluster_path: str, standby_path: str) -> None:
+    snap = _composed_snapshot(cluster_path)
+    if not os.path.exists(os.path.join(cluster_path, "manifest.json")) \
+            and not snap.get("version"):
+        return
+
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=standby_path, prefix=".manifest")
+    with os.fdopen(fd, "w") as f:
+        json.dump(snap, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(standby_path, "manifest.json"))
 
 
 def init_standby(cluster_path: str, standby_path: str) -> dict:
@@ -65,8 +105,7 @@ def init_standby(cluster_path: str, standby_path: str) -> dict:
         raise ValueError("standby path must differ from the cluster path")
     os.makedirs(standby_path, exist_ok=True)
     _sync_meta(cluster_path, standby_path)
-    with open(os.path.join(cluster_path, "manifest.json")) as f:
-        version = json.load(f).get("version", 0)
+    version = _composed_snapshot(cluster_path).get("version", 0)
     marker = {"role": "standby", "primary": os.path.abspath(cluster_path),
               "synced_version": version}
     with open(os.path.join(standby_path, MARKER), "w") as f:

@@ -732,14 +732,20 @@ class TableStore:
                                        seg_rows, fileno, raw_strs=raw_strs)
 
         if own_tx:
-            # Ordering: stage files -> prepare (version CAS = the write lock)
-            # -> persist dictionaries (fsynced; superset-safe) -> commit.
-            # A concurrent-writer CAS conflict RETRIES against the fresh
-            # snapshot: the staged files are tx-unique and remain valid, so
-            # only the manifest record needs re-merging (the appendonly
-            # writer's segfile-concurrency model — writers never block
-            # readers and autocommit writers serialize optimistically).
+            # Ordering: stage files -> prepare_delta (the PER-TABLE
+            # sequence CAS — appenders to different tables never contend)
+            # -> persist dictionaries (fsynced; superset-safe) -> commit
+            # (one fsynced commit-log line). A concurrent SAME-TABLE CAS
+            # conflict RETRIES against the fresh snapshot: the staged
+            # files are tx-unique and remain valid, so only the manifest
+            # record needs re-merging (the appendonly writer's
+            # segfile-concurrency model — writers never block readers and
+            # autocommit writers serialize optimistically). Each retry is
+            # counted in manifest_cas_retry_total (zero for cross-table
+            # workloads by construction).
             import time as _time
+
+            from greengage_tpu.runtime.logger import counters as _counters
 
             # a CROSS-PROCESS retry is only safe when this insert assigned
             # no new dictionary codes: a concurrent writer in another
@@ -752,13 +758,14 @@ class TableStore:
             last = None
             for attempt in range(20):
                 try:
-                    v = self.manifest.prepare(tx)
+                    handle = self.manifest.prepare_delta(tx, [table])
                     break
                 except RuntimeError as e:
                     last = e
                     if dict_grew:
                         self._invalidate_dicts(table)
                         raise
+                    _counters.inc("manifest_cas_retry_total")
                     _time.sleep(0.01 * (attempt + 1))
                     tx = self.manifest.begin()
                     merge_segfile_records(tx, table, records)
@@ -767,7 +774,12 @@ class TableStore:
                 raise RuntimeError(
                     f"write-write conflict persisted after retries: {last}")
             self.flush_dicts(table)
-            self.manifest.commit(v)
+            try:
+                self.manifest.commit_delta(handle)
+            except BaseException:
+                self.manifest.abort_delta(handle)
+                raise
+            self.maybe_fold_manifest()
         else:
             # DTM-managed tx: the caller drives prepare/commit and must call
             # flush_dicts(table) between those phases (see runtime/dtm.py).
@@ -1379,7 +1391,13 @@ class TableStore:
         self._write_segfiles(schema, table, tmeta, enc, valids, seg_rows,
                              uuid.uuid4().hex[:12], raw_strs=raw_strs)
         v = self.manifest.prepare(tx)
-        self.manifest.commit(v)
+        try:
+            self.manifest.commit(v)
+        except BaseException:
+            # a lost commit (cross-process fold raced the root version
+            # guard) must release the staged claim, as commit_tx does
+            self.manifest.abort(v)
+            raise
         # catalog: table now spans the new width (manifest is authoritative
         # if we crash before this save — see reconcile_widths)
         schema.policy = new_policy
@@ -1439,6 +1457,19 @@ class TableStore:
         return old_files
 
     GC_GRACE_S = 30.0   # snapshot readers finish well within this
+
+    def maybe_fold_manifest(self) -> bool:
+        """Checkpoint the delta backlog into the root snapshot once it
+        reaches manifest_delta_fold_threshold commits (the
+        checkpoint_segments analog). Opportunistic and race-tolerant —
+        a concurrent fold/root writer simply wins the claim."""
+        threshold = 64
+        if self.settings is not None:
+            threshold = int(getattr(self.settings,
+                                    "manifest_delta_fold_threshold", 64))
+        if self.manifest.delta_backlog() < max(1, threshold):
+            return False
+        return self.manifest.fold(min_deltas=max(1, threshold))
 
     def gc_files(self, table: str, rels: list, defer: bool = True) -> None:
         """Reclaim files made unreachable by a commit. Deletion is DEFERRED
@@ -1539,9 +1570,9 @@ class TableStore:
         """Autocommit full-table replacement (see stage_replace)."""
         tx = self.manifest.begin()
         old_files = self.stage_replace(tx, table, enc, valids, raw_strs)
-        v = self.manifest.prepare(tx)
-        self.manifest.commit(v)
+        self.manifest.commit_tables_tx(tx, [table])
         self.gc_files(table, old_files)
+        self.maybe_fold_manifest()
 
     # ---- deletion bitmaps (the appendonly visimap analog) ---------------
     # DELETE/UPDATE never rewrite data files: they publish a per-segment
@@ -1617,12 +1648,12 @@ class TableStore:
         return old_rels
 
     def set_delmask(self, table: str, masks: dict[int, np.ndarray]) -> None:
-        """Autocommit bitmap publish (one manifest commit)."""
+        """Autocommit bitmap publish (one per-table delta commit)."""
         tx = self.manifest.begin()
         old = self.stage_delmask(tx, table, masks)
-        v = self.manifest.prepare(tx)
-        self.manifest.commit(v)
+        self.manifest.commit_tables_tx(tx, [table])
         self.gc_files(table, old)
+        self.maybe_fold_manifest()
 
     def insert_encoded(self, table: str, enc: dict, valids: dict,
                        raw_strs: dict | None = None,

@@ -182,6 +182,133 @@ def test_server_concurrent_clients(db, tmp_path):
         srv.stop()
 
 
+def test_eight_appenders_four_tables_zero_cas_retries(devices8, tmp_path):
+    """The per-table delta-manifest acceptance matrix: 8 concurrent
+    appenders across 4 tables all commit with ZERO manifest CAS retries —
+    writers to different tables never contend on the commit path (each
+    table's delta sequence is its own CAS, the per-segment-WAL analog),
+    and same-table appenders serialize on the session's per-table lock
+    rather than a global manifest claim."""
+    from greengage_tpu.runtime.logger import counters
+
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    for t in "abcd":
+        d.sql(f"create table {t} (k int, v int) distributed by (k)")
+    retry_base = counters.get("manifest_cas_retry_total")
+    delta_base = counters.get("manifest_delta_commits")
+    errs = []
+
+    def appender(table, lo):
+        try:
+            for i in range(6):
+                d.sql(f"insert into {table} values ({lo + i}, 1)")
+        except Exception as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=appender, args=(t, 1000 * j))
+          for j, t in enumerate("abcd" * 2)]    # 2 appenders per table
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    assert counters.get("manifest_cas_retry_total") == retry_base
+    assert counters.get("manifest_delta_commits") >= delta_base + 48
+    for t in "abcd":
+        assert d.sql(f"select count(*) from {t}").rows()[0][0] == 12
+
+
+def test_cross_database_cross_table_appenders_zero_retries(devices8,
+                                                           tmp_path):
+    """Two Database OBJECTS on one cluster dir (the cross-process analog,
+    where no in-process lock can help) appending to DIFFERENT tables:
+    the per-table sequence CAS means neither writer ever retries."""
+    from greengage_tpu.runtime.logger import counters
+
+    d1 = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d1.sql("create table ta (k int, v int) distributed by (k)")
+    d1.sql("create table tb (k int, v int) distributed by (k)")
+    d2 = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    retry_base = counters.get("manifest_cas_retry_total")
+    errs = []
+
+    def w(d, table):
+        try:
+            for i in range(8):
+                d.sql(f"insert into {table} values ({i}, 7)")
+        except Exception as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=w, args=(d1, "ta")),
+          threading.Thread(target=w, args=(d2, "tb"))]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    assert counters.get("manifest_cas_retry_total") == retry_base
+    d3 = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    assert d3.sql("select count(*) from ta").rows()[0][0] == 8
+    assert d3.sql("select count(*) from tb").rows()[0][0] == 8
+
+
+def test_commit_during_reform_fault_aborts_cleanly(db):
+    """The commit_during_reform fault point sits exactly where a mesh
+    re-formation would race a 2PC committer (after the per-table claims,
+    before the commit-log line): an error there must abort the tx with
+    every claim released, admitting the next writer immediately."""
+    faults.inject("commit_during_reform", "error", occurrences=1)
+    try:
+        db.sql("begin")
+        db.sql("insert into acc values (7878, 1)")
+        with pytest.raises(Exception, match="commit_during_reform"):
+            db.sql("commit")
+    finally:
+        faults.reset("commit_during_reform")
+    assert db.sql("select count(*) from acc where id = 7878").rows()[0][0] == 0
+    db.sql("insert into acc values (7879, 1)")   # claims were released
+    assert db.sql("select count(*) from acc where id = 7879").rows()[0][0] == 1
+
+
+@pytest.mark.slow
+def test_appender_storm_folds_racing_commits(devices8, tmp_path):
+    """Chaos tier (the tier1.yml non-blocking chaos step): 16 appenders
+    over 4 tables with the fold threshold at 1 — every commit tries to
+    checkpoint, so root folds race delta prepares continuously — and a
+    sleep-type delta_fold fault parking early folds mid-window to widen
+    the race. Still ZERO cross-table CAS retries, every row lands, and
+    the backlog drains to a plain root on recover()."""
+    from greengage_tpu.runtime.logger import counters
+
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d.sql("set manifest_delta_fold_threshold = 1")
+    for t in "abcd":
+        d.sql(f"create table {t} (k int, v int) distributed by (k)")
+    retry_base = counters.get("manifest_cas_retry_total")
+    faults.inject("delta_fold", "sleep", sleep_s=0.05, occurrences=8)
+    errs = []
+
+    def appender(table, lo):
+        try:
+            for i in range(10):
+                d.sql(f"insert into {table} values ({lo + i}, 1)")
+        except Exception as e:
+            errs.append(e)
+
+    try:
+        ts = [threading.Thread(target=appender, args=(t, 1000 * j))
+              for j, t in enumerate("abcd" * 4)]   # 4 appenders per table
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+    finally:
+        faults.reset("delta_fold")
+    assert not errs, errs
+    assert counters.get("manifest_cas_retry_total") == retry_base
+    for t in "abcd":
+        assert d.sql(f"select count(*) from {t}").rows()[0][0] == 40
+    # a fresh open compacts whatever backlog the storm left behind
+    d2 = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    assert d2.store.manifest.delta_backlog() == 0
+    for t in "abcd":
+        assert d2.sql(f"select count(*) from {t}").rows()[0][0] == 40
+
+
 def test_server_wire_transactions(db, tmp_path):
     """BEGIN/COMMIT are per connection: another client never sees
     uncommitted rows; ROLLBACK discards; a dropped connection aborts."""

@@ -4,9 +4,12 @@ crash_recovery_dtm.sql analog
 
 A real subprocess is SIGKILLed while parked on a fault point inside
 Transaction.commit; the parent then asserts the distributed outcome is
-EXACTLY one of commit/abort (never half), that the in-doubt claim blocks
-concurrent writers until recovery, and that recovery releases it."""
+EXACTLY one of commit/abort (never half), that the in-doubt per-table
+delta claims block concurrent same-table writers until recovery, and that
+recovery releases them. A second family kills the process mid-FOLD (the
+delta-manifest checkpoint) and asserts no committed row is ever lost."""
 
+import json
 import os
 import signal
 import subprocess
@@ -29,6 +32,9 @@ sys.path.insert(0, sys.argv[2])
 from greengage_tpu.runtime.faultinject import faults
 import greengage_tpu
 db = greengage_tpu.connect(sys.argv[1], numsegments=4)
+# connect ran recover() (which may fold/compact, moving the root version):
+# signal the parent that every predicate baseline is safe to sample NOW
+open(sys.argv[1] + ".ready", "w").close()
 faults.inject(sys.argv[3], "sleep", sleep_s=120)
 db.sql("begin")
 db.sql("insert into t values (100000, 7)")
@@ -46,20 +52,35 @@ def _setup(path):
     d.sql("create table u (k int, v int) distributed by (k)")
     d.load_table("u", {"k": np.arange(50), "v": np.arange(50)})
     d.close()
+    return d
 
 
-def _run_child_until(path, fault, wait_for):
+def _run_child_until(path, fault, wait_for, child=CHILD,
+                     extra_env=None):
     """Spawn the committing child, wait for ``wait_for`` (a filesystem
     predicate), then SIGKILL it — the genuine kill -9 the thread-level
     concurrency tests could not deliver."""
     env = dict(os.environ)
     env["GGTPU_PLATFORM"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.update(extra_env or {})
     proc = subprocess.Popen(
-        [sys.executable, "-c", CHILD, path, REPO, fault],
+        [sys.executable, "-c", child, path, REPO, fault],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     deadline = time.monotonic() + 120
     try:
+        # phase 1: the child's connect-time recover() may fold/compact
+        # (both move the root version) — hold every predicate until the
+        # child signals that startup is behind it, or the baselines race
+        while time.monotonic() < deadline:
+            if os.path.exists(path + ".ready"):
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"child exited early:\n{proc.stdout.read()}")
+            time.sleep(0.05)
+        else:
+            raise AssertionError("child never finished connecting")
         while time.monotonic() < deadline:
             if wait_for():
                 break
@@ -75,7 +96,38 @@ def _run_child_until(path, fault, wait_for):
     assert proc.returncode == -signal.SIGKILL
 
 
+def _committed_delta_keys(path):
+    """(table, seq) pairs referenced by committed commit-log lines."""
+    m = Manifest(path)
+    root = m._root()
+    lines, _end = m._log_lines(int(root.get("log_pos", 0)))
+    out = set()
+    for line in lines:
+        for t, s in (line.get("t") or {}).items():
+            out.add((t, int(s)))
+    return out
+
+
+def _staged_uncommitted_deltas(path):
+    """Delta claims staged by an in-flight 2PC: files under deltas/ whose
+    (table, seq) no committed log line references — the in-doubt state a
+    kill -9 between prepare_delta and commit_delta leaves behind."""
+    ddir = os.path.join(path, "deltas")
+    if not os.path.isdir(ddir):
+        return []
+    committed = _committed_delta_keys(path)
+    out = []
+    for fn in os.listdir(ddir):
+        if not fn.endswith(".delta"):
+            continue
+        stem, seq_s = fn[:-len(".delta")].rsplit(".", 1)
+        if (stem, int(seq_s)) not in committed:
+            out.append(fn)
+    return out
+
+
 def _staged_above_head(path):
+    """Prepared-but-uncommitted ROOT stages (fold / structural commits)."""
     m = Manifest(path)
     head = m.snapshot().get("version", 0)
     return [fn for fn in os.listdir(path)
@@ -86,24 +138,33 @@ def _staged_above_head(path):
 def test_kill9_between_prepare_and_commit_rolls_back(tmp_path):
     path = str(tmp_path / "c")
     _setup(path)
-    _run_child_until(path, "dtx_after_prepare",
-                     lambda: bool(_staged_above_head(path)))
-    # in-doubt: the prepared claim exists above the committed head ...
-    assert _staged_above_head(path)
+    # wait for BOTH tables' claims: the predicate firing on the first
+    # file would let the SIGKILL land mid-prepare_delta (t staged, u not
+    # yet) instead of at the parked fault point
+    _run_child_until(
+        path, "dtx_after_prepare",
+        lambda: {fn.split(".")[0]
+                 for fn in _staged_uncommitted_deltas(path)} >= {"t", "u"})
+    # in-doubt: the per-table delta claims exist without a commit record...
+    staged = _staged_uncommitted_deltas(path)
+    assert {fn.split(".")[0] for fn in staged} == {"t", "u"}
     m = Manifest(path)
     head_before = m.snapshot().get("version", 0)
-    # ... and a concurrent writer cannot steal the claimed version
+    # ... and a concurrent writer to the SAME table cannot steal the
+    # claimed sequence (the per-table CAS; cross-table writers — here a
+    # fresh table name — are NOT blocked by the in-doubt claims)
     with pytest.raises(RuntimeError, match="write-write conflict"):
         tx = m.begin()
-        m.prepare(tx)
+        tx["tables"]["t"] = dict(tx["tables"]["t"])
+        m.prepare_delta(tx, ["t"])
     # recovery (runs inside connect) resolves the in-doubt tx: ABORT
     d = greengage_tpu.connect(path=path, numsegments=4)
-    assert not _staged_above_head(path)          # claim released
-    assert d.store.manifest.snapshot()["version"] == head_before
+    assert not _staged_uncommitted_deltas(path)      # claims released
+    assert d.store.manifest.snapshot()["version"] >= head_before
     # outcome is exactly-abort: NEITHER half of the transaction applied
     assert d.sql("select count(*) from t").rows()[0][0] == 100
     assert d.sql("select count(*) from u").rows()[0][0] == 50
-    # and the released claim admits new writers
+    # and the released claims admit new writers
     d.sql("insert into t values (555, 555)")
     assert d.sql("select count(*) from t").rows()[0][0] == 101
 
@@ -111,11 +172,15 @@ def test_kill9_between_prepare_and_commit_rolls_back(tmp_path):
 def test_kill9_after_commit_preserves_commit(tmp_path):
     path = str(tmp_path / "c")
     _setup(path)
-    m = Manifest(path)
-    v0 = m.snapshot().get("version", 0)
+    # the commit evidence is the durable commit-LOG line (the delta path's
+    # commit record): the child's startup compaction folds the _setup
+    # loads and truncates the log, so the 2PC's line (t.2) appearing is
+    # baseline-free ground truth — a lazy baseline would race a fast
+    # child that commits before the parent's first poll
     _run_child_until(path, "dtx_after_commit",
-                     lambda: m.snapshot().get("version", 0) > v0)
-    # the swap happened before the kill: recovery must KEEP the commit
+                     lambda: ("t", 2) in _committed_delta_keys(path))
+    # the commit-log line was durable before the kill: recovery must KEEP
+    # the commit (and fold it into the root)
     d = greengage_tpu.connect(path=path, numsegments=4)
     assert d.sql("select count(*) from t").rows()[0][0] == 101   # insert in
     assert d.sql("select count(*) from u").rows()[0][0] == 45    # delete in
@@ -134,10 +199,71 @@ def test_kill9_with_concurrent_writer_exactly_one_outcome(tmp_path):
     path = str(tmp_path / "c")
     _setup(path)
     _run_child_until(path, "dtx_after_prepare",
-                     lambda: bool(_staged_above_head(path)))
+                     lambda: bool(_staged_uncommitted_deltas(path)))
     d = greengage_tpu.connect(path=path, numsegments=4)   # recovers A
     d.sql("insert into u values (777, 1)")                # writer B
     assert d.sql("select count(*) from t").rows()[0][0] == 100   # A aborted
     assert d.sql("select count(*) from u").rows()[0][0] == 51
     # a second recovery pass is idempotent
+    assert d.store.manifest.recover() == []
+
+
+# ---------------------------------------------------------------------------
+# kill -9 during a delta FOLD (the checkpoint): the root replace is atomic
+# and replayed deltas are sequence-guarded, so committed rows survive a
+# crash in either fold window (staged-not-committed / committed-not-GC'd)
+# ---------------------------------------------------------------------------
+
+FOLD_CHILD = r"""
+import os, sys
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[2])
+from greengage_tpu.runtime.faultinject import faults
+import greengage_tpu
+db = greengage_tpu.connect(sys.argv[1], numsegments=4)
+open(sys.argv[1] + ".ready", "w").close()         # startup recovery done
+db.sql("set manifest_delta_fold_threshold = 1")   # fold on every commit
+# start_after targets the fold window: 0 = parked after the fold root is
+# STAGED (before the atomic replace), 1 = parked after the replace
+# (before the folded delta files are GC'd)
+faults.inject("delta_fold", "sleep", sleep_s=120,
+              start_after=int(os.environ.get("GGTPU_FOLD_WINDOW", "0")))
+db.sql("insert into t values (100000, 7)")
+print("FOLDED", flush=True)
+"""
+
+
+@pytest.mark.parametrize("window", [0, 1])
+def test_kill9_mid_fold_loses_no_committed_rows(tmp_path, window):
+    path = str(tmp_path / f"c{window}")
+    _setup(path)
+
+    if window == 0:
+        # parked between staging the fold root and the atomic replace:
+        # the staged claim is visible above the committed head
+        def parked():
+            return bool(_staged_above_head(path))
+    else:
+        # parked after the replace: the new root folded the INSERT's
+        # delta, so its recorded sequence for t reached 2 (t.1 = the
+        # _setup load, folded at the child's startup compaction; t.2 =
+        # the insert). Baseline-free on purpose — a lazy baseline races
+        # a fast child, which can fold before the parent's first poll.
+        def parked():
+            seqs = Manifest(path)._root().get("delta_seqs", {})
+            return int(seqs.get("t", 0)) >= 2
+
+    _run_child_until(path, "delta_fold", parked, child=FOLD_CHILD,
+                     extra_env={"GGTPU_FOLD_WINDOW": str(window)})
+    # the INSERT's commit line was durable before the fold began: whatever
+    # the fold got to, recovery must surface the committed row
+    d = greengage_tpu.connect(path=path, numsegments=4)
+    assert d.sql("select count(*) from t").rows()[0][0] == 101
+    assert d.sql("select v from t where k = 100000").rows() == [(7,)]
+    assert not _staged_above_head(path)          # fold claim resolved
+    assert not _staged_uncommitted_deltas(path)
+    # recovery compacted: the store keeps serving writes
+    d.sql("insert into t values (100001, 8)")
+    assert d.sql("select count(*) from t").rows()[0][0] == 102
     assert d.store.manifest.recover() == []

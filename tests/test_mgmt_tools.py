@@ -233,6 +233,19 @@ def test_ps_and_cancel_smoke(clu, tmp_path, capsys):
             if line is None:
                 time.sleep(0.05)
         assert line is not None, "gg ps never showed the statement"
+        # topology surfacing (the reform counters' operator window):
+        # `gg ps` leads with the cluster state + topology version, and the
+        # status frame carries the mh_*/manifest_* counter family
+        assert "cluster: local  topology v" in out
+        from greengage_tpu.runtime.server import SqlClient
+
+        c = SqlClient(sock)
+        try:
+            st = c.op({"op": "status"})
+        finally:
+            c.close()
+        assert st["ok"] and st["cluster"]["state"] == "local"
+        assert "mh_topology_version" in st["cluster"]["counters"]
         sid = line.split()[0]
         assert run_cli("cancel", sid, "-s", sock) == 0
         assert f"statement {sid} cancelled" in capsys.readouterr().out

@@ -193,6 +193,9 @@ db.sql("insert into f values " + ",".join(f"({i}, {i % 7})" for i in range(2000)
 db.sql("analyze")
 r = db.sql("select count(*), sum(v) from f")
 out["pre"] = [int(x) for x in r.rows()[0]]
+# this test pins the LEGACY degraded fallback (N-1 re-formation has its
+# own tests): without the pin the coordinator would re-form and serve
+db.sql("set mh_reform_enabled = off")
 open(mark + ".phase1", "w").close()
 while not os.path.exists(mark + ".killed"):
     time.sleep(0.05)
@@ -284,8 +287,10 @@ def test_plan_hash_deterministic_across_sessions(devices8, tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# worker death + cross-host mirrors: the re-formed topology serves from
-# PROMOTED mirror trees on surviving roots (ftsprobe.c:968 / VERDICT r4 #8)
+# worker SIGKILL + cross-host mirrors: the gang RE-FORMS over the survivors
+# (N-1 mesh — never the single-process degraded path) and serves every
+# content from PROMOTED mirror trees on surviving roots; DML included
+# (ftsprobe.c:968 / the tentpole acceptance matrix)
 # ---------------------------------------------------------------------------
 
 COORD_MIRROR_DEATH_SCRIPT = r"""
@@ -298,10 +303,13 @@ sys.path.insert(0, os.environ["GGTPU_REPO"])
 from greengage_tpu.parallel.multihost import init_multihost
 mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
 import greengage_tpu
+from greengage_tpu.runtime.logger import counters
 db = greengage_tpu.connect(path, multihost=mh)
 out = {}
 r = db.sql("select count(*), sum(v) from f")
 out["pre"] = [int(x) for x in r.rows()[0]]
+reform0 = counters.get("mh_reform_total")
+topo0 = counters.get("mh_topology_version")
 open(mark + ".phase1", "w").close()
 while not os.path.exists(mark + ".killed"):
     time.sleep(0.05)
@@ -313,9 +321,18 @@ for content in (4, 5, 6, 7):
 r = db.sql("select count(*), sum(v) from f")
 out["post"] = [int(x) for x in r.rows()[0]]
 out["degraded"] = bool(db._mh_degraded)
+out["deg_stats"] = bool(getattr(r, "stats", {}).get("degraded"))
+out["segments"] = r.stats.get("segments")
+out["state"] = db.mh_state()["state"]
+out["reform_delta"] = counters.get("mh_reform_total") - reform0
+out["topo_bumped"] = counters.get("mh_topology_version") > topo0
 out["promoted"] = sorted(
     c for c in range(8)
     if db.catalog.segments.acting_primary(c).preferred_role.value == "m")
+# DML on the re-formed N-1 gang: manifest commits are coordinator-local,
+# so writes flow without the dead worker
+db.sql("delete from f where k < 100")
+out["post_dml"] = int(db.sql("select count(*) from f").rows()[0][0])
 print("RESULT:" + json.dumps(out), flush=True)
 os._exit(0)
 """
@@ -378,9 +395,16 @@ def test_worker_death_promotes_cross_host_mirrors(tmp_path):
     out = json.loads(res[0][len("RESULT:"):])
     want = [2000, sum(i % 7 for i in range(2000))]
     assert out["pre"] == want
-    assert out["degraded"] is True
+    # the gang RE-FORMED over the survivors: never the single-process path
+    assert out["degraded"] is False
+    assert out["deg_stats"] is False
+    assert out["state"] == "n-1"
+    assert out["segments"] == 8           # full local mesh, not a subprocess
+    assert out["reform_delta"] >= 1       # mh_reform_total counted it
+    assert out["topo_bumped"] is True     # mh_topology_version advanced
     assert out["promoted"] == [4, 5, 6, 7]  # mirrors promoted for lost trees
     assert out["post"] == want            # served from mirror data
+    assert out["post_dml"] == 1900        # DML commits on the N-1 gang
 
 
 # ---------------------------------------------------------------------------
@@ -566,8 +590,10 @@ def test_quiesce_keeps_listener_and_gang_rejoins():
 # and rejoin, no sleeps longer than the configured deadlines.
 # ---------------------------------------------------------------------------
 
-def _scripted_gang(tmp_path, settings_json):
-    """Database(multihost=coordinator) + a WorkerChannel the test scripts."""
+def _scripted_gang(tmp_path, settings_json, n_workers=1):
+    """Database(multihost=coordinator) + WorkerChannel(s) the test scripts.
+    Setup statements are host-only (DDL / VALUES insert / analyze), so no
+    worker needs to serve during them."""
     import json as _json
 
     import greengage_tpu
@@ -577,14 +603,17 @@ def _scripted_gang(tmp_path, settings_json):
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "settings.json"), "w") as f:
         f.write(_json.dumps(settings_json))
-    ch, (w,) = _channel_pair()
+    ch, workers = _channel_pair(n_workers=n_workers)
     db = greengage_tpu.connect(path, numsegments=8,
-                               multihost=MultihostRuntime(0, 2, ch))
+                               multihost=MultihostRuntime(0, n_workers + 1,
+                                                          ch))
     db.sql("create table t (k bigint, v int) distributed by (k)")
     db.sql("insert into t values " + ",".join(
         f"({i}, {i % 7})" for i in range(300)))
     db.sql("analyze")
-    return db, ch, w
+    if n_workers == 1:
+        return db, ch, workers[0]
+    return db, ch, workers
 
 
 def _serve_mesh(w, n=100):
@@ -623,12 +652,15 @@ def test_session_hang_at_readiness_degrades_and_rejoins(devices8, tmp_path):
     """Worker goes silent on the readiness round: detection within
     mh_ready_deadline, the statement completes degraded, the worker
     rejoins, and the session returns to mesh dispatch."""
-    # mh_retry_window_s = 0: this test asserts the DEGRADED fallback, so
-    # the transparent read-only redispatch (test_dispatch_retry_*) must
-    # not win the race against the instantly-reconnecting scripted worker
+    # mh_retry_window_s = 0 and mh_reform_enabled = 0: this test asserts
+    # the LEGACY degraded fallback, so neither the transparent read-only
+    # redispatch (test_dispatch_retry_*) nor N-1 re-formation
+    # (test_session_worker_death_reforms_n1_*) may win the race against
+    # the instantly-reconnecting scripted worker
     db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
                                           "mh_ready_deadline": 0.5,
-                                          "mh_retry_window_s": 0})
+                                          "mh_retry_window_s": 0,
+                                          "mh_reform_enabled": 0})
 
     def script():
         from greengage_tpu.parallel.multihost import CoordinatorLost
@@ -680,9 +712,10 @@ def test_session_death_at_go_phase_degrades_and_rejoins(devices8, tmp_path):
     statement completes degraded, and the gang re-forms."""
     from greengage_tpu.runtime.faultinject import faults
 
-    # retry window 0: assert the degraded fallback (see above)
+    # retry window + reform 0: assert the degraded fallback (see above)
     db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
-                                          "mh_retry_window_s": 0})
+                                          "mh_retry_window_s": 0,
+                                          "mh_reform_enabled": 0})
 
     def script():
         from greengage_tpu.parallel.multihost import CoordinatorLost
@@ -720,8 +753,11 @@ def test_session_hang_at_completion_keeps_result_and_rejoins(devices8, tmp_path)
     """Worker answers readiness + go but never acks completion: the
     coordinator's own result stands (it already executed), the session
     degrades within mh_ack_deadline, then recovers on rejoin."""
+    # reform off: this test asserts the LEGACY degraded fallback (the N-1
+    # re-formation path has its own tests below)
     db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
-                                          "mh_ack_deadline": 0.5})
+                                          "mh_ack_deadline": 0.5,
+                                          "mh_reform_enabled": 0})
 
     def script():
         from greengage_tpu.parallel.multihost import CoordinatorLost
@@ -778,6 +814,10 @@ db.sql("insert into f values " + ",".join(f"({i}, {i % 7})" for i in range(2000)
 db.sql("analyze")
 r = db.sql("select count(*), sum(v) from f")
 out["pre"] = [int(x) for x in r.rows()[0]]
+# this test pins the LEGACY degrade-then-rejoin path (the N-1 re-formation
+# path is asserted by the reform tests): without the pin the coordinator
+# would re-form over the survivors and never degrade
+db.sql("set mh_reform_enabled = off")
 # bound the readiness round tightly, then arm a one-shot 4s hang on the
 # worker's ack path (gp_inject_fault dispatched over the control channel)
 db.sql("set mh_ready_deadline = 1")
@@ -958,3 +998,255 @@ def test_dispatch_failure_write_not_retried(devices8, tmp_path):
     assert int(r.rows()[0][0]) == 300
     ch.close()
     t.join(10)
+
+
+# ---------------------------------------------------------------------------
+# N-1 mesh re-formation (the tentpole; docs/ROBUSTNESS.md "Topology
+# re-formation"): a worker SIGKILL re-forms the gang over the SURVIVORS —
+# subsequent statements (DML included) dispatch on the shrunken topology,
+# never the single-process degraded path — and a rejoin restores full
+# strength. Scripted 3-process gang: coordinator + 2 worker channels.
+# ---------------------------------------------------------------------------
+
+class _ReformWorker:
+    """Scripted gang member for the re-formation tests: serves sync/ping/
+    sql frames, survives quiesce teardowns by redialing the kept listener
+    (the survivor half of re-formation), and can be killed — an abrupt
+    socket close with no stop frame, the SIGKILL analog — then later
+    allowed back in (the rejoin half). Reads BLOCK like the real
+    worker_loop; every control transition arrives as a socket error
+    (short recv timeouts poison the channel's buffered reader)."""
+
+    def __init__(self, w):
+        self.w = w
+        self.die = threading.Event()
+        self.dead = threading.Event()   # the close actually landed
+        self.rejoin = threading.Event()
+        self.halt = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def kill(self):
+        """SIGKILL analog: shut the socket down under the serving thread —
+        EOF with no stop frame. (shutdown, not close: closing the makefile
+        from another thread deadlocks against an in-flight readline.) The
+        thread parks until allow_rejoin()."""
+        self.die.set()
+        self._shutdown()
+
+    def allow_rejoin(self):
+        self.rejoin.set()
+
+    def close(self):
+        self.halt.set()
+        self.rejoin.set()
+        self._shutdown()
+        self.thread.join(10)
+        self.w.close()
+
+    def _shutdown(self):
+        try:
+            self.w._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def _redial(self):
+        end = time.monotonic() + 15
+        while time.monotonic() < end and not self.halt.is_set():
+            if self.w.reconnect():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _run(self):
+        from greengage_tpu.parallel.multihost import CoordinatorLost
+
+        w = self.w
+        while not self.halt.is_set():
+            try:
+                msg = w.recv()
+                op = msg.get("op")
+                if op == "stop":
+                    return
+                if op == "sync":
+                    w.ack(True, topology_version=msg.get("topology_version"))
+                elif op == "ping":
+                    w.ack(True)
+                elif op == "sql":
+                    w.ack(True)                     # readiness
+                    if w.recv().get("op") == "go":
+                        w.ack(True)                 # completion
+            except (CoordinatorLost, OSError):
+                if self.halt.is_set():
+                    return
+                if self.die.is_set():               # killed: hold the EOF
+                    self.dead.set()
+                    self.rejoin.wait(60)
+                    if self.halt.is_set():
+                        return
+                    self.die.clear()
+                    self.rejoin.clear()
+                    self.dead.clear()
+                if not self._redial():              # quiesce/rejoin redial
+                    return
+
+
+def test_worker_sigkill_reforms_n1_then_rejoin_restores_full(devices8,
+                                                             tmp_path):
+    """The acceptance matrix: SIGKILL a worker mid-session -> the next
+    statement (and DML) runs on the re-formed N-1 gang, counted in
+    mh_reform_total with a bumped mh_topology_version; the worker's
+    rejoin restores the full topology."""
+    from greengage_tpu.runtime.logger import counters
+
+    db, ch, (w1, w2) = _scripted_gang(
+        tmp_path, {"mh_heartbeat_interval": 0, "mh_ready_deadline": 2,
+                   "mh_reform_deadline_s": 5}, n_workers=2)
+    g1, g2 = _ReformWorker(w1), _ReformWorker(w2)
+    try:
+        want = [300, sum(i % 7 for i in range(300))]
+        r = db.sql("select count(*), sum(v) from t")
+        assert [int(x) for x in r.rows()[0]] == want
+        assert db.mh_state()["state"] == "full"
+        base_reform = counters.get("mh_reform_total")
+        topo0 = counters.get("mh_topology_version")
+
+        g1.kill()                    # worker 1 dies: abrupt close, no stop
+        assert g1.dead.wait(5), "scripted worker never closed its socket"
+        r = db.sql("select count(*), sum(v) from t")
+        assert [int(x) for x in r.rows()[0]] == want
+        assert not r.stats.get("degraded"), \
+            "worker death fell to the single-process path instead of N-1"
+        assert r.stats.get("segments") == 8
+        assert db._mh_degraded is None
+        st = db.mh_state()
+        assert st["state"] == "n-1"
+        assert st["active_workers"] == 1 and st["expected_workers"] == 2
+        assert counters.get("mh_reform_total") == base_reform + 1
+        assert counters.get("mh_topology_version") > topo0
+        assert counters.get("mh_topology_version") == \
+            db.catalog.segments.version
+
+        # DML on the re-formed gang: manifest commits are coordinator-local
+        db.sql("delete from t where k < 5")
+        r = db.sql("select count(*) from t")
+        assert int(r.rows()[0][0]) == 295
+        assert db.mh_state()["state"] == "n-1"
+
+        topo_n1 = counters.get("mh_topology_version")
+        g1.allow_rejoin()            # the lost worker returns
+        end = time.monotonic() + 10
+        while db.mh_state()["state"] != "full" and time.monotonic() < end:
+            db.mh_try_recover()
+            time.sleep(0.05)
+        assert db.mh_state()["state"] == "full", \
+            "rejoin never restored the full topology"
+        assert counters.get("mh_topology_version") > topo_n1
+        r = db.sql("select count(*), sum(v) from t")
+        assert int(r.rows()[0][0]) == 295
+        assert r.stats.get("segments") == 8
+    finally:
+        g1.close()
+        g2.close()
+        ch.close()
+
+
+@pytest.mark.parametrize("fault", ["mesh_reform",
+                                   "mirror_promote_during_reform"])
+def test_reform_fault_falls_back_to_degraded(devices8, tmp_path, fault):
+    """A re-formation that fails at either fault point (the reform step
+    itself, or mirror promotion inside it) must take the legacy degraded
+    path — bounded, never a hang or a half-formed gang — and the normal
+    full-gang rejoin must still recover it."""
+    from greengage_tpu.runtime.faultinject import faults
+    from greengage_tpu.runtime.logger import counters
+
+    db, ch, w = _scripted_gang(tmp_path, {"mh_heartbeat_interval": 0,
+                                          "mh_retry_window_s": 0})
+    t = threading.Thread(target=_die_then_rejoin, args=(w,), daemon=True)
+    t.start()
+    base = counters.get("mh_reform_total")
+    faults.inject(fault, "error", occurrences=1)
+    try:
+        r = db.sql("select count(*) from t")
+    finally:
+        faults.reset(fault)
+    assert int(r.rows()[0][0]) == 300
+    assert r.stats.get("degraded") is True
+    assert db._mh_degraded
+    assert counters.get("mh_reform_total") == base
+    assert _recover(db), "gang never recovered after worker rejoin"
+    r = db.sql("select count(*) from t")
+    assert int(r.rows()[0][0]) == 300
+    assert r.stats.get("segments") == 8
+    ch.close()
+    t.join(10)
+
+# ---------------------------------------------------------------------------
+# chaos tier (slow; the tier1.yml non-blocking chaos step): repeated
+# kill -> N-1 reform -> rejoin -> full cycles, with the reform fault
+# points armed on later cycles so the degraded fallback and the recovery
+# from it are exercised in the SAME session as successful re-formations
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_reform_rejoin_chaos_cycles(devices8, tmp_path):
+    """Three kill/rejoin cycles against one session: every cycle must land
+    in n-1 (never the single-process path), serve reads AND writes there,
+    and restore full strength on rejoin — with monotonically advancing
+    mh_reform_total / mh_topology_version. Cycle 2 arms a one-shot
+    mesh_reform fault, so that cycle degrades instead, recovers via the
+    full-gang rejoin, and the NEXT cycle still re-forms cleanly."""
+    from greengage_tpu.runtime.faultinject import faults
+    from greengage_tpu.runtime.logger import counters
+
+    db, ch, (w1, w2) = _scripted_gang(
+        tmp_path, {"mh_heartbeat_interval": 0, "mh_ready_deadline": 2,
+                   "mh_reform_deadline_s": 5}, n_workers=2)
+    g1, g2 = _ReformWorker(w1), _ReformWorker(w2)
+    rows = 300
+    try:
+        for cycle, faulted in enumerate((False, True, False)):
+            victim = (g1, g2)[cycle % 2]
+            reform0 = counters.get("mh_reform_total")
+            topo0 = counters.get("mh_topology_version")
+            if faulted:
+                faults.inject("mesh_reform", "error", occurrences=1)
+            try:
+                victim.kill()
+                assert victim.dead.wait(5), \
+                    f"cycle {cycle}: worker never closed its socket"
+                r = db.sql("select count(*) from t")
+            finally:
+                if faulted:
+                    faults.reset("mesh_reform")
+            assert int(r.rows()[0][0]) == rows
+            if faulted:
+                assert r.stats.get("degraded") is True
+                assert counters.get("mh_reform_total") == reform0
+            else:
+                assert not r.stats.get("degraded"), \
+                    f"cycle {cycle} fell to the single-process path"
+                assert db.mh_state()["state"] == "n-1"
+                assert counters.get("mh_reform_total") == reform0 + 1
+                assert counters.get("mh_topology_version") > topo0
+                # writes flow on the shrunken gang every cycle
+                db.sql(f"delete from t where k = {cycle}")
+                rows -= 1
+                assert int(db.sql("select count(*) from t")
+                           .rows()[0][0]) == rows
+            victim.allow_rejoin()
+            end = time.monotonic() + 10
+            while db.mh_state()["state"] != "full" \
+                    and time.monotonic() < end:
+                db.mh_try_recover()
+                time.sleep(0.05)
+            assert db.mh_state()["state"] == "full", \
+                f"cycle {cycle}: rejoin never restored the full topology"
+            r = db.sql("select count(*) from t")
+            assert int(r.rows()[0][0]) == rows
+            assert r.stats.get("segments") == 8
+    finally:
+        g1.close()
+        g2.close()
+        ch.close()
