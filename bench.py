@@ -811,6 +811,30 @@ def timed(db, sql, runs):
     return best, first, r
 
 
+def record_trace(db, qname: str) -> str | None:
+    """Export the newest statement trace (the last timed run) as Chrome
+    trace_event JSON next to the bench cluster, so an unwedged TPU run
+    yields a per-phase PROFILE (stage vs dispatch vs fetch spans), not
+    just a headline number. Best-effort — profiling must never fail the
+    measurement."""
+    try:
+        from greengage_tpu.runtime.trace import TRACES, to_chrome
+
+        tr = TRACES.last()
+        if tr is None:
+            return None
+        path = os.path.join(db.path, f"trace_{qname}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(to_chrome(tr), f)
+        os.replace(tmp, path)
+        log(f"trace recorded: {path}")
+        return path
+    except Exception as e:
+        log(f"trace recording failed (non-fatal): {e}")
+        return None
+
+
 class _Setup:
     """Shared by --run (measurement) and --prewarm (cache population):
     connect / validate-or-load the bench cluster, expose sidecar-cached
@@ -952,6 +976,7 @@ def run_child():
             # the three queries' column sets together exceed HBM
             db.executor._stage_cache.clear()
             best, first, r = timed(db, sql, RUNS)
+            trace_path = record_trace(db, qname)
             cpu_s = get_baseline(qname)
             value = n_rows / best
             base = n_rows / cpu_s
@@ -962,6 +987,7 @@ def run_child():
                 "cpu_baseline_ms": round(cpu_s * 1e3, 1),
                 "vs_baseline": round(value / base, 3),
                 "rows_out": len(r),
+                "trace": trace_path,
             }
             if qname == "q1":
                 assert len(r) == 6, f"Q1 expected 6 groups, got {len(r)}"

@@ -134,6 +134,16 @@ class Settings:
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True
+    # observability (docs/OBSERVABILITY.md; the gpperfmon analog):
+    # trace_enabled records per-phase spans for every statement into the
+    # bounded completed-trace ring (`gg trace <id>` exports Chrome
+    # trace_event JSON); log_min_duration_ms additionally writes a
+    # slow_statement log row (plan digest + trace id) and dumps the trace
+    # JSON beside the CSV logs for any statement at/above the threshold
+    # (-1 disables, 0 logs every statement)
+    trace_enabled: bool = True
+    trace_ring_size: int = 64
+    log_min_duration_ms: float = -1.0
     # continuous archiving (archive_mode/archive_command analog): after
     # each committed write, ship the new manifest version + its new
     # segment files to archive_dir (storage/archive.py); `gg restore-pitr`

@@ -31,8 +31,9 @@ from greengage_tpu.exec.compile import (VALID_PREFIX, Compiler, CompileResult,
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.faultinject import faults
-from greengage_tpu.runtime.logger import counters
+from greengage_tpu.runtime.logger import counters, histograms
 from greengage_tpu.runtime.runaway import TRACKER
 
 # per-statement I/O accounting reported in Result.stats["scan_io"] and the
@@ -309,22 +310,23 @@ class Executor:
                 if ck is not None:
                     counters.inc("program_cache_miss")
                 t_comp = time.monotonic()
-                if sig_comp is not None:
-                    # reuse the signature walk's Compiler (same args by
-                    # construction on this branch: the cacheable gate above
-                    # pins instrument/overrides/aux off)
-                    comp = sig_comp.compile(plan)
-                else:
-                    comp = Compiler(self.catalog, self.store, self.mesh,
-                                    self.nseg, consts, self.settings,
-                                    tier=tier, cap_overrides=cap_overrides,
-                                    instrument=instrument,
-                                    multihost=self.multihost is not None,
-                                    scan_cap_override=scan_cap_override,
-                                    aux_tables=aux_tables,
-                                    pack_disabled=pack_disabled,
-                                    fused_disabled=fused_disabled,
-                                    no_direct=no_direct).compile(plan)
+                with _trace.span("compile", tier=tier, cached=False):
+                    if sig_comp is not None:
+                        # reuse the signature walk's Compiler (same args by
+                        # construction on this branch: the cacheable gate
+                        # above pins instrument/overrides/aux off)
+                        comp = sig_comp.compile(plan)
+                    else:
+                        comp = Compiler(self.catalog, self.store, self.mesh,
+                                        self.nseg, consts, self.settings,
+                                        tier=tier, cap_overrides=cap_overrides,
+                                        instrument=instrument,
+                                        multihost=self.multihost is not None,
+                                        scan_cap_override=scan_cap_override,
+                                        aux_tables=aux_tables,
+                                        pack_disabled=pack_disabled,
+                                        fused_disabled=fused_disabled,
+                                        no_direct=no_direct).compile(plan)
                 compile_ms = (time.monotonic() - t_comp) * 1e3
                 if ck is not None:
                     # keep the compiled SPMD program for repeated dispatch
@@ -359,14 +361,16 @@ class Executor:
 
                     try:
                         res, npasses = spill.spill_run(
-                            self, plan, consts, out_cols, raw)
+                            self, plan, consts, out_cols, raw,
+                            instrument=instrument)
                     except spill.NotSpillable:
                         try:
                             # external-merge sort spill (tuplesort role):
                             # ORDER BY results merge on the host from
                             # per-pass device-sorted runs
                             res, npasses = spill.spill_sort_run(
-                                self, plan, consts, out_cols, raw)
+                                self, plan, consts, out_cols, raw,
+                                instrument=instrument)
                         except spill.NotSpillable:
                             raise QueryError(
                                 f"query would allocate ~"
@@ -405,25 +409,30 @@ class Executor:
             # I/O counter deltas this statement caused
             io0 = {k: counters.get(k) for k in SCAN_COUNTERS}
             t_stage = time.monotonic()
-            inputs = self._stage(comp, snapshot, pvec)
-            if comp.param_dtypes:
-                inputs = list(inputs) + [
-                    self._put_param(np.asarray([v], dtype=dt))
-                    for v, dt in zip(pvec.values, comp.param_dtypes)]
+            with _trace.span("stage", cat="stage",
+                             tables=len(comp.input_spec)) as _sp_stage:
+                inputs = self._stage(comp, snapshot, pvec)
+                if comp.param_dtypes:
+                    inputs = list(inputs) + [
+                        self._put_param(np.asarray([v], dtype=dt))
+                        for v, dt in zip(pvec.values, comp.param_dtypes)]
             t_compute = time.monotonic()
             stage_ms = (t_compute - t_stage) * 1e3
             scan_io = {k: counters.get(k) - io0[k] for k in SCAN_COUNTERS}
+            _trace.annotate(_sp_stage, **scan_io)
             # last cancellation point before dispatch: once the program
             # is on the device it runs to this boundary (the documented
             # semantic — XLA programs cannot be preempted mid-flight)
             faults.check("cancel_before_dispatch")
             interrupt.check_interrupts()
             try:
-                flat = comp.device_fn(*inputs)
-                # resolve async dispatch here so compute_ms is the device
-                # program (and a deferred pallas failure still lands in
-                # the retry logic below, not in device_get)
-                jax.block_until_ready(flat)
+                with _trace.span("dispatch", cat="device", tier=tier,
+                                 est_bytes=comp.est_bytes):
+                    flat = comp.device_fn(*inputs)
+                    # resolve async dispatch here so compute_ms is the
+                    # device program (and a deferred pallas failure still
+                    # lands in the retry logic below, not in device_get)
+                    jax.block_until_ready(flat)
             except Exception as e:
                 # a pallas lowering/compile failure on this backend must
                 # not fail the query: retry the SAME tier on the pure-XLA
@@ -451,8 +460,11 @@ class Executor:
             compute_ms = (t_fetch - t_compute) * 1e3
             # ONE device->host fetch for every output (per-transfer latency
             # through tunneled/remote device paths dwarfs per-byte cost)
-            flat = jax.device_get(list(flat))
+            with _trace.span("fetch", cat="device") as _sp_f:
+                flat = jax.device_get(list(flat))
             fetch_ms = (time.monotonic() - t_fetch) * 1e3
+            _trace.annotate(_sp_f, bytes=int(sum(
+                getattr(a, "nbytes", 0) for a in flat)))
             ncols = len(comp.out_cols)
             nflags = len(comp.flag_names)
             flags = dict(zip(comp.flag_names,
@@ -494,7 +506,8 @@ class Executor:
                     # every segment's shard is on the host — finalization
                     # happens per-endpoint at RETRIEVE time
                     return EndpointBatch(comp, flat, snapshot, raw, self.nseg)
-                res = self._finalize(comp, flat, snapshot, raw=raw)
+                with _trace.span("finalize", cat="host"):
+                    res = self._finalize(comp, flat, snapshot, raw=raw)
                 res.wall_ms = (time.monotonic() - t0) * 1e3
                 if not was_cached:
                     # the first dispatch of a fresh program carries the
@@ -546,6 +559,17 @@ class Executor:
                                   for k, v in metrics.items()
                                   if k in comp.node_rows},
                 }
+                # latency histograms (the gpperfmon timing surface):
+                # per-phase host-data-path distributions, exposed as
+                # Prometheus histograms via `gg metrics`
+                histograms.observe("stage_ms", stage_ms)
+                histograms.observe("dispatch_ms", compute_ms)
+                histograms.observe("fetch_ms", fetch_ms)
+                if not was_cached:
+                    # compile_latency_ms, NOT compile_ms: the legacy
+                    # total-ms counter already owns that name and one
+                    # exposition name cannot carry two TYPEs
+                    histograms.observe("compile_latency_ms", compile_ms)
                 return res
             # size the retry from exact cardinalities where the device
             # reported them (join expansion totals)
@@ -583,12 +607,16 @@ class Executor:
 
     def run_single(self, plan, consts, out_cols, raw=False,
                    scan_cap_override=None, row_ranges=None, aux_tables=None,
-                   no_direct=False):
-        """One spill pass: no recursive spilling, no plan caching."""
+                   no_direct=False, instrument=False):
+        """One spill pass: no recursive spilling, no plan caching.
+        ``instrument`` flows through so EXPLAIN ANALYZE of a spilling
+        statement still collects per-node row counts (summed across
+        passes by the spill driver)."""
         return self.run(plan, consts, out_cols, cache_key=None, raw=raw,
                         scan_cap_override=scan_cap_override,
                         row_ranges=row_ranges, aux_tables=aux_tables,
-                        allow_spill=False, no_direct=no_direct)
+                        allow_spill=False, no_direct=no_direct,
+                        instrument=instrument)
 
     # ------------------------------------------------------------------
     def _local_segments(self):
@@ -782,55 +810,63 @@ class Executor:
         done_reads = 0
         for kind, table, cols, cap, key, prune, payload in plans:
             interrupt.check_interrupts()   # between per-table assemblies
-            if kind == "aux":
-                arrays.extend(
-                    self._stage_aux(table, cols, cap, aux[table], shard))
-                continue
-            if kind == "hit":
-                staged, pstats = payload
-                arrays.extend(staged)
-                if pstats is not None:
-                    self._last_prune_stats[table] = pstats
-                continue
-            if kind == "dup":
-                # eviction-immune within the statement: the first
-                # occurrence stored its result here whatever the cache
-                # budget did since
-                staged, pstats = staged_local[key]
-                arrays.extend(staged)
-                if pstats is not None:
-                    self._last_prune_stats[table] = pstats
-                continue
-            for j in range(done_reads, min(done_reads + 2,
-                                           len(read_plans))):
-                _submit(read_plans[j])   # this table + one of lookahead
-            st = payload
-            storage_cols, futs, buffers = \
-                st["storage_cols"], st["futs"], st["buffers"]
-            per_seg = []
-            kept = total_blocks = 0
-            for fut in futs:
-                if fut is None:
-                    per_seg.append(({c: np.empty(0, dtype=np.int64)
-                                     for c in storage_cols}, {}, 0))
+            # one span per (table) staging unit — read+decode+assemble+
+            # device-put for misses, a cache probe for hits; rows/bytes
+            # land in the span args (the trace's data-movement accounting)
+            with _trace.span("stage:" + table, cat="stage",
+                             kind=kind) as _sp_t:
+                if kind == "aux":
+                    arrays.extend(
+                        self._stage_aux(table, cols, cap, aux[table], shard))
                     continue
-                c, v, n, pstat = fut.result()
-                per_seg.append((c, v, n))
-                if pstat is not None:
-                    kept += pstat[0]
-                    total_blocks += pstat[1]
-            if prune and total_blocks:
-                self._last_prune_stats[table] = (kept, total_blocks)
-            staged = self._assemble(table, cols, cap, per_seg, shard,
-                                    buffers)
-            staged_local[key] = (staged, self._last_prune_stats.get(table))
-            if st["rng"] is None:
-                self._stage_cache.put(
-                    key, (staged, self._last_prune_stats.get(table)),
-                    nbytes=sum(int(getattr(a, "nbytes", 64)) for a in staged),
-                    version=version)
-            arrays.extend(staged)
-            done_reads += 1
+                if kind == "hit":
+                    staged, pstats = payload
+                    arrays.extend(staged)
+                    if pstats is not None:
+                        self._last_prune_stats[table] = pstats
+                    continue
+                if kind == "dup":
+                    # eviction-immune within the statement: the first
+                    # occurrence stored its result here whatever the cache
+                    # budget did since
+                    staged, pstats = staged_local[key]
+                    arrays.extend(staged)
+                    if pstats is not None:
+                        self._last_prune_stats[table] = pstats
+                    continue
+                for j in range(done_reads, min(done_reads + 2,
+                                               len(read_plans))):
+                    _submit(read_plans[j])   # this table + one of lookahead
+                st = payload
+                storage_cols, futs, buffers = \
+                    st["storage_cols"], st["futs"], st["buffers"]
+                per_seg = []
+                kept = total_blocks = 0
+                for fut in futs:
+                    if fut is None:
+                        per_seg.append(({c: np.empty(0, dtype=np.int64)
+                                         for c in storage_cols}, {}, 0))
+                        continue
+                    c, v, n, pstat = fut.result()
+                    per_seg.append((c, v, n))
+                    if pstat is not None:
+                        kept += pstat[0]
+                        total_blocks += pstat[1]
+                if prune and total_blocks:
+                    self._last_prune_stats[table] = (kept, total_blocks)
+                staged = self._assemble(table, cols, cap, per_seg, shard,
+                                        buffers)
+                staged_local[key] = (staged,
+                                     self._last_prune_stats.get(table))
+                nbytes = sum(int(getattr(a, "nbytes", 64)) for a in staged)
+                _trace.annotate(_sp_t, rows=int(sum(n for _, _, n in per_seg)),
+                                bytes=nbytes, segments=len(per_seg))
+                if st["rng"] is None:
+                    self._stage_cache.put(
+                        key, (staged, self._last_prune_stats.get(table)),
+                        nbytes=nbytes, version=version)
+                arrays.extend(staged)
+                done_reads += 1
         return arrays
 
     def _read_unit(self, table, child_parts, seg, storage_cols, snapshot,

@@ -25,9 +25,12 @@ from greengage_tpu.exec.executor import Executor, QueryError, Result
 from greengage_tpu.parallel import make_mesh
 from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
+from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.interrupt import (REGISTRY as _INTERRUPTS,
                                              StatementCancelled)
 from greengage_tpu.runtime.logger import counters as _counters
+from greengage_tpu.runtime.logger import histograms as _histograms
+from greengage_tpu.runtime.trace import TRACES as _TRACES
 from greengage_tpu.sql import ast as A
 from greengage_tpu.sql.binder import (Binder, _contains_agg,
                                        type_from_name)
@@ -315,6 +318,21 @@ class Database:
         share the outermost statement's context."""
         ctx, _outer = _INTERRUPTS.enter(
             text, timeout_s=float(self.settings.statement_timeout_s))
+        # statement trace (runtime/trace.py, the gpperfmon query-detail
+        # role): trace id == statement id, so `gg ps` ids address `gg
+        # trace` directly; nested calls share the outermost trace
+        tr, t_outer = _TRACES.enter(
+            ctx.statement_id, text,
+            enabled=bool(getattr(self.settings, "trace_enabled", True)),
+            ring_size=int(getattr(self.settings, "trace_ring_size", 64)))
+        t0 = time.monotonic()
+        root = (tr.begin("statement", cat="statement")
+                if tr is not None and t_outer else None)
+        if t_outer:
+            # slow-log digest source: _cached_plan stashes the bound plan
+            # here; cleared per statement so a slow DML can't pick up the
+            # previous SELECT's digest
+            self._pc_info_local.planned = None
         try:
             return self._sql_inner(text)
         except StatementCancelled as e:
@@ -331,13 +349,70 @@ class Database:
                                    f"{text.strip()[:200]}")
             raise
         finally:
+            if root is not None:
+                tr.end(root)
+            if t_outer:
+                dur_ms = (time.monotonic() - t0) * 1e3
+                _histograms.observe("statement_ms", dur_ms)
+                self._maybe_log_slow(text, dur_ms, ctx.statement_id)
+            _TRACES.exit(tr)
             _INTERRUPTS.exit(ctx)
+
+    def _maybe_log_slow(self, text: str, dur_ms: float,
+                        statement_id: int) -> None:
+        """Slow-statement log (log_min_duration_statement analog): any
+        statement at/above log_min_duration_ms writes one slow_statement
+        row carrying the plan digest and trace id, and exports the trace
+        JSON beside the CSV logs for post-mortems (`gg trace` serves the
+        same ring entry while the process lives). Never raises — logging
+        must not take the query path down."""
+        try:
+            lm = float(getattr(self.settings, "log_min_duration_ms", -1.0))
+            if lm < 0 or dur_ms < lm:
+                return
+            # digest from the plan this statement ACTUALLY bound (stashed
+            # by _cached_plan) — never re-enter the plan cache here: a
+            # plan_hash() call would double-count plan_cache_hit/miss,
+            # record spurious spans, and on an evicted entry re-plan
+            # (scalar subqueries included) on the query path
+            digest = None
+            planned = getattr(self._pc_info_local, "planned", None)
+            if planned is not None:
+                import hashlib
+
+                from greengage_tpu.planner.logical import describe as _desc
+
+                digest = hashlib.sha1(
+                    _desc(planned).encode()).hexdigest()[:16]
+            _counters.inc("slow_statements")
+            self.log.log(
+                "WARNING", "slow_statement",
+                f"duration {dur_ms:.1f} ms >= log_min_duration_ms={lm:g} "
+                f"[trace={statement_id} plan={digest or '-'}]: "
+                f"{text.strip()[:200]}",
+                duration_ms=dur_ms)
+            tr = _TRACES.current()
+            if tr is not None and self.log.enabled:
+                import json as _json
+
+                # the registry sets dur_ms at exit (after this dump):
+                # record the measured duration now so the exported JSON
+                # carries it instead of null
+                tr.dur_ms = dur_ms
+                os.makedirs(os.path.join(self.path, "log"), exist_ok=True)
+                path = os.path.join(self.path, "log",
+                                    f"trace-{statement_id}.json")
+                with open(path, "w") as f:
+                    _json.dump(_trace.to_chrome(tr), f)
+        except Exception:
+            pass
 
     def _sql_inner(self, text: str):
         if self.multihost is not None and self.multihost.is_coordinator:
             return self._coordinator_sql(text)
         out = None
-        stmts = parse(text)
+        with _trace.span("parse", cat="sql"):
+            stmts = parse(text)
         for i, stmt in enumerate(stmts):
             # per-statement attribution even in a multi-statement batch
             what = text.strip() if len(stmts) == 1 else \
@@ -909,6 +984,12 @@ class Database:
                 # mh_ready/ack_deadline, never an unbounded readline).
                 # The WorkerDied handler sits OUTSIDE the admission scope
                 # so a retry redispatch re-admits on a released slot.
+                # The whole exchange is the statement's DISPATCH span:
+                # worker-side spans arrive in the completion acks and
+                # graft under it, so one trace shows the whole cluster
+                _tr = _TRACES.current()
+                _disp = (_tr.begin("dispatch", cat="multihost")
+                         if _tr is not None else None)
                 try:
                     with self._admission():
                         with ch.exchange():
@@ -936,9 +1017,11 @@ class Database:
                                 out = self._execute(stmt)
                             finally:
                                 try:
-                                    ch.collect_acks(
+                                    _acks = ch.collect_acks(
                                         deadline="mh_ack_deadline",
                                         phase="completion")
+                                    if _disp is not None:
+                                        _trace.graft_acks(_tr, _acks, _disp)
                                 except WorkerDied as e:
                                     # our side already finished its mesh
                                     # program: the result stands; later
@@ -967,6 +1050,9 @@ class Database:
                     self._mh_worker_lost(str(e),
                                          getattr(e, "process_id", None))
                     return self._dispatch_failover(stmt, text, e, _is_retry)
+                finally:
+                    if _disp is not None:
+                        _tr.end(_disp)
             else:
                 if isinstance(stmt, A.SetStmt):
                     # settings steer MESH decisions (spill passes, retry
@@ -1479,7 +1565,8 @@ class Database:
         binder = Binder(self.catalog, self.store,
                         subquery_executor=self._scalar_subquery,
                         optimizer=self.settings.optimizer)
-        logical, outs = binder.bind_select(stmt)
+        with _trace.span("bind", cat="plan"):
+            logical, outs = binder.bind_select(stmt)
         planned = plan_query(logical, self.catalog, self.store, self.numsegments,
                              force_multi_join=force_multi_join)
         if info is not None:
@@ -1772,9 +1859,10 @@ class Database:
         from greengage_tpu.sql.paramize import ParamVector, paramize
 
         version = self.store.manifest.snapshot().get("version", 0)
-        norm, pv, sig = (paramize(stmt, self.catalog)
-                         if self.settings.plan_cache_params
-                         else (stmt, None, None))
+        with _trace.span("paramize", cat="plan"):
+            norm, pv, sig = (paramize(stmt, self.catalog)
+                             if self.settings.plan_cache_params
+                             else (stmt, None, None))
         if sig is not None and sig in self._paramize_fallback:
             # this shape is known-unparameterizable: plan value-pinned
             # directly instead of re-paying the doomed normalized bind
@@ -1805,13 +1893,15 @@ class Database:
                 _counters.inc("plan_cache_hit")
                 info["hit"] = True
                 planned, consts, outs, ek, ptypes = hit
+                self._pc_info_local.planned = planned   # slow-log digest
                 return planned, self._attach_params(consts, pv, ptypes,
                                                     info), outs, ek
         _counters.inc("plan_cache_miss")
         ptypes = pv.types if (pv is not None and norm is not stmt) else None
         try:
-            planned, consts, outs = self._plan(
-                norm, force_multi_join=force_multi_join)
+            with _trace.span("plan", cat="plan"):
+                planned, consts, outs = self._plan(
+                    norm, force_multi_join=force_multi_join)
         except (SqlError, NotImplementedError, TypeError):
             if ptypes is None:
                 raise
@@ -1828,8 +1918,9 @@ class Database:
             ptypes = None
             key_sig = repr(stmt)
             key = (key_sig, version)
-            planned, consts, outs = self._plan(
-                stmt, force_multi_join=force_multi_join)
+            with _trace.span("plan", cat="plan", fallback=True):
+                planned, consts, outs = self._plan(
+                    stmt, force_multi_join=force_multi_join)
         ek = key_sig + ("#multi" if force_multi_join else "")
         cache[key] = (planned, consts, outs, ek, ptypes)
         try:
@@ -1842,6 +1933,7 @@ class Database:
                 cache.popitem(last=False)
             except KeyError:   # concurrent statement emptied it
                 break
+        self._pc_info_local.planned = planned   # slow-log digest source
         return planned, self._attach_params(consts, pv, ptypes,
                                             info), outs, ek
 
@@ -1945,12 +2037,15 @@ class Database:
                 planned, consts, outs, _ek = self._cached_plan(stmt.query)
                 pc_info = dict(self._plan_cache_info)
             # per-node instrumentation (explain_gp.c's Instrumentation
-            # tree analog): every operator reports its actual output rows
+            # tree analog): every operator reports its actual output rows;
+            # device time is attributed per node proportional to its rows
+            # (one fused XLA program has no per-op clocks — the
+            # host-attributed split Theseus-style accounting needs), and
+            # Motion nodes additionally report the bytes they moved
             res = self.executor.run(planned, consts, outs, instrument=True,
                                     aux_tables=aux or None)
             s = res.stats or {}
-            annot = {pid: f"actual rows={n}"
-                     for pid, n in (s.get("node_rows") or {}).items()}
+            annot = self._analyze_annotations(planned, s)
             text = describe(planned, annot=annot)
             text += (f"\n Plan cache: {'hit' if pc_info.get('hit') else 'miss'}"
                      f"{' (fallback: unparameterizable shape)' if pc_info.get('fallback') else ''}"
@@ -1993,6 +2088,44 @@ class Database:
                    valids={}, _order=["p"])
         r.plan_text = text
         return r
+
+    @staticmethod
+    def _analyze_annotations(planned, s: dict) -> dict:
+        """Per-plan-node EXPLAIN ANALYZE annotations: actual rows out,
+        host-attributed device ms (the whole program is one fused XLA
+        dispatch, so compute_ms splits proportional to each node's rows —
+        exact per-segment clocks would need per-op program breaks), and
+        moved bytes for Motion nodes (rows x output row width). Keys are
+        id(plan-node), matching describe()'s annot contract."""
+        from greengage_tpu.planner.logical import Motion as _Motion
+
+        node_rows = s.get("node_rows") or {}
+        if not node_rows:
+            return {}
+        id2node = {}
+        stack = [planned]
+        while stack:
+            p = stack.pop()
+            id2node[id(p)] = p
+            stack.extend(p.children)
+        total = sum(node_rows.values())
+        compute = float(s.get("compute_ms") or 0.0)
+        annot = {}
+        for pid, n in node_rows.items():
+            parts = [f"actual rows={n}"]
+            if total > 0 and compute > 0:
+                parts.append(f"device ~{compute * n / total:.2f} ms "
+                             f"(host-attributed)")
+            node = id2node.get(pid)
+            if isinstance(node, _Motion):
+                try:
+                    width = sum(int(c.type.np_dtype.itemsize)
+                                for c in node.out_cols())
+                except Exception:
+                    width = 8
+                parts.append(f"motion ~{n * width} B")
+            annot[pid] = ", ".join(parts)
+        return annot
 
     # ------------------------------------------------------------------
     def _create_table(self, stmt: A.CreateTableStmt):
@@ -2116,18 +2249,25 @@ class Database:
     def _admission(self):
         """Statement admission: resource-group slot (weighted backoff when
         the global cap binds) nested inside/with the legacy resource
-        queue; either is a no-op when unconfigured."""
+        queue; either is a no-op when unconfigured. The wait is metered
+        into the queue_wait_ms histogram (`gg metrics`) and the
+        statement's trace."""
         from contextlib import ExitStack
 
+        t0 = time.monotonic()
         st = ExitStack()
         try:
-            st.enter_context(self.resgroups.admit())
-            st.enter_context(self.resqueue.admit())
+            with _trace.span("admission", cat="queue"):
+                st.enter_context(self.resgroups.admit())
+                st.enter_context(self.resqueue.admit())
         except BaseException:
             # a queue timeout after the group slot was granted must release
             # the slot (and unpin the thread's group memory ceiling)
             st.close()
             raise
+        finally:
+            _histograms.observe("queue_wait_ms",
+                                (time.monotonic() - t0) * 1e3)
         return st
 
     def resgroup_status(self) -> list[dict]:

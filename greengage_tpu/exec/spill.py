@@ -30,6 +30,7 @@ import numpy as np
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.planner.locus import Locus
 from greengage_tpu.planner.logical import (Aggregate, ColInfo, Filter, Join,
                                            Limit, Motion, MotionKind,
@@ -191,9 +192,14 @@ def _collect_passes(cols_spec, results):
     return cols, valids
 
 
-def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
+def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
+              instrument: bool = False):
     """Execute ``plan`` in partitioned passes. Raises ValueError when the
-    plan shape is not spillable (caller surfaces the vmem rejection)."""
+    plan shape is not spillable (caller surfaces the vmem rejection).
+    ``instrument`` (EXPLAIN ANALYZE) collects per-node row counts from
+    every pass and the merge program, summed back onto the ORIGINAL plan's
+    node identities (the pass subtree shares node objects with the plan;
+    the merge path's clones are remapped via _replace_child's node map)."""
     split = find_spill_split(plan)
     if split is None:
         raise NotSpillable("plan shape not spillable")
@@ -293,10 +299,13 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
             interrupt.check_interrupts()
             if i + 1 < len(combos):
                 prefetcher.kick()
-            pass_results.append(executor.run_single(
-                pass_plan, consts, partial_cols, raw=True,
-                scan_cap_override=caps,
-                row_ranges=dict(combo), no_direct=True))
+            with _trace.span("spill-pass", cat="spill", index=i,
+                             total=len(combos)):
+                pass_results.append(executor.run_single(
+                    pass_plan, consts, partial_cols, raw=True,
+                    scan_cap_override=caps,
+                    row_ranges=dict(combo), no_direct=True,
+                    instrument=instrument))
     finally:
         prefetcher.close()
     aux_cols, aux_valids = _collect_passes(partial_cols, pass_results)
@@ -321,14 +330,16 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
                                executor.nseg)
         m.est_rows = host_scan.est_rows
         repl = m
-    merged = _replace_child(plan, replace_target, repl)
+    node_map: dict = {}
+    merged = _replace_child(plan, replace_target, repl, node_map)
     from greengage_tpu.exec.executor import AdmissionError
 
     try:
-        return executor.run_single(
-            merged, consts, out_cols, raw=raw,
-            aux_tables={aux_name: (aux_cols, aux_valids)},
-            no_direct=True), npasses
+        with _trace.span("spill-merge", cat="spill", passes=npasses):
+            res = executor.run_single(
+                merged, consts, out_cols, raw=raw,
+                aux_tables={aux_name: (aux_cols, aux_valids)},
+                no_direct=True, instrument=instrument)
     except AdmissionError:
         if capture_agg.aggs:          # partial-state merges never regress
             raise
@@ -341,7 +352,31 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool):
         res, extra = _bucketed_dedupe_merge(
             executor, merged, capture_agg, host_scan, aux_name, aux_cols,
             aux_valids, consts, out_cols, raw, limit_bytes)
+        if instrument:
+            _merge_node_rows(res, pass_results, node_map)
         return res, npasses + extra
+    if instrument:
+        _merge_node_rows(res, pass_results, node_map)
+    return res, npasses
+
+
+def _merge_node_rows(res, pass_results, node_map) -> None:
+    """EXPLAIN ANALYZE accounting across spill passes: per-node row
+    counts from the pass programs (whose subtree nodes ARE the original
+    plan's objects) sum with the merge program's (clone ids remapped to
+    their originals), landing in the final Result's stats under the
+    ORIGINAL plan-node identities the session's describe() walk uses."""
+    agg: dict = {}
+    for r in pass_results:
+        for nid, n in (((r.stats or {}).get("node_rows")) or {}).items():
+            agg[nid] = agg.get(nid, 0) + n
+    if isinstance(res.stats, dict):
+        for nid, n in ((res.stats.get("node_rows")) or {}).items():
+            nid = node_map.get(nid, nid)
+            agg[nid] = agg.get(nid, 0) + n
+    else:
+        res.stats = {}
+    res.stats["node_rows"] = agg
 
 
 def _find_partial_above(plan: Plan, target: Plan):
@@ -493,7 +528,8 @@ def _sortable_host_key(arr: np.ndarray, valid, desc: bool,
     return [enc, nul]
 
 
-def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
+def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
+                   instrument: bool = False):
     """External-merge sort spill (tuplesort.c role,
     /root/reference/src/backend/utils/sort/tuplesort.c:1): an ORDER BY
     whose input exceeds HBM runs as partitioned passes of the ORIGINAL
@@ -579,11 +615,13 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
                 # warm the next sorted run's cold reads while this pass's
                 # device sort executes (same files, later row range)
                 prefetcher.kick()
-            res = executor.run_single(
-                pass_plan, consts, out_cols, raw=raw,
-                scan_cap_override={cand: chunk},
-                row_ranges={cand: (p * chunk, (p + 1) * chunk)},
-                no_direct=True)
+            with _trace.span("spill-pass", cat="spill", index=p,
+                             total=npasses):
+                res = executor.run_single(
+                    pass_plan, consts, out_cols, raw=raw,
+                    scan_cap_override={cand: chunk},
+                    row_ranges={cand: (p * chunk, (p + 1) * chunk)},
+                    no_direct=True, instrument=instrument)
             runs.append(res)
     finally:
         prefetcher.close()
@@ -616,23 +654,37 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool):
                  _order=list(base._order),
                  stats=dict(base.stats or {}))
     res.stats["spill_kind"] = "sort"
+    if instrument:
+        # per-node rows sum across the sorted-run passes; the pass plan's
+        # instrumented subtree IS the original plan's node objects (the
+        # Limit, dropped from passes, stays unannotated). Drop pass 0's
+        # counts inherited via base.stats first — _merge_node_rows would
+        # otherwise double-count that pass.
+        res.stats.pop("node_rows", None)
+        _merge_node_rows(res, runs, {})
     return res, npasses
 
 
-def _replace_child(plan: Plan, target: Plan, repl: Plan) -> Plan:
+def _replace_child(plan: Plan, target: Plan, repl: Plan,
+                   node_map: dict | None = None) -> Plan:
     """Shallow-rebuild the path from ``plan`` to ``target`` with the target
-    swapped (the original tree stays untouched for re-raising)."""
+    swapped (the original tree stays untouched for re-raising).
+    ``node_map`` (optional) collects id(clone) -> id(original) for the
+    cloned path nodes so instrumented row counts from the merged plan can
+    be attributed back to the original tree's nodes."""
     import copy
 
     if plan is target:
         return repl
     clone = copy.copy(plan)
+    if node_map is not None:
+        node_map[id(clone)] = id(plan)
     for attr in ("child", "left", "right"):
         c = getattr(plan, attr, None)
         if c is None:
             continue
         if c is target or _contains(c, target):
-            setattr(clone, attr, _replace_child(c, target, repl))
+            setattr(clone, attr, _replace_child(c, target, repl, node_map))
     return clone
 
 

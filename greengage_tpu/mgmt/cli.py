@@ -852,12 +852,74 @@ def cmd_ps(args):
                     f"{cl.get('expected_workers')}")
         print(f"cluster: {cl.get('state', '?')}  "
               f"topology v{cl.get('topology_version', '?')}{gang}")
-    print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} SQL")
+    print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} {'SPAN':>22} SQL")
     for r in rows:
         state = f"cancel:{r['cancelled']}" if r.get("cancelled") else "active"
+        # current execution phase (trace registry): span name + how long
+        # the statement has been inside it — stage vs device vs queue at
+        # a glance, the pg_stat_activity wait_event analog
+        span = "-"
+        if r.get("span"):
+            span = f"{r['span']} {r.get('span_ms', 0):.0f}ms"
         print(f"{r['id']:>6} {r['elapsed_s']:>10.3f} {state:>12} "
-              f"{r['sql']}")
+              f"{span:>22} {r['sql']}")
     print(f"({len(rows)} statements)", file=sys.stderr)
+    return 0
+
+
+def cmd_trace(args):
+    """Chrome trace_event export of one statement's trace (the gpperfmon
+    query-detail analog): `gg trace <id>` (or the newest trace with no
+    id) from a running server's bounded trace ring; load the JSON in
+    chrome://tracing or Perfetto."""
+    from greengage_tpu.runtime.server import SqlClient
+
+    sock = _activity_socket(args)
+    if sock is None:
+        print("error: trace needs -s SOCKET or -d DIR with a running "
+              "server", file=sys.stderr)
+        return 1
+    c = SqlClient(sock)
+    try:
+        req = {"op": "trace"}
+        if args.id is not None:
+            req["id"] = args.id
+        resp = c.op(req)
+    finally:
+        c.close()
+    if not resp.get("ok"):
+        print(f"error: {resp.get('error')}", file=sys.stderr)
+        return 1
+    out = json.dumps(resp["trace"], indent=1)
+    if getattr(args, "out", None):
+        with open(args.out, "w") as f:
+            f.write(out)
+        print(f"trace written to {args.out}", file=sys.stderr)
+    else:
+        print(out)
+    return 0
+
+
+def cmd_metrics(args):
+    """Prometheus text exposition of the cluster's counters, gauges and
+    latency histograms (the gpperfmon/pg_stat export surface): scrape
+    with any Prometheus agent via `gg metrics`, or eyeball directly."""
+    from greengage_tpu.runtime.server import SqlClient
+
+    sock = _activity_socket(args)
+    if sock is None:
+        print("error: metrics needs -s SOCKET or -d DIR with a running "
+              "server", file=sys.stderr)
+        return 1
+    c = SqlClient(sock)
+    try:
+        resp = c.op({"op": "metrics"})
+    finally:
+        c.close()
+    if not resp.get("ok"):
+        print(f"error: {resp.get('error')}", file=sys.stderr)
+        return 1
+    sys.stdout.write(resp["text"])
     return 0
 
 
@@ -1214,6 +1276,20 @@ def main(argv=None):
     p.add_argument("-d", "--dir", default=None)
     p.add_argument("-s", "--socket", default=None)
     p.set_defaults(fn=cmd_cancel)
+
+    p = sub.add_parser("trace")   # Chrome trace_event export (gpperfmon)
+    p.add_argument("id", nargs="?", type=int, default=None,
+                   help="statement id (default: newest completed trace)")
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
+    p.add_argument("-o", "--out", default=None,
+                   help="write the JSON here instead of stdout")
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("metrics")  # Prometheus text exposition
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("server")
     p.add_argument("-d", "--dir", required=True)

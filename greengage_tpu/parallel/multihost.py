@@ -766,12 +766,25 @@ def _serve_one(db, ch) -> bool:
     if nxt.get("op") != "go":
         return True            # coordinator skipped the statement
     # phase 2: the mesh program (collectives rendezvous with the
-    # coordinator's concurrent execution)
+    # coordinator's concurrent execution). The worker traces its side
+    # (runtime/trace.py) and ships the span list in the completion ack so
+    # the coordinator can graft it under its dispatch span — one trace
+    # for the whole cluster's statement.
+    from greengage_tpu.runtime.trace import TRACES
+
+    tr, _ = TRACES.enter(
+        None, msg["sql"],
+        enabled=bool(getattr(db.settings, "trace_enabled", True)))
     try:
         db.worker_sql(msg["sql"])
     except Exception as e:
+        TRACES.exit(tr)
         ch.ack(False, f"{type(e).__name__}: {e}")
         return True
+    # bounded export: one control-channel line carries the ack, and a
+    # pathological pass count must not balloon it
+    spans = tr.export(limit=512) if tr is not None else None
+    TRACES.exit(tr)
     faults.check("worker_ack")
-    ch.ack(True)
+    ch.ack(True, spans=spans, process_id=db.multihost.process_id)
     return True

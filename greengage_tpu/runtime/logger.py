@@ -17,23 +17,33 @@ take the query path down with it.
 
 from __future__ import annotations
 
+import bisect
 import csv
 import datetime
 import io
 import os
+import re
 import threading
 
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL", "PANIC")
+
+# names that are levels, not monotone counts, regardless of how they were
+# first written — the Prometheus exposition must emit `# TYPE ... gauge`
+# for them even in a process that has only inc()'d so far
+GAUGE_NAMES = ("mh_topology_version",)
 
 
 class Counters:
     """Process-wide monotonic event counters (the pg_stat counter surface):
     storage repair/quarantine/scrub events land here so tests and `gg
-    scrub`/`gg state` can assert on behavior without parsing log text."""
+    scrub`/`gg state` can assert on behavior without parsing log text.
+    Names written through set() are tagged as GAUGES (levels, e.g.
+    mh_topology_version) so the Prometheus exposition types them right."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._c: dict[str, int] = {}
+        self._gauges: set[str] = set(GAUGE_NAMES)
 
     def inc(self, name: str, n: int = 1) -> int:
         with self._lock:
@@ -45,7 +55,18 @@ class Counters:
         surface also carries a few level values tests assert on."""
         with self._lock:
             self._c[name] = int(value)
+            self._gauges.add(name)
             return self._c[name]
+
+    def gauges(self) -> set[str]:
+        """Names holding gauge (level) semantics; everything else in
+        snapshot() is a monotone counter."""
+        with self._lock:
+            return set(self._gauges)
+
+    def kind(self, name: str) -> str:
+        with self._lock:
+            return "gauge" if name in self._gauges else "counter"
 
     def get(self, name: str) -> int:
         with self._lock:
@@ -72,6 +93,104 @@ class Counters:
 
 
 counters = Counters()   # shared registry (shmem stats analog)
+
+
+# fixed latency buckets (ms): wide enough for a cold XLA compile, fine
+# enough for a warm cached statement — fixed so two processes' expositions
+# aggregate bucket-by-bucket in Prometheus
+DEFAULT_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+
+class Histograms:
+    """Fixed-bucket latency histograms (the pg_stat_statements timing
+    role, shaped for Prometheus exposition): statement latency, host
+    data-path phases, queue waits. observe() is O(log buckets) under one
+    lock — safe for every statement."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> [buckets tuple, per-bucket counts, overflow, sum, count]
+        self._h: dict[str, list] = {}
+
+    def observe(self, name: str, value_ms: float,
+                buckets: tuple = DEFAULT_BUCKETS_MS) -> None:
+        v = float(value_ms)
+        with self._lock:
+            h = self._h.get(name)
+            if h is None:
+                h = self._h[name] = [tuple(buckets),
+                                     [0] * len(buckets), 0, 0.0, 0]
+            bks, counts, _over, _s, _n = h
+            i = bisect.bisect_left(bks, v)
+            if i < len(bks):
+                counts[i] += 1
+            else:
+                h[2] += 1
+            h[3] += v
+            h[4] += 1
+
+    def snapshot(self) -> dict:
+        """name -> {"buckets": [...], "counts": [...per bucket...],
+        "sum": total_ms, "count": n}; counts are per-bucket (NOT
+        cumulative) — the exposition cumulates."""
+        with self._lock:
+            return {name: {"buckets": list(h[0]), "counts": list(h[1]),
+                           "sum": h[3], "count": h[4]}
+                    for name, h in self._h.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._h.clear()
+
+
+histograms = Histograms()   # shared registry, same lifetime as `counters`
+
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str, prefix: str) -> str:
+    s = _METRIC_NAME_RE.sub("_", name)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return prefix + s
+
+
+def _fmt_float(v: float) -> str:
+    return repr(round(float(v), 6))
+
+
+def prometheus_text(prefix: str = "ggtpu_") -> str:
+    """Prometheus text exposition (format 0.0.4) over the process-wide
+    counters, gauges, and histograms — the `gg metrics` / server
+    {"op":"metrics"} payload. Counter vs gauge typing comes from the
+    Counters gauge tags (set() marks a name as a gauge)."""
+    lines: list[str] = []
+    snap = counters.snapshot()
+    gauges = counters.gauges()
+    for name in sorted(snap):
+        mn = _metric_name(name, prefix)
+        lines.append(f"# TYPE {mn} {'gauge' if name in gauges else 'counter'}")
+        lines.append(f"{mn} {snap[name]}")
+    hsnap = histograms.snapshot()
+    counter_names = {_metric_name(n, prefix) for n in snap}
+    for name in sorted(hsnap):
+        h = hsnap[name]
+        mn = _metric_name(name, prefix)
+        if mn in counter_names:
+            # one exposition name cannot carry two TYPEs: a histogram
+            # colliding with a counter/gauge family exports suffixed
+            mn += "_hist"
+        lines.append(f"# TYPE {mn} histogram")
+        cum = 0
+        for b, c in zip(h["buckets"], h["counts"]):
+            cum += c
+            lines.append(f'{mn}_bucket{{le="{b:g}"}} {cum}')
+        lines.append(f'{mn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{mn}_sum {_fmt_float(h['sum'])}")
+        lines.append(f"{mn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
 
 
 class ClusterLog:
@@ -159,8 +278,6 @@ def filter_entries(entries: list[dict], trouble: bool = False,
                    min_duration_ms: float | None = None) -> list[dict]:
     """gplogfilter semantics: severity gate (-t), regex (-m), time window
     (-b/-e), slow-statement floor."""
-    import re
-
     rx = re.compile(match, re.I) if match else None
     out = []
     for e in entries:

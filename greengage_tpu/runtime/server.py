@@ -211,11 +211,47 @@ class SqlServer:
 
             def _control(self, req: dict) -> dict:
                 """Protocol control ops (never parsed as SQL): 'ps' lists
-                in-flight statements, 'cancel' flags one by id."""
+                in-flight statements, 'cancel' flags one by id, 'metrics'
+                serves the Prometheus text exposition, 'trace' exports one
+                statement's Chrome trace_event JSON from the trace ring."""
                 op = req.get("op")
                 if op == "ps":
-                    return {"ok": True, "rows": REGISTRY.snapshot(),
+                    from greengage_tpu.runtime.trace import TRACES
+
+                    rows = REGISTRY.snapshot()
+                    for r in rows:
+                        # current execution phase from the trace registry
+                        # (`gg ps` SPAN column): deepest open span + its
+                        # elapsed ms, when the statement is traced
+                        sp = TRACES.active_span(r["id"])
+                        if sp is not None:
+                            r["span"], r["span_ms"] = sp[0], round(sp[1], 1)
+                    return {"ok": True, "rows": rows,
                             "cluster": _cluster_status(outer.db)}
+                if op == "metrics":
+                    # Prometheus text exposition over the process-wide
+                    # counters/gauges/histograms (`gg metrics`)
+                    from greengage_tpu.runtime.logger import prometheus_text
+
+                    return {"ok": True, "text": prometheus_text()}
+                if op == "trace":
+                    from greengage_tpu.runtime.trace import TRACES, to_chrome
+
+                    tid = req.get("id")
+                    if tid is None:
+                        tr = TRACES.last()
+                    else:
+                        try:
+                            tr = TRACES.get(int(tid))
+                        except (TypeError, ValueError):
+                            return {"ok": False,
+                                    "error": "trace needs a numeric id"}
+                    if tr is None:
+                        return {"ok": False,
+                                "error": f"no trace for statement {tid!r} "
+                                         "(evicted from the ring, or "
+                                         "tracing is disabled)"}
+                    return {"ok": True, "trace": to_chrome(tr)}
                 if op == "status":
                     # the server status frame: dispatch topology state
                     # (full / n-1 / degraded), FTS topology version, and

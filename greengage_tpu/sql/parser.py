@@ -222,7 +222,12 @@ class Parser:
             name = self.next()[1]
             if not self.accept("op", "="):
                 self.expect("kw", "to")
+            # negative numeric values lex as two tokens ('-', number):
+            # `SET log_min_duration_ms = -1` must parse (-1 = disabled)
+            neg = self.accept("op", "-")
             value = self.next()[1]
+            if neg:
+                value = f"-{value}"
             return A.SetStmt(name, value)
         if self.at_kw("begin"):
             self.next()

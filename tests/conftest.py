@@ -42,6 +42,32 @@ def pytest_sessionfinish(session, exitstatus):
     # a finished run must not leave the timer armed (it would fire inside
     # whatever process reuses this interpreter, e.g. pytest plugins' atexit)
     faulthandler.cancel_dump_traceback_later()
+    # failure forensics (docs/OBSERVABILITY.md): counters live in THIS
+    # process, so a post-mortem shell can't read them — dump the snapshot
+    # and the newest statement trace here, where CI uploads them as
+    # workflow artifacts alongside the cluster CSV logs
+    if exitstatus not in (0, 5):   # 5 = no tests collected
+        import json
+
+        try:
+            from greengage_tpu.runtime.logger import counters, histograms
+
+            with open("/tmp/gg_tier1_counters.json", "w") as f:
+                json.dump({"counters": counters.snapshot(),
+                           "gauges": sorted(counters.gauges()),
+                           "histograms": histograms.snapshot()},
+                          f, indent=1, sort_keys=True)
+        except Exception:
+            pass
+        try:
+            from greengage_tpu.runtime.trace import TRACES, to_chrome
+
+            tr = TRACES.last()
+            if tr is not None:
+                with open("/tmp/gg_tier1_trace.json", "w") as f:
+                    json.dump(to_chrome(tr), f, indent=1)
+        except Exception:
+            pass
 
 
 @pytest.fixture(scope="session")
