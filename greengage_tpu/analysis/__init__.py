@@ -1,0 +1,32 @@
+"""Static analysis suite — the assert-rich-planner discipline as a tool.
+
+Reference parity: the reference walks every sliced plan before dispatch
+(cdbmutate.c checkPlan machinery) and ships an assertion-heavy build for
+development; this package is that discipline turned outward, in two
+halves surfaced as ``gg check``:
+
+* ``plancheck`` — plan-tree invariant validation run on every planned
+  statement under the ``plan_validate`` GUC and over the TPC-H/TPC-DS
+  plan corpus in tests: Motion placement, join/agg distribution-key
+  locality, pow2 capacity bucketing, prune-predicate well-formedness,
+  no interior Gather funnels.
+* ``lint_*`` — stdlib-``ast`` lints over the package source for this
+  codebase's recurring bug classes: lock-order cycles, blocking waits
+  that skip the interrupt registry, host sync inside jit-traced code,
+  executable-cache keys digesting estimates, metric/GUC/fault-point
+  registry drift, and function-local stdlib imports.
+
+All findings flow through one reporter (``report.Report``) with a
+checked-in baseline (``analysis/baseline.txt``) for the rare deliberate
+suppression, so ``gg check`` is zero-findings-clean at merge and gates
+CI thereafter (docs/ANALYSIS.md).
+"""
+
+from greengage_tpu.analysis.plancheck import (PlanInvariantError,
+                                              validate_capacities,
+                                              validate_plan)
+from greengage_tpu.analysis.report import Finding, Report
+from greengage_tpu.analysis.runner import CHECKS, run_checks
+
+__all__ = ["PlanInvariantError", "validate_plan", "validate_capacities",
+           "Finding", "Report", "CHECKS", "run_checks"]

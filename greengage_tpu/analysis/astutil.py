@@ -1,0 +1,135 @@
+"""Shared ``ast`` plumbing for the ``gg check`` lints.
+
+Every lint walks the same parsed package, so sources are read and parsed
+once per run (``SourceSet``). Helpers keep the lints about their
+invariants, not about AST shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from greengage_tpu.analysis.report import line_pragmas
+
+
+def package_root() -> str:
+    """Directory of the ``greengage_tpu`` package itself."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+@dataclass
+class Source:
+    path: str            # absolute
+    rel: str             # repo-relative (the path findings report)
+    text: str
+    tree: ast.Module
+    lines: list[str]
+
+    def pragma_ok(self, lineno: int, check: str) -> bool:
+        """True when the 1-based line (or its statement's first line)
+        carries ``# gg:ok(<check>)``."""
+        if 1 <= lineno <= len(self.lines):
+            if check in line_pragmas(self.lines[lineno - 1]):
+                return True
+        return False
+
+
+class SourceSet:
+    """Parsed sources of the package (and optionally the test tree)."""
+
+    def __init__(self, roots: list[str] | None = None,
+                 exclude: tuple[str, ...] = ()):
+        self.sources: list[Source] = []
+        base = repo_root()
+        for root in roots or [package_root()]:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(path, base)
+                    if any(rel.startswith(e) for e in exclude):
+                        continue
+                    with open(path, encoding="utf-8") as f:
+                        text = f.read()
+                    try:
+                        tree = ast.parse(text, filename=rel)
+                    except SyntaxError:
+                        continue   # not this analyzer's finding to make
+                    self.sources.append(Source(path, rel, text, tree,
+                                               text.splitlines()))
+
+    def __iter__(self):
+        return iter(self.sources)
+
+    def get(self, rel_suffix: str) -> Source | None:
+        for s in self.sources:
+            if s.rel.endswith(rel_suffix):
+                return s
+        return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Trailing name of the called expression: ``a.b.c(...)`` -> ``c``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def dotted(node: ast.expr) -> str | None:
+    """``a.b.c`` -> "a.b.c" for pure Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.expr) -> str | None:
+    """For ``f"name_{x}"`` -> "name_" (the literal head of a JoinedStr);
+    None for non-f-strings or ones not starting with a literal."""
+    if not isinstance(node, ast.JoinedStr) or not node.values:
+        return None
+    head = node.values[0]
+    if isinstance(head, ast.Constant) and isinstance(head.value, str):
+        return head.value
+    return None
+
+
+def functions(tree: ast.Module):
+    """Yield every (possibly nested) function/method definition."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def enclosing_class_map(tree: ast.Module) -> dict[int, str]:
+    """id(function node) -> name of the class that directly owns it."""
+    out: dict[int, str] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[id(item)] = cls.name
+    return out

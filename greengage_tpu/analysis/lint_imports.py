@@ -1,0 +1,62 @@
+"""Import-hygiene lint: no function-local imports of cheap stdlib modules.
+
+Function-local imports are legitimate exactly twice in this codebase:
+breaking package-internal import cycles, and deferring genuinely heavy
+or optional dependencies (jax and friends take ~seconds and initialize
+backends; pandas/yaml/zstandard are optional). Everything else — a
+``import json`` inside a hot helper — re-pays a dict lookup per call,
+hides the module's real dependency surface, and (as PR 4 found with a
+function-local ``import time`` inside the resource-queue admit path)
+lands in exactly the code least prepared for extra latency. PR 4 and
+PR 7 each hoisted stragglers by hand; this lint keeps them hoisted.
+
+Scope: imports of CHEAP_STDLIB modules inside any function/method.
+Package-internal (``greengage_tpu.*``) and heavy/optional imports are
+out of scope by design, not by baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.report import Report
+
+# stdlib modules cheap enough that deferring them buys nothing
+CHEAP_STDLIB = frozenset({
+    "bisect", "collections", "configparser", "contextlib", "copy", "csv",
+    "dataclasses", "datetime", "decimal", "functools", "glob", "hashlib",
+    "io", "itertools", "json", "math", "operator", "os", "pickle", "re",
+    "select", "shutil", "signal", "socket", "string", "struct",
+    "subprocess", "sys", "tarfile", "tempfile", "threading", "time",
+    "types", "uuid", "warnings",
+})
+
+
+def run(sources=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet(
+        exclude=("greengage_tpu/analysis/",))
+    for src in sources:
+        for fn in astutil.functions(src.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Import):
+                    mods = [a.name for a in node.names]
+                elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                        and node.module:
+                    mods = [node.module]
+                else:
+                    continue
+                for mod in mods:
+                    top = mod.split(".", 1)[0]
+                    if top not in CHEAP_STDLIB:
+                        continue
+                    if src.pragma_ok(node.lineno, "imports"):
+                        continue
+                    report.add(
+                        "imports", src.rel, node.lineno,
+                        f"{fn.name}:{mod}",
+                        f"function-local `import {mod}` in {fn.name}() — "
+                        "cheap stdlib imports belong at module top "
+                        "(docs/ANALYSIS.md import hygiene)")
+    return report

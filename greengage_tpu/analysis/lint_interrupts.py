@@ -1,0 +1,139 @@
+"""Interrupt-coverage lint: every blocking host wait on a statement path
+must poll the PR-4 interrupt registry.
+
+The cancellation design (runtime/interrupt.py) is boundary-granular: a
+statement dies only where the host polls. Each new wait site added
+without a poll silently re-opens the "cancel does nothing" bug class
+PR 4 closed, so this lint finds blocking wait shapes statically:
+
+* ``time.sleep`` inside a loop (retry/backoff/poll loops),
+* ``.wait(...)`` on Condition/Event receivers,
+* ``.result(...)`` on futures,
+* ``.recv(...)`` / zero-arg ``.accept()`` socket reads,
+* ``.get(...)`` on queue-named receivers,
+
+and requires an interrupt poll — ``check_interrupts()``, a ``ctx.check()``
+/ ``.check()`` on a statement context, or a ``.cancelled`` test — in the
+same function (helpers may poll beside the wait rather than inside it).
+
+Modules whose waits can NEVER run on a statement thread are exempt here
+with their reason; anything subtler carries an inline ``# gg:ok(interrupts)``
+pragma next to its justification in the source.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.report import Report
+
+# module path prefix (repo-relative) -> why its waits are exempt
+EXEMPT = {
+    "greengage_tpu/mgmt/": "operator CLI process; no statement registry",
+    "greengage_tpu/runtime/server.py":
+        "listener/watcher threads; statement threads poll in the session",
+    "greengage_tpu/runtime/fts.py": "prober daemon thread",
+    "greengage_tpu/runtime/standby.py": "standby sync runs off-statement",
+    "greengage_tpu/runtime/runaway.py":
+        "cleaner thread; victims die at their own cancellation points",
+    "greengage_tpu/runtime/faultinject.py":
+        "test machinery; suspend loops end by fault reset",
+    "greengage_tpu/runtime/replication.py":
+        "mirror copy pool joins are commit-side, bounded by file count",
+    "greengage_tpu/storage/": "storage write/GC paths; statement-side "
+                              "reads poll in exec/staging and exec/executor",
+    "greengage_tpu/runtime/ingest.py": "host CSV parse helpers",
+    "greengage_tpu/analysis/": "the analyzers themselves",
+}
+
+_POLL_ATTRS = {"check", "check_interrupts"}
+
+
+def _is_exempt(rel: str) -> str | None:
+    for prefix, why in EXEMPT.items():
+        if rel.startswith(prefix):
+            return why
+    return None
+
+
+def _has_poll(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name is not None and name.endswith("check_interrupts"):
+                return True
+            if name == "check" and isinstance(node.func, ast.Attribute):
+                recv = astutil.dotted(node.func.value) or ""
+                if "ctx" in recv or "interrupt" in recv.lower() \
+                        or recv.endswith("TRACKER"):
+                    return True
+        elif isinstance(node, ast.Attribute) and node.attr == "cancelled":
+            return True
+    return False
+
+
+def _wait_kind(node: ast.Call, in_loop: bool) -> str | None:
+    name = astutil.call_name(node)
+    if name is None or not isinstance(node.func, ast.Attribute):
+        if name == "sleep":   # bare `sleep(...)` from `from time import`
+            return "sleep-loop" if in_loop else None
+        return None
+    recv = astutil.dotted(node.func.value) or ""
+    if name == "sleep" and recv.endswith("time"):
+        return "sleep-loop" if in_loop else None
+    if name == "wait":
+        return "condition-wait"
+    if name == "result":
+        return "future-result"
+    if name in ("recv", "recv_into"):
+        return "socket-recv"
+    if name == "accept" and not node.args and not node.keywords:
+        return "socket-accept"
+    if name == "get" and ("queue" in recv.lower() or recv in ("q", "jobs")):
+        return "queue-get"
+    return None
+
+
+def run(sources=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet()
+    exempt_count = 0
+    for src in sources:
+        why = _is_exempt(src.rel)
+        for fn in astutil.functions(src.tree):
+            # loops owned by THIS function (not nested defs)
+            loop_lines: set[int] = set()
+            own_nodes: list[ast.AST] = []
+            stack: list[ast.AST] = list(fn.body)
+            while stack:
+                n = stack.pop()
+                own_nodes.append(n)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(n, (ast.While, ast.For)):
+                    for sub in ast.walk(n):
+                        loop_lines.add(getattr(sub, "lineno", -1))
+                stack.extend(ast.iter_child_nodes(n))
+            polled = _has_poll(fn)
+            for n in own_nodes:
+                if not isinstance(n, ast.Call):
+                    continue
+                kind = _wait_kind(n, n.lineno in loop_lines)
+                if kind is None:
+                    continue
+                if why is not None:
+                    exempt_count += 1
+                    continue
+                if polled:
+                    continue
+                if src.pragma_ok(n.lineno, "interrupts"):
+                    continue
+                report.add(
+                    "interrupts", src.rel, n.lineno,
+                    f"{fn.name}:{kind}",
+                    f"blocking wait ({kind}) in {fn.name}() without an "
+                    "interrupt poll — a cancelled statement blocks here "
+                    "forever (runtime/interrupt.py discipline)")
+    report.notes["interrupt_exempt_waits"] = exempt_count
+    return report

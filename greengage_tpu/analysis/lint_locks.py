@@ -1,0 +1,301 @@
+"""Static lock-order analysis over the package's ~35 lock sites.
+
+Deadlock class this targets: thread 1 takes A then B while thread 2
+takes B then A. The chaos storm in PR 6 caught two manifest-lock races
+only at runtime; this extracts the ACQUISITION GRAPH statically and
+fails on cycles, so an inconsistent order is a merge-time finding.
+
+Model (heuristic by design — suppressions go through the baseline):
+
+* A lock identity is the attribute (or module global) a ``threading``
+  Lock/RLock/Condition (or the session ``_RWLock``) is assigned to,
+  named ``module.Class.attr``. Dict-stored per-key lock families
+  (``self._repair_locks[...]``, ``self._table_locks[...]``) collapse to
+  one identity each — ordering *within* such a family is the runtime
+  hook's job (``runtime/lockdebug.py``), not static analysis.
+* An acquisition is ``with <lock>:``, ``<lock>.acquire()``, or — for
+  Condition-backed classes — ``with self._cond`` / ``wait()`` blocks.
+* Held-across edges: inside a ``with A`` body, every direct acquisition
+  of B adds A -> B, and every CALL to a package function/method known
+  to directly acquire B adds A -> B (one interprocedural hop, resolved
+  by method name across the package — deliberately conservative).
+
+A cycle in that graph is a finding naming the participating locks.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.report import Report
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "_RWLock"}
+
+# Method names too generic to resolve by name across the package: a call
+# ``x.get(...)`` under a held lock is almost always dict/queue access, not
+# ``StatementRegistry.get`` — resolving it to every lock-acquiring class
+# with a ``get`` method fabricates cycles. Calls to these names create
+# interprocedural edges only for ``self.<name>()`` (resolved to the same
+# class, which IS reliable).
+_GENERIC_METHODS = frozenset({
+    "get", "set", "add", "pop", "popitem", "update", "clear", "append",
+    "remove", "discard", "keys", "values", "items", "copy", "close",
+    "put", "join", "start", "run", "send", "write", "read", "next",
+    "check", "reset", "wait", "notify", "notify_all", "info", "error",
+    "log", "snapshot", "describe", "observe", "inc",
+})
+
+# attribute names that ARE locks but are assigned indirectly (aliases the
+# constructor scan below can't see): Condition(self._lock) keeps the
+# underlying lock identity, so alias both names to one node
+_KNOWN_ALIASES = {
+    # resqueue: self._slots = threading.Condition(self._lock)
+    ("runtime.resqueue", "_slots"): ("runtime.resqueue", "_lock"),
+}
+
+
+def _lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = astutil.call_name(node)
+    if name == "named" and node.args:
+        # lockdebug.named(threading.Lock(), "...") keeps lock identity
+        return _lock_ctor(node.args[0])
+    return name in _LOCK_CTORS
+
+
+def _module_key(rel: str) -> str:
+    # greengage_tpu/runtime/resqueue.py -> runtime.resqueue
+    parts = rel.replace("\\", "/").split("/")
+    if parts and parts[0] == "greengage_tpu":
+        parts = parts[1:]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _SiteCollector(ast.NodeVisitor):
+    """Pass 1: find every lock identity in a module."""
+
+    def __init__(self, mod: str):
+        self.mod = mod
+        self._class: list[str] = []
+        # (scope, attr) -> lineno; scope = class name or "" for globals
+        self.sites: dict[tuple[str, str], int] = {}
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def visit_Assign(self, node: ast.Assign):
+        if _lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    self.sites[(self._class[-1] if self._class else "",
+                                t.attr)] = node.lineno
+                elif isinstance(t, ast.Name):
+                    self.sites[("", t.id)] = node.lineno
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute):
+                    # per-key lock family: self._table_locks[k] = Lock()
+                    self.sites[(self._class[-1] if self._class else "",
+                                t.value.attr)] = node.lineno
+        self.generic_visit(node)
+
+
+def _collect_sites(sources) -> dict[str, tuple[str, int]]:
+    """-> lock id "mod.Class.attr" -> (rel path, line)."""
+    out: dict[str, tuple[str, int]] = {}
+    for src in sources:
+        mod = _module_key(src.rel)
+        c = _SiteCollector(mod)
+        c.visit(src.tree)
+        for (scope, attr), line in c.sites.items():
+            key = _KNOWN_ALIASES.get((mod, attr), None)
+            if key is not None:
+                ident = f"{key[0]}.{key[1]}"
+            else:
+                ident = f"{mod}.{scope}.{attr}" if scope else f"{mod}.{attr}"
+            out[ident] = (src.rel, line)
+    return out
+
+
+def _attr_names_to_ids(sites: dict) -> dict[str, list[str]]:
+    """attr name (last path component) -> every lock id carrying it."""
+    out: dict[str, list[str]] = defaultdict(list)
+    for ident in sites:
+        out[ident.rsplit(".", 1)[-1]].append(ident)
+    return out
+
+
+def _acquired_lock(node: ast.expr, mod: str, cls: str,
+                   by_attr: dict[str, list[str]]) -> str | None:
+    """Resolve a with/acquire target expression to a lock identity.
+    ``self._x`` prefers this module+class's site; a foreign attribute
+    matches only when exactly ONE class in the package owns that attr
+    (ambiguous names are skipped rather than guessed)."""
+    expr = node
+    if isinstance(expr, ast.Call):
+        name = astutil.call_name(expr)
+        if name in ("acquire", "shared"):
+            expr = expr.func.value if isinstance(expr.func, ast.Attribute) \
+                else expr
+        else:
+            return None
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value           # lock family: self._locks[key]
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        cands = by_attr.get(attr, [])
+        if not cands:
+            return None
+        mine = [c for c in cands if c == f"{mod}.{cls}.{attr}"]
+        if mine:
+            return mine[0]
+        alias = _KNOWN_ALIASES.get((mod, attr))
+        if alias:
+            return f"{alias[0]}.{alias[1]}"
+        if len(cands) == 1 and isinstance(expr.value, ast.Attribute | ast.Name):
+            return cands[0]
+        return None
+    if isinstance(expr, ast.Name):
+        cands = [c for c in by_attr.get(expr.id, [])
+                 if c == f"{mod}.{expr.id}"]
+        return cands[0] if cands else None
+    return None
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Pass 2 per function: direct acquisitions + calls made while held."""
+
+    def __init__(self, mod: str, cls: str, by_attr: dict):
+        self.mod, self.cls, self.by_attr = mod, cls, by_attr
+        self.held: list[str] = []
+        # lock -> [(callee name, lineno)] calls made while held
+        self.calls_under: dict[str, list[tuple[str, int]]] = defaultdict(list)
+        # direct nesting edges: (outer, inner, lineno)
+        self.edges: list[tuple[str, str, int]] = []
+        self.direct: set[str] = set()       # locks this fn acquires
+
+    def visit_With(self, node: ast.With):
+        got: list[str] = []
+        for item in node.items:
+            lk = _acquired_lock(item.context_expr, self.mod, self.cls,
+                                self.by_attr)
+            if lk is not None:
+                self.direct.add(lk)
+                for outer in self.held:
+                    if outer != lk:
+                        self.edges.append((outer, lk, node.lineno))
+                got.append(lk)
+        self.held.extend(got)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in got:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call):
+        lk = _acquired_lock(node, self.mod, self.cls, self.by_attr)
+        if lk is not None and astutil.call_name(node) == "acquire":
+            self.direct.add(lk)
+            for outer in self.held:
+                if outer != lk:
+                    self.edges.append((outer, lk, node.lineno))
+        elif self.held:
+            name = astutil.call_name(node)
+            if name is not None:
+                is_self = (isinstance(node.func, ast.Attribute)
+                           and isinstance(node.func.value, ast.Name)
+                           and node.func.value.id == "self")
+                for outer in self.held:
+                    self.calls_under[outer].append(
+                        (name, node.lineno, is_self))
+        self.generic_visit(node)
+
+    # nested defs scan separately (their bodies run later, not under the
+    # with); visiting them here would fabricate held-across edges
+    def visit_FunctionDef(self, node):   # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(sources=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet(
+        exclude=("greengage_tpu/analysis/",))
+    srcs = list(sources)
+    sites = _collect_sites(srcs)
+    by_attr = _attr_names_to_ids(sites)
+    report.notes["lock_sites"] = len(sites)
+
+    # lock sets keyed two ways: (class, fn) for `self.m()` calls (reliable
+    # resolution) and bare fn name for distinctive cross-object calls —
+    # generic names (get/put/check/...) resolve via self ONLY, because
+    # name-matching them across the package fabricates edges from plain
+    # dict/queue access (see _GENERIC_METHODS)
+    fn_locks_self: dict[tuple[str, str], set[str]] = defaultdict(set)
+    fn_locks_any: dict[str, set[str]] = defaultdict(set)
+    scanned = []   # (src, class name, fn node, scanner)
+    for src in srcs:
+        mod = _module_key(src.rel)
+        cls_of = astutil.enclosing_class_map(src.tree)
+        for fn in astutil.functions(src.tree):
+            cls = cls_of.get(id(fn), "")
+            sc = _FnScanner(mod, cls, by_attr)
+            for stmt in fn.body:
+                sc.visit(stmt)
+            scanned.append((src, cls, fn, sc))
+            if sc.direct:
+                fn_locks_self[(cls, fn.name)] |= sc.direct
+                if fn.name not in _GENERIC_METHODS:
+                    fn_locks_any[fn.name] |= sc.direct
+
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for src, cls, fn, sc in scanned:
+        for a, b, line in sc.edges:
+            edges.setdefault((a, b), (src.rel, line, fn.name))
+        for outer, calls in sc.calls_under.items():
+            for callee, line, is_self in calls:
+                inners = (fn_locks_self.get((cls, callee), set())
+                          if is_self else fn_locks_any.get(callee, set()))
+                for inner in inners:
+                    if inner != outer:
+                        edges.setdefault(
+                            (outer, inner),
+                            (src.rel, line, f"{fn.name} -> {callee}()"))
+    report.notes["lock_edges"] = len(edges)
+
+    # cycle detection over the acquisition graph
+    graph: dict[str, set[str]] = defaultdict(set)
+    for a, b in edges:
+        graph[a].add(b)
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = tuple(sorted(path))
+                if cyc not in seen_cycles:
+                    seen_cycles.add(cyc)
+                    rel, line, via = edges[(path[-1], start)]
+                    src = next((s for s in srcs if s.rel == rel), None)
+                    if src is not None and src.pragma_ok(line, "locks"):
+                        continue
+                    report.add(
+                        "locks", rel, line,
+                        "cycle:" + ">".join(cyc),
+                        "lock-order cycle: " + " -> ".join(path + [start])
+                        + f" (closing edge via {via}); threads taking "
+                        "these in different orders can deadlock")
+            elif nxt not in path:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return report

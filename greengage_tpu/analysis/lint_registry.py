@@ -1,0 +1,216 @@
+"""Registry-hygiene lint: declared catalogs vs. what the code does.
+
+Three registries drift silently without this check:
+
+* **metrics** — ``runtime/logger.py`` declares ``COUNTER_NAMES`` /
+  ``GAUGE_NAMES`` / ``HISTOGRAM_NAMES``; every ``counters.inc()`` /
+  ``counters.set()`` / ``histograms.observe()`` site must name a
+  declared metric of the right KIND (inc on a gauge or set on a counter
+  is the exposition-type bug PR 7 fixed for mh_topology_version), and
+  every declared metric must have a writer. F-string families
+  (``statements_cancelled_{cause}``) match declared names by their
+  literal prefix.
+* **GUCs** — every ``Settings`` field must be documented in
+  ``docs/GUCS.md``, and every row there must be a real field (SET-able
+  knobs with no docs and documented knobs that no longer exist both
+  fail).
+* **fault points** — ``runtime/faultinject.py`` declares
+  ``FAULT_POINTS``; every ``faults.check()`` in the package and every
+  ``faults.inject()`` in the test tree must name a registered point,
+  and every registered point must have a check site (a point tests arm
+  but nothing fires is a dead test).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.report import Report
+
+_GUC_DOC = os.path.join("docs", "GUCS.md")
+_GUC_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`")
+
+
+def _metric_calls(sources):
+    """Yield (src, node, kind, name, is_prefix) for every metric write.
+    kind: inc | set | observe; is_prefix marks f-string families."""
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            recv = (astutil.dotted(node.func.value) or "").rsplit(".", 1)[-1]
+            meth = node.func.attr
+            if meth in ("inc", "set") and recv.lstrip("_") == "counters":
+                kind = meth
+            elif meth == "observe" and recv.lstrip("_") == "histograms":
+                kind = "observe"
+            else:
+                continue
+            if not node.args:
+                continue
+            name = astutil.const_str(node.args[0])
+            if name is not None:
+                yield src, node, kind, name, False
+                continue
+            prefix = astutil.fstring_prefix(node.args[0])
+            if prefix is not None:
+                yield src, node, kind, prefix, True
+            else:
+                yield src, node, kind, None, False
+
+
+def _check_metrics(sources, report: Report) -> None:
+    from greengage_tpu.runtime.logger import (COUNTER_NAMES, GAUGE_NAMES,
+                                              HISTOGRAM_NAMES)
+
+    counters, gauges = set(COUNTER_NAMES), set(GAUGE_NAMES)
+    hists = set(HISTOGRAM_NAMES)
+    declared = {"inc": counters, "set": gauges, "observe": hists}
+    kind_word = {"inc": "counter", "set": "gauge", "observe": "histogram"}
+    written: set[str] = set()
+    logger_src = sources.get("runtime/logger.py")
+    for src, node, kind, name, is_prefix in _metric_calls(sources):
+        if src.rel.endswith("runtime/logger.py"):
+            continue   # the registry module's own plumbing
+        if name is None:
+            if not src.pragma_ok(node.lineno, "registry"):
+                report.add("registry", src.rel, node.lineno,
+                           f"metric-dynamic:{kind}",
+                           f"{kind}() with a non-literal metric name — "
+                           "the hygiene check cannot see it; use a "
+                           "literal or an f-string with a literal prefix")
+            continue
+        if is_prefix:
+            family = {n for n in declared[kind] if n.startswith(name)}
+            if not family:
+                if not src.pragma_ok(node.lineno, "registry"):
+                    report.add("registry", src.rel, node.lineno,
+                               f"metric-family:{name}",
+                               f"f-string metric family {name!r}* matches "
+                               f"no declared {kind_word[kind]} in "
+                               "runtime/logger.py")
+            written |= family
+            continue
+        written.add(name)
+        if name not in declared[kind] \
+                and not src.pragma_ok(node.lineno, "registry"):
+            other = ("gauge (use counters.set)" if kind == "inc"
+                     and name in gauges else
+                     "counter (use counters.inc)" if kind == "set"
+                     and name in counters else None)
+            detail = (f"declared as a {other}" if other else
+                      f"not declared a {kind_word[kind]} in "
+                      "runtime/logger.py "
+                      f"(COUNTER_NAMES/GAUGE_NAMES/HISTOGRAM_NAMES)")
+            report.add("registry", src.rel, node.lineno,
+                       f"metric-undeclared:{name}",
+                       f"{kind}({name!r}): {detail}")
+    for name in sorted((counters | gauges | hists) - written):
+        line = 1
+        report.add("registry",
+                   logger_src.rel if logger_src else "runtime/logger.py",
+                   line, f"metric-unwritten:{name}",
+                   f"declared metric {name!r} has no writer in the "
+                   "package — dead catalog entry (or a family prefix "
+                   "typo)")
+
+
+def _check_gucs(sources, report: Report) -> None:
+    cfg = sources.get("config.py")
+    if cfg is None:
+        return
+    fields: dict[str, int] = {}
+    for node in ast.walk(cfg.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Settings":
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) \
+                        and isinstance(item.target, ast.Name) \
+                        and not item.target.id.startswith("_"):
+                    fields[item.target.id] = item.lineno
+    doc_path = os.path.join(astutil.repo_root(), _GUC_DOC)
+    documented: set[str] = set()
+    if os.path.exists(doc_path):
+        with open(doc_path) as f:
+            for line in f:
+                m = _GUC_ROW_RE.match(line)
+                if m:
+                    documented.add(m.group(1))
+    else:
+        report.add("registry", _GUC_DOC, 1, "guc-doc-missing",
+                   f"{_GUC_DOC} does not exist: the GUC reference the "
+                   "hygiene check validates Settings against")
+        return
+    for name, line in sorted(fields.items()):
+        if name not in documented and not cfg.pragma_ok(line, "registry"):
+            report.add("registry", cfg.rel, line, f"guc-undocumented:{name}",
+                       f"GUC {name!r} is SET-able but has no row in "
+                       f"{_GUC_DOC}")
+    for name in sorted(documented - set(fields)):
+        report.add("registry", _GUC_DOC, 1, f"guc-phantom:{name}",
+                   f"{_GUC_DOC} documents {name!r}, which is not a "
+                   "Settings field")
+
+
+def _fault_name_calls(sources, meth: str):
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr != meth:
+                continue
+            recv = (astutil.dotted(node.func.value) or "").rsplit(".", 1)[-1]
+            if recv != "faults":
+                continue
+            if node.args:
+                yield src, node, astutil.const_str(node.args[0])
+
+
+def _check_faults(pkg_sources, test_sources, report: Report) -> None:
+    from greengage_tpu.runtime.faultinject import FAULT_POINTS
+
+    checked: set[str] = set()
+    for src, node, name in _fault_name_calls(pkg_sources, "check"):
+        if src.rel.endswith("runtime/faultinject.py"):
+            continue   # the registry module's own docstring examples
+        if name is None:
+            continue
+        checked.add(name)
+        if name not in FAULT_POINTS \
+                and not src.pragma_ok(node.lineno, "registry"):
+            report.add("registry", src.rel, node.lineno,
+                       f"fault-unregistered:{name}",
+                       f"faults.check({name!r}) names a point missing "
+                       "from runtime/faultinject.py FAULT_POINTS")
+    fi = pkg_sources.get("runtime/faultinject.py")
+    for name in sorted(FAULT_POINTS - checked):
+        report.add("registry",
+                   fi.rel if fi else "runtime/faultinject.py", 1,
+                   f"fault-unfired:{name}",
+                   f"registered fault point {name!r} has no "
+                   "faults.check() site — tests arming it test nothing")
+    if test_sources is not None:
+        for src, node, name in _fault_name_calls(test_sources, "inject"):
+            if name is None or name in FAULT_POINTS:
+                continue
+            if src.pragma_ok(node.lineno, "registry"):
+                continue
+            report.add("registry", src.rel, node.lineno,
+                       f"fault-inject-unknown:{name}",
+                       f"test injects unregistered fault point {name!r} "
+                       "— it will never fire in the package")
+
+
+def run(sources=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet()
+    tests_dir = os.path.join(astutil.repo_root(), "tests")
+    test_sources = (astutil.SourceSet(roots=[tests_dir])
+                    if os.path.isdir(tests_dir) else None)
+    _check_metrics(sources, report)
+    _check_gucs(sources, report)
+    _check_faults(sources, test_sources, report)
+    return report

@@ -1,0 +1,233 @@
+"""Tracer-safety lint — the PR-5 jnp-identity-under-jit bug class.
+
+Two checks:
+
+**tracer-sync** — inside jit-traced code (every function in
+``greengage_tpu/ops/`` plus the closures nested inside
+``exec/compile.py`` methods — the ``seg_fn``/``run`` bodies that execute
+under ``jax.jit(_shard_map(...))``), a value produced by
+``jnp.*``/``lax.*`` is a *tracer*; forcing it to a host scalar —
+``.item()``, ``float()``/``int()``/``bool()``, ``np.asarray``/
+``np.array`` — either raises ``ConcretizationTypeError`` at trace time
+or, worse, silently bakes a wrong constant (the PR-5 fused min/max
+identity bug: ``jnp.array`` identity + ``ident.item()``). The lint
+taints names assigned from jnp/lax calls (propagated through simple
+expressions and method chains) and flags host-forcing operations on
+tainted values. Host-concrete numpy identities (``np.array(...)`` then
+``.item()``) stay legal — that IS the fix pattern.
+
+**cache-key** — the executable-reuse signature must digest only
+bucketed/stable inputs: every ``est_*`` estimate field on a plan node
+dataclass must be listed in ``Compiler._SIG_SKIP_FIELDS`` (estimates
+reach the program only through pow2-bucketed capacities), and the
+signature functions must not read estimate fields or nondeterministic
+sources (``id()``, ``time.*``, ``random.*``) directly — any of those in
+the key silently fractures (or worse, falsely merges) executable reuse.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.report import Report
+
+_TRACED_ROOTS = ("jnp", "lax")
+_HOST_FORCE = {"float", "int", "bool", "complex"}
+_SIG_FUNCS = ("shape_signature", "codegen_settings_sig")
+_EST_PREFIXES = ("est_", "expand_est")
+
+
+def _is_traced_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = astutil.dotted(node.func)
+    return bool(d) and d.split(".", 1)[0] in _TRACED_ROOTS
+
+
+def _tainted_names(fn: ast.AST) -> set[str]:
+    """Names bound (directly or through simple expressions/method chains)
+    to jnp/lax results within this function body."""
+    tainted: set[str] = set()
+
+    def expr_tainted(e: ast.expr) -> bool:
+        if _is_traced_call(e):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in tainted
+        if isinstance(e, ast.BinOp):
+            return expr_tainted(e.left) or expr_tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return expr_tainted(e.operand)
+        if isinstance(e, ast.Subscript):
+            return expr_tainted(e.value)
+        if isinstance(e, ast.IfExp):
+            return expr_tainted(e.body) or expr_tainted(e.orelse)
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+            # method chain on a tainted value (x.astype(...), x.sum())
+            return expr_tainted(e.func.value)
+        if isinstance(e, ast.Attribute):
+            return expr_tainted(e.value)
+        return False
+
+    for _ in range(3):   # small fixpoint for chained assignments
+        before = len(tainted)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        tainted.update(e.id for e in t.elts
+                                       if isinstance(e, ast.Name))
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and expr_tainted(node.value):
+                tainted.add(node.target.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def _flag_host_sync(src, fn, where: str, report: Report) -> None:
+    tainted = _tainted_names(fn)
+
+    def is_tainted(e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in tainted
+        if _is_traced_call(e):
+            return True
+        if isinstance(e, (ast.Subscript, ast.Attribute)):
+            return is_tainted(e.value)
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+            return is_tainted(e.func.value)
+        return False
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = None
+        name = astutil.call_name(node)
+        if name == "item" and isinstance(node.func, ast.Attribute) \
+                and is_tainted(node.func.value):
+            hit = ".item() on a traced value"
+        elif isinstance(node.func, ast.Name) and name in _HOST_FORCE \
+                and node.args and is_tainted(node.args[0]):
+            hit = f"{name}() on a traced value"
+        else:
+            d = astutil.dotted(node.func) or ""
+            if d in ("np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array") and node.args \
+                    and is_tainted(node.args[0]):
+                hit = f"{d}() on a traced value"
+        if hit is None:
+            continue
+        if src.pragma_ok(node.lineno, "tracer"):
+            continue
+        report.add(
+            "tracer", src.rel, node.lineno,
+            f"{where}:{fn.name}:{hit}",
+            f"host sync inside jit-traced code: {hit} in {fn.name}() — "
+            "under trace this concretizes a tracer (the PR-5 identity "
+            "bug class); keep the value device-side or build it "
+            "host-concrete with numpy BEFORE tracing")
+
+
+def _est_fields(sources) -> set[str]:
+    src = sources.get("planner/logical.py")
+    out: set[str] = set()
+    if src is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                          ast.Name):
+            n = node.target.id
+            if n.startswith(_EST_PREFIXES[0]) or n in _EST_PREFIXES:
+                out.add(n)
+    # est_rows lives on the Plan base via field(); AnnAssign covers it
+    return out
+
+
+def _check_cache_keys(sources, report: Report) -> None:
+    comp = sources.get("exec/compile.py")
+    if comp is None:
+        return
+    est = _est_fields(sources)
+    skip: set[str] = set()
+    skip_line = 1
+    for node in ast.walk(comp.tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "_SIG_SKIP_FIELDS"
+                        for t in node.targets):
+            skip_line = node.lineno
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    skip.add(c.value)
+    missing = sorted(est - skip)
+    if missing:
+        report.add(
+            "tracer", comp.rel, skip_line, "sig-skip:" + ",".join(missing),
+            f"estimate field(s) {missing} are not in "
+            "Compiler._SIG_SKIP_FIELDS: raw estimates in the shape "
+            "signature fracture executable reuse on every ANALYZE "
+            "(estimates may only reach programs via bucketed capacities)")
+    for fn in astutil.functions(comp.tree):
+        if fn.name not in _SIG_FUNCS:
+            continue
+        # id() used as a SUBSCRIPT KEY builds the preorder-ordinal map
+        # (id -> ordinal, a per-walk identity table) — only id() values
+        # flowing into the digested payload itself are unstable
+        keyed_ids: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript):
+                for sub in ast.walk(node.slice):
+                    if isinstance(sub, ast.Call) \
+                            and astutil.dotted(sub.func) == "id":
+                        keyed_ids.add(id(sub))
+        for node in ast.walk(fn):
+            bad = None
+            if isinstance(node, ast.Attribute) and (
+                    node.attr.startswith("est_")
+                    or node.attr in _EST_PREFIXES):
+                bad = f"reads .{node.attr}"
+            elif isinstance(node, ast.Call):
+                d = astutil.dotted(node.func) or ""
+                if (d == "id" and id(node) not in keyed_ids) \
+                        or d.startswith(("time.", "random.")):
+                    bad = f"calls {d}()"
+            if bad is None:
+                continue
+            if comp.pragma_ok(node.lineno, "tracer"):
+                continue
+            report.add(
+                "tracer", comp.rel, node.lineno,
+                f"sig-unstable:{fn.name}:{bad}",
+                f"{fn.name}() {bad}: executable-cache keys must digest "
+                "only bucketed, process-stable values")
+
+
+def run(sources=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet()
+    for src in sources:
+        in_ops = "/ops/" in src.rel.replace("\\", "/")
+        is_compile = src.rel.endswith("exec/compile.py")
+        if not in_ops and not is_compile:
+            continue
+        if in_ops:
+            for fn in astutil.functions(src.tree):
+                _flag_host_sync(src, fn, "ops", report)
+        else:
+            # compile.py: only the NESTED closures run under trace (the
+            # methods themselves run at compile time on the host)
+            nested: dict[int, ast.AST] = {}
+            for f in astutil.functions(src.tree):
+                for inner in ast.walk(f):
+                    if inner is not f and isinstance(
+                            inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        nested[id(inner)] = inner
+            for inner in nested.values():
+                _flag_host_sync(src, inner, "traced", report)
+    _check_cache_keys(sources, report)
+    return report

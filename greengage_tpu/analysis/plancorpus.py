@@ -1,0 +1,247 @@
+"""The plan corpus: TPC-H + TPC-DS statement shapes for whole-corpus
+plan validation (``gg check --plans`` and ``tests/test_analysis.py``).
+
+The per-statement ``plan_validate`` GUC catches violations as they
+happen; this corpus makes the sweep REPEATABLE and CI-gated — every
+planner change re-proves the full query-shape spectrum (joins of every
+motion flavor, one/two/three-phase aggregates, windows global and
+partitioned, funneled LIMITs, semi/anti subqueries, unions) against the
+invariants in ``analysis/plancheck.py``.
+
+Queries are the shapes the oracle tests already execute (tests/
+test_tpch_*.py, test_tpcds_subset.py) so the corpus can never drift
+ahead of what the engine actually supports.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TPCH_QUERIES: dict[str, str] = {
+    "q1_pricing_summary": """
+      select l_returnflag, l_linestatus, sum(l_quantity), count(*)
+      from lineitem where l_shipdate <= date '1998-09-02'
+      group by l_returnflag, l_linestatus
+      order by l_returnflag, l_linestatus""",
+    "q3_shipping_priority": """
+      select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+             o_orderdate, o_shippriority
+      from customer, orders, lineitem
+      where c_mktsegment = 'BUILDING'
+        and c_custkey = o_custkey and l_orderkey = o_orderkey
+        and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+      group by l_orderkey, o_orderdate, o_shippriority
+      order by revenue desc, o_orderdate limit 10""",
+    "q6_forecast_revenue": """
+      select sum(l_extendedprice * l_discount) as revenue
+      from lineitem
+      where l_shipdate >= date '1994-01-01'
+        and l_shipdate < date '1995-01-01'
+        and l_discount between 0.05 and 0.07 and l_quantity < 24""",
+    "q10_returned_items": """
+      select c_custkey, c_name,
+             sum(l_extendedprice * (1 - l_discount)) as revenue,
+             c_acctbal, n_name
+      from customer, orders, lineitem, nation
+      where c_custkey = o_custkey and l_orderkey = o_orderkey
+        and o_orderdate >= date '1993-10-01'
+        and o_orderdate < date '1994-01-01'
+        and l_returnflag = 'R' and c_nationkey = n_nationkey
+      group by c_custkey, c_name, c_acctbal, n_name
+      order by revenue desc limit 20""",
+    "q12_shipmode": """
+      select l_shipmode,
+             sum(case when o_orderpriority = '1-URGENT'
+                       or o_orderpriority = '2-HIGH' then 1 else 0 end)
+               as high_line_count,
+             sum(case when o_orderpriority <> '1-URGENT'
+                       and o_orderpriority <> '2-HIGH' then 1 else 0 end)
+               as low_line_count
+      from orders, lineitem
+      where o_orderkey = l_orderkey
+        and l_shipmode in ('MAIL', 'SHIP')
+        and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+        and l_receiptdate >= date '1994-01-01'
+        and l_receiptdate < date '1995-01-01'
+      group by l_shipmode order by l_shipmode""",
+    "q14_promo_effect": """
+      select 100.00 * sum(case when p_type like 'type 1%'
+                               then l_extendedprice * (1 - l_discount)
+                               else 0 end)
+             / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+      from lineitem, part
+      where l_partkey = p_partkey
+        and l_shipdate >= date '1995-09-01'
+        and l_shipdate < date '1995-10-01'""",
+    "q18_large_volume": """
+      select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+             sum(l_quantity)
+      from customer, orders, lineitem
+      where o_orderkey in (
+              select l_orderkey from lineitem
+              group by l_orderkey having sum(l_quantity) > 250)
+        and c_custkey = o_custkey and o_orderkey = l_orderkey
+      group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+      order by o_totalprice desc, o_orderdate limit 100""",
+    "point_direct_dispatch":
+        "select o_totalprice from orders where o_orderkey = 100",
+    "semi_exists": """
+      select c_custkey, c_name from customer
+      where exists (select 1 from orders where o_custkey = c_custkey
+                    and o_totalprice > 100000)
+      order by c_custkey limit 50""",
+    "anti_not_in": """
+      select c_custkey from customer
+      where c_custkey not in (select o_custkey from orders)
+      order by c_custkey limit 50""",
+    "scalar_subquery": """
+      select l_orderkey, l_extendedprice from lineitem
+      where l_extendedprice > (select avg(l_extendedprice) from lineitem)
+      order by l_extendedprice desc limit 25""",
+    "global_window_rank": """
+      select o_orderkey, o_totalprice,
+             row_number() over (order by o_orderkey) rn
+      from orders order by rn limit 20""",
+    "partitioned_window": """
+      select o_custkey, o_orderkey, o_totalprice,
+             sum(o_totalprice) over (partition by o_custkey) cust_total
+      from orders order by o_custkey, o_orderkey limit 30""",
+    "union_all_branches": """
+      select o_orderkey as k, o_totalprice as v from orders
+        where o_totalprice > 150000
+      union all
+      select l_orderkey as k, l_extendedprice as v from lineitem
+        where l_quantity > 45
+      order by k, v limit 40""",
+    "distinct_group": """
+      select distinct l_shipmode from lineitem order by l_shipmode""",
+    "cross_join_scalar": """
+      select n_name, r_name from nation, region
+      where n_regionkey = r_regionkey order by n_name limit 10""",
+    "buried_limit_subquery": """
+      select k from (select o_orderkey as k from orders
+                     order by o_totalprice desc limit 5) t
+      order by k""",
+    "two_phase_strewn_group": """
+      select l_suppkey, count(*) c, sum(l_quantity) q
+      from lineitem group by l_suppkey order by c desc, l_suppkey limit 15""",
+}
+
+# the test-scale star schema of tests/test_tpcds_subset.py
+TPCDS_QUERIES: dict[str, str] = {
+    "ds_q3_brand_revenue": """
+      select d_year, i_brand_id, sum(ss_ext_sales_price) as rev
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and i_manufact_id = 28 and d_moy = 11
+      group by d_year, i_brand_id
+      order by d_year, rev desc, i_brand_id limit 25""",
+    "ds_q42_category_rollup": """
+      select d_year, i_category, sum(ss_ext_sales_price) as rev
+      from store_sales, date_dim, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 1 and d_moy = 11 and d_year = 1999
+      group by d_year, i_category order by rev desc, i_category""",
+    "ds_semi_bitmap": """
+      select s_state, count(*) as cnt, sum(ss_quantity) as q
+      from store_sales, store
+      where ss_store_sk = s_store_sk
+        and ss_item_sk in (select i_item_sk from item where i_brand_id < 5)
+        and ss_sold_date_sk in (select d_date_sk from date_dim
+                                where d_year = 2000)
+      group by s_state order by s_state""",
+    "ds_q52_brand_by_month": """
+      select d_year, i_brand_id, sum(ss_ext_sales_price) as p
+      from date_dim, store_sales, item
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 1 and d_moy = 12 and d_year = 1998
+      group by d_year, i_brand_id order by d_year, p desc, i_brand_id
+      limit 10""",
+    "ds_q27_rollup_grouping": """
+      select i_category, s_state, grouping(i_category, s_state) g,
+             avg(ss_quantity) aq, count(*) c
+      from store_sales, item, store
+      where ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+        and i_manager_id < 10
+      group by rollup(i_category, s_state)
+      order by g, i_category, s_state""",
+    "ds_q70_grouped_rank": """
+      select s_state, sum(ss_ext_sales_price) rev,
+             rank() over (order by sum(ss_ext_sales_price) desc) rnk
+      from store_sales, store
+      where ss_store_sk = s_store_sk
+      group by s_state order by rnk""",
+    "ds_q86_share_of_total": """
+      select i_category, sum(ss_ext_sales_price) rev,
+             sum(ss_ext_sales_price) * 100.0
+               / sum(sum(ss_ext_sales_price)) over () share
+      from store_sales, item
+      where ss_item_sk = i_item_sk
+      group by i_category order by i_category""",
+}
+
+
+def load_tpcds_mini(db, n_fact: int = 20_000, seed: int = 77) -> None:
+    """Create the TPC-DS star subset (store_sales + 3 dims) at validation
+    scale — same schema as tests/test_tpcds_subset.py."""
+    from greengage_tpu.types import Coded
+
+    rng = np.random.default_rng(seed)
+    n_date, n_item, n_store = 400, 300, 12
+    db.sql("create table date_dim (d_date_sk bigint, d_year int, d_moy int) "
+           "distributed replicated")
+    db.sql("create table item (i_item_sk bigint, i_brand_id int, "
+           "i_category text, i_manufact_id int, i_manager_id int) "
+           "distributed by (i_item_sk)")
+    db.sql("create table store (s_store_sk bigint, s_state text) "
+           "distributed replicated")
+    db.sql("create table store_sales (ss_sold_date_sk bigint, "
+           "ss_item_sk bigint, ss_store_sk bigint, ss_quantity int, "
+           "ss_ext_sales_price bigint) distributed by (ss_item_sk)")
+    db.load_table("date_dim", {
+        "d_date_sk": np.arange(n_date, dtype=np.int64),
+        "d_year": (1998 + np.arange(n_date) // 180).astype(np.int32),
+        "d_moy": (1 + (np.arange(n_date) // 15) % 12).astype(np.int32)})
+    db.load_table("item", {
+        "i_item_sk": np.arange(n_item, dtype=np.int64),
+        "i_brand_id": rng.integers(1, 60, n_item).astype(np.int32),
+        "i_category": Coded([f"Cat{i}" for i in range(10)],
+                            rng.integers(0, 10, n_item).astype(np.int32)),
+        "i_manufact_id": rng.integers(1, 100, n_item).astype(np.int32),
+        "i_manager_id": rng.integers(1, 40, n_item).astype(np.int32)})
+    db.load_table("store", {
+        "s_store_sk": np.arange(n_store, dtype=np.int64),
+        "s_state": Coded(["CA", "NY", "TX", "WA"],
+                         rng.integers(0, 4, n_store).astype(np.int32))})
+    db.load_table("store_sales", {
+        "ss_sold_date_sk": rng.integers(0, n_date, n_fact),
+        "ss_item_sk": rng.integers(0, n_item, n_fact),
+        "ss_store_sk": rng.integers(0, n_store, n_fact),
+        "ss_quantity": rng.integers(1, 100, n_fact).astype(np.int32),
+        "ss_ext_sales_price":
+            rng.integers(100, 100_000, n_fact).astype(np.int64)})
+    db.sql("analyze")
+
+
+def validate_corpus(db, queries: dict[str, str]) -> list[tuple[str, str]]:
+    """Plan + validate every corpus statement against ``db``; also prove
+    the I7 capacity contract through a real Compiler. -> [(name, error)]
+    for statements that failed (empty = clean)."""
+    from greengage_tpu.analysis.plancheck import (validate_capacities,
+                                                  validate_plan)
+    from greengage_tpu.exec.compile import Compiler
+    from greengage_tpu.sql.parser import parse
+
+    failures: list[tuple[str, str]] = []
+    for name, sql in queries.items():
+        try:
+            stmt = parse(sql)[0]
+            planned, consts, _outs = db._plan(stmt)
+            validate_plan(planned, db.catalog)   # explicit even if GUC off
+            comp = Compiler(db.catalog, db.store, db.mesh, db.numsegments,
+                            consts, db.settings,
+                            multihost=db.multihost is not None)
+            validate_capacities(comp, planned)
+        except Exception as e:   # noqa: BLE001 — report, don't abort sweep
+            failures.append((name, f"{type(e).__name__}: {e}"))
+    return failures

@@ -1,0 +1,108 @@
+"""One reporter for every ``gg check`` finding.
+
+Findings carry a stable suppression *key* (path + symbol + detail, no
+line numbers) so the checked-in baseline survives unrelated edits. Two
+suppression channels:
+
+* ``analysis/baseline.txt`` — one ``check<TAB>key`` per line, checked in
+  beside this module. The file starts near-empty by policy: a finding
+  lands here only when it is a verified false positive of the analyzer,
+  never to dodge a real fix (docs/ANALYSIS.md).
+* an inline ``# gg:ok(<check>)`` pragma on the flagged line, for
+  deliberate exceptions whose justification belongs next to the code
+  (e.g. a wait loop that provably never runs on a statement thread).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+_PRAGMA_RE = re.compile(r"#\s*gg:ok\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str          # analyzer id: locks | interrupts | tracer | ...
+    path: str           # repo-relative source path
+    line: int           # 1-based; informational only (keys are line-free)
+    key: str            # stable suppression key within (check, path)
+    message: str
+
+    @property
+    def full_key(self) -> str:
+        return f"{self.path}::{self.key}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    # analyzer-level notes (counts, skipped modules) for --json consumers
+    notes: dict = field(default_factory=dict)
+
+    def add(self, check: str, path: str, line: int, key: str,
+            message: str) -> None:
+        self.findings.append(Finding(check, path, line, key, message))
+
+    def extend(self, other: "Report") -> None:
+        self.findings.extend(other.findings)
+        self.notes.update(other.notes)
+
+    def suppressed(self, baseline: set[tuple[str, str]]) -> "Report":
+        """-> a Report holding only findings NOT covered by the baseline
+        (pragma suppression happens in the analyzers, which see source)."""
+        out = Report(notes=dict(self.notes))
+        out.findings = [f for f in self.findings
+                        if (f.check, f.full_key) not in baseline]
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "findings": [{"check": f.check, "path": f.path, "line": f.line,
+                          "key": f.full_key, "message": f.message}
+                         for f in self.findings],
+            "notes": self.notes,
+            "clean": not self.findings,
+        }, indent=1, sort_keys=True)
+
+    def to_text(self) -> str:
+        if not self.findings:
+            return "gg check: clean (0 findings)"
+        lines = [f.render() for f in sorted(
+            self.findings, key=lambda f: (f.check, f.path, f.line))]
+        lines.append(f"gg check: {len(self.findings)} finding(s)")
+        return "\n".join(lines)
+
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def load_baseline(path: str | None = None) -> set[tuple[str, str]]:
+    """-> {(check, full_key)} from the checked-in baseline file."""
+    path = path or baseline_path()
+    out: set[tuple[str, str]] = set()
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 1)
+            if len(parts) == 2:
+                out.add((parts[0], parts[1]))
+    return out
+
+
+def line_pragmas(source_line: str) -> set[str]:
+    """Checks suppressed by an inline ``# gg:ok(a, b)`` pragma."""
+    m = _PRAGMA_RE.search(source_line)
+    if not m:
+        return set()
+    return {p.strip() for p in m.group(1).split(",") if p.strip()}

@@ -1,0 +1,73 @@
+"""``gg check`` driver: run analyzers, apply the baseline, report.
+
+Static checks are pure stdlib-``ast`` over the package tree and run in
+well under a second; the plan-corpus sweep (``run_plan_corpus``) builds
+throwaway TPC-H/TPC-DS clusters and needs a jax backend, so it hides
+behind ``gg check --plans`` (CI runs both).
+"""
+
+from __future__ import annotations
+
+from greengage_tpu.analysis import (astutil, lint_imports, lint_interrupts,
+                                    lint_locks, lint_registry, lint_tracer)
+from greengage_tpu.analysis.report import Report, load_baseline
+
+CHECKS = {
+    "locks": lint_locks.run,
+    "interrupts": lint_interrupts.run,
+    "tracer": lint_tracer.run,
+    "registry": lint_registry.run,
+    "imports": lint_imports.run,
+}
+
+
+def run_checks(names: list[str] | None = None,
+               baseline_file: str | None = None,
+               use_baseline: bool = True) -> Report:
+    """Run the named static analyzers (all by default) over one shared
+    parsed view of the package; findings surviving the baseline remain."""
+    sources = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+    report = Report()
+    for name in names or sorted(CHECKS):
+        if name not in CHECKS:
+            raise ValueError(f"unknown check {name!r} "
+                             f"(have: {', '.join(sorted(CHECKS))})")
+        report.extend(CHECKS[name](sources))
+    if use_baseline:
+        baseline = load_baseline(baseline_file)
+        before = len(report.findings)
+        report = report.suppressed(baseline)
+        report.notes["baseline_suppressed"] = before - len(report.findings)
+    return report
+
+
+def run_plan_corpus(numsegments: int = 4) -> Report:
+    """Validate every TPC-H/TPC-DS corpus plan (I1-I7) on throwaway
+    in-memory clusters — the ``gg check --plans`` / CI half."""
+    import greengage_tpu
+    from greengage_tpu.analysis import plancorpus
+    from greengage_tpu.utils import tpch
+
+    report = Report()
+    db = greengage_tpu.connect(numsegments=numsegments)
+    try:
+        tpch.load(db, sf=0.005)
+        db.sql("analyze")
+        for name, err in plancorpus.validate_corpus(
+                db, plancorpus.TPCH_QUERIES):
+            report.add("plans", "analysis/plancorpus.py", 1,
+                       f"tpch:{name}", f"{name}: {err}")
+        report.notes["tpch_validated"] = len(plancorpus.TPCH_QUERIES)
+    finally:
+        db.close()
+    db = greengage_tpu.connect(numsegments=numsegments)
+    try:
+        plancorpus.load_tpcds_mini(db)
+        for name, err in plancorpus.validate_corpus(
+                db, plancorpus.TPCDS_QUERIES):
+            report.add("plans", "analysis/plancorpus.py", 1,
+                       f"tpcds:{name}", f"{name}: {err}")
+        report.notes["tpcds_validated"] = len(plancorpus.TPCDS_QUERIES)
+    finally:
+        db.close()
+    return report

@@ -131,6 +131,13 @@ class Settings:
     # jax's persistent cache never evicts (0.4.x), so init prunes the
     # active platform subdir oldest-first past this bound; 0 = unbounded
     xla_cache_limit_mb: int = 2048
+    # plan-invariant validation (analysis/plancheck.py; the cdbmutate
+    # checkPlan-before-dispatch analog): walk every planned statement and
+    # raise a typed PlanInvariantError on Motion-placement / locality /
+    # prune-shape violations BEFORE compile or dispatch. The walk is
+    # O(plan nodes) of host attribute checks — noise next to planning —
+    # so it defaults on everywhere, not just in tests
+    plan_validate: bool = True
     # logging (log_statement / log_min_duration_statement analog): every
     # statement + errors land in <cluster>/log CSV files
     log_statement: bool = True

@@ -17,6 +17,7 @@ next size tier — the spill/flow-control analog.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+import hashlib
 
 import numpy as np
 
@@ -425,7 +426,6 @@ class Compiler:
         compiled executable ACROSS manifest versions: a DML that stays
         inside every capacity bucket and grows no dictionary re-dispatches
         the hot executable instead of recompiling."""
-        import hashlib
 
         self._snap = snapshot
         self._nids = {}
@@ -437,7 +437,10 @@ class Compiler:
         below = plan.child
         self._dict_refs = {}
         _collect_dict_refs(plan, self._dict_refs)
-        self._host_limit_node = id(below) if isinstance(below, Limit) else None
+        # node-identity marker compared against id(p) during this same
+        # walk — never digested into the payload
+        self._host_limit_node = (
+            id(below) if isinstance(below, Limit) else None)  # gg:ok(tracer)
         self._collect_scans(below)
         self._merge_unpinned_scan_caps()
         nodes = []

@@ -8,16 +8,31 @@ statements route to their handlers, mirroring ProcessUtility.
 
 from __future__ import annotations
 
+import copy as _copy
 import csv as _csv
+import dataclasses as _dc
+import glob as _glob
+import hashlib
+import io
+import json as _json
 import os
-import threading as _threading
+import shutil
+import subprocess
+import sys as _sys
+import tempfile
+import threading
 import time
-from contextlib import contextmanager as _contextmanager
+import uuid as _uuid
+import warnings
+from collections import OrderedDict as _OD
+from contextlib import ExitStack, contextmanager as _contextmanager
+from types import SimpleNamespace
 
 import numpy as np
 
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
+from greengage_tpu.analysis.plancheck import validate_plan
 from greengage_tpu.catalog import (Catalog, Column, DistPolicy, Partition,
                                    PolicyKind, TableSchema)
 from greengage_tpu.config import Settings
@@ -27,7 +42,8 @@ from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.interrupt import (REGISTRY as _INTERRUPTS,
-                                             StatementCancelled)
+                                             StatementCancelled,
+                                             check_interrupts)
 from greengage_tpu.runtime.logger import counters as _counters
 from greengage_tpu.runtime.logger import histograms as _histograms
 from greengage_tpu.runtime.trace import TRACES as _TRACES
@@ -60,8 +76,6 @@ class Database:
             self.catalog = Catalog(numsegments, path=path, mirrors=mirrors)
         self.numsegments = numsegments
         if path is None:
-            import tempfile
-
             path = tempfile.mkdtemp(prefix="ggtpu_")
             self.catalog.path = path
         self.path = path
@@ -87,8 +101,6 @@ class Database:
         # can't become silent divergence between set and running values
         self.settings_warnings: list[str] = []
         if os.path.exists(sp):
-            import json as _json
-
             try:
                 with open(sp) as f:
                     for k, v in _json.load(f).items():
@@ -108,8 +120,6 @@ class Database:
 
         cal = None
         if os.path.exists(cal_path):
-            import json as _json
-
             try:
                 with open(cal_path) as f:
                     cal = _json.load(f)
@@ -131,11 +141,10 @@ class Database:
         # manifest version) -> (planned, consts, outs, exec_key, param
         # types). Literal-parameterized keys via sql/paramize.py; bounded
         # by the plan_cache_size GUC (_cached_plan)
-        from collections import OrderedDict as _OD
 
         self._select_cache: dict = _OD()
         # per-thread (threaded SQL server): see the _plan_cache_info property
-        self._pc_info_local = _threading.local()
+        self._pc_info_local = threading.local()
         # statement signatures the binder proved unparameterizable: later
         # literal variants of the shape skip the doomed normalized bind
         # and go straight to the value-pinned plan (bounded backstop)
@@ -200,7 +209,6 @@ class Database:
         # SHARED mode plus a per-table lock, so appenders to different
         # tables run concurrently end-to-end (per-table delta manifests
         # make their commits contention-free too — docs/ROBUSTNESS.md)
-        import threading
 
         self._write_lock = _RWLock()
         self._table_locks: dict[str, threading.RLock] = {}
@@ -294,7 +302,6 @@ class Database:
         """Best-effort: a recorded extension whose module is gone must not
         brick the cluster (PG opens the database and errors at use); its
         functions simply stay unknown."""
-        import warnings
 
         from greengage_tpu import extensions as X
 
@@ -378,8 +385,6 @@ class Database:
             digest = None
             planned = getattr(self._pc_info_local, "planned", None)
             if planned is not None:
-                import hashlib
-
                 from greengage_tpu.planner.logical import describe as _desc
 
                 digest = hashlib.sha1(
@@ -393,8 +398,6 @@ class Database:
                 duration_ms=dur_ms)
             tr = _TRACES.current()
             if tr is not None and self.log.enabled:
-                import json as _json
-
                 # the registry sets dur_ms at exit (after this dump):
                 # record the measured duration now so the exported JSON
                 # carries it instead of null
@@ -470,7 +473,6 @@ class Database:
         verify theirs matches BEFORE entering the collectives — the
         lockstep assertion VERDICT r3 #8 asked for. None when the
         statement has no single pre-plannable query."""
-        import hashlib
 
         from greengage_tpu.planner.logical import describe
 
@@ -599,7 +601,11 @@ class Database:
             self.log.error("multihost", f"topology save failed: {e}")
         dl = Deadline(float(self.settings.mh_reform_deadline_s))
         while ch.pending_count() < survivors_want and not dl.expired:
-            time.sleep(0.02)
+            # re-formation must run to completion-or-fallback even when
+            # the triggering statement was cancelled: aborting mid-reform
+            # leaves a half-promoted topology no later statement can use.
+            # Bounded by mh_reform_deadline_s.
+            time.sleep(0.02)   # gg:ok(interrupts)
         ch.adopt_pending()
         try:
             self._mh_sync_gang(phase="reform sync")
@@ -629,7 +635,6 @@ class Database:
         """Replay the settings + topology sync against the current gang;
         raises WorkerDied/RuntimeError when any member is gone or reports
         a stale topology version (shared directory out of sync)."""
-        import dataclasses as _dc
 
         from greengage_tpu.parallel.multihost import WorkerDied
 
@@ -806,9 +811,6 @@ class Database:
         """Serve one statement from a fresh single-process subprocess over
         the shared directory (all segments local). Transactions cannot
         span subprocesses; everything else completes with full results."""
-        import json as _json
-        import subprocess
-        import sys as _sys
 
         if self.dtm.current is not None and self.dtm.current.state == "active":
             raise SqlError("cluster is degraded (worker died); transactions "
@@ -916,6 +918,9 @@ class Database:
                     return redispatch()
                 if dl.expired:
                     break
+                # retry-window wait = a cancellation point: a cancelled
+                # statement must not sit out the full window first
+                check_interrupts()
                 time.sleep(0.05)
         return self._degraded_sql(text)
 
@@ -1172,7 +1177,6 @@ class Database:
         """Per-table append serializer (same-table appenders queue; the
         base storage table keys the lock so partition children share their
         parent's)."""
-        import threading
 
         base = table.split("#", 1)[0]
         with self._table_locks_mu:
@@ -1225,7 +1229,6 @@ class Database:
                 # compiled programs scanning this table must not survive a
                 # same-named recreate (the shape signature could coincide)
                 self.executor.invalidate_table(stmt.name)
-                import shutil
 
                 for st in storage:
                     shutil.rmtree(os.path.join(self.path, "data", st),
@@ -1453,7 +1456,6 @@ class Database:
         statement; accumulation tables are real (ephemeral) tables, so
         the final query plans/distributes normally. UNION (not ALL)
         dedupes rows across iterations — which is also the cycle guard."""
-        import copy as _copy
 
         MAX_ITER = 500
         mapping: dict[str, str] = {}
@@ -1569,12 +1571,16 @@ class Database:
             logical, outs = binder.bind_select(stmt)
         planned = plan_query(logical, self.catalog, self.store, self.numsegments,
                              force_multi_join=force_multi_join)
+        if self.settings.plan_validate:
+            # checkPlan-before-dispatch (analysis/plancheck.py): a plan
+            # violating a Motion/locality/prune invariant dies HERE with a
+            # typed node path, never as a wrong answer after dispatch
+            validate_plan(planned, self.catalog)
         if info is not None:
             info["memo_used"] = binder.memo_used
         # content digest of the LUT pool, computed once per bind: part of
         # the executor's executable-reuse shape signature (the compiled
         # program bakes these arrays)
-        import hashlib
 
         h = hashlib.sha1()
         for k in sorted(binder.consts):
@@ -1695,7 +1701,6 @@ class Database:
         (reference: src/backend/cdb/endpoint/cdbendpoint.c — there results
         park on the segments behind direct connections, here as per-shard
         host buffers after the single device fetch)."""
-        import threading
 
         self._validate_declare(stmt)
         with self._write_lock:
@@ -1758,7 +1763,6 @@ class Database:
     def close_thread_cursors(self) -> None:
         """Release cursors declared by the calling thread (connection
         teardown; the reference's endpoints die with their session)."""
-        import threading
 
         me = threading.get_ident()
         with self._write_lock:
@@ -2002,10 +2006,8 @@ class Database:
                 return res
 
     def _record_stats(self, res) -> None:
-        import time as _time
-
         self.stat_activity.append({
-            "ts": _time.time(),
+            "ts": time.time(),
             "wall_ms": res.wall_ms,
             "rows": len(res),
             **(res.stats or {}),
@@ -2252,8 +2254,6 @@ class Database:
         queue; either is a no-op when unconfigured. The wait is metered
         into the queue_wait_ms histogram (`gg metrics`) and the
         statement's trace."""
-        from contextlib import ExitStack
-
         t0 = time.monotonic()
         st = ExitStack()
         try:
@@ -2341,7 +2341,6 @@ class Database:
         if ext["exec_cmd"] is not None:
             # EXECUTE ON ALL: the command runs once per segment with
             # GP_SEGMENT_ID/GP_SEGMENT_COUNT env (fileam.c EXECUTE popen)
-            import subprocess
 
             for seg in range(self.numsegments):
                 env = dict(os.environ,
@@ -2356,7 +2355,6 @@ class Database:
                         f"{out.stderr.decode(errors='replace')[:200]}")
                 chunks.append((out.stdout, True))
             return chunks
-        import glob as _glob
 
         for url in ext["urls"]:
             if url.startswith("gpfdist://"):
@@ -2501,7 +2499,6 @@ class Database:
             del tx["tables"][child]
             self.store.manifest.commit_tx(tx)
             self.store.manifest.drop_table_deltas(child)
-        import shutil
 
         shutil.rmtree(os.path.join(self.path, "data", child),
                       ignore_errors=True)
@@ -2607,19 +2604,14 @@ class Database:
         return f"INSERT 0 {n}"
 
     def _write_external(self, schema, ext, res) -> str:
-        import csv as _c
-        import io
-
         buf = io.StringIO()
         fmt = ext.get("format", {})
-        w = _c.writer(buf, delimiter=fmt.get("delimiter", ","))
+        w = _csv.writer(buf, delimiter=fmt.get("delimiter", ","))
         null_s = fmt.get("null", "")
         for row in res.rows():
             w.writerow([null_s if v is None else v for v in row])
         payload = buf.getvalue()
         if ext["exec_cmd"] is not None:
-            import subprocess
-
             out = subprocess.run(ext["exec_cmd"], shell=True,
                                  input=payload.encode(), timeout=120,
                                  capture_output=True)
@@ -2635,7 +2627,6 @@ class Database:
         if url.startswith("s3://"):
             # one object per INSERT batch (the gpcloud writable layout:
             # unique keys so parallel writers never clobber)
-            import uuid as _uuid
 
             from greengage_tpu.runtime import s3
 
@@ -2734,7 +2725,6 @@ class Database:
         """gpssh analog: run a shell command on every host of the cluster
         — workers over the control channel, the coordinator locally.
         -> [{'host': id, 'ok': bool, 'output': str}]."""
-        import subprocess
 
         out = []
         local = subprocess.run(cmd, shell=True, capture_output=True,
@@ -3308,8 +3298,6 @@ class _RWLock:
     statement paths)."""
 
     def __init__(self):
-        import threading
-
         self._c = threading.Condition()
         self._excl: int | None = None     # owning thread ident
         self._depth = 0
@@ -3318,15 +3306,16 @@ class _RWLock:
 
     # exclusive (context manager: `with db._write_lock:`)
     def __enter__(self):
-        import threading
-
         me = threading.get_ident()
         with self._c:
             self._excl_waiting += 1
             try:
                 while not (self._excl in (None, me)
                            and all(t == me for t in self._shared)):
-                    self._c.wait()
+                    # timed slices: a cancelled writer must leave the
+                    # wait (statement cancellation point, PR-4 style)
+                    self._c.wait(0.25)
+                    check_interrupts()
             finally:
                 self._excl_waiting -= 1
             self._excl = me
@@ -3342,18 +3331,16 @@ class _RWLock:
         return False
 
     def shared(self):
-        from contextlib import contextmanager
-
-        @contextmanager
+        @_contextmanager
         def _shared_cm():
-            import threading
-
             me = threading.get_ident()
             with self._c:
                 while (self._excl not in (None, me)
                        or (self._excl_waiting and self._excl is None
                            and me not in self._shared)):
-                    self._c.wait()
+                    # timed slices: cancelled appenders leave the wait
+                    self._c.wait(0.25)
+                    check_interrupts()
                 self._shared[me] = self._shared.get(me, 0) + 1
             try:
                 yield self
@@ -3471,7 +3458,6 @@ def _ddl_type(t) -> str:
 def _rename_base_tables(node, mapping: dict):
     """Rewrite BaseTable references per ``mapping`` everywhere in the AST
     (including subqueries) — the worktable substitution."""
-    import dataclasses as _dc
 
     if isinstance(node, A.BaseTable):
         if node.name in mapping:
@@ -3494,7 +3480,6 @@ def _rename_base_tables(node, mapping: dict):
 def _inferred_col(name: str, arr):
     """ColInfo-lite (name+type) from a host result array — the typing
     fallback for constant-only recursive base terms."""
-    from types import SimpleNamespace
 
     k = arr.dtype.kind
     if k == "M":

@@ -24,6 +24,8 @@ rejecting it at the vmem admission check.
 """
 
 from __future__ import annotations
+import copy
+import itertools
 
 import numpy as np
 
@@ -280,7 +282,6 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
     # k+1's cold block reads into the block cache (exec/staging.py; all
     # passes share the same committed files, so after the budget-resident
     # first pass this is a cheap cache probe)
-    import itertools
 
     from greengage_tpu.exec import staging as _staging
 
@@ -568,9 +569,7 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
     # passes must NOT carry the Limit: its host re-limit would drop each
     # CHUNK's first `offset` rows; offset/limit apply once after the merge
     if limit_node is not None:
-        import copy as _copy
-
-        pass_plan = _copy.copy(plan)
+        pass_plan = copy.copy(plan)
         pass_plan.child = sort
     else:
         pass_plan = plan
@@ -672,7 +671,6 @@ def _replace_child(plan: Plan, target: Plan, repl: Plan,
     ``node_map`` (optional) collects id(clone) -> id(original) for the
     cloned path nodes so instrumented row counts from the merged plan can
     be attributed back to the original tree's nodes."""
-    import copy
 
     if plan is target:
         return repl

@@ -23,6 +23,7 @@ import importlib.util
 import os
 from dataclasses import dataclass
 from typing import Callable
+import sys
 
 from greengage_tpu import types as T
 
@@ -84,7 +85,6 @@ def load(name: str, cluster_path: str | None = None) -> None:
     gppkg analog), then any importable module of that name. A module
     that imports but registers NOTHING is rejected — `create extension
     json` must not silently record an arbitrary stdlib module."""
-    import sys
 
     pkg_root = (os.path.join(cluster_path, "extensions")
                 if cluster_path else None)

@@ -9,6 +9,7 @@ Subcommands mirror the reference's operator tools:
   gg recover  -d DIR              gprecoverseg: roll back in-doubt 2PC,
                                   rebalance roles to preferred
   gg checkcat -d DIR              gpcheckcat: catalog/storage consistency
+  gg check [--plans] [--json]     static-analysis gate (docs/ANALYSIS.md)
 
 Run as: python -m greengage_tpu.mgmt.cli <cmd> ...
 """
@@ -19,7 +20,12 @@ import argparse
 import json
 import os
 import re
+import shutil
+import signal
 import sys
+import tarfile
+import tempfile
+import time
 
 
 def _open(path, numsegments=None):
@@ -47,7 +53,6 @@ def cmd_mirrorroots(args):
     content k's mirror tree under roots[(k+1) % n] — offset so a content
     never mirrors onto its own root when roots are per-host mounts — and
     move any already-replicated trees there."""
-    import shutil
 
     from greengage_tpu.storage.table_store import mirror_root
 
@@ -97,7 +102,6 @@ def cmd_mapreduce(args):
 def cmd_config(args):
     """gpconfig analog: show or persist cluster-level settings
     (settings.json, adopted by every connect on every process)."""
-    import json
 
     sp = os.path.join(args.dir, "settings.json")
     vals = {}
@@ -232,8 +236,6 @@ def cmd_checkperf(args):
     """gpcheckperf analog: micro-benchmark the cluster's hardware paths —
     data-dir disk bandwidth, host memory bandwidth, device HBM bandwidth,
     and the mesh collective (ICI) path."""
-    import tempfile
-    import time
 
     import numpy as np
 
@@ -302,11 +304,9 @@ def cmd_checkperf(args):
             cal = _measure_device_primitives()
             results.update({f"cal_{k}": v for k, v in cal.items()})
             if getattr(args, "apply", False):
-                import json as _json
-
                 p = os.path.join(args.dir, "calibration.json")
                 with open(p, "w") as f:
-                    _json.dump(cal, f, indent=1)
+                    json.dump(cal, f, indent=1)
                 print(f"calibration written to {p}")
         except Exception as e:
             results["calibration_error"] = str(e)[:160]
@@ -328,7 +328,6 @@ def _measure_device_primitives(n: int = 1 << 22) -> dict:
     CALIBRATION_DEFAULTS) on the live backend: random gather, scatter-add,
     two-operand sort, HBM streaming, and the device->host relay. The ICI
     constant needs >1 device; on a single chip it keeps its default."""
-    import time
 
     import numpy as np
 
@@ -482,8 +481,6 @@ def cmd_pkg(args):
     greengage_tpu.extensions.register_scalar. Installing copies it under
     <cluster>/extensions/ and makes `CREATE EXTENSION <name>` resolve it
     for THIS cluster only (per-database pg_proc visibility)."""
-    import shutil
-    import tarfile
 
     ext_root = os.path.join(args.dir, "extensions")
     if args.action in ("install", "remove") and not args.package:
@@ -623,8 +620,6 @@ def cmd_server(args):
     where = args.socket + (
         f" and {host}:{srv.port}" if srv._tcp_server is not None else "")
     print(f"serving {args.dir} on {where} (ctrl-c to stop)")
-    import signal
-    import time as _t
 
     try:
         if hasattr(signal, "pause"):
@@ -634,7 +629,7 @@ def cmd_server(args):
             # (the old blanket AttributeError handler silently swallowed
             # REAL AttributeError bugs from anywhere in the wait path)
             while True:
-                _t.sleep(3600)
+                time.sleep(3600)
     except KeyboardInterrupt:
         # flag every in-flight statement before tearing the listener
         # down, so blocked connections die with a typed cause instead of
@@ -690,7 +685,6 @@ def cmd_start(args):
     if pid:
         # parent: reap the intermediate child (it exits at once in the
         # double fork), then poll the pidfile until the daemon confirms
-        import time as _t
 
         os.waitpid(pid, 0)
         for _ in range(1200):   # jax import + device init can take ~30s
@@ -698,7 +692,7 @@ def cmd_start(args):
             if info and _pid_alive(info[0]):
                 print(f"server started (pid {info[0]}, socket {info[1]})")
                 return 0
-            _t.sleep(0.05)
+            time.sleep(0.05)
         print("error: server failed to start (see log/server.out)",
               file=sys.stderr)
         return 1
@@ -718,7 +712,6 @@ def cmd_start(args):
     with open(_pidfile(args.dir), "w") as f:
         f.write(f"{os.getpid()}\n{sock}\n")
     db.log.info("lifecycle", f"server started on {sock}")
-    import signal
 
     # sigwait avoids the check-then-pause lost-wakeup race: the signal is
     # blocked until we are actually waiting for it
@@ -737,8 +730,6 @@ def cmd_start(args):
 def cmd_stop(args):
     """gpstop analog. -m smart/fast: SIGTERM + wait; -m immediate:
     SIGKILL."""
-    import signal
-    import time as _t
 
     info = _read_pidfile(args.dir)
     if not info or not _pid_alive(info[0]):
@@ -759,7 +750,7 @@ def cmd_stop(args):
             except OSError:
                 pass
             return 0
-        _t.sleep(0.05)
+        time.sleep(0.05)
     print(f"error: server (pid {pid}) did not exit in {args.timeout}s "
           "(try -m immediate)", file=sys.stderr)
     return 1
@@ -1040,7 +1031,6 @@ def cmd_backup(args):
     names one committed version's files; DELETE/UPDATE/expand may GC old
     files concurrently, so a vanished file triggers a re-snapshot retry
     until one version copies completely."""
-    import shutil
 
     db = _open(args.dir)
     last_err = None
@@ -1079,8 +1069,6 @@ def cmd_backup(args):
 
 
 def cmd_restore(args):
-    import shutil
-
     if os.path.exists(os.path.join(args.dir, "catalog.json")):
         print(f"error: {args.dir} already contains a cluster", file=sys.stderr)
         return 1
@@ -1132,6 +1120,23 @@ def cmd_scrub(args):
                  if str(p.get("status", "")).startswith(
                      ("standby_corrupt", "standby_refresh"))))
     return 1 if bad else 0
+
+
+def cmd_check(args):
+    """gg check: the static-analysis gate (docs/ANALYSIS.md) — codebase
+    lints always; the TPC-H/TPC-DS plan-corpus sweep under --plans."""
+    from greengage_tpu.analysis.runner import run_checks, run_plan_corpus
+
+    report = run_checks(names=args.checks or None,
+                        baseline_file=args.baseline,
+                        use_baseline=not args.no_baseline)
+    if args.plans:
+        report.extend(run_plan_corpus(numsegments=args.nseg))
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.to_text())
+    return 1 if report.findings else 0
 
 
 def cmd_checkcat(args):
@@ -1347,6 +1352,19 @@ def main(argv=None):
     p = sub.add_parser("recover")
     p.add_argument("-d", "--dir", required=True)
     p.set_defaults(fn=cmd_recover)
+
+    p = sub.add_parser("check")   # static analysis gate (docs/ANALYSIS.md)
+    p.add_argument("checks", nargs="*",
+                   help="subset of checks (default: all static lints)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--plans", action="store_true",
+                   help="also validate the TPC-H/TPC-DS plan corpus")
+    p.add_argument("--nseg", type=int, default=4)
+    p.add_argument("--baseline", default=None,
+                   help="alternate baseline file (default: checked-in)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="show findings the baseline would suppress")
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("checkcat")
     p.add_argument("-d", "--dir", required=True)

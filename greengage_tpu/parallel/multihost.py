@@ -45,6 +45,7 @@ import sys
 import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
+import subprocess
 
 from greengage_tpu.runtime import interrupt
 from greengage_tpu.runtime.faultinject import FaultError, faults
@@ -196,7 +197,9 @@ class CoordinatorChannel:
             for _ in range(expected_workers):
                 try:
                     self._srv.settimeout(dl.remaining(minimum=0.001))
-                    conn, _ = self._srv.accept()
+                    # gang assembly at Database init: no statement exists
+                    # yet; bounded by mh_connect_deadline
+                    conn, _ = self._srv.accept()   # gg:ok(interrupts)
                     peer = self._handshake(conn, dl)
                 except (socket.timeout, TimeoutError):
                     raise WorkerDied(
@@ -353,7 +356,8 @@ class CoordinatorChannel:
                 if interval <= 0:
                     return     # '0 disables' applies to a LIVE SET too —
                                # wait(0) would turn this into a busy loop
-                if self._hb_stop.wait(interval):
+                # heartbeat daemon thread: never a statement thread
+                if self._hb_stop.wait(interval):   # gg:ok(interrupts)
                     return
                 if self._quiesced or self._closed or self.hb_failure:
                     return
@@ -408,7 +412,9 @@ class CoordinatorChannel:
             while not self._rejoin_stop.is_set():
                 try:
                     self._srv.settimeout(0.2)
-                    conn, _ = self._srv.accept()
+                    # rejoin accept thread (quiesce keeps the listener
+                    # open for redialing workers): not a statement thread
+                    conn, _ = self._srv.accept()   # gg:ok(interrupts)
                 except (socket.timeout, TimeoutError):
                     continue
                 except OSError:
@@ -673,7 +679,9 @@ def _worker_idle_timeout(db) -> float | None:
 
 def _serve_one(db, ch) -> bool:
     """Handle one control frame; False = clean stop."""
-    msg = ch.recv(_worker_idle_timeout(db))
+    # worker process main loop: no statement registry on this side (the
+    # coordinator cancels by quiescing/stopping the exchange)
+    msg = ch.recv(_worker_idle_timeout(db))   # gg:ok(interrupts)
     op = msg.get("op")
     if op == "stop":
         return False
@@ -723,7 +731,6 @@ def _serve_one(db, ch) -> bool:
     if op == "exec":
         # gpssh role: run a shell command on every worker host over
         # the control plane; the ack's error slot carries the output
-        import subprocess
 
         try:
             out = subprocess.run(
@@ -760,7 +767,7 @@ def _serve_one(db, ch) -> bool:
     except Exception as e:
         ch.ack(False, f"{type(e).__name__}: {e}")
         return True
-    nxt = ch.recv(_worker_idle_timeout(db))
+    nxt = ch.recv(_worker_idle_timeout(db))   # gg:ok(interrupts)
     if nxt.get("op") == "stop":
         return False
     if nxt.get("op") != "go":

@@ -27,6 +27,8 @@ themselves immediately.
 """
 
 from __future__ import annotations
+import bisect
+import math
 
 from greengage_tpu import expr as E
 
@@ -123,7 +125,6 @@ def _hist_frac_below(hist: list, v: float) -> float:
     (planner/stats.py): whole buckets below v count 1/nbuckets each, the
     straddling bucket interpolates linearly within its boundaries — the
     CHistogram bucket-calculus / ineq_histogram_selectivity analog."""
-    import bisect
 
     nb = len(hist) - 1
     if v <= hist[0]:
@@ -212,7 +213,6 @@ def est_groups(rows: float, ndvs: list[float] | None = None) -> float:
             if prod >= rows:
                 return max(rows, 1.0)
         return max(min(prod, rows), 1.0)
-    import math
 
     return min(max(math.sqrt(max(rows, 1.0)) * 4, 16.0), 1 << 20)
 

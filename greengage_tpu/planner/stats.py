@@ -18,6 +18,8 @@ mis-estimate via the overflow-tier retry.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+import hashlib
+import json
 
 import numpy as np
 
@@ -271,8 +273,6 @@ def join_selectivity(ls: ColumnStats, rs: ColumnStats,
 def table_fingerprint(snap: dict, schema) -> str:
     """Stable hash of a table's manifest entries (all storage children) —
     equal fingerprints mean the on-disk data is unchanged since analyze."""
-    import hashlib
-    import json
 
     tables = snap.get("tables", {})
     ent = {s: tables.get(s) for s in schema.storage_tables()}

@@ -24,6 +24,28 @@ class FaultError(RuntimeError):
     pass
 
 
+# Registered fault points — the shmem-registry analog's name catalog and
+# the source of truth `gg check` (analysis/lint_registry.py) cross-checks:
+# every faults.check() site in the package must name a registered point,
+# every registered point must have a check() site, and every
+# faults.inject() in the test tree must target a registered point (the
+# injector's OWN unit tests use throwaway names under a lint pragma).
+# Runtime stays permissive — unknown names simply never fire — so the
+# registry can't break production; drift is a merge-time lint failure.
+FAULT_POINTS = frozenset({
+    # multihost control plane (parallel/multihost.py, exec/session.py)
+    "dispatch_send", "worker_ack", "heartbeat", "retry_redispatch",
+    "mesh_reform", "mirror_promote_during_reform",
+    # FTS / DTM (runtime/fts.py, runtime/dtm.py)
+    "fts_probe", "dtx_before_prepare", "dtx_after_prepare",
+    "dtx_before_commit", "dtx_after_commit", "commit_during_reform",
+    # storage read/repair/scrub (storage/)
+    "storage_corrupt_block", "repair_copy", "scrub_file", "delta_fold",
+    # statement lifecycle (exec/executor.py)
+    "cancel_before_dispatch", "cancel_in_staging",
+})
+
+
 @dataclass
 class _Fault:
     name: str

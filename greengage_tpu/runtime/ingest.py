@@ -22,6 +22,9 @@ import socketserver
 import threading
 import urllib.parse
 import urllib.request
+import csv as _csv
+import io
+import time
 
 
 # ---------------------------------------------------------------------------
@@ -154,8 +157,6 @@ def parse_csv_rows(text: str, schema, delim: str, header: bool, null_s: str,
     """-> (cols {name: list}, valids {name: list}, rejects [(line, raw,
     error)]). Malformed rows are REJECTED, not fatal (cdbsreh.c role) —
     the caller enforces the reject limit."""
-    import csv as _csv
-    import io
 
     from greengage_tpu import types as T
 
@@ -215,7 +216,6 @@ def _zero_for(t):
 def append_error_log(root: str, table: str, rejects: list) -> None:
     d = os.path.join(root, "errlog")
     os.makedirs(d, exist_ok=True)
-    import time
 
     with open(os.path.join(d, f"{table}.jsonl"), "a") as f:
         for line, raw, err in rejects:

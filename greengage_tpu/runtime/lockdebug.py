@@ -1,0 +1,130 @@
+"""Debug-mode runtime lock-order assertions — the dynamic half of the
+``gg check`` lock-order analyzer (analysis/lint_locks.py).
+
+The static analyzer sees the package-wide acquisition graph but must
+collapse per-key lock *families* (``session._table_locks``, the repair
+locks) to one node; this hook watches real acquisitions and fails the
+process on an order inversion the moment one thread observes A -> B
+after any thread observed B -> A — the classic witness a deadlock needs,
+caught even when the interleaving never actually deadlocks.
+
+Zero-cost by default: nothing records unless ``enable()`` ran (tests,
+``GGTPU_LOCK_DEBUG=1``). Usage::
+
+    from greengage_tpu.runtime import lockdebug
+    lock = lockdebug.named(threading.Lock(), "manifest._log_lock")
+    with lock: ...
+
+``named()`` returns the lock unwrapped when disabled, so production
+paths keep raw ``threading`` primitives.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class LockOrderError(AssertionError):
+    """Two lock names were observed in both acquisition orders."""
+
+
+class _OrderTable:
+    """Global observed-order relation: pair (a, b) means some thread
+    held a while acquiring b. Inversions raise immediately."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._after: dict[str, set[str]] = {}
+        self._local = threading.local()
+
+    def _held(self) -> list[str]:
+        h = getattr(self._local, "held", None)
+        if h is None:
+            h = self._local.held = []
+        return h
+
+    def acquiring(self, name: str) -> None:
+        held = self._held()
+        with self._mu:
+            for outer in held:
+                if outer == name:
+                    continue   # re-entrant same-name holds are fine
+                if name in self._after and outer in self._after[name]:
+                    raise LockOrderError(
+                        f"lock-order inversion: acquiring {name!r} while "
+                        f"holding {outer!r}, but {outer!r} was previously "
+                        f"acquired while holding {name!r}")
+                self._after.setdefault(outer, set()).add(name)
+        held.append(name)
+
+    def released(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+    def reset(self) -> None:
+        with self._mu:
+            self._after.clear()
+
+
+_TABLE = _OrderTable()
+_ENABLED = bool(int(os.environ.get("GGTPU_LOCK_DEBUG", "0") or "0"))
+
+
+def enable(on: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = on
+    if not on:
+        _TABLE.reset()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def reset() -> None:
+    _TABLE.reset()
+
+
+class _Named:
+    """Order-asserting proxy for Lock/RLock (context-manager protocol +
+    acquire/release, which covers every package call pattern)."""
+
+    __slots__ = ("_lock", "_name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self._name = name
+
+    def acquire(self, *a, **kw):
+        _TABLE.acquiring(self._name)
+        got = self._lock.acquire(*a, **kw)
+        if not got:
+            _TABLE.released(self._name)
+        return got
+
+    def release(self):
+        self._lock.release()
+        _TABLE.released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *a):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+
+def named(lock, name: str):
+    """Wrap ``lock`` with order assertions under debug mode; return it
+    untouched otherwise."""
+    if not _ENABLED:
+        return lock
+    return _Named(lock, name)

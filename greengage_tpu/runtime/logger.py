@@ -32,6 +32,40 @@ SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL", "PANIC")
 # for them even in a process that has only inc()'d so far
 GAUGE_NAMES = ("mh_topology_version",)
 
+# Declared metric catalog — the source of truth `gg check`
+# (analysis/lint_registry.py) cross-checks against the package source:
+# every counters.inc() site must name a declared counter (f-string
+# families match by their literal prefix), every counters.set() site a
+# declared gauge, every histograms.observe() site a declared histogram —
+# and every declared name must have a writer. Undeclared names are a
+# merge-time lint failure, so the docs/OBSERVABILITY.md metric catalog
+# and the exposition can't silently drift from the code.
+COUNTER_NAMES = (
+    # plan / executable cache (exec/session.py, exec/executor.py)
+    "plan_cache_hit", "plan_cache_miss", "plan_cache_fallback",
+    "program_cache_hit", "program_cache_miss", "program_cache_unsignable",
+    "params_hoisted", "compile_ms",
+    # statement lifecycle (exec/session.py, runtime/resqueue.py)
+    "statements_cancelled_user", "statements_cancelled_timeout",
+    "statements_cancelled_runaway", "statements_cancelled_client_gone",
+    "statements_cancelled_shutdown", "statements_retried",
+    "queue_cancelled_total", "slow_statements",
+    # host data path (storage/blockcache.py, exec/executor.py)
+    "scan_files_read", "scan_bytes_decoded",
+    "scan_cache_hit", "scan_cache_miss", "scan_cache_evict",
+    # storage self-heal (storage/table_store.py, storage/scrub.py)
+    "storage_repair", "storage_standby_repair", "storage_quarantine",
+    "storage_scrub_runs", "storage_scrub_files",
+    # manifest commit path + topology (storage/manifest.py, exec/session.py)
+    "manifest_delta_commits", "manifest_cas_retry_total",
+    "manifest_cas_conflict_total", "manifest_folds", "mh_reform_total",
+)
+
+HISTOGRAM_NAMES = (
+    "statement_ms", "queue_wait_ms", "compile_latency_ms",
+    "stage_ms", "dispatch_ms", "fetch_ms",
+)
+
 
 class Counters:
     """Process-wide monotonic event counters (the pg_stat counter surface):

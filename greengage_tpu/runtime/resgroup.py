@@ -28,6 +28,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime.logger import counters
+
 DEFAULT_GROUP = "default_group"
 ADMIN_GROUP = "admin_group"
 
@@ -75,7 +78,10 @@ class ResourceGroupManager:
 
     def __init__(self, settings, groups: dict[str, ResourceGroup] | None = None):
         self.settings = settings
-        self._lock = threading.Lock()
+        # RLock: add_listener fires the waker INLINE when the cancel flag
+        # is already set, on the admitting thread, while it holds this
+        # lock (same re-entrancy as ResourceQueue)
+        self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self.groups: dict[str, ResourceGroup] = groups or {}
         for name, weight in ((DEFAULT_GROUP, 100), (ADMIN_GROUP, 300)):
@@ -164,6 +170,7 @@ class ResourceGroupManager:
     def admit(self, group: str | None = None):
         name = group or self.current_group()
         timeout = float(getattr(self.settings, "resource_queue_timeout_s", 30.0))
+        ctx = interrupt.REGISTRY.current()
         with self._cond:
             g = self.groups.get(name)
             if g is None:   # dropped since SET: fall back to default
@@ -173,12 +180,35 @@ class ResourceGroupManager:
                 return _GroupSlot(self, g, counted=False)
             deadline = time.monotonic() + timeout
             g.waiting += 1
+            # cancel() from another thread must WAKE this wait, not be
+            # discovered at the next timeout slice (same discipline as
+            # ResourceQueue.admit)
+            waker = None
+            if ctx is not None:
+                def waker():
+                    with self._cond:
+                        self._cond.notify_all()
+                ctx.add_listener(waker)
             try:
                 while not self._eligible(g):
+                    if ctx is not None and ctx.cancelled:
+                        # leave the wait NOW; re-notify so a release that
+                        # raced our abandonment is never lost
+                        self._cond.notify_all()
+                        counters.inc("queue_cancelled_total")
+                        ctx.check()   # raises StatementCancelled
                     remaining = deadline - time.monotonic()
+                    if ctx is not None:
+                        sr = ctx.remaining()
+                        if sr is not None:
+                            remaining = min(remaining, sr + 0.001)
                     if remaining <= 0 or not self._cond.wait(remaining):
+                        if ctx is not None and ctx.cancelled:
+                            continue   # classify at the loop head
                         if self._eligible(g):
                             break
+                        if deadline - time.monotonic() > 0:
+                            continue   # woken by a cancel-listener ping
                         g.timed_out_total += 1
                         self._cond.notify_all()
                         raise GroupTimeout(
@@ -187,6 +217,8 @@ class ResourceGroupManager:
                             f"(concurrency={g.concurrency or 'unlimited'})")
             finally:
                 g.waiting -= 1
+                if waker is not None:
+                    ctx.remove_listener(waker)
             g.active += 1
             g.admitted_total += 1
             # wake deferred waiters: our admission changed _next_group()'s

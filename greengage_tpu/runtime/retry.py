@@ -22,7 +22,18 @@ from __future__ import annotations
 
 import random
 import socket
+import sys
 import time
+
+
+def _check_interrupts() -> None:
+    """Interrupt poll that keeps this module stdlib-only: when the engine
+    is loaded, retry sleeps are statement cancellation points (PR-4
+    discipline); when bench.py file-loads this module standalone, the
+    registry module is absent and this is a no-op."""
+    mod = sys.modules.get("greengage_tpu.runtime.interrupt")
+    if mod is not None:
+        mod.check_interrupts()
 
 # Errors that indicate a transient transport condition: the peer is not
 # (yet) reachable or the exchange timed out — retrying can succeed.
@@ -131,4 +142,12 @@ class RetryPolicy:
                         on_retry(attempt, e, delay)
                     except Exception:
                         pass
-                time.sleep(delay)
+                # backoff in short slices so a cancel LANDING mid-sleep
+                # fires within ~0.25s, not after the full delay (cap_s=5)
+                until = time.monotonic() + delay
+                while True:
+                    _check_interrupts()
+                    rem = until - time.monotonic()
+                    if rem <= 0:
+                        break
+                    time.sleep(min(rem, 0.25))

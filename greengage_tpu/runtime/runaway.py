@@ -24,6 +24,7 @@ statement one cancellable unit.
 from __future__ import annotations
 
 import threading
+import time
 
 from greengage_tpu.runtime.interrupt import REGISTRY, StatementCancelled
 
@@ -95,7 +96,6 @@ class VmemTracker:
             total = sum(e.bytes for e in self._active.values())
             if total <= red_zone * global_limit_bytes:
                 return
-            import time
 
             now = time.monotonic()
             if any(e.cancel_reason is not None and now - e.flag_time < 10.0

@@ -34,6 +34,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
+import configparser
 
 
 class S3Error(IOError):
@@ -66,7 +67,6 @@ def load_config(path: str | None) -> dict:
             "https": True}
     if not path:
         return conf
-    import configparser
 
     cp = configparser.ConfigParser()
     read = cp.read(path)

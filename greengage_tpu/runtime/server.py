@@ -35,6 +35,7 @@ import os
 import socket
 import socketserver
 import threading
+import select
 
 from greengage_tpu.runtime.interrupt import REGISTRY, StatementCancelled
 
@@ -46,7 +47,6 @@ def _watch_client(sock, thread_ident: int, stop: "threading.Event") -> None:
     it dies at its next cancellation point instead of running to
     completion for nobody. A readable socket with DATA is a pipelined
     request (client alive): stop watching, never consume it."""
-    import select
 
     while not stop.wait(0.1):
         try:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 
 MARKER = "standby.json"
 PRIMARY_MARKER = "standby_registered.json"
@@ -87,8 +88,6 @@ def _write_composed_manifest(cluster_path: str, standby_path: str) -> None:
     if not os.path.exists(os.path.join(cluster_path, "manifest.json")) \
             and not snap.get("version"):
         return
-
-    import tempfile
 
     fd, tmp = tempfile.mkstemp(dir=standby_path, prefix=".manifest")
     with os.fdopen(fd, "w") as f:

@@ -13,6 +13,9 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import re
+import copy as _copy
+import datetime
+import operator
 
 import numpy as np
 
@@ -120,7 +123,6 @@ class Binder:
         # place, and callers bind the same statement twice (multihost
         # plan-hash + execute; plan caches keyed on the AST) — one
         # defensive copy here establishes the invariant for all of them
-        import copy as _copy
 
         stmt = _copy.deepcopy(stmt)
         if isinstance(stmt, A.UnionStmt):
@@ -681,7 +683,6 @@ class Binder:
         plans for grouping extensions, gram.y:12457 -> planner groupingsets
         paths). Keys absent from a set project as typed NULLs; grouping()
         folds to a per-branch constant bitmask."""
-        import copy as _copy
 
         universe: dict[str, A.ANode] = {}
         for s in stmt.grouping_sets:
@@ -2214,7 +2215,6 @@ class Binder:
                 and re_.type.kind is T.Kind.TEXT):
             if le.value is None or re_.value is None:
                 return E.Literal(None, T.BOOL)
-            import operator
 
             fn = {"=": operator.eq, "<>": operator.ne, "<": operator.lt,
                   "<=": operator.le, ">": operator.gt, ">=": operator.ge}
@@ -2360,7 +2360,6 @@ class Binder:
 
 def _expr_col_ids(e) -> set:
     """Bound column ids a predicate references (generic expr walk)."""
-    import dataclasses
 
     out: set = set()
 
@@ -2445,8 +2444,6 @@ def _render_text(lit: E.Literal) -> str:
         a = abs(v)
         return f"{sign}{a // 10**s}.{a % 10**s:0{s}d}"
     if t.kind is T.Kind.DATE:
-        import datetime
-
         return (datetime.date(1970, 1, 1)
                 + datetime.timedelta(days=v)).isoformat()
     if t.kind is T.Kind.BOOL:

@@ -25,6 +25,7 @@ is percentile_cont(0.5). DESC within-group order is rejected at parse."""
 from __future__ import annotations
 
 import copy
+import dataclasses
 
 from greengage_tpu.sql import ast as A
 from greengage_tpu.sql.parser import SqlError
@@ -49,7 +50,6 @@ def _collect(stmt) -> list:
             if n.name in ORDERED_SET and n.over is None:
                 calls.append(n)
                 return
-        import dataclasses
 
         if isinstance(n, A.ANode):
             for f in dataclasses.fields(n):
@@ -88,7 +88,6 @@ def _strip_qualifiers(n):
     reads the flattened __os subquery, where the original table aliases
     no longer exist (PG would keep them; the Star-flattening loses them
     by construction)."""
-    import dataclasses
 
     if isinstance(n, A.SelectStmt):
         return n
@@ -215,8 +214,6 @@ def expand_ordered_set(stmt: A.SelectStmt):
                                                       copy.deepcopy(vlo))))
 
     def rewrite(n):
-        import dataclasses
-
         if isinstance(n, A.SelectStmt):
             return n
         if isinstance(n, A.FuncCall) and n.name in ORDERED_SET \

@@ -1092,9 +1092,7 @@ def _references_table(node, name: str) -> bool:
     if isinstance(node, A.BaseTable):
         return node.name == name
     if isinstance(node, A.ANode):
-        import dataclasses as _dc
-
-        for f in _dc.fields(node):
+        for f in dataclasses.fields(node):
             if _references_table(getattr(node, f.name), name):
                 return True
         return False

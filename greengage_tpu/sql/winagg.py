@@ -17,6 +17,7 @@ references rewritten to the inner aliases."""
 from __future__ import annotations
 
 import copy
+import dataclasses
 
 from greengage_tpu.sql import ast as A
 
@@ -65,7 +66,6 @@ def expand_windows_over_aggs(stmt: A.SelectStmt):
                               star=n.star, distinct=n.distinct, over=spec)
         if isinstance(n, A.ANode) and not _contains_window(n):
             return ref(n)
-        import dataclasses
 
         if isinstance(n, A.ANode):
             for f in dataclasses.fields(n):

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import hashlib
 
 import numpy as np
 
@@ -36,8 +37,6 @@ class Dictionary:
         Dictionaries are append-only, which makes the cached digest
         invalidatable by length alone."""
         if self._digest is None or self._digest_len != len(self.values):
-            import hashlib
-
             h = hashlib.sha1()
             for v in self.values:
                 h.update(v.encode("utf-8", "surrogatepass"))

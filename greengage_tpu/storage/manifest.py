@@ -52,6 +52,7 @@ import threading
 import time
 import uuid
 
+from greengage_tpu.runtime import lockdebug
 from greengage_tpu.runtime.faultinject import faults
 from greengage_tpu.runtime.logger import counters
 
@@ -74,7 +75,11 @@ class Manifest:
         # composed state as a JSON string. snapshot() re-parses the string
         # per call so callers can mutate their copy freely (they do — the
         # DTM mutates tx["tables"] nested dicts in place).
-        self._compose_lock = threading.Lock()
+        # lockdebug.named: order-asserting wrappers under GGTPU_LOCK_DEBUG
+        # (docs/ANALYSIS.md) — the PR-6 chaos storm found its races on
+        # exactly these locks; raw threading.Lock when disabled
+        self._compose_lock = lockdebug.named(threading.Lock(),
+                                             "manifest._compose_lock")
         self._compose_key = None
         self._compose_json = None
         self._compose_meta: dict = {"seqs": {}, "applied": 0, "log_end": 0,
@@ -82,11 +87,13 @@ class Manifest:
         # parsed delta-file contents; immutable once committed, keyed
         # (table, seq). Bounded: cleared whenever the root is replaced.
         self._delta_cache: dict = {}
-        self._log_lock = threading.Lock()   # in-process append serializer
+        self._log_lock = lockdebug.named(   # in-process append serializer
+            threading.Lock(), "manifest._log_lock")
         # serializes the root version-guard check against the replace (two
         # in-process folds must not replace out of order; cross-process
         # ordering is upheld by the staged-claim CAS + guard re-check)
-        self._root_commit_lock = threading.Lock()
+        self._root_commit_lock = lockdebug.named(
+            threading.Lock(), "manifest._root_commit_lock")
 
     # ---- raw root ------------------------------------------------------
     def _root(self) -> dict:

@@ -22,6 +22,10 @@ import shutil
 import tempfile
 import threading
 import uuid
+import datetime
+import hashlib
+import operator
+import time as _time
 
 import numpy as np
 
@@ -304,7 +308,6 @@ class TableStore:
         """Rename a bad file into <root>/.quarantine/ with a JSON sidecar
         recording the cause — preserved for forensics, and its absence
         fails storage_ok so FTS can fail the segment over."""
-        import datetime
 
         qdir = os.path.join(self.root, ".quarantine")
         os.makedirs(qdir, exist_ok=True)
@@ -510,7 +513,6 @@ class TableStore:
         """Register (or reuse) an in-memory dictionary for a string-function
         result; -> ("@expr", sha1) ref usable wherever a (table, col)
         dict_ref is (hash LUTs, sort ranks, result decode)."""
-        import hashlib
 
         h = hashlib.sha1("\x00".join(values).encode()).hexdigest()[:16]
         ref = ("@expr", h)
@@ -743,7 +745,6 @@ class TableStore:
             # autocommit writers serialize optimistically). Each retry is
             # counted in manifest_cas_retry_total (zero for cross-table
             # workloads by construction).
-            import time as _time
 
             from greengage_tpu.runtime.logger import counters as _counters
 
@@ -1242,13 +1243,11 @@ class TableStore:
     def host_pred_name(col: str, payload: dict) -> str:
         """Virtual staged-column name carrying a host-evaluated raw-text
         predicate: '@hp:<col>:<hex json payload>'."""
-        import json
 
         return f"@hp:{col}:{json.dumps(payload, sort_keys=True).encode().hex()}"
 
     def eval_host_pred(self, table: str, seg: int, name: str, snapshot=None):
         """-> (bool array, valid|None) for one '@hp:' virtual column."""
-        import json
 
         snap = snapshot or self.manifest.snapshot()
         version = snap.get("version", 0)
@@ -1273,7 +1272,6 @@ class TableStore:
             out = np.fromiter((s in vals for s in strs), bool, len(strs))
         elif op == "chain":
             # string-function chain + comparison (utils/strfuncs semantics)
-            import operator
 
             from greengage_tpu.utils import strfuncs
 
@@ -1477,7 +1475,6 @@ class TableStore:
         scanning these files from an older snapshot (the server's
         concurrent SELECT vs UPDATE interleaving). defer=False deletes
         immediately (rollback of files nobody else ever saw)."""
-        import time as _time
 
         if defer:
             if not hasattr(self, "_pending_gc"):
@@ -1500,7 +1497,6 @@ class TableStore:
 
     def reap_gc(self) -> int:
         """Delete deferred-GC entries older than the grace period."""
-        import time as _time
 
         pend = getattr(self, "_pending_gc", [])
         now = _time.monotonic()
@@ -1519,7 +1515,6 @@ class TableStore:
         older than ``grace_s`` (crashed writers' staging, rolled-back DML
         from dead processes, deferred GC lost at exit) — the VACUUM role.
         Recent files are spared: they may belong to an in-flight write."""
-        import time as _time
 
         snap = self.manifest.snapshot()
         referenced = set()

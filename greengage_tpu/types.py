@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
+import re
+from decimal import Decimal, ROUND_HALF_UP
 
 import numpy as np
 
@@ -194,7 +196,6 @@ def from_string(v: str, t: SqlType):
 
 def decimal_to_int(value, scale: int) -> int:
     """Parse a decimal literal (str/float/int) to scaled int64, half-up."""
-    from decimal import Decimal, ROUND_HALF_UP
 
     d = Decimal(str(value)).quantize(Decimal(1).scaleb(-scale), rounding=ROUND_HALF_UP)
     return int(d.scaleb(scale))
@@ -224,7 +225,6 @@ class Coded:
 def like_to_regex(pattern: str):
     """SQL LIKE pattern -> compiled regex (shared by the dictionary-LUT
     lowering and the raw-text host evaluator)."""
-    import re
 
     out = []
     for ch in pattern:

@@ -10,6 +10,8 @@ part = 200k x SF, supplier = 10k x SF.
 """
 
 from __future__ import annotations
+import os
+import pickle
 
 import numpy as np
 
@@ -174,8 +176,6 @@ def generate_cached(sf: float, seed: int = 19940801,
     cache is keyed by (sf, seed) and validated by a version tag so a
     generator change invalidates stale files. Falls back to generate() on
     any cache error (corrupt file, disk full, ...)."""
-    import os
-    import pickle
 
     if cache_dir is None:
         # user-owned cache dir, not world-writable /tmp: the cache is

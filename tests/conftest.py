@@ -36,6 +36,13 @@ def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`; the slow tier holds the long fuzz loops
     config.addinivalue_line(
         "markers", "slow: long fuzz/stress variants excluded from tier-1")
+    # debug-mode lock-order assertions (docs/ANALYSIS.md): every
+    # lockdebug.named() site created after this point records real
+    # acquisition orders and fails the suite on an inversion — the
+    # dynamic half of the `gg check` lock-order analyzer
+    from greengage_tpu.runtime import lockdebug
+
+    lockdebug.enable(True)
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -1,0 +1,365 @@
+"""gg check: plan-invariant validator + codebase analysis suite.
+
+Four layers:
+  * plancheck over the REAL TPC-H / TPC-DS plan corpus (every corpus
+    statement validates clean; deliberately mutated plans — a dropped
+    Motion, a wrong distribution key, an interior Gather — are rejected
+    with typed PlanInvariantErrors),
+  * the per-statement plan_validate GUC hook,
+  * the static analyzers against known-bad fixture snippets (a lock
+    cycle, an unpolled wait loop, a tracer-sync violation) plus the
+    runtime lock-order hook,
+  * the merge gate itself: `gg check` over the shipped tree is clean.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+import greengage_tpu
+from greengage_tpu.analysis import astutil
+from greengage_tpu.analysis.plancheck import (PlanInvariantError,
+                                              validate_capacities,
+                                              validate_plan)
+from greengage_tpu.analysis.plancorpus import (TPCDS_QUERIES, TPCH_QUERIES,
+                                               load_tpcds_mini,
+                                               validate_corpus)
+from greengage_tpu.planner.locus import Locus, LocusKind
+from greengage_tpu.planner.logical import (Aggregate, Join, Motion,
+                                           MotionKind)
+from greengage_tpu.sql.parser import parse
+from greengage_tpu.utils import tpch
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    tpch.load(d, sf=0.005)
+    d.sql("analyze")
+    return d
+
+
+@pytest.fixture(scope="module")
+def dsdb(devices8):
+    d = greengage_tpu.connect(numsegments=8)
+    load_tpcds_mini(d, n_fact=5_000)
+    return d
+
+
+def _find(plan, pred):
+    stack = [plan]
+    while stack:
+        p = stack.pop()
+        if pred(p):
+            return p
+        stack.extend(p.children)
+    return None
+
+
+# ---------------------------------------------------------------------
+# plan corpus: every TPC-H / TPC-DS shape validates clean (I1-I7)
+# ---------------------------------------------------------------------
+
+def test_tpch_corpus_validates(db):
+    failures = validate_corpus(db, TPCH_QUERIES)
+    assert failures == [], failures
+
+
+def test_tpcds_corpus_validates(dsdb):
+    failures = validate_corpus(dsdb, TPCDS_QUERIES)
+    assert failures == [], failures
+
+
+# ---------------------------------------------------------------------
+# mutated plans are rejected with typed errors naming the node path
+# ---------------------------------------------------------------------
+
+def test_dropped_motion_rejected(db):
+    """Splice the state Redistribute out from under Q1's final
+    aggregate: partial states stay Strewn, the final merge would
+    double-count across segments — plancheck must refuse (I5)."""
+    planned, _, _ = db._plan(parse(TPCH_QUERIES["q1_pricing_summary"])[0])
+    final = _find(planned, lambda p: isinstance(p, Aggregate)
+                  and p.phase == "final")
+    moved = final.child
+    assert isinstance(moved, Motion) \
+        and moved.kind is MotionKind.REDISTRIBUTE
+    final.child = moved.child          # the dropped Motion
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I5"
+    assert "Aggregate(final)" in ei.value.path
+
+
+def test_wrong_dist_key_rejected(db):
+    """Re-label a moved join side as hashed on the WRONG key: the join's
+    locality claim no longer holds (I4)."""
+    planned, _, _ = db._plan(parse(TPCH_QUERIES["q3_shipping_priority"])[0])
+
+    def both_hashed(p):
+        return (isinstance(p, Join) and p.left.locus is not None
+                and p.right.locus is not None
+                and p.left.locus.kind is LocusKind.HASHED
+                and p.right.locus.kind is LocusKind.HASHED)
+
+    join = _find(planned, both_hashed)
+    assert join is not None, "expected a co-located hashed join in Q3"
+    other = [c.id for c in join.right.out_cols()
+             if c.id not in join.right.locus.keys]
+    join.right.locus = Locus.hashed((other[0],),
+                                    join.right.locus.numsegments)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I4"
+
+
+def test_interior_gather_rejected(db):
+    planned, _, _ = db._plan(parse(TPCH_QUERIES["q1_pricing_summary"])[0])
+    final = _find(planned, lambda p: isinstance(p, Aggregate)
+                  and p.phase == "final")
+    funnel = Motion(MotionKind.GATHER, final.child)
+    funnel.locus = Locus.entry()
+    funnel.est_rows = final.child.est_rows
+    final.child = funnel
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I3"
+
+
+def test_bad_prune_predicate_rejected(db):
+    planned, _, _ = db._plan(
+        parse("select count(*) from orders where o_orderkey > 7")[0])
+    scan = _find(planned, lambda p: getattr(p, "prune_preds", ()))
+    assert scan is not None
+    scan.prune_preds = (("no_such_column", ">", 7),)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I6"
+
+
+def test_capacity_bucketing_enforced(db):
+    """I7 negative: a compiler whose scan bucketing is broken (returns a
+    non-pow2 capacity) must be refused."""
+    from greengage_tpu.exec.compile import Compiler
+
+    planned, consts, _ = db._plan(
+        parse("select count(*) from lineitem")[0])
+    comp = Compiler(db.catalog, db.store, db.mesh, db.numsegments,
+                    consts, db.settings)
+    validate_capacities(comp, planned)   # the honest compiler passes
+    comp2 = Compiler(db.catalog, db.store, db.mesh, db.numsegments,
+                     consts, db.settings)
+    comp2._bucket_cap = lambda table, cap: max(cap, 1) * 3   # de-bucketed
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_capacities(comp2, planned)
+    assert ei.value.invariant == "I7"
+
+
+# ---------------------------------------------------------------------
+# the plan_validate GUC hook
+# ---------------------------------------------------------------------
+
+def test_plan_validate_guc_hook(db, monkeypatch):
+    import greengage_tpu.exec.session as S
+
+    calls = []
+    orig = S.validate_plan
+    monkeypatch.setattr(
+        S, "validate_plan",
+        lambda p, cat=None: (calls.append(1), orig(p, cat))[1])
+    db.sql("select count(*) + 17 from region")   # unique: forces a plan
+    assert calls, "plan_validate on: _plan must run the validator"
+    calls.clear()
+    db.sql("set plan_validate = off")
+    try:
+        db.sql("select count(*) + 18 from region")
+        assert not calls, "plan_validate off: validator must not run"
+    finally:
+        db.sql("set plan_validate = on")
+
+
+# ---------------------------------------------------------------------
+# static analyzers against known-bad fixtures
+# ---------------------------------------------------------------------
+
+def _sources(tmp_path, files: dict):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return astutil.SourceSet(roots=[str(tmp_path)])
+
+
+def test_lock_cycle_detected(tmp_path):
+    from greengage_tpu.analysis import lint_locks
+
+    src = _sources(tmp_path, {"lockmod.py": (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def f():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with b:\n"
+        "        with a:\n"
+        "            pass\n")})
+    rep = lint_locks.run(src)
+    assert len(rep.findings) == 1
+    assert "lock-order cycle" in rep.findings[0].message
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    from greengage_tpu.analysis import lint_locks
+
+    src = _sources(tmp_path, {"lockmod.py": (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def f():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n")})
+    assert lint_locks.run(src).findings == []
+
+
+def test_lock_cycle_through_call_detected(tmp_path):
+    """One interprocedural hop: f holds A and calls helper() which takes
+    B; g nests them the other way round."""
+    from greengage_tpu.analysis import lint_locks
+
+    src = _sources(tmp_path, {"lockmod.py": (
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def helper_take_b():\n"
+        "    with b:\n"
+        "        pass\n"
+        "def f():\n"
+        "    with a:\n"
+        "        helper_take_b()\n"
+        "def g():\n"
+        "    with b:\n"
+        "        with a:\n"
+        "            pass\n")})
+    rep = lint_locks.run(src)
+    assert len(rep.findings) == 1
+
+
+def test_unpolled_wait_loop_detected(tmp_path):
+    from greengage_tpu.analysis import lint_interrupts
+
+    bad = ("import time\n"
+           "def waiter(ready):\n"
+           "    while not ready():\n"
+           "        time.sleep(0.1)\n")
+    good = ("import time\n"
+            "from greengage_tpu.runtime.interrupt import check_interrupts\n"
+            "def waiter(ready):\n"
+            "    while not ready():\n"
+            "        check_interrupts()\n"
+            "        time.sleep(0.1)\n")
+    rep = lint_interrupts.run(_sources(tmp_path / "bad", {"w.py": bad}))
+    assert [f.key for f in rep.findings] == ["waiter:sleep-loop"]
+    rep = lint_interrupts.run(_sources(tmp_path / "good", {"w.py": good}))
+    assert rep.findings == []
+
+
+def test_unpolled_condition_wait_detected(tmp_path):
+    from greengage_tpu.analysis import lint_interrupts
+
+    src = _sources(tmp_path, {"w.py": (
+        "def admit(cond, full):\n"
+        "    with cond:\n"
+        "        while full():\n"
+        "            cond.wait()\n")})
+    rep = lint_interrupts.run(src)
+    assert [f.key for f in rep.findings] == ["admit:condition-wait"]
+
+
+def test_tracer_sync_violation_detected(tmp_path):
+    from greengage_tpu.analysis import lint_tracer
+
+    src = _sources(tmp_path, {"ops/kern.py": (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def bad(vals):\n"
+        "    ident = jnp.array(0, vals.dtype)\n"     # the PR-5 bug class
+        "    return ident.item()\n"
+        "def good(vals):\n"
+        "    ident = np.array(0, vals.dtype)\n"      # host-concrete: the fix
+        "    return ident.item()\n"
+        "def also_bad(vals):\n"
+        "    s = jnp.sum(vals)\n"
+        "    return float(s)\n")})
+    rep = lint_tracer.run(src)
+    keys = sorted(f.key for f in rep.findings)
+    assert len(keys) == 2
+    assert any("bad" in k and ".item()" in k for k in keys)
+    assert any("also_bad" in k and "float()" in k for k in keys)
+
+
+def test_lockdebug_runtime_inversion():
+    import threading
+
+    from greengage_tpu.runtime import lockdebug
+
+    prior = lockdebug.enabled()   # conftest enables suite-wide: restore,
+    lockdebug.enable(True)        # never hard-disable for later tests
+    try:
+        a = lockdebug.named(threading.Lock(), "A")
+        b = lockdebug.named(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockdebug.LockOrderError):
+            with b:
+                with a:
+                    pass
+    finally:
+        lockdebug.enable(prior)
+        lockdebug.reset()   # drop this test's A->B edge from the table
+
+
+# ---------------------------------------------------------------------
+# the merge gate: the shipped tree is clean, and the CLI surfaces it
+# ---------------------------------------------------------------------
+
+def test_gg_check_shipped_tree_clean():
+    from greengage_tpu.analysis.runner import run_checks
+
+    rep = run_checks()
+    assert rep.findings == [], rep.to_text()
+
+
+def test_gg_check_cli_json():
+    import io
+    from contextlib import redirect_stdout
+
+    from greengage_tpu.mgmt import cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["check", "--json"])
+    assert rc == 0
+    payload = json.loads(buf.getvalue())
+    assert payload["clean"] is True and payload["findings"] == []
+
+
+def test_baseline_suppression(tmp_path):
+    from greengage_tpu.analysis.report import Report, load_baseline
+
+    rep = Report()
+    rep.add("locks", "x.py", 3, "cycle:a>b", "boom")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("# comment\nlocks x.py::cycle:a>b\n")
+    out = rep.suppressed(load_baseline(str(bl)))
+    assert out.findings == []
+    out2 = rep.suppressed(load_baseline(str(tmp_path / "missing.txt")))
+    assert len(out2.findings) == 1

@@ -30,13 +30,14 @@ def db(tmp_path, devices8):
 # ---------------------------------------------------------------------------
 
 def test_fault_types():
-    faults.inject("p1", "error", occurrences=1)
+    # throwaway names: this IS the injector unit test
+    faults.inject("p1", "error", occurrences=1)   # gg:ok(registry)
     with pytest.raises(FaultError):
         faults.check("p1")
     assert not faults.check("p1")  # occurrence consumed
-    faults.inject("p2", "skip", occurrences=2)
+    faults.inject("p2", "skip", occurrences=2)   # gg:ok(registry)
     assert faults.check("p2") and faults.check("p2") and not faults.check("p2")
-    faults.inject("p3", "error", segment=1)
+    faults.inject("p3", "error", segment=1)   # gg:ok(registry)
     assert not faults.check("p3", segment=0)
     with pytest.raises(FaultError):
         faults.check("p3", segment=1)
