@@ -989,6 +989,21 @@ def run_child():
                 "rows_out": len(r),
                 "trace": trace_path,
             }
+            # memory profile (VERDICT r5 standing order rider): the
+            # device allocator's live/peak bytes after this query plus
+            # the executable's measured memory_analysis, so the first
+            # unwedged TPU run also yields a memory profile. memory_stats
+            # is None on CPU — recorded as null, never a crash.
+            try:
+                dstats = dev.memory_stats() or {}
+            except Exception:
+                dstats = {}
+            detail[qname]["peak_bytes_in_use"] = dstats.get(
+                "peak_bytes_in_use")
+            detail[qname]["bytes_in_use"] = dstats.get("bytes_in_use")
+            mem = (r.stats or {}).get("mem") or {}
+            if mem.get("measured"):
+                detail[qname]["executable_mem"] = mem["measured"]
             if qname == "q1":
                 assert len(r) == 6, f"Q1 expected 6 groups, got {len(r)}"
                 gbs = n_rows * q1_bytes_per_row / best / 1e9

@@ -53,6 +53,18 @@ class Settings:
     # boundary). 0 disables cross-statement enforcement.
     vmem_global_limit_mb: int = 0
     runaway_red_zone: float = 0.9
+    # measured memory accounting (runtime/memaccount.py, the
+    # vmem_tracker/memaccounting.c analog): attach XLA memory_analysis to
+    # every cached executable, keep the per-statement owner tree, sample
+    # device watermarks at span boundaries, and let admission + the
+    # runaway cleaner prefer MEASURED executable bytes over the planner
+    # estimate once the executable is warm (only when the backend reports
+    # real temps — CPU reports none, so estimates keep governing there)
+    mem_accounting_enabled: bool = True
+    # on a device RESOURCE_EXHAUSTED the statement demotes to the spill
+    # path once (the workfile fallback) before surfacing the typed
+    # OutOfDeviceMemory; off = fail fast with the forensics dump only
+    oom_spill_retry: bool = True
     # synchronous mirror replication after each committed write (the
     # synchronous_standby_names / syncrep gate analog); off = mirrors go
     # stale and are barred from promotion until `gg replicate`

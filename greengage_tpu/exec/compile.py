@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 import hashlib
+import threading
 
 import numpy as np
 
@@ -103,6 +104,22 @@ class CompileResult:
     # hoisted-literal parameter slots, in slot order: the executor appends
     # one replicated (1,)-array per slot after the staged table inputs
     param_dtypes: tuple = ()
+    # per-node slice of est_bytes (id(plan node) -> bytes, the same
+    # identity node_rows uses) for the EXPLAIN ANALYZE per-node Memory
+    # annotation
+    node_est_bytes: dict = field(default_factory=dict)
+    # measured memory accounting (runtime/memaccount.py), filled by the
+    # executor at FIRST dispatch and reused on every warm program-cache
+    # hit: the AOT-compiled executable (dispatch goes through it so the
+    # program compiles exactly once), its memory_analysis dict, and a
+    # don't-retry latch for backends where lower/compile/analyze fails.
+    # mem_lock serializes the first analysis — two server threads cold-
+    # dispatching the same cached program must not both pay the compile
+    # (or double-count mem_analysis_runs)
+    aot_fn: object = None
+    mem_analysis: dict | None = None
+    mem_failed: bool = False
+    mem_lock: object = field(default_factory=threading.Lock)
 
 
 class Compiler:
@@ -374,6 +391,7 @@ class Compiler:
             metric_names=metric_names,
             flag_caps=dict(self.flag_caps),
             est_bytes=self._estimate_bytes(below),
+            node_est_bytes=dict(self.node_est_bytes),
             node_rows=dict(self.node_rows),
             flag_packs=dict(self.flag_packs),
             uses_fused=self.uses_fused,
@@ -500,8 +518,11 @@ class Compiler:
     def _estimate_bytes(self, plan: Plan) -> int:
         """Rough per-segment device allocation for the whole program
         (vmem_tracker admission analog): every node's batch capacity times
-        its column widths, summed over the tree."""
+        its column widths, summed over the tree. Records the per-node
+        slices in ``node_est_bytes`` (same id(node) identity as node_rows)
+        so EXPLAIN ANALYZE can print a per-node Memory annotation."""
         total = 0
+        self.node_est_bytes: dict[int, int] = {}
         stack = [plan]
         while stack:
             p = stack.pop()
@@ -510,18 +531,20 @@ class Compiler:
             except NotImplementedError:
                 cap = 0
             width = sum(max(c.type.np_dtype.itemsize, 1) + 1 for c in p.out_cols())
-            total += cap * width
+            node_bytes = cap * width
             if isinstance(p, Join):
                 if getattr(p, "direct_domain", None) is not None \
                         and self.tier == 0 and not self.no_direct:
                     # dense build table: slot_row/counts int32 + int64 temps
-                    total += int(p.direct_domain) * 16
+                    node_bytes += int(p.direct_domain) * 16
                 else:
                     try:
-                        total += self._join_table_size(
+                        node_bytes += self._join_table_size(
                             self._capacity_of(p.right)) * 16
                     except NotImplementedError:
                         pass
+            self.node_est_bytes[id(p)] = node_bytes
+            total += node_bytes
             stack.extend(p.children)
         return total
 

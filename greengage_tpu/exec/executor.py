@@ -31,9 +31,11 @@ from greengage_tpu.exec.compile import (VALID_PREFIX, Compiler, CompileResult,
 from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime import memaccount
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.faultinject import faults
-from greengage_tpu.runtime.logger import counters, histograms
+from greengage_tpu.runtime.logger import (DEFAULT_BUCKETS_MB, counters,
+                                          histograms)
 from greengage_tpu.runtime.runaway import TRACKER
 
 # per-statement I/O accounting reported in Result.stats["scan_io"] and the
@@ -51,6 +53,22 @@ class AdmissionError(QueryError):
     """Raised ONLY for the vmem admission rejection (est_bytes > limit) —
     the signal the spill machinery keys its escalation on."""
     pass
+
+
+class OutOfDeviceMemory(QueryError):
+    """The device allocator refused the program (XLA RESOURCE_EXHAUSTED)
+    after admission let it through — the typed OOM the reference's
+    memaccounting.c dumps an owner tree for. Carries the forensics the
+    session writes to ``mem-<statement id>.json``: the per-statement
+    accounting snapshot, the offending executable's memory analysis (when
+    XLA reported one), and the admission-time estimate."""
+
+    def __init__(self, message: str, snapshot: dict | None = None,
+                 mem_analysis: dict | None = None, est_bytes: int = 0):
+        super().__init__(message)
+        self.snapshot = snapshot or {}
+        self.mem_analysis = mem_analysis
+        self.est_bytes = int(est_bytes)
 
 
 def effective_limit_bytes(settings) -> int:
@@ -341,11 +359,17 @@ class Executor:
                         old_k, _old = self._plan_cache.popitem(last=False)
                         self._on_program_evicted(old_k)
             limit = effective_limit_bytes(self.settings)
-            if limit and comp.est_bytes > limit:
+            # admission charge: the MEASURED per-segment executable
+            # footprint when the executable is warm and the backend
+            # reports real temps, else the compile-time estimate
+            # (_admission_bytes) — four PRs of capacity bucketing finally
+            # admit against ground truth on silicon
+            admit_bytes, admit_measured = self._admission_bytes(comp)
+            if limit and admit_bytes > limit:
                 if deferred:
                     raise QueryError(
                         f"parallel retrieve cursor would hold ~"
-                        f"{comp.est_bytes >> 20} MB per segment, above the "
+                        f"{admit_bytes >> 20} MB per segment, above the "
                         f"{limit >> 20} MB memory ceiling; cursors pin the "
                         "whole result and cannot spill")
                 if allow_spill:
@@ -360,31 +384,19 @@ class Executor:
                     from greengage_tpu.exec import spill
 
                     try:
-                        res, npasses = spill.spill_run(
-                            self, plan, consts, out_cols, raw,
-                            instrument=instrument)
+                        return self._spill_fallback(plan, consts, out_cols,
+                                                    raw, instrument)
                     except spill.NotSpillable:
-                        try:
-                            # external-merge sort spill (tuplesort role):
-                            # ORDER BY results merge on the host from
-                            # per-pass device-sorted runs
-                            res, npasses = spill.spill_sort_run(
-                                self, plan, consts, out_cols, raw,
-                                instrument=instrument)
-                        except spill.NotSpillable:
-                            raise QueryError(
-                                f"query would allocate ~"
-                                f"{comp.est_bytes >> 20} MB "
-                                f"per segment, above vmem_protect_limit_mb="
-                                f"{self.settings.vmem_protect_limit_mb}, and "
-                                "its shape is not spillable (no "
-                                "partial-aggregate cut or sort over a "
-                                "single-scan probe table)")
-                    res.stats = dict(res.stats or {})
-                    res.stats["spill_passes"] = npasses
-                    return res
+                        raise QueryError(
+                            f"query would allocate ~"
+                            f"{admit_bytes >> 20} MB "
+                            f"per segment, above vmem_protect_limit_mb="
+                            f"{self.settings.vmem_protect_limit_mb}, and "
+                            "its shape is not spillable (no "
+                            "partial-aggregate cut or sort over a "
+                            "single-scan probe table)")
                 raise AdmissionError(
-                    f"query would allocate ~{comp.est_bytes >> 20} MB per "
+                    f"query would allocate ~{admit_bytes >> 20} MB per "
                     f"segment, above the {limit >> 20} MB memory ceiling "
                     "(vmem protection / resource queue; raise the limit or "
                     "reduce the data)")
@@ -398,11 +410,16 @@ class Executor:
             # plan-hash invariant, parallel/multihost.py); the reference's
             # cleaner is likewise per-host vmem, not cluster-coordinated
             if self.multihost is None:
+                # the cleaner prices victims by the same measured-when-warm
+                # bytes admission charges — an over-estimated statement no
+                # longer draws the red-zone cancellation for HBM it never
+                # holds
                 TRACKER.reprice(
-                    comp.est_bytes,
+                    admit_bytes,
                     int(getattr(self.settings,
                                 "vmem_global_limit_mb", 0)) << 20,
-                    float(getattr(self.settings, "runaway_red_zone", 0.9)))
+                    float(getattr(self.settings, "runaway_red_zone", 0.9)),
+                    measured=admit_measured)
                 TRACKER.check()
             # host-data-path breakdown (EXPLAIN ANALYZE + bench microbench):
             # staging wall vs device compute vs result fetch, plus the scan
@@ -425,10 +442,26 @@ class Executor:
             # semantic — XLA programs cannot be preempted mid-flight)
             faults.check("cancel_before_dispatch")
             interrupt.check_interrupts()
+            # measured memory accounting: AOT-compile once, attach XLA's
+            # memory_analysis to the cached executable (warm hits reuse
+            # it — zero re-analysis), and record the device owner on the
+            # statement's account before the allocator commits to it
+            self._ensure_mem_analysis(comp, inputs)
+            _acct = memaccount.ACCOUNTS.current()
+            if _acct is not None:
+                _acct.set_device(comp.mem_analysis, comp.est_bytes)
             try:
                 with _trace.span("dispatch", cat="device", tier=tier,
                                  est_bytes=comp.est_bytes):
-                    flat = comp.device_fn(*inputs)
+                    if faults.check("device_oom"):
+                        # faked allocator failure ('skip' type): the OOM
+                        # classification/demotion path without needing a
+                        # real 16 GB exhaustion in CI
+                        raise RuntimeError(
+                            "RESOURCE_EXHAUSTED: Out of memory while "
+                            f"trying to allocate {comp.est_bytes} bytes "
+                            "(fault injected: device_oom)")
+                    flat = (comp.aot_fn or comp.device_fn)(*inputs)
                     # resolve async dispatch here so compute_ms is the
                     # device program (and a deferred pallas failure still
                     # lands in the retry logic below, not in device_get)
@@ -444,6 +477,13 @@ class Executor:
                 if fused_disabled or not comp.uses_fused \
                         or not self.settings.fused_dense_agg \
                         or not _is_pallas_error(e):
+                    if memaccount.is_oom_error(e):
+                        # OOM forensics + demotion (memaccounting.c's
+                        # RESOURCE_EXHAUSTED dump): never a bare XLA
+                        # traceback for an allocator refusal
+                        return self._handle_oom(
+                            e, comp, plan, consts, out_cols, raw,
+                            instrument, allow_spill, deferred, tier)
                     raise
                 fused_disabled = True
                 self.last_fused_error = f"{type(e).__name__}: {e}"
@@ -558,7 +598,15 @@ class Executor:
                     "node_rows": {comp.node_rows[k]: int(np.sum(v))
                                   for k, v in metrics.items()
                                   if k in comp.node_rows},
+                    # measured memory accounting (docs/OBSERVABILITY.md):
+                    # what admission charged, what XLA measured for the
+                    # executable, and the statement's owner totals so far
+                    "mem": self._mem_stats(comp, admit_bytes,
+                                           admit_measured),
                 }
+                if instrument:
+                    # per-node Memory annotation source (EXPLAIN ANALYZE)
+                    res.stats["node_est_bytes"] = dict(comp.node_est_bytes)
                 # latency histograms (the gpperfmon timing surface):
                 # per-phase host-data-path distributions, exposed as
                 # Prometheus histograms via `gg metrics`
@@ -617,6 +665,180 @@ class Executor:
                         row_ranges=row_ranges, aux_tables=aux_tables,
                         allow_spill=False, no_direct=no_direct,
                         instrument=instrument)
+
+    # ---- measured memory accounting (runtime/memaccount.py) ----------
+    def _ensure_mem_analysis(self, comp: CompileResult, inputs) -> None:
+        """First dispatch of a program: AOT-compile it (lower().compile())
+        and attach XLA's memory_analysis — temp/argument/output/generated-
+        code bytes — to the cached CompileResult. Dispatch then goes
+        through the AOT executable, so the program still compiles exactly
+        once (the AOT call path measures no slower than the jit wrapper),
+        and every warm program-cache hit reuses both the executable and
+        the analysis: ``mem_analysis_runs`` counts analyses, and tests
+        assert a warm hit adds zero."""
+        if comp.mem_failed or comp.aot_fn is not None \
+                or not bool(getattr(self.settings,
+                                    "mem_accounting_enabled", True)):
+            return
+        if self.multihost is not None:
+            # multihost keeps the plain jit path: an AOT executable pins
+            # the compile-time device assignment, and the PR-6 topology
+            # re-formation contract depends on pjit re-binding cached
+            # executables to the CURRENT mesh at call site; per-process
+            # analysis state would also leak into admission and desync
+            # the lockstep branch decisions (see _admission_bytes)
+            return
+        # serialize the first analysis per program: two server threads
+        # cold-dispatching the same cached CompileResult must not both
+        # pay the XLA compile; the loser of the race waits and reuses
+        with comp.mem_lock:
+            if comp.mem_failed or comp.aot_fn is not None:
+                return
+            try:
+                comp.aot_fn = comp.device_fn.lower(*inputs).compile()
+            except Exception:
+                # a shape/backend the AOT path can't lower (incl. pallas
+                # compile failures): latch off and fall back to the jit
+                # path, which re-raises real errors into the dispatch
+                # retry logic
+                comp.mem_failed = True
+                return
+            try:
+                ma = comp.aot_fn.memory_analysis()
+                comp.mem_analysis = {
+                    "argument_bytes": int(
+                        getattr(ma, "argument_size_in_bytes", 0)),
+                    "output_bytes": int(
+                        getattr(ma, "output_size_in_bytes", 0)),
+                    "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+                    "generated_code_bytes": int(
+                        getattr(ma, "generated_code_size_in_bytes", 0)),
+                    "alias_bytes": int(
+                        getattr(ma, "alias_size_in_bytes", 0)),
+                }
+            except Exception:
+                comp.mem_failed = True   # executable stays dispatchable
+                return
+            counters.inc("mem_analysis_runs")
+            total = (comp.mem_analysis["argument_bytes"]
+                     + comp.mem_analysis["output_bytes"]
+                     + comp.mem_analysis["temp_bytes"])
+            histograms.observe("executable_mem_mb", total / 1e6,
+                               buckets=DEFAULT_BUCKETS_MB)
+            # estimate-vs-measured calibration gauge: the analysis is
+            # per DEVICE (one SPMD module), so compare against the
+            # estimate for the segments that device hosts
+            est_dev = comp.est_bytes * self._segments_per_device()
+            if est_dev > 0:
+                counters.set("mem_est_error_pct", int(round(
+                    100.0 * (total - est_dev) / est_dev)))
+
+    def _admission_bytes(self, comp: CompileResult) -> tuple[int, bool]:
+        """Bytes the admission check and runaway ledger charge for this
+        program -> (bytes, measured?). Prefers the measured per-segment
+        executable footprint once the executable is warm AND the backend
+        has a real device allocator (memory_stats() reports one — TPU/
+        GPU). The CPU backend's memory_analysis covers host buffers that
+        no HBM limit governs, so estimates keep governing there — and the
+        vmem GUC semantics the spill tests pin stay estimate-driven."""
+        ma = comp.mem_analysis
+        # multihost NEVER prefers measured bytes: comp.mem_analysis is
+        # per-process state (one worker's transient AOT failure would
+        # flip only ITS admission/spill branch and desync the lockstep
+        # collectives) — the spill decision must stay a pure function of
+        # est_bytes + settings, the PR-3 determinism contract
+        if ma and self.multihost is None \
+                and bool(getattr(self.settings,
+                                 "mem_accounting_enabled", True)) \
+                and ma.get("temp_bytes", 0) > 0 \
+                and memaccount.device_memory_stats() is not None:
+            # memory_analysis describes the per-DEVICE SPMD module (one
+            # device's shard of every buffer): scale to per-segment by
+            # the segments each device hosts, not by nseg — on a 1-chip
+            # backend all nseg segments share the device
+            measured = (ma["temp_bytes"] + ma.get("argument_bytes", 0)
+                        + ma.get("output_bytes", 0)) \
+                // self._segments_per_device()
+            if measured > 0:
+                return measured, True
+        return comp.est_bytes, False
+
+    def _segments_per_device(self) -> int:
+        ndev = max(int(getattr(getattr(self.mesh, "devices", None),
+                               "size", 1) or 1), 1)
+        return max(self.nseg // ndev, 1)
+
+    def _mem_stats(self, comp: CompileResult, admit_bytes: int,
+                   admit_measured: bool) -> dict:
+        """The Result.stats['mem'] block: estimate vs measurement vs live
+        device watermark (EXPLAIN ANALYZE's Memory lines + bench)."""
+        out = {
+            "est_bytes": int(comp.est_bytes),
+            "admitted_bytes": int(admit_bytes),
+            "admitted_by": "measured" if admit_measured else "estimate",
+            "measured": (dict(comp.mem_analysis)
+                         if comp.mem_analysis else None),
+        }
+        dstats = memaccount.device_memory_stats()
+        if dstats is not None:
+            out["device_bytes_in_use"] = int(dstats.get("bytes_in_use", 0))
+            out["device_peak_bytes_in_use"] = int(
+                dstats.get("peak_bytes_in_use", 0))
+        acct = memaccount.ACCOUNTS.current()
+        if acct is not None:
+            out["owners"] = acct.owner_totals()
+        return out
+
+    def _spill_fallback(self, plan, consts, out_cols, raw, instrument):
+        """Host-offload spill paths, shared by the admission rejection
+        and the OOM demotion: partial-aggregate passes first, then the
+        external-merge sort. Raises spill.NotSpillable through when
+        neither shape applies."""
+        from greengage_tpu.exec import spill
+
+        try:
+            res, npasses = spill.spill_run(
+                self, plan, consts, out_cols, raw, instrument=instrument)
+        except spill.NotSpillable:
+            # external-merge sort spill (tuplesort role): ORDER BY
+            # results merge on the host from per-pass device-sorted runs
+            res, npasses = spill.spill_sort_run(
+                self, plan, consts, out_cols, raw, instrument=instrument)
+        res.stats = dict(res.stats or {})
+        res.stats["spill_passes"] = npasses
+        return res
+
+    def _handle_oom(self, e, comp, plan, consts, out_cols, raw, instrument,
+                    allow_spill, deferred, tier):
+        """A dispatched program hit RESOURCE_EXHAUSTED: build the typed
+        OutOfDeviceMemory (accounting snapshot + the executable's memory
+        analysis — the memaccounting.c OOM dump payload), then demote to
+        the spill path ONCE when allowed (oom_spill_retry) before
+        surfacing. Multihost never demotes: a one-sided runtime OOM is
+        not a deterministic input, and a lone process entering the spill
+        regime would desync the lockstep collectives."""
+        counters.inc("oom_events")
+        acct = memaccount.ACCOUNTS.current()
+        snap = acct.snapshot() if acct is not None else {}
+        snap["device_stats"] = memaccount.device_memory_stats()
+        oom = OutOfDeviceMemory(
+            f"out of device memory dispatching at tier {tier} "
+            f"(estimated ~{comp.est_bytes >> 20} MB/segment): {e}",
+            snapshot=snap, mem_analysis=comp.mem_analysis,
+            est_bytes=comp.est_bytes)
+        if allow_spill and not deferred and self.multihost is None \
+                and bool(getattr(self.settings, "oom_spill_retry", True)):
+            from greengage_tpu.exec import spill
+
+            try:
+                res = self._spill_fallback(plan, consts, out_cols, raw,
+                                           instrument)
+            except spill.NotSpillable:
+                raise oom from e
+            counters.inc("oom_spill_retries")
+            res.stats["oom_demoted"] = True
+            return res
+        raise oom from e
 
     # ------------------------------------------------------------------
     def _local_segments(self):
@@ -716,6 +938,10 @@ class Executor:
         # each unit checks the flag before its read, so a multi-second
         # cold stage cancels mid-flight instead of at the next boundary
         stmt_ctx = interrupt.REGISTRY.current()
+        # the statement's memory account travels the same way: pool
+        # threads bind to it for the unit's duration, so block-cache
+        # inserts inside the read attribute to the right owner tree
+        stmt_acct = memaccount.ACCOUNTS.current()
 
         # plan phase: resolve per-table staging decisions. Read units are
         # submitted through a bounded LOOKAHEAD window (the table being
@@ -801,7 +1027,7 @@ class Executor:
                     futs.append(rpool.submit(
                         self._read_unit, table, st["child_parts"], seg,
                         st["storage_cols"], snapshot, prune, st["rng"],
-                        dest, stmt_ctx))
+                        dest, stmt_ctx, stmt_acct))
             st["buffers"] = buffers
             st["futs"] = futs
 
@@ -816,8 +1042,13 @@ class Executor:
             with _trace.span("stage:" + table, cat="stage",
                              kind=kind) as _sp_t:
                 if kind == "aux":
-                    arrays.extend(
-                        self._stage_aux(table, cols, cap, aux[table], shard))
+                    staged_aux = self._stage_aux(table, cols, cap,
+                                                 aux[table], shard)
+                    memaccount.charge(
+                        "staging",
+                        sum(int(getattr(a, "nbytes", 64))
+                            for a in staged_aux), item=table)
+                    arrays.extend(staged_aux)
                     continue
                 if kind == "hit":
                     staged, pstats = payload
@@ -859,6 +1090,7 @@ class Executor:
                 staged_local[key] = (staged,
                                      self._last_prune_stats.get(table))
                 nbytes = sum(int(getattr(a, "nbytes", 64)) for a in staged)
+                memaccount.charge("staging", nbytes, item=table)
                 _trace.annotate(_sp_t, rows=int(sum(n for _, _, n in per_seg)),
                                 bytes=nbytes, segments=len(per_seg))
                 if st["rng"] is None:
@@ -870,20 +1102,23 @@ class Executor:
         return arrays
 
     def _read_unit(self, table, child_parts, seg, storage_cols, snapshot,
-                   prune, rng, dest=None, stmt_ctx=None):
+                   prune, rng, dest=None, stmt_ctx=None, stmt_acct=None):
         """One pooled staging unit: one segment's decoded columns (+ this
         thread's zone-prune stats). Runs concurrently with other units —
         the store's caches and read-path self-heal are thread-safe.
         ``dest`` carries this segment's staging-buffer slots for the
         in-place decode fast path. ``stmt_ctx`` is the owning statement's
         interrupt context: each unit is a cancellation point, and the
-        raise travels back to the statement thread via fut.result()."""
+        raise travels back to the statement thread via fut.result().
+        ``stmt_acct`` binds this pool thread to the statement's memory
+        account so block-cache inserts inside the read attribute right."""
         faults.check("cancel_in_staging", segment=seg)
         if stmt_ctx is not None:
             stmt_ctx.check()
-        c, v, n = self._read_segment_parts(
-            table, child_parts, seg, storage_cols, snapshot, prune,
-            dest=dest)
+        with memaccount.ACCOUNTS.bind(stmt_acct):
+            c, v, n = self._read_segment_parts(
+                table, child_parts, seg, storage_cols, snapshot, prune,
+                dest=dest)
         if rng is not None:
             a, b = rng
             c = {k: arr[a:b] for k, arr in c.items()}

@@ -36,10 +36,12 @@ from greengage_tpu.analysis.plancheck import validate_plan
 from greengage_tpu.catalog import (Catalog, Column, DistPolicy, Partition,
                                    PolicyKind, TableSchema)
 from greengage_tpu.config import Settings
-from greengage_tpu.exec.executor import Executor, QueryError, Result
+from greengage_tpu.exec.executor import (Executor, OutOfDeviceMemory,
+                                         QueryError, Result)
 from greengage_tpu.parallel import make_mesh
 from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
+from greengage_tpu.runtime import memaccount as _memaccount
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.interrupt import (REGISTRY as _INTERRUPTS,
                                              StatementCancelled,
@@ -332,6 +334,13 @@ class Database:
             ctx.statement_id, text,
             enabled=bool(getattr(self.settings, "trace_enabled", True)),
             ring_size=int(getattr(self.settings, "trace_ring_size", 64)))
+        # per-statement memory account (runtime/memaccount.py, the
+        # memaccounting.c owner tree): staging/block-cache/spill/device
+        # charges land here; dumped on OOM, served by `gg mem`
+        acct, a_outer = _memaccount.ACCOUNTS.enter(
+            ctx.statement_id, text,
+            enabled=bool(getattr(self.settings,
+                                 "mem_accounting_enabled", True)))
         t0 = time.monotonic()
         root = (tr.begin("statement", cat="statement")
                 if tr is not None and t_outer else None)
@@ -355,6 +364,14 @@ class Database:
                                    f"{e} [cause={e.cause}] -- in: "
                                    f"{text.strip()[:200]}")
             raise
+        except OutOfDeviceMemory as e:
+            # OOM forensics (memaccounting.c's OOM owner-tree dump):
+            # mem-<id>.json beside the slow-log traces, carrying the full
+            # per-owner accounting snapshot + the offending executable's
+            # memory analysis
+            if a_outer:
+                self._dump_mem_forensics(e, ctx.statement_id, text)
+            raise
         finally:
             if root is not None:
                 tr.end(root)
@@ -362,6 +379,7 @@ class Database:
                 dur_ms = (time.monotonic() - t0) * 1e3
                 _histograms.observe("statement_ms", dur_ms)
                 self._maybe_log_slow(text, dur_ms, ctx.statement_id)
+            _memaccount.ACCOUNTS.exit(acct)
             _TRACES.exit(tr)
             _INTERRUPTS.exit(ctx)
 
@@ -407,6 +425,35 @@ class Database:
                                     f"trace-{statement_id}.json")
                 with open(path, "w") as f:
                     _json.dump(_trace.to_chrome(tr), f)
+        except Exception:
+            pass
+
+    def _dump_mem_forensics(self, e: OutOfDeviceMemory,
+                            statement_id: int, text: str) -> None:
+        """Write ``mem-<statement id>.json`` beside the slow-log traces
+        (<cluster>/log): the per-owner accounting tree, the offending
+        executable's memory_analysis, the admission estimate, and the
+        live device stats at failure. Never raises — forensics must not
+        replace the typed error the client is owed."""
+        try:
+            if not self.log.enabled:
+                return
+            payload = {
+                "statement_id": statement_id,
+                "sql": text.strip()[:500],
+                "error": str(e),
+                "est_bytes": e.est_bytes,
+                "memory_analysis": e.mem_analysis,
+                "accounting": e.snapshot,
+                "ts_unix_s": round(time.time(), 3),
+            }
+            os.makedirs(os.path.join(self.path, "log"), exist_ok=True)
+            path = os.path.join(self.path, "log",
+                                f"mem-{statement_id}.json")
+            with open(path, "w") as f:
+                _json.dump(payload, f, indent=1, default=str)
+            self.log.error("out_of_device_memory",
+                           f"{e} [mem dump={path}]")
         except Exception:
             pass
 
@@ -2073,6 +2120,9 @@ class Database:
                          f"{io.get('scan_cache_hit', 0)} hit / "
                          f"{io.get('scan_cache_miss', 0)} miss / "
                          f"{io.get('scan_cache_evict', 0)} evicted")
+            mline = self._memory_line(s.get("mem"))
+            if mline:
+                text += "\n " + mline
             if s.get("fused_kernel"):
                 text += "\n Fused dense-agg pallas kernel: yes"
             for t, (kept, total) in (s.get("zone_prune") or {}).items():
@@ -2092,6 +2142,34 @@ class Database:
         return r
 
     @staticmethod
+    def _memory_line(mem: dict | None) -> str | None:
+        """The statement-level EXPLAIN ANALYZE Memory line: the vmem
+        admission estimate alongside the MEASURED executable bytes (XLA
+        memory_analysis — args/temps/output) and, where the backend
+        reports one, the live device peak (docs/OBSERVABILITY.md
+        "Memory accounting")."""
+        if not mem:
+            return None
+        line = (f"Memory: vmem estimate "
+                f"{mem.get('est_bytes', 0) / 1e6:.1f} MB/segment")
+        meas = mem.get("measured")
+        if meas:
+            total = (meas.get("argument_bytes", 0)
+                     + meas.get("temp_bytes", 0)
+                     + meas.get("output_bytes", 0))
+            line += (f"; executable measured: "
+                     f"args {meas.get('argument_bytes', 0) / 1e6:.1f}"
+                     f" + temps {meas.get('temp_bytes', 0) / 1e6:.1f}"
+                     f" + out {meas.get('output_bytes', 0) / 1e6:.1f}"
+                     f" = {total / 1e6:.1f} MB")
+        if mem.get("admitted_by") == "measured":
+            line += " (admitted by measured bytes)"
+        if mem.get("device_peak_bytes_in_use") is not None:
+            line += (f"; device peak "
+                     f"{mem['device_peak_bytes_in_use'] / 1e6:.1f} MB")
+        return line
+
+    @staticmethod
     def _analyze_annotations(planned, s: dict) -> dict:
         """Per-plan-node EXPLAIN ANALYZE annotations: actual rows out,
         host-attributed device ms (the whole program is one fused XLA
@@ -2104,6 +2182,7 @@ class Database:
         node_rows = s.get("node_rows") or {}
         if not node_rows:
             return {}
+        node_mem = s.get("node_est_bytes") or {}
         id2node = {}
         stack = [planned]
         while stack:
@@ -2126,6 +2205,12 @@ class Database:
                 except Exception:
                     width = 8
                 parts.append(f"motion ~{n * width} B")
+            # per-node Memory: this node's slice of the compiled device
+            # estimate (capacity x widths; spill merges keep the last
+            # merge program's slices — pass clones don't re-map)
+            mb = node_mem.get(pid)
+            if mb:
+                parts.append(f"memory ~{mb >> 10} KB")
             annot[pid] = ", ".join(parts)
         return annot
 

@@ -32,6 +32,7 @@ import numpy as np
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.runtime import interrupt
+from greengage_tpu.runtime import memaccount
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.planner.locus import Locus
 from greengage_tpu.planner.logical import (Aggregate, ColInfo, Filter, Join,
@@ -172,6 +173,15 @@ def count_scans(plan: Plan, table: str) -> int:
 
 
 
+def _charge_spill(cols: dict, valids: dict, item: str) -> None:
+    """Account the host-resident captured rows (the workfile bytes) to
+    the statement's 'spill' owner (runtime/memaccount.py)."""
+    nb = sum(int(getattr(a, "nbytes", 0)) for a in cols.values())
+    nb += sum(int(getattr(a, "nbytes", 0)) for a in valids.values()
+              if a is not None)
+    memaccount.charge("spill", nb, item=item)
+
+
 def _collect_passes(cols_spec, results):
     """Concatenate per-pass Result columns on the host with shared
     validity defaulting: -> (cols, valids) where valids[c] is None when
@@ -310,6 +320,7 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
     finally:
         prefetcher.close()
     aux_cols, aux_valids = _collect_passes(partial_cols, pass_results)
+    _charge_spill(aux_cols, aux_valids, "partials")
 
     # merge program: the original plan with the replace target swapped for
     # a host input of the concatenated captured rows. Partial case: the
@@ -480,6 +491,7 @@ def _bucketed_dedupe_merge(executor, merged, dedupe, host_scan, aux_name,
             bucket_plan, consts, state_cols, raw=True,
             aux_tables={aux_name: (sub_cols, sub_valids)}, no_direct=True))
     s_cols, s_valids = _collect_passes(state_cols, bucket_results)
+    _charge_spill(s_cols, s_valids, "merge-buckets")
     aux2 = "@spill:partials2"
     host_scan = Scan(aux2, list(state_cols))
     host_scan.locus = outer_partial.locus
@@ -626,6 +638,7 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
         prefetcher.close()
 
     cols, valids = _collect_passes(out_cols, runs)
+    _charge_spill(cols, valids, "sorted-runs")
 
     # one stable ascending lexsort; keys minor->major, so reverse the SQL
     # key order and emit each key's (enc, null-class) pair in that order

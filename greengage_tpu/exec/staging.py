@@ -88,6 +88,19 @@ def pool(settings):
         return _pool
 
 
+def pool_queue_depth() -> int:
+    """Read units waiting for a staging-pool thread right now — the
+    `gg metrics` staging_pool_queue_depth gauge (a persistent backlog
+    here means scan_threads is undersized for the workload)."""
+    p = _pool
+    if p is None:
+        return 0
+    try:
+        return p._work_queue.qsize()
+    except (AttributeError, NotImplementedError):
+        return 0
+
+
 def fill_buffer(nseg: int, cap: int, dtype, parts, fill=0) -> np.ndarray:
     """One staging buffer for one column: ``parts`` yields (seg, array)
     with len(array) <= cap; every other position holds ``fill``. When a

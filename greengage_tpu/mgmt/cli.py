@@ -914,6 +914,59 @@ def cmd_metrics(args):
     return 0
 
 
+def cmd_mem(args):
+    """Measured memory accounting surface (`gg mem`, the gp_toolkit vmem
+    views analog): live device allocator stats, per-statement owner
+    trees (in-flight + recent), the runaway ledger, block-cache budget
+    state, and each cached executable's measured footprint."""
+    from greengage_tpu.runtime.server import SqlClient
+
+    sock = _activity_socket(args)
+    if sock is None:
+        print("error: mem needs -s SOCKET or -d DIR with a running server",
+              file=sys.stderr)
+        return 1
+    c = SqlClient(sock)
+    try:
+        resp = c.op({"op": "mem"})
+    finally:
+        c.close()
+    if not resp.get("ok"):
+        print(f"error: {resp.get('error')}", file=sys.stderr)
+        return 1
+    mem = resp.get("mem") or {}
+    if getattr(args, "as_json", False):
+        print(json.dumps(mem, indent=1))
+        return 0
+    dev = mem.get("device")
+    if dev:
+        print(f"device: {dev.get('bytes_in_use', 0) / 1e6:.1f} MB in use, "
+              f"peak {dev.get('peak_bytes_in_use', 0) / 1e6:.1f} MB")
+    else:
+        print("device: no allocator stats (CPU backend)")
+    proc = mem.get("process") or {}
+    print(f"host: rss {proc.get('host_rss_bytes', 0) / 1e6:.1f} MB, "
+          f"{proc.get('host_open_fds', '?')} fds, staging queue depth "
+          f"{proc.get('staging_pool_queue_depth', 0)}")
+    bc = mem.get("block_cache") or {}
+    if bc:
+        print(f"block cache: {bc.get('total_bytes', 0) / 1e6:.1f} / "
+              f"{bc.get('limit_bytes', 0) / 1e6:.0f} MB")
+    for snap in (mem.get("in_flight") or []):
+        owners = ", ".join(
+            f"{o}={v['bytes'] / 1e6:.1f}MB"
+            for o, v in (snap.get("owners") or {}).items())
+        print(f"stmt {snap.get('statement_id')}: "
+              f"{snap.get('total_bytes', 0) / 1e6:.1f} MB in flight "
+              f"[{owners}] {snap.get('sql', '')[:60]}")
+    exes = mem.get("executables") or []
+    meas = [x for x in exes if x.get("measured")]
+    print(f"({len(mem.get('in_flight') or [])} in-flight statements, "
+          f"{len(exes)} cached executables, {len(meas)} measured)",
+          file=sys.stderr)
+    return 0
+
+
 def cmd_cancel(args):
     """pg_cancel_backend analog: flag one in-flight statement; it dies at
     its next cancellation point with cause 'user'."""
@@ -1295,6 +1348,13 @@ def main(argv=None):
     p.add_argument("-d", "--dir", default=None)
     p.add_argument("-s", "--socket", default=None)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("mem")      # measured memory accounting surface
+    p.add_argument("-d", "--dir", default=None)
+    p.add_argument("-s", "--socket", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw JSON report instead of the summary")
+    p.set_defaults(fn=cmd_mem)
 
     p = sub.add_parser("server")
     p.add_argument("-d", "--dir", required=True)

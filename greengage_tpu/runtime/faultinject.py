@@ -43,6 +43,10 @@ FAULT_POINTS = frozenset({
     "storage_corrupt_block", "repair_copy", "scrub_file", "delta_fold",
     # statement lifecycle (exec/executor.py)
     "cancel_before_dispatch", "cancel_in_staging",
+    # memory accounting (exec/executor.py): a 'skip' injection fakes a
+    # device RESOURCE_EXHAUSTED at dispatch — OOM classification and
+    # spill demotion without a real allocator exhaustion
+    "device_oom",
 })
 
 

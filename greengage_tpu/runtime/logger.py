@@ -30,7 +30,17 @@ SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL", "PANIC")
 # names that are levels, not monotone counts, regardless of how they were
 # first written — the Prometheus exposition must emit `# TYPE ... gauge`
 # for them even in a process that has only inc()'d so far
-GAUGE_NAMES = ("mh_topology_version",)
+GAUGE_NAMES = (
+    "mh_topology_version",
+    # measured memory accounting (runtime/memaccount.py): live device
+    # allocator watermarks (absent on CPU backends — no writer runs),
+    # the signed estimate-vs-measured executable error, per-owner live
+    # host bytes, and the host process gauges `gg metrics` refreshes
+    "device_bytes_in_use", "device_peak_bytes_in_use", "mem_est_error_pct",
+    "mem_owner_bytes_staging", "mem_owner_bytes_blockcache",
+    "mem_owner_bytes_spill", "mem_owner_bytes_device",
+    "host_rss_bytes", "host_open_fds", "staging_pool_queue_depth",
+)
 
 # Declared metric catalog — the source of truth `gg check`
 # (analysis/lint_registry.py) cross-checks against the package source:
@@ -59,11 +69,18 @@ COUNTER_NAMES = (
     # manifest commit path + topology (storage/manifest.py, exec/session.py)
     "manifest_delta_commits", "manifest_cas_retry_total",
     "manifest_cas_conflict_total", "manifest_folds", "mh_reform_total",
+    # measured memory accounting (exec/executor.py): executable analyses
+    # performed (a warm program-cache hit must add ZERO), classified
+    # device OOMs, and OOMs absorbed by the one-shot spill demotion
+    "mem_analysis_runs", "oom_events", "oom_spill_retries",
 )
 
 HISTOGRAM_NAMES = (
     "statement_ms", "queue_wait_ms", "compile_latency_ms",
     "stage_ms", "dispatch_ms", "fetch_ms",
+    # measured executable footprint (args+temps+output, MB buckets —
+    # observed with DEFAULT_BUCKETS_MB, not the ms defaults)
+    "executable_mem_mb",
 )
 
 
@@ -134,6 +151,11 @@ counters = Counters()   # shared registry (shmem stats analog)
 # aggregate bucket-by-bucket in Prometheus
 DEFAULT_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+# byte-sized histograms (executable memory footprints) bucket in MB:
+# fine enough for point-query programs, wide enough for a v5e's 16 GB
+DEFAULT_BUCKETS_MB = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0,
+                      16384.0)
 
 
 class Histograms:

@@ -40,10 +40,16 @@ class RunawayCancelled(StatementCancelled):
 
 
 class _Entry:
-    __slots__ = ("bytes", "cancel_reason", "depth", "flag_time", "ctx")
+    __slots__ = ("bytes", "cancel_reason", "depth", "flag_time", "ctx",
+                 "measured")
 
     def __init__(self, nbytes: int, ctx=None):
         self.bytes = nbytes
+        # True once the price came from the executable's XLA
+        # memory_analysis instead of the planner estimate (warm
+        # executables under mem_accounting_enabled) — the cleaner then
+        # arbitrates on ground truth, and `gg mem` shows which
+        self.measured = False
         self.cancel_reason: str | None = None
         self.depth = 1          # nested executor runs (spill passes)
         self.flag_time = 0.0
@@ -75,7 +81,7 @@ class VmemTracker:
                 self._active[tid] = _Entry(0, ctx)
 
     def reprice(self, est_bytes: int, global_limit_bytes: int,
-                red_zone: float) -> None:
+                red_zone: float, measured: bool = False) -> None:
         """Record this statement's current compiled estimate, then run the
         red-zone scan: when the cluster-wide total crosses the zone, flag
         the HEAVIEST in-flight statement for termination
@@ -91,6 +97,7 @@ class VmemTracker:
             # regime its footprint IS the per-pass estimate — the
             # rejected whole-plan estimate was never allocated
             cur.bytes = est_bytes
+            cur.measured = bool(measured)
             if not global_limit_bytes:
                 return
             total = sum(e.bytes for e in self._active.values())
@@ -160,6 +167,9 @@ class VmemTracker:
     def snapshot(self) -> list[dict]:
         with self._lock:
             return [{"thread": t, "bytes": e.bytes,
+                     "measured": e.measured,
+                     "statement_id": (e.ctx.statement_id
+                                      if e.ctx is not None else None),
                      "flagged": e.cancel_reason is not None}
                     for t, e in self._active.items()]
 

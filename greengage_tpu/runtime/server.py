@@ -13,6 +13,7 @@ executing one is blocked in its statement):
 
     -> {"op": "ps"}            <- {"ok": true, "rows": [activity...]}
     -> {"op": "cancel", "id": N}  <- {"ok": true/false}
+    -> {"op": "mem"}           <- {"ok": true, "mem": {device/accounts...}}
 
 Reference parity: exec_simple_query serving many clients
 (src/backend/tcop/postgres.c:1622). Each connection gets a thread; SELECTs
@@ -230,10 +231,22 @@ class SqlServer:
                             "cluster": _cluster_status(outer.db)}
                 if op == "metrics":
                     # Prometheus text exposition over the process-wide
-                    # counters/gauges/histograms (`gg metrics`)
+                    # counters/gauges/histograms (`gg metrics`); host
+                    # process gauges (RSS, fds, staging-pool depth,
+                    # per-owner live bytes) refresh at scrape time
+                    from greengage_tpu.runtime import memaccount
                     from greengage_tpu.runtime.logger import prometheus_text
 
+                    memaccount.update_process_gauges()
                     return {"ok": True, "text": prometheus_text()}
+                if op == "mem":
+                    # the measured-memory surface (`gg mem`): device
+                    # allocator stats, per-statement accounting trees,
+                    # the runaway ledger, block-cache budget state, and
+                    # per-executable measured footprints
+                    from greengage_tpu.runtime import memaccount
+
+                    return {"ok": True, "mem": memaccount.report(outer.db)}
                 if op == "trace":
                     from greengage_tpu.runtime.trace import TRACES, to_chrome
 

@@ -45,6 +45,18 @@ from contextlib import contextmanager
 MAX_SPANS = 4096
 MAX_GRAFT_SPANS = 1024
 
+# live device-memory sampler (runtime/memaccount.py installs it): called
+# at span boundaries so every span carries its HBM watermark + delta.
+# None until installed; the installed sampler returns None on backends
+# without allocator stats (CPU), which keeps spans clean there. A hook
+# (not an import) so this substrate stays dependency-free.
+MEM_SAMPLER = None
+
+
+def set_mem_sampler(fn) -> None:
+    global MEM_SAMPLER
+    MEM_SAMPLER = fn
+
 _JSON_SCALARS = (bool, int, float, str, type(None))
 
 
@@ -87,6 +99,10 @@ class Trace:
     def begin(self, name: str, cat: str = "exec", **args) -> int:
         ts = (time.monotonic() - self.t0) * 1e3
         tid = threading.get_ident()
+        if MEM_SAMPLER is not None:
+            hbm = MEM_SAMPLER()   # device watermark at span entry
+            if hbm is not None:
+                args["hbm_bytes"] = hbm
         with self._lock:
             if len(self._spans) >= MAX_SPANS:
                 return -1
@@ -111,10 +127,17 @@ class Trace:
         if sid is None or sid < 0:
             return
         now = (time.monotonic() - self.t0) * 1e3
+        hbm = MEM_SAMPLER() if MEM_SAMPLER is not None else None
         with self._lock:
             span = self._by_id.get(sid)
             if span is None:
                 return
+            if hbm is not None:
+                # device-memory delta across the span (`gg trace` shows
+                # which phase grew/shrank HBM — the data-movement lens)
+                span["args"]["hbm_end_bytes"] = hbm
+                if "hbm_bytes" in span["args"]:
+                    span["args"]["hbm_delta"] = hbm - span["args"]["hbm_bytes"]
             span["dur"] = round(now - span["ts"], 3)
             if args:
                 span["args"].update(_safe_args(args))

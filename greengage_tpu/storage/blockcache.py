@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from greengage_tpu.runtime import memaccount
 from greengage_tpu.runtime.logger import counters
 
 MISS = object()   # sentinel distinguishing "absent" from a cached None
@@ -118,6 +119,12 @@ class BlockCache:
             self.bytes += nb
             reg._total += nb
             reg._evict_to_fit()
+        # memory accounting (runtime/memaccount.py): attribute the bytes
+        # this statement INSERTED into the shared cache to its
+        # 'blockcache' owner — charged OUTSIDE the registry lock so the
+        # account lock never nests under it (lock-order hygiene). Pool
+        # threads reach here bound to the statement's account.
+        memaccount.charge("blockcache", nb, item=self.name)
 
     def pop(self, key, default=None):
         with self.registry._lock:

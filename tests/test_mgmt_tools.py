@@ -266,6 +266,33 @@ def test_ps_requires_running_server(tmp_path, capsys):
     assert "running server" in capsys.readouterr().err
 
 
+def test_gg_mem_smoke(clu, tmp_path, capsys):
+    """`gg mem` (the measured-memory surface, docs/OBSERVABILITY.md):
+    summary + --json against a live server."""
+    import json as _json
+
+    from greengage_tpu.runtime.server import SqlServer
+
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table memt (a int) distributed by (a)")
+    db.sql("insert into memt values " + ",".join(f"({i})" for i in range(64)))
+    db.sql("select count(*) from memt")
+    sock = str(tmp_path / "mem.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    try:
+        assert run_cli("mem", "-s", sock) == 0
+        out = capsys.readouterr().out
+        assert "host: rss" in out and "device:" in out
+        assert run_cli("mem", "-s", sock, "--json") == 0
+        payload = _json.loads(capsys.readouterr().out)
+        assert "process" in payload and "executables" in payload
+    finally:
+        srv.stop()
+    assert run_cli("mem", "-d", str(tmp_path / "nowhere")) == 1
+    assert "running server" in capsys.readouterr().err
+
+
 # ---------------------------------------------------------------------------
 # daemon lifecycle (subprocess: fork conflicts with pytest/jax state)
 # ---------------------------------------------------------------------------
