@@ -580,6 +580,125 @@ def microbench_plan_cache() -> None:
         shutil.rmtree(path, ignore_errors=True)
 
 
+def _batch_serving_measure(db, make_q, concs=(1, 4, 16),
+                           per_thread=16) -> dict:
+    """Statements/sec with batched serving on vs off at each concurrency
+    (shared by the microbench and the TPU bench's detail rider). Warms
+    every pow2 width bucket first so the measurement is steady-state
+    serving, not bucket compiles."""
+    import threading
+
+    from greengage_tpu.runtime.logger import counters
+    from greengage_tpu.sql.parser import parse
+
+    maxw = int(db.settings.batch_max_width)
+    db.sql("set batch_serving_enabled = off")
+    db.sql(make_q(0))   # warm plan cache + width-0 classic program
+    stmt = parse(make_q(0))[0]
+    planned, consts, outs, ek = db._cached_plan(stmt)
+    pv = consts["@params@"]
+    w = 1
+    while w <= maxw:
+        # the member values are irrelevant for warming — the bucket's
+        # program is value-generic; repeating one vector is type-exact
+        db.executor.run_batch(planned, consts, outs, ek, [pv] * w)
+        w *= 2
+
+    def run_conc(conc: int) -> float:
+        def worker(tid):
+            for j in range(per_thread):
+                db.sql(make_q(tid * per_thread + j))
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(conc)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return conc * per_thread / (time.monotonic() - t0)
+
+    out = {}
+    for conc in concs:
+        db.sql("set batch_serving_enabled = off")
+        off = run_conc(conc)
+        db.sql("set batch_serving_enabled = on")
+        c0 = counters.snapshot()
+        on = run_conc(conc)
+        d = counters.since(c0)
+        ndisp = max(d.get("batch_dispatch_total", 0), 1)
+        out[f"conc{conc}"] = {
+            "off_stmts_per_sec": round(off, 1),
+            "on_stmts_per_sec": round(on, 1),
+            "speedup": round(on / max(off, 1e-9), 2),
+            "avg_width": round(d.get("batch_members_total", 0) / ndisp, 1),
+            "dispatches": d.get("batch_dispatch_total", 0),
+            "fallbacks": d.get("batch_fallback_total", 0),
+        }
+    db.sql("set batch_serving_enabled = off")
+    return out
+
+
+def microbench_batch_serving() -> None:
+    """Vectorized-serving throughput (ISSUE 11, docs/PERF.md "Vectorized
+    serving"): point-query statements/sec at concurrency {1, 4, 16} with
+    batched serving on vs off. CPU-runnable by design — the win there is
+    amortized per-statement host overhead (the CPU backend executes vmap
+    members serially); on TPU the stacked members additionally share the
+    device. Prints the standard one-line JSON:
+
+        {"metric": "batch_serving_stmts_per_sec", "value": <conc-16 on>,
+         "unit": "stmts/s", "vs_baseline": <on/off at conc 16>, ...}
+
+    Env: GGTPU_MB_ROWS (default 8000), GGTPU_MB_SEGS (4),
+         GGTPU_MB_PER_THREAD (16 statements per thread)."""
+    os.environ.setdefault("GGTPU_BENCH_PLATFORM", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax  # noqa: F401  (platform pinning below)
+
+    _apply_platform_override()
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import greengage_tpu
+
+    rows = int(os.environ.get("GGTPU_MB_ROWS", "8000"))
+    nseg = int(os.environ.get("GGTPU_MB_SEGS", "4"))
+    per_thread = int(os.environ.get("GGTPU_MB_PER_THREAD", "16"))
+    path = tempfile.mkdtemp(prefix="ggtpu_batchserve_mb_")
+    try:
+        db = greengage_tpu.connect(path, numsegments=nseg)
+        db.sql("create table d (k int, a int, v double precision) "
+               "distributed by (k)")
+        rng = np.random.default_rng(7)
+        db.load_table("d", {
+            "k": np.arange(rows, dtype=np.int32),
+            "a": np.arange(rows, dtype=np.int32),
+            "v": rng.random(rows)})
+
+        def q(i: int) -> str:
+            return (f"select count(*), sum(v) from d "
+                    f"where a > {100 + i % 400}")
+
+        res = _batch_serving_measure(db, q, per_thread=per_thread)
+        c16 = res.get("conc16", {})
+        line = {
+            "metric": "batch_serving_stmts_per_sec",
+            "value": c16.get("on_stmts_per_sec", 0),
+            "unit": "stmts/s",
+            "vs_baseline": c16.get("speedup", 0),
+            "rows": rows, "segments": nseg,
+            **res,
+        }
+        print(json.dumps(line), flush=True)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+
+
 def microbench(name: str) -> None:
     fn = globals().get("microbench_" + name)
     if fn is None:
@@ -1033,6 +1152,27 @@ def run_child():
             detail[qname] = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps({qname: detail.get(qname)}), file=sys.stderr,
               flush=True)
+
+    # vectorized-serving rider (ISSUE 11): a small point-query table in
+    # the same cluster, measured at concurrency 4 with batching on vs
+    # off — so the first unwedged TPU run also captures the serving
+    # amortization on silicon, not just the CPU microbench number
+    try:
+        log("=== batch_serving rider ===")
+        db.executor._stage_cache.clear()
+        import numpy as _np
+        db.sql("create table bserve (k int, a int, v double precision) "
+               "distributed by (k)")
+        db.load_table("bserve", {
+            "k": _np.arange(50_000, dtype=_np.int32),
+            "a": _np.arange(50_000, dtype=_np.int32),
+            "v": _np.arange(50_000) * 0.5})
+        detail["batch_serving"] = _batch_serving_measure(
+            db, lambda i: ("select count(*), sum(v) from bserve "
+                           f"where a > {100 + i % 400}"),
+            concs=(4,), per_thread=8)
+    except Exception as e:
+        detail["batch_serving"] = {"error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(detail, indent=None), file=sys.stderr, flush=True)
     if "q1" not in QUERIES:

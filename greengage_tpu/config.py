@@ -135,6 +135,20 @@ class Settings:
     # executable)
     plan_cache_params: bool = True
     plan_cache_size: int = 256
+    # vectorized serving (exec/batchserve.py; docs/PERF.md "Vectorized
+    # serving"): concurrent SELECTs sharing one literal-stripped statement
+    # shape are collected during an admission window and executed as ONE
+    # XLA dispatch over their stacked parameter vectors. Off by default —
+    # a serving deployment opts in; the single-user path is unchanged.
+    # batch_window_ms bounds how long a statement may wait for batch-mates
+    # (the window only opens while the serving pipeline is busy — an idle
+    # pipeline dispatches immediately, so the window costs latency only
+    # when the device is the bottleneck anyway); batch_max_width flushes a
+    # window early when it fills, and bounds the stacked width (widths
+    # compile per pow2 bucket, so 1..max_width costs log2 compiles)
+    batch_serving_enabled: bool = False
+    batch_window_ms: float = 2.0
+    batch_max_width: int = 16
     # persistent XLA compilation cache directory, applied at Database init
     # (the warm-cache requirement in docs/PERF.md — a cold cache
     # recompiles every query shape once per process). Empty = leave the

@@ -120,6 +120,11 @@ class CompileResult:
     mem_analysis: dict | None = None
     mem_failed: bool = False
     mem_lock: object = field(default_factory=threading.Lock)
+    # vectorized serving (exec/batchserve.py): >0 means the program was
+    # compiled with a leading member axis — parameters arrive stacked
+    # (width, 1) per slot and every output/flag/metric carries a leading
+    # (width,) axis the executor demuxes per member. 0 = classic program.
+    batch_width: int = 0
 
 
 class Compiler:
@@ -129,7 +134,8 @@ class Compiler:
                  multihost: bool = False, scan_cap_override: dict | None = None,
                  aux_tables: dict | None = None,
                  pack_disabled: set | None = None,
-                 fused_disabled: bool = False, no_direct: bool = False):
+                 fused_disabled: bool = False, no_direct: bool = False,
+                 batch_width: int = 0):
         self.catalog = catalog
         self.store = store
         self.mesh = mesh
@@ -170,6 +176,14 @@ class Compiler:
         # host-staged ephemeral inputs ("@spill:" tables)
         self.scan_cap_override = scan_cap_override or {}
         self.aux_tables = aux_tables or {}
+        # vectorized serving (exec/batchserve.py): wrap the per-member
+        # program in a vmap over the stacked parameter inputs. Staged
+        # table inputs are closed over (broadcast — every member scans the
+        # same data); only parameters carry the member axis. Single-host,
+        # parameterized statements only.
+        self.batch_width = int(batch_width)
+        if self.batch_width:
+            assert not multihost, "batched serving is single-host only"
 
     def _reset_scan_state(self) -> None:
         """Fresh per-walk scan collection: compile() re-resets so ONE
@@ -362,15 +376,86 @@ class Compiler:
                 outs.append(jnp.broadcast_to(m, (1,)))
             return tuple(outs)
 
+        # vectorized serving (docs/PERF.md "Vectorized serving"): the same
+        # per-member program body, vmapped over the stacked parameter
+        # inputs — each slot arrives (width, 1) and every member instance
+        # sees the classic (1,) contract. Table inputs are closed over
+        # (broadcast: every member scans the same staged data); outputs,
+        # flags, and metrics gain a leading (width,) member axis the
+        # executor demuxes. Kept as a SEPARATE closure so the classic
+        # program's jaxpr — and its persistent-XLA-cache entries — stay
+        # byte-identical when batching is off.
+        W = self.batch_width
+
+        def seg_fn_batched(*flat):
+            from jax import lax
+
+            tables = {}
+            i = 0
+            for tname, cols, cap, _direct, _prune, _parts, _dyn in input_spec:
+                entry = {}
+                for c in cols:
+                    entry[c] = flat[i]
+                    i += 1
+                entry["@present"] = flat[i]
+                i += 1
+                tables[tname] = entry
+            pstack = flat[i:i + nparams]    # each (W, 1)
+
+            def one_member(pflat):
+                ctx = {"tables": dict(tables), "flags": [], "metrics": []}
+                self.consts["@params@rt"] = {
+                    k: pflat[k] for k in range(nparams)}
+                batch = compiled(ctx)
+                sel = batch.selection()
+                if compact_k is not None:
+                    dead = (~sel).astype(jnp.uint8)
+                    rid = jnp.arange(sel.shape[0], dtype=jnp.int32)
+                    _, perm = lax.sort((dead, rid), num_keys=2)
+                    perm = perm[:compact_k]
+                    total = jnp.sum(sel.astype(jnp.int32))
+                    ctx["flags"].append((fid_cmp, total > compact_k))
+                    ctx["metrics"].append((mid_cmp, total))
+                    batch = Batch(
+                        {c.id: batch.cols[c.id][perm] for c in out_cols},
+                        {c.id: batch.valids[c.id][perm] for c in out_cols
+                         if batch.valids.get(c.id) is not None},
+                        jnp.arange(compact_k, dtype=jnp.int32) < total)
+                    sel = batch.selection()
+                outs = []
+                for c in out_cols:
+                    outs.append(batch.cols[c.id])
+                    v = batch.valids.get(c.id)
+                    outs.append(jnp.ones_like(sel) if v is None else v)
+                outs.append(sel)
+                fdict = dict(ctx["flags"])
+                assert len(fdict) == len(flag_names), (
+                    sorted(fdict), sorted(flag_names))
+                for name in flag_names:
+                    outs.append(jnp.broadcast_to(
+                        fdict[name].astype(jnp.int32), (1,)))
+                mdict = dict(ctx["metrics"])
+                for name in metric_names:
+                    outs.append(jnp.broadcast_to(
+                        mdict[name].astype(jnp.int64), (1,)))
+                return tuple(outs)
+
+            return jax.vmap(one_member)(pstack)
+
         ncols_out = 2 * len(out_cols) + 1
         nouts = ncols_out + len(flag_names) + len(metric_names)
-        if mh:
+        if W:
+            assert nparams, "a batched program needs parameter inputs"
+            # outputs carry a leading member axis; segments concatenate
+            # along axis 1 -> global (W, nseg * cap) per output
+            out_specs = tuple([P(None, SEG_AXIS)] * nouts)
+        elif mh:
             out_specs = tuple([P()] * nouts)
         else:
             out_specs = tuple([P(SEG_AXIS)] * nouts)
         fn = jax.jit(
             _shard_map(
-                seg_fn,
+                seg_fn_batched if W else seg_fn,
                 mesh=self.mesh,
                 in_specs=tuple(P(SEG_AXIS) for _ in range(
                     sum(len(c) + 1 for _, c, *_ in input_spec)))
@@ -390,12 +475,17 @@ class Compiler:
             else self._capacity_of(below),
             metric_names=metric_names,
             flag_caps=dict(self.flag_caps),
-            est_bytes=self._estimate_bytes(below),
+            # a batched program holds ~one member's intermediates PER
+            # member (vmap), while the staged scan args are shared; charge
+            # the conservative width multiple — admission over-refusing a
+            # wide batch only narrows it to serial execution, never fails
+            est_bytes=self._estimate_bytes(below) * max(W, 1),
             node_est_bytes=dict(self.node_est_bytes),
             node_rows=dict(self.node_rows),
             flag_packs=dict(self.flag_packs),
             uses_fused=self.uses_fused,
             param_dtypes=param_dtypes,
+            batch_width=W,
         )
 
     def _nid(self, plan) -> int:

@@ -55,6 +55,14 @@ class AdmissionError(QueryError):
     pass
 
 
+class BatchFallback(Exception):
+    """A batched-serving window cannot run as one program (admission
+    ceiling, overflow flags, unsignable shape): every member re-runs
+    serially through the classic path, which owns retries and spill.
+    Never surfaces to a client — it only routes execution."""
+    pass
+
+
 class OutOfDeviceMemory(QueryError):
     """The device allocator refused the program (XLA RESOURCE_EXHAUSTED)
     after admission let it through — the typed OOM the reference's
@@ -286,32 +294,28 @@ class Executor:
                       tuple(sorted(cap_overrides.items())),
                       fused_disabled, no_direct,
                       Compiler.codegen_settings_sig(self.settings))
-                sig = self._sig_memo.get(mk)
-                if sig is None:
-                    try:
-                        sig_comp = Compiler(self.catalog, self.store,
-                                            self.mesh, self.nseg, consts,
-                                            self.settings, tier=tier,
-                                            cap_overrides=cap_overrides,
-                                            multihost=self.multihost is not None,
-                                            fused_disabled=fused_disabled,
-                                            no_direct=no_direct)
-                        sig = sig_comp.shape_signature(plan, snapshot)
-                        self._sig_memo[mk] = sig
-                        while len(self._sig_memo) > 2048:
-                            try:
-                                self._sig_memo.popitem(last=False)
-                            except KeyError:
-                                break
-                    except Exception:
-                        # unsignable shape (e.g. evicted transient raw
-                        # dict): compile uncached; counted so a signature
-                        # bug shows up as a visible reuse regression, not
-                        # silence
-                        counters.inc("program_cache_unsignable")
-                        sig, sig_comp = None, None
+                try:
+                    sig, sig_comp = self._memo_signature(
+                        mk,
+                        lambda: Compiler(self.catalog, self.store,
+                                         self.mesh, self.nseg, consts,
+                                         self.settings, tier=tier,
+                                         cap_overrides=cap_overrides,
+                                         multihost=self.multihost is not None,
+                                         fused_disabled=fused_disabled,
+                                         no_direct=no_direct),
+                        plan, snapshot)
+                except Exception:
+                    # unsignable shape (e.g. evicted transient raw
+                    # dict): compile uncached; counted so a signature
+                    # bug shows up as a visible reuse regression, not
+                    # silence
+                    counters.inc("program_cache_unsignable")
+                    sig, sig_comp = None, None
                 if sig is not None:
-                    ck = (cache_key, sig, fused_disabled)
+                    # trailing 0 = the unbatched program; batched serving
+                    # keys its width buckets in the same LRU (run_batch)
+                    ck = (cache_key, sig, fused_disabled, 0)
             # single fetch: a concurrent statement's eviction between a
             # membership test and the read must not KeyError (threaded
             # SQL server; the value object stays alive once fetched)
@@ -352,12 +356,7 @@ class Executor:
                     # pins an XLA executable), with cap-hint / fused-failed
                     # bookkeeping evicted alongside the last program of a
                     # statement (unbounded-growth fix, ISSUE 5)
-                    self._plan_cache[ck] = comp
-                    limit_n = max(int(getattr(self.settings,
-                                              "plan_cache_size", 128)), 1)
-                    while len(self._plan_cache) > limit_n:
-                        old_k, _old = self._plan_cache.popitem(last=False)
-                        self._on_program_evicted(old_k)
+                    self._cache_program(ck, comp)
             limit = effective_limit_bytes(self.settings)
             # admission charge: the MEASURED per-segment executable
             # footprint when the executable is warm and the backend
@@ -665,6 +664,191 @@ class Executor:
                         row_ranges=row_ranges, aux_tables=aux_tables,
                         allow_spill=False, no_direct=no_direct,
                         instrument=instrument)
+
+    # ---- program-cache bookkeeping shared by the classic dispatch
+    # ---- loop and the batched-serving path ---------------------------
+    def _memo_signature(self, mk, make_compiler, plan, snapshot):
+        """Memoized shape-signature walk -> (sig, walker Compiler or
+        None when the memo hit). An unsignable shape raises through —
+        callers choose their fallback (uncached compile / BatchFallback).
+        The walker is returned so a compile on the miss path can reuse
+        its scan collection instead of re-walking."""
+        sig = self._sig_memo.get(mk)
+        if sig is not None:
+            return sig, None
+        comp = make_compiler()
+        sig = comp.shape_signature(plan, snapshot)
+        self._sig_memo[mk] = sig
+        while len(self._sig_memo) > 2048:
+            try:
+                self._sig_memo.popitem(last=False)
+            except KeyError:
+                break
+        return sig, comp
+
+    def _cache_program(self, ck, comp) -> None:
+        """Insert a compiled program into the bounded LRU; evictions
+        drop their statement's cap-hint / fused-failed bookkeeping via
+        _on_program_evicted (one policy for every caller)."""
+        self._plan_cache[ck] = comp
+        limit_n = max(int(getattr(self.settings,
+                                  "plan_cache_size", 128)), 1)
+        while len(self._plan_cache) > limit_n:
+            old_k, _old = self._plan_cache.popitem(last=False)
+            self._on_program_evicted(old_k)
+
+    # ---- vectorized serving (exec/batchserve.py) ---------------------
+    # One XLA dispatch serves a whole admission window of same-shape
+    # statements: their hoisted parameter vectors stack along a leading
+    # member axis and the width-bucketed batched program (compile.py
+    # batch_width) runs once over the shared staged inputs. Split into
+    # prepare (compile/admit/stage) and dispatch (device) halves so the
+    # serving pipeline can stage batch k+1 while batch k runs on device.
+
+    def prepare_batch(self, plan, consts, out_cols, cache_key, pvec_rows):
+        """Compile-or-reuse the width-bucketed batched program, admit it,
+        and stage its (shared) table inputs plus the stacked parameter
+        arrays. -> (comp, inputs, snapshot, compiled: bool). Raises
+        BatchFallback when the batch cannot run as one program (admission
+        ceiling, unsignable shape) — members then re-run serially."""
+        width = len(pvec_rows)
+        bucket = _pow2(max(width, 1))
+        snapshot = self.store.manifest.snapshot()
+        version = snapshot.get("version", 0)
+        hints = dict(self._cap_hints.get(cache_key) or {})
+        # batched programs always disable the fused pallas kernel: the
+        # dense-agg kernel has no vmap batching rule, and a mid-batch
+        # lowering failure would cost every member a serial re-run
+        mk = (cache_key, version, 0, tuple(sorted(hints.items())),
+              True, False, Compiler.codegen_settings_sig(self.settings),
+              "batch")
+        try:
+            sig, sig_comp = self._memo_signature(
+                mk,
+                lambda: Compiler(self.catalog, self.store, self.mesh,
+                                 self.nseg, consts, self.settings,
+                                 tier=0, cap_overrides=dict(hints),
+                                 fused_disabled=True,
+                                 batch_width=bucket),
+                plan, snapshot)
+        except Exception:
+            counters.inc("program_cache_unsignable")
+            raise BatchFallback("unsignable statement shape")
+        ck = (cache_key, sig, True, bucket)
+        comp = self._plan_cache.get(ck)
+        was_cached = comp is not None
+        if was_cached:
+            try:
+                self._plan_cache.move_to_end(ck)
+            except KeyError:
+                pass
+            counters.inc("program_cache_hit")
+        else:
+            counters.inc("program_cache_miss")
+            t_comp = time.monotonic()
+            with _trace.span("compile", cat="exec", batch_width=bucket,
+                             cached=False):
+                if sig_comp is None:
+                    sig_comp = Compiler(self.catalog, self.store, self.mesh,
+                                        self.nseg, consts, self.settings,
+                                        tier=0, cap_overrides=dict(hints),
+                                        fused_disabled=True,
+                                        batch_width=bucket)
+                comp = sig_comp.compile(plan)
+            counters.inc("compile_ms",
+                         int((time.monotonic() - t_comp) * 1e3))
+            self._cache_program(ck, comp)
+        # admission: est_bytes is already width-scaled (compile.py); the
+        # measured footprint of a warm bucket takes over once the AOT
+        # analysis ran — PR-10's ground truth bounding the batch width
+        limit = effective_limit_bytes(self.settings)
+        admit_bytes, _measured = self._admission_bytes(comp)
+        if limit and admit_bytes > limit:
+            raise BatchFallback(
+                f"batched program would hold ~{admit_bytes >> 20} MB "
+                f"per segment at width {bucket}, above the "
+                f"{limit >> 20} MB ceiling")
+        # staging: identical to the classic single-statement stage except
+        # that parameter-valued prune predicates are DROPPED (pvec=None):
+        # zone-map pruning by one member's values would starve its
+        # batch-mates of blocks their rows live in. Value-pinned prune
+        # predicates are shared by every member and stay active.
+        self._row_ranges = {}
+        self._aux_tables = {}
+        with _trace.span("stage", cat="stage",
+                         tables=len(comp.input_spec)) as _sp:
+            inputs = list(self._stage(comp, snapshot, None))
+            padded = list(pvec_rows) \
+                + [pvec_rows[-1]] * (bucket - width)
+            for slot, dt in enumerate(comp.param_dtypes):
+                host = np.asarray([[pv.values[slot]] for pv in padded],
+                                  dtype=dt)
+                inputs.append(self._put_param(host))
+        _trace.annotate(_sp, batch_width=width, batch_bucket=bucket)
+        return comp, inputs, snapshot, not was_cached
+
+    def dispatch_batch(self, comp: CompileResult, inputs) -> list:
+        """Run a prepared batched program and fetch every output to host.
+        The serving pipeline's device stage — runs on the dispatcher
+        thread with NO statement context, so a member's cancellation can
+        never abort its batch-mates (members are masked at demux)."""
+        self._ensure_mem_analysis(comp, inputs)
+        with _trace.span("dispatch", cat="device",
+                         batch_width=comp.batch_width,
+                         est_bytes=comp.est_bytes):
+            faults.check("batch_dispatch")
+            flat = (comp.aot_fn or comp.device_fn)(*inputs)
+            jax.block_until_ready(flat)
+        with _trace.span("fetch", cat="device") as _sp:
+            flat = jax.device_get(list(flat))
+        _trace.annotate(_sp, bytes=int(sum(
+            getattr(a, "nbytes", 0) for a in flat)))
+        return flat
+
+    def batch_overflowed(self, comp: CompileResult, flat) -> list[str]:
+        """Flag names any member tripped — capacity overflow, packing
+        bounds, duplicate join keys. A batched program never retries in
+        place (per-member capacity needs differ); any flag sends every
+        member down the serial path, whose tier machinery handles it."""
+        ncols_part = 2 * len(comp.out_cols) + 1
+        out = []
+        for j, name in enumerate(comp.flag_names):
+            if np.asarray(flat[ncols_part + j]).any():
+                out.append(name)
+        return out
+
+    def demux_batch(self, comp: CompileResult, flat, member: int,
+                    snapshot) -> Result:
+        """One member's Result from a fetched batched output: slice its
+        row along the leading member axis and finalize exactly like a
+        classic dispatch (merge keys, host LIMIT, TEXT decode)."""
+        ncols_part = 2 * len(comp.out_cols) + 1
+        member_flat = [np.asarray(flat[i])[member]
+                       for i in range(ncols_part)]
+        with _trace.span("finalize", cat="host", member=member):
+            return self._finalize(comp, member_flat, snapshot, raw=False)
+
+    def run_batch(self, plan, consts, out_cols, cache_key,
+                  pvec_rows) -> list[Result]:
+        """Synchronous prepare+dispatch+demux of one batch (the test and
+        fallback surface; the serving pipeline calls the halves from its
+        own stage/dispatch threads). Raises BatchFallback when the batch
+        must be served serially."""
+        comp, inputs, snapshot, compiled = self.prepare_batch(
+            plan, consts, out_cols, cache_key, pvec_rows)
+        flat = self.dispatch_batch(comp, inputs)
+        over = self.batch_overflowed(comp, flat)
+        if over:
+            raise BatchFallback(f"overflow flags {over} at width "
+                                f"{len(pvec_rows)}")
+        out = []
+        for m in range(len(pvec_rows)):
+            res = self.demux_batch(comp, flat, m, snapshot)
+            res.stats = {"batched": True, "batch_width": len(pvec_rows),
+                         "batch_bucket": comp.batch_width,
+                         "compiled": compiled, "segments": self.nseg}
+            out.append(res)
+        return out
 
     # ---- measured memory accounting (runtime/memaccount.py) ----------
     def _ensure_mem_analysis(self, comp: CompileResult, inputs) -> None:

@@ -155,6 +155,11 @@ class Database:
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings,
                                  multihost=multihost)
+        # vectorized serving pipeline (exec/batchserve.py): created
+        # lazily on the first batch-eligible statement so the two
+        # pipeline threads only exist when batch_serving_enabled is on
+        self._batch_server = None
+        self._batch_server_mu = threading.Lock()
         from greengage_tpu.runtime.dtm import DtmSession
         from greengage_tpu.runtime.fts import FtsProber
         from greengage_tpu.runtime.replication import Replicator
@@ -1988,6 +1993,32 @@ class Database:
         return planned, self._attach_params(consts, pv, ptypes,
                                             info), outs, ek
 
+    # ---- vectorized serving (exec/batchserve.py) ---------------------
+    def _batcher(self):
+        b = self._batch_server
+        if b is None:
+            with self._batch_server_mu:
+                b = self._batch_server
+                if b is None:
+                    from greengage_tpu.exec.batchserve import BatchServer
+
+                    b = self._batch_server = BatchServer(self)
+        return b
+
+    def _batch_eligible(self, consts, aux) -> bool:
+        """May this SELECT ride the batched-serving path? Parameterized
+        single-host autocommit reads only: multihost stays lockstep,
+        external-table loads stay serial, and a statement inside an open
+        transaction must see its session's uncommitted state."""
+        if not bool(getattr(self.settings, "batch_serving_enabled", False)):
+            return False
+        if self.multihost is not None or aux:
+            return False
+        if (consts or {}).get("@params@") is None:
+            return False
+        cur = self.dtm.current
+        return cur is None or cur.state != "active"
+
     def _select(self, stmt: A.SelectStmt) -> Result:
         rctes = getattr(stmt, "_recursive_ctes", None)
         if rctes:
@@ -2026,6 +2057,18 @@ class Database:
         # post-broadcast wait here would strand workers in the collectives)
         with (self._admission() if self.multihost is None
               else _NullSlot()):
+            if self._batch_eligible(consts, aux):
+                # vectorized serving: enroll in the admission window for
+                # this statement shape — one XLA dispatch serves every
+                # in-flight member. None = the batch fell back (or this
+                # member should run alone): continue on the classic path
+                res = self._batcher().submit(planned, consts, outs,
+                                             exec_key, consts["@params@"])
+                if res is not None:
+                    if isinstance(res.stats, dict):
+                        res.stats["plan_cache"] = dict(pc_info)
+                    self._record_stats(res)
+                    return res
             try:
                 # executor adds the manifest version; the bare statement
                 # identity lets it evict compiled programs of old versions
@@ -3360,6 +3403,11 @@ class Database:
             self.fts.stop()
         except Exception:
             pass
+        if self._batch_server is not None:
+            try:
+                self._batch_server.stop()
+            except Exception:
+                pass
         if self.multihost is not None and self.multihost.is_coordinator \
                 and self.multihost.channel is not None:
             try:

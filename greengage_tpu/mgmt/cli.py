@@ -836,14 +836,25 @@ def cmd_ps(args):
         c.close()
     rows = resp.get("rows") or []
     cl = resp.get("cluster") or {}
+    pipe = resp.get("pipeline") or {}
     if cl:
         gang = ""
         if cl.get("expected_workers") is not None:
             gang = (f"  workers: {cl.get('active_workers')}/"
                     f"{cl.get('expected_workers')}")
+        # serving-pipeline depths (vectorized serving + staging pool):
+        # a persistent backlog here means the device or scan_threads is
+        # the bottleneck, not planning
+        pq = ""
+        if pipe:
+            pq = (f"  pipeline: batch-window "
+                  f"{pipe.get('batch_admission_depth', 0)}"
+                  f" in-flight {pipe.get('batch_inflight', 0)}"
+                  f" stage-pool {pipe.get('staging_pool_queue_depth', 0)}")
         print(f"cluster: {cl.get('state', '?')}  "
-              f"topology v{cl.get('topology_version', '?')}{gang}")
-    print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} {'SPAN':>22} SQL")
+              f"topology v{cl.get('topology_version', '?')}{gang}{pq}")
+    print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} {'BATCH':>6} "
+          f"{'SPAN':>22} SQL")
     for r in rows:
         state = f"cancel:{r['cancelled']}" if r.get("cancelled") else "active"
         # current execution phase (trace registry): span name + how long
@@ -852,8 +863,11 @@ def cmd_ps(args):
         span = "-"
         if r.get("span"):
             span = f"{r['span']} {r.get('span_ms', 0):.0f}ms"
+        # member-of-batch id (vectorized serving): statements riding one
+        # admission window share a BATCH id — one device dispatch
+        batch = str(r["batch"]) if r.get("batch") is not None else "-"
         print(f"{r['id']:>6} {r['elapsed_s']:>10.3f} {state:>12} "
-              f"{span:>22} {r['sql']}")
+              f"{batch:>6} {span:>22} {r['sql']}")
     print(f"({len(rows)} statements)", file=sys.stderr)
     return 0
 

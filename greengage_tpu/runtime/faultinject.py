@@ -47,6 +47,10 @@ FAULT_POINTS = frozenset({
     # device RESOURCE_EXHAUSTED at dispatch — OOM classification and
     # spill demotion without a real allocator exhaustion
     "device_oom",
+    # vectorized serving (exec/executor.py dispatch_batch): a 'sleep'
+    # injection holds a batch on the device so tests can pin window
+    # accumulation and stage(k+1)/dispatch(k) pipeline overlap
+    "batch_dispatch",
 })
 
 

@@ -40,6 +40,9 @@ GAUGE_NAMES = (
     "mem_owner_bytes_staging", "mem_owner_bytes_blockcache",
     "mem_owner_bytes_spill", "mem_owner_bytes_device",
     "host_rss_bytes", "host_open_fds", "staging_pool_queue_depth",
+    # vectorized serving (exec/batchserve.py): members waiting in open
+    # admission windows right now
+    "batch_queue_depth",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -73,6 +76,13 @@ COUNTER_NAMES = (
     # performed (a warm program-cache hit must add ZERO), classified
     # device OOMs, and OOMs absorbed by the one-shot spill demotion
     "mem_analysis_runs", "oom_events", "oom_spill_retries",
+    # vectorized serving (exec/batchserve.py): device dispatches vs
+    # statements they served (members/dispatch = the amortization
+    # factor), why windows flushed, and batches routed back to the
+    # serial path (admission ceiling / overflow flags / stage failure)
+    "batch_dispatch_total", "batch_members_total",
+    "batch_window_flush_full", "batch_window_flush_timer",
+    "batch_fallback_total",
 )
 
 HISTOGRAM_NAMES = (
@@ -81,6 +91,9 @@ HISTOGRAM_NAMES = (
     # measured executable footprint (args+temps+output, MB buckets —
     # observed with DEFAULT_BUCKETS_MB, not the ms defaults)
     "executable_mem_mb",
+    # vectorized serving: members per flushed batch (pow2-width buckets,
+    # exec/batchserve.WIDTH_BUCKETS — not the ms defaults)
+    "batch_width",
 )
 
 

@@ -65,6 +65,23 @@ def _watch_client(sock, thread_ident: int, stop: "threading.Event") -> None:
             return
 
 
+def _pipeline_depths(db) -> dict:
+    """Serving-pipeline queue depths for the ps/status frames: members
+    waiting in batched-serving admission windows, batches staged-but-not-
+    demuxed, and the staging pool's read-unit backlog (the PR-10
+    staging_pool_queue_depth probe, reused rather than re-measured)."""
+    from greengage_tpu.exec import staging
+
+    out = {"staging_pool_queue_depth": staging.pool_queue_depth()}
+    bs = getattr(db, "_batch_server", None)
+    if bs is not None:
+        try:
+            out.update(bs.queue_depths())
+        except Exception:
+            pass
+    return out
+
+
 def _cluster_status(db) -> dict:
     """Topology state for the ps/status control frames; resilient to a
     Database predating mh_state (bare test doubles)."""
@@ -220,6 +237,7 @@ class SqlServer:
                     from greengage_tpu.runtime.trace import TRACES
 
                     rows = REGISTRY.snapshot()
+                    bs = getattr(outer.db, "_batch_server", None)
                     for r in rows:
                         # current execution phase from the trace registry
                         # (`gg ps` SPAN column): deepest open span + its
@@ -227,8 +245,16 @@ class SqlServer:
                         sp = TRACES.active_span(r["id"])
                         if sp is not None:
                             r["span"], r["span_ms"] = sp[0], round(sp[1], 1)
+                        # batched-serving membership (`gg ps` BATCH
+                        # column): which flush window this statement is
+                        # riding, when it is riding one
+                        if bs is not None:
+                            bid = bs.member_of(r["id"])
+                            if bid is not None:
+                                r["batch"] = bid
                     return {"ok": True, "rows": rows,
-                            "cluster": _cluster_status(outer.db)}
+                            "cluster": _cluster_status(outer.db),
+                            "pipeline": _pipeline_depths(outer.db)}
                 if op == "metrics":
                     # Prometheus text exposition over the process-wide
                     # counters/gauges/histograms (`gg metrics`); host
@@ -274,8 +300,9 @@ class SqlServer:
                     st = _cluster_status(outer.db)
                     st["counters"] = {
                         k: v for k, v in counters.snapshot().items()
-                        if k.startswith(("mh_", "manifest_"))}
-                    return {"ok": True, "cluster": st}
+                        if k.startswith(("mh_", "manifest_", "batch_"))}
+                    return {"ok": True, "cluster": st,
+                            "pipeline": _pipeline_depths(outer.db)}
                 if op == "cancel":
                     try:
                         sid = int(req.get("id"))

@@ -188,16 +188,23 @@ class Trace:
                 out.append(s)
             return out
 
-    def graft(self, spans: list[dict], parent_sid: int, tid: str) -> None:
-        """Adopt a remote process's exported spans as children of
-        ``parent_sid`` (the dispatch span), re-based onto its clock."""
+    def graft(self, spans: list[dict], parent_sid: int, tid: str,
+              base_ms: float | None = None) -> None:
+        """Adopt another trace's exported spans as children of
+        ``parent_sid`` (the dispatch span), re-based onto its clock —
+        or onto an explicit ``base_ms`` offset from THIS trace's start
+        (the batched-serving graft, whose donor trace started on its own
+        clock rather than inside the parent span)."""
         if not spans:
             return
         with self._lock:
             base = 0.0
-            pspan = self._by_id.get(parent_sid)
-            if pspan is not None:
-                base = pspan["ts"]
+            if base_ms is not None:
+                base = float(base_ms)
+            else:
+                pspan = self._by_id.get(parent_sid)
+                if pspan is not None:
+                    base = pspan["ts"]
             idmap: dict = {}
             for s in spans[:MAX_GRAFT_SPANS]:
                 if len(self._spans) >= MAX_SPANS:
@@ -288,6 +295,34 @@ class TraceRegistry:
 
     def current(self) -> Trace | None:
         return self._by_thread.get(threading.get_ident())
+
+    # ---- pipeline-thread adoption (exec/batchserve.py) ---------------
+    # The batched-serving pipeline threads are not statement threads:
+    # they carry a standalone per-flush Trace so the executor's span
+    # calls (module-level span()) land in it while a batch stages or
+    # dispatches, and the finished trace is grafted into every member's
+    # statement trace + retired into the ring under its own (negative)
+    # id, where `gg trace` can serve it directly.
+    def adopt(self, trace: Trace) -> None:
+        """Make ``trace`` the calling thread's current trace (no nesting
+        bookkeeping — pipeline threads adopt exactly one at a time)."""
+        with self._lock:
+            self._by_thread[threading.get_ident()] = trace
+
+    def release(self, trace: Trace) -> None:
+        """Drop the calling thread's adopted trace (only if still it)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if self._by_thread.get(tid) is trace:
+                del self._by_thread[tid]
+
+    def retire(self, trace: Trace) -> None:
+        """Park a finished standalone trace in the completed ring."""
+        with self._lock:
+            trace.dur_ms = (time.monotonic() - trace.t0) * 1e3
+            self._ring[trace.trace_id] = trace
+            while len(self._ring) > max(self.ring_size, 1):
+                self._ring.popitem(last=False)
 
     def get(self, trace_id: int) -> Trace | None:
         """In-flight first (any thread), then the ring."""
