@@ -1174,6 +1174,36 @@ def run_child():
     except Exception as e:
         detail["batch_serving"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # window-engine rider (ISSUE 12): an ordered-global ntile (the
+    # gather-free all-gather rank machinery) and a partitioned running
+    # sum over lineitem, warm-timed — so the first unwedged TPU run
+    # captures window kernel timings alongside Q1/Q3/Q5
+    try:
+        log("=== window rider ===")
+        from greengage_tpu.runtime.logger import counters as _wc
+
+        wq = {
+            "ntile_global": ("select max(nt) from (select ntile(8) over "
+                             "(order by o_orderkey) nt from orders) t"),
+            "partitioned_running_sum": (
+                "select max(rs) from (select sum(l_quantity) over "
+                "(partition by l_suppkey order by l_extendedprice, "
+                "l_orderkey) rs from lineitem) t"),
+        }
+        wd = {}
+        for name, q in wq.items():
+            db.sql(q)   # warm: compile once, then measure dispatch
+            t0 = time.monotonic()
+            r = db.sql(q)
+            wd[name] = {"ms": round((time.monotonic() - t0) * 1e3, 1),
+                        "compute_ms": r.stats.get("compute_ms"),
+                        "fused": r.stats.get("fused_kernel")}
+        wd["gather_free_total"] = _wc.get("window_gather_free_total")
+        wd["funnel_total"] = _wc.get("window_funnel_total")
+        detail["window"] = wd
+    except Exception as e:
+        detail["window"] = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps(detail, indent=None), file=sys.stderr, flush=True)
     if "q1" not in QUERIES:
         # the headline is defined as the Q1 number; record an explicit

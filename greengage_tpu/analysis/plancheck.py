@@ -19,10 +19,13 @@ I2  Motions sit exactly at distribution boundaries: GATHER lands on
     ENTRY, BROADCAST turns a partitioned/SingleQE child replicated,
     REDISTRIBUTE carries hash exprs and lands HASHED (or SingleQE via
     the constant-key funnel the planner uses for buried LIMITs and
-    exotic windows, or STREWN for computed keys).
+    exotic windows, or STREWN for computed keys); a range-spec
+    REDISTRIBUTE (sampled-splitter window repartition) lands STREWN
+    with exactly the leading order key.
 I3  ENTRY exists only at the root, which is the single Gather Motion —
     an interior Gather is a hidden one-chip funnel in a plan that
-    claims parallel execution.
+    claims parallel execution; a global-mode Window above a SingleQE
+    funnel is the same lie one node up.
 I4  a Join whose two children are both partitioned must have them
     co-located on its join keys (cdbpath_motion_for_join's contract):
     HASHED sides correspond pairwise through the join-key equivalence,
@@ -31,7 +34,10 @@ I5  Aggregate/Window locality claims hold: a single-phase grouped agg
     over a HASHED child is hashed on its group keys; a grouped final
     agg sits above the state Redistribute; a scalar final sits above
     the partial-state Broadcast; a non-global Window owns whole
-    partitions per segment.
+    partitions per segment; an ordered-global Window carries a
+    packed/full64 gkey_spec inside the 64-bit budget; a range-mode
+    Window sits directly above its range Redistribute (whole key
+    ranges per segment).
 I6  Scan annotations are well-formed: prune predicates reference only
     existing storage columns with sane ops and Param/host values,
     direct dispatch targets a real segment, index hits name real
@@ -248,6 +254,18 @@ def _validate(node: Plan, root: Plan, trail: list[str], catalog) -> None:
                     and not all(_is_const_expr(e) for e in node.hash_exprs):
                 _fail("I2", trail, node,
                       "SingleQE funnel must hash on constants")
+            if getattr(node, "range_spec", None) is not None:
+                # range repartition: rows route by key RANGES, not a
+                # hash — claiming HASHED (or a funnel) would let a join
+                # co-locate against a distribution that does not exist
+                if locus.kind is not LocusKind.STREWN:
+                    _fail("I2", trail, node,
+                          f"range Redistribute lands {locus.kind.value}, "
+                          "not Strewn")
+                if len(node.hash_exprs) != 1:
+                    _fail("I2", trail, node,
+                          "range Redistribute must carry exactly the "
+                          "leading order key")
             if locus.kind is LocusKind.HASHED \
                     and len(locus.keys) != len(node.hash_exprs):
                 _fail("I2", trail, node,
@@ -295,7 +313,8 @@ def _validate(node: Plan, root: Plan, trail: list[str], catalog) -> None:
                       f"states, child is {child_locus.describe()}")
     if isinstance(node, Window):
         child_locus = node.child.locus
-        is_global = bool(getattr(node, "global_mode", False))
+        gm = getattr(node, "global_mode", False)
+        is_global = bool(gm)
         if child_locus is not None and not is_global \
                 and child_locus.is_partitioned:
             key_ids = tuple(e.name for e in node.partition_keys
@@ -310,6 +329,54 @@ def _validate(node: Plan, root: Plan, trail: list[str], catalog) -> None:
                       f"window partitions split across segments: child "
                       f"{child_locus.describe()} not hashed on "
                       f"PARTITION BY keys {key_ids}")
+        if is_global:
+            # gather-free global windows: the shape claims rows never
+            # funnel, so the claim must be machine-checkable — a global
+            # window above a SingleQE funnel is a hidden one-chip plan
+            # wearing a distributed label (I3's spirit, node-local half)
+            if node.partition_keys:
+                _fail("I5", trail, node,
+                      "global window carries PARTITION BY keys")
+            if child_locus is not None \
+                    and child_locus.kind is LocusKind.SINGLE_QE:
+                _fail("I3", trail, node,
+                      "global-mode window above a SingleQE funnel — the "
+                      "gather-free claim is false")
+            spec = getattr(node, "gkey_spec", None)
+            if gm == "ordered":
+                if not node.order_keys:
+                    _fail("I5", trail, node,
+                          "ordered-global window with no ORDER BY keys")
+                if not isinstance(spec, dict) \
+                        or spec.get("mode") not in ("packed", "full64"):
+                    _fail("I5", trail, node,
+                          "ordered-global window without a packed/full64 "
+                          f"gkey_spec (got {spec!r})")
+                if spec.get("mode") == "packed":
+                    total = sum(int(f.get("bits", 0)) + 1
+                                for f in spec.get("fields", ()))
+                    if not spec.get("fields") or total > 64 or any(
+                            int(f.get("bits", 0)) < 1
+                            for f in spec["fields"]):
+                        _fail("I5", trail, node,
+                              f"packed gkey_spec fields exceed the 64-bit "
+                              f"budget or carry zero-width fields "
+                              f"({total} bits)")
+            elif gm == "range":
+                if not isinstance(spec, dict) or spec.get("mode") != "range":
+                    _fail("I5", trail, node,
+                          "range-mode window without a range gkey_spec")
+                ch = node.child
+                if not (isinstance(ch, Motion)
+                        and ch.kind is MotionKind.REDISTRIBUTE
+                        and getattr(ch, "range_spec", None) is not None):
+                    _fail("I5", trail, node,
+                          "range-mode window's child is not a range "
+                          "Redistribute — segments would not own whole "
+                          "key ranges")
+            elif node.order_keys:
+                _fail("I5", trail, node,
+                      "unordered-global window carries ORDER BY keys")
     # ---- I6: scan annotations --------------------------------------
     if isinstance(node, Scan):
         _validate_scan(node, trail, catalog)

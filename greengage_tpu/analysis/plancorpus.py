@@ -125,6 +125,31 @@ TPCH_QUERIES: dict[str, str] = {
     "two_phase_strewn_group": """
       select l_suppkey, count(*) c, sum(l_quantity) q
       from lineitem group by l_suppkey order by c desc, l_suppkey limit 15""",
+    # ---- window engine (ISSUE 12): every shape below must plan with the
+    # ---- root Gather as its ONLY Gather and no SingleQE funnel --------
+    "ordered_global_ntile": """
+      select o_orderkey, ntile(4) over (order by o_orderkey) nt
+      from orders order by o_orderkey limit 20""",
+    "ordered_global_lag_lead": """
+      select o_orderkey, lag(o_totalprice) over (order by o_orderdate,
+                                                 o_orderkey) lp,
+             lead(o_custkey, 2) over (order by o_orderdate, o_orderkey) lc
+      from orders order by o_orderkey limit 20""",
+    "ordered_global_text_rank": """
+      select o_clerk, ntile(3) over (order by o_clerk) nt,
+             dense_rank() over (order by o_clerk) dr
+      from orders order by o_clerk limit 20""",
+    "range_window_running_sum": """
+      select o_orderkey, sum(o_totalprice) over (order by o_totalprice,
+                                                 o_orderkey) rs
+      from orders order by o_orderkey limit 20""",
+    "ordered_global_decimal_rank": """
+      select o_orderkey, rank() over (order by o_totalprice desc) rk
+      from orders order by o_orderkey limit 20""",
+    "whole_frame_first_value": """
+      select o_custkey, first_value(o_totalprice) over
+               (partition by o_custkey) f
+      from orders order by o_orderkey limit 20""",
 }
 
 # the test-scale star schema of tests/test_tpcds_subset.py

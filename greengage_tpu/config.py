@@ -93,6 +93,17 @@ class Settings:
     # spill passes warm the next pass's cold block reads on a background
     # thread while the current pass's jitted program runs
     spill_prefetch: bool = True
+    # window-partition spill (exec/spill.py spill_window_run): a window
+    # whose working set exceeds the admission limit captures its input in
+    # chunked passes, then runs the window over disjoint PARTITION BY
+    # hash buckets — whole partitions per bucket, exact results. Off =
+    # honest admission rejection (the pre-spill behavior)
+    window_spill_enabled: bool = True
+    # sampled-splitter range repartition for ordered global windows
+    # (exec/compile.py _c_motion range branch): per-segment sample size
+    # feeding the global splitter selection; larger = better balance for
+    # skewed keys at a few KB of extra all_gather
+    window_range_sample: int = 64
     # read-path self-heal (docs/ROBUSTNESS.md storage failure model): a
     # corrupt/missing block file is repaired from the IN-SYNC standby tree
     # and the read retried once; off = detect-and-quarantine only (the

@@ -67,6 +67,20 @@ def _pow2(n: float) -> int:
     return m
 
 
+def _lag_lead_lookup(fname, param, rn0, n_total, lookup, live):
+    """lag/lead via a global position lookup -> (values, valid); the
+    SQL-standard default argument replaces out-of-partition offsets.
+    Shared by the ordered-global and range window kernels."""
+    k, default = param if isinstance(param, tuple) else (param, None)
+    p = rn0 - k if fname == "lag" else rn0 + k
+    ok = (p >= 0) & (p < n_total)
+    val, vv = lookup(p)
+    if default is not None:
+        val = jnp.where(ok, val, jnp.asarray(default, val.dtype))
+        return val, (ok & vv | ~ok) & live
+    return val, ok & vv & live
+
+
 def _static_order_packable(keys, bounds) -> bool:
     """Compile-time mirror of ops/sort.order_pack_bits: the shared bounds
     budget (ops/sort.order_bounds_bits), plus no key may be TEXT (collation
@@ -622,6 +636,19 @@ class Compiler:
                 cap = 0
             width = sum(max(c.type.np_dtype.itemsize, 1) + 1 for c in p.out_cols())
             node_bytes = cap * width
+            if isinstance(p, Window) \
+                    and getattr(p, "global_mode", False) in ("ordered",
+                                                             "range"):
+                # all-gathered sorted key runs [nseg, cap] (8B keys) plus
+                # one gathered (value, valid) run per positional function
+                # argument — the real footprint of the gather-free path
+                extra = cap * self.nseg * 9
+                for _ci, fname, arg, _o, _pp in p.wfuncs:
+                    if fname in ("lag", "lead", "first_value",
+                                 "last_value") and arg is not None:
+                        extra += cap * self.nseg * (
+                            max(arg.type.np_dtype.itemsize, 1) + 1)
+                node_bytes += extra
             if isinstance(p, Join):
                 if getattr(p, "direct_domain", None) is not None \
                         and self.tier == 0 and not self.no_direct:
@@ -1435,6 +1462,66 @@ class Compiler:
         fid = f"motion_overflow_{len(self.flags)}"
         self.flags.append(fid)
 
+        if plan.range_spec is not None:
+            # range repartition by sampled splitters (the distributed
+            # sample-sort routing step): each segment samples S evenly
+            # spaced values of its locally sorted keys, the gathered
+            # sample sorts globally, and nseg-1 splitters route every row
+            # so equal keys co-locate and segments own contiguous ranges.
+            # Deterministic and SPMD-identical — every segment computes
+            # the same splitters from the same all_gather.
+            spec = plan.range_spec
+            S = max(int(getattr(self.s, "window_range_sample", 64)), 8)
+
+            def run_range(ctx):
+                from jax import lax
+
+                b = child_fn(ctx)
+                sel = b.selection()
+                ev = Evaluator(b, self.consts)
+                v, valid = ev.value(spec["expr"])
+                enc = sort_ops.encode_key64(v, spec["desc"], spec["kind"])
+                MAXU = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+                if valid is not None:
+                    # live NULL keys are all peers on the leading key:
+                    # route them together to the end their placement puts
+                    # them at
+                    enc = jnp.where(valid, enc,
+                                    jnp.uint64(0) if spec["nulls_first"]
+                                    else MAXU)
+                dead = ~sel
+                n = sel.shape[0]
+                enc_sorted = lax.sort(
+                    (dead.astype(jnp.uint8), jnp.where(dead, MAXU, enc)),
+                    num_keys=2)[1]
+                live = jnp.sum((~dead).astype(jnp.int64))
+                take = jnp.clip(
+                    (jnp.arange(S, dtype=jnp.int64) * live) // S,
+                    0, n - 1).astype(jnp.int32)
+                samp = jnp.where(live > 0, enc_sorted[take], MAXU)
+                g = lax.sort(
+                    lax.all_gather(samp, SEG_AXIS).reshape(nseg * S))
+                splitters = g[jnp.asarray(
+                    [(i + 1) * (nseg * S) // nseg - 1
+                     for i in range(nseg - 1)], dtype=jnp.int32)]
+                # count of splitters strictly below enc: equal keys land
+                # on the same destination segment, always
+                dest = jnp.searchsorted(
+                    splitters, enc, side="left").astype(jnp.int32)
+                arrs = dict(b.cols)
+                for name, vv in b.valids.items():
+                    arrs[VALID_PREFIX + name] = vv
+                recv, precv, overflow = motion_ops.redistribute(
+                    arrs, sel, dest, nseg, C)
+                ctx["flags"].append((fid, overflow))
+                cols = {k: a for k, a in recv.items()
+                        if not k.startswith(VALID_PREFIX)}
+                valids = {k[len(VALID_PREFIX):]: a for k, a in recv.items()
+                          if k.startswith(VALID_PREFIX)}
+                return Batch(cols, valids, precv)
+
+            return run_range
+
         def run(ctx):
             b = child_fn(ctx)
             specs = self._key_specs(b, hash_exprs)
@@ -1492,6 +1579,14 @@ class Compiler:
 
             part_eq = eq_prev(pkeys) if pkeys else jnp.concatenate(
                 [jnp.zeros((1,), bool), jnp.ones((cap - 1,), bool)])
+            # dead rows (parked at the end by the sort) must always BREAK
+            # a group: padded buffer values can compare equal to the last
+            # live row, silently extending its peer/partition end into
+            # the dead region (ops/window.py documents both arrays False
+            # at dead rows — enforce it)
+            live_pair = sel_sorted & jnp.concatenate(
+                [jnp.zeros((1,), bool), sel_sorted[:-1]])
+            part_eq = part_eq & live_pair
             peer_eq = part_eq & (eq_prev([e for e, _, _ in okeys])
                                  if okeys else jnp.ones((cap,), bool))
 
@@ -1531,6 +1626,8 @@ class Compiler:
         nseg = self.nseg
         if plan.global_mode == "ordered":
             return self._c_window_global_ordered(plan, child_fn, cap)
+        if plan.global_mode == "range":
+            return self._c_window_global_range(plan, child_fn, cap)
 
         def run(ctx):
             from jax import lax
@@ -1549,6 +1646,27 @@ class Compiler:
                     if arg.type.kind is T.Kind.DECIMAL:
                         scale = arg.type.scale
                 lv = sel if valid is None else (sel & valid)
+                if fname in ("first_value", "last_value"):
+                    # whole-frame semantics (legal without ORDER BY, PG):
+                    # the first/last live ROW of the one global partition
+                    # in (segment, row) order — its value even when NULL
+                    va = valid if valid is not None \
+                        else jnp.ones((cap,), bool)
+                    if fname == "first_value":
+                        li = jnp.argmax(sel)
+                    else:
+                        li = cap - 1 - jnp.argmax(sel[::-1])
+                    g_has = lax.all_gather(jnp.any(sel), SEG_AXIS)
+                    g_val = lax.all_gather(vals[li], SEG_AXIS)
+                    g_ok = lax.all_gather(va[li], SEG_AXIS)
+                    if fname == "first_value":
+                        pick = jnp.argmax(g_has)
+                    else:
+                        pick = nseg - 1 - jnp.argmax(g_has[::-1])
+                    out_c[ci.id] = jnp.broadcast_to(g_val[pick], (cap,))
+                    out_v[ci.id] = jnp.broadcast_to(
+                        g_ok[pick] & jnp.any(g_has), (cap,))
+                    continue
                 if fname == "row_number":
                     local = jnp.cumsum(sel.astype(jnp.int64))
                     counts = lax.all_gather(
@@ -1600,15 +1718,19 @@ class Compiler:
         return run
 
     def _c_window_global_ordered(self, plan: Window, child_fn, cap: int):
-        """Distributed GLOBAL ranking (row_number/rank/dense_rank) over
-        integer/date ORDER BY keys: each row's rank = (# rows ordered
-        before it anywhere) computed IN PLACE — per segment, encode the
-        keys order-preservingly into one uint64, locally sort, all_gather
-        the sorted runs [nseg, cap] + live counts, and per row sum
-        searchsorted counts across segments. No funnel, no row motion:
-        ~8B x rows of gathered keys vs moving every row AND its payload
-        to one chip (reference shape: nodeWindowAgg.c over a distributed
-        tuplesort).
+        """Distributed GLOBAL ranking family (row_number/rank/dense_rank/
+        ntile/lag/lead/first_value/last_value) over integer/date/decimal/
+        float ORDER BY keys: each row's GLOBAL position and the global
+        row count are computed IN PLACE — per segment, encode the keys
+        order-preservingly into one uint64, locally sort, all_gather the
+        sorted runs [nseg, cap] + live counts, and per row sum
+        searchsorted counts across segments. ntile(k) is then arithmetic
+        on (position, count); lag/lead/first/last resolve position ±
+        offset via a lookup into the globally sorted gathered value runs.
+        No funnel, no row motion: ~8B x rows of gathered keys (plus one
+        value run per positional argument) vs moving every row AND its
+        payload to one chip (reference shape: nodeWindowAgg.c over a
+        distributed tuplesort).
 
         Encodings (planner._ordered_global_spec):
           packed — every key maps to (null_bit, value - lo) fields using
@@ -1621,10 +1743,14 @@ class Compiler:
         row_number() breaks ties deterministically by (segment, local
         sorted position); dense_rank counts distinct keys via a global
         two-key sort of the gathered runs + boundary cumsum."""
+        from greengage_tpu.ops import window as win_ops
+
         wfuncs = plan.wfuncs
         nseg = self.nseg
         spec = plan.gkey_spec
         need_dense = any(f[1] == "dense_rank" for f in wfuncs)
+        VALUE_FUNCS = ("lag", "lead", "first_value", "last_value")
+        need_values = any(f[1] in VALUE_FUNCS for f in wfuncs)
 
         def run(ctx):
             from jax import lax
@@ -1663,10 +1789,8 @@ class Compiler:
                 dead = ~sel
             else:                                   # full64, one key
                 v, valid = ev.value(spec["expr"])
-                enc = (v.astype(jnp.int64).astype(jnp.uint64)
-                       ^ (U1 << jnp.uint64(63)))
-                if spec["desc"]:
-                    enc = ~enc
+                enc = sort_ops.encode_key64(v, spec["desc"],
+                                            spec.get("kind", "int"))
                 isnull_cls = (sel & ~valid) if valid is not None \
                     else jnp.zeros((cap,), bool)
                 nulls_first = spec["nulls_first"]
@@ -1711,15 +1835,29 @@ class Compiler:
             valued_base = jnp.where(nulls_first, n_null_total, 0)
             null_base = jnp.where(nulls_first, 0, total_valued)
 
+            # global 0-based position of every row (row_number semantics:
+            # ties break by (segment, local sorted position)) and the
+            # GLOBAL row count — ntile is pure arithmetic on these, and
+            # lag/lead/first/last resolve position±offset via the lookup
+            rn0 = jnp.where(
+                isnull_cls,
+                null_base + null_prior_segs + local_null_idx,
+                valued_base + less_g + eq_prior + local_eq_before
+            ).astype(jnp.int64)
+            n_total = total_valued + n_null_total
+
+            flat = flive = None
+            if need_dense or need_values:
+                flat = g_sorted.reshape(nseg * cap)
+                flive = (jnp.arange(cap)[None, :] < g_live[:, None]) \
+                    .reshape(nseg * cap)
+
             dense_b = total_distinct = None
             if need_dense:
                 # distinct count: one global sort of the gathered runs by
                 # (enc, live-first) + boundary flags on live key changes.
                 # Dead entries carry 0xFF..FF; a LIVE max-value row sorts
                 # before them (secondary key) so its boundary still counts
-                flat = g_sorted.reshape(nseg * cap)
-                flive = (jnp.arange(cap)[None, :] < g_live[:, None]) \
-                    .reshape(nseg * cap)
                 s_enc, s_dead, s_live = lax.sort(
                     (flat, (~flive).astype(jnp.uint8), flive), num_keys=2,
                     is_stable=True)
@@ -1731,23 +1869,290 @@ class Compiler:
                 dense_b = cum_excl[jnp.clip(idx, 0, nseg * cap - 1)]
                 total_distinct = jnp.sum(d)
 
+            cum_null = jnp.cumsum(g_null)
+
+            def make_lookup(arg):
+                """-> lookup(p): the window argument's (value, valid) at
+                GLOBAL position p. Valued positions read the globally
+                sorted gathered value run — live entries occupy exactly
+                [0, total_valued) in rank order, and the stable sort's
+                seg-major tie order equals the rank tie-break (runs are
+                locally sorted, flattened segment-major). full64
+                NULL-class positions read a (segment, row)-ordered
+                gathered run of the null-key rows."""
+                vals, valid = ev.value(arg)
+                va = valid if valid is not None else jnp.ones((cap,), bool)
+                g_vs = lax.all_gather(
+                    vals[sorted_rid], SEG_AXIS).reshape(nseg * cap)
+                g_vv = lax.all_gather(
+                    va[sorted_rid], SEG_AXIS).reshape(nseg * cap)
+                _e, _d2, s_vals, s_valid = lax.sort(
+                    (flat, (~flive).astype(jnp.uint8), g_vs, g_vv),
+                    num_keys=2, is_stable=True)
+                if spec["mode"] == "full64":
+                    npos = jnp.where(
+                        isnull_cls,
+                        jnp.cumsum(isnull_cls.astype(jnp.int32)) - 1,
+                        jnp.int32(cap))
+                    g_nv = lax.all_gather(
+                        jnp.zeros((cap + 1,), vals.dtype)
+                        .at[npos].set(vals)[:cap], SEG_AXIS)   # [nseg,cap]
+                    g_nvv = lax.all_gather(
+                        jnp.zeros((cap + 1,), bool)
+                        .at[npos].set(va)[:cap], SEG_AXIS)
+                else:
+                    g_nv = g_nvv = None
+
+                def lookup(p):
+                    q = jnp.clip(
+                        jnp.where(nulls_first, p - n_null_total, p),
+                        0, nseg * cap - 1)
+                    val = s_vals[q]
+                    ok = s_valid[q]
+                    if g_nv is not None:
+                        in_null = (p < n_null_total) if nulls_first \
+                            else (p >= total_valued)
+                        j = p if nulls_first else p - total_valued
+                        sg = jnp.clip(
+                            jnp.searchsorted(cum_null, j, side="right"),
+                            0, nseg - 1)
+                        loc = jnp.clip(j - (cum_null[sg] - g_null[sg]),
+                                       0, cap - 1).astype(jnp.int32)
+                        val = jnp.where(in_null, g_nv[sg, loc], val)
+                        ok = jnp.where(in_null, g_nvv[sg, loc], ok)
+                    return val, ok
+
+                return lookup
+
             out_c = dict(b.cols)
             out_v = dict(b.valids)
-            for ci, fname, _arg, _ordered, _param in wfuncs:
+            for ci, fname, arg, _ordered, param in wfuncs:
                 if fname == "row_number":
-                    valued = valued_base + less_g + eq_prior + local_eq_before
-                    nullv = null_base + null_prior_segs + local_null_idx
-                elif fname == "rank":
-                    valued = valued_base + less_g
-                    nullv = null_base
-                else:                               # dense_rank
+                    out_c[ci.id] = rn0 + 1
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "rank":
+                    out_c[ci.id] = jnp.where(
+                        isnull_cls, null_base, valued_base + less_g) + 1
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "dense_rank":
                     has_nulls_first = (n_null_total > 0) & nulls_first
                     valued = dense_b + has_nulls_first.astype(jnp.int64)
                     nullv = jnp.where(nulls_first, 0, total_distinct)
                     nullv = jnp.broadcast_to(nullv, (cap,))
-                out_c[ci.id] = jnp.where(isnull_cls, nullv, valued) + 1
-                out_v.pop(ci.id, None)
+                    out_c[ci.id] = jnp.where(isnull_cls, nullv, valued) + 1
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "ntile":
+                    out_c[ci.id] = win_ops.ntile_bucket(rn0, n_total, param)
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname in ("lag", "lead"):
+                    out_c[ci.id], out_v[ci.id] = _lag_lead_lookup(
+                        fname, param, rn0, n_total, make_lookup(arg), sel)
+                    continue
+                # first_value / last_value, default frame (RANGE
+                # UNBOUNDED PRECEDING..CURRENT ROW): frame start is the
+                # global partition start, frame end the row's last PEER
+                lk = make_lookup(arg)
+                if fname == "first_value":
+                    p = jnp.zeros((cap,), jnp.int64)
+                else:
+                    eq_total = jnp.sum(right - left, axis=0)
+                    p = jnp.where(
+                        isnull_cls,
+                        null_base + n_null_total - 1,
+                        valued_base + less_g + eq_total - 1
+                    ).astype(jnp.int64)
+                val, vv = lk(p)
+                out_c[ci.id] = val
+                out_v[ci.id] = vv & sel
             return Batch(out_c, out_v, sel)
+
+        return run
+
+    def _c_window_global_range(self, plan: Window, child_fn, cap: int):
+        """Global window over RANGE-repartitioned rows (the child is the
+        sampled-splitter Redistribute, _c_motion): each segment owns a
+        contiguous range of the leading ORDER BY key with equal keys
+        co-located, so after a segment-local sort by the FULL key list
+        the global order is simply the concatenation of the per-segment
+        runs — peer groups never straddle a boundary. Rank family and
+        dense_rank stitch with all-gathered per-segment counts, ntile is
+        arithmetic on (global position, global count), running
+        sum/count/avg/min/max add prior segments' totals, and
+        lag/lead/first_value resolve cross-segment positions via a
+        lookup into the all-gathered sorted runs. One balanced
+        Redistribute where the planner used to funnel every row to one
+        chip."""
+        from greengage_tpu.ops import window as win_ops
+
+        wfuncs = plan.wfuncs
+        nseg = self.nseg
+        okeys = plan.order_keys
+
+        def run(ctx):
+            from jax import lax
+
+            b = child_fn(ctx)
+            skeys = self._sort_keys(b, okeys)
+            perm, sel_sorted, _ = sort_ops.sort_batch(
+                skeys, b.selection(), cap)
+            cols, valids = sort_ops.apply_perm(b.cols, b.valids, perm)
+            sb = Batch(cols, valids, sel_sorted)
+            ev = Evaluator(sb, self.consts)
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            # peer boundaries among LIVE rows (dead rows park at the end
+            # and always break a group — padded values can tie)
+            eq = jnp.ones((cap,), bool)
+            for e, _d, _nf in okeys:
+                v, valid = ev.value(e)
+                same = v[1:] == v[:-1]
+                if valid is not None:
+                    same = (same & valid[1:] & valid[:-1]) | (
+                        ~valid[1:] & ~valid[:-1])
+                eq = eq & jnp.concatenate([jnp.zeros((1,), bool), same])
+            eq = eq & sel_sorted & jnp.concatenate(
+                [jnp.zeros((1,), bool), sel_sorted[:-1]])
+            peer_bound = ~eq
+            peer_start = win_ops._starts(peer_bound, idx)
+            peer_end = jnp.clip(win_ops._ends(peer_start, cap), 0, cap - 1)
+
+            n_live = jnp.sum(sel_sorted.astype(jnp.int64))
+            g_n = lax.all_gather(n_live, SEG_AXIS)           # [nseg]
+            seg = lax.axis_index(SEG_AXIS)
+            prior_mask = jnp.arange(nseg) < seg
+            prior = jnp.sum(jnp.where(prior_mask, g_n, 0))
+            n_total = jnp.sum(g_n)
+            cum_n = jnp.cumsum(g_n)
+            # live rows occupy the local prefix, so local index == local
+            # rank and the global 0-based position is one offset away
+            rn0 = idx.astype(jnp.int64) + prior
+
+            def make_lookup(vals, va):
+                g_vals = lax.all_gather(vals, SEG_AXIS)      # [nseg, cap]
+                g_valid = lax.all_gather(va, SEG_AXIS)
+
+                def lookup(p):
+                    sg = jnp.clip(
+                        jnp.searchsorted(cum_n, p, side="right"),
+                        0, nseg - 1)
+                    loc = jnp.clip(p - (cum_n[sg] - g_n[sg]),
+                                   0, cap - 1).astype(jnp.int32)
+                    return g_vals[sg, loc], g_valid[sg, loc]
+
+                return lookup
+
+            out_c = dict(sb.cols)
+            out_v = dict(sb.valids)
+            db_loc = jnp.cumsum((peer_bound & sel_sorted).astype(jnp.int64))
+            for ci, fname, arg, _ordered, param in wfuncs:
+                vals = valid = None
+                scale = 0
+                if arg is not None:
+                    vals, valid = ev.value(arg)
+                    if arg.type.kind is T.Kind.DECIMAL:
+                        scale = arg.type.scale
+                if fname == "row_number":
+                    out_c[ci.id] = rn0 + 1
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "rank":
+                    out_c[ci.id] = peer_start.astype(jnp.int64) + prior + 1
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "dense_rank":
+                    g_d = lax.all_gather(db_loc[cap - 1], SEG_AXIS)
+                    out_c[ci.id] = db_loc + jnp.sum(
+                        jnp.where(prior_mask, g_d, 0))
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname == "ntile":
+                    out_c[ci.id] = win_ops.ntile_bucket(rn0, n_total, param)
+                    out_v.pop(ci.id, None)
+                    continue
+                if fname in ("lag", "lead"):
+                    va = valid if valid is not None \
+                        else jnp.ones((cap,), bool)
+                    out_c[ci.id], out_v[ci.id] = _lag_lead_lookup(
+                        fname, param, rn0, n_total,
+                        make_lookup(vals, va), sel_sorted)
+                    continue
+                if fname in ("first_value", "last_value"):
+                    va = valid if valid is not None \
+                        else jnp.ones((cap,), bool)
+                    if fname == "first_value":
+                        # global partition start lives on the first
+                        # non-empty segment
+                        lk = make_lookup(vals, va)
+                        val, vv = lk(jnp.zeros((cap,), jnp.int64))
+                    else:
+                        # last PEER is local — peers are whole per segment
+                        val, vv = vals[peer_end], va[peer_end]
+                    out_c[ci.id] = val
+                    out_v[ci.id] = vv & sel_sorted
+                    continue
+                # running aggregates to the last peer (default RANGE
+                # UNBOUNDED PRECEDING..CURRENT ROW): local prefix value
+                # plus the prior segments' whole-segment totals
+                lv = sel_sorted if valid is None else (sel_sorted & valid)
+                if fname in ("sum", "count", "avg"):
+                    if fname == "count" and vals is None:
+                        vals = jnp.ones((cap,), dtype=jnp.int64)
+                    acc = (jnp.float64 if vals.dtype.kind == "f"
+                           else jnp.int64)
+                    contrib = jnp.where(lv, vals.astype(acc), acc(0))
+                    cs = jnp.cumsum(contrib)
+                    cnt = jnp.cumsum(lv.astype(jnp.int64))
+                    ps = jnp.sum(jnp.where(
+                        prior_mask, lax.all_gather(
+                            jnp.sum(contrib), SEG_AXIS), acc(0)))
+                    pc = jnp.sum(jnp.where(
+                        prior_mask, lax.all_gather(
+                            jnp.sum(lv.astype(jnp.int64)), SEG_AXIS), 0))
+                    s = cs[peer_end] + ps
+                    c = cnt[peer_end] + pc
+                    if fname == "count":
+                        out_c[ci.id] = c
+                        out_v.pop(ci.id, None)
+                    elif fname == "sum":
+                        out_c[ci.id] = s
+                        out_v[ci.id] = c > 0
+                    else:
+                        a = (s.astype(jnp.float64)
+                             / jnp.where(c == 0, 1, c).astype(jnp.float64))
+                        if scale:
+                            a = a / (10.0 ** scale)
+                        out_c[ci.id] = a
+                        out_v[ci.id] = c > 0
+                    continue
+                # min / max (identity-fill rule of ops/window.py)
+                if vals.dtype.kind == "f":
+                    ident = jnp.array(jnp.inf if fname == "min"
+                                      else -jnp.inf, vals.dtype)
+                else:
+                    info = jnp.iinfo(vals.dtype)
+                    ident = jnp.array(info.max if fname == "min"
+                                      else info.min, vals.dtype)
+                filled = jnp.where(lv, vals, ident)
+                op = jnp.minimum if fname == "min" else jnp.maximum
+                run_ = (lax.cummin(filled) if fname == "min"
+                        else lax.cummax(filled))
+                g_t = lax.all_gather(
+                    jnp.min(filled) if fname == "min"
+                    else jnp.max(filled), SEG_AXIS)
+                prior_red = (jnp.min(jnp.where(prior_mask, g_t, ident))
+                             if fname == "min"
+                             else jnp.max(jnp.where(prior_mask, g_t,
+                                                    ident)))
+                cnt = jnp.cumsum(lv.astype(jnp.int64))
+                pc = jnp.sum(jnp.where(
+                    prior_mask, lax.all_gather(
+                        jnp.sum(lv.astype(jnp.int64)), SEG_AXIS), 0))
+                out_c[ci.id] = op(run_[peer_end], prior_red)
+                out_v[ci.id] = (cnt[peer_end] + pc) > 0
+            return Batch(out_c, out_v, sel_sorted)
 
         return run
 

@@ -975,19 +975,29 @@ class Executor:
 
     def _spill_fallback(self, plan, consts, out_cols, raw, instrument):
         """Host-offload spill paths, shared by the admission rejection
-        and the OOM demotion: partial-aggregate passes first, then the
-        external-merge sort. Raises spill.NotSpillable through when
-        neither shape applies."""
+        and the OOM demotion: partial-aggregate passes first, then
+        window-partition passes over the PARTITION BY hash space, then
+        the external-merge sort. Raises spill.NotSpillable through when
+        no shape applies."""
         from greengage_tpu.exec import spill
 
         try:
             res, npasses = spill.spill_run(
                 self, plan, consts, out_cols, raw, instrument=instrument)
         except spill.NotSpillable:
-            # external-merge sort spill (tuplesort role): ORDER BY
-            # results merge on the host from per-pass device-sorted runs
-            res, npasses = spill.spill_sort_run(
-                self, plan, consts, out_cols, raw, instrument=instrument)
+            try:
+                # window-partition spill (exec/spill.py spill_window_run):
+                # whole partitions per hash bucket, exact results
+                res, npasses = spill.spill_window_run(
+                    self, plan, consts, out_cols, raw,
+                    instrument=instrument)
+            except spill.NotSpillable:
+                # external-merge sort spill (tuplesort role): ORDER BY
+                # results merge on the host from per-pass device-sorted
+                # runs
+                res, npasses = spill.spill_sort_run(
+                    self, plan, consts, out_cols, raw,
+                    instrument=instrument)
         res.stats = dict(res.stats or {})
         res.stats["spill_passes"] = npasses
         return res

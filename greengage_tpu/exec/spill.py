@@ -34,11 +34,12 @@ from greengage_tpu import types as T
 from greengage_tpu.runtime import interrupt
 from greengage_tpu.runtime import memaccount
 from greengage_tpu.runtime import trace as _trace
+from greengage_tpu.runtime.logger import counters
 from greengage_tpu.planner.locus import Locus
 from greengage_tpu.planner.logical import (Aggregate, ColInfo, Filter, Join,
                                            Limit, Motion, MotionKind,
                                            PartialState, Plan, Project, Scan,
-                                           Sort)
+                                           Sort, Window)
 
 
 class NotSpillable(ValueError):
@@ -204,6 +205,61 @@ def _collect_passes(cols_spec, results):
     return cols, valids
 
 
+def _size_chunk_passes(executor, consts, pass_plan, candidates,
+                       limit_bytes):
+    """Largest-first chunk-size search shared by the partial-aggregate
+    and window spills: pick partition tables and per-pass chunk rows
+    that bring the compiled pass program's est_bytes under the limit
+    (multiple tables = the grace chunk grid). -> (chosen {table: chunk},
+    per_table [(table, chunk, n)], total passes, probe CompileResult);
+    raises NotSpillable when no combination fits or the grid explodes."""
+    from greengage_tpu.exec.compile import Compiler
+
+    store = executor.store
+    settings = executor.settings
+    candidates = sorted(
+        candidates, key=lambda t: -max(store.segment_rowcounts(t),
+                                       default=0))
+    floor = 1 << 12
+    MAX_PASSES = 256
+    chosen: dict[str, int] = {}          # table -> chunk rows
+    comp = None
+    fits = False
+    for cand in candidates:
+        max_rows = max(store.segment_rowcounts(cand), default=0)
+        if max_rows == 0:
+            continue
+        chunk = max_rows
+        while True:
+            chunk = max(chunk // 2, floor)
+            over = dict(chosen)
+            over[cand] = chunk
+            comp = Compiler(executor.catalog, store, executor.mesh,
+                            executor.nseg, consts, settings,
+                            scan_cap_override=over,
+                            no_direct=True).compile(pass_plan)
+            if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
+                break
+        chosen[cand] = chunk
+        if comp.est_bytes <= limit_bytes:
+            fits = True
+            break
+    if not fits:
+        raise NotSpillable("per-pass working set still exceeds the limit "
+                           "for every partitionable table combination")
+    per_table = []                        # (table, chunk, npasses)
+    npasses = 1
+    for t, chunk in chosen.items():
+        max_rows = max(store.segment_rowcounts(t), default=0)
+        n = -(-max_rows // chunk)
+        per_table.append((t, chunk, n))
+        npasses *= n
+    if npasses > MAX_PASSES:
+        raise NotSpillable(
+            f"spill would need {npasses} passes (> {MAX_PASSES})")
+    return chosen, per_table, npasses, comp
+
+
 def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
               instrument: bool = False):
     """Execute ``plan`` in partitioned passes. Raises ValueError when the
@@ -245,47 +301,8 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
     # cartesian chunk grid, exactly nodeHashjoin.c's batch x batch
     # schedule but with whole execution passes) and the chunk sizes that
     # bring the pass program under the limit
-    from greengage_tpu.exec.compile import Compiler
-
-    candidates.sort(
-        key=lambda t: -max(store.segment_rowcounts(t), default=0))
-    floor = 1 << 12
-    MAX_PASSES = 256
-    chosen: dict[str, int] = {}          # table -> chunk rows
-    comp = None
-    fits = False
-    for cand in candidates:
-        max_rows = max(store.segment_rowcounts(cand), default=0)
-        if max_rows == 0:
-            continue
-        chunk = max_rows
-        while True:
-            chunk = max(chunk // 2, floor)
-            over = dict(chosen)
-            over[cand] = chunk
-            comp = Compiler(executor.catalog, store, executor.mesh,
-                            executor.nseg, consts, settings,
-                            scan_cap_override=over,
-                            no_direct=True).compile(pass_plan)
-            if comp.est_bytes <= limit_bytes * 0.7 or chunk == floor:
-                break
-        chosen[cand] = chunk
-        if comp.est_bytes <= limit_bytes:
-            fits = True
-            break
-    if not fits:
-        raise NotSpillable("per-pass working set still exceeds the limit "
-                           "for every partitionable table combination")
-    per_table = []                        # (table, chunk, npasses)
-    npasses = 1
-    for t, chunk in chosen.items():
-        max_rows = max(store.segment_rowcounts(t), default=0)
-        n = -(-max_rows // chunk)
-        per_table.append((t, chunk, n))
-        npasses *= n
-    if npasses > MAX_PASSES:
-        raise NotSpillable(
-            f"spill would need {npasses} passes (> {MAX_PASSES})")
+    chosen, per_table, npasses, comp = _size_chunk_passes(
+        executor, consts, pass_plan, candidates, limit_bytes)
 
     # run the passes, collecting partial rows on the host (the workfile).
     # While pass k's jitted program runs, a background thread warms pass
@@ -541,6 +558,45 @@ def _sortable_host_key(arr: np.ndarray, valid, desc: bool,
     return [enc, nul]
 
 
+def _host_sort_spec(sort: Sort, out_cols) -> list[tuple]:
+    """Validate a Sort's keys as host-mergeable gathered output columns
+    -> [(col id, desc, nulls_first)]; raises NotSpillable otherwise.
+    Shared by the external-merge sort spill and the window spill's final
+    host ordering — any key type must be known host-orderable BEFORE
+    paying the pass loop."""
+    by_id = {c.id: c for c in out_cols}
+    keyspec = []
+    for e, desc, nf in sort.keys:
+        if not isinstance(e, E.ColRef) or e.name not in by_id:
+            raise NotSpillable("sort key is not a gathered output column")
+        kc = by_id[e.name]
+        # raw TEXT arrives as int64 row surrogates whose numeric order is
+        # row id, not string order
+        if getattr(kc, "raw_ref", None) is not None \
+                or getattr(kc, "raw_chain", None) is not None:
+            raise NotSpillable("sort key is raw-encoded text")
+        keyspec.append((e.name, bool(desc),
+                        bool(desc) if nf is None else bool(nf)))
+    return keyspec
+
+
+def _host_lexsort(cols: dict, valids: dict, keyspec: list[tuple]):
+    """One stable ascending lexsort over order-preserving key encodings
+    (the k-way merge step); keys minor->major, so reverse the SQL key
+    order and emit each key's (enc, null-class) pair in that order."""
+    lex: list[np.ndarray] = []
+    for name, desc, nf in reversed(keyspec):
+        enc = _sortable_host_key(cols[name], valids[name], desc, nf)
+        if enc is None:
+            raise NotSpillable("sort key host representation does not order")
+        lex.extend(enc)
+    perm = np.lexsort(lex)
+    cols = {k: v[perm] for k, v in cols.items()}
+    valids = {k: (v[perm] if v is not None else None)
+              for k, v in valids.items()}
+    return cols, valids
+
+
 def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
                    instrument: bool = False):
     """External-merge sort spill (tuplesort.c role,
@@ -560,20 +616,7 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
     if not isinstance(node, Sort):
         raise NotSpillable("no sort at the gather point")
     sort = node
-    by_id = {c.id: c for c in out_cols}
-    keyspec = []
-    for e, desc, nf in sort.keys:
-        if not isinstance(e, E.ColRef) or e.name not in by_id:
-            raise NotSpillable("sort key is not a gathered output column")
-        kc = by_id[e.name]
-        # raw TEXT arrives as int64 row surrogates whose numeric order is
-        # row id, not string order — and any key type must be known
-        # host-orderable BEFORE paying the pass loop
-        if getattr(kc, "raw_ref", None) is not None \
-                or getattr(kc, "raw_chain", None) is not None:
-            raise NotSpillable("sort key is raw-encoded text")
-        keyspec.append((e.name, bool(desc),
-                        bool(desc) if nf is None else bool(nf)))
+    keyspec = _host_sort_spec(sort, out_cols)
     candidates = [t for t in spill_candidate_tables(sort.child)
                   if not t.startswith("@") and count_scans(plan, t) == 1]
     if not candidates:
@@ -640,18 +683,7 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
     cols, valids = _collect_passes(out_cols, runs)
     _charge_spill(cols, valids, "sorted-runs")
 
-    # one stable ascending lexsort; keys minor->major, so reverse the SQL
-    # key order and emit each key's (enc, null-class) pair in that order
-    lex: list[np.ndarray] = []
-    for name, desc, nf in reversed(keyspec):
-        enc = _sortable_host_key(cols[name], valids[name], desc, nf)
-        if enc is None:
-            raise NotSpillable("sort key host representation does not order")
-        lex.extend(enc)
-    perm = np.lexsort(lex)
-    cols = {k: v[perm] for k, v in cols.items()}
-    valids = {k: (v[perm] if v is not None else None)
-              for k, v in valids.items()}
+    cols, valids = _host_lexsort(cols, valids, keyspec)
     if limit_node is not None:
         lo = limit_node.offset
         hi = None if limit_node.limit is None else lo + limit_node.limit
@@ -675,6 +707,237 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
         res.stats.pop("node_rows", None)
         _merge_node_rows(res, runs, {})
     return res, npasses
+
+
+def _window_spill_point(plan: Motion):
+    """-> (window, sort_node, limit_node) when the below-gather spine is
+    [Limit?] [Sort?] [Project|Filter]* Window(partitioned) — the
+    window-spill shape. None otherwise. Sort/Limit lift to the host
+    merge (row order is the only thing they change); Project/Filter are
+    row-wise and union-distributive, so they run inside every bucket."""
+    node = plan.child
+    sort_node = limit_node = None
+    while isinstance(node, _WRAPPERS):
+        if isinstance(node, Limit):
+            if limit_node is not None or sort_node is not None:
+                return None    # a Limit BELOW a Sort truncates pre-order
+            limit_node = node
+        elif isinstance(node, Sort):
+            if sort_node is not None:
+                return None
+            sort_node = node
+        node = node.child
+    if not isinstance(node, Window) or getattr(node, "global_mode", False) \
+            or not node.partition_keys:
+        return None
+    return node, sort_node, limit_node
+
+
+def spill_window_run(executor, plan: Motion, consts, out_cols, raw: bool,
+                     instrument: bool = False):
+    """Window-partition spill: a window whose working set exceeds the
+    admission limit completes by partitioning the PARTITION BY hash
+    space into passes — exactly the DISTINCT spill's recursive-merge
+    regime, but the bucketed unit is a whole window computation.
+
+    Soundness: window functions depend ONLY on rows of their own
+    partition, and a hash of the PARTITION BY keys puts every row of a
+    partition in the same bucket — so running the window per disjoint
+    bucket and unioning the outputs is exact (execHHashagg.c's batch
+    partitioning, applied to nodeWindowAgg.c's input).
+
+    Three phases:
+      1. capture — chunked passes over the biggest base table(s) gather
+         the window's INPUT rows (the subtree below its Redistribute) to
+         the host: per-pass working set is chunk-sized (host RAM is the
+         workfile);
+      2. window passes — captured rows bucket by hash(PARTITION BY) % K;
+         each bucket restages as an ephemeral host table, redistributes
+         by the partition keys, and runs the window + its row-wise
+         wrappers on device;
+      3. finalize — any Sort above the window merges on the host over
+         the unioned bucket outputs (the spill_sort_run lexsort), then
+         LIMIT/OFFSET trims once."""
+    settings = executor.settings
+    if not bool(getattr(settings, "window_spill_enabled", True)):
+        raise NotSpillable("window spill disabled (window_spill_enabled)")
+    if not isinstance(plan, Motion) or plan.kind is not MotionKind.GATHER:
+        raise NotSpillable("window spill needs a gathered result")
+    point = _window_spill_point(plan)
+    if point is None:
+        raise NotSpillable("no partitioned window at the spill point")
+    window, sort_node, limit_node = point
+    if not all(isinstance(e, E.ColRef) for e in window.partition_keys):
+        raise NotSpillable("window partition keys are not plain columns")
+    keyspec = (_host_sort_spec(sort_node, out_cols)
+               if sort_node is not None else None)
+    child = window.child
+    subtree = (child.child if isinstance(child, Motion)
+               and child.kind is MotionKind.REDISTRIBUTE else child)
+    sub_cols = []
+    for c in subtree.out_cols():
+        if getattr(c, "raw_ref", None) is not None \
+                or getattr(c, "raw_chain", None) is not None:
+            raise NotSpillable("window input carries raw-encoded text")
+        # name == id: host staging maps aux columns by storage NAME
+        sub_cols.append(ColInfo(c.id, c.type, c.id, c.dict_ref))
+    sub_ids = {c.id for c in sub_cols}
+    key_ids = [e.name for e in window.partition_keys]
+    if not set(key_ids) <= sub_ids:
+        raise NotSpillable("window partition keys are not captured "
+                           "input columns")
+
+    from greengage_tpu.exec import staging as _staging
+    from greengage_tpu.exec.compile import Compiler
+    from greengage_tpu.exec.executor import effective_limit_bytes
+
+    limit_bytes = effective_limit_bytes(settings)
+    store = executor.store
+
+    # ---- phase 1: chunked capture of the window's input rows ---------
+    capture = PartialState(subtree, sub_cols)
+    capture.locus = subtree.locus
+    capture.est_rows = subtree.est_rows
+    pass_plan = Motion(MotionKind.GATHER, capture)
+    pass_plan.locus = Locus.entry()
+    candidates = [t for t in spill_candidate_tables(subtree)
+                  if not t.startswith("@") and count_scans(plan, t) == 1]
+    if not candidates:
+        raise NotSpillable("no partitionable table below the window")
+    chosen, per_table, nchunks, comp = _size_chunk_passes(
+        executor, consts, pass_plan, candidates, limit_bytes)
+    grids = [[(t, (i * c, (i + 1) * c)) for i in range(n)]
+             for t, c, n in per_table]
+    caps = {t: c for t, c, _ in per_table}
+    combos = list(itertools.product(*grids))
+    prefetcher = _staging.PassPrefetcher(
+        executor, comp.input_spec, store.manifest.snapshot())
+    pass_results = []
+    try:
+        for i, combo in enumerate(combos):
+            interrupt.check_interrupts()   # spill pass boundary
+            if i + 1 < len(combos):
+                prefetcher.kick()
+            with _trace.span("spill-pass", cat="spill", index=i,
+                             total=len(combos), phase="capture"):
+                pass_results.append(executor.run_single(
+                    pass_plan, consts, sub_cols, raw=True,
+                    scan_cap_override=caps,
+                    row_ranges=dict(combo), no_direct=True,
+                    instrument=instrument))
+    finally:
+        prefetcher.close()
+    aux_cols, aux_valids = _collect_passes(sub_cols, pass_results)
+    _charge_spill(aux_cols, aux_valids, "window-input")
+
+    # ---- phase 2: window over PARTITION BY hash buckets --------------
+    aux_name = "@spill:window"
+    host_scan = Scan(aux_name, list(sub_cols))
+    host_scan.locus = Locus.strewn(executor.nseg)
+    host_scan.est_rows = float(len(next(iter(aux_cols.values()), [])))
+    key_cols = {c.id: c for c in sub_cols}
+    m = Motion(MotionKind.REDISTRIBUTE, host_scan,
+               hash_exprs=[E.ColRef(k, key_cols[k].type) for k in key_ids])
+    m.locus = Locus.hashed(tuple(key_ids), executor.nseg)
+    m.est_rows = host_scan.est_rows
+    node_map: dict = {}
+
+    def rebuild(nd):
+        if nd is window:
+            w = copy.copy(window)
+            node_map[id(w)] = id(window)
+            w.child = m
+            w.locus = m.locus
+            return w
+        if nd is sort_node or nd is limit_node:
+            return rebuild(nd.child)
+        clone = copy.copy(nd)
+        node_map[id(clone)] = id(nd)
+        clone.child = rebuild(nd.child)
+        return clone
+
+    bucket_plan = Motion(MotionKind.GATHER, rebuild(plan.child))
+    bucket_plan.locus = Locus.entry()
+    if bool(getattr(settings, "plan_validate", True)):
+        # the bucket plan is a real plan: machine-check the spill shape
+        # (hashed-on-partition-keys window, motion boundary) like any
+        # other statement before paying K dispatches
+        from greengage_tpu.analysis.plancheck import validate_plan
+
+        validate_plan(bucket_plan, executor.catalog)
+
+    h = _bucket_hash(aux_cols, aux_valids, key_ids)
+    K = 1
+    while True:
+        mk = (h % np.uint32(max(K, 1))) == 0
+        sub = {k: np.asarray(v)[mk] for k, v in aux_cols.items()}
+        subv = {k: (np.asarray(v, bool)[mk] if v is not None else None)
+                for k, v in aux_valids.items()}
+        bcomp = Compiler(executor.catalog, store, executor.mesh,
+                         executor.nseg, consts, settings,
+                         aux_tables={aux_name: (sub, subv)},
+                         no_direct=True).compile(bucket_plan)
+        if bcomp.est_bytes <= max(limit_bytes, 1) * 0.9 or K >= 64:
+            break
+        K *= 2
+    if bcomp.est_bytes > limit_bytes:
+        raise NotSpillable(
+            "per-bucket window working set still exceeds the limit at 64 "
+            "partition buckets")
+    bucket = h % np.uint32(K)
+
+    bucket_results = []
+    for bkt in range(K):
+        interrupt.check_interrupts()   # window bucket boundary
+        mk = bucket == bkt
+        if bkt > 0 and not mk.any():
+            continue    # bucket 0 always runs (result schema base)
+        sub = {k: np.asarray(v)[mk] for k, v in aux_cols.items()}
+        subv = {k: (np.asarray(v, bool)[mk] if v is not None else None)
+                for k, v in aux_valids.items()}
+        with _trace.span("spill-pass", cat="spill", index=bkt, total=K,
+                         phase="window"):
+            bucket_results.append(executor.run_single(
+                bucket_plan, consts, out_cols, raw=raw,
+                aux_tables={aux_name: (sub, subv)}, no_direct=True,
+                instrument=instrument))
+    cols, valids = _collect_passes(out_cols, bucket_results)
+    _charge_spill(cols, valids, "window-output")
+
+    # ---- phase 3: host ordering + limit ------------------------------
+    if keyspec is not None:
+        cols, valids = _host_lexsort(cols, valids, keyspec)
+    if limit_node is not None:
+        lo = limit_node.offset
+        hi = None if limit_node.limit is None else lo + limit_node.limit
+        cols = {k: v[lo:hi] for k, v in cols.items()}
+        valids = {k: (v[lo:hi] if v is not None else None)
+                  for k, v in valids.items()}
+
+    from greengage_tpu.exec.executor import Result
+
+    base = bucket_results[0]
+    res = Result(columns=base.columns, cols=cols, valids=valids,
+                 _order=list(base._order), stats=dict(base.stats or {}))
+    res.stats["spill_kind"] = "window"
+    res.stats["spill_window_buckets"] = K
+    if instrument:
+        # per-node rows: capture passes share the ORIGINAL subtree's node
+        # objects; bucket programs run clones remapped via node_map. Drop
+        # bucket 0's counts inherited through base.stats first.
+        res.stats.pop("node_rows", None)
+        agg: dict = {}
+        for r in pass_results:
+            for nid, nr in (((r.stats or {}).get("node_rows")) or {}).items():
+                agg[nid] = agg.get(nid, 0) + nr
+        for r in bucket_results:
+            for nid, nr in (((r.stats or {}).get("node_rows")) or {}).items():
+                nid = node_map.get(nid, nid)
+                agg[nid] = agg.get(nid, 0) + nr
+        res.stats["node_rows"] = agg
+    counters.inc("window_spill_runs")
+    counters.inc("window_spill_passes", nchunks + K)
+    return res, nchunks + K
 
 
 def _replace_child(plan: Plan, target: Plan, repl: Plan,

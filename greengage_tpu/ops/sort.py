@@ -37,6 +37,27 @@ class SortKey:
     rank_lut: jnp.ndarray | None = None  # TEXT collation ranks
 
 
+def encode_key64(v, desc: bool, kind: str) -> jnp.ndarray:
+    """Order-preserving uint64 encoding of one order-key column — the
+    SINGLE source of the sign-flip / IEEE-monotone transform, shared by
+    the multi-operand sort (_order_encode), the full64 ordered-global
+    window ranks, and the range-repartition Motion routing
+    (exec/compile.py) so the encodings can never drift apart.
+    ``kind``: "float" (IEEE trick, negatives bit-inverted) or "int"
+    (two's-complement sign flip); DESC = bitwise NOT."""
+    if kind == "float":
+        bits = v.astype(jnp.float64).view(jnp.uint64)
+        sign = bits >> jnp.uint64(63)
+        enc = jnp.where(sign == 1, ~bits,
+                        bits | jnp.uint64(1) << jnp.uint64(63))
+    else:
+        enc = v.astype(jnp.int64).view(jnp.uint64) \
+            ^ (jnp.uint64(1) << jnp.uint64(63))
+    if desc:
+        enc = ~enc
+    return enc
+
+
 def _order_encode(k: SortKey) -> list[jnp.ndarray]:
     """-> sort operands for this key: [null_order?, encoded_values]."""
     t: T.SqlType = k.type
@@ -46,14 +67,8 @@ def _order_encode(k: SortKey) -> list[jnp.ndarray]:
             raise ValueError("text sort key requires rank LUT")
         idx = jnp.where(v < 0, k.rank_lut.shape[0] - 1, v)
         v = k.rank_lut[idx]
-    if t.kind is T.Kind.FLOAT64:
-        bits = v.view(jnp.uint64)
-        sign = bits >> jnp.uint64(63)
-        enc = jnp.where(sign == 1, ~bits, bits | jnp.uint64(1) << jnp.uint64(63))
-    else:
-        enc = v.astype(jnp.int64).view(jnp.uint64) ^ (jnp.uint64(1) << jnp.uint64(63))
-    if k.desc:
-        enc = ~enc
+    enc = encode_key64(
+        v, k.desc, "float" if t.kind is T.Kind.FLOAT64 else "int")
     ops = [enc]
     if k.valid is not None:
         nulls_first = k.nulls_first if k.nulls_first is not None else k.desc

@@ -38,6 +38,21 @@ class WinFunc:
     param: int | None = None   # lag/lead offset, ntile buckets
 
 
+def ntile_bucket(rn, cnt, param):
+    """PG ntile bucket (1-based) from a 0-based position within the
+    partition and the partition row count — the ONE formula shared by
+    the segment-local kernel below and the global ordered/range window
+    kernels (exec/compile.py), so the bucket arithmetic can't drift."""
+    nb = jnp.int64(param)
+    q, r = cnt // nb, cnt % nb
+    big = r * (q + 1)
+    bucket = jnp.where(rn < big,
+                       rn // jnp.maximum(q + 1, 1),
+                       r + (rn - big) // jnp.maximum(q, 1))
+    # more buckets than rows: bucket = rn
+    return jnp.where(q == 0, jnp.minimum(rn, nb - 1), bucket) + 1
+
+
 def _starts(boundary, idx):
     """Monotone start-index array: for each row, the index of the first row
     of its group (boundary True marks group firsts)."""
@@ -119,16 +134,7 @@ def compute(partition_eq_prev, peer_eq_prev, sel_sorted, funcs: list[WinFunc],
         if f.func == "ntile":
             cnt_p = (p_end - p_start + 1).astype(jnp.int64)
             rn = (idx - p_start).astype(jnp.int64)
-            nb = jnp.int64(f.param)
-            q, r = cnt_p // nb, cnt_p % nb
-            big = r * (q + 1)
-            bucket = jnp.where(
-                rn < big,
-                rn // jnp.maximum(q + 1, 1),
-                r + (rn - big) // jnp.maximum(q, 1))
-            # more buckets than rows: bucket = rn
-            bucket = jnp.where(q == 0, jnp.minimum(rn, nb - 1), bucket)
-            out_vals[f.name] = bucket + 1
+            out_vals[f.name] = ntile_bucket(rn, cnt_p, f.param)
             out_valid[f.name] = None
             continue
         if f.func in ("lag", "lead"):

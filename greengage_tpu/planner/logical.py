@@ -223,6 +223,13 @@ class Motion(Plan):
     child: Plan
     hash_exprs: list[E.Expr] = field(default_factory=list)  # REDISTRIBUTE only
     merge_keys: list | None = None  # GATHER: preserve this sort order
+    # range repartition (REDISTRIBUTE only): rows route by sampled-splitter
+    # ranges of ONE order-preserving encoded key instead of its hash, so
+    # each segment owns a contiguous key range (equal keys co-locate) —
+    # the gather-free ordered-global window path for keys that cannot pack
+    # into the uint64 rank space (exec/compile.py _c_motion range branch).
+    # {"expr", "desc", "nulls_first", "kind": "int"|"float"}
+    range_spec: dict | None = None
 
     def out_cols(self):
         return self.child.out_cols()
@@ -247,8 +254,14 @@ def describe(plan: Plan, indent: int = 0, annot: dict | None = None) -> str:
         extra = f" {plan.kind}"
     elif isinstance(plan, Motion):
         extra = f" {plan.kind.value}"
+        if plan.range_spec is not None:
+            extra += " range"
         if plan.hash_exprs:
             extra += f" by ({', '.join(_expr_str(e) for e in plan.hash_exprs)})"
+    elif isinstance(plan, Window):
+        gm = getattr(plan, "global_mode", False)
+        if gm:
+            extra = f" global={'all' if gm is True else gm}"
     elif isinstance(plan, Aggregate):
         extra = f" {plan.phase} keys=({', '.join(c.name for c, _ in plan.group_keys)})"
     elif isinstance(plan, Limit):

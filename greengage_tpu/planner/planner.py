@@ -27,6 +27,7 @@ from greengage_tpu.planner.logical import (
     Aggregate, ColInfo, Filter, Join, Limit, Motion, MotionKind, Plan, Project,
     Scan, Sort, Union, Window,
 )
+from greengage_tpu.runtime.logger import counters
 
 
 def _param_value(e) -> E.Expr | None:
@@ -653,17 +654,30 @@ class Planner:
         node.est_rows = sum(c.est_rows for c in node.inputs)
         return node
 
+    # unordered global windows: every function is a whole-mesh collective
+    GLOBAL_DIST = {"row_number", "count", "sum", "avg", "min", "max",
+                   "first_value", "last_value"}
+    # ordered global windows computable IN PLACE from all-gathered sorted
+    # key runs: ranks are counted positions, ntile is arithmetic on
+    # (rank, count), lag/lead/first/last resolve rank±offset via a lookup
+    # into the gathered runs — rows never move
+    ORDERED_GLOBAL = {"row_number", "rank", "dense_rank", "ntile",
+                      "lag", "lead", "first_value", "last_value"}
+    # range-repartitioned global windows (one balanced Redistribute by
+    # sampled splitters of the leading key; segments own contiguous key
+    # ranges, so peer groups are whole per segment and running aggregates
+    # stitch with per-segment prefix totals)
+    RANGE_GLOBAL = ORDERED_GLOBAL | {"sum", "count", "avg", "min", "max"}
+
     def _plan_window(self, node: Window) -> Plan:
         node.child = self._rec(node.child)
         child = node.child
         key_ids = tuple(e.name for e in node.partition_keys
                         if isinstance(e, E.ColRef))
-        GLOBAL_DIST = {"row_number", "count", "sum", "avg", "min", "max"}
-        ORDERED_GLOBAL = {"row_number", "rank", "dense_rank"}
         if not node.partition_keys:
             if (not node.order_keys and node.frame is None
                     and child.locus.is_partitioned
-                    and all(f[1] in GLOBAL_DIST for f in node.wfuncs)):
+                    and all(f[1] in self.GLOBAL_DIST for f in node.wfuncs)):
                 # unordered global window: the whole table is one
                 # partition, so every function is a mesh collective —
                 # rows stay in place instead of funneling to one chip
@@ -671,27 +685,55 @@ class Planner:
                 node.global_mode = True
                 node.locus = child.locus
                 node.est_rows = child.est_rows
+                counters.inc("window_gather_free_total")
                 return node
             if (node.order_keys and node.frame is None
-                    and child.locus.is_partitioned
-                    and all(f[1] in ORDERED_GLOBAL for f in node.wfuncs)):
-                # ordered global ranking (row_number/rank/dense_rank) over
-                # integer/date keys: each row's global rank is computable
-                # IN PLACE from all-gathered per-segment sorted key runs —
-                # no funnel, no row motion. Multi-key and nullable shapes
-                # pack keys into one uint64 using EXACT storage bounds
-                # from block zone maps (+1 null bit per key); a single key
-                # without usable bounds falls back to the full-64-bit
-                # encoding with runtime NULL classes (see compile)
-                spec = self._ordered_global_spec(child, node.order_keys)
-                if spec is not None:
-                    node.global_mode = "ordered"
-                    node.gkey_spec = spec
-                    node.locus = child.locus
-                    node.est_rows = child.est_rows
-                    return node
-            # ordered / exotic global window: all rows to a single segment
+                    and child.locus.is_partitioned):
+                if all(f[1] in self.ORDERED_GLOBAL for f in node.wfuncs):
+                    # ordered global ranking family over integer/date/
+                    # decimal keys: each row's global rank AND the global
+                    # row count are computable IN PLACE from all-gathered
+                    # per-segment sorted key runs — no funnel, no row
+                    # motion. Multi-key and nullable shapes pack keys into
+                    # one uint64 using EXACT storage bounds from block
+                    # zone maps (+1 null bit per key); a single key
+                    # without usable bounds falls back to the full-64-bit
+                    # encoding with runtime NULL classes (see compile)
+                    spec = self._ordered_global_spec(child, node.order_keys)
+                    if spec is not None:
+                        node.global_mode = "ordered"
+                        node.gkey_spec = spec
+                        node.locus = child.locus
+                        node.est_rows = child.est_rows
+                        counters.inc("window_gather_free_total")
+                        return node
+                if all(f[1] in self.RANGE_GLOBAL for f in node.wfuncs):
+                    # keys that cannot pack into the uint64 rank space
+                    # (multi-key over wide domains, float keys, running
+                    # aggregates): range-repartition by sampled splitters
+                    # of the LEADING key — one balanced Redistribute
+                    # instead of the one-chip funnel. Equal leading keys
+                    # co-locate, so peer groups stay whole per segment and
+                    # the segment-local kernels stitch with per-segment
+                    # offsets (exec/compile.py _c_window_global_range)
+                    rspec = self._range_window_spec(node.order_keys)
+                    if rspec is not None:
+                        m = Motion(MotionKind.REDISTRIBUTE, child,
+                                   hash_exprs=[rspec["expr"]])
+                        m.range_spec = rspec
+                        m.locus = Locus.strewn(self.nseg)
+                        m.est_rows = child.est_rows
+                        node.child = m
+                        node.global_mode = "range"
+                        node.gkey_spec = {"mode": "range", **rspec}
+                        node.locus = m.locus
+                        node.est_rows = child.est_rows
+                        counters.inc("window_gather_free_total")
+                        return node
+            # exotic global window (explicit frames, unsupported key or
+            # function shapes): all rows to a single segment
             if child.locus.is_partitioned:
+                counters.inc("window_funnel_total")
                 const = E.Literal(0, T.INT64)
                 m = Motion(MotionKind.REDISTRIBUTE, child, hash_exprs=[const])
                 m.locus = Locus(LocusKind.SINGLE_QE, (), self.nseg)
@@ -706,6 +748,25 @@ class Planner:
         node.est_rows = child.est_rows
         return node
 
+    _RANGE_KINDS = (T.Kind.INT32, T.Kind.INT64, T.Kind.DATE, T.Kind.DECIMAL,
+                    T.Kind.FLOAT64)
+
+    def _range_window_spec(self, order_keys):
+        """Sampled-splitter range-repartition spec from the LEADING order
+        key, or None. The key only needs an order-preserving uint64
+        encoding (sign-flip ints / IEEE floats) — no bounds, no packing:
+        routing by range just needs comparisons, and the local sort above
+        handles the full key list with the general multi-operand path."""
+        e, desc, nf = order_keys[0]
+        if e.type.kind not in self._RANGE_KINDS \
+                and not getattr(e, "_rank_space", False):
+            return None
+        if nf is None:
+            nf = bool(desc)
+        kind = "float" if e.type.kind is T.Kind.FLOAT64 else "int"
+        return {"expr": e, "desc": bool(desc), "nulls_first": bool(nf),
+                "kind": kind}
+
     def _ordered_global_spec(self, child: Plan, order_keys):
         """Distribution spec for in-place global ranking, or None (-> the
         one-chip funnel). Reference never funnels — it sorts distributed
@@ -714,14 +775,15 @@ class Planner:
         = a counted position over all-gathered sorted key runs.
 
         PG null placement applies: NULLS LAST asc / FIRST desc unless
-        explicit. `packed` needs every key to be an INT32/INT64/DATE
-        ColRef with exact zone-map bounds and total width <= 64 bits;
-        `full64` handles ONE key of any such expression with no bounds at
-        all (runtime NULL classes)."""
-        INTISH = (T.Kind.INT32, T.Kind.INT64, T.Kind.DATE)
+        explicit. `packed` needs every key to be an INT32/INT64/DATE/
+        DECIMAL ColRef with exact zone-map bounds and total width <= 64
+        bits; `full64` handles ONE key of any such expression — or a
+        FLOAT64 one (IEEE monotone encoding) — with no bounds at all
+        (runtime NULL classes)."""
+        INTISH = (T.Kind.INT32, T.Kind.INT64, T.Kind.DATE, T.Kind.DECIMAL)
         resolved = []
         for e, desc, nf in order_keys:
-            if e.type.kind not in INTISH \
+            if e.type.kind not in INTISH + (T.Kind.FLOAT64,) \
                     and not getattr(e, "_rank_space", False):
                 return None   # rank-space TEXT keys are bounded ints
             if nf is None:
@@ -732,6 +794,8 @@ class Planner:
         for e, desc, nf in resolved:
             if getattr(e, "_rank_space", False):
                 bounds = (0, (1 << e._rank_bits) - 1)
+            elif e.type.kind is T.Kind.FLOAT64:
+                bounds = None   # floats never pack; full64 handles one
             else:
                 org = _origin(child, e.name) if isinstance(e, E.ColRef) \
                     else None
@@ -749,7 +813,9 @@ class Planner:
         if len(resolved) == 1:
             e, desc, nf = resolved[0]
             return {"mode": "full64", "expr": e, "desc": desc,
-                    "nulls_first": nf}
+                    "nulls_first": nf,
+                    "kind": ("float" if e.type.kind is T.Kind.FLOAT64
+                             else "int")}
         return None
 
     def _plan_sort(self, node: Sort) -> Plan:

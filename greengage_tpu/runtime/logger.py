@@ -83,6 +83,12 @@ COUNTER_NAMES = (
     "batch_dispatch_total", "batch_members_total",
     "batch_window_flush_full", "batch_window_flush_timer",
     "batch_fallback_total",
+    # window engine (planner/planner.py, exec/spill.py): plans kept
+    # gather-free (global collective / packed-rank / range-repartition
+    # modes) vs plans that still took the one-chip SingleQE funnel, and
+    # window-partition spill activity (runs + capture/bucket passes)
+    "window_gather_free_total", "window_funnel_total",
+    "window_spill_runs", "window_spill_passes",
 )
 
 HISTOGRAM_NAMES = (

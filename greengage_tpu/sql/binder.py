@@ -524,7 +524,10 @@ class Binder:
     _WINFUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
                  "min", "max", "lag", "lead", "first_value", "last_value",
                  "ntile"}
-    _WIN_NEED_ORDER = {"lag", "lead", "first_value", "last_value", "ntile"}
+    # first_value/last_value are legal WITHOUT order by in PostgreSQL
+    # (whole-frame semantics: the frame is the entire partition) — only
+    # position-offset functions truly need an ordering
+    _WIN_NEED_ORDER = {"lag", "lead", "ntile"}
 
     def _bind_windows(self, stmt, plan, scope):
         from greengage_tpu.planner.logical import Window
@@ -556,10 +559,12 @@ class Binder:
         rewrites: dict = {}
         for fcs in groups.values():
             spec = fcs[0].over
-            pkeys = [self._no_raw(self._expr(p, scope), "window partition key")
+            pkeys = [self._no_raw(self._win_raw_key(self._expr(p, scope)),
+                                  "window partition key")
                      for p in spec.partition_by]
             okeys = [(self._win_order_key(
-                          self._no_raw(self._expr(oi.expr, scope),
+                          self._no_raw(self._win_raw_key(
+                              self._expr(oi.expr, scope)),
                                        "window order key")),
                       oi.desc, oi.nulls_first)
                      for oi in spec.order_by]
@@ -587,7 +592,10 @@ class Binder:
                 elif fname in ("lag", "lead"):
                     if not fc.args:
                         raise SqlError(f"{fname}() requires an argument")
-                    arg = self._expr(fc.args[0], scope)
+                    # raw-TEXT args ride the transient dictionary: the
+                    # function only moves the value, codes decode at
+                    # finalize like any dict column
+                    arg = self._win_raw_key(self._expr(fc.args[0], scope))
                     k = (self._win_int_param(fc, 1, fname)
                          if len(fc.args) > 1 else 1)
                     if k < 0:
@@ -604,7 +612,7 @@ class Binder:
                 elif fname in ("first_value", "last_value"):
                     if not fc.args:
                         raise SqlError(f"{fname}() requires an argument")
-                    arg = self._expr(fc.args[0], scope)
+                    arg = self._win_raw_key(self._expr(fc.args[0], scope))
                     rtype = arg.type
                 elif fc.star or not fc.args:
                     if fname != "count":
@@ -1592,6 +1600,16 @@ class Binder:
             kind = strfuncs.SPECS[step[0]][2]
             coded = self._lower_str_step(coded, tuple(step), kind)
         return coded
+
+    def _win_raw_key(self, e: E.Expr) -> E.Expr:
+        """Raw-TEXT window partition/order keys re-code into the column's
+        transient per-version dictionary (the same service ORDER BY uses,
+        _raw_to_codes) — the device then sees bounded int32 codes with
+        full dictionary services, so `ntile(4) over (order by
+        raw_text_col)` rides the gather-free rank machinery instead of
+        being rejected (or funneled) as raw."""
+        conv = self._raw_to_codes(e)
+        return conv if conv is not None else e
 
     def _win_order_key(self, e: E.Expr) -> E.Expr:
         """Dict-TEXT window order keys re-code into RANK space at bind

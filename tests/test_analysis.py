@@ -156,6 +156,79 @@ def test_capacity_bucketing_enforced(db):
     assert ei.value.invariant == "I7"
 
 
+def test_window_ordered_global_spec_enforced(db):
+    """I5 negative (ISSUE 12): an ordered-global window stripped of its
+    gkey_spec — or carrying an over-budget packed spec — is refused."""
+    from greengage_tpu.planner.logical import Window
+
+    q = ("select o_orderkey, ntile(4) over (order by o_orderkey) nt "
+         "from orders")
+    planned, _, _ = db._plan(parse(q)[0])
+    win = _find(planned, lambda p: isinstance(p, Window))
+    assert win is not None and win.global_mode == "ordered"
+    validate_plan(planned, db.catalog)
+    spec = win.gkey_spec
+    win.gkey_spec = None
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I5"
+    # over-budget packed fields: the uint64 claim is false
+    win.gkey_spec = {"mode": "packed",
+                     "fields": [dict(f, bits=40) for f in spec["fields"]]
+                     + [dict(spec["fields"][0], bits=40)]}
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I5"
+
+
+def test_window_global_above_funnel_rejected(db):
+    """I3 negative: a global-mode window sitting above a SingleQE funnel
+    claims gather-freedom it does not have."""
+    from greengage_tpu import expr as E
+    from greengage_tpu import types as T
+    from greengage_tpu.planner.locus import Locus as L
+    from greengage_tpu.planner.logical import Window
+
+    q = ("select o_orderkey, ntile(4) over (order by o_orderkey) nt "
+         "from orders")
+    planned, _, _ = db._plan(parse(q)[0])
+    win = _find(planned, lambda p: isinstance(p, Window))
+    funnel = Motion(MotionKind.REDISTRIBUTE, win.child,
+                    hash_exprs=[E.Literal(0, T.INT64)])
+    funnel.locus = L(LocusKind.SINGLE_QE, (), db.numsegments)
+    funnel.est_rows = win.child.est_rows
+    win.child = funnel
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I3"
+
+
+def test_window_range_mode_needs_range_motion(db):
+    """I5 negative: a range-mode window whose child lost its range
+    Redistribute no longer owns whole key ranges."""
+    from greengage_tpu.planner.logical import Window
+
+    q = ("select o_orderkey, sum(o_totalprice) over "
+         "(order by o_totalprice, o_orderkey) rs from orders")
+    planned, _, _ = db._plan(parse(q)[0])
+    win = _find(planned, lambda p: isinstance(p, Window))
+    assert win is not None and win.global_mode == "range", win
+    validate_plan(planned, db.catalog)
+    moved = win.child
+    assert isinstance(moved, Motion) and moved.range_spec is not None
+    win.child = moved.child          # splice the range motion out
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I5"
+    # a range Redistribute claiming a HASHED landing is an I2 violation
+    win.child = moved
+    moved.locus = Locus.hashed((moved.hash_exprs[0].name,),
+                               db.numsegments)
+    with pytest.raises(PlanInvariantError) as ei:
+        validate_plan(planned, db.catalog)
+    assert ei.value.invariant == "I2"
+
+
 # ---------------------------------------------------------------------
 # the plan_validate GUC hook
 # ---------------------------------------------------------------------

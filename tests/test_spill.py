@@ -54,12 +54,30 @@ def test_spill_scalar_aggregate(db):
 
 
 def test_unspillable_shape_still_rejected(db):
-    # per-partition window over the whole table: no reduction point, no
-    # sort at the gather — honest rejection
+    # explicit-frame GLOBAL window: funneled to SingleQE, no reduction
+    # point, no partition keys to bucket, no sort at the gather — honest
+    # rejection (partitioned windows now spill; tests/test_window_spill.py)
     db.sql("set vmem_protect_limit_mb = 1")
     try:
         with pytest.raises(QueryError, match="not spillable|above vmem"):
-            db.sql("select k, sum(v) over (partition by k) from big")
+            db.sql("select k, sum(v) over (order by v, k rows between "
+                   "1 preceding and current row) from big")
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+
+
+def test_window_partition_spill_replaces_rejection(db):
+    """The shape the pre-window-spill engine rejected (ISSUE 12): a
+    per-partition window over the whole table completes via PARTITION BY
+    hash-bucket passes, exactly (full matrix in test_window_spill.py)."""
+    q = "select k, sum(v) over (partition by fk) s from big"
+    want = sorted(db.sql(q).rows())
+    db.sql("set vmem_protect_limit_mb = 4")
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+        assert r.stats.get("spill_passes", 0) >= 2, r.stats
+        assert sorted(r.rows()) == want
     finally:
         db.sql("set vmem_protect_limit_mb = 12288")
 

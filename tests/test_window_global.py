@@ -1,0 +1,474 @@
+"""Gather-free ordered-global windows (ISSUE 12): ntile/lag/lead/
+first_value/last_value via the packed-key all-gather rank machinery,
+raw-TEXT order keys via transient-dictionary rank space, sampled-splitter
+range repartition for keys that cannot pack, and whole-frame
+first_value/last_value without ORDER BY — all oracle-checked vs pandas
+and plan-checked gather-free (`gg check` I3/I5)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.analysis.plancheck import validate_plan
+from greengage_tpu.planner.logical import Motion, MotionKind, Window, describe
+from greengage_tpu.sql.parser import parse
+
+
+def _planned(db, q):
+    planned, _, _ = db._plan(parse(q)[0])
+    return planned
+
+
+def _assert_gather_free(db, q):
+    """The root Gather is the ONLY Gather and nothing funnels to one
+    chip; the plan also passes the machine checks (I1-I6)."""
+    planned = _planned(db, q)
+    txt = describe(planned)
+    assert txt.count("Gather") == 1, txt
+    assert "SingleQE" not in txt, txt
+    validate_plan(planned, db.catalog)
+    return planned
+
+
+def _pg_ntile(pos, n, k):
+    q, r = divmod(n, k)
+    big = r * (q + 1)
+    if q == 0:
+        return min(pos, k - 1) + 1
+    return (pos // (q + 1) if pos < big else r + (pos - big) // q) + 1
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    rng = np.random.default_rng(21)
+    n = 400
+    d.sql("create table s (k int, d int, v int, f double precision, "
+          "nv int) distributed by (k)")
+    nv = rng.integers(0, 90, n).astype(np.float64)
+    nv[rng.random(n) < 0.15] = np.nan
+    d.df = pd.DataFrame({
+        "k": np.arange(n),
+        "d": rng.integers(0, 40, n),         # ties
+        "v": rng.integers(0, 1000, n),
+        "f": np.round(rng.random(n), 6),
+        "nv": nv,
+    })
+    d.load_table("s", {
+        "k": d.df.k.values.astype(np.int32),
+        "d": d.df.d.values.astype(np.int32),
+        "v": d.df.v.values.astype(np.int32),
+        "f": d.df.f.values,
+        "nv": np.where(np.isnan(nv), 0, nv).astype(np.int32),
+    }, valids={"nv": ~np.isnan(nv)})
+    d.sql("analyze")
+    yield d
+    d.close()
+
+
+# ---------------------------------------------------------------------
+# ordered-global ntile / lag / lead (all-gather rank machinery)
+# ---------------------------------------------------------------------
+
+def test_ntile_global_unique_key(db):
+    q = "select k, ntile(7) over (order by k) nt from s"
+    _assert_gather_free(db, q)
+    rows = dict(db.sql(q).rows())
+    n = len(db.df)
+    for k, nt in rows.items():
+        assert nt == _pg_ntile(k, n, 7), (k, nt)
+
+
+def test_ntile_global_desc_and_more_buckets_than_rows(db):
+    q = "select k, ntile(1000) over (order by k desc) nt from s"
+    _assert_gather_free(db, q)
+    rows = dict(db.sql(q).rows())
+    n = len(db.df)
+    for k, nt in rows.items():
+        assert nt == _pg_ntile(n - 1 - k, n, 1000), (k, nt)
+
+
+def test_ntile_global_ties_bucket_sizes(db):
+    """Tied keys may permute within adjacent buckets, but bucket SIZES
+    and the key->bucket multiset are fixed by the global order."""
+    q = "select d, ntile(6) over (order by d) nt from s"
+    _assert_gather_free(db, q)
+    rows = db.sql(q).rows()
+    n = len(db.df)
+    sizes = {}
+    for _, nt in rows:
+        sizes[nt] = sizes.get(nt, 0) + 1
+    assert sizes == {b + 1: (n // 6) + (1 if b < n % 6 else 0)
+                     for b in range(6)}
+    # per-position key order must agree with a pandas stable sort
+    want = sorted(db.df.d.values)
+    got = sorted(rows, key=lambda x: (x[1],))
+    # within equal nt the d values are a multiset of the oracle's slice
+    pos = 0
+    for b in range(1, 7):
+        cnt = sizes[b]
+        assert sorted(x[0] for x in got[pos:pos + cnt]) \
+            == sorted(want[pos:pos + cnt])
+        pos += cnt
+
+
+def test_lag_lead_global_unique_key(db):
+    q = ("select k, lag(v) over (order by k) lg, "
+         "lead(v, 3) over (order by k) ld, "
+         "lag(v, 2, -5) over (order by k) lgd from s")
+    _assert_gather_free(db, q)
+    vs = dict(zip(db.df.k, db.df.v))
+    n = len(db.df)
+    for k, lg, ld, lgd in db.sql(q).rows():
+        assert lg == (vs[k - 1] if k >= 1 else None)
+        assert ld == (vs[k + 3] if k + 3 < n else None)
+        assert lgd == (vs[k - 2] if k >= 2 else -5)
+
+
+def test_lag_global_ties_multiset(db):
+    """With tied order keys the row->value mapping is tie-break
+    dependent; the MULTISET of lag values per key group is not."""
+    q = "select d, lag(d) over (order by d) lg from s"
+    _assert_gather_free(db, q)
+    got = {}
+    for d, lg in db.sql(q).rows():
+        got.setdefault(d, []).append(lg)
+    ds = sorted(db.df.d.values)
+    want = {}
+    for i, d in enumerate(ds):
+        want.setdefault(d, []).append(ds[i - 1] if i else None)
+    assert {k: sorted(v, key=lambda x: (x is None, x))
+            for k, v in got.items()} \
+        == {k: sorted(v, key=lambda x: (x is None, x))
+            for k, v in want.items()}
+
+
+def test_lag_lead_global_nullable_keys(db):
+    """NULL order keys form the runtime NULL class (full64): they rank
+    after all values (ASC default) and lag/lead walk straight through
+    the boundary in global position order."""
+    q = ("select k, nv, row_number() over (order by nv) rn, "
+         "lead(k) over (order by nv) ld from s")
+    _assert_gather_free(db, q)
+    rows = sorted(db.sql(q).rows(), key=lambda x: x[2])
+    n = len(db.df)
+    assert [r[2] for r in rows] == list(range(1, n + 1))
+    # nulls last: every non-null nv before every null
+    nulls = [r for r in rows if r[1] is None]
+    assert nulls and all(r[1] is not None for r in rows[:n - len(nulls)])
+    nvs = [r[1] for r in rows[:n - len(nulls)]]
+    assert nvs == sorted(nvs)
+    # lead(k) at global position i returns position i+1's k
+    for i in range(n - 1):
+        assert rows[i][3] == rows[i + 1][0]
+    assert rows[-1][3] is None
+
+
+def test_lag_global_nulls_first_desc(db):
+    q = ("select k, nv, row_number() over (order by nv desc) rn, "
+         "lag(k) over (order by nv desc) lg from s")
+    _assert_gather_free(db, q)
+    rows = sorted(db.sql(q).rows(), key=lambda x: x[2])
+    nn = int(db.df.nv.isna().sum())
+    assert all(r[1] is None for r in rows[:nn])       # nulls first (desc)
+    vals = [r[1] for r in rows[nn:]]
+    assert vals == sorted(vals, reverse=True)
+    for i in range(1, len(rows)):
+        assert rows[i][3] == rows[i - 1][0]
+    assert rows[0][3] is None
+
+
+def test_first_last_value_ordered_global(db):
+    """Default frame: first_value = global partition start, last_value =
+    the row's last PEER."""
+    q = ("select k, first_value(v) over (order by k) f, "
+         "last_value(v) over (order by k) l from s")
+    _assert_gather_free(db, q)
+    vs = dict(zip(db.df.k, db.df.v))
+    for k, f, l in db.sql(q).rows():
+        assert f == vs[0]
+        assert l == vs[k]     # unique keys: each row is its own peer
+
+
+def test_last_value_ordered_global_peers(db):
+    q = ("select d, last_value(d) over (order by d) l, "
+         "first_value(d) over (order by d) f from s")
+    _assert_gather_free(db, q)
+    dmin = int(db.df.d.min())
+    for d, l, f in db.sql(q).rows():
+        assert l == d and f == dmin
+
+
+def test_multikey_packed_ntile_lag(db):
+    q = ("select k, ntile(5) over (order by d, k) nt, "
+         "lag(v) over (order by d, k) lg from s")
+    _assert_gather_free(db, q)
+    order = db.df.sort_values(["d", "k"]).reset_index(drop=True)
+    pos_of = {int(k): i for i, k in enumerate(order.k)}
+    vs = dict(zip(db.df.k, db.df.v))
+    n = len(db.df)
+    for k, nt, lg in db.sql(q).rows():
+        pos = pos_of[k]
+        assert nt == _pg_ntile(pos, n, 5)
+        want = vs[int(order.k[pos - 1])] if pos else None
+        assert lg == want
+
+
+def test_decimal_order_key_gather_free(db):
+    db.sql("create table dec (k int, p decimal(12,2)) distributed by (k)")
+    db.sql("insert into dec values (0, 10.25), (1, 3.50), (2, 99.99), "
+           "(3, 3.49), (4, 50.00)")
+    db.sql("analyze")
+    q = "select k, rank() over (order by p desc) rk, " \
+        "ntile(2) over (order by p desc) nt from dec"
+    _assert_gather_free(db, q)
+    rows = dict((k, (rk, nt)) for k, rk, nt in db.sql(q).rows())
+    assert rows[2][0] == 1 and rows[4][0] == 2 and rows[0][0] == 3
+    assert rows[1][0] == 4 and rows[3][0] == 5
+    assert rows[2][1] == 1 and rows[3][1] == 2
+
+
+def test_float_order_key_full64(db):
+    q = ("select k, row_number() over (order by f) rn, "
+         "lag(k) over (order by f) lg from s")
+    _assert_gather_free(db, q)
+    order = db.df.sort_values("f").reset_index(drop=True)
+    rows = sorted(db.sql(q).rows(), key=lambda x: x[1])
+    assert [r[0] for r in rows] == [int(x) for x in order.k]
+    for i in range(1, len(rows)):
+        assert rows[i][2] == rows[i - 1][0]
+
+
+# ---------------------------------------------------------------------
+# raw-TEXT order keys (acceptance: zero Gather + oracle)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rawdb(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table rt (k int, c text) distributed by (k)")
+    col = d.catalog.get("rt").column("c")
+    object.__setattr__(col, "encoding", "raw")
+    rng = np.random.default_rng(7)
+    strs = [f"w{i:04d}-{j}" for j, i in
+            enumerate(rng.permutation(120))]
+    d.load_table("rt", {"k": np.arange(len(strs), dtype=np.int32),
+                        "c": np.array(strs, dtype=object)})
+    d.sql("analyze")
+    d.strs = strs
+    yield d
+    d.close()
+
+
+def test_ntile_raw_text_plan_golden(rawdb):
+    """THE acceptance shape: `ntile(4) over (order by raw_text_col)`
+    plans with no Gather node but the root — pinned as a golden."""
+    import re
+
+    q = "select c, ntile(4) over (order by c) nt from rt"
+    planned = _assert_gather_free(rawdb, q)
+    txt = re.sub(r" rows=\d+", "", describe(planned))
+    txt = re.sub(r"#\d+", "#N", txt)
+    assert txt == """\
+Motion Gather  [Entry]
+  Project [c=c#N, nt=ntile#N]  [Strewn x4]
+    Window global=ordered  [Strewn x4]
+      Scan rt  [Strewn x4]"""
+    w = planned
+    while not isinstance(w, Window):
+        w = w.children[0]
+    assert w.global_mode == "ordered"
+    assert w.gkey_spec["mode"] == "packed"
+
+
+def test_ntile_lag_raw_text_oracle(rawdb):
+    q = ("select k, ntile(4) over (order by c) nt, "
+         "lag(c) over (order by c) lg from rt")
+    _assert_gather_free(rawdb, q)
+    strs = rawdb.strs
+    order = sorted(range(len(strs)), key=lambda i: strs[i])
+    pos_of = {i: p for p, i in enumerate(order)}
+    n = len(strs)
+    for k, nt, lg in rawdb.sql(q).rows():
+        pos = pos_of[k]
+        assert nt == _pg_ntile(pos, n, 4)
+        assert lg == (strs[order[pos - 1]] if pos else None)
+
+
+def test_raw_text_partition_key(rawdb):
+    rawdb.sql("create table rp (k int, c text, v int) distributed by (k)")
+    col = rawdb.catalog.get("rp").column("c")
+    object.__setattr__(col, "encoding", "raw")
+    strs = ["alpha", "beta", "alpha", "gamma", "beta", "alpha"]
+    rawdb.load_table("rp", {
+        "k": np.arange(6, dtype=np.int32),
+        "c": np.array(strs, dtype=object),
+        "v": np.array([1, 2, 4, 8, 16, 32], dtype=np.int32)})
+    r = rawdb.sql("select c, sum(v) over (partition by c) s from rp")
+    want = {"alpha": 37, "beta": 18, "gamma": 8}
+    for c, s in r.rows():
+        assert s == want[c], (c, s)
+
+
+# ---------------------------------------------------------------------
+# range repartition (keys that cannot pack)
+# ---------------------------------------------------------------------
+
+def _assert_range_mode(db, q):
+    planned = _assert_gather_free(db, q)
+    w = planned
+    while not isinstance(w, Window):
+        w = w.children[0]
+    assert w.global_mode == "range", describe(planned)
+    assert isinstance(w.child, Motion) \
+        and w.child.kind is MotionKind.REDISTRIBUTE \
+        and w.child.range_spec is not None
+    return planned
+
+
+def test_range_mode_running_sum_oracle(db):
+    # (int, float) multi-key cannot pack -> range repartition
+    q = ("select k, sum(v) over (order by d, f, k) rs, "
+         "row_number() over (order by d, f, k) rn, "
+         "rank() over (order by d, f, k) rk, "
+         "dense_rank() over (order by d, f, k) dr from s")
+    _assert_range_mode(db, q)
+    order = db.df.sort_values(["d", "f", "k"]).reset_index(drop=True)
+    want_rs = order.v.cumsum()
+    pos_of = {int(k): i for i, k in enumerate(order.k)}
+    for k, rs, rn, rk, dr in db.sql(q).rows():
+        pos = pos_of[k]
+        assert rn == pos + 1
+        assert rk == pos + 1       # (d, f, k) unique
+        assert dr == pos + 1
+        assert rs == want_rs[pos]
+
+
+def test_range_mode_ntile_lag_minmax(db):
+    q = ("select k, ntile(9) over (order by d, f) nt, "
+         "lag(v, 2) over (order by d, f) lg, "
+         "min(v) over (order by d, f) mn, "
+         "max(v) over (order by d, f) mx, "
+         "count(*) over (order by d, f) c, "
+         "avg(v) over (order by d, f) av from s")
+    _assert_range_mode(db, q)
+    order = db.df.sort_values(["d", "f"], kind="stable") \
+        .reset_index(drop=True)
+    pos_of = {int(k): i for i, k in enumerate(order.k)}
+    n = len(order)
+    vs = list(order.v)
+    run_min = np.minimum.accumulate(vs)
+    run_max = np.maximum.accumulate(vs)
+    run_sum = np.cumsum(vs)
+    for k, nt, lg, mn, mx, c, av in db.sql(q).rows():
+        pos = pos_of[k]      # (d, f) unique with f ~ U(0,1)
+        assert nt == _pg_ntile(pos, n, 9)
+        assert lg == (vs[pos - 2] if pos >= 2 else None)
+        assert mn == run_min[pos] and mx == run_max[pos]
+        assert c == pos + 1
+        assert av == pytest.approx(run_sum[pos] / (pos + 1))
+
+
+def test_range_mode_first_last_value(db):
+    q = ("select k, first_value(v) over (order by f, k) fv, "
+         "last_value(v) over (order by f, k) lv from s")
+    _assert_range_mode(db, q)
+    order = db.df.sort_values(["f", "k"]).reset_index(drop=True)
+    first = int(order.v[0])
+    vs = dict(zip(db.df.k, db.df.v))
+    for k, fv, lv in db.sql(q).rows():
+        assert fv == first
+        assert lv == vs[k]    # unique keys: own peer
+
+
+def test_range_mode_desc_and_nulls(db):
+    q = ("select k, nv, row_number() over (order by nv desc, f, k) rn "
+         "from s")
+    _assert_range_mode(db, q)
+    rows = sorted(db.sql(q).rows(), key=lambda x: x[2])
+    nn = int(db.df.nv.isna().sum())
+    # nulls first under DESC (PG default)
+    assert all(r[1] is None for r in rows[:nn])
+    vals = [r[1] for r in rows[nn:]]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_range_vs_funnel_equivalence(db):
+    """The range-mode result must equal the funnel path's. A constant
+    BOOL leading key forces the funnel (unencodable for range routing)
+    without changing the effective (d, f) order."""
+    q1 = "select k, sum(v) over (order by d, f) rs from s"
+    q2 = "select k, sum(v) over (order by (d < 10000), d, f) rs from s"
+    _assert_range_mode(db, q1)
+    txt2 = describe(_planned(db, q2))
+    assert "SingleQE" in txt2    # still the funnel: control group
+    assert sorted(db.sql(q1).rows()) == sorted(db.sql(q2).rows())
+
+
+# ---------------------------------------------------------------------
+# first_value / last_value without ORDER BY (binder satellite)
+# ---------------------------------------------------------------------
+
+def _storage_order(db, table, cols, nseg=4):
+    snap = db.store.manifest.snapshot()
+    out = []
+    for seg in range(nseg):
+        c, _, n = db.store.read_segment(table, seg, None, snap)
+        for i in range(n):
+            out.append(tuple(int(c[x][i]) for x in cols))
+    return out
+
+
+def test_first_last_value_no_order_global(db):
+    """Legal without ORDER BY (whole-frame semantics, PG): pinned to the
+    deterministic storage (segment, row) order, gather-free."""
+    q = "select k, first_value(v) over () f, last_value(v) over () l from s"
+    planned = _assert_gather_free(db, q)
+    w = planned
+    while not isinstance(w, Window):
+        w = w.children[0]
+    assert w.global_mode is True
+    rows_st = _storage_order(db, "s", ("k", "v"))
+    fv, lv = rows_st[0][1], rows_st[-1][1]
+    for _, f, l in db.sql(q).rows():
+        assert f == fv and l == lv
+
+
+def test_first_last_value_no_order_partitioned(db):
+    q = ("select d, first_value(v) over (partition by d) f, "
+         "last_value(v) over (partition by d) l from s")
+    rows_st = _storage_order(db, "s", ("d", "v"))
+    first, last = {}, {}
+    for d, v in rows_st:
+        first.setdefault(d, v)
+        last[d] = v
+    for d, f, l in db.sql(q).rows():
+        assert f == first[d] and l == last[d]
+
+
+def test_first_value_still_needs_args(db):
+    from greengage_tpu.sql.parser import SqlError
+
+    with pytest.raises(SqlError, match="requires an argument"):
+        db.sql("select first_value() over () from s")
+    with pytest.raises(SqlError, match="ORDER BY"):
+        db.sql("select ntile(4) over () from s")
+
+
+# ---------------------------------------------------------------------
+# EXPLAIN ANALYZE / instrument still works on the new shapes
+# ---------------------------------------------------------------------
+
+def test_explain_analyze_ordered_global(db):
+    r = db.sql("explain analyze select k, ntile(4) over (order by k) "
+               "from s")
+    assert "Window global=ordered" in r.plan_text
+    assert "actual rows=" in r.plan_text
+
+
+def test_explain_analyze_range_mode(db):
+    r = db.sql("explain analyze select k, sum(v) over (order by d, f) "
+               "from s")
+    assert "Window global=range" in r.plan_text
+    assert "Redistribute range" in r.plan_text

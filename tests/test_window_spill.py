@@ -1,0 +1,209 @@
+"""Window-partition spill (ISSUE 12): a window whose working set exceeds
+the admission limit completes via PARTITION BY hash-bucket passes —
+capture the window's input in chunked passes, run the window per disjoint
+bucket (whole partitions per bucket = exact), merge Sort/Limit on the
+host. Plus the PR-10 OOM demotion giving windows a second life."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.exec.executor import QueryError
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table w (k int, g int, v int) distributed by (k)")
+    n = 200_000
+    rng = np.random.default_rng(11)
+    d.df = pd.DataFrame({"k": np.arange(n),
+                         "g": rng.integers(0, 400, n),
+                         "v": rng.integers(0, 1000, n)})
+    d.load_table("w", {c: d.df[c].values for c in ("k", "g", "v")})
+    d.sql("analyze")
+    yield d
+    d.close()
+
+
+def _with_limit(db, mb):
+    db.sql(f"set vmem_protect_limit_mb = {mb}")
+
+
+def test_window_spill_matches_in_memory(db):
+    q = ("select k, g, v, sum(v) over (partition by g order by v, k) rs, "
+         "row_number() over (partition by g order by v, k) rn from w")
+    want = sorted(db.sql(q).rows())
+    _with_limit(db, 4)
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+        assert r.stats.get("spill_passes", 0) >= 2, r.stats
+        assert r.stats.get("spill_window_buckets", 0) >= 2, r.stats
+        assert sorted(r.rows()) == want
+    finally:
+        _with_limit(db, 12288)
+
+
+def test_window_spill_ntile_lag_oracle(db):
+    """ntile/lag inside a spilled partitioned window stay exact vs the
+    pandas oracle (partitions are whole per bucket)."""
+    q = ("select k, ntile(3) over (partition by g order by v, k) nt, "
+         "lag(v) over (partition by g order by v, k) lg from w")
+    _with_limit(db, 4)
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+    finally:
+        _with_limit(db, 12288)
+    got = {k: (nt, lg) for k, nt, lg in r.rows()}
+    df = db.df.sort_values(["g", "v", "k"])
+    grp = df.groupby("g")
+    sizes = grp["v"].transform("size")
+    pos = grp.cumcount()
+    q_, r_ = sizes // 3, sizes % 3
+    big = r_ * (q_ + 1)
+    nt = np.where(pos < big, pos // np.maximum(q_ + 1, 1),
+                  r_ + (pos - big) // np.maximum(q_, 1)) + 1
+    lg = grp["v"].shift(1)
+    for k, want_nt, want_lg in zip(df.k, nt, lg):
+        gnt, glg = got[k]
+        assert gnt == want_nt, (k, gnt, want_nt)
+        assert glg == (None if pd.isna(want_lg) else want_lg), k
+
+
+def test_window_spill_sort_limit_on_host(db):
+    q = ("select k, g, rank() over (partition by g order by v desc) rk "
+         "from w order by g, rk, k limit 23 offset 5")
+    want = db.sql(q).rows()
+    _with_limit(db, 4)
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+        assert r.rows() == want
+    finally:
+        _with_limit(db, 12288)
+
+
+def test_window_spill_with_filter_above(db):
+    """Row-wise wrappers above the window run inside every bucket."""
+    q = ("select k, s from (select k, sum(v) over (partition by g) s "
+         "from w) t where s > 100000")
+    want = sorted(db.sql(q).rows())
+    _with_limit(db, 4)
+    try:
+        r = db.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+        assert sorted(r.rows()) == want
+    finally:
+        _with_limit(db, 12288)
+
+
+def test_window_spill_explain_analyze_rows(db):
+    """EXPLAIN ANALYZE of a spilling window keeps per-node actual rows
+    (capture passes + bucket programs sum onto the original nodes) and
+    shows the pass count — gg trace parity with the DISTINCT spill."""
+    _with_limit(db, 4)
+    try:
+        r = db.sql("explain analyze select k, sum(v) over "
+                   "(partition by g) s from w")
+        text = r.plan_text
+        assert "Spill passes:" in text, text
+        scan_line = [ln for ln in text.split("\n") if "Scan w" in ln][0]
+        assert "actual rows=200000" in scan_line, scan_line
+        win_line = [ln for ln in text.split("\n") if "Window" in ln][0]
+        assert "actual rows=200000" in win_line, win_line
+    finally:
+        _with_limit(db, 12288)
+
+
+def test_window_spill_disabled_rejects(db):
+    db.sql("set window_spill_enabled = off")
+    _with_limit(db, 4)
+    try:
+        with pytest.raises(QueryError, match="not spillable|above vmem"):
+            db.sql("select k, sum(v) over (partition by g) s from w")
+    finally:
+        db.sql("set window_spill_enabled = on")
+        _with_limit(db, 12288)
+
+
+def test_window_oom_demotes_to_spill(db):
+    """PR-10's oom_spill_retry path: a faked RESOURCE_EXHAUSTED on a
+    window statement demotes ONCE to the window spill and completes."""
+    q = "select g, count(*) over (partition by g) c from w where k < 5000"
+    want = sorted(db.sql(q).rows())
+    c0 = counters.snapshot()
+    faults.inject("device_oom", "skip", occurrences=1)
+    try:
+        r = db.sql(q)
+    finally:
+        faults.reset()
+    assert r.stats.get("oom_demoted") is True, r.stats
+    assert r.stats.get("spill_kind") == "window", r.stats
+    assert sorted(r.rows()) == want
+    d = counters.since(c0)
+    assert d.get("oom_spill_retries", 0) == 1
+    assert d.get("window_spill_runs", 0) == 1
+
+
+def test_window_spill_trace_has_passes(db):
+    """The spill passes land in the statement trace like any other
+    (per-pass spans with the spill category)."""
+    from greengage_tpu.runtime.trace import TRACES
+
+    _with_limit(db, 4)
+    try:
+        db.sql("select k, max(v) over (partition by g) m from w")
+        spans = [s for s in TRACES.last().export()
+                 if s["name"] == "spill-pass"]
+        assert len(spans) >= 2, spans
+        phases = {(s.get("args") or {}).get("phase") for s in spans}
+        assert {"capture", "window"} <= phases, spans
+    finally:
+        _with_limit(db, 12288)
+
+
+@pytest.mark.slow
+def test_window_spill_4x_admission_limit(devices8):
+    """Acceptance: a window over a table ~4x the admission limit
+    completes with results matching the pandas oracle."""
+    d = greengage_tpu.connect(numsegments=4)
+    n = 600_000
+    rng = np.random.default_rng(13)
+    df = pd.DataFrame({"k": np.arange(n),
+                       "g": rng.integers(0, 1000, n),
+                       "v": rng.integers(0, 10_000, n)})
+    d.sql("create table big4 (k int, g int, v int) distributed by (k)")
+    d.load_table("big4", {c: df[c].values for c in ("k", "g", "v")})
+    d.sql("analyze")
+    q = ("select k, sum(v) over (partition by g order by v, k) rs, "
+         "rank() over (partition by g order by v, k) rk from big4")
+    # measure the un-spilled estimate, then set the limit to ~1/4 of it
+    planned = d.sql("explain " + q)
+    from greengage_tpu.exec.executor import effective_limit_bytes  # noqa: F401
+    from greengage_tpu.exec.compile import Compiler
+    from greengage_tpu.sql.parser import parse
+
+    p, consts, _ = d._plan(parse(q)[0])
+    comp = Compiler(d.catalog, d.store, d.mesh, d.numsegments, consts,
+                    d.settings).compile(p)
+    limit_mb = max(int(comp.est_bytes / (1 << 20) / 4), 1)
+    d.sql(f"set vmem_protect_limit_mb = {limit_mb}")
+    try:
+        r = d.sql(q)
+        assert r.stats.get("spill_kind") == "window", r.stats
+        assert r.stats.get("spill_passes", 0) >= 2
+    finally:
+        d.sql("set vmem_protect_limit_mb = 12288")
+    got = {k: (rs, rk) for k, rs, rk in r.rows()}
+    sdf = df.sort_values(["g", "v", "k"])
+    grp = sdf.groupby("g")
+    rs = grp["v"].cumsum()
+    rk = grp.cumcount() + 1        # (v, k) unique within g
+    for k, want_rs, want_rk in zip(sdf.k, rs, rk):
+        assert got[k] == (want_rs, want_rk), k
+    d.close()
