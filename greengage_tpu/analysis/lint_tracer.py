@@ -3,8 +3,10 @@
 Two checks:
 
 **tracer-sync** — inside jit-traced code (every function in
-``greengage_tpu/ops/`` plus the closures nested inside
-``exec/compile.py`` methods — the ``seg_fn``/``run`` bodies that execute
+``greengage_tpu/ops/`` — the device scalar library ``ops/scalar.py``
+included, whose byte-window and civil-date kernels run under trace —
+plus the closures nested inside ``exec/compile.py`` methods, the
+``seg_fn``/``run`` bodies that execute
 under ``jax.jit(_shard_map(...))``), a value produced by
 ``jnp.*``/``lax.*`` is a *tracer*; forcing it to a host scalar —
 ``.item()``, ``float()``/``int()``/``bool()``, ``np.asarray``/

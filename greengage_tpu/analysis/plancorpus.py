@@ -203,6 +203,36 @@ TPCDS_QUERIES: dict[str, str] = {
       from store_sales, item
       where ss_item_sk = i_item_sk
       group by i_category order by i_category""",
+    # ---- scalar data-path fusion (ISSUE 13): every shape below must
+    # ---- lower its scalar work INTO the fused device program — no host
+    # ---- chains, no materialization between scan and agg ---------------
+    "ds_scalar_extract_group": """
+      select extract(year from d_date) y, extract(quarter from d_date) q,
+             count(*) c
+      from date_dim
+      where extract(year from d_date) >= 1999
+      group by extract(year from d_date), extract(quarter from d_date)
+      order by y, q""",
+    "ds_scalar_date_trunc_agg": """
+      select date_trunc('month', d_date) m, sum(ss_quantity) tq
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_date < date '2000-01-01' + interval '6' month
+      group by date_trunc('month', d_date) order by m limit 12""",
+    "ds_scalar_substr_case_agg": """
+      select substr(i_category, 1, 3) pfx,
+             sum(case when ss_ext_sales_price > 500
+                      then ss_ext_sales_price else 0 end) big_rev,
+             sum(coalesce(ss_ext_sales_price, 0)) rev
+      from store_sales, item
+      where ss_item_sk = i_item_sk
+      group by substr(i_category, 1, 3) order by pfx""",
+    "ds_scalar_nullif_greatest": """
+      select i_manager_id, greatest(i_brand_id, i_manufact_id) g,
+             count(nullif(i_manager_id, 1)) c
+      from item
+      group by i_manager_id, greatest(i_brand_id, i_manufact_id)
+      order by i_manager_id, g limit 20""",
 }
 
 
@@ -213,8 +243,8 @@ def load_tpcds_mini(db, n_fact: int = 20_000, seed: int = 77) -> None:
 
     rng = np.random.default_rng(seed)
     n_date, n_item, n_store = 400, 300, 12
-    db.sql("create table date_dim (d_date_sk bigint, d_year int, d_moy int) "
-           "distributed replicated")
+    db.sql("create table date_dim (d_date_sk bigint, d_date date, "
+           "d_year int, d_moy int) distributed replicated")
     db.sql("create table item (i_item_sk bigint, i_brand_id int, "
            "i_category text, i_manufact_id int, i_manager_id int) "
            "distributed by (i_item_sk)")
@@ -225,6 +255,8 @@ def load_tpcds_mini(db, n_fact: int = 20_000, seed: int = 77) -> None:
            "ss_ext_sales_price bigint) distributed by (ss_item_sk)")
     db.load_table("date_dim", {
         "d_date_sk": np.arange(n_date, dtype=np.int64),
+        # days since epoch starting 1998-01-01 (10227), one per sk
+        "d_date": (10227 + np.arange(n_date)).astype(np.int32),
         "d_year": (1998 + np.arange(n_date) // 180).astype(np.int32),
         "d_moy": (1 + (np.arange(n_date) // 15) % 12).astype(np.int32)})
     db.load_table("item", {

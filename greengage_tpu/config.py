@@ -99,6 +99,13 @@ class Settings:
     # hash buckets — whole partitions per bucket, exact results. Off =
     # honest admission rejection (the pre-spill behavior)
     window_spill_enabled: bool = True
+    # scalar data-path fusion (ops/scalar.py; docs/PERF.md "Scalar
+    # data-path fusion"): lower raw-TEXT string-function chains to device
+    # byte-window ops (E.RawStrOp) inside the fused programs; off = the
+    # legacy per-row host chains (the microbench baseline). Dictionary-LUT
+    # and date/numeric device scalars are always on — they have no host
+    # fallback to compare against.
+    scalar_device_enabled: bool = True
     # sampled-splitter range repartition for ordered global windows
     # (exec/compile.py _c_motion range branch): per-segment sample size
     # feeding the global splitter selection; larger = better balance for

@@ -1333,9 +1333,11 @@ class Database:
                 # wake blocked waiters: a lowered/disabled cap must admit
                 # them now, not at their timeout
                 self.resgroups.kick()
-            if stmt.name in ("optimizer", "plan_cache_params"):
-                # planner selection / literal-hoisting changed: cached
-                # bound plans were produced under the other regime
+            if stmt.name in ("optimizer", "plan_cache_params",
+                             "scalar_device_enabled"):
+                # planner selection / literal-hoisting / scalar-lowering
+                # changed: cached bound plans were produced under the
+                # other regime
                 self._select_cache.clear()
             return "SET"
         if isinstance(stmt, A.ResourceGroupStmt):
@@ -1618,7 +1620,8 @@ class Database:
     def _plan(self, stmt, force_multi_join: bool = False, info: dict | None = None):
         binder = Binder(self.catalog, self.store,
                         subquery_executor=self._scalar_subquery,
-                        optimizer=self.settings.optimizer)
+                        optimizer=self.settings.optimizer,
+                        scalar_device=self.settings.scalar_device_enabled)
         with _trace.span("bind", cat="plan"):
             logical, outs = binder.bind_select(stmt)
         planned = plan_query(logical, self.catalog, self.store, self.numsegments,

@@ -144,11 +144,19 @@ class InList(Expr):
 
 @dataclass(frozen=True)
 class Func(Expr):
-    """Scalar function call evaluated on device (registry in expr_eval)."""
+    """Scalar function call evaluated on device.
 
-    name: str           # extract_year | extract_month | extract_day | abs | ...
+    Resolution order in the evaluator: the device scalar library
+    (ops/scalar.py — typed registry with per-function NULL semantics),
+    then the extension UDF registry (extensions.py). ``params`` carries
+    bind-time static arguments the device implementation needs baked into
+    the traced program (DECIMAL scales, date_trunc field, interval
+    months) — never row data."""
+
+    name: str           # extract_year | date_trunc | coalesce | round_dec ...
     args: tuple[Expr, ...] = ()
     type: T.SqlType = T.INT32
+    params: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -165,6 +173,40 @@ class RawLike(Expr):
     parts: tuple          # literal parts as bytes, in pattern order
     anchored_start: bool
     anchored_end: bool
+    type: T.SqlType = T.BOOL
+
+
+@dataclass(frozen=True)
+class RawStrOp(Expr):
+    """Scalar string-function chain over a raw TEXT column, evaluated ON
+    DEVICE from the staged wide byte window (@rw word lanes + @rl length)
+    — the byte-op half of the scalar data-path fusion (ops/scalar.py;
+    docs/PERF.md "Scalar data-path fusion"). The chain's steps never move
+    bytes: they narrow a per-row (start, length) view over the unpacked
+    [rows, W] byte matrix (substr/left/right/trim) or transform the matrix
+    elementwise (upper/lower), so the whole expression is VPU
+    elementwise/reduce work with no gather.
+
+    Terminal op:
+      - out="length": the view's length (INT32) — usable anywhere a
+        device int is (projections, aggregates, predicates);
+      - out="cmp": equality of the view against ``literal`` (BOOL);
+      - out="like": RawLike's greedy %-part matching constrained to the
+        view (BOOL).
+
+    The binder only emits this when every committed row fits the staged
+    window and the column is pure ASCII where the chain's semantics
+    require it (upper/lower/substr/length count characters, the window
+    counts bytes)."""
+
+    words: tuple          # ColRefs of @rw:<col>:<w> int64 lanes, in order
+    length: "Expr"        # ColRef of @rl:<col>
+    steps: tuple = ()     # ((name, *literal args), ...) in application order
+    out: str = "cmp"      # cmp | like | length
+    literal: bytes = b""  # out="cmp": utf-8 bytes of the compared literal
+    parts: tuple = ()     # out="like": literal parts as bytes
+    anchored_start: bool = True
+    anchored_end: bool = True
     type: T.SqlType = T.BOOL
 
 

@@ -170,9 +170,11 @@ def _register_builtins():
     register_scalar("mod", _mod, ("int64", "int64"), "first", masked=True)
     register_scalar("sign", lambda x: jnp.sign(x).astype(jnp.int32),
                     ("numeric",), T.INT32)
-    # GREATEST/LEAST are deliberately absent: PG's ignore NULL arguments
-    # (they are expression constructs, not strict functions) and the
-    # strict registry would silently return NULL instead
+    # GREATEST/LEAST/COALESCE/NULLIF live in ops/scalar.py, not here: PG's
+    # ignore/inspect NULL arguments (they are expression constructs, not
+    # strict functions) and the strict registry would silently return NULL.
+    # round/trunc/mod keep their float64 forms here; the binder routes
+    # DECIMAL arguments to the scale-exact ops/scalar.py variants first.
 
 
 _register_builtins()

@@ -13,30 +13,14 @@ import jax.numpy as jnp
 
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
+from greengage_tpu.ops import scalar as scalar_ops
 from greengage_tpu.ops.batch import Batch
 
-
-def _and_valid(a, b):
-    if a is None:
-        return b
-    if b is None:
-        return a
-    return a & b
-
-
-def _pow10(k: int):
-    return jnp.int64(10 ** k)
-
-
-def _rescale(vals, from_scale: int, to_scale: int):
-    if from_scale == to_scale:
-        return vals
-    if to_scale > from_scale:
-        return vals * _pow10(to_scale - from_scale)
-    # round half away from zero (PG numeric rounding)
-    p = _pow10(from_scale - to_scale)
-    half = p // 2
-    return jnp.where(vals >= 0, (vals + half) // p, -((-vals + half) // p))
+# shared NULL/DECIMAL algebra lives with the scalar function library
+# (ops/scalar.py) so device functions and the evaluator agree on it
+_and_valid = scalar_ops.and_valid
+_pow10 = scalar_ops.pow10
+_rescale = scalar_ops.rescale
 
 
 def _rescale_host(v: int, from_scale: int, to_scale: int) -> int:
@@ -296,100 +280,78 @@ class Evaluator:
         return res, val
 
     def _eval_rawlike(self, e: E.RawLike):
-        """General device LIKE over the staged wide byte window: unpack
-        the int64 word lanes to a [rows, W] byte matrix, then match the
-        pattern's literal parts greedily left-to-right with rolling
-        byte-window equality (pure VPU elementwise/reduce work — no
-        gather/scatter). Greedy-leftmost is exact for %-separated literal
-        parts; END anchors pin the last part at length-L."""
+        """General device LIKE over the staged wide byte window — the
+        whole-string case of the shared byte-window machinery
+        (ops/scalar.py unpack_bytes/view_like): match the pattern's
+        literal parts greedily left-to-right with rolling byte-window
+        equality over the [rows, W] byte matrix (pure VPU
+        elementwise/reduce work, no gather/scatter; greedy-leftmost is
+        exact for %-separated literal parts)."""
         word_vals = []
         valid = None
         for wref in e.words:
             v, wv = self.value(wref)
-            word_vals.append(v.astype(jnp.uint64))
+            word_vals.append(v)
             valid = _and_valid(valid, wv)
         lens, lv = self.value(e.length)
         valid = _and_valid(valid, lv)
-        lens = lens.astype(jnp.int32)
-        n = self.n
-        W = 8 * len(word_vals)
-        # [n, W] byte matrix, big-endian within each word
-        cols = []
-        for wv64 in word_vals:
-            for j in range(8):
-                cols.append(((wv64 >> jnp.uint64(56 - 8 * j))
-                             & jnp.uint64(0xFF)).astype(jnp.uint8))
-        B = jnp.stack(cols, axis=1)
-        ok = jnp.ones((n,), bool)
-        prev_end = jnp.zeros((n,), jnp.int32)
-        parts = e.parts
-        for idx, part in enumerate(parts):
-            L = len(part)
-            nwin = W - L + 1
-            if nwin <= 0:
-                ok = jnp.zeros((n,), bool)
-                break
-            m = jnp.ones((n, nwin), bool)
-            for k, byte in enumerate(part):
-                m = m & (B[:, k:k + nwin] == jnp.uint8(byte))
-            s_idx = jnp.arange(nwin, dtype=jnp.int32)
-            m = m & (s_idx[None, :] >= prev_end[:, None])
-            m = m & (s_idx[None, :] + L <= lens[:, None])
-            if idx == 0 and e.anchored_start:
-                m = m & (s_idx[None, :] == 0)
-            if idx == len(parts) - 1 and e.anchored_end:
-                m = m & (s_idx[None, :] + L == lens[:, None])
-            ok = ok & m.any(axis=1)
-            prev_end = jnp.argmax(m, axis=1).astype(jnp.int32) + L
-        if not parts:
-            ok = jnp.ones((n,), bool)
+        B = scalar_ops.unpack_bytes(word_vals)
+        start = jnp.zeros((self.n,), jnp.int32)
+        ok = scalar_ops.view_like(B, start, lens.astype(jnp.int32), e.parts,
+                                  e.anchored_start, e.anchored_end)
         return ok, valid
+
+    def _eval_rawstrop(self, e: "E.RawStrOp"):
+        """Scalar string chain over the staged wide byte window (the
+        raw-TEXT half of ops/scalar.py): unpack the int64 lanes, narrow
+        the per-row (start, length) view through the chain, then compare /
+        measure — pure VPU elementwise/reduce work, no gather."""
+        word_vals = []
+        valid = None
+        for wref in e.words:
+            v, wv = self.value(wref)
+            word_vals.append(v)
+            valid = _and_valid(valid, wv)
+        lens, lv = self.value(e.length)
+        valid = _and_valid(valid, lv)
+        B = scalar_ops.unpack_bytes(word_vals)
+        start = jnp.zeros((self.n,), jnp.int32)
+        B, start, ln = scalar_ops.apply_steps(B, start,
+                                              lens.astype(jnp.int32), e.steps)
+        if e.out == "length":
+            return ln, valid
+        if e.out == "cmp":
+            return scalar_ops.view_eq(B, start, ln, e.literal), valid
+        if e.out == "like":
+            return scalar_ops.view_like(B, start, ln, e.parts,
+                                        e.anchored_start, e.anchored_end), \
+                valid
+        raise NotImplementedError(f"RawStrOp out={e.out}")
 
     def _eval_func(self, e: E.Func):
         args = [self.value(a) for a in e.args]
+        # device scalar library first (typed registry, per-function NULL
+        # semantics — coalesce/greatest are NOT strict)
+        dev = scalar_ops.lookup(e.name)
+        if dev is not None:
+            return dev.apply(e, args, self.n)
         valid = None
         for _, av in args:
             valid = _and_valid(valid, av)
         vals = [a for a, _ in args]
-        fn = _FUNCS.get(e.name)
-        if fn is None:
-            from greengage_tpu import extensions as X
+        from greengage_tpu import extensions as X
 
-            spec = X.lookup(e.name, len(vals))
-            if spec is None:
-                raise NotImplementedError(f"function {e.name}")
-            if spec.masked:
-                v, bad = spec.fn(*vals)
-                return v, _and_valid(valid, ~bad)
-            fn = spec.fn
-        return fn(*vals), valid
+        spec = X.lookup(e.name, len(vals))
+        if spec is None:
+            raise NotImplementedError(f"function {e.name}")
+        if spec.masked:
+            v, bad = spec.fn(*vals)
+            return v, _and_valid(valid, ~bad)
+        return spec.fn(*vals), valid
 
 
-# --------------------------------------------------------------------------
-# scalar function registry (device implementations)
-# --------------------------------------------------------------------------
-
-def _civil_from_days(z):
-    """days-since-1970 -> (year, month, day), branchless integer math
-    (Howard Hinnant's civil_from_days; valid for the SQL date range)."""
-    z = z.astype(jnp.int64) + 719468
-    era = z // 146097   # // already floors (Hinnant's C version must adjust)
-    doe = z - era * 146097
-    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-    y = yoe + era * 400
-    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-    mp = (5 * doy + 2) // 153
-    d = doy - (153 * mp + 2) // 5 + 1
-    m = jnp.where(mp < 10, mp + 3, mp - 9)
-    y = jnp.where(m <= 2, y + 1, y)
-    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
-
-
-_FUNCS = {
-    "extract_year": lambda d: _civil_from_days(d)[0],
-    "extract_month": lambda d: _civil_from_days(d)[1],
-    "extract_day": lambda d: _civil_from_days(d)[2],
-}
+# back-compat alias: the civil-calendar algebra moved to ops/scalar.py
+_civil_from_days = scalar_ops.civil_from_days
 
 
 def _or_true(valid):

@@ -1,0 +1,521 @@
+"""Device-native scalar function library (the pg_proc builtin slice,
+fused into the compiled scan/filter/agg programs).
+
+Where the reference evaluates scalar functions per tuple through fmgr
+(src/backend/utils/adt/date.c, timestamp.c, numeric.c, formatting.c),
+every function here is a whole-column jax computation the expression
+evaluator (ops/expr_eval.py) inlines into the surrounding traced closure
+— XLA fuses it into the same kernel as the scan decode, filter mask, and
+aggregate update, so scalar work never materializes a tuple between
+operators (the data-path-fusion argument; docs/PERF.md "Scalar data-path
+fusion").
+
+Three families live here:
+
+* **date functions** — ``extract_*`` / ``date_trunc`` / ``add_months``
+  over days-since-epoch int32, built on Howard Hinnant's branchless
+  civil-calendar algebra (``civil_from_days`` / ``days_from_civil``);
+* **NULL-aware constructs** — ``coalesce`` / ``nullif`` / ``greatest`` /
+  ``least``, which are NOT strict (PG treats them as expression syntax,
+  not functions): each carries its own validity algebra;
+* **DECIMAL-exact numerics** — ``round_dec`` / ``mod_dec`` on scaled
+  int64 with bind-time scales in ``Func.params`` (the float64 variants
+  stay in extensions.py; the binder routes DECIMAL arguments here so
+  exactness survives).
+
+The byte-window helpers at the bottom are the raw-TEXT half of the
+story: string functions over raw (non-dictionary) TEXT evaluate on
+device as elementwise/reduce work over the staged wide byte window
+(``E.RawStrOp``) — a function chain narrows a per-row (start, length)
+view over the unpacked [rows, W] byte matrix instead of materializing
+strings. Dictionary-encoded TEXT needs none of this: the binder applies
+utils/strfuncs.py once per distinct value and ships a LUT const.
+
+TEXT strategy table (the binder's lowering decision; the host path
+survives only for shapes neither device form can express):
+
+    encoding   function shape                     lowering
+    ---------  ---------------------------------  ------------------------
+    dict       any strfuncs function              LUT const (device gather)
+    raw        chain + [=|<>|LIKE] vs literal     RawStrOp byte ops
+    raw        length(chain)                      RawStrOp length view
+    raw        strpos/replace/lpad/... , non-     host chain (@hp pred /
+               ASCII data, rows past the window   finalize decode), counted
+                                                  in scalar_host_fallback_total
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax.numpy as jnp
+
+from greengage_tpu import types as T
+
+# ---------------------------------------------------------------------------
+# shared validity / DECIMAL-rescale algebra (also used by ops/expr_eval.py)
+# ---------------------------------------------------------------------------
+
+
+def and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def pow10(k: int):
+    return jnp.int64(10 ** k)
+
+
+def rescale(vals, from_scale: int, to_scale: int):
+    """Scaled-int64 DECIMAL rescale, rounding half AWAY from zero on
+    narrowing (PG numeric rounding)."""
+    if from_scale == to_scale:
+        return vals
+    if to_scale > from_scale:
+        return vals * pow10(to_scale - from_scale)
+    p = pow10(from_scale - to_scale)
+    half = p // 2
+    return jnp.where(vals >= 0, (vals + half) // p, -((-vals + half) // p))
+
+
+# ---------------------------------------------------------------------------
+# civil-calendar algebra (Howard Hinnant; valid for the SQL date range)
+# ---------------------------------------------------------------------------
+
+
+def civil_from_days(z):
+    """days-since-1970 -> (year, month, day), branchless integer math."""
+    z = z.astype(jnp.int64) + 719468
+    era = z // 146097   # // already floors (Hinnant's C version must adjust)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) -> days-since-1970 — Hinnant's inverse, the other
+    half the date_trunc / add_months round trips need."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9).astype(jnp.int64)
+    doy = (153 * mp + 2) // 5 + d.astype(jnp.int64) - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _is_leap(y):
+    return (jnp.mod(y, 4) == 0) & ((jnp.mod(y, 100) != 0)
+                                   | (jnp.mod(y, 400) == 0))
+
+
+_DAYS_IN_MONTH = (31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeviceFn:
+    """One device scalar function: ``apply(func_node, args, n)`` where
+    ``args`` is ``[(values, valid|None), ...]`` — each entry owns its NULL
+    semantics (strict functions AND-combine validity via ``_strict``)."""
+
+    name: str
+    apply: Callable
+
+
+_REG: dict[str, DeviceFn] = {}
+
+
+def register(name: str, apply: Callable) -> None:
+    _REG[name] = DeviceFn(name, apply)
+
+
+def lookup(name: str) -> DeviceFn | None:
+    return _REG.get(name)
+
+
+def names() -> tuple[str, ...]:
+    return tuple(sorted(_REG))
+
+
+def _strict(fn):
+    """Wrap a values-only implementation with the strict NULL rule
+    (NULL in -> NULL out): validity is the AND of argument validities."""
+    def apply(e, args, n):
+        valid = None
+        for _, av in args:
+            valid = and_valid(valid, av)
+        return fn(e, [v for v, _ in args]), valid
+    return apply
+
+
+# ---- date functions -------------------------------------------------------
+
+_EXTRACT_FIELDS = ("year", "month", "day", "quarter", "dow", "isodow",
+                   "doy", "week", "epoch", "decade", "century")
+
+
+def extract_fields() -> tuple[str, ...]:
+    """Fields the binder may lower to extract_<field> Func nodes."""
+    return _EXTRACT_FIELDS
+
+
+def _extract(field: str):
+    def fn(e, vals):
+        d = vals[0]
+        if field == "epoch":
+            return d.astype(jnp.int64) * jnp.int64(86400)
+        if field == "dow":       # PG: Sunday=0; 1970-01-01 was a Thursday
+            return jnp.mod(d.astype(jnp.int32) + 4, 7)
+        if field == "isodow":    # Monday=1 .. Sunday=7
+            return jnp.mod(d.astype(jnp.int32) + 3, 7) + 1
+        y, m, dd = civil_from_days(d)
+        if field == "year":
+            return y
+        if field == "month":
+            return m
+        if field == "day":
+            return dd
+        if field == "quarter":
+            return (m + 2) // 3
+        if field == "doy":
+            return (d.astype(jnp.int32)
+                    - days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m))
+                    + 1)
+        if field == "week":      # ISO 8601 week of the week's Thursday
+            thu = (d.astype(jnp.int32)
+                   - jnp.mod(d.astype(jnp.int32) + 3, 7) + 3)
+            ty, _, _ = civil_from_days(thu)
+            jan1 = days_from_civil(ty, jnp.ones_like(ty, jnp.int32),
+                                   jnp.ones_like(ty, jnp.int32))
+            return ((thu - jan1) // 7 + 1).astype(jnp.int32)
+        if field == "decade":
+            return y // 10
+        if field == "century":   # PG: 2000 -> 20, 2001 -> 21
+            return (y + 99) // 100
+        raise NotImplementedError(field)
+    return fn
+
+
+for _f in _EXTRACT_FIELDS:
+    register(f"extract_{_f}", _strict(_extract(_f)))
+
+
+_TRUNC_FIELDS = ("year", "quarter", "month", "week", "day")
+
+
+def trunc_fields() -> tuple[str, ...]:
+    return _TRUNC_FIELDS
+
+
+def _date_trunc(e, vals):
+    field = e.params[0]
+    d = vals[0].astype(jnp.int32)
+    if field == "day":
+        return d
+    if field == "week":          # ISO week starts Monday
+        return d - jnp.mod(d + 3, 7)
+    y, m, _dd = civil_from_days(d)
+    one = jnp.ones_like(m)
+    if field == "year":
+        return days_from_civil(y, one, one)
+    if field == "quarter":
+        return days_from_civil(y, 3 * ((m - 1) // 3) + 1, one)
+    if field == "month":
+        return days_from_civil(y, m, one)
+    raise NotImplementedError(field)
+
+
+register("date_trunc", _strict(_date_trunc))
+
+
+def _add_months(e, vals):
+    """date + INTERVAL 'n' month|year over a column (the literal-base case
+    folds at bind time): civil shift with end-of-month clamping, matching
+    timestamp.c's timestamp_pl_interval day clamp."""
+    months = int(e.params[0])
+    y, m, dd = civil_from_days(vals[0])
+    tot = y.astype(jnp.int64) * 12 + (m.astype(jnp.int64) - 1) + months
+    y2 = (tot // 12).astype(jnp.int32)
+    m2 = (tot - (tot // 12) * 12 + 1).astype(jnp.int32)
+    dim = jnp.asarray(_DAYS_IN_MONTH, dtype=jnp.int32)[m2 - 1]
+    dim = jnp.where((m2 == 2) & _is_leap(y2), dim + 1, dim)
+    return days_from_civil(y2, m2, jnp.minimum(dd, dim))
+
+
+register("add_months", _strict(_add_months))
+
+
+# ---- NULL-aware constructs (non-strict) -----------------------------------
+
+
+def _bool_valid(v, n):
+    return jnp.ones((n,), bool) if v is None else v
+
+
+def _coalesce(e, args, n):
+    vals = [a for a, _ in args]
+    valids = [_bool_valid(v, n) for _, v in args]
+    res, resv = vals[-1], valids[-1]
+    for v, ok in zip(reversed(vals[:-1]), reversed(valids[:-1])):
+        res = jnp.where(ok, v, res)
+        resv = ok | resv
+    return res, resv
+
+
+register("coalesce", _coalesce)
+
+
+def _nullif(e, args, n):
+    (a, av), (b, bv) = args
+    known_eq = (a == b) & _bool_valid(bv, n)
+    valid = _bool_valid(av, n) & ~known_eq
+    return a, valid
+
+
+register("nullif", _nullif)
+
+
+def _extreme(pick):
+    """GREATEST/LEAST: NULL arguments are IGNORED (the documented PG
+    deviation from the SQL standard); NULL only when every argument is."""
+    def apply(e, args, n):
+        res, resv = args[0][0], _bool_valid(args[0][1], n)
+        for v, ok in args[1:]:
+            ok = _bool_valid(ok, n)
+            both = resv & ok
+            res = jnp.where(both, pick(res, v), jnp.where(ok, v, res))
+            resv = resv | ok
+        return res, resv
+    return apply
+
+
+register("greatest", _extreme(jnp.maximum))
+register("least", _extreme(jnp.minimum))
+
+
+# ---- DECIMAL-exact numerics ----------------------------------------------
+
+
+def _round_dec(e, vals):
+    """round(DECIMAL(s), digits) -> DECIMAL(max(digits, 0)), exact scaled
+    int64 (the extensions.py float64 round loses exactness past 2^53;
+    numeric.c keeps the scale — so do we). Negative digits round to tens/
+    hundreds and re-widen to scale 0."""
+    from_scale, digits = e.params
+    r = rescale(vals[0].astype(jnp.int64), from_scale, digits)
+    if digits < 0:
+        r = r * pow10(-digits)
+    return r
+
+
+register("round_dec", _strict(_round_dec))
+
+
+def _trunc_dec(e, vals):
+    from_scale, digits = e.params
+    v = vals[0].astype(jnp.int64)
+    if digits >= from_scale:
+        return rescale(v, from_scale, digits)
+    p = pow10(from_scale - digits)
+    q = jnp.abs(v) // p
+    r = jnp.where(v < 0, -q, q)
+    if digits < 0:
+        r = r * pow10(-digits)
+    return r
+
+
+register("trunc_dec", _strict(_trunc_dec))
+
+
+def _mod_dec(e, args, n):
+    """mod over DECIMALs: align scales, truncation semantics with the
+    dividend's sign (numeric.c); mod(x, 0) yields NULL via the validity
+    mask (the zero_invalid deviation — PG raises)."""
+    ls, rs, out_scale = e.params
+    (a, av), (b, bv) = args
+    s = max(ls, rs)
+    a2 = rescale(a.astype(jnp.int64), ls, s)
+    b2 = rescale(b.astype(jnp.int64), rs, s)
+    zero = b2 == 0
+    safe = jnp.where(zero, jnp.int64(1), b2)
+    m = a2 - (jnp.abs(a2) // jnp.abs(safe)) * jnp.sign(a2) * jnp.abs(safe)
+    valid = and_valid(and_valid(av, bv), ~zero)
+    return rescale(m, s, out_scale), valid
+
+
+register("mod_dec", _mod_dec)
+
+
+# ---------------------------------------------------------------------------
+# raw-TEXT byte-window ops (E.RawStrOp evaluation; runs under trace)
+# ---------------------------------------------------------------------------
+
+# chain steps the byte-window path can express; True = the step's
+# semantics count CHARACTERS, so the byte view is only exact over pure
+# ASCII data (the binder checks store.raw_is_ascii before lowering)
+RAW_STEPS = {
+    "upper": True, "lower": True,
+    "trim": False, "ltrim": False, "rtrim": False,
+    "substr": True, "substring": True, "left": True, "right": True,
+    "length": True, "char_length": True, "character_length": True,
+}
+
+
+def raw_steps_ok(steps) -> tuple[bool, bool]:
+    """-> (device-expressible, needs-ascii) for a strfuncs chain."""
+    needs_ascii = False
+    for step in steps:
+        name = step[0]
+        if name not in RAW_STEPS:
+            return False, False
+        if name in ("ltrim", "rtrim") and len(step) > 1 and step[1] != " ":
+            return False, False   # non-space trim sets stay on the host
+        if name in ("substr", "substring"):
+            if int(step[1]) < 1:
+                return False, False   # start < 1 shortens the window (host)
+            if len(step) > 2 and int(step[2]) < 0:
+                return False, False   # negative length: host path RAISES
+        needs_ascii = needs_ascii or RAW_STEPS[name]
+    return True, needs_ascii
+
+
+def unpack_bytes(word_vals):
+    """[(n,) int64 word lanes] -> [n, 8*len] uint8 byte matrix, big-endian
+    within each word (the RawLike unpack, shared)."""
+    cols = []
+    for wv in word_vals:
+        w64 = wv.astype(jnp.uint64)
+        for j in range(8):
+            cols.append(((w64 >> jnp.uint64(56 - 8 * j))
+                         & jnp.uint64(0xFF)).astype(jnp.uint8))
+    return jnp.stack(cols, axis=1)
+
+
+def apply_steps(B, start, ln, steps):
+    """Apply a function chain to the (start, ln) view over byte matrix B.
+    upper/lower transform B elementwise; the rest only narrow the view —
+    no bytes move, so everything stays VPU elementwise/reduce work."""
+    W = B.shape[1]
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    for step in steps:
+        name = step[0]
+        if name == "upper":
+            B = jnp.where((B >= 97) & (B <= 122), B - 32, B)
+        elif name == "lower":
+            B = jnp.where((B >= 65) & (B <= 90), B + 32, B)
+        elif name in ("trim", "ltrim", "rtrim"):
+            in_win = (idx >= start[:, None]) & (idx < (start + ln)[:, None])
+            nonsp = in_win & (B != 32)
+            if name in ("trim", "ltrim"):
+                first = jnp.min(jnp.where(nonsp, idx, W), axis=1).astype(
+                    jnp.int32)
+                lead = jnp.minimum(first - start, ln)
+                start = start + lead
+                ln = ln - lead
+            if name in ("trim", "rtrim"):
+                last = jnp.max(jnp.where(nonsp, idx, -1), axis=1).astype(
+                    jnp.int32)
+                ln = jnp.where(last < start, 0, last - start + 1)
+        elif name in ("substr", "substring"):
+            a = int(step[1]) - 1          # binder guarantees start >= 1
+            take = jnp.minimum(jnp.int32(a), ln)
+            start = start + take
+            ln = ln - take
+            if len(step) > 2:
+                ln = jnp.minimum(ln, jnp.int32(int(step[2])))
+        elif name == "left":
+            k = int(step[1])
+            ln = (jnp.minimum(ln, jnp.int32(k)) if k >= 0
+                  else jnp.maximum(ln + jnp.int32(k), 0))
+        elif name == "right":
+            k = int(step[1])
+            if k >= 0:
+                shift = jnp.maximum(ln - jnp.int32(k), 0)
+                start = start + shift
+                ln = ln - shift
+            else:
+                take = jnp.minimum(jnp.int32(-k), ln)
+                start = start + take
+                ln = ln - take
+        elif name in ("length", "char_length", "character_length"):
+            pass   # terminal; the caller reads ln
+        else:
+            raise NotImplementedError(f"raw byte-op step {name}")
+    return B, start, ln
+
+
+def view_eq(B, start, ln, lit: bytes):
+    """view == literal, gather-free: match the literal at every static
+    offset (rolled byte-window equality), then select the per-row offset
+    with a positional mask instead of a dynamic index."""
+    n, W = B.shape
+    L = len(lit)
+    len_ok = ln == jnp.int32(L)
+    if L == 0:
+        return len_ok
+    nwin = W - L + 1
+    if nwin <= 0:
+        return jnp.zeros((n,), bool)
+    m = jnp.ones((n, nwin), bool)
+    for k, byte in enumerate(lit):
+        m = m & (B[:, k:k + nwin] == jnp.uint8(byte))
+    pos = jnp.arange(nwin, dtype=jnp.int32)[None, :]
+    at_start = (m & (pos == start[:, None])).any(axis=1)
+    return len_ok & at_start
+
+
+def view_like(B, start, ln, parts, anchored_start: bool, anchored_end: bool):
+    """RawLike's greedy leftmost %-part matching, constrained to the
+    (start, ln) view (exact for %-separated literal parts)."""
+    n, W = B.shape
+    if not parts:
+        # '' matches only the empty string; '%' (any %-only pattern)
+        # matches everything
+        return (ln == 0 if anchored_start and anchored_end
+                else jnp.ones((n,), bool))
+    end = start + ln
+    ok = jnp.ones((n,), bool)
+    prev_end = start
+    for i, part in enumerate(parts):
+        L = len(part)
+        nwin = W - L + 1
+        if nwin <= 0:
+            return jnp.zeros((n,), bool)
+        m = jnp.ones((n, nwin), bool)
+        for k, byte in enumerate(part):
+            m = m & (B[:, k:k + nwin] == jnp.uint8(byte))
+        pos = jnp.arange(nwin, dtype=jnp.int32)[None, :]
+        m = m & (pos >= prev_end[:, None])
+        m = m & (pos + L <= end[:, None])
+        if i == 0 and anchored_start:
+            m = m & (pos == start[:, None])
+        if i == len(parts) - 1 and anchored_end:
+            m = m & (pos + L == end[:, None])
+        ok = ok & m.any(axis=1)
+        prev_end = jnp.argmax(m, axis=1).astype(jnp.int32) + L
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# binder-facing typing tables
+# ---------------------------------------------------------------------------
+
+# date_part / extract function-call aliases resolve through the same
+# field registry the EXTRACT(.. FROM ..) spelling uses
+FIELD_RESULT = {f: (T.INT64 if f == "epoch" else T.INT32)
+                for f in _EXTRACT_FIELDS}

@@ -42,6 +42,39 @@ def _param_value(e) -> E.Expr | None:
     return None
 
 
+def _year_days(y: int) -> int:
+    """days-since-epoch of Jan 1 of ``y`` (host calendar math)."""
+    return int((np.datetime64(f"{y:04d}-01-01") - np.datetime64("1970-01-01"))
+               .astype(int))
+
+
+def _year_prune(lhs, rhs, op, by_id) -> list[tuple] | None:
+    """``extract_year(date_col) <op> int literal`` -> equivalent day-range
+    prune predicates on the base column, or None. Exact because year is
+    monotone non-decreasing in days-since-epoch."""
+    if not (isinstance(lhs, E.Func) and lhs.name == "extract_year"
+            and len(lhs.args) == 1 and isinstance(lhs.args[0], E.ColRef)
+            and lhs.args[0].name in by_id
+            and lhs.args[0].type.kind is T.Kind.DATE
+            and isinstance(rhs, E.Literal) and rhs.value is not None
+            and isinstance(rhs.value, (int, np.integer))
+            and op in ("=", "<", "<=", ">", ">=")):
+        return None
+    y = int(rhs.value)
+    if not 1 <= y < 9999:
+        return None
+    col = by_id[lhs.args[0].name]
+    if op == "=":
+        return [(col, ">=", _year_days(y)), (col, "<=", _year_days(y + 1) - 1)]
+    if op == "<=":
+        return [(col, "<=", _year_days(y + 1) - 1)]
+    if op == "<":
+        return [(col, "<=", _year_days(y) - 1)]
+    if op == ">=":
+        return [(col, ">=", _year_days(y))]
+    return [(col, ">=", _year_days(y + 1))]      # op == ">"
+
+
 class Planner:
     def __init__(self, catalog, store, numsegments: int, force_multi_join: bool = False):
         self.catalog = catalog
@@ -124,9 +157,18 @@ class Planner:
             if not isinstance(c, E.Cmp):
                 continue
             lhs, rhs, op = c.left, c.right, c.op
-            if isinstance(rhs, E.ColRef) and (isinstance(lhs, E.Literal)
-                                              or _param_value(lhs)):
+            if isinstance(rhs, (E.ColRef, E.Func)) \
+                    and (isinstance(lhs, E.Literal) or _param_value(lhs)):
                 lhs, rhs, op = rhs, lhs, flip.get(op, op)
+            # extract_year(d) <op> literal (the TPC-DS date-filter shape):
+            # year is monotone in days-since-epoch, so the conjunct
+            # implies exact day bounds on the BASE date column — zone
+            # maps / block indexes prune on those while the Func itself
+            # stays fused in the device filter (ops/scalar.py)
+            yp = _year_prune(lhs, rhs, op, by_id)
+            if yp:
+                prune.extend(yp)
+                continue
             # hoisted literal (sql/paramize.py): the pushed predicate
             # carries the Param expression; the executor substitutes the
             # statement's current value at STAGING time, so zone-map /

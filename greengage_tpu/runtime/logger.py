@@ -89,6 +89,12 @@ COUNTER_NAMES = (
     # window-partition spill activity (runs + capture/bucket passes)
     "window_gather_free_total", "window_funnel_total",
     "window_spill_runs", "window_spill_passes",
+    # scalar data-path fusion (sql/binder.py, ops/scalar.py): scalar
+    # function sites lowered INTO the fused device programs (Func /
+    # dictionary LUT / raw byte-window op) vs sites that fell back to the
+    # per-row host chain (@hp chain predicates, finalize-decode
+    # projections) — the fused-coverage ratio docs/PERF.md tracks
+    "scalar_device_total", "scalar_host_fallback_total",
 )
 
 HISTOGRAM_NAMES = (

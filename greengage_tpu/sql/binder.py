@@ -96,12 +96,16 @@ class Scope:
 
 class Binder:
     def __init__(self, catalog, store, subquery_executor=None,
-                 optimizer: bool = True):
+                 optimizer: bool = True, scalar_device: bool = True):
         self.catalog = catalog
         self.store = store
         self._uid = itertools.count()
         self.consts: dict[str, np.ndarray] = {}   # LUT pool shipped to device
         self._scan_for: dict[str, "Scan"] = {}    # base col id -> its Scan
+        # GUC 'scalar_device_enabled': lower raw-TEXT string-function
+        # chains to device byte ops (E.RawStrOp); False = the legacy
+        # per-row host chains (the microbench baseline)
+        self.scalar_device = scalar_device
         # callable(SelectStmt) -> (python scalar | None, SqlType): runs an
         # uncorrelated scalar subquery at bind time (InitPlan analog)
         self.subquery_executor = subquery_executor
@@ -335,6 +339,19 @@ class Binder:
                                  _dict_ref_of(coded), hidden=True)
                     sel_exprs.append((ci, coded))
                     e = _colref(ci)
+                if not isinstance(e, E.ColRef):
+                    # expression sort key over OUTPUT columns (order by
+                    # sum_sales - avg_monthly_sales): the gather's host
+                    # merge needs plain column keys, so re-express the
+                    # key over the outputs' SOURCE exprs and ride it as
+                    # a hidden projected column
+                    sub = _subst_refs(e, {ci2.id: ex
+                                          for ci2, ex in sel_exprs})
+                    if sub is not None:
+                        ci = ColInfo(self.new_id("ord"), e.type, "?order?",
+                                     _dict_ref_of(e), hidden=True)
+                        sel_exprs.append((ci, sub))
+                        e = _colref(ci)
                 order_keys.append((self._no_raw(e, "sort key"),
                                    oi.desc, oi.nulls_first))
 
@@ -1560,6 +1577,10 @@ class Binder:
                     "supported in WHERE")
             ci = ColInfo(self.new_id(name), e.type, name, _dict_ref_of(e),
                          raw_ref=_raw_ref_of(e), raw_chain=_raw_chain_of(e))
+            if _raw_chain_of(e):
+                # projected raw-text chain: the surrogate decodes + applies
+                # the chain per row at result finalize — a host fallback
+                self._count_scalar(device=False)
             sel_exprs.append((ci, e))
         return scope, sel_exprs
 
@@ -1707,6 +1728,13 @@ class Binder:
                 # scalar function OVER aggregates: round(sum(x), 2)
                 args = [self._rewritten_expr(a, rewrites, scope, allow_plain)
                         for a in ast.args]
+                special = self._bind_device_scalar(ast.name, args)
+                if special is not None:
+                    return special
+                from greengage_tpu.utils import strfuncs
+
+                if ast.name in strfuncs.SPECS and ast.name != "concat":
+                    return self._bind_string_func(ast.name, args)
                 return self._typed_scalar_func(ast.name, len(ast.args), args)
             if isinstance(ast, A.Name):
                 if allow_plain:
@@ -1716,6 +1744,13 @@ class Binder:
             if isinstance(ast, (A.Num, A.Str, A.Null, A.Bool, A.DateLit,
                                 A.ParamRef)):
                 return self._expr(ast, scope)
+            if isinstance(ast, A.ExtractExpr):
+                # the standard EXTRACT(field FROM expr) spelling over
+                # aggregate/group-key references
+                return self._bind_extract(
+                    ast.field,
+                    self._rewritten_expr(ast.arg, rewrites, scope,
+                                         allow_plain))
             clone = _ast_rebind(ast, lambda ch: self._rewritten_expr(
                 ch, rewrites, scope, allow_plain))
             if clone is not None:
@@ -1809,9 +1844,25 @@ class Binder:
                         raise SqlError("IN list must be literals")
                     vals.append(lit.value)
                 if isinstance(arg, E.RawChain):
-                    e = self._host_pred(arg, {
-                        "op": "chain", "chain": [list(s) for s in arg.chain],
-                        "cmp": "in", "value": vals})
+                    e = None
+                    if vals and all(isinstance(v, str) for v in vals):
+                        devs = []
+                        for v in vals:
+                            d0 = self._raw_strop(
+                                arg, arg.chain, "cmp",
+                                literal=v.encode("utf-8"))
+                            if d0 is None:
+                                devs = None
+                                break
+                            devs.append(d0)
+                        if devs:
+                            e = (devs[0] if len(devs) == 1
+                                 else E.BoolOp("or", tuple(devs)))
+                    if e is None:
+                        e = self._host_pred(arg, {
+                            "op": "chain",
+                            "chain": [list(s) for s in arg.chain],
+                            "cmp": "in", "value": vals})
                 else:
                     e = None
                     if vals and all(self._device_raw_eq_ok(arg, v)
@@ -1846,9 +1897,21 @@ class Binder:
             if arg.type.kind is not T.Kind.TEXT:
                 raise SqlError("LIKE requires a text column")
             if isinstance(arg, E.RawChain):
-                e = self._host_pred(arg, {
-                    "op": "chain", "chain": [list(s) for s in arg.chain],
-                    "cmp": "like", "value": ast.pattern})
+                p = ast.pattern
+                e = None
+                if "_" not in p and "\\" not in p:
+                    # chain + %-pattern: byte-op the chain's view, then
+                    # RawLike's greedy matching inside it — all on device
+                    e = self._raw_strop(
+                        arg, arg.chain, "like",
+                        parts=tuple(s.encode("utf-8")
+                                    for s in p.split("%") if s),
+                        anchored_start=not p.startswith("%"),
+                        anchored_end=not p.endswith("%"))
+                if e is None:
+                    e = self._host_pred(arg, {
+                        "op": "chain", "chain": [list(s) for s in arg.chain],
+                        "cmp": "like", "value": ast.pattern})
                 return E.Not(e) if ast.negate else e
             if _raw_ref_of(arg) is not None:
                 p = ast.pattern
@@ -1899,23 +1962,245 @@ class Binder:
                 return self._coerce_literal(a, target)
             return E.Cast(a, target)
         if isinstance(ast, A.ExtractExpr):
-            a = self._expr(ast.arg, scope)
-            if a.type.kind is not T.Kind.DATE:
-                raise SqlError("extract() requires a date")
-            f = ast.field.lower()
-            if f not in ("year", "month", "day"):
-                raise SqlError(f"extract({f}) unsupported")
-            return E.Func(f"extract_{f}", (a,), T.INT32)
+            return self._bind_extract(ast.field, self._expr(ast.arg, scope))
         if isinstance(ast, A.FuncCall):
             if ast.name in ("count", "sum", "avg", "min", "max"):
                 raise SqlError(f"aggregate {ast.name}() not allowed here")
             from greengage_tpu.utils import strfuncs
 
+            special = self._bind_device_scalar(
+                ast.name, [self._expr(a, scope) for a in ast.args])
+            if special is not None:
+                return special
             if ast.name in strfuncs.SPECS and ast.name != "concat":
                 return self._bind_string_func(
                     ast.name, [self._expr(a, scope) for a in ast.args])
             return self._bind_scalar_func(ast, scope)
         raise SqlError(f"cannot bind {type(ast).__name__}")
+
+    # ---- device scalar library (ops/scalar.py) -------------------------
+    def _bind_extract(self, field: str, a: E.Expr) -> E.Expr:
+        from greengage_tpu.ops import scalar as scalar_ops
+
+        f = field.lower()
+        if f not in scalar_ops.extract_fields():
+            raise SqlError(f"extract({f}) unsupported")
+        if a.type.kind is not T.Kind.DATE:
+            raise SqlError("extract() requires a date")
+        rt = scalar_ops.FIELD_RESULT[f]
+        if isinstance(a, E.Literal):
+            # constant-fold via the same civil algebra (1-row host eval)
+            if a.value is None:
+                return E.Literal(None, rt)
+            return E.Literal(self._fold_func(E.Func(f"extract_{f}", (a,), rt)),
+                             rt)
+        self._count_scalar(device=True)
+        return E.Func(f"extract_{f}", (a,), rt)
+
+    def _fold_func(self, e: E.Func):
+        """Evaluate a device scalar Func over literal args on the host (a
+        1-row trace through the same registry implementation — bind-time
+        constant folding that can never drift from device semantics)."""
+        import jax.numpy as jnp
+        import numpy as np_
+
+        from greengage_tpu.ops import scalar as scalar_ops
+
+        args = [(jnp.asarray([a.value], dtype=a.type.np_dtype), None)
+                for a in e.args]
+        v, _valid = scalar_ops.lookup(e.name).apply(e, args, 1)
+        return np_.asarray(v)[0].item()
+
+    def _bind_device_scalar(self, name: str, args: list) -> E.Expr | None:
+        """Lower the non-strfuncs device scalar forms (ops/scalar.py):
+        date_trunc/date_part, coalesce/nullif/greatest/least, and the
+        DECIMAL-exact round/trunc/mod. -> None when ``name`` isn't one of
+        them (caller falls through to strfuncs / the extension registry)."""
+        name = name.lower()
+        if name == "date_trunc":
+            from greengage_tpu.ops import scalar as scalar_ops
+
+            if len(args) != 2:
+                raise SqlError("date_trunc() takes (field, date)")
+            f = self._req_text_lit(args[0], "date_trunc() field").lower()
+            if f not in scalar_ops.trunc_fields():
+                raise SqlError(f"date_trunc({f!r}) unsupported")
+            d = args[1]
+            if d.type.kind is not T.Kind.DATE:
+                raise SqlError("date_trunc() requires a date")
+            e = E.Func("date_trunc", (d,), T.DATE, params=(f,))
+            if isinstance(d, E.Literal):
+                return (E.Literal(None, T.DATE) if d.value is None
+                        else E.Literal(self._fold_func(e), T.DATE))
+            self._count_scalar(device=True)
+            return e
+        if name == "date_part":
+            if len(args) != 2:
+                raise SqlError("date_part() takes (field, date)")
+            return self._bind_extract(
+                self._req_text_lit(args[0], "date_part() field"), args[1])
+        if name == "coalesce":
+            if not args:
+                raise SqlError("coalesce() requires arguments")
+            args = self._common_type(args, "coalesce")
+            if len(args) == 1:
+                return args[0]
+            e = E.Func("coalesce", tuple(args), args[0].type)
+            d = _dict_ref_of(args[0])
+            if d is not None:
+                object.__setattr__(e, "_dict_ref", d)
+            self._count_scalar(device=True)
+            return e
+        if name == "nullif":
+            if len(args) != 2:
+                raise SqlError("nullif() takes two arguments")
+            le, re_ = args
+            # TEXT vs a literal ABSENT from the dictionary: equality can
+            # never hold, so nullif folds to its first argument (coercing
+            # through _coerce_pair would leave the -1 sentinel code,
+            # which decodes to NULL — a silently wrong value)
+            if isinstance(le, E.Literal) and isinstance(re_, E.Literal) \
+                    and le.type.kind is T.Kind.TEXT \
+                    and re_.type.kind is T.Kind.TEXT:
+                if le.value is None or le.value == re_.value:
+                    return E.Literal(None, T.TEXT)
+                return self._text_literal_to_dict(le)
+            for a, b in ((le, re_), (re_, le)):
+                if isinstance(b, E.Literal) and isinstance(b.value, str) \
+                        and b.type.kind is T.Kind.TEXT \
+                        and _dict_ref_of(a) is not None \
+                        and self.store.dictionary(
+                            *_dict_ref_of(a)).lookup(b.value) < 0:
+                    return self._text_literal_to_dict(le) \
+                        if isinstance(le, E.Literal) else le
+            le, re_ = self._coerce_pair(le, re_)
+            e = E.Func("nullif", (le, re_), le.type)
+            # a coerced first-argument literal carries codes in the OTHER
+            # side's dictionary space — decode through that
+            d = _dict_ref_of(le) or _dict_ref_of(re_)
+            if d is not None and le.type.kind is T.Kind.TEXT:
+                object.__setattr__(e, "_dict_ref", d)
+            self._count_scalar(device=True)
+            return e
+        if name in ("greatest", "least"):
+            if len(args) < 2:
+                raise SqlError(f"{name}() requires at least two arguments")
+            args = self._common_type(args, name)
+            if args[0].type.kind is T.Kind.TEXT:
+                raise SqlError(f"{name}() over text is not supported")
+            self._count_scalar(device=True)
+            return E.Func(name, tuple(args), args[0].type)
+        if name in ("round", "trunc") and args \
+                and args[0].type.kind is T.Kind.DECIMAL:
+            if len(args) > 2:
+                raise SqlError(f"{name}() takes at most two arguments")
+            digits = 0
+            if len(args) == 2:
+                lit = args[1]
+                if not isinstance(lit, E.Literal) or lit.type.kind not in (
+                        T.Kind.INT32, T.Kind.INT64):
+                    raise SqlError(
+                        f"{name}() digits must be an integer literal")
+                digits = int(lit.value)
+            s = args[0].type.scale
+            rt = T.decimal(max(digits, 0))
+            self._count_scalar(device=True)
+            return E.Func(f"{name}_dec", (args[0],), rt, params=(s, digits))
+        if name == "mod" and len(args) == 2 and any(
+                a.type.kind is T.Kind.DECIMAL for a in args):
+            for a in args:
+                if a.type.kind is T.Kind.DECIMAL:
+                    continue
+                if not a.type.is_integer:
+                    raise SqlError("mod() over decimals takes numeric args")
+            ls = args[0].type.scale if args[0].type.kind is T.Kind.DECIMAL else 0
+            rs = args[1].type.scale if args[1].type.kind is T.Kind.DECIMAL else 0
+            out = max(ls, rs)
+            self._count_scalar(device=True)
+            return E.Func("mod_dec", tuple(args), T.decimal(out),
+                          params=(ls, rs, out))
+        return None
+
+    @staticmethod
+    def _req_text_lit(e: E.Expr, what: str) -> str:
+        if not (isinstance(e, E.Literal) and isinstance(e.value, str)):
+            raise SqlError(f"{what} must be a string literal")
+        return e.value
+
+    def _common_type(self, args: list, fname: str) -> list:
+        """Coerce a variadic argument list to one common type (coalesce /
+        greatest / least): promote across numerics/dates, pin TEXT
+        literals to the first dictionary-bearing argument's code space."""
+        t = args[0].type
+        for a in args[1:]:
+            if a.type.kind is T.Kind.TEXT and t.kind is T.Kind.TEXT:
+                continue
+            t = T.promote(t, a.type)
+        if t.kind is T.Kind.TEXT:
+            args = [self._raw_to_codes(a) or a
+                    if _raw_ref_of(a) is not None else a for a in args]
+            d = next((x for x in (_dict_ref_of(a) for a in args)
+                      if x is not None), None)
+            if d is None:
+                raise SqlError(
+                    f"{fname}() over text requires a "
+                    "dictionary-backed column argument")
+            for a in args:
+                if not isinstance(a, E.Literal) and _dict_ref_of(a) != d:
+                    raise SqlError(
+                        f"{fname}() over text columns from different "
+                        "dictionaries is not supported")
+            dic = self.store.dictionary(*d)
+            lits = [a.value for a in args
+                    if isinstance(a, E.Literal) and isinstance(a.value, str)]
+            missing = [v for v in dict.fromkeys(lits) if dic.lookup(v) < 0]
+            if missing:
+                # a fallback literal ABSENT from the column's dictionary:
+                # its -1 sentinel code would decode back to NULL — the
+                # exact value coalesce exists to supply. Re-code every
+                # argument into a derived dictionary that contains it.
+                ref = self.store.derived_dictionary(
+                    list(dic.values) + missing)
+                dd = self.store.dictionary(*ref)
+                trans = np.array([dd.lookup(v) for v in dic.values] + [-1],
+                                 dtype=np.int32)
+                tid = self._const(trans)
+                d, dic = ref, dd
+                out = []
+                for a in args:
+                    if isinstance(a, E.Literal):
+                        out.append(a)
+                    else:
+                        lut = E.Lut(a, tid, type=T.TEXT)
+                        object.__setattr__(lut, "_dict_ref", ref)
+                        out.append(lut)
+                args = out
+            out = []
+            for a in args:
+                if isinstance(a, E.Literal) and a.value is not None \
+                        and isinstance(a.value, str):
+                    a = E.Literal(dic.lookup(a.value), T.TEXT)
+                out.append(a)
+            for a in out:
+                object.__setattr__(a, "_dict_ref", d)
+            return out
+        out = []
+        for a in args:
+            if isinstance(a, E.Literal):
+                out.append(self._coerce_literal(a, t))
+            elif a.type != t:
+                out.append(E.Cast(a, t))
+            else:
+                out.append(a)
+        return out
+
+    def _count_scalar(self, device: bool) -> None:
+        from greengage_tpu.runtime.logger import counters
+
+        if device:
+            counters.inc("scalar_device_total")
+        else:
+            counters.inc("scalar_host_fallback_total")
 
     # ---- string functions ---------------------------------------------
     def _bind_string_func(self, name: str, args: list) -> E.Expr:
@@ -1998,6 +2283,14 @@ class Binder:
         if isinstance(subject, E.RawChain) or _raw_ref_of(subject) is not None:
             base = subject.arg if isinstance(subject, E.RawChain) else subject
             prev = _raw_chain_of(subject) or ()
+            if kind == "int":
+                # length(chain) over raw TEXT: the byte-window view's
+                # length is a plain device int32 — usable in projections,
+                # predicates, and aggregates with no host decode
+                dev = self._raw_strop(subject, prev + (tuple(step),),
+                                      "length")
+                if dev is not None:
+                    return dev
             t = T.TEXT if kind == "str" else T.INT32
             rc = E.RawChain(base, prev + (tuple(step),), t)
             object.__setattr__(rc, "_raw_ref", _raw_ref_of(subject))
@@ -2012,6 +2305,7 @@ class Binder:
                     for v in dic.values]
         except (ValueError, TypeError) as ex:
             raise SqlError(f"{step[0]}(): {ex}")
+        self._count_scalar(device=True)   # dict LUT rides the fused program
         if kind == "int":
             lut = np.array(list(outs) + [0], dtype=np.int32)
             return E.Lut(subject, self._const(lut), type=T.INT32)
@@ -2210,6 +2504,52 @@ class Binder:
             anchored_start=not pattern.startswith("%"),
             anchored_end=not pattern.endswith("%"))
 
+    def _raw_strop(self, arg: E.Expr, steps: tuple, out: str,
+                   **kw) -> E.Expr | None:
+        """DEVICE lowering for scalar string-function chains over raw TEXT
+        (the byte-op half of ops/scalar.py; docs/PERF.md "Scalar data-path
+        fusion"): stage the column's wide byte window (@rw lanes + @rl
+        length) and evaluate the chain + terminal op as elementwise work
+        inside the fused program. None -> caller falls back to the host
+        chain (counted in scalar_host_fallback_total). Gates:
+
+        * the GUC scalar_device_enabled is on;
+        * every chain step is byte-window-expressible (scalar.RAW_STEPS);
+        * every committed row fits the staged window (raw_max_len — a
+          longer row could match/measure past it);
+        * the column is pure ASCII where the chain counts characters
+          (upper/lower/substr/length — bytes == characters only then)."""
+        from greengage_tpu.ops import scalar as scalar_ops
+        from greengage_tpu.storage.table_store import (RAW_WIDE_BYTES,
+                                                       RAW_WIDE_WORDS)
+
+        if not self.scalar_device:
+            return None
+        base = arg.arg if isinstance(arg, E.RawChain) else arg
+        rr = _raw_ref_of(arg)
+        if rr is None or not isinstance(base, E.ColRef) \
+                or base.name not in self._scan_for:
+            return None
+        ok, needs_ascii = scalar_ops.raw_steps_ok(steps)
+        if not ok:
+            return None
+        table, col = rr
+        if self.store.raw_max_len(table, col) > RAW_WIDE_BYTES:
+            return None
+        if needs_ascii and not self.store.raw_is_ascii(table, col):
+            return None
+        scan = self._scan_for[base.name]
+        rl = self._raw_aux_col(scan, f"@rl:{col}", T.INT32)
+        nlanes = min(max(-(-self.store.raw_max_len(table, col) // 8), 1),
+                     RAW_WIDE_WORDS)
+        words = tuple(
+            self._raw_aux_col(scan, f"@rw:{col}:{w}", T.INT64)
+            for w in range(nlanes))
+        self._count_scalar(device=True)
+        return E.RawStrOp(
+            words=words, length=rl, steps=tuple(tuple(s) for s in steps),
+            out=out, type=T.INT32 if out == "length" else T.BOOL, **kw)
+
     def _host_pred(self, arg: E.Expr, payload: dict) -> E.Expr:
         """Lower a predicate over a raw TEXT column into a host-evaluated
         boolean staged with the scan (the dictionary-LUT strategy at
@@ -2220,6 +2560,11 @@ class Binder:
             raise SqlError(
                 "predicates on raw-encoded text are only supported directly "
                 "on base-table columns")
+        if payload.get("op") == "chain":
+            # a scalar function chain the device paths couldn't express:
+            # the retained per-row host fallback, counted so the fused
+            # coverage claim stays measurable
+            self._count_scalar(device=False)
         scan = self._scan_for[base.name]
         name = self.store.host_pred_name(rr[1], payload)
         return self._raw_aux_col(scan, name, T.BOOL)
@@ -2254,6 +2599,11 @@ class Binder:
                         raise SqlError(
                             "raw-text function result compared to non-string")
                     val = b.value
+                    if op in ("=", "<>") and isinstance(val, str):
+                        dev = self._raw_strop(a, a.chain, "cmp",
+                                              literal=val.encode("utf-8"))
+                        if dev is not None:
+                            return E.Not(dev) if op == "<>" else dev
                 else:
                     if not isinstance(b.value, (int, float)):
                         raise SqlError(
@@ -2347,13 +2697,30 @@ class Binder:
 
     # ---- date +/- interval constant folding ---------------------------
     def _bind_arith(self, ast: A.Bin, scope) -> E.Expr:
-        # date +/- interval folds at bind time (calendar math on host)
+        # date +/- interval: literal bases fold at bind time (calendar math
+        # on host); column bases lower to device civil math (ops/scalar.py
+        # add_months; day units are plain day arithmetic)
         if isinstance(ast.right, A.IntervalLit) and ast.op in ("+", "-"):
             base = self._expr(ast.left, scope)
-            if base.type.kind is not T.Kind.DATE or not isinstance(base, E.Literal):
-                raise SqlError("interval arithmetic requires a date literal")
-            days = _apply_interval(base.value, ast.right, ast.op)
-            return E.Literal(days, T.DATE)
+            if base.type.kind is not T.Kind.DATE:
+                raise SqlError("interval arithmetic requires a date")
+            if isinstance(base, E.Literal):
+                days = _apply_interval(base.value, ast.right, ast.op)
+                return E.Literal(days, T.DATE)
+            iv = ast.right
+            n = int(iv.value)
+            if ast.op == "-":
+                n = -n
+            if iv.unit.startswith("day"):
+                return E.BinOp("+", base, E.Literal(n, T.INT32), T.DATE)
+            if iv.unit.startswith("week"):
+                return E.BinOp("+", base, E.Literal(7 * n, T.INT32), T.DATE)
+            if iv.unit.startswith("month") or iv.unit.startswith("year"):
+                months = n * (12 if iv.unit.startswith("year") else 1)
+                self._count_scalar(device=True)
+                return E.Func("add_months", (base,), T.DATE,
+                              params=(months,))
+            raise SqlError(f"interval unit {iv.unit} unsupported")
         le = self._expr(ast.left, scope)
         re_ = self._expr(ast.right, scope)
         self._no_rawchain(le, "arithmetic")
@@ -2430,6 +2797,51 @@ def _merge_filter(node, pred):
         node.predicate = E.BoolOp("and", (node.predicate, pred))
         return node
     return Filter(node, pred)
+
+
+_SUBST_FAIL = object()
+
+
+def _subst_refs(e: E.Expr, mapping: dict):
+    """Replace ColRefs (by id) with their mapped source expressions,
+    rebuilding the tree; -> None when any part can't be rebuilt (caller
+    keeps the original expression and its original constraints)."""
+    def walk(v):
+        if isinstance(v, E.ColRef):
+            hit = mapping.get(v.name)
+            return hit if hit is not None else v
+        if isinstance(v, E.Expr):
+            if not dataclasses.is_dataclass(v):
+                return _SUBST_FAIL
+            changes = {}
+            for fld in dataclasses.fields(v):
+                old = getattr(v, fld.name)
+                new = walk(old)
+                if new is _SUBST_FAIL:
+                    return _SUBST_FAIL
+                if new is not old:
+                    changes[fld.name] = new
+            if not changes:
+                return v
+            out = dataclasses.replace(v, **changes)
+            for attr in ("_dict_ref", "_raw_ref", "_raw_chain",
+                         "_rank_space", "_rank_bits"):
+                if hasattr(v, attr):
+                    object.__setattr__(out, attr, getattr(v, attr))
+            return out
+        if isinstance(v, tuple):
+            outs = []
+            for x in v:
+                nx = walk(x)
+                if nx is _SUBST_FAIL:
+                    return _SUBST_FAIL
+                outs.append(nx)
+            return (tuple(outs) if any(a is not b for a, b in zip(outs, v))
+                    else v)
+        return v
+
+    res = walk(e)
+    return None if res is _SUBST_FAIL else res
 
 
 def _colref(c: ColInfo) -> E.ColRef:
@@ -2645,20 +3057,29 @@ def _gs_rewrite(node, present: set, universe: set):
 
 def _ast_rebind(ast, rec):
     """Rebuild scalar AST nodes whose children may contain agg/key refs."""
+    def cmp(op, l, r):
+        lt, rt = l.type, r.type
+        if lt != rt:
+            common = T.promote(lt, rt)
+            if lt != common:
+                l = E.Cast(l, common)
+            if rt != common:
+                r = E.Cast(r, common)
+        return E.Cmp(op, l, r)
+
+    if isinstance(ast, A.Between):
+        # HAVING-over-aggregate ratios (TPC-DS Q21): BETWEEN desugars to
+        # the two comparisons here, the same as plain-expression binding
+        arg, lo, hi = rec(ast.arg), rec(ast.lo), rec(ast.hi)
+        e = E.BoolOp("and", (cmp(">=", arg, lo), cmp("<=", arg, hi)))
+        return E.Not(e) if ast.negate else e
     if isinstance(ast, A.Bin):
         l = rec(ast.left)
         r = rec(ast.right)
         if ast.op in ("and", "or"):
             return E.BoolOp(ast.op, (l, r))
         if ast.op in ("=", "<>", "<", "<=", ">", ">="):
-            lt, rt = l.type, r.type
-            if lt != rt:
-                common = T.promote(lt, rt)
-                if lt != common:
-                    l = E.Cast(l, common)
-                if rt != common:
-                    r = E.Cast(r, common)
-            return E.Cmp(ast.op, l, r)
+            return cmp(ast.op, l, r)
         return E.BinOp(ast.op, l, r, T.arith_result(ast.op, l.type, r.type))
     if isinstance(ast, A.Unary) and ast.op == "-":
         a = rec(ast.arg)
@@ -2767,6 +3188,8 @@ def _apply_interval(days: int, iv: A.IntervalLit, op: str) -> int:
     d = np.datetime64("1970-01-01", "D") + np.timedelta64(days, "D")
     if iv.unit.startswith("day"):
         d = d + np.timedelta64(n, "D")
+    elif iv.unit.startswith("week"):
+        d = d + np.timedelta64(7 * n, "D")
     elif iv.unit.startswith("month"):
         m = d.astype("datetime64[M]") + np.timedelta64(n, "M")
         dom = (d - d.astype("datetime64[M]")).astype(int)

@@ -26,6 +26,8 @@ Safe/unsafe classification (docs/PERF.md "Plan cache"):
     word-compare rewrites are all bind-time value rewrites);
   - any comparison against a partition key (static partition pruning
     changes the staged input spec and capacities);
+  - any comparison against ``extract(year from col)`` (the planner
+    derives zone-map day bounds from the year value at plan time);
   - equality against a hash-distribution key (direct dispatch pins the
     scan to one segment in the input spec);
   - IN lists, string-function arguments, CAST operands, interval
@@ -164,7 +166,15 @@ class _Paramizer:
         return A.ParamRef(idx, t, est_value=v)
 
     def _pinned_name(self, node, op: str) -> bool:
-        """Is ``node`` a bare column whose comparisons must stay literal?"""
+        """Is ``node`` an operand whose comparisons must stay literal?"""
+        if isinstance(node, A.ExtractExpr) and node.field.lower() == "year" \
+                and isinstance(node.arg, A.Name):
+            # extract(year from col) <op> literal: the planner derives
+            # zone-map day bounds on the base column from the literal at
+            # plan time (planner._year_prune) — hoisting the year would
+            # make the TPC-DS date-filter pruning inert, so it stays in
+            # the cache key like partition-key comparisons do
+            return True
         if not isinstance(node, A.Name):
             return False
         name = node.parts[-1]

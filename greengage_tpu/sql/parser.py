@@ -586,7 +586,18 @@ class Parser:
             t = self.peek()
             if t[0] == "op" and t[1] in ("+", "-", "||"):
                 self.next()
-                e = A.Bin(t[1], e, self.mul_expr())
+                rhs = self.mul_expr()
+                # the official TPC-DS interval spelling: `date + 30 days`
+                # (gram.y accepts the bare unit postfix only right after
+                # an additive op, so `select 1 days` stays an alias)
+                if t[1] in ("+", "-") and isinstance(rhs, A.Num) \
+                        and self.peek()[0] == "name" \
+                        and self.peek()[1] in ("day", "days", "week",
+                                               "weeks", "month", "months",
+                                               "year", "years"):
+                    unit = self.next()[1].rstrip("s")
+                    rhs = A.IntervalLit(rhs.text, unit)
+                e = A.Bin(t[1], e, rhs)
             else:
                 return e
 

@@ -1046,8 +1046,7 @@ class TableStore:
                 continue
             if name.startswith("@rl:"):
                 rcol = name[4:]
-                _w, lens = self.raw_prefix(table, seg, rcol, snap)
-                cols[name] = lens
+                cols[name] = self.raw_lengths(table, seg, rcol, snap)
                 valids[name] = self.raw_chunk(table, seg, rcol, snap).valid
                 continue
             c = schema.column(name)
@@ -1188,6 +1187,51 @@ class TableStore:
                 best = max(best, int((ends - starts).max()))
         self._rawprefix_cache.put(key, best, version=version)
         return best
+
+    def raw_lengths(self, table: str, seg: int, col: str, snapshot=None):
+        """Exact byte lengths of a raw column's rows for one segment —
+        O(rows) offset subtraction straight off the chunk, WITHOUT the
+        byte-window packing raw_prefix pays (an @rl-only consumer, e.g.
+        ``length(col)`` device chains, must not fund word lanes it never
+        reads). Cached per version under the same key raw_prefix shares,
+        so either producer serves later readers."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        lkey = ("@len", table, col, seg, version)
+        hit = self._rawprefix_cache.get(lkey, MISS)
+        if hit is not MISS:
+            return hit
+        chunk = self.raw_chunk(table, seg, col, snap)
+        ends = chunk.ends
+        starts = (np.concatenate([np.zeros(1, np.int64), ends[:-1]])
+                  if len(ends) else np.zeros(0, np.int64))
+        lengths = (ends - starts).astype(np.int32)
+        self._rawprefix_cache.put(lkey, lengths, version=version)
+        return lengths
+
+    def raw_is_ascii(self, table: str, col: str, snapshot=None) -> bool:
+        """True when every committed byte of a raw column is < 0x80
+        (cached per version) — gates the byte-window scalar lowerings
+        whose semantics count CHARACTERS (upper/lower/substr/length):
+        over pure ASCII, bytes and characters coincide, so the device
+        byte ops are exact; otherwise those chains stay on the host."""
+        snap = snapshot or self.manifest.snapshot()
+        version = snap.get("version", 0)
+        key = ("@ascii", table, col, version)
+        hit = self._rawprefix_cache.get(key, MISS)
+        if hit is not MISS:
+            return hit
+        schema = self.catalog.get(table)
+        ok = True
+        for seg in range(schema.policy.numsegments):
+            chunk = self.raw_chunk(table, seg, col, snap)
+            if len(chunk.ends):
+                blob = chunk.blob()
+                if len(blob) and int(blob.max()) >= 0x80:
+                    ok = False
+                    break
+        self._rawprefix_cache.put(key, ok, version=version)
+        return ok
 
     def raw_prefix(self, table: str, seg: int, col: str, snapshot=None,
                    nwords: int = RAW_PREFIX_WORDS):

@@ -47,11 +47,13 @@ def apply(name: str, s: str, *extra):
     if name == "lower":
         return s.lower()
     if name == "trim":
-        return s.strip()
+        # PG btrim strips SPACES only by default (not all whitespace) —
+        # and so does the device byte-window path (ops/scalar.py)
+        return s.strip(" ")
     if name == "ltrim":
-        return s.lstrip(extra[0]) if extra else s.lstrip()
+        return s.lstrip(extra[0]) if extra else s.lstrip(" ")
     if name == "rtrim":
-        return s.rstrip(extra[0]) if extra else s.rstrip()
+        return s.rstrip(extra[0]) if extra else s.rstrip(" ")
     if name in ("substring", "substr"):
         start = int(extra[0])
         if len(extra) == 1:

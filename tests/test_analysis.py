@@ -378,6 +378,23 @@ def test_tracer_sync_violation_detected(tmp_path):
     assert any("also_bad" in k and "float()" in k for k in keys)
 
 
+def test_tracer_lint_covers_scalar_library():
+    """ISSUE 13: the device scalar library (ops/scalar.py) is inside the
+    tracer lint's jit-traced scope — its byte-window/date kernels run
+    under trace, so a host sync there is the PR-5 bug class. Guard the
+    scope (the /ops/ glob must keep matching it) and its cleanliness."""
+    from greengage_tpu.analysis import astutil, lint_tracer
+
+    sources = astutil.SourceSet()
+    rels = {s.rel.replace("\\", "/") for s in sources}
+    assert any(r.endswith("ops/scalar.py") for r in rels), \
+        sorted(r for r in rels if "/ops/" in r)
+    rep = lint_tracer.run(sources)
+    scalar_findings = [f for f in rep.findings
+                       if f.path.endswith("ops/scalar.py")]
+    assert scalar_findings == [], scalar_findings
+
+
 def test_lockdebug_runtime_inversion():
     import threading
 

@@ -1,0 +1,379 @@
+"""Scalar-function edge semantics + data-path-fusion contracts (ISSUE 13).
+
+Four contract families over the device scalar library (ops/scalar.py):
+NULL propagation (strict functions and the NULL-aware constructs),
+DECIMAL-exact round/trunc/mod scale behavior (half-away-from-zero, not
+the float path's half-to-even), dictionary-LUT vs raw byte-window vs
+host-chain parity on identical strings, and the LUT cache-key contract —
+a DML that grows a dictionary recompiles the LUT-bearing executable
+(PR-5 dictionary-fingerprint keys) instead of serving stale tables.
+
+The fusion acceptance (ISSUE 13): the corpus's scalar shapes plan with
+ZERO host materialization between scan and agg — no @hp host-predicate
+columns, no RawChain finalize decodes, scalar_host_fallback_total
+untouched — while the scalar work runs inside the compiled program."""
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.planner.logical import Scan
+from greengage_tpu.runtime.logger import counters
+from greengage_tpu.sql.parser import parse
+
+STRS = ["  Hello World  ", "promoXYZ", "abcdef", "MiXeD", "promo", ""]
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table s (k int, d date, v decimal(7,2), a int, "
+          "cdict text, craw text) distributed by (k)")
+    object.__setattr__(d.catalog.get("s").column("craw"), "encoding", "raw")
+    n = len(STRS)
+    d.load_table("s", {
+        "k": np.arange(n, dtype=np.int32),
+        # 2000-01-01, 2000-02-29, 2000-12-31, 2001-03-01, x, x
+        "d": np.array([10957, 11016, 11322, 11382, 0, 1], dtype=np.int32),
+        "v": np.array([12345, 5, 12500, -12345, 770, 0], dtype=np.int64),
+        "a": np.array([1, 2, 3, 4, 5, 6], dtype=np.int32),
+        "cdict": np.array(STRS, dtype=object),
+        "craw": np.array(STRS, dtype=object),
+    }, valids={
+        "d": np.array([1, 1, 1, 1, 0, 0], dtype=bool),
+        "v": np.array([1, 1, 1, 1, 1, 0], dtype=bool),
+        "a": np.array([1, 1, 1, 0, 1, 1], dtype=bool),
+        "cdict": None, "craw": None, "k": None,
+    })
+    return d
+
+
+def _col(db, q):
+    return [r[0] for r in db.sql(q).rows()]
+
+
+# ----------------------------------------------------------------------
+# NULL propagation
+# ----------------------------------------------------------------------
+
+def test_strict_null_propagation_dates(db):
+    # rows 4 and 5 carry NULL d: every date function must yield NULL there
+    for expr in ("extract(year from d)", "extract(quarter from d)",
+                 "extract(dow from d)", "extract(doy from d)",
+                 "extract(week from d)", "extract(epoch from d)",
+                 "date_trunc('month', d)", "date_trunc('year', d)",
+                 "d + interval '1' month", "d - interval '2' year",
+                 "date_part('decade', d)"):
+        vals = _col(db, f"select {expr} from s order by k")
+        assert vals[4] is None and vals[5] is None, expr
+        assert all(v is not None for v in vals[:4]), expr
+
+
+def test_strict_null_propagation_numeric(db):
+    for expr in ("round(v, 1)", "round(v)", "trunc(v, 1)", "mod(v, 1.5)",
+                 "abs(v)"):
+        vals = _col(db, f"select {expr} from s order by k")
+        assert vals[5] is None, expr
+        assert all(v is not None for v in vals[:5]), expr
+
+
+def test_mod_by_zero_is_null(db):
+    assert _col(db, "select mod(v, 0.0) from s where k = 0") == [None]
+    assert _col(db, "select mod(a, 0) from s where k = 0") == [None]
+
+
+def test_coalesce_semantics(db):
+    # a is NULL at k=3: coalesce falls through; all-NULL stays NULL
+    assert _col(db, "select coalesce(a, 0 - 1) from s order by k") == \
+        [1, 2, 3, -1, 5, 6]
+    assert _col(db, "select coalesce(a, a, a) from s where k = 3") == [None]
+    # first non-null wins even when later args are NULL
+    assert _col(db, "select coalesce(a, v) from s where k = 5") == [6.0]
+
+
+def test_nullif_semantics(db):
+    assert _col(db, "select nullif(a, 2) from s order by k") == \
+        [1, None, 3, None, 5, 6]
+    # NULL argument: comparison unknown -> first argument passes through
+    assert _col(db, "select nullif(a, v) from s where k = 5") is not None
+
+
+def test_greatest_least_ignore_nulls(db):
+    # PG semantics: NULLs are ignored; NULL only when ALL arguments are
+    assert _col(db, "select greatest(a, 3) from s order by k") == \
+        [3, 3, 3, 3, 5, 6]
+    assert _col(db, "select least(a, 3) from s order by k") == \
+        [1, 2, 3, 3, 3, 3]
+    # k=3: a NULL -> greatest(a, 4) = 4, not NULL
+    assert _col(db, "select greatest(a, 4) from s where k = 3") == [4]
+    assert _col(db, "select greatest(a, a) from s where k = 3") == [None]
+
+
+# ----------------------------------------------------------------------
+# DECIMAL scale semantics (round half AWAY from zero — numeric.c)
+# ----------------------------------------------------------------------
+
+def test_round_decimal_half_away(db):
+    # 123.45 -> 123.5 / -123.45 -> -123.5; the float64 path's
+    # half-to-even would give 123.4 / -123.4
+    assert _col(db, "select round(v, 1) from s where k = 0") == [123.5]
+    assert _col(db, "select round(v, 1) from s where k = 3") == [-123.5]
+    # 0.05 -> 0.1 (float round(0.5) is 0.0)
+    assert _col(db, "select round(v, 1) from s where k = 1") == [0.1]
+
+
+def test_round_decimal_negative_digits(db):
+    # 125.00 rounded to tens: half away -> 130 (float half-to-even: 120)
+    assert _col(db, "select round(v, -1) from s where k = 2") == [130.0]
+
+
+def test_trunc_decimal(db):
+    assert _col(db, "select trunc(v, 1) from s where k = 0") == [123.4]
+    assert _col(db, "select trunc(v, 1) from s where k = 3") == [-123.4]
+
+
+def test_mod_decimal_exact(db):
+    # 7.70 mod 1.5 = 0.2 EXACT (the float path leaves 0.20000000000000018)
+    assert _col(db, "select mod(v, 1.5) from s where k = 4") == [0.2]
+    # sign follows the dividend (numeric.c truncation semantics)
+    assert _col(db, "select mod(v, 2.0) from s where k = 3") == [-1.45]
+
+
+def test_round_over_aggregate(db):
+    # scalar-over-aggregate path (_rewritten_expr): sum(v) is DECIMAL(2)
+    got = _col(db, "select round(sum(v), 1) from s where k < 3")
+    # 123.45 + 0.05 + 125.00 = 248.50 -> round(., 1) = 248.5 exactly
+    assert got == [248.5]
+
+
+# ----------------------------------------------------------------------
+# dict-LUT vs raw byte-window vs host-chain parity
+# ----------------------------------------------------------------------
+
+_PARITY = [
+    ("upper({c})", None),
+    ("lower({c})", None),
+    ("length({c})", None),
+    ("length(trim({c}))", None),
+    (None, "upper({c}) = 'PROMO'"),
+    (None, "substr({c}, 1, 5) = 'promo'"),
+    (None, "trim({c}) like 'Hello%'"),
+    (None, "upper({c}) like '%PROMO%'"),
+    (None, "length({c}) > 5"),
+]
+
+
+def test_dict_vs_raw_parity(db):
+    for proj, pred in _PARITY:
+        if proj is not None:
+            qd = f"select {proj.format(c='cdict')} from s order by k"
+            qr = f"select {proj.format(c='craw')} from s order by k"
+        else:
+            qd = f"select k from s where {pred.format(c='cdict')} order by k"
+            qr = f"select k from s where {pred.format(c='craw')} order by k"
+        assert _col(db, qd) == _col(db, qr), (proj, pred)
+
+
+def test_device_off_guc_parity(db):
+    """scalar_device_enabled=off falls back to the host chains — same
+    answers, counted as host fallbacks (the microbench baseline path)."""
+    q = "select k from s where upper(craw) = 'PROMO' order by k"
+    on = _col(db, q)
+    db.sql("set scalar_device_enabled = off")
+    try:
+        c0 = counters.snapshot()
+        off = _col(db, "select k from s where upper(craw) = 'PROMO' "
+                       "order by k  -- host")
+        assert on == off == [4]
+        assert counters.since(c0).get("scalar_host_fallback_total", 0) >= 1
+    finally:
+        db.sql("set scalar_device_enabled = on")
+
+
+def test_nonascii_raw_falls_back_correctly(db):
+    """Non-ASCII raw data fails the byte-window ascii gate: the chain runs
+    on the host (counted) and still answers correctly."""
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table nr (k int, c text) distributed by (k)")
+    object.__setattr__(d.catalog.get("nr").column("c"), "encoding", "raw")
+    d.load_table("nr", {"k": np.arange(3, dtype=np.int32),
+                        "c": np.array(["café", "cafe", "CAFÉ"],
+                                      dtype=object)})
+    c0 = counters.snapshot()
+    got = [r[0] for r in d.sql(
+        "select k from nr where upper(c) = 'CAFÉ' order by k").rows()]
+    assert got == [0, 2]
+    assert counters.since(c0).get("scalar_host_fallback_total", 0) >= 1
+
+
+def test_coalesce_fallback_absent_from_dictionary(devices8):
+    """Review finding: a coalesce fallback literal ABSENT from the
+    column's dictionary must come back as the string, not decode to NULL
+    through the -1 sentinel (the binder re-codes through a derived
+    dictionary that contains it)."""
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table ct (k int, c text) distributed by (k)")
+    d.load_table("ct", {"k": np.array([0, 1], np.int32),
+                        "c": np.array(["alpha", "beta"], dtype=object)},
+                 valids={"k": None,
+                         "c": np.array([True, False])})
+    got = [r[0] for r in d.sql(
+        "select coalesce(c, 'zzz') from ct order by k").rows()]
+    assert got == ["alpha", "zzz"]
+    # present-in-dictionary fallback still works
+    got = [r[0] for r in d.sql(
+        "select coalesce(c, 'alpha') from ct order by k").rows()]
+    assert got == ["alpha", "alpha"]
+
+
+def test_nullif_text_literal_first(db):
+    """Review finding: nullif('lit', col) must return STRINGS (codes in
+    the column's dictionary space decode through it), and an absent
+    literal folds to itself — never a bare int or a sentinel NULL."""
+    got = [r[0] for r in db.sql(
+        "select nullif('promo', cdict) from s order by k").rows()]
+    assert got == ["promo", "promo", "promo", "promo", None, "promo"]
+    got = [r[0] for r in db.sql(
+        "select nullif('zzz', cdict) from s where k = 0").rows()]
+    assert got == ["zzz"]
+    assert db.sql("select nullif('a', 'a') from s where k = 0").rows() \
+        == [(None,)]
+
+
+def test_empty_like_pattern_on_chain(db):
+    """Review finding: chain LIKE '' matches only empty strings (not
+    every row); '%' matches everything."""
+    assert [r[0] for r in db.sql(
+        "select k from s where trim(craw) like '' order by k").rows()] \
+        == [5]
+    assert len(db.sql(
+        "select k from s where trim(craw) like '%'").rows()) == len(STRS)
+
+
+def test_trim_space_only_parity(devices8):
+    """Review finding: trim() strips SPACES only (PG btrim) on every
+    path — dict LUT, raw byte window, and the host chain agree on data
+    containing tabs."""
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table tt (k int, cd text, cr text) distributed by (k)")
+    object.__setattr__(d.catalog.get("tt").column("cr"), "encoding", "raw")
+    vals = np.array(["\tx ", " y ", "z\t"], dtype=object)
+    d.load_table("tt", {"k": np.arange(3, dtype=np.int32),
+                        "cd": vals, "cr": vals.copy()})
+    # \t survives trim on every path; the raw chain falls back to the
+    # host (non-ascii gate is unrelated — tab IS ascii — but the dict
+    # LUT and byte window must agree with it regardless)
+    want = [(0, "\tx"), (1, "y"), (2, "z\t")]
+    got_d = d.sql("select k, trim(cd) from tt order by k").rows()
+    got_r = d.sql("select k, trim(cr) from tt order by k").rows()
+    assert [tuple(x) for x in got_d] == want
+    assert [tuple(x) for x in got_r] == want
+    assert [r[0] for r in d.sql(
+        "select k from tt where trim(cr) = 'y' order by k").rows()] == [1]
+
+
+def test_extract_year_prune_fires_with_param_cache(devices8):
+    """Review finding: the extract_year zone-map prune must fire in the
+    DEFAULT configuration (plan_cache_params on) — the year literal is
+    pinned by paramize, not hoisted into an inert Param."""
+    from greengage_tpu.planner.logical import Scan
+    from greengage_tpu.sql.paramize import paramize
+
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table pt (k int, dt date, v int) distributed by (k)")
+    d.load_table("pt", {"k": np.arange(100, dtype=np.int32),
+                        "dt": (10000 + np.arange(100) * 40).astype(np.int32),
+                        "v": np.arange(100, dtype=np.int32)})
+    d.sql("analyze")
+    stmt = parse("select sum(v) from pt "
+                 "where extract(year from dt) = 2000 and v > 3")[0]
+    norm, vec, _sig = paramize(stmt, d.catalog)
+    assert vec is not None and 2000 not in vec.values, vec
+    planned, _, _ = d._plan(norm)
+    preds = []
+    stack = [planned]
+    while stack:
+        p = stack.pop()
+        if isinstance(p, Scan):
+            preds.extend(p.prune_preds or ())
+        stack.extend(p.children)
+    assert any(c == "dt" and op == ">=" for c, op, _ in preds), preds
+    assert any(c == "dt" and op == "<=" for c, op, _ in preds), preds
+
+
+# ----------------------------------------------------------------------
+# LUT cache keys: DML growing the dictionary recomputes the LUT
+# ----------------------------------------------------------------------
+
+def test_lut_recomputed_after_dict_growth(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table lt (k int, c text) distributed by (k)")
+    d.load_table("lt", {"k": np.array([0, 1], np.int32),
+                        "c": np.array(["alpha", "beta"], dtype=object)})
+    q = "select k from lt where upper(c) = 'GAMMA' order by k"
+    assert d.sql(q).rows() == []
+    c0 = counters.snapshot()
+    assert d.sql(q).rows() == []        # warm: cached program serves it
+    warm = counters.since(c0)
+    assert warm.get("program_cache_miss", 0) == 0, warm
+    # DML grows the dictionary: the upper() LUT must be recomputed and
+    # the LUT-bearing executable recompiled (dictionary fingerprint +
+    # consts digest are in the shape signature) — never a stale miss
+    d.sql("insert into lt values (2, 'gamma')")
+    c1 = counters.snapshot()
+    assert d.sql(q).rows() == [(2,)]
+    delta = counters.since(c1)
+    assert delta.get("program_cache_miss", 0) >= 1, delta
+
+
+# ----------------------------------------------------------------------
+# fusion acceptance: zero host materialization between scan and agg
+# ----------------------------------------------------------------------
+
+def _scan_cols(db, sql):
+    planned, _, _ = db._plan(parse(sql)[0])
+    out = []
+    stack = [planned]
+    while stack:
+        p = stack.pop()
+        if isinstance(p, Scan):
+            out.extend(c.name for c in p.cols)
+        stack.extend(p.children)
+    return out
+
+
+def test_raw_strop_plan_is_gather_and_host_free(db):
+    cols = _scan_cols(db, "select k from s where upper(craw) = 'PROMO'")
+    assert any(c.startswith("@rw:") for c in cols), cols
+    assert not any(c.startswith("@hp:") for c in cols), cols
+
+
+def test_corpus_scalar_shapes_fully_fused(devices8):
+    """ISSUE 13 acceptance: the plan-corpus scalar shapes (Q42-class date
+    math over a dict-encoded dimension included) execute with the scalar
+    work INSIDE the fused program — scalar_host_fallback_total untouched,
+    no @hp host-predicate columns staged, correct answers."""
+    from greengage_tpu.analysis.plancorpus import (TPCDS_QUERIES,
+                                                   load_tpcds_mini)
+
+    d = greengage_tpu.connect(numsegments=4)
+    load_tpcds_mini(d, n_fact=5_000)
+    shapes = {k: q for k, q in TPCDS_QUERIES.items()
+              if k.startswith("ds_scalar_")}
+    assert len(shapes) >= 3, sorted(shapes)
+    c0 = counters.snapshot()
+    for name, q in shapes.items():
+        cols = _scan_cols(d, q)
+        assert not any(c.startswith("@hp:") for c in cols), (name, cols)
+        r = d.sql(q)
+        assert r.rows() is not None, name
+    delta = counters.since(c0)
+    assert delta.get("scalar_host_fallback_total", 0) == 0, delta
+    assert delta.get("scalar_device_total", 0) >= len(shapes), delta
+    # and the Q42 date-math acceptance query itself, vs a direct oracle
+    r = d.sql("""select extract(year from d_date) y, sum(ss_ext_sales_price)
+                 from store_sales, date_dim
+                 where ss_sold_date_sk = d_date_sk
+                 group by extract(year from d_date) order by y""")
+    rows = r.rows()
+    assert len(rows) >= 1 and all(x[0] >= 1998 for x in rows)

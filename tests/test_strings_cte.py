@@ -235,9 +235,11 @@ def test_raw_concat_projection(rawdb):
     assert r.rows() == [(1, "Hello World."), (2, "bye."), (3, "pad.")]
 
 
-def test_raw_numeric_projection_rejected(rawdb):
-    with pytest.raises(SqlError, match="WHERE"):
-        rawdb.sql("select length(c) from r")
+def test_raw_length_projection_device(rawdb):
+    # ISSUE 13: length(raw) is a device byte-window int32 (E.RawStrOp) —
+    # projectable anywhere, not just WHERE (the pre-fusion rejection)
+    r = rawdb.sql("select a, length(c) from r order by a")
+    assert r.rows() == [(1, 11), (2, 3), (3, 7)]
 
 
 def test_raw_group_by_function(rawdb):
@@ -253,12 +255,13 @@ def test_left_right_functions(db):
     assert r.rows() == [("al", "ha")]
 
 
-def test_raw_chain_in_arithmetic_rejected(rawdb):
-    # surrogate must never leak into device arithmetic
-    with pytest.raises(SqlError, match="arithmetic"):
-        rawdb.sql("select a from r where length(c) + 0 = 11")
-    with pytest.raises(SqlError):
-        rawdb.sql("select a, sum(length(c)) from r group by a")
+def test_raw_length_in_arithmetic_and_aggs(rawdb):
+    # ISSUE 13: the device length view is a real int32 — arithmetic and
+    # aggregates over it are legal now (the surrogate never leaks: the
+    # byte-window op replaces it before any numeric context sees it)
+    assert rawdb.sql(
+        "select a from r where length(c) + 0 = 11").rows() == [(1,)]
+    assert rawdb.sql("select sum(length(c)) from r").rows() == [(21,)]
 
 
 def test_raw_chain_through_subquery(rawdb):

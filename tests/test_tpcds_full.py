@@ -1,0 +1,1018 @@
+"""Full TPC-DS queries vs pandas oracles (ISSUE 13 / ROADMAP item 4).
+
+26 queries from the official TPC-DS set (Q3, Q7, Q12, Q13, Q15, Q19,
+Q20, Q21, Q26, Q27, Q32, Q37, Q42, Q43, Q48, Q52, Q55, Q61, Q62, Q65,
+Q68, Q73, Q82, Q89, Q96, Q98) over the dsdgen-lite star schema
+(utils/tpcds.py: three sales channels + inventory over 12 shared
+dimensions), each verified row-for-row against a pandas oracle. Values
+are tuned to the generated data's ranges; two structural adaptations are
+applied where the engine's binder requires them and semantics are
+unchanged: (a) join equalities that the official text repeats inside
+every OR branch (Q13/Q48) are hoisted to top-level conjuncts, (b) a few
+ORDER BYs gain trailing tiebreaker columns so LIMIT boundaries are
+deterministic against the oracle.
+
+The scalar work these queries carry (d_year/d_moy date math, substr
+grouping, CASE buckets, coalesce-class NULL handling, decimal division)
+runs inside the fused device programs — test_scalar_funcs.py asserts
+that fusion directly; here the *answers* are the contract."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import greengage_tpu
+from greengage_tpu.utils import tpcds
+
+SCALE = 1.0
+
+
+def _day(s):
+    return int((np.datetime64(s) - np.datetime64("1970-01-01")).astype(int))
+
+
+@pytest.fixture(scope="module")
+def env(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    tpcds.load(d, SCALE)
+    d.sql("analyze")
+    dfs = tpcds.to_pandas(tpcds.generate(SCALE))
+    return d, dfs
+
+
+def _rows(r):
+    out = []
+    for row in r.rows():
+        out.append(tuple(None if v is None
+                         else (v.item() if hasattr(v, "item") else v)
+                         for v in row))
+    return out
+
+
+def _check(got, want_df, approx_cols=(), rel=1e-9):
+    """Row-for-row comparison of engine rows vs an oracle frame (already
+    sorted/limited). approx_cols = positional indexes compared with
+    pytest.approx (float aggregates)."""
+    assert len(got) == len(want_df), (len(got), len(want_df))
+    for row, (_, w) in zip(got, want_df.iterrows()):
+        wvals = list(w)
+        assert len(row) == len(wvals)
+        for i, (g, e) in enumerate(zip(row, wvals)):
+            if e is None or (isinstance(e, float) and np.isnan(e)):
+                assert g is None, (i, row, wvals)
+            elif i in approx_cols:
+                assert g == pytest.approx(e, rel=rel, abs=1e-6), (i, row, wvals)
+            else:
+                assert g == e, (i, row, wvals)
+
+
+def _nlast(df, by, ascending=None):
+    return df.sort_values(by, ascending=ascending if ascending is not None
+                          else [True] * len(by),
+                          na_position="last", kind="mergesort")
+
+
+# ----------------------------------------------------------------------
+# reporting-class star joins
+# ----------------------------------------------------------------------
+
+def test_q3_brand_by_year(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+             sum(ss_ext_sales_price) sum_agg
+      from date_dim dt, store_sales, item
+      where dt.d_date_sk = store_sales.ss_sold_date_sk
+        and store_sales.ss_item_sk = item.i_item_sk
+        and item.i_manufact_id = 28 and dt.d_moy = 12
+      group by dt.d_year, item.i_brand_id, item.i_brand
+      order by dt.d_year, sum_agg desc, brand_id limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manufact_id == 28) & (j.d_moy == 12)]
+    w = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+          .ss_ext_sales_price.sum())
+    w = _nlast(w, ["d_year", "ss_ext_sales_price", "i_brand_id"],
+               [True, False, True]).head(100)
+    _check(got, w, approx_cols=(3,))
+
+
+def test_q42_category_by_year(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select dt.d_year, item.i_category_id, item.i_category,
+             sum(ss_ext_sales_price)
+      from date_dim dt, store_sales, item
+      where dt.d_date_sk = store_sales.ss_sold_date_sk
+        and store_sales.ss_item_sk = item.i_item_sk
+        and item.i_manager_id = 1 and dt.d_moy = 11 and dt.d_year = 2000
+      group by dt.d_year, item.i_category_id, item.i_category
+      order by sum(ss_ext_sales_price) desc, dt.d_year, item.i_category_id,
+               item.i_category limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    w = (j.groupby(["d_year", "i_category_id", "i_category"], as_index=False)
+          .ss_ext_sales_price.sum())
+    w = _nlast(w, ["ss_ext_sales_price", "d_year", "i_category_id",
+                   "i_category"], [False, True, True, True]).head(100)
+    w = w[["d_year", "i_category_id", "i_category", "ss_ext_sales_price"]]
+    _check(got, w, approx_cols=(3,))
+
+
+def test_q52_brand_by_year(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+             sum(ss_ext_sales_price) ext_price
+      from date_dim dt, store_sales, item
+      where dt.d_date_sk = store_sales.ss_sold_date_sk
+        and store_sales.ss_item_sk = item.i_item_sk
+        and item.i_manager_id = 1 and dt.d_moy = 11 and dt.d_year = 2000
+      group by dt.d_year, item.i_brand, item.i_brand_id
+      order by dt.d_year, ext_price desc, brand_id limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    w = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+          .ss_ext_sales_price.sum())
+    w = _nlast(w, ["d_year", "ss_ext_sales_price", "i_brand_id"],
+               [True, False, True]).head(100)
+    w = w[["d_year", "i_brand_id", "i_brand", "ss_ext_sales_price"]]
+    _check(got, w, approx_cols=(3,))
+
+
+def test_q55_brand_revenue(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_brand_id brand_id, i_brand brand,
+             sum(ss_ext_sales_price) ext_price
+      from date_dim, store_sales, item
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+      group by i_brand, i_brand_id
+      order by ext_price desc, brand_id limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 28) & (j.d_moy == 11) & (j.d_year == 1999)]
+    w = (j.groupby(["i_brand_id", "i_brand"], as_index=False)
+          .ss_ext_sales_price.sum())
+    w = _nlast(w, ["ss_ext_sales_price", "i_brand_id"],
+               [False, True]).head(100)
+    _check(got, w, approx_cols=(2,))
+
+
+# ----------------------------------------------------------------------
+# demographics-filtered averages
+# ----------------------------------------------------------------------
+
+def _q7_oracle(f):
+    j = (f["store_sales"]
+         .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(f["promotion"], left_on="ss_promo_sk", right_on="p_promo_sk"))
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    w = (j.groupby("i_item_id", as_index=False)
+          .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+               agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean")))
+    return _nlast(w, ["i_item_id"]).head(100)
+
+
+def test_q7_promo_demographics(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+             avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+      from store_sales, customer_demographics, date_dim, item, promotion
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+        and cd_gender = 'M' and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and (p_channel_email = 'N' or p_channel_event = 'N')
+        and d_year = 2000
+      group by i_item_id order by i_item_id limit 100"""))
+    _check(got, _q7_oracle(f), approx_cols=(1, 2, 3, 4))
+
+
+def test_q26_catalog_demographics(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+             avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+      from catalog_sales, customer_demographics, date_dim, item, promotion
+      where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+        and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+        and cd_gender = 'F' and cd_marital_status = 'W'
+        and cd_education_status = 'Primary'
+        and (p_channel_email = 'N' or p_channel_event = 'N')
+        and d_year = 2000
+      group by i_item_id order by i_item_id limit 100"""))
+    j = (f["catalog_sales"]
+         .merge(f["customer_demographics"], left_on="cs_bill_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(f["date_dim"], left_on="cs_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="cs_item_sk", right_on="i_item_sk")
+         .merge(f["promotion"], left_on="cs_promo_sk", right_on="p_promo_sk"))
+    j = j[(j.cd_gender == "F") & (j.cd_marital_status == "W")
+          & (j.cd_education_status == "Primary")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    w = (j.groupby("i_item_id", as_index=False)
+          .agg(agg1=("cs_quantity", "mean"), agg2=("cs_list_price", "mean"),
+               agg3=("cs_coupon_amt", "mean"), agg4=("cs_sales_price", "mean")))
+    w = _nlast(w, ["i_item_id"]).head(100)
+    _check(got, w, approx_cols=(1, 2, 3, 4))
+
+
+def test_q27_rollup_demographics(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_item_id, s_state, grouping(s_state) g_state,
+             avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+             avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+      from store_sales, customer_demographics, date_dim, store, item
+      where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+        and cd_gender = 'M' and cd_marital_status = 'S'
+        and cd_education_status = 'College' and d_year = 2002
+        and s_state in ('CA', 'TX', 'NY', 'OH')
+      group by rollup (i_item_id, s_state)
+      order by i_item_id, s_state limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College") & (j.d_year == 2002)
+          & j.s_state.isin(["CA", "TX", "NY", "OH"])]
+    levels = []
+    leaf = (j.groupby(["i_item_id", "s_state"], as_index=False)
+             .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+                  agg3=("ss_coupon_amt", "mean"),
+                  agg4=("ss_sales_price", "mean")))
+    leaf.insert(2, "g_state", 0)
+    levels.append(leaf)
+    mid = (j.groupby("i_item_id", as_index=False)
+            .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+                 agg3=("ss_coupon_amt", "mean"),
+                 agg4=("ss_sales_price", "mean")))
+    mid.insert(1, "s_state", None)
+    mid.insert(2, "g_state", 1)
+    levels.append(mid)
+    if len(j):
+        top = pd.DataFrame([{
+            "i_item_id": None, "s_state": None, "g_state": 1,
+            "agg1": j.ss_quantity.mean(), "agg2": j.ss_list_price.mean(),
+            "agg3": j.ss_coupon_amt.mean(), "agg4": j.ss_sales_price.mean()}])
+        levels.append(top)
+    w = _nlast(pd.concat(levels, ignore_index=True),
+               ["i_item_id", "s_state"]).head(100)
+    _check(got, w, approx_cols=(3, 4, 5, 6))
+
+
+# ----------------------------------------------------------------------
+# channel revenue-share windows (Q12 / Q20 / Q98)
+# ----------------------------------------------------------------------
+
+_Q12_SQL = """
+  select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+         sum({v}_ext_sales_price) as itemrevenue,
+         sum({v}_ext_sales_price) * 100 /
+           sum(sum({v}_ext_sales_price)) over (partition by i_class)
+           as revenueratio
+  from {t}, item, date_dim
+  where {v}_item_sk = i_item_sk
+    and i_category in ('Sports', 'Books', 'Home')
+    and {v}_sold_date_sk = d_date_sk
+    and d_date between cast('{d0}' as date) and (cast('{d0}' as date) + 30 days)
+  group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+  order by i_category, i_class, i_item_id, i_item_desc, revenueratio"""
+
+
+def _share_oracle(f, tab, v, d0):
+    j = (f[tab]
+         .merge(f["item"], left_on=f"{v}_item_sk", right_on="i_item_sk")
+         .merge(f["date_dim"], left_on=f"{v}_sold_date_sk",
+                right_on="d_date_sk"))
+    j = j[j.i_category.isin(["Sports", "Books", "Home"])
+          & (j.d_date >= _day(d0)) & (j.d_date <= _day(d0) + 30)]
+    w = (j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
+                    "i_current_price"], as_index=False)
+          [f"{v}_ext_sales_price"].sum()
+          .rename(columns={f"{v}_ext_sales_price": "itemrevenue"}))
+    w["revenueratio"] = (w.itemrevenue * 100
+                         / w.groupby("i_class").itemrevenue.transform("sum"))
+    w = _nlast(w, ["i_category", "i_class", "i_item_id", "i_item_desc",
+                   "revenueratio"])
+    return w[["i_item_id", "i_item_desc", "i_category", "i_class",
+              "i_current_price", "itemrevenue", "revenueratio"]]
+
+
+def test_q12_web_revenue_share(env):
+    d, f = env
+    got = _rows(d.sql(_Q12_SQL.format(t="web_sales", v="ws", d0="1999-02-22")))
+    _check(got, _share_oracle(f, "web_sales", "ws", "1999-02-22"),
+           approx_cols=(5, 6), rel=1e-5)
+
+
+def test_q20_catalog_revenue_share(env):
+    d, f = env
+    got = _rows(d.sql(_Q12_SQL.format(t="catalog_sales", v="cs",
+                                      d0="2000-03-10")))
+    _check(got, _share_oracle(f, "catalog_sales", "cs", "2000-03-10"),
+           approx_cols=(5, 6), rel=1e-5)
+
+
+def test_q98_store_revenue_share(env):
+    d, f = env
+    got = _rows(d.sql(_Q12_SQL.format(t="store_sales", v="ss",
+                                      d0="2001-01-12")))
+    _check(got, _share_oracle(f, "store_sales", "ss", "2001-01-12"),
+           approx_cols=(5, 6), rel=1e-5)
+
+
+# ----------------------------------------------------------------------
+# OR-heavy single-row aggregates (Q13 / Q48)
+# ----------------------------------------------------------------------
+
+def test_q13_triple_or_averages(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select avg(ss_quantity), avg(ss_ext_sales_price),
+             avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+      from store_sales, store, customer_demographics,
+           household_demographics, customer_address, date_dim
+      where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 2001
+        and ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ((cd_marital_status = 'M'
+              and cd_education_status = 'Advanced Degree'
+              and ss_sales_price between 10.00 and 50.00
+              and hd_dep_count = 3)
+          or (cd_marital_status = 'S' and cd_education_status = 'College'
+              and ss_sales_price between 5.00 and 30.00
+              and hd_dep_count = 1)
+          or (cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+              and ss_sales_price between 15.00 and 60.00
+              and hd_dep_count = 1))
+        and ((ca_state in ('TX', 'OH', 'CA')
+              and ss_net_profit between 100 and 200)
+          or (ca_state in ('IL', 'NY', 'GA')
+              and ss_net_profit between 150 and 300)
+          or (ca_state in ('WA', 'TN') and ss_net_profit between 50 and 250))
+      """))
+    j = (f["store_sales"]
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+         .merge(f["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+         .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                right_on="d_date_sk"))
+    j = j[(j.d_year == 2001) & (j.ca_country == "United States")]
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "Advanced Degree")
+             & j.ss_sales_price.between(10.0, 50.0) & (j.hd_dep_count == 3))
+            | ((j.cd_marital_status == "S")
+               & (j.cd_education_status == "College")
+               & j.ss_sales_price.between(5.0, 30.0) & (j.hd_dep_count == 1))
+            | ((j.cd_marital_status == "W")
+               & (j.cd_education_status == "2 yr Degree")
+               & j.ss_sales_price.between(15.0, 60.0)
+               & (j.hd_dep_count == 1)))
+    addr = ((j.ca_state.isin(["TX", "OH", "CA"])
+             & j.ss_net_profit.between(100, 200))
+            | (j.ca_state.isin(["IL", "NY", "GA"])
+               & j.ss_net_profit.between(150, 300))
+            | (j.ca_state.isin(["WA", "TN"])
+               & j.ss_net_profit.between(50, 250)))
+    j = j[demo & addr]
+    assert len(got) == 1
+    if len(j) == 0:
+        assert got[0] == (None, None, None, None)
+    else:
+        want = (j.ss_quantity.mean(), j.ss_ext_sales_price.mean(),
+                j.ss_ext_wholesale_cost.mean(), j.ss_ext_wholesale_cost.sum())
+        for g, e in zip(got[0], want):
+            assert g == pytest.approx(e, rel=1e-9)
+
+
+def test_q48_quantity_sum_or_blocks(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select sum(ss_quantity)
+      from store_sales, store, customer_demographics,
+           customer_address, date_dim
+      where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+        and d_year = 2000
+        and cd_demo_sk = ss_cdemo_sk and ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ((cd_marital_status = 'M' and cd_education_status = '4 yr Degree'
+              and ss_sales_price between 10.00 and 50.00)
+          or (cd_marital_status = 'D' and cd_education_status = '2 yr Degree'
+              and ss_sales_price between 5.00 and 35.00)
+          or (cd_marital_status = 'S' and cd_education_status = 'College'
+              and ss_sales_price between 15.00 and 60.00))
+        and ((ca_state in ('CA', 'OH', 'TX')
+              and ss_net_profit between 0 and 2000)
+          or (ca_state in ('IL', 'NY', 'GA')
+              and ss_net_profit between 150 and 3000)
+          or (ca_state in ('WA', 'TN') and ss_net_profit between 50 and 2500))
+      """))
+    j = (f["store_sales"]
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(f["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+         .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                right_on="d_date_sk"))
+    j = j[(j.d_year == 2000) & (j.ca_country == "United States")]
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "4 yr Degree")
+             & j.ss_sales_price.between(10.0, 50.0))
+            | ((j.cd_marital_status == "D")
+               & (j.cd_education_status == "2 yr Degree")
+               & j.ss_sales_price.between(5.0, 35.0))
+            | ((j.cd_marital_status == "S")
+               & (j.cd_education_status == "College")
+               & j.ss_sales_price.between(15.0, 60.0)))
+    addr = ((j.ca_state.isin(["CA", "OH", "TX"])
+             & j.ss_net_profit.between(0, 2000))
+            | (j.ca_state.isin(["IL", "NY", "GA"])
+               & j.ss_net_profit.between(150, 3000))
+            | (j.ca_state.isin(["WA", "TN"])
+               & j.ss_net_profit.between(50, 2500)))
+    j = j[demo & addr]
+    want = None if len(j) == 0 else int(j.ss_quantity.sum())
+    assert got == [(want,)]
+
+
+# ----------------------------------------------------------------------
+# zip/substr shapes (Q15 / Q19 / Q62)
+# ----------------------------------------------------------------------
+
+def test_q15_catalog_by_zip(env):
+    d, f = env
+    zips = "'81', '82', '83', '84', '8100', '8101', '8102', '8103', '8104'"
+    got = _rows(d.sql(f"""
+      select ca_zip, sum(cs_sales_price)
+      from catalog_sales, customer, customer_address, date_dim
+      where cs_bill_customer_sk = c_customer_sk
+        and c_current_addr_sk = ca_address_sk
+        and (substr(ca_zip, 1, 5) in ({zips})
+             or ca_state in ('CA', 'WA', 'GA') or cs_sales_price > 500)
+        and cs_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+      group by ca_zip order by ca_zip limit 100"""))
+    zlist = [z.strip().strip("'") for z in zips.split(",")]
+    j = (f["catalog_sales"]
+         .merge(f["customer"], left_on="cs_bill_customer_sk",
+                right_on="c_customer_sk")
+         .merge(f["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+         .merge(f["date_dim"], left_on="cs_sold_date_sk",
+                right_on="d_date_sk"))
+    j = j[(j.d_qoy == 2) & (j.d_year == 2001)
+          & (j.ca_zip.str[:5].isin(zlist)
+             | j.ca_state.isin(["CA", "WA", "GA"])
+             | (j.cs_sales_price > 500))]
+    w = _nlast(j.groupby("ca_zip", as_index=False).cs_sales_price.sum(),
+               ["ca_zip"]).head(100)
+    _check(got, w, approx_cols=(1,))
+
+
+def test_q19_brand_cross_zip(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+             sum(ss_ext_sales_price) ext_price
+      from date_dim, store_sales, item, customer, customer_address, store
+      where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+        and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+        and ss_customer_sk = c_customer_sk
+        and c_current_addr_sk = ca_address_sk
+        and substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+        and ss_store_sk = s_store_sk
+      group by i_brand, i_brand_id, i_manufact_id, i_manufact
+      order by ext_price desc, brand, i_brand_id, i_manufact_id, i_manufact
+      limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(f["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+         .merge(f["customer_address"], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[(j.i_manager_id == 8) & (j.d_moy == 11) & (j.d_year == 1998)
+          & (j.ca_zip.str[:5] != j.s_zip.str[:5])]
+    w = (j.groupby(["i_brand_id", "i_brand", "i_manufact_id", "i_manufact"],
+                   as_index=False).ss_ext_sales_price.sum())
+    w = _nlast(w, ["ss_ext_sales_price", "i_brand", "i_brand_id",
+                   "i_manufact_id", "i_manufact"],
+               [False, True, True, True, True]).head(100)
+    w = w[["i_brand_id", "i_brand", "i_manufact_id", "i_manufact",
+           "ss_ext_sales_price"]]
+    _check(got, w, approx_cols=(4,))
+
+
+def test_q62_ship_latency_buckets(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select substr(w_warehouse_name, 1, 20), sm_type, web_name,
+        sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30)
+                 then 1 else 0 end) as d30,
+        sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+                  and (ws_ship_date_sk - ws_sold_date_sk <= 60)
+                 then 1 else 0 end) as d60,
+        sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
+                  and (ws_ship_date_sk - ws_sold_date_sk <= 90)
+                 then 1 else 0 end) as d90,
+        sum(case when (ws_ship_date_sk - ws_sold_date_sk > 90)
+                  and (ws_ship_date_sk - ws_sold_date_sk <= 120)
+                 then 1 else 0 end) as d120,
+        sum(case when (ws_ship_date_sk - ws_sold_date_sk > 120)
+                 then 1 else 0 end) as dmore
+      from web_sales, warehouse, ship_mode, web_site, date_dim
+      where d_month_seq between 1200 and 1211
+        and ws_ship_date_sk = d_date_sk
+        and ws_warehouse_sk = w_warehouse_sk
+        and ws_ship_mode_sk = sm_ship_mode_sk
+        and ws_web_site_sk = web_site_sk
+      group by substr(w_warehouse_name, 1, 20), sm_type, web_name
+      order by 1, 2, 3 limit 100"""))
+    j = (f["web_sales"]
+         .merge(f["warehouse"], left_on="ws_warehouse_sk",
+                right_on="w_warehouse_sk")
+         .merge(f["ship_mode"], left_on="ws_ship_mode_sk",
+                right_on="sm_ship_mode_sk")
+         .merge(f["web_site"], left_on="ws_web_site_sk",
+                right_on="web_site_sk")
+         .merge(f["date_dim"], left_on="ws_ship_date_sk",
+                right_on="d_date_sk"))
+    j = j[(j.d_month_seq >= 1200) & (j.d_month_seq <= 1211)].copy()
+    j["wname"] = j.w_warehouse_name.str[:20]
+    lat = j.ws_ship_date_sk - j.ws_sold_date_sk
+    j["d30"] = (lat <= 30).astype(int)
+    j["d60"] = ((lat > 30) & (lat <= 60)).astype(int)
+    j["d90"] = ((lat > 60) & (lat <= 90)).astype(int)
+    j["d120"] = ((lat > 90) & (lat <= 120)).astype(int)
+    j["dmore"] = (lat > 120).astype(int)
+    w = (j.groupby(["wname", "sm_type", "web_name"], as_index=False)
+          [["d30", "d60", "d90", "d120", "dmore"]].sum())
+    w = _nlast(w, ["wname", "sm_type", "web_name"]).head(100)
+    _check(got, w)
+
+
+# ----------------------------------------------------------------------
+# inventory shapes (Q21 / Q37 / Q82)
+# ----------------------------------------------------------------------
+
+def test_q21_inventory_before_after(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select w_warehouse_name, i_item_id,
+        sum(case when d_date < cast('2000-03-11' as date)
+                 then inv_quantity_on_hand else 0 end) as inv_before,
+        sum(case when d_date >= cast('2000-03-11' as date)
+                 then inv_quantity_on_hand else 0 end) as inv_after
+      from inventory, warehouse, item, date_dim
+      where i_item_sk = inv_item_sk and inv_warehouse_sk = w_warehouse_sk
+        and inv_date_sk = d_date_sk
+        and i_current_price between 10.00 and 14.90
+        and d_date between (cast('2000-03-11' as date) - 30 days)
+                       and (cast('2000-03-11' as date) + 30 days)
+      group by w_warehouse_name, i_item_id
+      having (case when sum(case when d_date < cast('2000-03-11' as date)
+                               then inv_quantity_on_hand else 0 end) > 0
+              then sum(case when d_date >= cast('2000-03-11' as date)
+                            then inv_quantity_on_hand else 0 end) * 1.0
+                 / sum(case when d_date < cast('2000-03-11' as date)
+                            then inv_quantity_on_hand else 0 end)
+              else null end) between 2.0 / 3.0 and 3.0 / 2.0
+      order by w_warehouse_name, i_item_id limit 100"""))
+    cut = _day("2000-03-11")
+    j = (f["inventory"]
+         .merge(f["warehouse"], left_on="inv_warehouse_sk",
+                right_on="w_warehouse_sk")
+         .merge(f["item"], left_on="inv_item_sk", right_on="i_item_sk")
+         .merge(f["date_dim"], left_on="inv_date_sk", right_on="d_date_sk"))
+    j = j[j.i_current_price.between(10.0, 14.9)
+          & (j.d_date >= cut - 30) & (j.d_date <= cut + 30)].copy()
+    j["before"] = np.where(j.d_date < cut, j.inv_quantity_on_hand, 0)
+    j["after"] = np.where(j.d_date >= cut, j.inv_quantity_on_hand, 0)
+    w = (j.groupby(["w_warehouse_name", "i_item_id"], as_index=False)
+          [["before", "after"]].sum())
+    ratio = np.where(w.before > 0, w.after / np.where(w.before > 0,
+                                                      w.before, 1), np.nan)
+    w = w[(ratio >= 2.0 / 3.0) & (ratio <= 3.0 / 2.0)]
+    w = _nlast(w, ["w_warehouse_name", "i_item_id"]).head(100)
+    _check(got, w)
+
+
+def _q37_oracle(f, fact, key, price_lo, price_hi, d0, manufs):
+    j = (f["item"]
+         .merge(f["inventory"], left_on="i_item_sk", right_on="inv_item_sk")
+         .merge(f["date_dim"], left_on="inv_date_sk", right_on="d_date_sk"))
+    j = j[j.i_current_price.between(price_lo, price_hi)
+          & (j.d_date >= _day(d0)) & (j.d_date <= _day(d0) + 60)
+          & j.i_manufact_id.isin(manufs)
+          & j.inv_quantity_on_hand.between(100, 500)]
+    sold = set(f[fact][key])
+    j = j[j.i_item_sk.isin(sold)]
+    w = (j.groupby(["i_item_id", "i_item_desc", "i_current_price"])
+          .size().reset_index()[["i_item_id", "i_item_desc",
+                                 "i_current_price"]])
+    return _nlast(w, ["i_item_id"]).head(100)
+
+
+def test_q37_catalog_inventory(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_item_id, i_item_desc, i_current_price
+      from item, inventory, date_dim, catalog_sales
+      where i_current_price between 20.00 and 50.00
+        and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+        and d_date between cast('2000-02-01' as date)
+                       and (cast('2000-02-01' as date) + 60 days)
+        and i_manufact_id in (5, 20, 40, 80)
+        and inv_quantity_on_hand between 100 and 500
+        and cs_item_sk = i_item_sk
+      group by i_item_id, i_item_desc, i_current_price
+      order by i_item_id limit 100"""))
+    _check(got, _q37_oracle(f, "catalog_sales", "cs_item_sk",
+                            20.0, 50.0, "2000-02-01", [5, 20, 40, 80]))
+
+
+def test_q82_store_inventory(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_item_id, i_item_desc, i_current_price
+      from item, inventory, date_dim, store_sales
+      where i_current_price between 30.00 and 60.00
+        and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+        and d_date between cast('2001-06-01' as date)
+                       and (cast('2001-06-01' as date) + 60 days)
+        and i_manufact_id in (10, 30, 50, 70)
+        and inv_quantity_on_hand between 100 and 500
+        and ss_item_sk = i_item_sk
+      group by i_item_id, i_item_desc, i_current_price
+      order by i_item_id limit 100"""))
+    _check(got, _q37_oracle(f, "store_sales", "ss_item_sk",
+                            30.0, 60.0, "2001-06-01", [10, 30, 50, 70]))
+
+
+# ----------------------------------------------------------------------
+# correlated / derived-table shapes (Q32 / Q61 / Q65)
+# ----------------------------------------------------------------------
+
+def test_q32_excess_discount(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select sum(cs_ext_discount_amt) as excess_discount_amount
+      from catalog_sales, item, date_dim
+      where i_manufact_id = 29 and i_item_sk = cs_item_sk
+        and d_date between cast('1999-01-07' as date)
+                       and (cast('1999-01-07' as date) + 90 days)
+        and d_date_sk = cs_sold_date_sk
+        and cs_ext_discount_amt > (
+            select 1.3 * avg(cs_ext_discount_amt)
+            from catalog_sales, date_dim
+            where cs_item_sk = i_item_sk
+              and d_date between cast('1999-01-07' as date)
+                             and (cast('1999-01-07' as date) + 90 days)
+              and d_date_sk = cs_sold_date_sk)
+      limit 100"""))
+    lo, hi = _day("1999-01-07"), _day("1999-01-07") + 90
+    cs = f["catalog_sales"].merge(f["date_dim"], left_on="cs_sold_date_sk",
+                                  right_on="d_date_sk")
+    cs = cs[(cs.d_date >= lo) & (cs.d_date <= hi)]
+    avg_by_item = cs.groupby("cs_item_sk").cs_ext_discount_amt.mean()
+    j = cs.merge(f["item"], left_on="cs_item_sk", right_on="i_item_sk")
+    j = j[j.i_manufact_id == 29]
+    j = j[j.cs_ext_discount_amt
+          > 1.3 * j.cs_item_sk.map(avg_by_item).fillna(np.inf)]
+    want = None if len(j) == 0 else j.cs_ext_discount_amt.sum()
+    assert len(got) == 1
+    if want is None:
+        assert got[0][0] is None
+    else:
+        assert got[0][0] == pytest.approx(want, rel=1e-9)
+
+
+def test_q61_promotion_ratio(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select promotions, total,
+             cast(promotions as decimal(15,4))
+               / cast(total as decimal(15,4)) * 100
+      from
+        (select sum(ss_ext_sales_price) promotions
+         from store_sales, store, promotion, date_dim, customer,
+              customer_address, item
+         where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+           and ss_promo_sk = p_promo_sk and ss_customer_sk = c_customer_sk
+           and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+           and ca_gmt_offset = -5 and i_category = 'Jewelry'
+           and (p_channel_dmail = 'Y' or p_channel_email = 'Y'
+                or p_channel_tv = 'Y')
+           and s_gmt_offset = -5 and d_year = 1998 and d_moy = 11)
+          promotional_sales,
+        (select sum(ss_ext_sales_price) total
+         from store_sales, store, date_dim, customer, customer_address, item
+         where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+           and ss_customer_sk = c_customer_sk
+           and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+           and ca_gmt_offset = -5 and i_category = 'Jewelry'
+           and s_gmt_offset = -5 and d_year = 1998 and d_moy = 11) all_sales
+      order by promotions, total limit 100"""))
+    base = (f["store_sales"]
+            .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+            .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                   right_on="d_date_sk")
+            .merge(f["customer"], left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+            .merge(f["customer_address"], left_on="c_current_addr_sk",
+                   right_on="ca_address_sk")
+            .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    base = base[(base.ca_gmt_offset == -5) & (base.i_category == "Jewelry")
+                & (base.s_gmt_offset == -5) & (base.d_year == 1998)
+                & (base.d_moy == 11)]
+    promo = base.merge(f["promotion"], left_on="ss_promo_sk",
+                       right_on="p_promo_sk")
+    promo = promo[(promo.p_channel_dmail == "Y")
+                  | (promo.p_channel_email == "Y")
+                  | (promo.p_channel_tv == "Y")]
+    p, t = promo.ss_ext_sales_price.sum(), base.ss_ext_sales_price.sum()
+    assert len(got) == 1
+    assert got[0][0] == pytest.approx(p, rel=1e-9)
+    assert got[0][1] == pytest.approx(t, rel=1e-9)
+    assert got[0][2] == pytest.approx(p / t * 100, rel=1e-4)
+
+
+def test_q65_low_revenue_items(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select s_store_name, i_item_desc, sc.revenue, i_current_price,
+             i_wholesale_cost, i_brand
+      from store, item,
+        (select ss_store_sk, avg(revenue) as ave
+         from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+               from store_sales, date_dim
+               where ss_sold_date_sk = d_date_sk
+                 and d_month_seq between 1176 and 1187
+               group by ss_store_sk, ss_item_sk) sa
+         group by ss_store_sk) sb,
+        (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+         from store_sales, date_dim
+         where ss_sold_date_sk = d_date_sk
+           and d_month_seq between 1176 and 1187
+         group by ss_store_sk, ss_item_sk) sc
+      where sb.ss_store_sk = sc.ss_store_sk and sc.revenue <= 0.1 * sb.ave
+        and s_store_sk = sc.ss_store_sk and i_item_sk = sc.ss_item_sk
+      order by s_store_name, i_item_desc, sc.revenue, i_brand limit 100"""))
+    ss = f["store_sales"].merge(f["date_dim"], left_on="ss_sold_date_sk",
+                                right_on="d_date_sk")
+    ss = ss[(ss.d_month_seq >= 1176) & (ss.d_month_seq <= 1187)]
+    rev = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+             .ss_sales_price.sum().rename(columns={"ss_sales_price":
+                                                   "revenue"}))
+    ave = rev.groupby("ss_store_sk").revenue.mean()
+    j = rev[rev.revenue <= 0.1 * rev.ss_store_sk.map(ave)]
+    j = (j.merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+          .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    w = _nlast(j, ["s_store_name", "i_item_desc", "revenue",
+                   "i_brand"]).head(100)
+    w = w[["s_store_name", "i_item_desc", "revenue", "i_current_price",
+           "i_wholesale_cost", "i_brand"]]
+    _check(got, w, approx_cols=(2, 3, 4))
+
+
+# ----------------------------------------------------------------------
+# per-ticket shapes (Q68 / Q73)
+# ----------------------------------------------------------------------
+
+def test_q68_ticket_city_mismatch(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select c_last_name, c_first_name, ca_city, bought_city,
+             ss_ticket_number, extended_price, extended_tax, list_price
+      from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+                   sum(ss_ext_sales_price) extended_price,
+                   sum(ss_ext_list_price) list_price,
+                   sum(ss_ext_tax) extended_tax
+            from store_sales, date_dim, store, household_demographics,
+                 customer_address
+            where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+              and store_sales.ss_store_sk = store.s_store_sk
+              and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+              and store_sales.ss_addr_sk = customer_address.ca_address_sk
+              and date_dim.d_dom between 1 and 2
+              and (household_demographics.hd_dep_count = 4
+                   or household_demographics.hd_vehicle_count = 3)
+              and date_dim.d_year in (1999, 2000, 2001)
+              and store.s_city in ('Midway', 'Fairview')
+            group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                     ca_city) dn,
+           customer, customer_address current_addr
+      where ss_customer_sk = c_customer_sk
+        and customer.c_current_addr_sk = current_addr.ca_address_sk
+        and current_addr.ca_city <> bought_city
+      order by c_last_name, ss_ticket_number limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+         .merge(f["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk"))
+    j = j[j.d_dom.between(1, 2)
+          & ((j.hd_dep_count == 4) | (j.hd_vehicle_count == 3))
+          & j.d_year.isin([1999, 2000, 2001])
+          & j.s_city.isin(["Midway", "Fairview"])]
+    dn = (j.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                     "ca_city"], as_index=False)
+           .agg(extended_price=("ss_ext_sales_price", "sum"),
+                list_price=("ss_ext_list_price", "sum"),
+                extended_tax=("ss_ext_tax", "sum"))
+           .rename(columns={"ca_city": "bought_city"}))
+    w = (dn.merge(f["customer"], left_on="ss_customer_sk",
+                  right_on="c_customer_sk")
+           .merge(f["customer_address"], left_on="c_current_addr_sk",
+                  right_on="ca_address_sk"))
+    w = w[w.ca_city != w.bought_city]
+    w = _nlast(w, ["c_last_name", "ss_ticket_number"]).head(100)
+    w = w[["c_last_name", "c_first_name", "ca_city", "bought_city",
+           "ss_ticket_number", "extended_price", "extended_tax",
+           "list_price"]]
+    _check(got, w, approx_cols=(5, 6, 7))
+
+
+def test_q73_ticket_line_counts(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select c_last_name, c_first_name, c_salutation,
+             c_preferred_cust_flag, ss_ticket_number, cnt
+      from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+            from store_sales, date_dim, store, household_demographics
+            where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+              and store_sales.ss_store_sk = store.s_store_sk
+              and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+              and date_dim.d_dom between 1 and 2
+              and (household_demographics.hd_buy_potential = '>10000'
+                   or household_demographics.hd_buy_potential = 'Unknown')
+              and household_demographics.hd_vehicle_count > 0
+              and household_demographics.hd_dep_count
+                  / household_demographics.hd_vehicle_count > 1
+              and date_dim.d_year in (1999, 2000, 2001)
+              and store.s_county in ('Ziebach County 1', 'Walker County 2',
+                                     'Daviess County 1', 'Barrow County 2')
+            group by ss_ticket_number, ss_customer_sk) dj, customer
+      where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+      order by cnt desc, c_last_name, ss_ticket_number limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk")
+         .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk"))
+    # integer division truncating toward zero (the engine's PG semantics)
+    ratio = (j.hd_dep_count // np.where(j.hd_vehicle_count != 0,
+                                        j.hd_vehicle_count, 1))
+    j = j[j.d_dom.between(1, 2)
+          & (j.hd_buy_potential.isin([">10000", "Unknown"]))
+          & (j.hd_vehicle_count > 0) & (ratio > 1)
+          & j.d_year.isin([1999, 2000, 2001])
+          & j.s_county.isin(["Ziebach County 1", "Walker County 2",
+                             "Daviess County 1", "Barrow County 2"])]
+    dj = (j.groupby(["ss_ticket_number", "ss_customer_sk"])
+           .size().reset_index(name="cnt"))
+    dj = dj[dj.cnt.between(1, 5)]
+    w = dj.merge(f["customer"], left_on="ss_customer_sk",
+                 right_on="c_customer_sk")
+    w = _nlast(w, ["cnt", "c_last_name", "ss_ticket_number"],
+               [False, True, True]).head(100)
+    w = w[["c_last_name", "c_first_name", "c_salutation",
+           "c_preferred_cust_flag", "ss_ticket_number", "cnt"]]
+    _check(got, w)
+
+
+# ----------------------------------------------------------------------
+# day-name pivots, store channels (Q43 / Q89 / Q96)
+# ----------------------------------------------------------------------
+
+def test_q43_sales_by_day_name(env):
+    d, f = env
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    cases = ",\n".join(
+        f"sum(case when d_day_name = '{dn}' then ss_sales_price "
+        f"else null end) {dn[:3].lower()}_sales" for dn in days)
+    got = _rows(d.sql(f"""
+      select s_store_name, s_store_id, {cases}
+      from date_dim, store_sales, store
+      where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+        and s_gmt_offset = -5 and d_year = 2000
+      group by s_store_name, s_store_id
+      order by s_store_name, s_store_id limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[(j.s_gmt_offset == -5) & (j.d_year == 2000)]
+    grp = j.groupby(["s_store_name", "s_store_id"])
+    rows = []
+    for (nm, sid), g in grp:
+        row = {"s_store_name": nm, "s_store_id": sid}
+        for dn in days:
+            sub = g[g.d_day_name == dn]
+            row[dn] = sub.ss_sales_price.sum() if len(sub) else None
+        rows.append(row)
+    w = _nlast(pd.DataFrame(rows), ["s_store_name", "s_store_id"]).head(100)
+    _check(got, w, approx_cols=tuple(range(2, 9)))
+
+
+def test_q89_monthly_vs_average(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select i_category, i_class, i_brand, s_store_name, s_company_name,
+             d_moy, sum_sales, avg_monthly_sales
+      from (select i_category, i_class, i_brand, s_store_name,
+                   s_company_name, d_moy, sum(ss_sales_price) sum_sales,
+                   avg(sum(ss_sales_price)) over
+                     (partition by i_category, i_brand, s_store_name,
+                      s_company_name) avg_monthly_sales
+            from item, store_sales, date_dim, store
+            where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+              and ss_store_sk = s_store_sk and d_year = 1999
+              and ((i_category in ('Books', 'Electronics', 'Sports')
+                    and i_class in ('class 1', 'class 2', 'class 3'))
+                or (i_category in ('Men', 'Jewelry', 'Women')
+                    and i_class in ('class 4', 'class 5', 'class 6')))
+            group by i_category, i_class, i_brand, s_store_name,
+                     s_company_name, d_moy) tmp1
+      where case when avg_monthly_sales <> 0
+                 then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+                 else null end > 0.1
+      order by sum_sales - avg_monthly_sales, s_store_name, i_brand,
+               i_class, d_moy limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(f["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    sel = ((j.i_category.isin(["Books", "Electronics", "Sports"])
+            & j.i_class.isin(["class 1", "class 2", "class 3"]))
+           | (j.i_category.isin(["Men", "Jewelry", "Women"])
+              & j.i_class.isin(["class 4", "class 5", "class 6"])))
+    j = j[(j.d_year == 1999) & sel]
+    g = (j.groupby(["i_category", "i_class", "i_brand", "s_store_name",
+                    "s_company_name", "d_moy"], as_index=False)
+          .ss_sales_price.sum().rename(columns={"ss_sales_price":
+                                                "sum_sales"}))
+    g["avg_monthly_sales"] = g.groupby(
+        ["i_category", "i_brand", "s_store_name",
+         "s_company_name"]).sum_sales.transform("mean")
+    g = g[np.where(g.avg_monthly_sales != 0,
+                   np.abs(g.sum_sales - g.avg_monthly_sales)
+                   / np.where(g.avg_monthly_sales != 0,
+                              g.avg_monthly_sales, 1), np.nan) > 0.1]
+    g["diff"] = g.sum_sales - g.avg_monthly_sales
+    w = _nlast(g, ["diff", "s_store_name", "i_brand", "i_class",
+                   "d_moy"]).head(100)
+    w = w[["i_category", "i_class", "i_brand", "s_store_name",
+           "s_company_name", "d_moy", "sum_sales", "avg_monthly_sales"]]
+    _check(got, w, approx_cols=(6, 7), rel=1e-6)
+
+
+def test_q96_evening_store_traffic(env):
+    d, f = env
+    got = _rows(d.sql("""
+      select count(*)
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 20 and time_dim.t_minute >= 30
+        and household_demographics.hd_dep_count = 7
+        and store.s_store_name = 'ese'
+      order by count(*) limit 100"""))
+    j = (f["store_sales"]
+         .merge(f["time_dim"], left_on="ss_sold_time_sk", right_on="t_time_sk")
+         .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+         .merge(f["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    want = len(j[(j.t_hour == 20) & (j.t_minute >= 30)
+                 & (j.hd_dep_count == 7) & (j.s_store_name == "ese")])
+    assert got == [(want,)]
