@@ -10,7 +10,11 @@ PR 4 closed, so this lint finds blocking wait shapes statically:
 * ``.wait(...)`` on Condition/Event receivers,
 * ``.result(...)`` on futures,
 * ``.recv(...)`` / zero-arg ``.accept()`` socket reads,
-* ``.get(...)`` on queue-named receivers,
+* ``.get(...)`` on queue-named receivers, and ANY ``.get(timeout=...)``
+  (the PR-11 serving pipeline's ready-queue wait shape — a timeout
+  keyword is a blocking wait whatever the receiver is called),
+* ``.join(timeout=...)`` on thread-named receivers (the PR-12
+  prefetcher-drain shape: a statement thread waiting out a worker),
 
 and requires an interrupt poll — ``check_interrupts()``, a ``ctx.check()``
 / ``.check()`` on a statement context, or a ``.cancelled`` test — in the
@@ -90,8 +94,15 @@ def _wait_kind(node: ast.Call, in_loop: bool) -> str | None:
         return "socket-recv"
     if name == "accept" and not node.args and not node.keywords:
         return "socket-accept"
-    if name == "get" and ("queue" in recv.lower() or recv in ("q", "jobs")):
-        return "queue-get"
+    if name == "get":
+        if "queue" in recv.lower() or recv in ("q", "jobs"):
+            return "queue-get"
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            # whatever the receiver's name, get(timeout=...) is a
+            # blocking dequeue (the serving pipeline's `_dq.get`)
+            return "queue-get"
+    if name == "join" and ("thread" in recv.lower() or recv == "t"):
+        return "thread-join"
     return None
 
 

@@ -1,0 +1,568 @@
+"""Cross-role race analysis: shared state written by one thread role and
+touched by another with no common lock.
+
+PR 8's lock lint proves the package acquires locks in a consistent
+ORDER; nothing proved shared state is locked AT ALL. This analyzer walks
+interprocedurally from each declared thread role's entry points
+(analysis/threadmodel.py) and collects every ``self.<attr>`` /
+module-global access with the set of locks held around it — with-block
+tracking and lock-identity resolution shared with ``lint_locks``, one
+more hop of call resolution (self-calls in-class; attribute/global
+receivers through constructor typing; distinctive bare names
+package-wide). An attribute *written* by one role and *touched* by
+another where the two access paths hold no common lock is a finding
+carrying both paths.
+
+Instance-vs-identity honesty: a static identity (``mod.Class.attr``)
+merges every instance of the class, so per-statement objects (Compiler,
+Binder, plan nodes) would fabricate races. The analyzer therefore pairs
+accesses only on classes declared genuinely shared
+(``threadmodel.SHARED_CLASSES``) and on module globals — which are
+shared by construction. ``self.x = threading.local()`` containers are
+recognized and their contents exempted (per-thread by construction).
+
+Suppression: the usual two channels — ``# gg:ok(races)`` on either
+access line, or the checked-in baseline. The runtime complement is the
+``GGTPU_RACE_DEBUG`` access witness in ``runtime/lockdebug.py``: the
+analyzer proves the *model* has no bare cross-role access; the witness
+catches a real interleaving the model missed.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from dataclasses import dataclass, field
+from itertools import combinations
+
+from greengage_tpu.analysis import astutil, threadmodel
+from greengage_tpu.analysis.lint_locks import (_GENERIC_METHODS, _lock_ctor,
+                                               _module_key)
+from greengage_tpu.analysis.report import Report
+
+# method names that mutate their receiver: `self.x.append(...)` is a
+# WRITE to x even though x itself is only loaded
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "move_to_end", "sort", "reverse",
+})
+
+
+# ---------------------------------------------------------------------
+# package model: locks, classes, globals, imports, typing
+# ---------------------------------------------------------------------
+
+@dataclass
+class _Model:
+    sites: dict = field(default_factory=dict)        # lock id -> (rel, line)
+    per_module: dict = field(default_factory=dict)   # mod -> attr -> [ids]
+    by_attr: dict = field(default_factory=dict)      # attr -> [ids]
+    aliases: dict = field(default_factory=dict)      # (mod,cls,attr) -> attr
+    classes: set = field(default_factory=set)        # class names
+    global_types: dict = field(default_factory=dict)  # (mod, name) -> class
+    attr_types: dict = field(default_factory=dict)   # attr -> class | None
+    imports: dict = field(default_factory=dict)      # (mod, name) -> (mod2, name2)
+    module_globals: dict = field(default_factory=dict)  # mod -> set of names
+    thread_locals: set = field(default_factory=set)  # (mod, cls, attr)
+
+
+def _build_model(srcs, receiver_types) -> _Model:
+    m = _Model()
+    for src in srcs:
+        mod = _module_key(src.rel)
+        gl: set = set()
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        gl.add(t.id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                gl.add(node.target.id)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith("greengage_tpu"):
+                o = _module_key(node.module.replace(".", "/") + ".py")
+                for alias in node.names:
+                    m.imports[(mod, alias.asname or alias.name)] = \
+                        (o, alias.name)
+        m.module_globals[mod] = gl
+        cls_stack: list[str] = []
+
+        def walk(node, in_fn: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    m.classes.add(child.name)
+                    cls_stack.append(child.name)
+                    walk(child, in_fn)
+                    cls_stack.pop()
+                    continue
+                if isinstance(child, ast.Assign):
+                    _assign(child, in_fn)
+                walk(child, in_fn or isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)))
+
+        def _assign(node: ast.Assign, in_fn: bool):
+            cls = cls_stack[-1] if cls_stack else ""
+            val = node.value
+            if _lock_ctor(val):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        t = t.value
+                    if isinstance(t, ast.Attribute):
+                        ident = f"{mod}.{cls}.{t.attr}"
+                        m.sites[ident] = (src.rel, node.lineno)
+                        m.per_module.setdefault(mod, {}).setdefault(
+                            t.attr, []).append(ident)
+                        m.by_attr.setdefault(t.attr, []).append(ident)
+                    elif isinstance(t, ast.Name) and not in_fn:
+                        # bare-name lock sites are module globals only —
+                        # a function-local Lock is not a shared identity
+                        ident = f"{mod}.{t.id}"
+                        m.sites[ident] = (src.rel, node.lineno)
+                        m.per_module.setdefault(mod, {}).setdefault(
+                            t.id, []).append(ident)
+                        m.by_attr.setdefault(t.id, []).append(ident)
+            if isinstance(val, ast.Call):
+                name = astutil.call_name(val)
+                # Condition(self._mu) keeps the underlying lock identity
+                if name == "Condition" and val.args \
+                        and isinstance(val.args[0], ast.Attribute) \
+                        and isinstance(val.args[0].value, ast.Name) \
+                        and val.args[0].value.id == "self":
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            m.aliases[(mod, cls, t.attr)] = val.args[0].attr
+                elif name == "local":       # threading.local(): per-thread
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            m.thread_locals.add((mod, cls, t.attr))
+                elif name is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            prev = m.attr_types.get(t.attr, name)
+                            # conflicting ctor classes: untyped
+                            m.attr_types[t.attr] = \
+                                name if prev == name else None
+                        elif isinstance(t, ast.Name) and not cls_stack \
+                                and not in_fn:
+                            # TOP-LEVEL singletons only (counters = ...):
+                            # a function-local `x = C()` must not type
+                            # every `x.m()` in the package — and same-name
+                            # conflicts untype, like attr_types
+                            prev = m.global_types.get((mod, t.id), name)
+                            m.global_types[(mod, t.id)] = \
+                                name if prev == name else None
+
+        walk(src.tree, False)
+        # properties backed by a threading.local container (the
+        # last_prune pattern): every self-attr their bodies touch is a
+        # declared thread-local -> the property name itself is per-thread
+        for cls_node in ast.walk(src.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            for item in cls_node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                decs = {astutil.dotted(d) for d in item.decorator_list}
+                if not any(d and (d == "property" or d.endswith(".setter"))
+                           for d in decs):
+                    continue
+                touched = {n.attr for n in ast.walk(item)
+                           if isinstance(n, ast.Attribute)
+                           and isinstance(n.value, ast.Name)
+                           and n.value.id == "self"}
+                if touched and all(
+                        (mod, cls_node.name, a) in m.thread_locals
+                        for a in touched):
+                    m.thread_locals.add((mod, cls_node.name, item.name))
+    # drop ctor "types" that aren't package classes (np.zeros etc.) and
+    # fold in the declared receiver typing (factory returns)
+    m.attr_types = {a: c for a, c in m.attr_types.items()
+                    if c is not None and c in m.classes}
+    for attr, cname in (receiver_types or {}).items():
+        if cname in m.classes:
+            m.attr_types[attr] = cname
+    m.global_types = {k: c for k, c in m.global_types.items()
+                      if c in m.classes}
+    return m
+
+
+def _resolve_lock(expr, mod: str, cls: str, model: _Model) -> str | None:
+    """Best-effort lock identity for a with/acquire target. Exact
+    self-site first, then module-unique, then package-unique; a known
+    lock attr that stays ambiguous gets a synthetic per-module identity
+    (same receiver text in the same module = same lock for common-lock
+    purposes) rather than silently dropping the protection."""
+    if isinstance(expr, ast.Call):
+        name = astutil.call_name(expr)
+        if name == "acquire" and isinstance(expr.func, ast.Attribute):
+            expr = expr.func.value
+        else:
+            return None
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if isinstance(expr, ast.Attribute):
+        attr = model.aliases.get((mod, cls, expr.attr), expr.attr)
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            ident = f"{mod}.{cls}.{attr}"
+            if ident in model.sites:
+                return ident
+        mod_ids = model.per_module.get(mod, {}).get(attr, [])
+        if len(mod_ids) == 1:
+            return mod_ids[0]
+        ids = model.by_attr.get(attr, [])
+        if len(ids) == 1:
+            return ids[0]
+        if ids:
+            return f"{mod}.~{attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        ident = f"{mod}.{expr.id}"
+        if ident in model.sites:
+            return ident
+        orig = model.imports.get((mod, expr.id))
+        if orig is not None:
+            ident = f"{orig[0]}.{orig[1]}"
+            if ident in model.sites:
+                return ident
+    return None
+
+
+# ---------------------------------------------------------------------
+# per-function scan: accesses + calls, each with the local lock set
+# ---------------------------------------------------------------------
+
+@dataclass
+class _FnInfo:
+    key: tuple                      # (rel, cls, name)
+    src: object
+    accesses: list = field(default_factory=list)
+    # (ident, owner_cls|None, "r"/"w", frozenset(locks), lineno)
+    calls: list = field(default_factory=list)
+    # ((kind, name, detail), frozenset(locks), lineno)
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, info: _FnInfo, mod: str, cls: str, model: _Model):
+        self.info, self.mod, self.cls, self.model = info, mod, cls, model
+        self.held: list[str] = []
+
+    # -- helpers --------------------------------------------------------
+    def _locks(self) -> frozenset:
+        return frozenset(self.held)
+
+    def _acc(self, ident, owner, rw, lineno):
+        # an access line carrying `# gg:ok(races)` is exempt at the
+        # source: the justification sits next to the code
+        if self.info.src.pragma_ok(lineno, "races"):
+            return
+        self.info.accesses.append((ident, owner, rw, self._locks(), lineno))
+
+    def _global_ident(self, name: str):
+        if name in self.model.module_globals.get(self.mod, ()):
+            return f"{self.mod}.{name}"
+        orig = self.model.imports.get((self.mod, name))
+        if orig is not None and name in \
+                self.model.module_globals.get(orig[0], ()):
+            return f"{orig[0]}.{orig[1]}"
+        return None
+
+    # -- lock flow ------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        got = []
+        for item in node.items:
+            lk = _resolve_lock(item.context_expr, self.mod, self.cls,
+                               self.model)
+            if lk is not None:
+                got.append(lk)
+        self.held.extend(got)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in got:
+            self.held.pop()
+
+    # -- accesses -------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and self.cls and not node.attr.startswith("__") \
+                and (self.mod, self.cls, node.attr) \
+                not in self.model.thread_locals:
+            rw = "r" if isinstance(node.ctx, ast.Load) else "w"
+            self._acc(f"{self.mod}.{self.cls}.{node.attr}", self.cls,
+                      rw, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        ident = self._global_ident(node.id)
+        if ident is not None:
+            rw = "r" if isinstance(node.ctx, ast.Load) else "w"
+            self._acc(ident, None, rw, node.lineno)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # self.x[k] = v / del self.x[k] mutate x even though the
+        # attribute itself is only loaded
+        if not isinstance(node.ctx, ast.Load):
+            t = node.value
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" and self.cls \
+                    and (self.mod, self.cls, t.attr) \
+                    not in self.model.thread_locals:
+                self._acc(f"{self.mod}.{self.cls}.{t.attr}", self.cls,
+                          "w", node.lineno)
+            elif isinstance(t, ast.Name):
+                gid = self._global_ident(t.id)
+                if gid is not None:
+                    self._acc(gid, None, "w", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        name = astutil.call_name(node)
+        lineno = node.lineno
+        f = node.func
+        if name == "acquire" and isinstance(f, ast.Attribute):
+            # linear held tracking for acquire()/release() pairs (the
+            # try/finally pattern): source order approximates hold scope
+            lk = _resolve_lock(node, self.mod, self.cls, self.model)
+            if lk is not None:
+                self.held.append(lk)
+        elif name == "release" and isinstance(f, ast.Attribute):
+            lk = _resolve_lock(f.value, self.mod, self.cls, self.model)
+            if lk is not None and lk in self.held:
+                self.held.remove(lk)
+        elif name is not None and isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.info.calls.append((("self", name, None),
+                                        self._locks(), lineno))
+            elif isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self":
+                # self.X.m(): mutators write X — except when X's class is
+                # known (the walk descends into the real method, which
+                # does its own locking; a blind write here would indict
+                # e.g. every internally-locked BlockCache.pop call)
+                if name in _MUTATORS and self.cls \
+                        and recv.attr not in self.model.attr_types \
+                        and (self.mod, self.cls, recv.attr) \
+                        not in self.model.thread_locals:
+                    self._acc(f"{self.mod}.{self.cls}.{recv.attr}",
+                              self.cls, "w", lineno)
+                self.info.calls.append((("selfattr", name, recv.attr),
+                                        self._locks(), lineno))
+            elif isinstance(recv, ast.Name):
+                gid = self._global_ident(recv.id)
+                if gid is not None and name in _MUTATORS:
+                    self._acc(gid, None, "w", lineno)
+                self.info.calls.append((("recv", name, recv.id),
+                                        self._locks(), lineno))
+            else:
+                self.info.calls.append((("other", name, None),
+                                        self._locks(), lineno))
+        elif name is not None and isinstance(f, ast.Name):
+            self.info.calls.append((("bare", name, None),
+                                    self._locks(), lineno))
+        self.generic_visit(node)
+
+    # nested defs are separate walk targets, not part of this body's
+    # execution (they run when called/spawned)
+    def visit_FunctionDef(self, node):   # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):   # noqa: D102
+        pass
+
+
+def _index_functions(srcs, model):
+    """-> {(rel, cls, name): _FnInfo}, nested defs attributed to their
+    nearest enclosing class."""
+    out: dict[tuple, _FnInfo] = {}
+
+    def walk(node, cls, src, mod):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, src, mod)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (src.rel, cls, child.name)
+                info = _FnInfo(key, src)
+                sc = _Scanner(info, mod, cls, model)
+                for stmt in child.body:
+                    sc.visit(stmt)
+                out.setdefault(key, info)
+                walk(child, cls, src, mod)
+            else:
+                walk(child, cls, src, mod)
+
+    for src in srcs:
+        walk(src.tree, "", src, _module_key(src.rel))
+    return out
+
+
+# ---------------------------------------------------------------------
+# call resolution + role walk
+# ---------------------------------------------------------------------
+
+class _Resolver:
+    def __init__(self, fns: dict, model: _Model):
+        self.fns = fns
+        self.model = model
+        self.by_cls_name: dict = defaultdict(list)   # (cls, name) -> keys
+        self.by_rel_name: dict = defaultdict(list)   # (rel, name) -> keys
+        self.by_name: dict = defaultdict(list)       # name -> keys
+        for key in fns:
+            rel, cls, name = key
+            if cls:
+                self.by_cls_name[(cls, name)].append(key)
+            self.by_rel_name[(rel, name)].append(key)
+            self.by_name[name].append(key)
+
+    def targets(self, callspec, caller_key) -> list:
+        kind, name, detail = callspec
+        rel, cls, _ = caller_key
+        if kind == "self" and cls:
+            keys = self.by_cls_name.get((cls, name), [])
+            same = [k for k in keys if k[0] == rel]
+            return same or keys
+        if kind == "selfattr":
+            tcls = self.model.attr_types.get(detail)
+            if tcls is not None:
+                return self.by_cls_name.get((tcls, name), [])
+            kind = "other"          # untyped receiver: distinctive-name
+        if kind == "recv":
+            mod = _module_key(rel)
+            g = self.model.global_types.get((mod, detail))
+            if g is None:
+                orig = self.model.imports.get((mod, detail))
+                if orig is not None:
+                    g = self.model.global_types.get(orig)
+            if g is not None:
+                return self.by_cls_name.get((g, name), [])
+            kind = "other"
+        if kind == "bare":
+            same = self.by_rel_name.get((rel, name), [])
+            if len(same) == 1:
+                return same
+        if name in _GENERIC_METHODS:
+            return []
+        keys = self.by_name.get(name, [])
+        return keys if len(keys) == 1 else []
+
+
+def _entry_keys(role, fns) -> list:
+    out = []
+    for suffix, cls, name in role.entries:
+        for key in fns:
+            rel, kcls, kname = key
+            if rel.endswith(suffix) and kname == name \
+                    and (cls == "" or kcls == cls):
+                out.append(key)
+    return out
+
+
+def run(sources=None, roles=None, shared_classes=None,
+        receiver_types=None) -> Report:
+    report = Report()
+    sources = sources if sources is not None else astutil.SourceSet(
+        exclude=("greengage_tpu/analysis/",))
+    srcs = list(sources)
+    roles = roles if roles is not None else threadmodel.THREAD_ROLES
+    shared = set(shared_classes if shared_classes is not None
+                 else threadmodel.SHARED_CLASSES)
+    model = _build_model(srcs, receiver_types if receiver_types is not None
+                         else threadmodel.RECEIVER_TYPES)
+    fns = _index_functions(srcs, model)
+    resolver = _Resolver(fns, model)
+    src_by_rel = {s.rel: s for s in srcs}
+
+    entries = {name: _entry_keys(role, fns) for name, role in roles.items()}
+    entry_owner: dict[tuple, set] = defaultdict(set)
+    for rname, keys in entries.items():
+        for k in keys:
+            entry_owner[k].add(rname)
+
+    # ident -> role -> {(rw, lockset): (rel, line, fn)}
+    acc: dict[str, dict] = defaultdict(dict)
+    owner_of: dict[str, str | None] = {}
+
+    for rname in sorted(roles):
+        stack = [(k, frozenset()) for k in entries[rname]]
+        seen = set(stack)
+        while stack:
+            key, held = stack.pop()
+            info = fns.get(key)
+            if info is None:
+                continue
+            if key[2] == "__init__":
+                continue    # construction precedes sharing
+            for ident, owner, rw, locks, lineno in info.accesses:
+                eff = held | locks
+                slot = acc[ident].setdefault(rname, {})
+                slot.setdefault((rw, eff), (key[0], lineno, key[2]))
+                owner_of.setdefault(ident, owner)
+            for callspec, locks, lineno in info.calls:
+                for tgt in resolver.targets(callspec, key):
+                    if entry_owner.get(tgt) \
+                            and rname not in entry_owner[tgt]:
+                        continue    # another role's surface starts here
+                    st = (tgt, held | locks)
+                    if st not in seen:
+                        seen.add(st)
+                        stack.append(st)
+
+    report.notes["races_functions"] = len(fns)
+    report.notes["races_shared_idents"] = sum(
+        1 for i, by_role in acc.items() if len(by_role) > 1)
+
+    def _fmt(locks: frozenset) -> str:
+        return "{" + ", ".join(sorted(locks)) + "}" if locks else "no lock"
+
+    for ident in sorted(acc):
+        owner = owner_of.get(ident)
+        if owner is not None and owner not in shared:
+            continue
+        by_role = acc[ident]
+        if len(by_role) < 2:
+            continue
+        # one finding per identity: the first offending (writer, toucher)
+        # pair as the evidence, every racing role pair in the tally —
+        # a per-pair fan-out would bury one unlocked structure under
+        # len(roles)^2 findings
+        hit = None
+        pairs = set()
+        for a, b in combinations(sorted(by_role), 2):
+            for (rw1, l1), w1 in sorted(by_role[a].items()):
+                for (rw2, l2), w2 in sorted(by_role[b].items()):
+                    if "w" not in (rw1, rw2) or (l1 & l2):
+                        continue
+                    pairs.add((a, b))
+                    if hit is None:
+                        wa = (a, rw1, l1, w1)
+                        wb = (b, rw2, l2, w2)
+                        hit = (wa, wb) if rw1 == "w" else (wb, wa)
+        if hit is None:
+            continue
+        (wr, wrw, wl, wloc), (tr, trw, tl, tloc) = hit
+        s1 = src_by_rel.get(wloc[0])
+        s2 = src_by_rel.get(tloc[0])
+        if (s1 is not None and s1.pragma_ok(wloc[1], "races")) or \
+                (s2 is not None and s2.pragma_ok(tloc[1], "races")):
+            continue
+        more = len(pairs) - 1
+        report.add(
+            "races", wloc[0], wloc[1],
+            f"race:{ident}",
+            f"{ident} is written by role {wr} in {wloc[2]}() "
+            f"[{wloc[0]}:{wloc[1]}, {_fmt(wl)}] and "
+            f"{'written' if trw == 'w' else 'read'} by role {tr} in "
+            f"{tloc[2]}() [{tloc[0]}:{tloc[1]}, {_fmt(tl)}] with no "
+            "common lock — one role can observe the other's "
+            "half-applied update"
+            + (f" (+{more} more racing role pair(s))" if more else ""))
+    return report

@@ -1,6 +1,6 @@
 """Registry-hygiene lint: declared catalogs vs. what the code does.
 
-Three registries drift silently without this check:
+Four registries drift silently without this check:
 
 * **metrics** — ``runtime/logger.py`` declares ``COUNTER_NAMES`` /
   ``GAUGE_NAMES`` / ``HISTOGRAM_NAMES``; every ``counters.inc()`` /
@@ -19,6 +19,13 @@ Three registries drift silently without this check:
   ``faults.inject()`` in the test tree must name a registered point,
   and every registered point must have a check site (a point tests arm
   but nothing fires is a dead test).
+* **plan-cache GUCs** — the SET handler in ``exec/session.py`` clears
+  ``_select_cache`` for a literal tuple of GUC names; every ``Settings``
+  field the binding/paramization path reads must appear in that tuple
+  (or carry a declared exemption with its reason), or a SET serves
+  cached bound plans produced under the old regime — the footgun each
+  of optimizer/plan_cache_params/scalar_device_enabled once was. Checked
+  both ways: a tuple entry nothing in the binding path reads is stale.
 """
 
 from __future__ import annotations
@@ -204,6 +211,125 @@ def _check_faults(pkg_sources, test_sources, report: Report) -> None:
                        "— it will never fire in the package")
 
 
+# Binding-path scope: the functions (by module suffix) whose Settings
+# reads shape the BOUND PLAN that _select_cache memoizes. sql/binder.py
+# and sql/paramize.py are swept whole (they receive settings values via
+# these functions today; a future direct read must not escape).
+_BINDING_FUNCS = {
+    "exec/session.py": ("_cached_plan", "_plan"),
+    "sql/binder.py": ("*",),
+    "sql/paramize.py": ("*",),
+}
+
+# Settings fields the binding path reads that legitimately stay OUT of
+# the clear list — each with the reason the cached plans stay valid
+PLAN_CACHE_GUC_EXEMPT = {
+    "plan_validate": "validation hook only: toggling it changes whether "
+                     "_plan raises, never the bound plan it returns",
+    "plan_cache_size": "bounds the cache itself, not the plans in it",
+}
+
+
+def _settings_reads(src, fn_names):
+    """Yield (field, lineno) for settings.<field> / getattr(settings,
+    "<field>") reads inside the named functions ("*" = all)."""
+    for fn in astutil.functions(src.tree):
+        if "*" not in fn_names and fn.name not in fn_names:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                recv = astutil.dotted(node.value) or ""
+                if recv == "settings" or recv.endswith(".settings"):
+                    yield node.attr, node.lineno
+            elif isinstance(node, ast.Call) \
+                    and astutil.call_name(node) == "getattr" \
+                    and len(node.args) >= 2:
+                recv = astutil.dotted(node.args[0]) or ""
+                name = astutil.const_str(node.args[1])
+                if name is not None and (recv == "settings"
+                                         or recv.endswith(".settings")):
+                    yield name, node.lineno
+
+
+def _clear_list(session_src):
+    """The literal tuple guarding the SET handler's _select_cache.clear()
+    -> ({names}, lineno) or (None, 0) when the pattern is missing."""
+    for node in ast.walk(session_src.tree):
+        if not isinstance(node, ast.If) \
+                or not isinstance(node.test, ast.Compare) \
+                or len(node.test.ops) != 1 \
+                or not isinstance(node.test.ops[0], ast.In):
+            continue
+        lhs = astutil.dotted(node.test.left) or ""
+        if not lhs.endswith(".name"):
+            continue
+        clears = any(
+            isinstance(n, ast.Call) and astutil.call_name(n) == "clear"
+            and "_select_cache" in (astutil.dotted(n.func.value) or "")
+            for stmt in node.body for n in ast.walk(stmt)
+            if isinstance(n, ast.Call))
+        comp = node.test.comparators[0]
+        if clears and isinstance(comp, (ast.Tuple, ast.List)):
+            names = {astutil.const_str(e) for e in comp.elts}
+            if None not in names:
+                return names, node.lineno
+    return None, 0
+
+
+def _check_plan_cache_gucs(sources, report: Report) -> None:
+    session = sources.get("exec/session.py")
+    if session is None:
+        return
+    cfg = sources.get("config.py")
+    fields = set()
+    if cfg is not None:
+        for node in ast.walk(cfg.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Settings":
+                fields = {i.target.id for i in node.body
+                          if isinstance(i, ast.AnnAssign)
+                          and isinstance(i.target, ast.Name)}
+    cleared, tuple_line = _clear_list(session)
+    if cleared is None:
+        report.add("registry", session.rel, 1, "plan-cache-clear-missing",
+                   "exec/session.py SET handler no longer clears "
+                   "_select_cache for a literal GUC tuple — the "
+                   "plan-cache invalidation contract this lint checks")
+        return
+    reads: dict[str, tuple[str, int]] = {}
+    for suffix, fn_names in _BINDING_FUNCS.items():
+        src = sources.get(suffix)
+        if src is None:
+            continue
+        for field, line in _settings_reads(src, fn_names):
+            if field in fields:
+                reads.setdefault(field, (src.rel, line))
+    for field in sorted(set(reads) - cleared - set(PLAN_CACHE_GUC_EXEMPT)):
+        rel, line = reads[field]
+        src = next((s for s in sources if s.rel == rel), None)
+        if src is not None and src.pragma_ok(line, "registry"):
+            continue
+        report.add(
+            "registry", rel, line, f"plan-cache-guc-unclears:{field}",
+            f"binding/paramization reads Settings.{field} but the SET "
+            "handler's _select_cache.clear() tuple does not list it — "
+            "SET would keep serving bound plans from the old regime "
+            "(add it to the tuple in exec/session.py, or to "
+            "PLAN_CACHE_GUC_EXEMPT with its reason)")
+    for name in sorted(cleared - set(reads)):
+        report.add(
+            "registry", session.rel, tuple_line,
+            f"plan-cache-guc-stale:{name}",
+            f"the SET handler clears _select_cache for {name!r}, but the "
+            "binding path no longer reads that field — stale tuple entry")
+    for name in sorted(cleared - fields):
+        report.add(
+            "registry", session.rel, tuple_line,
+            f"plan-cache-guc-phantom:{name}",
+            f"the SET handler's clear tuple names {name!r}, which is not "
+            "a Settings field")
+
+
 def run(sources=None) -> Report:
     report = Report()
     sources = sources if sources is not None else astutil.SourceSet()
@@ -213,4 +339,5 @@ def run(sources=None) -> Report:
     _check_metrics(sources, report)
     _check_gucs(sources, report)
     _check_faults(sources, test_sources, report)
+    _check_plan_cache_gucs(sources, report)
     return report

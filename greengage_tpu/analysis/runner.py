@@ -9,7 +9,8 @@ behind ``gg check --plans`` (CI runs both).
 from __future__ import annotations
 
 from greengage_tpu.analysis import (astutil, lint_imports, lint_interrupts,
-                                    lint_locks, lint_registry, lint_tracer)
+                                    lint_locks, lint_races, lint_registry,
+                                    lint_tracer, threadmodel)
 from greengage_tpu.analysis.report import Report, load_baseline
 
 CHECKS = {
@@ -18,6 +19,23 @@ CHECKS = {
     "tracer": lint_tracer.run,
     "registry": lint_registry.run,
     "imports": lint_imports.run,
+    "threads": threadmodel.run,
+    "races": lint_races.run,
+}
+
+# one-line catalog (gg check --list); keep in step with docs/ANALYSIS.md
+DESCRIPTIONS = {
+    "locks": "lock-order cycles over the package acquisition graph",
+    "interrupts": "blocking waits on statement paths poll the "
+                  "interrupt registry",
+    "tracer": "no host-forcing of tracers under jit; cache-key purity",
+    "registry": "metric/GUC/fault-point/plan-cache-GUC catalogs match "
+                "the code both ways",
+    "imports": "no function-local imports of cheap stdlib modules",
+    "threads": "every thread spawn site is declared in THREAD_ROLES "
+               "(and every declared role is live)",
+    "races": "no shared attribute written by one thread role and "
+             "touched by another without a common lock",
 }
 
 

@@ -123,7 +123,9 @@ class BatchServer:
         self._members: dict[int, int] = {}   # statement id -> batch id
         self._inflight = 0     # batches popped from the window, not demuxed
         self._started = False
-        self._stop = False
+        # Event, not a bare bool: stop() runs on a statement thread
+        # while both pipeline threads poll it (gg check races)
+        self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # finished per-flush traces, newest last (tests + introspection;
         # the same traces sit in the TRACES ring under their -bid ids)
@@ -183,7 +185,7 @@ class BatchServer:
             while not m.event.wait(0.02):
                 if ctx is not None:
                     ctx.check()
-                if self._stop:
+                if self._stop.is_set():
                     # Database.close(): whatever this member's window
                     # was doing, degrade to the classic path rather
                     # than park the connection thread on a dead pipeline
@@ -241,12 +243,15 @@ class BatchServer:
         still parked in a window or staged batch — each degrades to the
         classic serial path on its own thread instead of waiting out the
         wedge timeout against a dead pipeline."""
-        self._stop = True
+        self._stop.set()
         with self._cv:
             self._cv.notify_all()
         for t in self._threads:
             if t is not threading.current_thread():
-                t.join(timeout=3.0)
+                # Database.close() teardown, not a statement path: the
+                # pipeline threads exit on _stop within one poll tick and
+                # the join is hard-bounded
+                t.join(timeout=3.0)   # gg:ok(interrupts)
         stranded: list[_Member] = []
         with self._cv:
             for b in list(self._open.values()):
@@ -292,7 +297,7 @@ class BatchServer:
         accumulating members — the wait is free exactly when the device
         is the bottleneck, and width grows to match the device's pace."""
         with self._cv:
-            while not self._stop:
+            while not self._stop.is_set():
                 now = time.monotonic()
                 maxw = max(int(getattr(self.db.settings,
                                        "batch_max_width", 16)), 1)
@@ -333,7 +338,7 @@ class BatchServer:
         dispatcher's device stage — statement k+1 stages while statement
         k runs on device (the PR-3 staging pool extended past a single
         statement)."""
-        while not self._stop:
+        while not self._stop.is_set():
             try:
                 b = self._take_window()
                 if b is None:
@@ -363,7 +368,7 @@ class BatchServer:
     def _dispatch_loop(self) -> None:
         """Dispatch -> fetch -> demux: run staged batches on the device
         one at a time and hand every member its slice."""
-        while not self._stop:
+        while not self._stop.is_set():
             try:
                 # pipeline thread: members poll their own contexts
                 b = self._dq.get(timeout=0.25)   # gg:ok(interrupts)

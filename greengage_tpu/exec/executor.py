@@ -15,6 +15,7 @@ Gather Motion receive (nodeMotion.c:378) in one place:
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -26,6 +27,7 @@ import jax
 from greengage_tpu import expr as E
 from greengage_tpu import types as T
 from greengage_tpu.exec import staging
+from greengage_tpu.runtime import lockdebug
 from greengage_tpu.exec.compile import (VALID_PREFIX, Compiler, CompileResult,
                                         _pow2)
 from greengage_tpu.parallel.mesh import seg_sharding
@@ -203,7 +205,18 @@ class Executor:
         # manifest-version bump that stays inside every capacity bucket
         # and grows no dictionary REUSES the hot XLA executable instead
         # of recompiling. Bounded by the plan_cache_size GUC.
-        self._plan_cache: OrderedDict = OrderedDict()
+        #
+        # _cache_mu guards ALL program-cache bookkeeping (_plan_cache,
+        # _cap_hints, _sig_memo, _fused_failed, _dyn_prune_cache): the
+        # batch-serving stager mutates these concurrently with statement
+        # threads (gg check races), and the old GIL-reliant try/KeyError
+        # defenses only made lost updates quiet, not absent. RLock:
+        # _cache_program -> _on_program_evicted nests. Critical sections
+        # are dict ops only — never a compile, never device work.
+        self._cache_mu = lockdebug.named(threading.RLock(),
+                                         "executor._cache_mu")
+        self._plan_cache: OrderedDict = lockdebug.shared(
+            OrderedDict(), "executor._plan_cache")
         # statements whose fused pallas kernel failed to lower on this
         # backend: later runs skip the pallas attempt entirely instead of
         # paying a failed compile + XLA recompile every execution
@@ -217,10 +230,50 @@ class Executor:
         # them through overflow-retry recompiles. cache_key -> {nid: cap},
         # LRU (recency = last record OR last use) under a fixed backstop
         # bound; the primary lifetime tie is _on_program_evicted
-        self._cap_hints: OrderedDict = OrderedDict()
+        self._cap_hints: OrderedDict = lockdebug.shared(
+            OrderedDict(), "executor._cap_hints")
         # memoized shape signatures (see the dispatch loop in run());
         # insertion-order bounded — entries for dead versions age out
         self._sig_memo: OrderedDict = OrderedDict()
+        # per-DISPATCH staging context (row ranges, aux tables, prune
+        # stats): the serving stager stages batch k+1 WHILE a statement
+        # thread stages its own classic dispatch on the same Executor, so
+        # these travel per-thread — plain attributes were a cross-role
+        # clobber (gg check races)
+        self._tls = threading.local()
+
+    # -- per-thread staging context (source-compatible properties) -----
+    @property
+    def _row_ranges(self):
+        return getattr(self._tls, "row_ranges", {})
+
+    @_row_ranges.setter
+    def _row_ranges(self, value):
+        self._tls.row_ranges = value
+
+    @property
+    def _aux_tables(self):
+        return getattr(self._tls, "aux_tables", {})
+
+    @_aux_tables.setter
+    def _aux_tables(self, value):
+        self._tls.aux_tables = value
+
+    @property
+    def _last_prune_stats(self):
+        return getattr(self._tls, "last_prune_stats", {})
+
+    @_last_prune_stats.setter
+    def _last_prune_stats(self, value):
+        self._tls.last_prune_stats = value
+
+    @property
+    def _last_dyn_stats(self):
+        return getattr(self._tls, "last_dyn_stats", {})
+
+    @_last_dyn_stats.setter
+    def _last_dyn_stats(self, value):
+        self._tls.last_dyn_stats = value
 
     # ------------------------------------------------------------------
     def run(self, plan, consts: dict, out_cols, cache_key=None,
@@ -233,15 +286,14 @@ class Executor:
         t0 = time.monotonic()
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
-        hints = dict(self._cap_hints.get(cache_key) or {})
-        if hints:
-            try:
+        with self._cache_mu:
+            hints = dict(self._cap_hints.get(cache_key) or {})
+            if hints:
                 self._cap_hints.move_to_end(cache_key)
-            except KeyError:
-                pass   # concurrent statement evicted it; `hints` is ours
+            fused_disabled = cache_key is not None \
+                and cache_key in self._fused_failed
         cap_overrides: dict = dict(hints)
         pack_disabled: set = set()
-        fused_disabled = cache_key is not None and cache_key in self._fused_failed
         TRACKER.enter()   # nested spill passes share the statement entry
         try:
             return self._run_tiers(
@@ -316,17 +368,16 @@ class Executor:
                     # trailing 0 = the unbatched program; batched serving
                     # keys its width buckets in the same LRU (run_batch)
                     ck = (cache_key, sig, fused_disabled, 0)
-            # single fetch: a concurrent statement's eviction between a
-            # membership test and the read must not KeyError (threaded
-            # SQL server; the value object stays alive once fetched)
-            comp = self._plan_cache.get(ck) if ck is not None else None
-            was_cached = comp is not None
+            # fetch + recency bump in one _cache_mu section: a concurrent
+            # statement's eviction can no longer interleave (the value
+            # object stays alive once fetched either way)
+            with self._cache_mu:
+                comp = self._plan_cache.get(ck) if ck is not None else None
+                was_cached = comp is not None
+                if was_cached:
+                    self._plan_cache.move_to_end(ck)
             compile_ms = 0.0
             if was_cached:
-                try:
-                    self._plan_cache.move_to_end(ck)
-                except KeyError:
-                    pass
                 counters.inc("program_cache_hit")
             else:
                 if ck is not None:
@@ -486,14 +537,16 @@ class Executor:
                     raise
                 fused_disabled = True
                 self.last_fused_error = f"{type(e).__name__}: {e}"
-                if cache_key is not None:
-                    self._fused_failed.add(cache_key)
-                if ck is not None:
-                    # plain pop, NOT _on_program_evicted: that would discard
-                    # the fused-failed memo just recorded; the retry below
-                    # immediately caches the unfused program for this
-                    # statement, re-tying the bookkeeping to a live entry
-                    self._plan_cache.pop(ck, None)
+                with self._cache_mu:
+                    if cache_key is not None:
+                        self._fused_failed.add(cache_key)
+                    if ck is not None:
+                        # plain pop, NOT _on_program_evicted: that would
+                        # discard the fused-failed memo just recorded; the
+                        # retry below immediately caches the unfused
+                        # program for this statement, re-tying the
+                        # bookkeeping to a live entry
+                        self._plan_cache.pop(ck, None)
                 continue
             t_fetch = time.monotonic()
             compute_ms = (t_fetch - t_compute) * 1e3
@@ -524,22 +577,21 @@ class Executor:
                 # metrics are device-reduced, so multihost processes
                 # record identical hints and stay in lockstep
                 if cache_key is not None and comp.flag_caps:
-                    rec = self._cap_hints.setdefault(cache_key, {})
-                    try:
+                    with self._cache_mu:
+                        rec = self._cap_hints.setdefault(cache_key, {})
                         self._cap_hints.move_to_end(cache_key)
-                    except KeyError:
-                        pass   # concurrent eviction between setdefault/move
-                    for _f, (nid, metric) in comp.flag_caps.items():
-                        if metric in metrics:
-                            need = (int(metrics[metric].flat[0])
-                                    if self.multihost
-                                    else int(np.max(metrics[metric])))
-                            # pow2 bucket: small data drift re-records the
-                            # SAME hint, so hint-sized programs keep their
-                            # executable-cache entry across DML
-                            rec[nid] = _pow2(need + max(need // 16, 64))
-                    while len(self._cap_hints) > 512:
-                        self._cap_hints.popitem(last=False)
+                        for _f, (nid, metric) in comp.flag_caps.items():
+                            if metric in metrics:
+                                need = (int(metrics[metric].flat[0])
+                                        if self.multihost
+                                        else int(np.max(metrics[metric])))
+                                # pow2 bucket: small data drift re-records
+                                # the SAME hint, so hint-sized programs
+                                # keep their executable-cache entry
+                                # across DML
+                                rec[nid] = _pow2(need + max(need // 16, 64))
+                        while len(self._cap_hints) > 512:
+                            self._cap_hints.popitem(last=False)
                 if deferred:
                     # parallel retrieve cursor: the program already ran and
                     # every segment's shard is on the host — finalization
@@ -673,29 +725,31 @@ class Executor:
         callers choose their fallback (uncached compile / BatchFallback).
         The walker is returned so a compile on the miss path can reuse
         its scan collection instead of re-walking."""
-        sig = self._sig_memo.get(mk)
+        with self._cache_mu:
+            sig = self._sig_memo.get(mk)
         if sig is not None:
             return sig, None
         comp = make_compiler()
+        # the signature walk itself runs unlocked (it reads plan/manifest
+        # state, not the memo); only the memo insert is serialized
         sig = comp.shape_signature(plan, snapshot)
-        self._sig_memo[mk] = sig
-        while len(self._sig_memo) > 2048:
-            try:
+        with self._cache_mu:
+            self._sig_memo[mk] = sig
+            while len(self._sig_memo) > 2048:
                 self._sig_memo.popitem(last=False)
-            except KeyError:
-                break
         return sig, comp
 
     def _cache_program(self, ck, comp) -> None:
         """Insert a compiled program into the bounded LRU; evictions
         drop their statement's cap-hint / fused-failed bookkeeping via
         _on_program_evicted (one policy for every caller)."""
-        self._plan_cache[ck] = comp
-        limit_n = max(int(getattr(self.settings,
-                                  "plan_cache_size", 128)), 1)
-        while len(self._plan_cache) > limit_n:
-            old_k, _old = self._plan_cache.popitem(last=False)
-            self._on_program_evicted(old_k)
+        with self._cache_mu:
+            self._plan_cache[ck] = comp
+            limit_n = max(int(getattr(self.settings,
+                                      "plan_cache_size", 128)), 1)
+            while len(self._plan_cache) > limit_n:
+                old_k, _old = self._plan_cache.popitem(last=False)
+                self._on_program_evicted(old_k)
 
     # ---- vectorized serving (exec/batchserve.py) ---------------------
     # One XLA dispatch serves a whole admission window of same-shape
@@ -715,7 +769,8 @@ class Executor:
         bucket = _pow2(max(width, 1))
         snapshot = self.store.manifest.snapshot()
         version = snapshot.get("version", 0)
-        hints = dict(self._cap_hints.get(cache_key) or {})
+        with self._cache_mu:
+            hints = dict(self._cap_hints.get(cache_key) or {})
         # batched programs always disable the fused pallas kernel: the
         # dense-agg kernel has no vmap batching rule, and a mid-batch
         # lowering failure would cost every member a serial re-run
@@ -735,13 +790,12 @@ class Executor:
             counters.inc("program_cache_unsignable")
             raise BatchFallback("unsignable statement shape")
         ck = (cache_key, sig, True, bucket)
-        comp = self._plan_cache.get(ck)
-        was_cached = comp is not None
-        if was_cached:
-            try:
+        with self._cache_mu:
+            comp = self._plan_cache.get(ck)
+            was_cached = comp is not None
+            if was_cached:
                 self._plan_cache.move_to_end(ck)
-            except KeyError:
-                pass
+        if was_cached:
             counters.inc("program_cache_hit")
         else:
             counters.inc("program_cache_miss")
@@ -1050,26 +1104,27 @@ class Executor:
         bookkeeping too — their lifetime is tied to the plan cache
         (unbounded-growth fix, ISSUE 5)."""
         cache_key = key[0]
-        # snapshot: a concurrent statement's insert/evict must not break
-        # the membership scan (threaded SQL server)
-        if any(k[0] == cache_key for k in list(self._plan_cache)):
-            return
-        self._cap_hints.pop(cache_key, None)
-        self._fused_failed.discard(cache_key)
+        # callers hold _cache_mu (RLock): the membership scan, the
+        # cap-hint drop, and the fused-failed drop are one atomic step
+        with self._cache_mu:
+            if any(k[0] == cache_key for k in list(self._plan_cache)):
+                return
+            self._cap_hints.pop(cache_key, None)
+            self._fused_failed.discard(cache_key)
 
     def invalidate_table(self, table: str) -> None:
         """Drop compiled programs scanning ``table`` (DROP TABLE / DROP
         PARTITION): a same-named recreated table could otherwise alias a
         stale executable whose shape signature coincides."""
         base = table.split("#", 1)[0]
-        # snapshot + pop(None): concurrent statements mutate the LRU
-        stale = [k for k, c in list(self._plan_cache.items())
-                 if any(t == table or t.split("#", 1)[0] == base
-                        for t, *_ in c.input_spec)]
-        for k in stale:
-            self._plan_cache.pop(k, None)
-        for k in stale:
-            self._on_program_evicted(k)
+        with self._cache_mu:
+            stale = [k for k, c in list(self._plan_cache.items())
+                     if any(t == table or t.split("#", 1)[0] == base
+                            for t, *_ in c.input_spec)]
+            for k in stale:
+                self._plan_cache.pop(k, None)
+            for k in stale:
+                self._on_program_evicted(k)
 
     @staticmethod
     def _resolve_prune(prune, pvec):
@@ -1393,10 +1448,11 @@ class Executor:
         irregularity (a missed prune is only a perf loss)."""
         version = snapshot.get("version", 0)
         ck = (table, child_parts, dyn, version)
-        cache = getattr(self, "_dyn_prune_cache", None)
-        if cache is None:
-            cache = self._dyn_prune_cache = {}
-        hit = cache.get(ck)
+        with self._cache_mu:
+            cache = getattr(self, "_dyn_prune_cache", None)
+            if cache is None:
+                cache = self._dyn_prune_cache = {}
+            hit = cache.get(ck)
         if hit is not None:
             self._last_dyn_stats[table] = (len(hit), len(child_parts))
             return hit
@@ -1434,9 +1490,10 @@ class Executor:
         except Exception:
             return child_parts   # never fail the query for a prune
         self._last_dyn_stats[table] = (len(kept), len(child_parts))
-        if len(cache) > 64:
-            cache.pop(next(iter(cache)))
-        cache[ck] = kept
+        with self._cache_mu:
+            if len(cache) > 64:
+                cache.pop(next(iter(cache)))
+            cache[ck] = kept
         return kept
 
     def _read_segment_parts(self, table, child_parts, seg, storage_cols,

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -138,8 +139,15 @@ class PassPrefetcher:
     close() joins."""
 
     def __init__(self, executor, input_spec, snapshot):
+        from greengage_tpu.runtime import interrupt
+
         self.executor = executor
         self.snapshot = snapshot
+        # the spawning statement's interrupt context: _warm polls it
+        # between units so a cancelled statement's prefetcher dies at the
+        # next unit boundary instead of reading the whole next pass (and
+        # close() below never outwaits a cancelled warm loop)
+        self._ctx = interrupt.REGISTRY.current()
         # (table, plain storage columns) units; aux/virtual tables skipped
         self.units = []
         for table, cols, _cap, _direct, _prune, child_parts, _dyn \
@@ -159,6 +167,8 @@ class PassPrefetcher:
             reg = store.blockcache
             for table, cols in self.units:
                 for seg in self.executor._local_segments():
+                    if self._ctx is not None and self._ctx.cancelled:
+                        return   # statement is dying: stop warming for it
                     # budget guard: a table bigger than the cache would
                     # only evict its own (and the running pass's) blocks —
                     # stop warming once the registry nears its limit
@@ -178,6 +188,19 @@ class PassPrefetcher:
         self._thread.start()
 
     def close(self) -> None:
-        if self._thread is not None:
-            self._thread.join(timeout=60.0)
-            self._thread = None
+        """Join the warm thread, bounded. Runs on the statement thread —
+        poll the statement's cancellation so a dying statement stops
+        waiting after the warm loop's current unit instead of sitting
+        out the full drain (lint_interrupts thread-join coverage)."""
+        t = self._thread
+        if t is None:
+            return
+        deadline = time.monotonic() + 60.0
+        while t.is_alive() and time.monotonic() < deadline:
+            if self._ctx is not None and self._ctx.cancelled:
+                # _warm observes the same flag at its next unit boundary
+                # and exits; one bounded join covers that last unit
+                t.join(timeout=5.0)
+                break
+            t.join(timeout=0.25)
+        self._thread = None

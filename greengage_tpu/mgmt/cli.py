@@ -1191,8 +1191,43 @@ def cmd_scrub(args):
 
 def cmd_check(args):
     """gg check: the static-analysis gate (docs/ANALYSIS.md) — codebase
-    lints always; the TPC-H/TPC-DS plan-corpus sweep under --plans."""
-    from greengage_tpu.analysis.runner import run_checks, run_plan_corpus
+    lints always; the TPC-H/TPC-DS plan-corpus sweep under --plans;
+    --list prints the check catalog with per-check finding counts (the
+    tier-1 log's what-ran receipt)."""
+    from greengage_tpu.analysis.runner import (CHECKS, DESCRIPTIONS,
+                                               run_checks, run_plan_corpus)
+
+    if args.list:
+        from greengage_tpu.analysis import astutil
+        from greengage_tpu.analysis.report import load_baseline
+
+        names = args.checks or sorted(CHECKS)
+        for name in names:
+            if name not in CHECKS:
+                raise ValueError(f"unknown check {name!r} "
+                                 f"(have: {', '.join(sorted(CHECKS))})")
+        # one shared parsed view of the package for every row (the
+        # run_checks design), not a re-parse per check
+        sources = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+        baseline = (None if args.no_baseline
+                    else load_baseline(args.baseline))
+        rows = []
+        for name in names:
+            rep = CHECKS[name](sources)
+            if baseline is not None:
+                rep = rep.suppressed(baseline)
+            rows.append({"check": name,
+                         "description": DESCRIPTIONS.get(name, ""),
+                         "findings": len(rep.findings),
+                         "notes": rep.notes})
+        if args.json:
+            print(json.dumps({"checks": rows}, indent=1, sort_keys=True))
+        else:
+            width = max(len(r["check"]) for r in rows)
+            for r in rows:
+                print(f"{r['check']:<{width}}  {r['findings']:>3} "
+                      f"finding(s)  {r['description']}")
+        return 1 if any(r["findings"] for r in rows) else 0
 
     report = run_checks(names=args.checks or None,
                         baseline_file=args.baseline,
@@ -1438,6 +1473,9 @@ def main(argv=None):
                    help="alternate baseline file (default: checked-in)")
     p.add_argument("--no-baseline", action="store_true",
                    help="show findings the baseline would suppress")
+    p.add_argument("--list", action="store_true",
+                   help="print the check catalog with per-check finding "
+                        "counts instead of the findings themselves")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("checkcat")

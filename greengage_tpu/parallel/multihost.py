@@ -388,7 +388,9 @@ class CoordinatorChannel:
         self._hb_stop.set()
         t = self._hb_thread
         if t is not None and t.is_alive() and t is not threading.current_thread():
-            t.join(timeout=5)
+            # control-plane teardown: the loop exits on _hb_stop within
+            # one heartbeat tick and the join is hard-bounded
+            t.join(timeout=5)   # gg:ok(interrupts)
         self._hb_thread = None
 
     # ---- quiesce + rejoin (gang re-formation, cdbgang recreation) ------
@@ -501,7 +503,9 @@ class CoordinatorChannel:
         t = self._rejoin_thread
         if t is not None and t.is_alive() \
                 and t is not threading.current_thread():
-            t.join(timeout=2)
+            # gang-reformation teardown: the acceptor exits on
+            # _rejoin_stop within one accept timeout, join hard-bounded
+            t.join(timeout=2)   # gg:ok(interrupts)
         self._rejoin_thread = None
 
     def adopt_rejoined(self) -> None:

@@ -140,7 +140,11 @@ def fetch_chunks(url: str, nchunks: int) -> list[bytes]:
         except Exception as e:
             errs.append(e)
 
-    ts = [threading.Thread(target=one, args=(i,)) for i in range(nchunks)]
+    # named so the runtime race witness tags these as the ingest role
+    # (threadmodel.ROLE_NAME_PREFIXES maps the gg-gpfdist prefix)
+    ts = [threading.Thread(target=one, args=(i,),
+                           name=f"gg-gpfdist-fetch-{i}")
+          for i in range(nchunks)]
     [t.start() for t in ts]
     [t.join() for t in ts]
     if errs:

@@ -196,7 +196,11 @@ class StatementRegistry:
                 del self._by_thread[tid]
 
     def current(self) -> StatementContext | None:
-        return self._by_thread.get(threading.get_ident())
+        # deliberately lock-free: the calling thread reads ITS OWN entry,
+        # which only this same thread inserts/deletes (enter/exit), and
+        # this runs at every CHECK_FOR_INTERRUPTS — a mutex here would
+        # tax every cancellation point in the engine
+        return self._by_thread.get(threading.get_ident())   # gg:ok(races)
 
     def get(self, statement_id: int) -> StatementContext | None:
         with self._lock:

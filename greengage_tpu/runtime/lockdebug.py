@@ -1,26 +1,43 @@
-"""Debug-mode runtime lock-order assertions — the dynamic half of the
-``gg check`` lock-order analyzer (analysis/lint_locks.py).
+"""Debug-mode runtime lock-order assertions and the cross-role access
+witness — the dynamic halves of the ``gg check`` lock-order and race
+analyzers (analysis/lint_locks.py, analysis/lint_races.py).
 
-The static analyzer sees the package-wide acquisition graph but must
-collapse per-key lock *families* (``session._table_locks``, the repair
-locks) to one node; this hook watches real acquisitions and fails the
-process on an order inversion the moment one thread observes A -> B
-after any thread observed B -> A — the classic witness a deadlock needs,
-caught even when the interleaving never actually deadlocks.
+Two independent hooks, each zero-cost unless armed:
 
-Zero-cost by default: nothing records unless ``enable()`` ran (tests,
-``GGTPU_LOCK_DEBUG=1``). Usage::
+* **Lock order** (``GGTPU_LOCK_DEBUG=1`` / ``enable()``): the static
+  analyzer sees the package-wide acquisition graph but must collapse
+  per-key lock *families* (``session._table_locks``, the repair locks)
+  to one node; this hook watches real acquisitions and fails the
+  process on an order inversion the moment one thread observes A -> B
+  after any thread observed B -> A — the classic witness a deadlock
+  needs, caught even when the interleaving never actually deadlocks.
+
+* **Race witness** (``GGTPU_RACE_DEBUG=1`` / ``enable_races()``):
+  ``shared(obj, name)`` wraps a dict-like structure with a proxy that
+  records (thread role, held named-lock set, read/write) per access —
+  the thread role comes from the spawn site's thread-name prefix
+  (analysis/threadmodel.py), the held set from this module's own
+  acquisition tracking. The first witnessed pair of accesses from two
+  DIFFERENT roles where at least one writes and the held sets share no
+  lock raises ``RaceWitnessError`` naming both sides — the runtime
+  complement of ``gg check races``, catching an interleaving the
+  static model missed (and dumping a JSON report for CI forensics).
+
+Usage::
 
     from greengage_tpu.runtime import lockdebug
     lock = lockdebug.named(threading.Lock(), "manifest._log_lock")
-    with lock: ...
+    cache = lockdebug.shared({}, "manifest._delta_cache")
+    with lock: cache[k] = v
 
-``named()`` returns the lock unwrapped when disabled, so production
-paths keep raw ``threading`` primitives.
+``named()``/``shared()`` return their argument unwrapped when the
+corresponding mode is off, so production paths keep raw ``threading``
+primitives and raw containers.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 
@@ -46,16 +63,20 @@ class _OrderTable:
 
     def acquiring(self, name: str) -> None:
         held = self._held()
-        with self._mu:
-            for outer in held:
-                if outer == name:
-                    continue   # re-entrant same-name holds are fine
-                if name in self._after and outer in self._after[name]:
-                    raise LockOrderError(
-                        f"lock-order inversion: acquiring {name!r} while "
-                        f"holding {outer!r}, but {outer!r} was previously "
-                        f"acquired while holding {name!r}")
-                self._after.setdefault(outer, set()).add(name)
+        # order assertions belong to lock debug; with only the race
+        # witness armed this table still tracks the held set (the
+        # witness's protection evidence) without judging order
+        if _ENABLED:
+            with self._mu:
+                for outer in held:
+                    if outer == name:
+                        continue   # re-entrant same-name holds are fine
+                    if name in self._after and outer in self._after[name]:
+                        raise LockOrderError(
+                            f"lock-order inversion: acquiring {name!r} "
+                            f"while holding {outer!r}, but {outer!r} was "
+                            f"previously acquired while holding {name!r}")
+                    self._after.setdefault(outer, set()).add(name)
         held.append(name)
 
     def released(self, name: str) -> None:
@@ -124,7 +145,165 @@ class _Named:
 
 def named(lock, name: str):
     """Wrap ``lock`` with order assertions under debug mode; return it
-    untouched otherwise."""
-    if not _ENABLED:
+    untouched otherwise. Race debug implies lock debug wrapping: the
+    witness's held-set tracking rides the same acquisition hooks."""
+    if not (_ENABLED or _RACE_ENABLED):
         return lock
     return _Named(lock, name)
+
+
+def held_names() -> frozenset:
+    """Named locks the calling thread holds right now (the race
+    witness's protection evidence)."""
+    return frozenset(_TABLE._held())
+
+
+# ---------------------------------------------------------------------
+# cross-role access witness (GGTPU_RACE_DEBUG; docs/ANALYSIS.md)
+# ---------------------------------------------------------------------
+
+class RaceWitnessError(AssertionError):
+    """Two thread roles touched a shared structure, at least one wrote,
+    and the two accesses held no common named lock."""
+
+
+_RACE_ENABLED = bool(int(os.environ.get("GGTPU_RACE_DEBUG", "0") or "0"))
+_RACE_REPORT_PATH = os.environ.get("GGTPU_RACE_REPORT",
+                                   "/tmp/gg_race_witness.json")
+
+
+def enable_races(on: bool = True) -> None:
+    global _RACE_ENABLED
+    _RACE_ENABLED = on
+
+
+def races_enabled() -> bool:
+    return _RACE_ENABLED
+
+
+def current_role() -> str:
+    """The calling thread's declared role, from its name prefix (every
+    package spawn site names its thread — analysis/threadmodel.py)."""
+    from greengage_tpu.analysis.threadmodel import role_of_thread_name
+
+    return role_of_thread_name(threading.current_thread().name)
+
+
+class _Witness:
+    """Per-structure access log: one (role, locks, wrote) record per
+    distinct observation, checked pairwise against other roles'."""
+
+    __slots__ = ("name", "_mu", "_seen")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self._seen: set = set()   # (role, frozenset(locks), wrote)
+
+    def record(self, wrote: bool, op: str) -> None:
+        if not _RACE_ENABLED:
+            return
+        role = current_role()
+        locks = held_names()
+        rec = (role, locks, wrote)
+        with self._mu:
+            if rec in self._seen:
+                return
+            for role2, locks2, wrote2 in self._seen:
+                if role2 != role and (wrote or wrote2) \
+                        and not (locks & locks2):
+                    self._dump(role, locks, wrote, op,
+                               role2, locks2, wrote2)
+                    raise RaceWitnessError(
+                        f"unprotected cross-role access on {self.name!r}: "
+                        f"role {role} ({op}, "
+                        f"{'write' if wrote else 'read'}, locks "
+                        f"{sorted(locks) or 'none'}) vs role {role2} "
+                        f"({'write' if wrote2 else 'read'}, locks "
+                        f"{sorted(locks2) or 'none'}) — no common lock; "
+                        "see gg check races / docs/ANALYSIS.md")
+            self._seen.add(rec)
+
+    def _dump(self, role, locks, wrote, op, role2, locks2, wrote2) -> None:
+        """Forensics file for the CI artifact: the witnessed pair, the
+        structure, and the offending thread's identity."""
+        try:
+            with open(_RACE_REPORT_PATH, "w") as f:
+                json.dump({
+                    "structure": self.name,
+                    "thread": threading.current_thread().name,
+                    "access": {"role": role, "op": op, "write": wrote,
+                               "locks": sorted(locks)},
+                    "prior": {"role": role2, "write": wrote2,
+                              "locks": sorted(locks2)},
+                }, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+
+
+# dict/OrderedDict surface split by effect; everything else a structure
+# needs should be added here, not reached through __getattr__ silently
+_READ_METHODS = ("get", "keys", "values", "items", "copy")
+_WRITE_METHODS = ("pop", "popitem", "clear", "update", "setdefault",
+                  "move_to_end")
+
+
+class SharedDict:
+    """Access-witnessing proxy over a dict-like structure. Mirrors the
+    mapping surface the package uses; every entry point records
+    (role, held locks) before delegating."""
+
+    __slots__ = ("_d", "_w")
+
+    def __init__(self, d, name: str):
+        self._d = d
+        self._w = _Witness(name)
+
+    # -- reads ----------------------------------------------------------
+    def __getitem__(self, k):
+        self._w.record(False, "__getitem__")
+        return self._d[k]
+
+    def __contains__(self, k):
+        self._w.record(False, "__contains__")
+        return k in self._d
+
+    def __len__(self):
+        self._w.record(False, "__len__")
+        return len(self._d)
+
+    def __iter__(self):
+        self._w.record(False, "__iter__")
+        return iter(self._d)
+
+    def __bool__(self):
+        self._w.record(False, "__bool__")
+        return bool(self._d)
+
+    # -- writes ---------------------------------------------------------
+    def __setitem__(self, k, v):
+        self._w.record(True, "__setitem__")
+        self._d[k] = v
+
+    def __delitem__(self, k):
+        self._w.record(True, "__delitem__")
+        del self._d[k]
+
+    def __getattr__(self, name):
+        if name in _READ_METHODS:
+            self._w.record(False, name)
+        elif name in _WRITE_METHODS:
+            self._w.record(True, name)
+        else:
+            raise AttributeError(
+                f"{type(self._d).__name__} witness proxy does not expose "
+                f"{name!r}; add it to lockdebug.SharedDict explicitly")
+        return getattr(self._d, name)
+
+
+def shared(obj, name: str):
+    """Wrap a dict-like shared structure with the access witness under
+    ``GGTPU_RACE_DEBUG``; return it untouched otherwise."""
+    if not _RACE_ENABLED:
+        return obj
+    return SharedDict(obj, name)

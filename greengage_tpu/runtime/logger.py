@@ -117,8 +117,13 @@ class Counters:
     mh_topology_version) so the Prometheus exposition types them right."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._c: dict[str, int] = {}
+        from greengage_tpu.runtime import lockdebug
+
+        self._lock = lockdebug.named(threading.Lock(),
+                                     "logger.counters._lock")
+        # access-witnessed under GGTPU_RACE_DEBUG: every touch must hold
+        # the counters lock (docs/ANALYSIS.md "Race analysis")
+        self._c: dict[str, int] = lockdebug.shared({}, "logger.counters._c")
         self._gauges: set[str] = set(GAUGE_NAMES)
 
     def inc(self, name: str, n: int = 1) -> int:
@@ -150,7 +155,9 @@ class Counters:
 
     def snapshot(self) -> dict[str, int]:
         with self._lock:
-            return dict(self._c)
+            # items() not dict(): one access-witness record per snapshot
+            # instead of one per key (GGTPU_RACE_DEBUG)
+            return dict(self._c.items())
 
     def since(self, base: dict[str, int],
               prefix: str | None = None) -> dict[str, int]:

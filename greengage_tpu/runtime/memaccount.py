@@ -292,12 +292,17 @@ _dev_handle = None   # cached jax device: the sampler runs twice per span
 
 def device_memory_stats() -> dict | None:
     """First local device's allocator stats (bytes_in_use,
-    peak_bytes_in_use, ...); None when the backend has none (CPU)."""
+    peak_bytes_in_use, ...); None when the backend has none (CPU).
+    All probe state (_dev_handle/_DEV_FAILS/_DEV_UNSUPPORTED) moves
+    under _dev_mu; only the memory_stats() C call itself runs outside
+    it, so concurrent samplers never see a half-updated handle
+    (gg check races)."""
     global _DEV_UNSUPPORTED, _DEV_FAILS, _dev_handle
-    if _DEV_UNSUPPORTED:
-        return None
-    try:
+    with _dev_mu:
+        if _DEV_UNSUPPORTED:
+            return None
         d = _dev_handle
+    try:
         if d is None:
             import jax
 
@@ -307,11 +312,12 @@ def device_memory_stats() -> dict | None:
                     _DEV_UNSUPPORTED = True
                 return None
             d = devs[0]
-            _dev_handle = d
+            with _dev_mu:
+                _dev_handle = d
         stats = d.memory_stats()
     except Exception:
-        _dev_handle = None   # re-resolve next probe (backend restart)
         with _dev_mu:
+            _dev_handle = None   # re-resolve next probe (backend restart)
             _DEV_FAILS += 1
             if _DEV_FAILS >= _DEV_FAIL_LIMIT:
                 _DEV_UNSUPPORTED = True

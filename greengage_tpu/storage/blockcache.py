@@ -32,7 +32,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
-from greengage_tpu.runtime import memaccount
+from greengage_tpu.runtime import lockdebug, memaccount
 from greengage_tpu.runtime.logger import counters
 
 MISS = object()   # sentinel distinguishing "absent" from a cached None
@@ -65,7 +65,10 @@ class BlockCache:
     def __init__(self, registry: "CacheRegistry", name: str):
         self.registry = registry
         self.name = name
-        self._d: OrderedDict = OrderedDict()
+        # access-witnessed under GGTPU_RACE_DEBUG: every touch must hold
+        # the registry lock (docs/ANALYSIS.md "Race analysis")
+        self._d: OrderedDict = lockdebug.shared(OrderedDict(),
+                                                f"blockcache.{name}._d")
         self.bytes = 0
 
     # -- reads ----------------------------------------------------------
@@ -156,7 +159,8 @@ class CacheRegistry:
     """Shared byte budget + global-LRU eviction over named BlockCaches."""
 
     def __init__(self, limit_mb: int | None = None):
-        self._lock = threading.RLock()
+        self._lock = lockdebug.named(threading.RLock(),
+                                     "blockcache.registry._lock")
         self._caches: dict[str, BlockCache] = {}
         self._tick = 0
         self._total = 0

@@ -86,7 +86,15 @@ class Manifest:
                                     "root_version": 0}
         # parsed delta-file contents; immutable once committed, keyed
         # (table, seq). Bounded: cleared whenever the root is replaced.
-        self._delta_cache: dict = {}
+        # Own lock (never held across I/O): _read_delta runs OUTSIDE
+        # _compose_lock by design (the compose loop re-stats between
+        # attempts), and every snapshot-taking role — statements, the
+        # serving pipeline, FTS, the spill prefetcher — reaches it
+        # concurrently (gg check races).
+        self._delta_lock = lockdebug.named(threading.Lock(),
+                                           "manifest._delta_lock")
+        self._delta_cache: dict = lockdebug.shared(
+            {}, "manifest._delta_cache")
         self._log_lock = lockdebug.named(   # in-process append serializer
             threading.Lock(), "manifest._log_lock")
         # serializes the root version-guard check against the replace (two
@@ -152,7 +160,8 @@ class Manifest:
         # recreated delta must never be served from the dropped table's
         # cached bytes (only same-process commits clear the cache)
         key = (table, seq, st.st_ino, st.st_mtime_ns)
-        hit = self._delta_cache.get(key)
+        with self._delta_lock:
+            hit = self._delta_cache.get(key)
         if hit is not None:
             return json.loads(hit)
         try:
@@ -161,9 +170,10 @@ class Manifest:
             parsed = json.loads(raw)
         except (OSError, ValueError):
             return None
-        if len(self._delta_cache) > 512:
-            self._delta_cache.clear()   # bound a long-lived reader
-        self._delta_cache[key] = raw
+        with self._delta_lock:
+            if len(self._delta_cache) > 512:
+                self._delta_cache.clear()   # bound a long-lived reader
+            self._delta_cache[key] = raw
         return parsed
 
     def _log_lines(self, offset: int) -> tuple[list[dict], int]:
@@ -365,7 +375,7 @@ class Manifest:
                     f"write-write conflict: root advanced to v{cur} before "
                     f"staged v{version} could commit")
             os.replace(tmp, self.path)
-        with self._compose_lock:
+        with self._delta_lock:
             self._delta_cache.clear()
         # the new root folded every delta at or below its recorded
         # sequences: GC their files (best-effort; recover() is the backstop)
@@ -590,6 +600,7 @@ class Manifest:
                     pass
         with self._compose_lock:
             self._compose_key = None
+        with self._delta_lock:
             self._delta_cache.clear()
 
     # ---- recovery ------------------------------------------------------
@@ -652,6 +663,7 @@ class Manifest:
                 os.remove(os.path.join(self.delta_dir, fn))   # fold leftover
         with self._compose_lock:
             self._compose_key = None    # delta files moved under us
+        with self._delta_lock:
             self._delta_cache.clear()
         # compaction: fold everything, then reset the log (exclusive-open
         # startup is the one safe moment to shrink it)
@@ -697,6 +709,7 @@ class Manifest:
         self._gc_deltas(int(self._root().get("log_pos", 0)), grace_s=0.0)
         with self._compose_lock:
             self._compose_key = None
+        with self._delta_lock:
             self._delta_cache.clear()
         return rolled
 

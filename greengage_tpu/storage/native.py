@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 import zlib
 
 import numpy as np
@@ -30,9 +31,19 @@ BLOCK_MAGIC = 0x47474232
 HDR_LEN = 32
 
 _lib = None
+_load_mu = threading.Lock()
 
 
 def _load():
+    # serialized: two staging threads racing the first load would run
+    # `make` twice and publish half-configured handles (gg check races);
+    # the steady-state cost is one uncontended acquire per call, noise
+    # next to the ctypes dispatch it guards
+    with _load_mu:
+        return _load_locked()
+
+
+def _load_locked():
     global _lib
     if _lib is not None:
         return _lib

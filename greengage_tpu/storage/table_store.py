@@ -88,6 +88,9 @@ def merge_segfile_records(tx: dict, table: str, records: list) -> None:
 
 
 _MIRROR_MAP_CACHE: dict = {}   # root -> (mtime, {content: dir})
+# read-path self-heal resolves mirror roots from staging-pool threads
+# while FTS promotion re-reads the operator map (gg check races)
+_mirror_map_mu = threading.Lock()
 
 
 def mirror_root(root: str, content: int) -> str:
@@ -101,19 +104,22 @@ def mirror_root(root: str, content: int) -> str:
     mp = os.path.join(root, "mirror_roots.json")
     try:
         mtime = os.stat(mp).st_mtime_ns
-        cached = _MIRROR_MAP_CACHE.get(root)
-        if cached is None or cached[0] != mtime:
-            with open(mp) as f:
-                _MIRROR_MAP_CACHE[root] = (mtime, json.load(f))
-        override = _MIRROR_MAP_CACHE[root][1].get(str(content))
+        with _mirror_map_mu:
+            cached = _MIRROR_MAP_CACHE.get(root)
+            if cached is None or cached[0] != mtime:
+                with open(mp) as f:
+                    cached = _MIRROR_MAP_CACHE[root] = (mtime, json.load(f))
+        override = cached[1].get(str(content))
         if override:
             return os.path.join(override, f"content{content}")
     except OSError:
-        _MIRROR_MAP_CACHE.pop(root, None)
+        with _mirror_map_mu:
+            _MIRROR_MAP_CACHE.pop(root, None)
     except ValueError:
         # malformed operator edit: fall back to the default placement
         # rather than taking down every mirror-maintenance path
-        _MIRROR_MAP_CACHE.pop(root, None)
+        with _mirror_map_mu:
+            _MIRROR_MAP_CACHE.pop(root, None)
     return os.path.join(root, "mirror", f"content{content}")
 
 
@@ -484,8 +490,14 @@ class TableStore:
         if table == "@rawdict":
             # transient raw-TEXT dicts are bounded-evicted; a cached plan
             # may still hold an evicted ref — rebuild from the key, which
-            # encodes parent:col:version (exactly raw_dictionary's inputs)
-            if (table, col) not in self._derived:
+            # encodes parent:col:version (exactly raw_dictionary's
+            # inputs). Probe and fetch under _dict_lock: raw_dictionary's
+            # >16 transient bound evicts CONCURRENTLY from staging-pool
+            # threads, and an unlocked membership test could pass right
+            # before the eviction lands (gg check races).
+            with self._dict_lock:
+                hit = self._derived.get((table, col))
+            if hit is None:
                 parent, rcol, ver = col.rsplit(":", 2)
                 snap = self.manifest.snapshot()
                 if snap.get("version", 0) != int(ver):
@@ -493,14 +505,23 @@ class TableStore:
                         f"raw dictionary {col} evicted and manifest moved to "
                         f"v{snap.get('version', 0)}; plan cache is stale")
                 self.raw_dictionary(parent, rcol, snap)
-            return self._derived[(table, col)]
+                with self._dict_lock:
+                    hit = self._derived[(table, col)]
+            return hit
         if table == "@expr":
-            return self._derived[(table, col)]
+            with self._dict_lock:
+                return self._derived[(table, col)]
         # partition children share the PARENT's dictionary: one code space
         # per logical table, so codes compare/join across partitions
         table = table.split("#", 1)[0]
         key = (table, col)
-        d = self._dicts.get(key)
+        # unlocked fast-path probe, double-checked under the lock below:
+        # a persisted dict is immutable once loaded and evicted only by
+        # DROP/recreate DDL (_invalidate_dicts), so a hit is always a
+        # valid value for any scan that began before the drop, and a
+        # stale miss only costs the locked re-probe — the per-scan hot
+        # path skips the mutex
+        d = self._dicts.get(key)   # gg:ok(races)
         if d is None:
             with self._dict_lock:   # one load per dict under parallel staging
                 d = self._dicts.get(key)
@@ -516,8 +537,9 @@ class TableStore:
 
         h = hashlib.sha1("\x00".join(values).encode()).hexdigest()[:16]
         ref = ("@expr", h)
-        if ref not in self._derived:
-            self._derived[ref] = Dictionary(list(values))
+        with self._dict_lock:
+            if ref not in self._derived:
+                self._derived[ref] = Dictionary(list(values))
         return ref
 
     def raw_dictionary(self, table: str, col: str, snapshot=None) -> tuple:
@@ -818,17 +840,24 @@ class TableStore:
         schema = self.catalog.get(table)
         table = table.split("#", 1)[0]   # children share the parent dict
         for c in schema.columns:
-            if c.type.kind is T.Kind.TEXT and (table, c.name) in self._dicts:
-                os.makedirs(os.path.join(self.root, "data", table), exist_ok=True)
-                self._dicts[(table, c.name)].save(self._dict_path(table, c.name))
+            if c.type.kind is not T.Kind.TEXT:
+                continue
+            with self._dict_lock:   # loaders insert from staging threads
+                d = self._dicts.get((table, c.name))
+            if d is not None:
+                os.makedirs(os.path.join(self.root, "data", table),
+                            exist_ok=True)
+                d.save(self._dict_path(table, c.name))
 
     def _invalidate_dicts(self, table: str) -> None:
         table = table.split("#", 1)[0]
-        for key in [k for k in self._dicts if k[0] == table]:
-            del self._dicts[key]
+        with self._dict_lock:   # staging threads load dicts concurrently
+            for key in [k for k in self._dicts if k[0] == table]:
+                del self._dicts[key]
 
     def _invalidate_dicts_all(self) -> None:
-        self._dicts.clear()
+        with self._dict_lock:
+            self._dicts.clear()
 
     # ---- read path -----------------------------------------------------
     @property

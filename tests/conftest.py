@@ -43,6 +43,12 @@ def pytest_configure(config):
     from greengage_tpu.runtime import lockdebug
 
     lockdebug.enable(True)
+    # cross-role access witness (docs/ANALYSIS.md "Race analysis"): every
+    # lockdebug.shared() structure created after this point records
+    # (thread role, held-lock set) per access and fails the suite on the
+    # first unprotected cross-role pair — the dynamic half of the
+    # `gg check races` analyzer
+    lockdebug.enable_races(True)
 
 
 def pytest_sessionfinish(session, exitstatus):

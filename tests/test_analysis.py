@@ -1,6 +1,6 @@
 """gg check: plan-invariant validator + codebase analysis suite.
 
-Four layers:
+Five layers:
   * plancheck over the REAL TPC-H / TPC-DS plan corpus (every corpus
     statement validates clean; deliberately mutated plans — a dropped
     Motion, a wrong distribution key, an interior Gather — are rejected
@@ -9,6 +9,10 @@ Four layers:
   * the static analyzers against known-bad fixture snippets (a lock
     cycle, an unpolled wait loop, a tracer-sync violation) plus the
     runtime lock-order hook,
+  * the ISSUE-14 thread-topology suite: cross-role race fixtures,
+    shipped-tree mutations (a de-locked BlockCache / program LRU, an
+    unregistered thread spawn, a dropped plan-cache GUC) that must each
+    produce a typed finding, and the runtime access witness,
   * the merge gate itself: `gg check` over the shipped tree is clean.
 """
 
@@ -453,3 +457,244 @@ def test_baseline_suppression(tmp_path):
     assert out.findings == []
     out2 = rep.suppressed(load_baseline(str(tmp_path / "missing.txt")))
     assert len(out2.findings) == 1
+
+
+# ---------------------------------------------------------------------
+# ISSUE 14: thread-topology race analysis (threads + races checks) and
+# the runtime access witness — all pure-AST / host-only
+# ---------------------------------------------------------------------
+
+def _two_roles(entries_a, entries_b):
+    from greengage_tpu.analysis.threadmodel import Role
+
+    return {
+        "alpha": Role("alpha", "fixture role A", (), tuple(entries_a)),
+        "beta": Role("beta", "fixture role B", (), tuple(entries_b)),
+    }
+
+
+_RACY = (
+    "import threading\n"
+    "lock = threading.Lock()\n"
+    "state = {}\n"
+    "def writer_loop():\n"
+    "    state['x'] = 1\n"
+    "def reader_loop():\n"
+    "    return state.get('x')\n")
+
+_LOCKED = (
+    "import threading\n"
+    "lock = threading.Lock()\n"
+    "state = {}\n"
+    "def writer_loop():\n"
+    "    with lock:\n"
+    "        state['x'] = 1\n"
+    "def reader_loop():\n"
+    "    with lock:\n"
+    "        return state.get('x')\n")
+
+
+def test_cross_role_bare_write_detected(tmp_path):
+    from greengage_tpu.analysis import lint_races
+
+    src = _sources(tmp_path, {"racemod.py": _RACY})
+    roles = _two_roles([("racemod.py", "", "writer_loop")],
+                       [("racemod.py", "", "reader_loop")])
+    rep = lint_races.run(src, roles=roles)
+    assert len(rep.findings) == 1, rep.to_text()
+    f = rep.findings[0]
+    assert f.check == "races" and "racemod.state" in f.key
+    # the typed finding carries BOTH access paths and names both roles
+    assert "alpha" in f.message and "beta" in f.message
+    assert f.message.count("racemod.py:") == 2
+
+
+def test_cross_role_locked_and_single_role_clean(tmp_path):
+    from greengage_tpu.analysis import lint_races
+
+    src = _sources(tmp_path / "locked", {"racemod.py": _LOCKED})
+    roles = _two_roles([("racemod.py", "", "writer_loop")],
+                       [("racemod.py", "", "reader_loop")])
+    assert lint_races.run(src, roles=roles).findings == []
+    # same bare write, but only ONE role ever touches it: clean (the
+    # analyzer is cross-role by design; intra-role races are the lock
+    # lint's and the session's domain)
+    src2 = _sources(tmp_path / "single", {"racemod.py": _RACY})
+    roles2 = _two_roles([("racemod.py", "", "writer_loop"),
+                         ("racemod.py", "", "reader_loop")], [])
+    assert lint_races.run(src2, roles=roles2).findings == []
+
+
+def _mutated(sources, rel_suffix, old, new):
+    import ast as _ast
+
+    src = sources.get(rel_suffix)
+    text = src.text.replace(old, new)
+    assert text != src.text, f"mutation anchor drifted in {rel_suffix}"
+    src.text = text
+    src.tree = _ast.parse(text)
+    src.lines = text.splitlines()
+    return sources
+
+
+def test_mutation_unlocked_blockcache_read_flagged():
+    """Strip the registry lock from BlockCache.get: the races check must
+    name the structure and two real roles (staging pool vs statement /
+    serving pipeline all reach the block cache)."""
+    from greengage_tpu.analysis import lint_races
+
+    src = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+    _mutated(src, "storage/blockcache.py",
+             "        with reg._lock:\n            ent = self._d.get(key)",
+             "        if True:\n            ent = self._d.get(key)")
+    rep = lint_races.run(src)
+    hit = [f for f in rep.findings if "BlockCache._d" in f.key]
+    assert hit, rep.to_text()
+    assert "written by role" in hit[0].message \
+        and "no common lock" in hit[0].message
+
+
+def test_mutation_unlocked_program_lru_flagged():
+    """Strip _cache_mu from the program-LRU insert: the races check must
+    flag _plan_cache between the serving stager and statement threads."""
+    from greengage_tpu.analysis import lint_races
+
+    src = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+    _mutated(src, "exec/executor.py",
+             "        with self._cache_mu:\n"
+             "            self._plan_cache[ck] = comp",
+             "        if True:\n"
+             "            self._plan_cache[ck] = comp")
+    rep = lint_races.run(src)
+    hit = [f for f in rep.findings if "Executor._plan_cache" in f.key]
+    assert hit, rep.to_text()
+
+
+def test_thread_hygiene_both_ways():
+    from greengage_tpu.analysis import threadmodel
+
+    # shipped tree: every spawn site modelled, every model row live
+    src = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+    rep = threadmodel.run(src)
+    assert rep.findings == [], rep.to_text()
+    assert rep.notes["thread_spawn_sites"] >= 12
+    # an unregistered spawn site is a finding
+    src2 = astutil.SourceSet(exclude=("greengage_tpu/analysis/",))
+    _mutated(src2, "runtime/fts.py",
+             "    def stop(self) -> None:",
+             "    def rogue(self):\n"
+             "        threading.Thread(target=self.probe_once).start()\n\n"
+             "    def stop(self) -> None:")
+    rep2 = threadmodel.run(src2)
+    assert any("unregistered-spawn" in f.key for f in rep2.findings), \
+        rep2.to_text()
+
+
+def test_plan_cache_guc_lint_mutation():
+    """ISSUE 14 satellite: dropping a binding-read GUC from the SET
+    handler's _select_cache.clear() tuple is a finding; so is a tuple
+    entry the binding path no longer reads."""
+    from greengage_tpu.analysis import lint_registry
+
+    src = astutil.SourceSet()
+    _mutated(src, "exec/session.py",
+             'if stmt.name in ("optimizer", "plan_cache_params",',
+             'if stmt.name in ("plan_cache_params",')
+    rep = lint_registry.run(src)
+    assert any(f.key == "plan-cache-guc-unclears:optimizer"
+               for f in rep.findings), rep.to_text()
+    src2 = astutil.SourceSet()
+    _mutated(src2, "exec/session.py",
+             'if stmt.name in ("optimizer", "plan_cache_params",',
+             'if stmt.name in ("optimizer", "motion_retry_tiers", '
+             '"plan_cache_params",')
+    rep2 = lint_registry.run(src2)
+    assert any(f.key == "plan-cache-guc-stale:motion_retry_tiers"
+               for f in rep2.findings), rep2.to_text()
+
+
+def test_queue_get_timeout_and_thread_join_detected(tmp_path):
+    """ISSUE 14 satellite: the PR-11 ready-queue wait (`.get(timeout=)`
+    on any receiver) and the PR-12 prefetcher drain (`.join(timeout=)`
+    on a thread) are blocking waits; polling variants are clean."""
+    from greengage_tpu.analysis import lint_interrupts
+
+    bad = ("def pump(dq):\n"
+           "    while True:\n"
+           "        item = dq.get(timeout=0.25)\n"
+           "def drain(worker_thread):\n"
+           "    worker_thread.join(timeout=60.0)\n")
+    good = ("def pump(dq, ctx):\n"
+            "    while True:\n"
+            "        ctx.check()\n"
+            "        item = dq.get(timeout=0.25)\n"
+            "def drain(worker_thread, ctx):\n"
+            "    if not ctx.cancelled:\n"
+            "        worker_thread.join(timeout=60.0)\n")
+    rep = lint_interrupts.run(_sources(tmp_path / "bad", {"w.py": bad}))
+    assert sorted(f.key for f in rep.findings) == \
+        ["drain:thread-join", "pump:queue-get"], rep.to_text()
+    rep2 = lint_interrupts.run(_sources(tmp_path / "good", {"w.py": good}))
+    assert rep2.findings == []
+
+
+def test_race_witness_runtime():
+    """The dynamic half: an injected bare cross-role access under the
+    armed witness raises RaceWitnessError naming both roles; the same
+    access under a common named lock is clean."""
+    import threading
+
+    from greengage_tpu.runtime import lockdebug
+
+    prior = lockdebug.races_enabled()
+    lockdebug.enable_races(True)
+    try:
+        c = lockdebug.shared({}, "test.witness")
+        mu = lockdebug.named(threading.Lock(), "test.witness_mu")
+        c["x"] = 1               # statement role (MainThread), bare
+        got = []
+
+        def bare():
+            try:
+                c["x"] = 2       # fts role by thread name, bare: races
+            except lockdebug.RaceWitnessError as e:
+                got.append(e)
+        t = threading.Thread(target=bare, name="fts-prober")
+        t.start()
+        t.join()
+        assert got and "fts" in str(got[0]) and "statement" in str(got[0])
+
+        c2 = lockdebug.shared({}, "test.witness_locked")
+        with mu:
+            c2["x"] = 1
+        ok = []
+
+        def locked():
+            with mu:
+                c2["x"] = 2
+            ok.append(True)
+        t2 = threading.Thread(target=locked, name="fts-prober")
+        t2.start()
+        t2.join()
+        assert ok, "common named lock must satisfy the witness"
+    finally:
+        lockdebug.enable_races(prior)
+
+
+def test_gg_check_list_catalog():
+    """`gg check --list` prints every registered check (threads/races
+    included) with per-check finding counts; clean tree exits 0."""
+    import io
+    from contextlib import redirect_stdout
+
+    from greengage_tpu.mgmt import cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["check", "--list", "--json"])
+    assert rc == 0
+    payload = json.loads(buf.getvalue())
+    names = {r["check"] for r in payload["checks"]}
+    assert {"threads", "races", "locks", "interrupts", "registry",
+            "tracer", "imports"} <= names
+    assert all(r["findings"] == 0 for r in payload["checks"]), payload

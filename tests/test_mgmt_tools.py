@@ -393,3 +393,18 @@ def test_settings_adoption_failures_surface(devices8, tmp_path, capsys):
     blob = "".join(open(os.path.join(logdir, p)).read()
                    for p in os.listdir(logdir))
     assert "no_such_guc" in blob
+
+
+# ---------------------------------------------------------------------------
+# gg check --list (ISSUE 14: the check catalog with per-check counts — the
+# tier-1 log's receipt of what ran; the analyzers' behavior matrix lives in
+# test_analysis.py, this keeps the COMMAND itself wired)
+# ---------------------------------------------------------------------------
+
+def test_check_list_smoke(capsys):
+    assert run_cli("check", "--list") == 0
+    out = capsys.readouterr().out
+    for name in ("locks", "interrupts", "tracer", "registry", "imports",
+                 "threads", "races"):
+        assert name in out, out
+    assert "finding(s)" in out
