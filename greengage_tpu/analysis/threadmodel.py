@@ -55,22 +55,29 @@ THREAD_ROLES: dict[str, Role] = {
         "statement",
         "statement/connection threads: Database.sql on the caller's "
         "thread, including every server handler thread executing it "
-        "(and the inline staging pool at scan_threads=1)",
+        "(and the inline staging pool at scan_threads=1). Handler.handle "
+        "is an entry so the serving plane's shared state (SqlServer "
+        "admission/drain bookkeeping, the per-connection watcher "
+        "arm/disarm surface) is race-analyzed from the threads that "
+        "actually touch it",
         spawns=(),          # spawned by callers/socketserver, not by us
         entries=(("exec/session.py", "Database", "sql"),
+                 # server handler threads: admission, serve loop, drain
+                 ("runtime/server.py", "Handler", "handle"),
                  # scan_threads=1 runs read units on the calling thread
                  ("exec/executor.py", "Executor", "_read_unit")),
     ),
     "server": Role(
         "server",
-        "socket accept loops plus the per-statement client-disconnect "
-        "watcher (the handler threads themselves run statements and are "
-        "modelled as the statement role)",
+        "socket accept loops plus the per-CONNECTION client-disconnect "
+        "watcher (_ConnWatcher: armed per statement, parked between "
+        "statements; the handler threads themselves run statements and "
+        "are modelled as the statement role)",
         spawns=(("runtime/server.py", "serve_forever"),
-                ("runtime/server.py", "_watch_client"),
+                ("runtime/server.py", "_loop"),
                 ("runtime/server.py", "class:Server"),
                 ("runtime/server.py", "class:TcpServer")),
-        entries=(("runtime/server.py", "", "_watch_client"),),
+        entries=(("runtime/server.py", "_ConnWatcher", "_loop"),),
     ),
     "staging": Role(
         "staging",
@@ -181,6 +188,12 @@ SHARED_CLASSES: dict[str, str] = {
     "Database":          "session state reached from handler threads",
     "StatementRegistry": "interrupt contexts, cancelled cross-thread",
     "StatementContext":  "flag set by watcher/FTS/runaway threads",
+    "SqlServer":         "connection admission/drain state, mutated by "
+                         "every handler thread and stop()",
+    "_ConnWatcher":      "armed/epoch state shared between the handler "
+                         "thread and its watcher",
+    "OverloadController": "process-wide brownout state machine, "
+                          "evaluated from any statement thread",
     "FTSProber":         "probe bookkeeping",
     "SegmentConfig":     "topology mutated by FTS, read at dispatch",
     "PassPrefetcher":    "kicked by the spill loop, joined at close",

@@ -167,6 +167,58 @@ class Settings:
     batch_serving_enabled: bool = False
     batch_window_ms: float = 2.0
     batch_max_width: int = 16
+    # ---- overload armor (docs/ROBUSTNESS.md "Overload protection") ----
+    # bounded front end (runtime/server.py): cap on concurrent client
+    # connections — excess connects get a typed too_many_connections
+    # fast-fail (SQLSTATE 53300 analog) instead of silent thread growth;
+    # 0 = unlimited (the embedded/test default behavior stays reachable)
+    max_connections: int = 100
+    # auth-handshake deadline for remote (TCP) peers: a connect that
+    # never completes the challenge-response is closed, so a port-scan
+    # or wedged client cannot pin a handler thread forever (0 = off)
+    client_auth_deadline_s: float = 10.0
+    # idle-read deadline between statements: a connection silent past
+    # this is told idle_timeout and closed (0 = off, the default — BI
+    # tools hold idle connections legitimately)
+    client_idle_timeout_s: float = 0.0
+    # maximum request-frame size (one newline-delimited JSON line): an
+    # oversized frame is rejected with frame_too_large and the
+    # connection closed (the stream cannot be resynced), so a multi-GB
+    # line cannot OOM the host
+    max_frame_bytes: int = 64 << 20
+    # graceful-drain window for SqlServer.stop(): in-flight statements
+    # are flagged shutdown and handler threads joined up to this bound
+    # before their sockets are force-closed
+    server_drain_s: float = 5.0
+    # load shedding (runtime/resqueue.py shed_check, shared by the
+    # resource queue and resource groups): cap on statements WAITING for
+    # an admission slot — at the cap the statement is rejected with the
+    # typed, retryable AdmissionShed (SQLSTATE 53300 analog) instead of
+    # queueing forever; 0 = queue forever (legacy). Rejection ramps in
+    # probabilistically from admission_shed_ramp x cap so the approach
+    # to the cap sheds gradually, not as a cliff.
+    admission_queue_limit: int = 0
+    admission_shed_ramp: float = 0.75
+    # serving-pipeline cap (exec/batchserve.py): members allowed to wait
+    # across open admission windows; past it, new members shed to the
+    # classic serial path (which the admission queue bounds) instead of
+    # accumulating unboundedly while the device is busy. 0 = uncapped.
+    batch_queue_limit: int = 512
+    # memory-pressure brownout (runtime/overload.py): on sustained HBM
+    # pressure (watermark fraction or an OOM streak) the engine enters a
+    # typed brownout — block-cache budget x brownout_cache_factor, batch
+    # serving disabled, admission ceiling x brownout_vmem_factor so new
+    # statements prefer the spill tier — and exits only after every
+    # signal stays clear for brownout_exit_s (hysteresis; the watermark
+    # bar also drops to brownout_exit_pct while browned out)
+    brownout_enabled: bool = True
+    brownout_enter_pct: float = 0.92
+    brownout_exit_pct: float = 0.80
+    brownout_oom_events: int = 3
+    brownout_window_s: float = 30.0
+    brownout_exit_s: float = 5.0
+    brownout_cache_factor: float = 0.5
+    brownout_vmem_factor: float = 0.5
     # persistent XLA compilation cache directory, applied at Database init
     # (the warm-cache requirement in docs/PERF.md — a cold cache
     # recompiles every query shape once per process). Empty = leave the

@@ -154,7 +154,19 @@ class BatchServer:
         # window's lifetime (_Batch.plan holds a reference), so id() is
         # unambiguous here.
         wkey = (key, id(plan))
+        qcap = int(getattr(self.db.settings, "batch_queue_limit", 0))
         with self._cv:
+            if qcap > 0:
+                waiting = sum(len(x.members) for x in self._open.values()) \
+                    + sum(len(x.members) for x in self._full)
+                if waiting >= qcap:
+                    # serving-pipeline shed (docs/ROBUSTNESS.md "Overload
+                    # protection"): past the member cap this statement
+                    # runs on the classic serial path — bounded by the
+                    # admission queue — instead of growing the windows
+                    # unboundedly while the device is the bottleneck
+                    counters.inc("batch_members_shed_total")
+                    return None
             b = self._open.get(wkey)
             if b is not None and len(b.members) >= maxw:
                 # the window filled before the stager collected it: hand

@@ -34,6 +34,7 @@ from greengage_tpu.parallel.mesh import seg_sharding
 from greengage_tpu.planner.locus import LocusKind
 from greengage_tpu.runtime import interrupt
 from greengage_tpu.runtime import memaccount
+from greengage_tpu.runtime import overload as _overload
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.faultinject import faults
 from greengage_tpu.runtime.logger import (DEFAULT_BUCKETS_MB, counters,
@@ -409,6 +410,14 @@ class Executor:
                     # statement (unbounded-growth fix, ISSUE 5)
                     self._cache_program(ck, comp)
             limit = effective_limit_bytes(self.settings)
+            if self.multihost is None:
+                # memory-pressure brownout (runtime/overload.py): scale
+                # the admission ceiling down so borderline statements
+                # demote to the spill tier instead of racing a pressured
+                # allocator. Single-host only — the factor is
+                # process-local state and would desync the multihost
+                # lockstep spill decision (est_bytes + settings only)
+                limit = _overload.CONTROLLER.scaled_vmem(limit)
             # admission charge: the MEASURED per-segment executable
             # footprint when the executable is warm and the backend
             # reports real temps, else the compile-time estimate

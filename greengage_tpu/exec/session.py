@@ -42,6 +42,7 @@ from greengage_tpu.parallel import make_mesh
 from greengage_tpu.planner import plan_query
 from greengage_tpu.planner.logical import describe
 from greengage_tpu.runtime import memaccount as _memaccount
+from greengage_tpu.runtime import overload as _overload
 from greengage_tpu.runtime import trace as _trace
 from greengage_tpu.runtime.interrupt import (REGISTRY as _INTERRUPTS,
                                              StatementCancelled,
@@ -160,6 +161,10 @@ class Database:
         # pipeline threads only exist when batch_serving_enabled is on
         self._batch_server = None
         self._batch_server_mu = threading.Lock()
+        # last brownout state this Database observed (runtime/overload.py
+        # is process-wide; the edge effects — prompt cache eviction, the
+        # log line — are per-Database and applied by _overload_tick)
+        self._overload_seen = False
         from greengage_tpu.runtime.dtm import DtmSession
         from greengage_tpu.runtime.fts import FtsProber
         from greengage_tpu.runtime.replication import Replicator
@@ -354,6 +359,10 @@ class Database:
             # here; cleared per statement so a slow DML can't pick up the
             # previous SELECT's digest
             self._pc_info_local.planned = None
+            # memory-pressure brownout (runtime/overload.py): evaluate
+            # the process-wide controller once per outermost statement
+            # (rate-limited inside) and apply edge effects
+            self._overload_tick()
         try:
             return self._sql_inner(text)
         except StatementCancelled as e:
@@ -387,6 +396,39 @@ class Database:
             _memaccount.ACCOUNTS.exit(acct)
             _TRACES.exit(tr)
             _INTERRUPTS.exit(ctx)
+
+    def _overload_tick(self) -> None:
+        """Brownout edge application (docs/ROBUSTNESS.md "Overload
+        protection"): evaluate the process-wide controller and, on a
+        transition this Database has not yet seen, apply the per-database
+        effects — prompt block-cache eviction to the shrunken budget on
+        enter (limit_bytes already reads the brownout factor; eviction
+        would otherwise wait for the next insert) — and log the edge.
+        Never raises: overload protection must not fail the statement it
+        is protecting."""
+        try:
+            state = _overload.CONTROLLER.evaluate(self.settings)
+            if state == self._overload_seen:
+                return
+            self._overload_seen = state
+            snap = _overload.CONTROLLER.snapshot()
+            with _trace.span("brownout-transition", cat="overload",
+                             entered=state):
+                self.store.blockcache.evict_to_fit()
+            if state:
+                self.log.log(
+                    "WARNING", "overload",
+                    f"brownout entered: {snap.get('reason')} — "
+                    f"block-cache budget x{snap.get('cache_factor')}, "
+                    "batch serving disabled, admissions prefer the "
+                    "spill tier")
+            else:
+                self.log.info(
+                    "overload",
+                    "brownout cleared: pressure below the exit "
+                    "threshold for brownout_exit_s")
+        except Exception:
+            pass
 
     def _maybe_log_slow(self, text: str, dur_ms: float,
                         statement_id: int) -> None:
@@ -2014,6 +2056,11 @@ class Database:
         external-table loads stay serial, and a statement inside an open
         transaction must see its session's uncommitted state."""
         if not bool(getattr(self.settings, "batch_serving_enabled", False)):
+            return False
+        if _overload.CONTROLLER.brownout_active():
+            # brownout: stacked member params multiply device footprints
+            # exactly when HBM has no headroom — serve serially until
+            # pressure clears (docs/ROBUSTNESS.md "Overload protection")
             return False
         if self.multihost is not None or aux:
             return False

@@ -93,7 +93,8 @@ def pool_queue_depth() -> int:
     """Read units waiting for a staging-pool thread right now — the
     `gg metrics` staging_pool_queue_depth gauge (a persistent backlog
     here means scan_threads is undersized for the workload)."""
-    p = _pool
+    with _pool_mu:   # ps/metrics-frame rate; never on the read path
+        p = _pool
     if p is None:
         return 0
     try:

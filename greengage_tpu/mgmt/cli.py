@@ -853,6 +853,14 @@ def cmd_ps(args):
                   f" stage-pool {pipe.get('staging_pool_queue_depth', 0)}")
         print(f"cluster: {cl.get('state', '?')}  "
               f"topology v{cl.get('topology_version', '?')}{gang}{pq}")
+    # overload state (docs/ROBUSTNESS.md "Overload protection"): a
+    # browned-out engine is serving degraded on purpose — say so before
+    # anyone reads the statement list as a performance bug
+    ov = resp.get("overload") or {}
+    if ov.get("brownout"):
+        print(f"overload: BROWNOUT ({ov.get('since_s', 0):.0f}s) — "
+              f"{ov.get('reason')}; block-cache x"
+              f"{ov.get('cache_factor')}, batch serving disabled")
     print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} {'BATCH':>6} "
           f"{'SPAN':>22} SQL")
     for r in rows:

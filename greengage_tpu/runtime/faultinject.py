@@ -51,6 +51,12 @@ FAULT_POINTS = frozenset({
     # injection holds a batch on the device so tests can pin window
     # accumulation and stage(k+1)/dispatch(k) pipeline overlap
     "batch_dispatch",
+    # overload armor (runtime/server.py, runtime/overload.py): a 'skip'
+    # injection at overload_accept forces the connection-shed path as if
+    # the server were at max_connections; any firing type at
+    # brownout_force is forced memory pressure — the deterministic
+    # brownout drill (occurrences=-1 holds the state until reset)
+    "overload_accept", "brownout_force",
 })
 
 

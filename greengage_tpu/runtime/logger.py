@@ -43,6 +43,10 @@ GAUGE_NAMES = (
     # vectorized serving (exec/batchserve.py): members waiting in open
     # admission windows right now
     "batch_queue_depth",
+    # overload armor (runtime/server.py, runtime/overload.py): live
+    # client connections on the serving front end, and whether the
+    # memory-pressure brownout is engaged (1) or clear (0)
+    "server_active_connections", "brownout",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -95,6 +99,17 @@ COUNTER_NAMES = (
     # per-row host chain (@hp chain predicates, finalize-decode
     # projections) — the fused-coverage ratio docs/PERF.md tracks
     "scalar_device_total", "scalar_host_fallback_total",
+    # overload armor (docs/ROBUSTNESS.md "Overload protection"):
+    # connections accepted vs shed at the bounded front end
+    # (runtime/server.py), oversized request frames rejected, statements
+    # shed at the admission queues (runtime/resqueue.py shed_check),
+    # serving-pipeline members shed to the serial path
+    # (exec/batchserve.py), and brownout state transitions
+    # (runtime/overload.py)
+    "server_connections_total", "connections_shed_total",
+    "frames_rejected_total", "admission_shed_total",
+    "batch_members_shed_total",
+    "brownout_entered_total", "brownout_exited_total",
 )
 
 HISTOGRAM_NAMES = (

@@ -178,6 +178,17 @@ class ResourceGroupManager:
             if not g.concurrency and not self._global_cap():
                 g.admitted_total += 1
                 return _GroupSlot(self, g, counted=False)
+            if not self._eligible(g):
+                # the statement would have to WAIT (no slot, or a slot
+                # but not this group's backoff turn — the same predicate
+                # the wait loop blocks on): load-shed before joining.
+                # Depth counts waiters across ALL groups, since the
+                # global cap is what they contend for
+                from greengage_tpu.runtime.resqueue import shed_check
+
+                shed_check(self.settings,
+                           sum(x.waiting for x in self.groups.values()),
+                           "resource group")
             deadline = time.monotonic() + timeout
             g.waiting += 1
             # cancel() from another thread must WAKE this wait, not be

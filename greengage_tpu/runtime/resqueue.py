@@ -14,14 +14,22 @@ cancelled waiter leaves the queue immediately — its cancel() wakes the
 wait via a registered listener, and the abandoning waiter re-notifies so
 a racing release is never lost (the same discipline as the timeout path).
 
+Overload armor (docs/ROBUSTNESS.md "Overload protection"): with
+``admission_queue_limit`` set, a statement that would have to WAIT is
+load-shed by ``shed_check`` — a typed, retryable ``AdmissionShed``
+(SQLSTATE 53300 analog) at the depth cap, ramping in probabilistically
+from ``admission_shed_ramp`` x cap — instead of queueing unboundedly.
+
 Usage (session-level):
     SET resource_queue_active = 2        -- concurrent mesh statements
     SET resource_queue_memory_mb = 4096  -- per-query est ceiling (0 = off)
     SET resource_queue_timeout_s = 30
+    SET admission_queue_limit = 8        -- shed past this queue depth
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -31,6 +39,51 @@ from greengage_tpu.runtime.logger import counters
 
 class QueueTimeout(RuntimeError):
     pass
+
+
+class AdmissionShed(RuntimeError):
+    """Typed load-shed rejection (the SQLSTATE 53300 'insufficient
+    resources / too many connections' analog): the admission queue is at
+    (or ramping toward) its depth cap and this statement was rejected
+    instead of queued. Retryable by design — the client should back off
+    and retry; the server maps it to a retryable response frame."""
+
+    sqlstate = "53300"
+    retryable = True
+
+
+def shed_check(settings, depth: int, what: str) -> None:
+    """Queue-depth load shedding (docs/ROBUSTNESS.md "Overload
+    protection"), shared by the resource queue and resource groups.
+
+    ``depth`` is how many statements are ALREADY waiting for a slot; the
+    caller invokes this only when the new statement would have to wait.
+    At ``admission_queue_limit`` the statement sheds outright; from
+    ``admission_shed_ramp`` x the cap upward it sheds probabilistically,
+    with probability rising linearly to 1 at the cap — rejection is a
+    ramp, not a cliff, so a burst near capacity degrades gradually
+    instead of flipping between "everyone queues" and "everyone dies".
+    0 disables (legacy queue-forever behavior)."""
+    cap = int(getattr(settings, "admission_queue_limit", 0))
+    if cap <= 0:
+        return
+    if depth >= cap:
+        counters.inc("admission_shed_total")
+        raise AdmissionShed(
+            f"statement shed: {what} admission queue is full "
+            f"({depth} waiting, admission_queue_limit={cap}); "
+            "retry with backoff")
+    ramp = min(max(float(getattr(settings, "admission_shed_ramp", 0.75)),
+                   0.0), 1.0)
+    start = cap * ramp
+    if depth > start:
+        p = (depth - start) / max(cap - start, 1e-9)
+        if random.random() < p:
+            counters.inc("admission_shed_total")
+            raise AdmissionShed(
+                f"statement shed: {what} admission queue depth {depth} "
+                f"approaching admission_queue_limit={cap} "
+                f"(shed probability {p:.2f}); retry with backoff")
 
 
 class ResourceQueue:
@@ -60,6 +113,10 @@ class ResourceQueue:
             if limit <= 0:
                 self.admitted_total += 1
                 return _Slot(self, counted=False)
+            if self.active >= limit:
+                # the statement would have to WAIT: load-shed before
+                # joining the queue (admitted statements never shed)
+                shed_check(self.settings, self.waiting, "resource queue")
             timeout = float(self.settings.resource_queue_timeout_s)
             deadline = time.monotonic() + timeout
             self.waiting += 1

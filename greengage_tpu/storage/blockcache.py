@@ -32,7 +32,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
-from greengage_tpu.runtime import lockdebug, memaccount
+from greengage_tpu.runtime import lockdebug, memaccount, overload
 from greengage_tpu.runtime.logger import counters
 
 MISS = object()   # sentinel distinguishing "absent" from a cached None
@@ -183,7 +183,22 @@ class CacheRegistry:
         if mb is None:
             mb = self._limit_mb if self._limit_mb is not None \
                 else DEFAULT_LIMIT_MB
-        return max(int(mb), 1) << 20
+        base = max(int(mb), 1) << 20
+        # memory-pressure brownout (runtime/overload.py): under device
+        # pressure the shared budget shrinks by the brownout cache
+        # factor — read live, so SET and state transitions apply to the
+        # next eviction decision, exactly like the GUC itself
+        factor = overload.CONTROLLER.cache_factor()
+        if factor >= 1.0:
+            return base
+        return max(int(base * factor), 1 << 20)
+
+    def evict_to_fit(self) -> None:
+        """Public eviction-to-budget pass: applied on a brownout
+        transition edge so the shrunken budget frees bytes NOW instead
+        of waiting for the next insert."""
+        with self._lock:
+            self._evict_to_fit()
 
     @property
     def total_bytes(self) -> int:
