@@ -131,12 +131,15 @@ THREAD_ROLES: dict[str, Role] = {
     "ingest": Role(
         "ingest",
         "gpfdist loader: HTTP chunk server handler threads plus the "
-        "parallel chunk fetchers",
+        "parallel chunk fetchers, and the streaming-plane deadline "
+        "flusher (time-watermark micro-batch commits, idle reaping)",
         spawns=(("runtime/ingest.py", "serve_forever"),
                 ("runtime/ingest.py", "one"),
-                ("runtime/ingest.py", "class:Server")),
+                ("runtime/ingest.py", "class:Server"),
+                ("runtime/ingest.py", "_flush_loop")),
         entries=(("runtime/ingest.py", "", "one"),
-                 ("runtime/ingest.py", "", "do_GET")),
+                 ("runtime/ingest.py", "", "do_GET"),
+                 ("runtime/ingest.py", "StreamIngestor", "_flush_loop")),
     ),
 }
 
@@ -154,6 +157,7 @@ ROLE_NAME_PREFIXES: tuple = (
     ("gg-client-watch", "server"),
     ("gg-server", "server"),
     ("gg-gpfdist", "ingest"),
+    ("gg-ingest-flush", "ingest"),
     ("fts-prober", "fts"),
     ("mh-heartbeat", "heartbeat"),
     ("mh-rejoin-accept", "rejoin"),
@@ -195,6 +199,10 @@ SHARED_CLASSES: dict[str, str] = {
     "OverloadController": "process-wide brownout state machine, "
                           "evaluated from any statement thread",
     "FTSProber":         "probe bookkeeping",
+    "StreamIngestor":    "stream registry shared by server handler "
+                         "threads and the deadline flusher",
+    "StreamSession":     "per-stream buffer/watermarks, fed by handlers "
+                         "and flushed by the deadline thread",
     "SegmentConfig":     "topology mutated by FTS, read at dispatch",
     "PassPrefetcher":    "kicked by the spill loop, joined at close",
     "_OrderTable":       "lockdebug's own global table",

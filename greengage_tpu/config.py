@@ -144,6 +144,21 @@ class Settings:
     # backlog into the root snapshot once it reaches this many commits
     # (the checkpoint_segments analog); 0 folds on every commit
     manifest_delta_fold_threshold: int = 64
+    # hot-table write scale (storage/manifest.py write-intent path,
+    # runtime/ingest.py streaming plane): write_intents_enabled routes
+    # autocommit appends through txid-named intent records (same-table
+    # appenders commit with zero claim retries; off = the per-table CAS
+    # for every write). Stream sessions buffer rows host-side up to
+    # ingest_buffer_rows (overflow past an inline flush sheds, typed and
+    # retryable), committing micro-batches at ingest_batch_rows rows or
+    # ingest_batch_ms milliseconds — the durability watermarks. A stream
+    # idle past ingest_stream_idle_s is flushed and closed by the
+    # flusher (abandoned-client hygiene); 0 disables the deadline.
+    write_intents_enabled: bool = True
+    ingest_batch_rows: int = 4096
+    ingest_batch_ms: float = 250.0
+    ingest_buffer_rows: int = 65536
+    ingest_stream_idle_s: float = 300.0
     # plan / executable cache (plancache.c prepared-statement analog;
     # docs/PERF.md "Plan cache"): plan_cache_params hoists plan-safe
     # literals into runtime parameters so one XLA executable serves every

@@ -229,6 +229,11 @@ class Database:
         # concurrent shared appenders: serialize it separately
         self._pc_lock = threading.Lock()
         self._dtm_local = threading.local()
+        # streaming ingest plane (runtime/ingest.py): long-lived COPY
+        # streams committing micro-batches through the write-intent path
+        from greengage_tpu.runtime.ingest import StreamIngestor
+
+        self.ingest = StreamIngestor(self)
         # control-channel liveness: the channel reads its deadlines live
         # from THIS session's settings (SET mh_* applies immediately), and
         # the coordinator heartbeats workers between statements so an
@@ -1255,7 +1260,10 @@ class Database:
         if isinstance(stmt, (A.InsertStmt, A.CopyStmt)) \
                 and not (self.dtm.current is not None
                          and self.dtm.current.state == "active"):
-            with self._write_lock.shared(), self._table_lock(stmt.table):
+            with self._write_lock.shared(), \
+                    (self._table_lock(stmt.table)
+                     if self._append_needs_table_lock(stmt.table)
+                     else _NullSlot()):
                 if isinstance(stmt, A.InsertStmt):
                     out = self._insert(stmt)
                 else:
@@ -1278,6 +1286,23 @@ class Database:
             if lk is None:
                 lk = self._table_locks[base] = threading.RLock()
             return lk
+
+    def _append_needs_table_lock(self, table: str) -> bool:
+        """Whether same-table appenders must still queue on the per-table
+        serializer. With write intents on, N appenders stage disjoint
+        segment deltas and resolve at commit with zero claim retries —
+        UNLESS the table has a dict-encoded TEXT column: Dictionary.encode
+        grows shared code maps, and divergent codes assigned by truly
+        concurrent appenders are only reconciled by the legacy CAS path's
+        conflict, so those tables keep the serializer."""
+        if not getattr(self.settings, "write_intents_enabled", True):
+            return True
+        try:
+            schema = self.catalog.get(table.split("#", 1)[0])
+        except Exception:
+            return True
+        return any(c.type.kind is T.Kind.TEXT and c.encoding != "raw"
+                   for c in schema.columns)
 
     def _execute_write(self, stmt):
         if isinstance(stmt, A.CreateTableStmt):
@@ -3449,6 +3474,10 @@ class Database:
     def close(self):
         # stop the background probers/heartbeats and send the gang a clean
         # stop frame (workers distinguish this from a coordinator crash)
+        try:
+            self.ingest.stop()   # drain-or-abort open streams first
+        except Exception:
+            pass
         try:
             self.fts.stop()
         except Exception:

@@ -861,6 +861,15 @@ def cmd_ps(args):
         print(f"overload: BROWNOUT ({ov.get('since_s', 0):.0f}s) — "
               f"{ov.get('reason')}; block-cache x"
               f"{ov.get('cache_factor')}, batch serving disabled")
+    # open ingest streams (streaming COPY plane): buffered rows are
+    # volatile until the next micro-batch commit; committed_seq is the
+    # durable resume watermark
+    for s in resp.get("ingest") or []:
+        state = "error" if s.get("error") else (
+            "closed" if s.get("closed") else "open")
+        print(f"stream: {s['stream']} -> {s['table']}  {state}  "
+              f"buffered {s['buffered_rows']}  acked {s['acked_seq']}  "
+              f"committed {s['committed_seq']}")
     print(f"{'ID':>6} {'ELAPSED_S':>10} {'STATE':>12} {'BATCH':>6} "
           f"{'SPAN':>22} SQL")
     for r in rows:
@@ -1171,6 +1180,14 @@ def cmd_scrub(args):
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    # in-doubt write intents ride the scrub sweep (same grace-GC
+    # discipline as stale delta claims). _open's startup recover()
+    # already swept crash leftovers, so report the process-wide
+    # manifest_intent_swept_total rather than just this late sweep.
+    from greengage_tpu.runtime.logger import counters
+
+    db.store.manifest.sweep_intents()
+    rep["intents_swept"] = int(counters.get("manifest_intent_swept_total"))
     if args.json:
         print(json.dumps(rep, indent=1))
     else:
@@ -1183,6 +1200,9 @@ def cmd_scrub(args):
             print(f"corrupt     {rep['files_corrupt']} (--no-repair)")
         if rep["files_missing"]:
             print(f"missing     {rep['files_missing']}")
+        if rep["intents_swept"]:
+            print(f"intents     {rep['intents_swept']} in-doubt write "
+                  "intents swept")
         if args.mirrors:
             print(f"standby     {rep['standby_verified']} verified, "
                   f"{rep['standby_repaired']} repaired")

@@ -57,6 +57,15 @@ FAULT_POINTS = frozenset({
     # brownout_force is forced memory pressure — the deterministic
     # brownout drill (occurrences=-1 holds the state until reset)
     "overload_accept", "brownout_force",
+    # hot-table write path (storage/manifest.py, runtime/ingest.py):
+    # intent_stage parks a writer between staging its durable intent and
+    # resolving it (kill = in-doubt rollback); intent_resolve fires TWICE
+    # per commit — before the merge line is appended and again after it
+    # is durable but before the marker unlink — so start_after pins
+    # either crash window; ingest_flush parks a stream micro-batch after
+    # the buffer is drained and before its intent commit (the mid-stream
+    # kill window)
+    "intent_stage", "intent_resolve", "ingest_flush",
 })
 
 

@@ -11,10 +11,18 @@ Reference parity:
     whole load, up to a limit. parse_csv_rows returns (rows, rejects);
     the session layer enforces the limit and appends rejects to
     ``<cluster>/errlog/<table>.jsonl`` (the gp_read_error_log analog).
+  * Streaming ingest plane (docs/ROBUSTNESS.md "Write-intent commit &
+    streaming ingest"): StreamIngestor/StreamSession — long-lived COPY
+    FROM STDIN-style sessions that buffer rows host-side (bounded) and
+    commit micro-batches through the manifest's write-intent path on
+    size/time watermarks, with brownout admission, typed retryable
+    sheds, and idempotent resume from the last committed batch sequence
+    (the Taurus-style near-storage continuous-ingest shape).
 """
 
 from __future__ import annotations
 
+import contextlib
 import http.server
 import json
 import os
@@ -22,9 +30,18 @@ import socketserver
 import threading
 import urllib.parse
 import urllib.request
+import uuid
 import csv as _csv
 import io
 import time
+
+import numpy as np
+
+from greengage_tpu.runtime import lockdebug
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+from greengage_tpu.runtime.overload import CONTROLLER
+from greengage_tpu.runtime.resqueue import AdmissionShed
 
 
 # ---------------------------------------------------------------------------
@@ -238,3 +255,358 @@ def read_error_log(root: str, table: str) -> list[dict]:
             if line:
                 out.append(json.loads(line))
     return out
+
+
+# ---------------------------------------------------------------------------
+# streaming ingest plane (crash-safe micro-batch COPY)
+# ---------------------------------------------------------------------------
+
+class StreamSession:
+    """One long-lived ingest stream onto one table.
+
+    Durability contract (the resume protocol): a batch is ACKED when
+    buffered (volatile) and COMMITTED when its micro-batch's merge line
+    is durable — the batch sequence rides the commit record as the
+    stream's watermark ("s" entry), so after kill-9 the client re-begins
+    with the same stream id, reads resume_seq, and re-sends everything
+    above it; replayed batches at/below the committed watermark are
+    deduplicated here (ingest_resume_dedup_total). Nothing past the last
+    committed watermark survives a crash, and nothing at/below it is
+    ever applied twice.
+
+    Shared across the serving handler threads (feed/finish) and the
+    gg-ingest-flush deadline thread (tick) — every mutable attribute is
+    guarded by self._mu (gg check races)."""
+
+    def __init__(self, db, stream_id: str, table: str, committed_seq: int):
+        self._db = db                    # read-only after construction
+        self._mu = lockdebug.named(threading.Lock(),
+                                   "ingest.StreamSession._mu")
+        self.id = stream_id
+        self.table = table
+        self.committed_seq = int(committed_seq)   # durable watermark
+        self.acked_seq = int(committed_seq)       # buffered, volatile
+        self.batches: list = []       # [(seq, {col: [values]}, nrows)]
+        self.buffered_rows = 0
+        self.first_ts: float | None = None        # oldest buffered batch
+        self.last_activity = time.monotonic()
+        self.closed = False
+        self.error: str | None = None
+
+    # -- client surface --------------------------------------------------
+    def feed(self, columns: dict, seq: int) -> dict:
+        """Buffer one client batch; flush inline when a watermark trips.
+        Returns the ack frame ({seq, acked_seq, committed_seq, ...})."""
+        seq = int(seq)
+        lens = {k: len(v) for k, v in columns.items()}
+        if not lens:
+            raise ValueError("empty batch: no columns")
+        n = next(iter(lens.values()))
+        if any(l != n for l in lens.values()):
+            raise ValueError(f"ragged batch: column lengths {lens}")
+        settings = self._db.settings
+        with self._mu:
+            self.last_activity = time.monotonic()
+            if self.error is not None:
+                raise RuntimeError(
+                    f"stream {self.id} failed: {self.error} — re-begin "
+                    "and resume from the committed watermark")
+            if self.closed:
+                raise RuntimeError(f"stream {self.id} is closed")
+            if seq <= self.acked_seq:
+                # resume replay (or a client retry of an acked frame)
+                counters.inc("ingest_resume_dedup_total")
+                return {"stream": self.id, "seq": seq, "duplicate": True,
+                        "acked_seq": self.acked_seq,
+                        "committed_seq": self.committed_seq}
+            # admission: sustained overload degrades to typed retryable
+            # sheds (PR-15 armor), never to unbounded host buffering
+            CONTROLLER.evaluate(settings)
+            if CONTROLLER.brownout_active():
+                counters.inc("ingest_shed_total")
+                raise AdmissionShed(
+                    "stream batch shed: memory brownout; retry with "
+                    "backoff")
+            cap = max(1, int(getattr(settings, "ingest_buffer_rows",
+                                     65536)))
+            if self.buffered_rows + n > cap and self.batches:
+                self._flush_locked()    # make room: flush IS backpressure
+            if self.buffered_rows + n > cap:
+                counters.inc("ingest_shed_total")
+                raise AdmissionShed(
+                    f"stream batch of {n} rows exceeds "
+                    f"ingest_buffer_rows={cap}; split the batch")
+            self.batches.append(
+                (seq, {k: list(v) for k, v in columns.items()}, n))
+            self.buffered_rows += n
+            self.acked_seq = seq
+            if self.first_ts is None:
+                self.first_ts = time.monotonic()
+            if self.buffered_rows >= max(1, int(getattr(
+                    settings, "ingest_batch_rows", 4096))):
+                self._flush_locked()    # size watermark
+            return {"stream": self.id, "seq": seq,
+                    "acked_seq": self.acked_seq,
+                    "committed_seq": self.committed_seq,
+                    "buffered_rows": self.buffered_rows}
+
+    def finish(self, drain: bool = True) -> dict:
+        """Close the stream: final flush (drain=True) or drop the buffer.
+        Returns the final watermark frame."""
+        with self._mu:
+            if drain and self.error is None and not self.closed:
+                self._flush_locked()
+            self.batches = []
+            self.buffered_rows = 0
+            self.first_ts = None
+            self.closed = True
+            return {"stream": self.id, "table": self.table,
+                    "committed_seq": self.committed_seq,
+                    "error": self.error}
+
+    # -- flusher surface -------------------------------------------------
+    def tick(self, now: float, settings) -> bool:
+        """Deadline maintenance (gg-ingest-flush cadence): flush when the
+        time watermark expires; returns True when the stream idled past
+        ingest_stream_idle_s and was closed (caller deregisters it)."""
+        with self._mu:
+            if self.closed:
+                return True
+            if self.batches and self.first_ts is not None \
+                    and self.error is None:
+                batch_ms = float(getattr(settings, "ingest_batch_ms",
+                                         250.0))
+                if (now - self.first_ts) * 1000.0 >= batch_ms:
+                    try:
+                        self._flush_locked()    # time watermark
+                    except Exception:
+                        # insert failures marked self.error for the
+                        # client; a parked fault point re-tries next tick
+                        pass
+            idle_s = float(getattr(settings, "ingest_stream_idle_s",
+                                   300.0))
+            if idle_s > 0 and now - self.last_activity >= idle_s:
+                if self.error is None:
+                    with contextlib.suppress(Exception):
+                        self._flush_locked()
+                self.batches = []
+                self.buffered_rows = 0
+                self.closed = True
+                return True
+            return False
+
+    def rows_buffered(self) -> int:
+        with self._mu:
+            return self.buffered_rows
+
+    def status_row(self) -> dict:
+        with self._mu:
+            return {"stream": self.id, "table": self.table,
+                    "buffered_rows": self.buffered_rows,
+                    "acked_seq": self.acked_seq,
+                    "committed_seq": self.committed_seq,
+                    "closed": self.closed, "error": self.error}
+
+    # -- internals -------------------------------------------------------
+    def _flush_locked(self) -> None:
+        """Commit the buffered batches as ONE micro-batch through the
+        write-intent path. Caller holds self._mu (per-stream flushes are
+        serialized — the protocol's ordering unit is the stream)."""
+        if not self.batches:
+            return
+        db = self._db
+        # the mid-stream kill window: parked HERE the buffer is intact
+        # and nothing past committed_seq is durable
+        faults.check("ingest_flush")
+        batches, self.batches = self.batches, []
+        rows, self.buffered_rows = self.buffered_rows, 0
+        self.first_ts = None
+        maxseq = max(s for s, _c, _n in batches)
+        try:
+            schema = db.catalog.get(self.table)
+            cols: dict = {}
+            valids: dict = {}
+            for c in schema.columns:
+                vals: list = []
+                oks: list = []
+                for _s, payload, _n in batches:
+                    if c.name not in payload:
+                        raise ValueError(
+                            f"batch missing column {c.name!r}")
+                    for v in payload[c.name]:
+                        if v is None:
+                            vals.append(_zero_for(c.type))
+                            oks.append(False)
+                        else:
+                            vals.append(v)
+                            oks.append(True)
+                cols[c.name] = vals
+                if not all(oks):
+                    valids[c.name] = np.asarray(oks, dtype=bool)
+            with contextlib.ExitStack() as st:
+                # same lock discipline as an autocommit INSERT statement:
+                # shared session write mode, plus the per-table serializer
+                # only when the table's dictionary encoding needs it
+                st.enter_context(db._write_lock.shared())
+                if db._append_needs_table_lock(self.table):
+                    st.enter_context(db._table_lock(self.table))
+                db.store.insert(self.table, cols, valids or None,
+                                stream_marks={self.id: maxseq})
+                db._post_commit()   # archive/standby/replicator ride-along
+        except BaseException as e:
+            # the drained batches are gone from the buffer: fail the
+            # SESSION so the client re-begins and resends everything
+            # above committed_seq — exactly what resume replays
+            self.error = f"{type(e).__name__}: {e}"
+            raise
+        self.committed_seq = max(self.committed_seq, maxseq)
+        counters.inc("ingest_batches_total")
+        counters.inc("ingest_rows_total", rows)
+
+
+class StreamIngestor:
+    """Registry + deadline flusher for the streaming ingest plane. One
+    per Database; the gg-ingest-flush thread only exists while streams
+    are open. Shared across handler threads and the flusher — the
+    registry dict and lifecycle flags are guarded by self._mu."""
+
+    def __init__(self, db):
+        self._db = db               # read-only after construction
+        self._mu = lockdebug.named(threading.Lock(),
+                                   "ingest.StreamIngestor._mu")
+        self._streams: dict[str, StreamSession] = {}
+        self._flusher: threading.Thread | None = None
+        self._wake = threading.Event()      # set = flusher exits
+        self._stopped = False
+
+    # -- wire surface (runtime/server.py _control ops) -------------------
+    def stream_begin(self, table: str, stream_id: str | None = None) -> dict:
+        """Open (or resume) a stream; returns {stream, table, resume_seq}.
+        resume_seq is the durable watermark — the client re-sends batch
+        sequences ABOVE it after a crash or reconnect."""
+        db = self._db
+        CONTROLLER.evaluate(db.settings)
+        if CONTROLLER.brownout_active():
+            counters.inc("ingest_shed_total")
+            raise AdmissionShed(
+                "stream admission shed: memory brownout; retry with "
+                "backoff")
+        if table not in db.catalog:
+            raise ValueError(f"unknown table {table!r}")
+        schema = db.catalog.get(table)
+        if getattr(schema, "partitions", None):
+            raise ValueError(
+                "stream ingest targets a plain (non-partitioned) table")
+        sid = str(stream_id) if stream_id else uuid.uuid4().hex[:12]
+        snap = db.store.manifest.snapshot()
+        committed = int(snap["tables"].get(table, {})
+                        .get("streams", {}).get(sid, 0))
+        sess = StreamSession(db, sid, table, committed)
+        with self._mu:
+            if self._stopped:
+                raise RuntimeError("ingest plane is shut down")
+            # re-begin replaces a stale session object (client reconnect):
+            # its unacked buffer is exactly what the client resends
+            self._streams[sid] = sess
+            self._ensure_flusher_locked()
+            n = len(self._streams)
+        counters.set("ingest_active_streams", n)
+        return {"stream": sid, "table": table, "resume_seq": committed}
+
+    def stream_rows(self, stream_id: str, columns: dict, seq: int) -> dict:
+        sess = self._get(stream_id)
+        try:
+            return sess.feed(columns, seq)
+        finally:
+            self._refresh_gauges()
+
+    def stream_end(self, stream_id: str) -> dict:
+        sess = self._get(stream_id)
+        try:
+            out = sess.finish()
+        finally:
+            with self._mu:
+                self._streams.pop(stream_id, None)
+                n = len(self._streams)
+            counters.set("ingest_active_streams", n)
+            self._refresh_gauges()
+        return out
+
+    def stream_status(self) -> list[dict]:
+        with self._mu:
+            sessions = list(self._streams.values())
+        return [s.status_row() for s in sessions]
+
+    # -- lifecycle -------------------------------------------------------
+    def drain_all(self, drain: bool = True) -> int:
+        """Flush-or-abort every open stream (server/database shutdown):
+        no abandoned buffers. Returns the number of streams closed."""
+        with self._mu:
+            sessions, self._streams = dict(self._streams), {}
+        for sess in sessions.values():
+            with contextlib.suppress(Exception):
+                sess.finish(drain=drain)
+        counters.set("ingest_active_streams", 0)
+        counters.set("ingest_buffered_rows", 0)
+        return len(sessions)
+
+    def stop(self, drain: bool = True) -> None:
+        """Shut the plane down: drain streams, stop the flusher with a
+        bounded join (it wakes immediately off the event)."""
+        with self._mu:
+            self._stopped = True
+            flusher, self._flusher = self._flusher, None
+        self._wake.set()
+        self.drain_all(drain=drain)
+        if flusher is not None and flusher.is_alive():
+            flusher.join(timeout=10.0)
+
+    # -- internals -------------------------------------------------------
+    def _get(self, stream_id: str) -> StreamSession:
+        with self._mu:
+            sess = self._streams.get(str(stream_id))
+        if sess is None:
+            raise ValueError(
+                f"unknown stream {stream_id!r}: begin a stream first "
+                "(after a crash, re-begin with the same id and resume "
+                "from resume_seq)")
+        return sess
+
+    def _refresh_gauges(self) -> None:
+        with self._mu:
+            sessions = list(self._streams.values())
+        counters.set("ingest_buffered_rows",
+                     sum(s.rows_buffered() for s in sessions))
+
+    def _ensure_flusher_locked(self) -> None:
+        if self._flusher is not None and self._flusher.is_alive():
+            return
+        # threading.Event is internally locked; the flusher's unlocked
+        # wait() observing a clear()/set() is the designed wakeup channel
+        self._wake.clear()   # gg:ok(races)
+        t = threading.Thread(target=self._flush_loop,
+                             name="gg-ingest-flush", daemon=True)
+        self._flusher = t
+        t.start()
+
+    def _flush_loop(self) -> None:
+        """Deadline flusher: trips time watermarks and idle deadlines at
+        half the batch_ms cadence; exits when stop() sets the event."""
+        while True:
+            settings = self._db.settings
+            tick_s = max(0.02, min(1.0, float(getattr(
+                settings, "ingest_batch_ms", 250.0)) / 2000.0))
+            if self._wake.wait(tick_s):
+                return
+            now = time.monotonic()
+            with self._mu:
+                sessions = list(self._streams.items())
+            expired = [sid for sid, sess in sessions
+                       if sess.tick(now, settings)]
+            if expired:
+                with self._mu:
+                    for sid in expired:
+                        self._streams.pop(sid, None)
+                    n = len(self._streams)
+                counters.set("ingest_active_streams", n)
+            self._refresh_gauges()

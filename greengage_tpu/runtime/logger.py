@@ -47,6 +47,9 @@ GAUGE_NAMES = (
     # client connections on the serving front end, and whether the
     # memory-pressure brownout is engaged (1) or clear (0)
     "server_active_connections", "brownout",
+    # streaming ingest plane (runtime/ingest.py): live stream sessions
+    # and rows currently buffered host-side across them
+    "ingest_active_streams", "ingest_buffered_rows",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -110,6 +113,16 @@ COUNTER_NAMES = (
     "frames_rejected_total", "admission_shed_total",
     "batch_members_shed_total",
     "brownout_entered_total", "brownout_exited_total",
+    # hot-table write scale (storage/manifest.py, runtime/ingest.py):
+    # write-intent merges resolved into the commit log, state-replacing
+    # commits fenced off by a landed merge (clean conflicts), in-doubt /
+    # leftover intent markers swept by recovery and grace-GC, and the
+    # streaming ingest plane's committed micro-batches, rows, typed
+    # sheds, and replayed batches deduplicated on resume
+    "manifest_intent_commits", "manifest_intent_conflict_total",
+    "manifest_intent_swept_total",
+    "ingest_batches_total", "ingest_rows_total", "ingest_shed_total",
+    "ingest_resume_dedup_total",
 )
 
 HISTOGRAM_NAMES = (

@@ -561,7 +561,8 @@ class SqlServer:
                     return {"ok": True, "rows": rows,
                             "cluster": _cluster_status(outer.db),
                             "pipeline": _pipeline_depths(outer.db),
-                            "overload": _overload.CONTROLLER.snapshot()}
+                            "overload": _overload.CONTROLLER.snapshot(),
+                            "ingest": outer.db.ingest.stream_status()}
                 if op == "metrics":
                     # Prometheus text exposition over the process-wide
                     # counters/gauges/histograms (`gg metrics`); host
@@ -616,9 +617,13 @@ class SqlServer:
                                          "server_", "connections_",
                                          "admission_", "brownout",
                                          "frames_"))}
+                    st["counters"].update({
+                        k: v for k, v in _c.snapshot().items()
+                        if k.startswith("ingest_")})
                     return {"ok": True, "cluster": st,
                             "pipeline": _pipeline_depths(outer.db),
-                            "overload": _overload.CONTROLLER.snapshot()}
+                            "overload": _overload.CONTROLLER.snapshot(),
+                            "ingest": outer.db.ingest.stream_status()}
                 if op == "cancel":
                     try:
                         sid = int(req.get("id"))
@@ -632,6 +637,21 @@ class SqlServer:
                         return {"ok": True}
                     return {"ok": False,
                             "error": f"no in-flight statement {sid}"}
+                # streaming ingest plane (runtime/ingest.py): long-lived
+                # micro-batch COPY sessions; AdmissionShed raised here is
+                # mapped by _serve into the typed retryable 53300 frame
+                if op == "stream_begin":
+                    out = outer.db.ingest.stream_begin(
+                        req.get("table"), req.get("stream"))
+                    return {"ok": True, **out}
+                if op == "stream_rows":
+                    out = outer.db.ingest.stream_rows(
+                        req.get("stream"), req.get("columns") or {},
+                        req.get("seq", 0))
+                    return {"ok": True, **out}
+                if op == "stream_end":
+                    out = outer.db.ingest.stream_end(req.get("stream"))
+                    return {"ok": True, **out}
                 return {"ok": False, "error": f"unknown op {op!r}"}
 
         class Server(socketserver.ThreadingUnixStreamServer):
@@ -719,6 +739,13 @@ class SqlServer:
                     "WARNING", "overload",
                     f"drain deadline ({drain_s:g}s) expired with {still} "
                     "connection(s) still closing")
+        # open ingest streams flush-or-abort once their handlers are gone:
+        # nothing buffered is silently abandoned, and the plane stays up
+        # for Database.close() to stop for real
+        try:
+            self.db.ingest.drain_all()
+        except Exception:
+            pass
         # _draining stays set: a straggler handler past the deadline must
         # not serve another statement on a server that no longer accepts
         if os.path.exists(self.socket_path):

@@ -23,6 +23,27 @@ global CAS:
       prefix order is the cluster-wide total order of delta commits)
   abort_delta(handle): unlink the staged delta files (release the claims)
 
+WRITE-INTENT path (append-only commits: hot-table INSERT/COPY and the
+streaming ingest plane) — the distributedlog + visimap analog that takes
+same-table appenders off the per-table claim entirely:
+  stage_intent(table, records): durably stage a per-writer intent record
+      under intents/, named by the writer's txid — txid-unique names mean
+      N same-table appenders stage concurrently with ZERO claim retries
+      by construction
+  commit_intent(handle): append ONE fsynced MERGE line ({"w": ...}) to
+      commits.log carrying the new segfile records INLINE, then remove
+      the intent file. Compose never reads intent files: a merge line
+      extends the table's segfiles/nrows instead of replacing its state,
+      so appenders commute with each other and overlapping DELETE/UPDATE
+      is arbitrated by row visibility (the delmask covers a PREFIX of the
+      manifest row order; rows appended after the mask was computed are
+      implicitly live — the visimap discipline).
+  State-REPLACING delta commits are fenced against in-flight merges by a
+  per-table intent sequence (iseq): prepare_delta validates the writer's
+  base iseq and commit_delta re-validates it under the commit-log flock,
+  so a full-state line can never silently clobber a merge that landed
+  after its snapshot (the loser gets a clean write-write conflict).
+
 Readers snapshot the composed state (root + committed deltas in log
 order) once per query, so concurrent loads never tear a scan (snapshot
 isolation). The effective version = root version + applied delta count is
@@ -40,6 +61,13 @@ Crash matrix (docs/ROBUSTNESS.md):
   * kill-9 mid-fold: the root replace is atomic; a replayed line whose
     sequence is <= the root's folded sequence is skipped, so the fold is
     idempotent and no committed row is ever lost.
+  * kill-9 after stage_intent, before the merge line is durable: the
+    intent file is in-doubt evidence only (no reader depends on it) —
+    recover() rolls it back exactly like a stale delta claim, and the
+    appended rows' segfiles are unreferenced orphans for the sweep.
+  * kill-9 after the merge line is durable, before the intent file is
+    removed: the commit survives (the line carries the records); the
+    leftover intent marker is plain garbage recover()/GC sweeps.
 """
 
 from __future__ import annotations
@@ -57,6 +85,16 @@ from greengage_tpu.runtime.faultinject import faults
 from greengage_tpu.runtime.logger import counters
 
 
+class IntentConflict(RuntimeError):
+    """A state-replacing commit lost to a write-intent merge that landed
+    after its snapshot (or a parked intent expired before resolving).
+    Subclasses RuntimeError so every existing write-write-conflict
+    handler keeps working; callers that can safely re-stage against a
+    fresh snapshot (delmask publishes — the bitmap covers a prefix of
+    the row order, so merged appends stay implicitly live) catch THIS
+    type to retry, while full-rewrite publishes must surface it."""
+
+
 class ManifestError(RuntimeError):
     """FATAL: the cluster's commit record is unreadable. Nothing can be
     repaired from segment mirrors (the manifest IS the thing that says
@@ -70,6 +108,7 @@ class Manifest:
         os.makedirs(root, exist_ok=True)
         self.path = os.path.join(root, "manifest.json")
         self.delta_dir = os.path.join(root, "deltas")
+        self.intent_dir = os.path.join(root, "intents")
         self.log_path = os.path.join(root, "commits.log")
         # composed-snapshot memo: (root file sig, log file sig) -> the
         # composed state as a JSON string. snapshot() re-parses the string
@@ -82,8 +121,8 @@ class Manifest:
                                              "manifest._compose_lock")
         self._compose_key = None
         self._compose_json = None
-        self._compose_meta: dict = {"seqs": {}, "applied": 0, "log_end": 0,
-                                    "root_version": 0}
+        self._compose_meta: dict = {"seqs": {}, "iseqs": {}, "applied": 0,
+                                    "log_end": 0, "root_version": 0}
         # parsed delta-file contents; immutable once committed, keyed
         # (table, seq). Bounded: cleared whenever the root is replaced.
         # Own lock (never held across I/O): _read_delta runs OUTSIDE
@@ -243,6 +282,7 @@ class Manifest:
         root = self._root()
         tables = root.get("tables", {})
         seqs = dict(root.get("delta_seqs", {}))
+        iseqs = {t: int(s) for t, s in root.get("intent_seqs", {}).items()}
         log_pos = int(root.get("log_pos", 0))
         lines, log_end = self._log_lines(log_pos)
         applied = 0
@@ -260,16 +300,56 @@ class Manifest:
                 if state is None:
                     tables.pop(table, None)
                     seqs.pop(table, None)
+                    iseqs.pop(table, None)
                 else:
                     tables[table] = state
                     seqs[table] = seq
+                hit = True
+            # write-intent MERGE lines ("w"): the records are carried
+            # INLINE, so no intent file is ever read here. The iseq bump
+            # and `applied` count are UNCONDITIONAL per mentioned table —
+            # a compose from an older root replays more merge lines but
+            # starts from lower stored intent_seqs, so equal versions
+            # keep denoting equal states (cache keys stay sound).
+            wents = line.get("w") or {}
+            sents = line.get("s") or {}
+            for table, recs in wents.items():
+                iseqs[table] = iseqs.get(table, 0) + 1
+                # a first-ever append creates the table's storage state
+                # (the delta path does the same via its staged snapshot);
+                # a "w" line cannot resurrect a dropped table because
+                # commit_intent's token re-check is atomic with the log
+                # append and DROP removes tokens before its tombstone
+                state = tables.setdefault(
+                    table, {"segfiles": {}, "nrows": {}})
+                segfiles = state.setdefault("segfiles", {})
+                nrows = state.setdefault("nrows", {})
+                for seg, rels, n in recs:
+                    files = segfiles.setdefault(str(seg), [])
+                    # rel-membership dedup keeps replay on an older root
+                    # idempotent (segfile names embed a tx-unique fileno)
+                    if rels and rels[0] in files:
+                        continue
+                    files.extend(rels)
+                    nrows[str(seg)] = int(nrows.get(str(seg), 0)) + int(n)
+                marks = sents.get(table) or {}
+                if marks:
+                    # ingest resume watermarks ride the merge line; max()
+                    # keeps out-of-order replay and concurrent per-stream
+                    # flushes idempotent
+                    streams = state.setdefault("streams", {})
+                    for sid, mseq in marks.items():
+                        streams[sid] = max(int(streams.get(sid, 0)),
+                                           int(mseq))
+            if wents:
                 hit = True
             if hit:
                 applied += 1
         version = int(root.get("version", 0)) + applied
         snap = {"version": version, "tables": tables}
-        return {"_json": json.dumps(snap), "seqs": seqs, "applied": applied,
-                "log_end": log_end, "root_version": int(root.get("version", 0)),
+        return {"_json": json.dumps(snap), "seqs": seqs, "iseqs": iseqs,
+                "applied": applied, "log_end": log_end,
+                "root_version": int(root.get("version", 0)),
                 "version": version}
 
     def snapshot(self) -> dict:
@@ -293,7 +373,8 @@ class Manifest:
         meta = self._compose()
         snap = json.loads(meta["json"])
         return {"base_version": snap["version"], "tables": snap["tables"],
-                "base_seqs": dict(meta["seqs"])}
+                "base_seqs": dict(meta["seqs"]),
+                "base_iseqs": dict(meta["iseqs"])}
 
     # ---- ROOT path (structural commits; every root commit is a fold) ---
     def _staged_path(self, version: int) -> str:
@@ -317,8 +398,10 @@ class Manifest:
                 f"current v{meta['version']}")
         version = tx["base_version"] + 1
         seqs = {t: s for t, s in meta["seqs"].items() if t in tx["tables"]}
+        iseqs = {t: s for t, s in meta["iseqs"].items() if t in tx["tables"]}
         data = {"version": version, "tables": tx["tables"],
-                "delta_seqs": seqs, "log_pos": meta["log_end"]}
+                "delta_seqs": seqs, "intent_seqs": iseqs,
+                "log_pos": meta["log_end"]}
         staged = self._staged_path(version)
         fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".manifest")
         with os.fdopen(fd, "w") as f:
@@ -380,6 +463,8 @@ class Manifest:
         # the new root folded every delta at or below its recorded
         # sequences: GC their files (best-effort; recover() is the backstop)
         self._gc_deltas(int(data.get("log_pos", 0)))
+        # same ride-along for intent markers left by crashed writers
+        self.sweep_intents()
 
     def abort(self, version: int) -> None:
         staged = self._staged_path(version)
@@ -394,8 +479,11 @@ class Manifest:
         a lost claim releases everything already claimed and raises the
         write-write conflict. Returns the commit handle."""
         base_seqs = tx.get("base_seqs", {})
+        # hand-built txs (fold, restores, tests) carry no base_iseqs and
+        # opt out of the intent fence; begin()-issued txs always carry it
+        base_iseqs = tx.get("base_iseqs")
         cur = self._compose()
-        handle = {"txid": uuid.uuid4().hex[:12], "tables": {}}
+        handle = {"txid": uuid.uuid4().hex[:12], "tables": {}, "iseq": {}}
         claimed: list[tuple[str, int]] = []
         try:
             os.makedirs(self.delta_dir, exist_ok=True)
@@ -408,6 +496,18 @@ class Manifest:
                     raise RuntimeError(
                         f"write-write conflict on table {table!r}: base "
                         f"seq {want} != current seq {have}")
+                if base_iseqs is not None:
+                    # intent fence: this full-state line would CLOBBER any
+                    # merge that landed after the writer's snapshot
+                    iwant = int(base_iseqs.get(table, 0))
+                    ihave = int(cur["iseqs"].get(table, 0))
+                    if ihave != iwant:
+                        counters.inc("manifest_intent_conflict_total")
+                        raise IntentConflict(
+                            f"write-write conflict on table {table!r}: "
+                            f"{ihave - iwant} intent merge(s) landed since "
+                            "this transaction's snapshot")
+                    handle["iseq"][table] = iwant
                 seq = want + 1
                 data = {"txid": handle["txid"], "table": table, "seq": seq,
                         "state": tx["tables"].get(table)}
@@ -437,6 +537,13 @@ class Manifest:
                     raise RuntimeError(
                         f"write-write conflict: table {table!r} advanced to "
                         f"seq {now['seqs'].get(table)} during prepare")
+                if base_iseqs is not None and \
+                        int(now["iseqs"].get(table, 0)) \
+                        != int(base_iseqs.get(table, 0)):
+                    counters.inc("manifest_intent_conflict_total")
+                    raise IntentConflict(
+                        f"write-write conflict on table {table!r}: an "
+                        "intent merge landed during prepare")
         except BaseException:
             for table, seq in claimed:
                 try:
@@ -472,6 +579,22 @@ class Manifest:
                 # truncate: an append can never land between its size
                 # check and the truncate
                 fcntl.flock(fd, fcntl.LOCK_EX)
+                # final intent fence, atomic with the append: commit_intent
+                # serializes through this same flock, so an iseq that still
+                # matches HERE cannot be invalidated before our line lands.
+                # Without this, a merge committing inside the prepare ->
+                # commit window would be silently erased by this full-state
+                # line (lost update on the appended rows).
+                expect = handle.get("iseq") or {}
+                if expect:
+                    now = self._compose()
+                    for table, iwant in expect.items():
+                        if int(now["iseqs"].get(table, 0)) != int(iwant):
+                            counters.inc("manifest_intent_conflict_total")
+                            raise IntentConflict(
+                                f"write-write conflict on table {table!r}: "
+                                "an intent merge landed during this "
+                                "transaction's commit window")
                 os.write(fd, line)
                 os.fsync(fd)
             finally:
@@ -486,6 +609,131 @@ class Manifest:
                 os.remove(self._delta_path(table, int(seq)))
             except OSError:
                 pass
+
+    # ---- WRITE-INTENT path (concurrent same-table appends) -------------
+    def _intent_path(self, table: str, txid: str) -> str:
+        # txid-unique names: no exclusive-link CAS, hence no claim retry
+        return os.path.join(self.intent_dir, f"{table}.{txid}.intent")
+
+    def stage_intent(self, table: str, records: list,
+                     streams: dict | None = None) -> dict:
+        """Stage a per-writer write-intent for an APPEND-ONLY commit.
+
+        ``records`` is the _write_segfiles output — [(seg, [rels], nrows)]
+        per written segment. The durable intent file is in-doubt crash
+        evidence plus the expiry token commit_intent re-checks; it is
+        never read by compose (the merge line carries the records), so
+        sweeping it can only abort an uncommitted writer, never corrupt a
+        committed state. Returns the commit handle."""
+        os.makedirs(self.intent_dir, exist_ok=True)
+        self._ensure_root()
+        txid = uuid.uuid4().hex[:12]
+        recs = [(int(seg), list(rels), int(n)) for seg, rels, n in records]
+        marks = {str(k): int(v) for k, v in (streams or {}).items()}
+        data = {"txid": txid, "table": table, "records": recs,
+                "streams": marks}
+        path = self._intent_path(table, txid)
+        fd, tmp = tempfile.mkstemp(dir=self.intent_dir, prefix=".intent")
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # crash window: intent staged, merge line not durable — recover()
+        # rolls this writer back exactly like a stale delta claim
+        faults.check("intent_stage")
+        return {"txid": txid, "table": table, "records": recs,
+                "streams": marks, "path": path}
+
+    def commit_intent(self, handle: dict) -> int:
+        """Resolve a staged intent: append ONE fsynced merge line, then
+        remove the intent file. Returns the new effective version.
+
+        The intent file is re-checked first, mirroring commit_delta's
+        claim re-validation: a writer parked past the GC grace (or raced
+        by recover()/DROP) finds its token gone and gets a clean
+        write-write conflict instead of publishing rows whose segfiles
+        the orphan sweep may already have reclaimed."""
+        path = handle["path"]
+        if not os.path.exists(path):
+            counters.inc("manifest_intent_conflict_total")
+            raise IntentConflict(
+                f"write-write conflict: staged intent {handle['table']}."
+                f"{handle['txid']} expired before commit (removed by GC, "
+                "recovery, or DROP TABLE)")
+        rec: dict = {"x": handle["txid"],
+                     "w": {handle["table"]: handle["records"]}}
+        if handle.get("streams"):
+            rec["s"] = {handle["table"]: handle["streams"]}
+        line = (json.dumps(rec) + "\n").encode()
+        # crash window A: resolve reached, line not appended — rollback
+        faults.check("intent_resolve")
+        with self._log_lock:
+            fd = os.open(self.log_path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                # token re-check ATOMIC with the append: a sweep or DROP
+                # that removes the token strictly before this point keeps
+                # the merge line out of the log entirely, so a "w" line
+                # can never land after its table's drop tombstone
+                if not os.path.exists(path):
+                    counters.inc("manifest_intent_conflict_total")
+                    raise IntentConflict(
+                        f"write-write conflict: staged intent "
+                        f"{handle['table']}.{handle['txid']} expired "
+                        "before commit (removed by GC, recovery, or "
+                        "DROP TABLE)")
+                os.write(fd, line)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        # crash window B: line durable, marker not yet removed — the
+        # commit SURVIVES; the leftover marker is garbage for the sweep
+        faults.check("intent_resolve")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        counters.inc("manifest_intent_commits")
+        return self.version()
+
+    def abort_intent(self, handle: dict) -> None:
+        """Withdraw a staged intent (rollback before the merge line)."""
+        try:
+            os.remove(handle["path"])
+        except OSError:
+            pass
+
+    def sweep_intents(self, grace_s: float | None = None) -> int:
+        """Remove write-intent files older than the grace window — the
+        delta-claim grace-GC discipline applied to intents. Safe at any
+        time: compose never reads intent files, so a swept file either
+        aborts a crashed/parked writer (which gets the clean conflict at
+        commit_intent, like an expired delta claim) or clears a committed
+        writer's leftover marker. Returns the number removed."""
+        if grace_s is None:
+            grace_s = self.GC_GRACE_S
+        try:
+            names = os.listdir(self.intent_dir)
+        except OSError:
+            return 0
+        removed = 0
+        now = time.time()
+        for fn in names:
+            if not fn.endswith(".intent"):
+                continue
+            path = os.path.join(self.intent_dir, fn)
+            try:
+                if now - os.stat(path).st_mtime < grace_s:
+                    continue
+                os.remove(path)
+                removed += 1
+            except OSError:
+                continue
+        if removed:
+            counters.inc("manifest_intent_swept_total", removed)
+        return removed
 
     # ---- checkpoint fold -----------------------------------------------
     def fold(self, min_deltas: int = 1) -> bool:
@@ -598,6 +846,24 @@ class Manifest:
                     os.remove(os.path.join(self.delta_dir, fn))
                 except OSError:
                     pass
+        # the dropped table's staged intents go with it (no grace, same
+        # contract): an in-flight appender finds its token gone and gets
+        # the clean conflict at commit_intent
+        swept = 0
+        try:
+            inames = os.listdir(self.intent_dir)
+        except OSError:
+            inames = []
+        for fn in inames:
+            if fn.endswith(".intent") \
+                    and fn[:-len(".intent")].rsplit(".", 1)[0] == table:
+                try:
+                    os.remove(os.path.join(self.intent_dir, fn))
+                    swept += 1
+                except OSError:
+                    pass
+        if swept:
+            counters.inc("manifest_intent_swept_total", swept)
         with self._compose_lock:
             self._compose_key = None
         with self._delta_lock:
@@ -661,6 +927,15 @@ class Manifest:
                 rolled.append(-seq)
             elif seq <= folded.get(stem, 0):
                 os.remove(os.path.join(self.delta_dir, fn))   # fold leftover
+        # in-doubt write intents: at exclusive-open startup EVERY intent
+        # file is removable — an uncommitted one rolls its writer back
+        # (exactly like the staged delta claims above; its orphaned
+        # segfiles fall to the store's sweep), a committed one is only
+        # the leftover marker of a kill between the durable merge line
+        # and the unlink. Counted (manifest_intent_swept_total), not
+        # appended to `rolled` — callers assert recover() idempotence as
+        # `recover() == []` and a marker sweep is not a rolled-back root.
+        self.sweep_intents(grace_s=0.0)
         with self._compose_lock:
             self._compose_key = None    # delta files moved under us
         with self._delta_lock:

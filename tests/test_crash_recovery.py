@@ -173,12 +173,13 @@ def test_kill9_after_commit_preserves_commit(tmp_path):
     path = str(tmp_path / "c")
     _setup(path)
     # the commit evidence is the durable commit-LOG line (the delta path's
-    # commit record): the child's startup compaction folds the _setup
-    # loads and truncates the log, so the 2PC's line (t.2) appearing is
-    # baseline-free ground truth — a lazy baseline would race a fast
-    # child that commits before the parent's first poll
+    # commit record): the _setup loads commit via intent MERGE lines (no
+    # delta claim), so the 2PC's line (t.1 — the first delta claim the
+    # cluster ever makes for t) appearing is baseline-free ground truth —
+    # a lazy baseline would race a fast child that commits before the
+    # parent's first poll
     _run_child_until(path, "dtx_after_commit",
-                     lambda: ("t", 2) in _committed_delta_keys(path))
+                     lambda: ("t", 1) in _committed_delta_keys(path))
     # the commit-log line was durable before the kill: recovery must KEEP
     # the commit (and fold it into the root)
     d = greengage_tpu.connect(path=path, numsegments=4)
@@ -246,12 +247,14 @@ def test_kill9_mid_fold_loses_no_committed_rows(tmp_path, window):
             return bool(_staged_above_head(path))
     else:
         # parked after the replace: the new root folded the INSERT's
-        # delta, so its recorded sequence for t reached 2 (t.1 = the
-        # _setup load, folded at the child's startup compaction; t.2 =
-        # the insert). Baseline-free on purpose — a lazy baseline races
-        # a fast child, which can fold before the parent's first poll.
+        # merge line, so its recorded INTENT sequence for t reached 2
+        # (iseq 1 = the _setup load's merge, folded at the child's
+        # startup compaction; iseq 2 = the insert — autocommit appends
+        # commit via write intents, not delta claims). Baseline-free on
+        # purpose — a lazy baseline races a fast child, which can fold
+        # before the parent's first poll.
         def parked():
-            seqs = Manifest(path)._root().get("delta_seqs", {})
+            seqs = Manifest(path)._root().get("intent_seqs", {})
             return int(seqs.get("t", 0)) >= 2
 
     _run_child_until(path, "delta_fold", parked, child=FOLD_CHILD,
@@ -266,4 +269,154 @@ def test_kill9_mid_fold_loses_no_committed_rows(tmp_path, window):
     # recovery compacted: the store keeps serving writes
     d.sql("insert into t values (100001, 8)")
     assert d.sql("select count(*) from t").rows()[0][0] == 102
+    assert d.store.manifest.recover() == []
+
+
+# ---------------------------------------------------------------------------
+# kill -9 on the WRITE-INTENT path (docs/ROBUSTNESS.md "Write-intent
+# commit & streaming ingest"): the intent_resolve fault point fires TWICE
+# per commit, so start_after pins either crash window — before the merge
+# line (in-doubt intent, rolled back like a stale delta claim) and after
+# it is durable but before the marker unlink (the commit SURVIVES)
+# ---------------------------------------------------------------------------
+
+INTENT_CHILD = r"""
+import os, sys
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[2])
+from greengage_tpu.runtime.faultinject import faults
+import greengage_tpu
+db = greengage_tpu.connect(sys.argv[1], numsegments=4)
+open(sys.argv[1] + ".ready", "w").close()         # startup recovery done
+# window 0 = parked after the intent is staged, merge line NOT appended;
+# window 1 = parked after the merge line is durable, marker NOT unlinked
+faults.inject(sys.argv[3], "sleep", sleep_s=120,
+              start_after=int(os.environ.get("GGTPU_INTENT_WINDOW", "0")))
+db.sql("insert into t values (100000, 7)")
+print("RESOLVED", flush=True)
+"""
+
+
+def _intent_files(path):
+    idir = os.path.join(path, "intents")
+    if not os.path.isdir(idir):
+        return []
+    return [fn for fn in os.listdir(idir) if fn.endswith(".intent")]
+
+
+def _merge_lines_for(path, table):
+    """Committed "w" merge lines for ``table`` past the root's log_pos."""
+    m = Manifest(path)
+    root = m._root()
+    lines, _end = m._log_lines(int(root.get("log_pos", 0)))
+    return [line["w"][table] for line in lines
+            if table in (line.get("w") or {})]
+
+
+def _merged_rows_for(path, table):
+    return sum(int(n) for recs in _merge_lines_for(path, table)
+               for _seg, _rels, n in recs)
+
+
+@pytest.mark.parametrize("window", [0, 1])
+def test_kill9_mid_intent_resolve_both_windows(tmp_path, window):
+    path = str(tmp_path / f"c{window}")
+    _setup(path)
+
+    if window == 0:
+        # parked between stage and resolve: the durable intent exists,
+        # no merge line does — the in-doubt state recovery must roll back
+        def parked():
+            return bool(_intent_files(path))
+    else:
+        # parked after the fsynced merge line (the commit point), before
+        # the marker unlink: the 1-row merge for t is ground truth (the
+        # child's startup compaction folded the _setup load's 100 rows)
+        def parked():
+            return _merged_rows_for(path, "t") >= 1
+
+    _run_child_until(path, "intent_resolve", parked, child=INTENT_CHILD,
+                     extra_env={"GGTPU_INTENT_WINDOW": str(window)})
+    assert _intent_files(path)           # both windows leave the marker
+    if window == 0:
+        assert _merged_rows_for(path, "t") == 0
+    from greengage_tpu.runtime.logger import counters
+    base = counters.snapshot()
+    d = greengage_tpu.connect(path=path, numsegments=4)   # runs recover()
+    # recovery swept the marker with the no-grace discipline either way:
+    # window 0 rolls the writer back, window 1 clears committed garbage
+    assert not _intent_files(path)
+    assert counters.since(base).get("manifest_intent_swept_total", 0) >= 1
+    expect = 100 if window == 0 else 101
+    assert d.sql("select count(*) from t").rows()[0][0] == expect
+    if window == 1:
+        assert d.sql("select v from t where k = 100000").rows() == [(7,)]
+    # the dead writer's segfiles: orphans (window 0) are reclaimed, live
+    # files (window 1) are untouchable — either way counts are stable
+    d.store.sweep_orphans(grace_s=0)
+    assert d.sql("select count(*) from t").rows()[0][0] == expect
+    # the manifest stays foldable past the crash
+    d.sql("set manifest_delta_fold_threshold = 1")
+    d.sql("insert into t values (100001, 8)")
+    assert d.sql("select count(*) from t").rows()[0][0] == expect + 1
+    assert d.store.manifest.recover() == []
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-STREAM (the ingest_flush fault point parks a micro-batch
+# after the client ack, before its intent commit): nothing past the last
+# committed watermark survives, resume replays exactly the tail
+# ---------------------------------------------------------------------------
+
+STREAM_CHILD = r"""
+import os, sys
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[2])
+from greengage_tpu.runtime.faultinject import faults
+import greengage_tpu
+db = greengage_tpu.connect(sys.argv[1], numsegments=4)
+open(sys.argv[1] + ".ready", "w").close()
+db.sql("set ingest_batch_rows = 1")      # every batch commits inline
+db.ingest.stream_begin("t", "s1")
+db.ingest.stream_rows("s1", {"k": [200000], "v": [1]}, 1)   # committed
+faults.inject(sys.argv[3], "sleep", sleep_s=120)
+open(sys.argv[1] + ".batch2", "w").close()
+# batch 2 is ACKED into the buffer, then parks before its intent commit
+db.ingest.stream_rows("s1", {"k": [200001], "v": [2]}, 2)
+print("NEVER", flush=True)
+"""
+
+
+def _stream_mark(path, table, sid):
+    return int(Manifest(path).snapshot()["tables"]
+               .get(table, {}).get("streams", {}).get(sid, 0))
+
+
+def test_kill9_mid_stream_resumes_from_watermark(tmp_path):
+    path = str(tmp_path / "c")
+    _setup(path)
+    _run_child_until(
+        path, "ingest_flush",
+        lambda: os.path.exists(path + ".batch2")
+        and _stream_mark(path, "t", "s1") >= 1,
+        child=STREAM_CHILD)
+    # batch 1's watermark rode its merge line; batch 2 died in the buffer
+    d = greengage_tpu.connect(path=path, numsegments=4)
+    assert d.sql("select count(*) from t").rows()[0][0] == 101
+    assert d.sql("select v from t where k = 200000").rows() == [(1,)]
+    assert d.sql("select count(*) from t where k = 200001").rows() \
+        == [(0,)]
+    # the client re-begins with the SAME stream id: the durable watermark
+    # names exactly what to re-send — and a replay of batch 1 dedups
+    out = d.ingest.stream_begin("t", "s1")
+    assert out["resume_seq"] == 1
+    dup = d.ingest.stream_rows("s1", {"k": [200000], "v": [1]}, 1)
+    assert dup["duplicate"] is True
+    d.ingest.stream_rows("s1", {"k": [200001], "v": [2]}, 2)
+    d.ingest.stream_end("s1")
+    assert d.sql("select count(*) from t").rows()[0][0] == 102
+    assert d.sql("select count(*) from t where k = 200001").rows() \
+        == [(1,)]
     assert d.store.manifest.recover() == []

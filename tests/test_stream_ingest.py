@@ -1,0 +1,269 @@
+"""Streaming ingest plane (runtime/ingest.py StreamIngestor, docs/
+ROBUSTNESS.md "Write-intent commit & streaming ingest"): long-lived COPY
+streams with bounded host buffers, micro-batch commits on size/time
+watermarks through the write-intent path, idempotent client resume from
+the acked batch sequence, and admission through the overload armor. The
+kill-9 half of the contract lives in test_crash_recovery.py."""
+
+import threading
+import time
+
+import pytest
+
+import greengage_tpu
+from greengage_tpu.runtime import overload
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+from greengage_tpu.runtime.resqueue import AdmissionShed
+
+
+@pytest.fixture()
+def db(devices8, tmp_path):
+    d = greengage_tpu.connect(str(tmp_path / "c"), numsegments=4)
+    d.sql("create table hot (k int, v double) distributed by (k)")
+    yield d
+    d.close()
+
+
+def _count(db):
+    return int(db.sql("select count(*) from hot").rows()[0][0])
+
+
+def test_size_watermark_commits_microbatch(db):
+    db.sql("set ingest_batch_rows = 4")
+    out = db.ingest.stream_begin("hot", "s1")
+    assert out == {"stream": "s1", "table": "hot", "resume_seq": 0}
+    a1 = db.ingest.stream_rows("s1", {"k": [1, 2], "v": [0.1, 0.2]}, 1)
+    assert a1["acked_seq"] == 1 and a1["committed_seq"] == 0
+    assert _count(db) == 0               # buffered, below the watermark
+    a2 = db.ingest.stream_rows("s1", {"k": [3, 4], "v": [0.3, 0.4]}, 2)
+    assert a2["committed_seq"] == 2      # watermark tripped: ONE commit
+    assert a2["buffered_rows"] == 0
+    assert _count(db) == 4
+    db.ingest.stream_end("s1")
+    assert _count(db) == 4
+
+
+def test_time_watermark_commits_via_flusher(db):
+    """Below the size watermark, the gg-ingest-flush deadline thread
+    commits the buffer once ingest_batch_ms elapses."""
+    db.sql("set ingest_batch_ms = 50")
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        row = db.ingest.stream_status()[0]
+        if row["committed_seq"] == 1:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(
+            f"time watermark never flushed: {db.ingest.stream_status()}")
+    assert _count(db) == 1
+    db.ingest.stream_end("s1")
+
+
+def test_final_flush_on_stream_end(db):
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1, 2], "v": [1.0, None]}, 1)
+    fin = db.ingest.stream_end("s1")
+    assert fin["committed_seq"] == 1 and fin["error"] is None
+    assert _count(db) == 2
+    # the null rode the batch as an invalid row, not a fabricated value
+    assert db.sql("select count(*) from hot where v is null") \
+        .rows()[0][0] == 1
+
+
+def test_resume_replays_dedup_below_watermark(db):
+    """The idempotent-resume protocol: after reopen, resume_seq is the
+    durable watermark; replayed batches at/below it are dropped, batches
+    above it land exactly once."""
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1, 2], "v": [1.0, 2.0]}, 1)
+    db.ingest.stream_end("s1")
+    base = counters.snapshot()
+    out = db.ingest.stream_begin("hot", "s1")     # the client re-begins
+    assert out["resume_seq"] == 1
+    dup = db.ingest.stream_rows("s1", {"k": [1, 2], "v": [1.0, 2.0]}, 1)
+    assert dup["duplicate"] is True
+    assert counters.since(base).get("ingest_resume_dedup_total") == 1
+    db.ingest.stream_rows("s1", {"k": [3], "v": [3.0]}, 2)
+    db.ingest.stream_end("s1")
+    assert _count(db) == 3               # nothing twice, nothing lost
+
+
+def test_flush_failure_fails_session_for_rebegin(db):
+    """A failed micro-batch marks the SESSION failed (its drained batches
+    are exactly what resume re-sends); the stream id stays resumable."""
+    db.sql("set ingest_batch_rows = 1")
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+    with pytest.raises(ValueError, match="missing column"):
+        db.ingest.stream_rows("s1", {"k": [2]}, 2)       # no "v"
+    with pytest.raises(RuntimeError, match="re-begin"):
+        db.ingest.stream_rows("s1", {"k": [3], "v": [3.0]}, 3)
+    out = db.ingest.stream_begin("hot", "s1")
+    assert out["resume_seq"] == 1        # batch 1 committed, batch 2 not
+    db.ingest.stream_rows("s1", {"k": [2], "v": [2.0]}, 2)
+    db.ingest.stream_end("s1")
+    assert _count(db) == 2
+
+
+def test_brownout_sheds_stream_admission_typed(db):
+    ctl = overload.CONTROLLER
+    faults.inject("brownout_force", "skip", occurrences=-1)
+    try:
+        assert ctl.evaluate(db.settings, force=True) is True
+        base = counters.snapshot()
+        with pytest.raises(AdmissionShed):
+            db.ingest.stream_begin("hot", "s1")
+        assert counters.since(base).get("ingest_shed_total") == 1
+    finally:
+        faults.reset("brownout_force")
+        db.sql("set brownout_exit_s = 0")
+        ctl.evaluate(db.settings, force=True)
+    # pressure gone: admission recovers
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_end("s1")
+
+
+def test_buffer_cap_sheds_oversized_batch(db):
+    """ingest_buffer_rows bounds host memory: a batch that cannot fit
+    even after an inline flush sheds typed-retryable, never buffers."""
+    db.sql("set ingest_buffer_rows = 4")
+    db.sql("set ingest_batch_rows = 100")        # size watermark idle
+    db.ingest.stream_begin("hot", "s1")
+    base = counters.snapshot()
+    with pytest.raises(AdmissionShed, match="ingest_buffer_rows"):
+        db.ingest.stream_rows(
+            "s1", {"k": list(range(6)), "v": [0.0] * 6}, 1)
+    assert counters.since(base).get("ingest_shed_total") == 1
+    # a fitting batch buffers; the next one flushes inline to make room
+    a1 = db.ingest.stream_rows(
+        "s1", {"k": [1, 2, 3], "v": [0.0] * 3}, 2)
+    assert a1["buffered_rows"] == 3 and a1["committed_seq"] == 0
+    a2 = db.ingest.stream_rows(
+        "s1", {"k": [4, 5, 6], "v": [0.0] * 3}, 3)
+    assert a2["committed_seq"] == 2      # room was made by committing
+    db.ingest.stream_end("s1")
+    assert _count(db) == 6
+
+
+def test_stop_drains_open_streams_bounded(db):
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+    db.ingest.stream_begin("hot", "s2")
+    db.ingest.stream_rows("s2", {"k": [2], "v": [2.0]}, 1)
+    assert counters.get("ingest_active_streams") == 2
+    t0 = time.monotonic()
+    db.ingest.stop()
+    assert time.monotonic() - t0 < 15.0          # bounded join
+    assert _count(db) == 2               # flush-or-abort chose flush
+    assert counters.get("ingest_active_streams") == 0
+    assert counters.get("ingest_buffered_rows") == 0
+    with pytest.raises(RuntimeError, match="shut down"):
+        db.ingest.stream_begin("hot", "s3")
+
+
+def test_idle_stream_is_reaped_with_final_flush(db):
+    db.sql("set ingest_stream_idle_s = 0.2")
+    db.sql("set ingest_batch_ms = 60000")        # only idle can flush
+    db.ingest.stream_begin("hot", "s1")
+    db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if counters.get("ingest_active_streams") == 0:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("idle stream never reaped")
+    assert _count(db) == 1               # reap flushed, not dropped
+    with pytest.raises(ValueError, match="unknown stream"):
+        db.ingest.stream_rows("s1", {"k": [2], "v": [2.0]}, 2)
+
+
+def test_server_wire_ops_and_ps(db, tmp_path):
+    from greengage_tpu.runtime.server import SqlClient, SqlServer
+
+    sock = str(tmp_path / "gg.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    try:
+        c = SqlClient(sock)
+        out = c.op({"op": "stream_begin", "table": "hot", "stream": "w1"})
+        assert out["ok"] and out["resume_seq"] == 0
+        ack = c.op({"op": "stream_rows", "stream": "w1",
+                    "columns": {"k": [1, 2], "v": [1.0, 2.0]}, "seq": 1})
+        assert ack["ok"] and ack["acked_seq"] == 1
+        ps = c.op({"op": "ps"})
+        assert [s["stream"] for s in ps["ingest"]] == ["w1"]
+        st = c.op({"op": "status"})
+        assert [s["stream"] for s in st["ingest"]] == ["w1"]
+        assert "ingest_rows_total" in st["cluster"]["counters"] or \
+            st["cluster"]["counters"].get("ingest_batches_total", 0) >= 0
+        fin = c.op({"op": "stream_end", "stream": "w1"})
+        assert fin["ok"] and fin["committed_seq"] == 1
+        c.close()
+        assert _count(db) == 2
+    finally:
+        srv.stop()
+    # server stop left no abandoned buffers
+    assert counters.get("ingest_buffered_rows") == 0
+
+
+def test_streams_ride_storm_without_retries(db):
+    """Streams and SQL appenders hit ONE table together: still zero claim
+    retries, and the total is exact (the acceptance's mixed workload)."""
+    db.sql("set ingest_batch_rows = 8")
+    base = counters.snapshot()
+    errs = []
+
+    def sql_appender(w):
+        try:
+            for i in range(6):
+                db.sql(f"insert into hot values ({w * 100 + i}, {w}.0)")
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    def streamer(sid):
+        try:
+            db.ingest.stream_begin("hot", sid)
+            for seq in range(1, 7):
+                db.ingest.stream_rows(
+                    sid, {"k": [hash(sid) % 1000 + seq + 10000],
+                          "v": [float(seq)]}, seq)
+            db.ingest.stream_end(sid)
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=sql_appender, args=(w,))
+          for w in range(4)]
+    ts += [threading.Thread(target=streamer, args=(f"st{j}",))
+           for j in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    d = counters.since(base)
+    assert d.get("manifest_cas_retry_total", 0) == 0
+    assert _count(db) == 4 * 6 + 4 * 6
+
+
+@pytest.mark.slow
+def test_sustained_stream_storm_steady_state(db):
+    """Sustained mixed pressure holds steady state: the buffer gauge
+    returns to zero between waves and every row is accounted for."""
+    db.sql("set ingest_batch_rows = 32")
+    total = 0
+    for wave in range(5):
+        sid = f"wave{wave}"
+        db.ingest.stream_begin("hot", sid)
+        for seq in range(1, 21):
+            db.ingest.stream_rows(
+                sid, {"k": [wave * 10000 + seq * 10 + j
+                            for j in range(8)],
+                      "v": [0.0] * 8}, seq)
+        db.ingest.stream_end(sid)
+        total += 20 * 8
+        assert counters.get("ingest_buffered_rows") == 0
+        assert _count(db) == total
+    assert counters.get("ingest_active_streams") == 0
