@@ -380,8 +380,12 @@ class StreamSession:
                     try:
                         self._flush_locked()    # time watermark
                     except Exception:
-                        # insert failures marked self.error for the
-                        # client; a parked fault point re-tries next tick
+                        # a fault parked at ingest_flush raises BEFORE
+                        # the buffer drains, so that flush re-tries next
+                        # tick; an insert failure has already drained the
+                        # buffer and set self.error — the stream is
+                        # terminally failed and the client must re-begin
+                        # and resume above the committed watermark
                         pass
             idle_s = float(getattr(settings, "ingest_stream_idle_s",
                                    300.0))
@@ -498,6 +502,19 @@ class StreamIngestor:
             raise ValueError(
                 "stream ingest targets a plain (non-partitioned) table")
         sid = str(stream_id) if stream_id else uuid.uuid4().hex[:12]
+        with self._mu:
+            if self._stopped:
+                raise RuntimeError("ingest plane is shut down")
+            old = self._streams.pop(sid, None)
+        if old is not None:
+            # live reconnect: quiesce the old session BEFORE reading the
+            # resume watermark. finish(drain=False) serializes behind an
+            # in-flight deadline flush via the session lock, so a commit
+            # racing this re-begin lands before the snapshot below and
+            # the client never gets a resume_seq under what is durable.
+            # The dropped unacked buffer is exactly what it resends.
+            with contextlib.suppress(Exception):
+                old.finish(drain=False)
         snap = db.store.manifest.snapshot()
         committed = int(snap["tables"].get(table, {})
                         .get("streams", {}).get(sid, 0))
@@ -505,8 +522,6 @@ class StreamIngestor:
         with self._mu:
             if self._stopped:
                 raise RuntimeError("ingest plane is shut down")
-            # re-begin replaces a stale session object (client reconnect):
-            # its unacked buffer is exactly what the client resends
             self._streams[sid] = sess
             self._ensure_flusher_locked()
             n = len(self._streams)
@@ -601,12 +616,15 @@ class StreamIngestor:
             now = time.monotonic()
             with self._mu:
                 sessions = list(self._streams.items())
-            expired = [sid for sid, sess in sessions
+            expired = [(sid, sess) for sid, sess in sessions
                        if sess.tick(now, settings)]
             if expired:
                 with self._mu:
-                    for sid in expired:
-                        self._streams.pop(sid, None)
+                    for sid, sess in expired:
+                        # identity-guarded: a re-begin may have swapped in
+                        # a fresh session for this id since the snapshot
+                        if self._streams.get(sid) is sess:
+                            self._streams.pop(sid)
                     n = len(self._streams)
                 counters.set("ingest_active_streams", n)
             self._refresh_gauges()

@@ -645,9 +645,16 @@ class SqlServer:
                         req.get("table"), req.get("stream"))
                     return {"ok": True, **out}
                 if op == "stream_rows":
+                    seq = req.get("seq")
+                    # a missing seq must NOT default to 0: feed() treats
+                    # seq <= acked_seq as a resume replay and acks it as
+                    # a duplicate — silently dropping the frame's rows
+                    if isinstance(seq, bool) or not isinstance(seq, int):
+                        return {"ok": False,
+                                "error": "stream_rows requires an integer"
+                                         " 'seq' (batch sequence number)"}
                     out = outer.db.ingest.stream_rows(
-                        req.get("stream"), req.get("columns") or {},
-                        req.get("seq", 0))
+                        req.get("stream"), req.get("columns") or {}, seq)
                     return {"ok": True, **out}
                 if op == "stream_end":
                     out = outer.db.ingest.stream_end(req.get("stream"))

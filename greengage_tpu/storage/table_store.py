@@ -802,6 +802,23 @@ class TableStore:
                     raise
                 self.maybe_fold_manifest()
                 return nrows
+            def _fold_stream_marks(tx_):
+                # Dictionary growth forces a streamed micro-batch onto
+                # the CAS path; the full-state line it stages must still
+                # carry the stream's resume watermark — otherwise the
+                # rows commit but the durable watermark never advances,
+                # and after kill-9 the client resumes from a stale seq
+                # and replays already-durable batches (double-apply).
+                if not stream_marks:
+                    return
+                state = tx_["tables"].setdefault(
+                    table, {"segfiles": {}, "nrows": {}})
+                marks = state.setdefault("streams", {})
+                for sid, sq in stream_marks.items():
+                    marks[str(sid)] = max(int(marks.get(str(sid), 0)),
+                                          int(sq))
+
+            _fold_stream_marks(tx)
             last = None
             for attempt in range(20):
                 try:
@@ -816,6 +833,7 @@ class TableStore:
                     _time.sleep(0.01 * (attempt + 1))
                     tx = self.manifest.begin()
                     merge_segfile_records(tx, table, records)
+                    _fold_stream_marks(tx)
             else:
                 self._invalidate_dicts(table)
                 raise RuntimeError(

@@ -109,6 +109,66 @@ def test_flush_failure_fails_session_for_rebegin(db):
     assert _count(db) == 2
 
 
+def test_dict_growth_flush_carries_durable_watermark(db):
+    """A streamed micro-batch whose TEXT values grow the dictionary is
+    forced onto the per-table CAS path (cross-process code safety) — the
+    full-state line it stages must still carry the stream's resume
+    watermark, or committed_seq advances in memory while resume_seq
+    stays stale and a crash replays already-durable batches."""
+    db.sql("create table tagged (k int, tag text) distributed by (k)")
+    db.sql("set ingest_batch_rows = 2")
+    db.ingest.stream_begin("tagged", "s1")
+    db.ingest.stream_rows("s1", {"k": [1, 2], "tag": ["a", "b"]}, 1)
+    snap = db.store.manifest.snapshot()
+    assert int(snap["tables"]["tagged"]
+               .get("streams", {}).get("s1", 0)) == 1
+    out = db.ingest.stream_begin("tagged", "s1")     # crash-style re-begin
+    assert out["resume_seq"] == 1
+    dup = db.ingest.stream_rows("s1", {"k": [1, 2], "tag": ["a", "b"]}, 1)
+    assert dup["duplicate"] is True
+    db.ingest.stream_rows("s1", {"k": [3], "tag": ["c"]}, 2)
+    db.ingest.stream_end("s1")                       # final flush grows too
+    snap = db.store.manifest.snapshot()
+    assert int(snap["tables"]["tagged"]["streams"]["s1"]) == 2
+    assert int(db.sql("select count(*) from tagged").rows()[0][0]) == 3
+
+
+def test_live_rebegin_serializes_behind_inflight_flush(db):
+    """Reconnect with the same stream id while the deadline flusher is
+    mid-commit: stream_begin must quiesce the old session FIRST (it
+    serializes behind the in-flight flush on the session lock), so the
+    resume watermark it reads can never be below what is durable."""
+    db.sql("set ingest_batch_ms = 40")
+    db.ingest.stream_begin("hot", "s1")
+    faults.inject("ingest_flush", "suspend", occurrences=1)
+    try:
+        db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(f["name"] == "ingest_flush" and f["hits"] > 0
+                   for f in faults.status()):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("flusher never reached ingest_flush")
+        out: dict = {}
+        t = threading.Thread(
+            target=lambda: out.update(db.ingest.stream_begin("hot", "s1")))
+        t.start()
+        t.join(timeout=0.3)
+        # blocked behind the suspended flush — NOT reading a stale snapshot
+        assert t.is_alive()
+    finally:
+        faults.reset("ingest_flush")
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert out["resume_seq"] == 1        # the racing commit is visible
+    dup = db.ingest.stream_rows("s1", {"k": [1], "v": [1.0]}, 1)
+    assert dup["duplicate"] is True      # resend dedups, no double-apply
+    db.ingest.stream_end("s1")
+    assert _count(db) == 1
+
+
 def test_brownout_sheds_stream_admission_typed(db):
     ctl = overload.CONTROLLER
     faults.inject("brownout_force", "skip", occurrences=-1)
@@ -195,6 +255,14 @@ def test_server_wire_ops_and_ps(db, tmp_path):
         ack = c.op({"op": "stream_rows", "stream": "w1",
                     "columns": {"k": [1, 2], "v": [1.0, 2.0]}, "seq": 1})
         assert ack["ok"] and ack["acked_seq"] == 1
+        # a malformed frame without seq must be REJECTED, not silently
+        # acked as a seq-0 duplicate (which would drop its rows)
+        bad = c.op({"op": "stream_rows", "stream": "w1",
+                    "columns": {"k": [9], "v": [9.0]}})
+        assert bad["ok"] is False and "seq" in bad["error"]
+        bad = c.op({"op": "stream_rows", "stream": "w1",
+                    "columns": {"k": [9], "v": [9.0]}, "seq": "2"})
+        assert bad["ok"] is False and "seq" in bad["error"]
         ps = c.op({"op": "ps"})
         assert [s["stream"] for s in ps["ingest"]] == ["w1"]
         st = c.op({"op": "status"})
