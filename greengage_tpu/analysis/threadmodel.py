@@ -93,6 +93,15 @@ THREAD_ROLES: dict[str, Role] = {
         spawns=(("exec/staging.py", "_warm"),),
         entries=(("exec/staging.py", "PassPrefetcher", "_warm"),),
     ),
+    "motion-stage": Role(
+        "motion-stage",
+        "bucket-pipeline stager (exec/motionpipe.py): runs bucket k+1's "
+        "side-effect-free stage callable (subset builds, workfile "
+        "promotion reads) while the statement thread computes bucket k; "
+        "slot handoff under the pipeline's own condition lock",
+        spawns=(("exec/motionpipe.py", "_stage_loop"),),
+        entries=(("exec/motionpipe.py", "BucketPipeline", "_stage_loop"),),
+    ),
     "batch-stage": Role(
         "batch-stage",
         "vectorized-serving stager: pops admission windows and runs "
@@ -152,6 +161,7 @@ THREAD_ROLES: dict[str, Role] = {
 ROLE_NAME_PREFIXES: tuple = (
     ("gg-stage", "staging"),              # ThreadPoolExecutor prefix
     ("gg-spill-prefetch", "spill-prefetch"),
+    ("gg-motion-stage", "motion-stage"),
     ("gg-batch-stage", "batch-stage"),
     ("gg-batch-dispatch", "batch-dispatch"),
     ("gg-client-watch", "server"),
@@ -205,6 +215,9 @@ SHARED_CLASSES: dict[str, str] = {
                          "and flushed by the deadline thread",
     "SegmentConfig":     "topology mutated by FTS, read at dispatch",
     "PassPrefetcher":    "kicked by the spill loop, joined at close",
+    "BucketPipeline":    "slot exchange between the statement thread and "
+                         "its motion stager, under the pipeline's "
+                         "condition lock",
     "_OrderTable":       "lockdebug's own global table",
 }
 

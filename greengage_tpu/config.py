@@ -37,6 +37,17 @@ class Settings:
     # motion (gp_interconnect_queue_depth analog)
     motion_capacity_slack: float = 1.6  # per-destination bucket headroom
     motion_retry_tiers: int = 3         # capacity x4 per retry on overflow
+    # pipelined motion (docs/PERF.md "Data movement"): motion_pipeline
+    # overlaps the host side of bucketed spill schedules — bucket k+1's
+    # staging runs on a background thread while bucket k computes; off =
+    # the serial-phase loops (the microbench baseline).
+    # motion_pipeline_buckets > 1 additionally splits each compiled
+    # redistribute into that many sub-exchanges along the capacity axis
+    # (row-order identical to the single all_to_all) so XLA can overlap
+    # exchange k+1 with compute on exchange k's rows; 1 = the single
+    # monolithic all_to_all (the pre-PR-18 program, byte-identical)
+    motion_pipeline: bool = True
+    motion_pipeline_buckets: int = 1
     # planner selection (the GUC 'optimizer' analog): on = Cascades-lite
     # memo search (planner/memo.py, the ORCA engine analog); off = the
     # left-deep Selinger DP / greedy order in the binder
@@ -93,6 +104,15 @@ class Settings:
     # spill passes warm the next pass's cold block reads on a background
     # thread while the current pass's jitted program runs
     spill_prefetch: bool = True
+    # tiered spill workfile (exec/workfile.py; docs/PERF.md "Data
+    # movement"): captured spill passes land in a byte-accounted host-RAM
+    # tier; once a statement's retained passes exceed spill_host_limit_mb
+    # the coldest passes demote to compressed segment files under
+    # spill_dir (default <cluster>/spill when empty) and are promoted
+    # back to RAM ahead of the merge schedule. 0 = RAM-only (the
+    # pre-tiered behavior: the workfile never touches disk)
+    spill_dir: str = ""
+    spill_host_limit_mb: int = 512
     # window-partition spill (exec/spill.py spill_window_run): a window
     # whose working set exceeds the admission limit captures its input in
     # chunked passes, then runs the window over disjoint PARTITION BY

@@ -67,15 +67,19 @@ _WEDGE_TIMEOUT_S = 600.0
 
 class _Member:
     """One waiting statement: its parameter vector, interrupt context,
-    statement trace, and the event its connection thread parks on."""
+    statement trace, and the event its connection thread parks on.
+    ``sql`` is the member's statement text — only set (and only needed)
+    on a multihost coordinator, where the flush broadcasts the window's
+    texts so the gang runs the same batched program."""
 
     __slots__ = ("pvec", "ctx", "trace", "wait_sid", "event", "result",
-                 "fallback", "masked", "t0")
+                 "fallback", "masked", "t0", "sql")
 
-    def __init__(self, pvec, ctx, trace):
+    def __init__(self, pvec, ctx, trace, sql=None):
         self.pvec = pvec
         self.ctx = ctx
         self.trace = trace
+        self.sql = sql
         self.wait_sid = None
         self.event = threading.Event()
         self.result = None
@@ -88,9 +92,11 @@ class _Batch:
     """One admission window: same plan-cache key, stacked at flush."""
 
     __slots__ = ("bid", "key", "plan", "consts", "outs", "members",
-                 "deadline", "trace", "root_sid", "staged", "stage_error")
+                 "deadline", "trace", "root_sid", "staged", "stage_error",
+                 "plan_hash")
 
-    def __init__(self, bid, key, plan, consts, outs, deadline):
+    def __init__(self, bid, key, plan, consts, outs, deadline,
+                 plan_hash=None):
         self.bid = bid
         self.key = key
         self.plan = plan
@@ -102,6 +108,7 @@ class _Batch:
         self.root_sid = None
         self.staged = None
         self.stage_error = None
+        self.plan_hash = plan_hash    # gang broadcast verification
 
 
 class BatchServer:
@@ -132,15 +139,18 @@ class BatchServer:
         self.recent: deque = deque(maxlen=32)
 
     # ---- the statement-thread surface --------------------------------
-    def submit(self, plan, consts, outs, key: str, pvec):
+    def submit(self, plan, consts, outs, key: str, pvec, sql=None,
+               plan_hash=None):
         """Enroll the calling statement in the admission window for its
         plan-cache key and wait for the flush. Returns the member's
         Result, or None when the batch fell back (the caller re-runs the
         statement through the classic path). Raises StatementCancelled
-        for a member cancelled while waiting or masked at demux."""
+        for a member cancelled while waiting or masked at demux. On a
+        multihost coordinator the caller passes the statement text and
+        plan hash so the flush can broadcast the window to the gang."""
         ctx = _INTERRUPTS.current()
         mtr = TRACES.current()
-        m = _Member(pvec, ctx, mtr)
+        m = _Member(pvec, ctx, mtr, sql=sql)
         self._ensure_threads()
         window_s = max(float(getattr(self.db.settings,
                                      "batch_window_ms", 2.0)), 0.0) / 1e3
@@ -177,7 +187,8 @@ class BatchServer:
                 b = None
             if b is None:
                 b = _Batch(next(self._bids), key, plan, consts, outs,
-                           time.monotonic() + window_s)
+                           time.monotonic() + window_s,
+                           plan_hash=plan_hash)
                 self._open[wkey] = b
             b.members.append(m)
             if ctx is not None:
@@ -409,7 +420,17 @@ class BatchServer:
             if b.staged is None:
                 raise BatchFallback(f"stage failed: {b.stage_error!r}")
             comp, inputs, snapshot, compiled = b.staged
-            flat = ex.dispatch_batch(comp, inputs)
+            mh_cm = self._mh_exchange(b)
+            if mh_cm is not None:
+                # multihost gang: two-phase broadcast of the batch window
+                # (readiness acks -> 'go' -> concurrent dispatch ->
+                # completion acks); any refusal/failure raises
+                # BatchFallback so members re-run via the classic
+                # per-statement dispatch, which owns failover
+                with mh_cm:
+                    flat = ex.dispatch_batch(comp, inputs)
+            else:
+                flat = ex.dispatch_batch(comp, inputs)
             over = ex.batch_overflowed(comp, flat)
             if over:
                 # per-member capacity needs differ (value-dependent join
@@ -470,6 +491,22 @@ class BatchServer:
                 self._inflight -= 1
                 self._cv.notify_all()
             self._refresh_depth()
+
+    def _mh_exchange(self, b: _Batch):
+        """Context manager broadcasting this window to the worker gang
+        (session._mh_batch_exchange), or None on a single-host Database.
+        Raises BatchFallback when a member lacks its statement text —
+        the gang cannot replay what it cannot see."""
+        db = self.db
+        mh = getattr(db, "multihost", None)
+        if mh is None or not getattr(mh, "is_coordinator", False):
+            return None
+        sqls = [m.sql for m in b.members]
+        if not all(sqls):
+            raise BatchFallback(
+                "batched member lacks statement text for the gang "
+                "broadcast")
+        return db._mh_batch_exchange(sqls, b.plan_hash)
 
     # ---- bookkeeping --------------------------------------------------
     def _graft(self, b: _Batch, bt: Trace) -> None:

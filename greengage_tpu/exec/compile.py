@@ -193,11 +193,12 @@ class Compiler:
         # vectorized serving (exec/batchserve.py): wrap the per-member
         # program in a vmap over the stacked parameter inputs. Staged
         # table inputs are closed over (broadcast — every member scans the
-        # same data); only parameters carry the member axis. Single-host,
-        # parameterized statements only.
+        # same data); only parameters carry the member axis. Under
+        # multihost the coordinator broadcasts the whole batch window
+        # (op sql_batch) so every gang member compiles this same
+        # width-bucketed program and its collectives rendezvous exactly
+        # like a classic statement's.
         self.batch_width = int(batch_width)
-        if self.batch_width:
-            assert not multihost, "batched serving is single-host only"
 
     def _reset_scan_state(self) -> None:
         """Fresh per-walk scan collection: compile() re-resets so ONE
@@ -617,6 +618,7 @@ class Compiler:
         return (s.dense_group_limit, s.fused_dense_agg,
                 s.fused_dense_min_rows, s.fused_dense_max_domain,
                 s.fused_dense_max_scratch_mb, s.motion_capacity_slack,
+                s.motion_pipeline_buckets,
                 s.hash_num_probes, s.hash_table_min, s.hash_table_max)
 
     def _estimate_bytes(self, plan: Plan) -> int:
@@ -1458,6 +1460,10 @@ class Compiler:
         # REDISTRIBUTE
         child_cap = self._capacity_of(plan.child)
         C = self._motion_bucket(child_cap)
+        # sub-exchange split (motion_pipeline_buckets): capacity is
+        # pow2(>=64) x 4^tier, so any pow2 bucket count <= 64 divides it;
+        # redistribute() itself guards the uneven case back to monolithic
+        nb = max(int(getattr(self.s, "motion_pipeline_buckets", 1)), 1)
         hash_exprs = plan.hash_exprs
         fid = f"motion_overflow_{len(self.flags)}"
         self.flags.append(fid)
@@ -1512,7 +1518,7 @@ class Compiler:
                 for name, vv in b.valids.items():
                     arrs[VALID_PREFIX + name] = vv
                 recv, precv, overflow = motion_ops.redistribute(
-                    arrs, sel, dest, nseg, C)
+                    arrs, sel, dest, nseg, C, nbuckets=nb)
                 ctx["flags"].append((fid, overflow))
                 cols = {k: a for k, a in recv.items()
                         if not k.startswith(VALID_PREFIX)}
@@ -1534,7 +1540,7 @@ class Compiler:
             for name, v in b.valids.items():
                 arrs[VALID_PREFIX + name] = v
             recv, precv, overflow = motion_ops.redistribute(
-                arrs, b.selection(), dest, nseg, C)
+                arrs, b.selection(), dest, nseg, C, nbuckets=nb)
             ctx["flags"].append((fid, overflow))
             cols = {k: v for k, v in recv.items() if not k.startswith(VALID_PREFIX)}
             valids = {k[len(VALID_PREFIX):]: v for k, v in recv.items()

@@ -243,6 +243,41 @@ class Executor:
         # clobber (gg check races)
         self._tls = threading.local()
 
+    # -- multihost spill-schedule parity (docs/PERF.md "Data movement") --
+    # The tiered workfile's pass/bucket schedules are pure functions of
+    # compiled estimates + settings, so every gang member computes the
+    # same one. These hooks make that a VERIFIED invariant instead of a
+    # hope: the coordinator arms recording per statement, every schedule
+    # decision is noted (and broadcast one-way to the workers for
+    # observability), workers ship the schedule they actually ran in
+    # their completion ack, and the session compares. Single-host runs
+    # never arm recording, so note() is a no-op there.
+    def begin_spill_schedule(self) -> None:
+        self._tls.spill_sched = []
+
+    def note_spill_schedule(self, kind: str, **info) -> None:
+        steps = getattr(self._tls, "spill_sched", None)
+        if steps is None:
+            return
+        entry = {"kind": kind, **info}
+        steps.append(entry)
+        mh = self.multihost
+        if mh is not None and getattr(mh, "is_coordinator", False):
+            ch = getattr(mh, "channel", None)
+            if ch is not None:
+                try:
+                    # one-way frame (workers' serve loop drops unknown
+                    # ops): the schedule lands on every host's control
+                    # log even if the statement later dies
+                    ch.send({"op": "spill_schedule", **entry})
+                except Exception:
+                    pass   # observability must never fail the statement
+
+    def collect_spill_schedule(self) -> list:
+        steps = getattr(self._tls, "spill_sched", None)
+        self._tls.spill_sched = None
+        return steps or []
+
     # -- per-thread staging context (source-compatible properties) -----
     @property
     def _row_ranges(self):

@@ -131,6 +131,9 @@ class Database:
         # always (re)install — an uncalibrated cluster opened after a
         # calibrated one in the same process must get the defaults back
         _cost.set_calibration(cal)
+        # planner overlap credit for pipelined motion (same process-global
+        # pattern; recomputed on SET motion_pipeline*)
+        _cost.set_motion_overlap(self._motion_overlap_factor())
         # the store's read-path self-heal honors storage_autorepair live,
         # and the block-cache registry reads scan_cache_limit_mb live
         self.store.settings = self.settings
@@ -156,6 +159,12 @@ class Database:
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings,
                                  multihost=multihost)
+        if not is_worker:
+            # spill segments whose owning process died mid-pass (tiered
+            # workfile; live paths clean up in their own finally)
+            from greengage_tpu.exec import workfile as _workfile
+            _workfile.sweep_orphans(
+                _workfile.spill_dir_of(self.settings, self.store))
         # vectorized serving pipeline (exec/batchserve.py): created
         # lazily on the first batch-eligible statement so the two
         # pipeline threads only exist when batch_serving_enabled is on
@@ -245,6 +254,22 @@ class Database:
                     multihost.channel.start_heartbeat()
                 except Exception as e:
                     self.log.error("multihost", f"heartbeat start failed: {e}")
+
+    def _motion_overlap_factor(self) -> float:
+        """Redistribute overlap credit from the motion_pipeline* GUCs
+        (planner/cost.set_motion_overlap). The host bucket pipeline alone
+        hides a modest slice of each exchange behind neighboring compute;
+        sub-exchange splitting deepens the device-timeline overlap — up to
+        half the transfer hidden at the deepest split. Deliberately
+        conservative: the credit shapes plan choice between motion
+        strategies, it does not promise free transfers."""
+        if not bool(getattr(self.settings, "motion_pipeline", True)):
+            return 1.0
+        nb = max(int(getattr(self.settings, "motion_pipeline_buckets", 1)), 1)
+        if nb <= 1:
+            return 0.9
+        # 2 buckets -> 0.75, 4 -> 0.625, >=8 -> floors at 0.5625
+        return max(0.5, 0.5 + 0.5 / min(nb, 8))
 
     def _apply_xla_cache_dir(self) -> None:
         """Arm jax's persistent compilation cache from the xla_cache_dir
@@ -1073,6 +1098,16 @@ class Database:
         out = None
         for stmt in stmts:
             if self._needs_mesh(stmt):
+                # vectorized serving on the gang: an eligible SELECT
+                # enrolls in the batch window BEFORE the per-statement
+                # two-phase dispatch — the flush broadcasts the whole
+                # window (op sql_batch) instead. None = not eligible or
+                # the batch fell back; continue on the classic dispatch.
+                if isinstance(stmt, A.SelectStmt):
+                    bres = self._mh_batch_try(stmt, text)
+                    if bres is not None:
+                        out = bres
+                        continue
                 # coordinator-side validation AND queue admission BEFORE
                 # the broadcast: a host-side rejection or queue wait after
                 # workers enter the collectives would deadlock the cluster
@@ -1117,8 +1152,15 @@ class Database:
                                 ch.send({"op": "skip"})
                                 raise QueryError(str(e))
                             ch.send({"op": "go"})
+                            # arm spill-schedule recording: the workers
+                            # ship theirs in the completion acks and the
+                            # parity check below asserts lockstep
+                            self.executor.begin_spill_schedule()
+                            _sched = None
                             try:
                                 out = self._execute(stmt)
+                                _sched = \
+                                    self.executor.collect_spill_schedule()
                             finally:
                                 try:
                                     _acks = ch.collect_acks(
@@ -1126,6 +1168,10 @@ class Database:
                                         phase="completion")
                                     if _disp is not None:
                                         _trace.graft_acks(_tr, _acks, _disp)
+                                    if _sched is not None:
+                                        # only when our side succeeded —
+                                        # never mask an in-flight error
+                                        self._mh_spill_parity(_sched, _acks)
                                 except WorkerDied as e:
                                     # our side already finished its mesh
                                     # program: the result stands; later
@@ -1227,16 +1273,173 @@ class Database:
         else:
             self._update(stmt, worker_scan_only=True)
 
+    # ---- multihost serving parity (docs/PERF.md "Data movement") ------
+    def _mh_batch_try(self, stmt, text: str):
+        """Coordinator half of gang batch serving: enroll an eligible
+        parameterized SELECT in the batch window BEFORE any per-statement
+        broadcast; the BatchServer's flush broadcasts the whole window
+        (op sql_batch) through _mh_batch_exchange so every gang member
+        dispatches the same width-bucketed program. Returns the member's
+        Result, or None (not eligible / window fell back) — the caller
+        proceeds with the classic two-phase dispatch."""
+        if not bool(getattr(self.settings, "batch_serving_enabled", False)):
+            return None
+        if not isinstance(stmt, A.SelectStmt) or not stmt.from_:
+            return None
+        if _overload.CONTROLLER.brownout_active():
+            return None
+        cur = self.dtm.current
+        if cur is not None and cur.state == "active":
+            return None
+        try:
+            planned, consts, outs, exec_key = self._cached_plan(stmt)
+        except Exception:
+            return None   # the classic path owns surfacing plan errors
+        pc_info = self._plan_cache_info
+        if (consts or {}).get("@params@") is None:
+            return None
+        aux, _dirty = self._load_external_aux(planned)
+        if aux:
+            return None   # external loads stay serial (per-member state)
+        with self._admission():
+            res = self._batcher().submit(planned, consts, outs, exec_key,
+                                         consts["@params@"], sql=text,
+                                         plan_hash=self.plan_hash(stmt))
+        if res is not None:
+            if isinstance(res.stats, dict):
+                res.stats["plan_cache"] = dict(pc_info)
+            self._record_stats(res)
+        return res
+
+    @_contextmanager
+    def _mh_batch_exchange(self, sqls: list, plan_hash):
+        """Two-phase broadcast of one batch window, called on the
+        BatchServer's dispatcher thread (no statement context): readiness
+        acks -> 'go' -> yield for the concurrent local dispatch ->
+        completion acks. EVERY failure surfaces as BatchFallback — the
+        members re-run through the classic per-statement dispatch, which
+        owns retries and failover. Gang degradation is NOT handled here:
+        this runs on the dispatcher thread, and _mh_degraded/_mh_detached
+        belong to the statement role. A dead peer raises WorkerDied again
+        on the first serial re-run's own broadcast, where _coordinator_sql
+        re-forms the gang on a statement thread."""
+        from greengage_tpu.exec.executor import BatchFallback
+        from greengage_tpu.parallel.multihost import WorkerDied
+
+        ch = self.multihost.channel
+        if getattr(ch, "hb_failure", None):
+            raise BatchFallback("gang unavailable for batched dispatch")
+        try:
+            with ch.exchange():
+                ch.send({"op": "sql_batch", "sqls": list(sqls),
+                         "plan_hash": plan_hash})
+                try:
+                    ch.collect_acks(deadline="mh_ready_deadline",
+                                    phase="readiness")
+                except RuntimeError as e:
+                    # a worker REFUSED (hash mismatch / planning failed):
+                    # nobody entered the mesh — release the parked
+                    # survivors and serve the members serially
+                    ch.send({"op": "skip"})
+                    raise BatchFallback(
+                        f"worker refused batch window: {e}")
+                ch.send({"op": "go"})
+                done = False
+                try:
+                    yield
+                    done = True
+                finally:
+                    try:
+                        ch.collect_acks(deadline="mh_ack_deadline",
+                                        phase="completion")
+                    except WorkerDied:
+                        raise
+                    except RuntimeError as e:
+                        if done:
+                            # a worker's batch failed where ours ran:
+                            # fall back — the serial re-runs keep the
+                            # gang in lockstep statement by statement
+                            raise BatchFallback(
+                                f"worker batch execution failed: {e}")
+                        # local dispatch already raising: let it surface
+        except WorkerDied as e:
+            raise BatchFallback(f"worker lost during batched dispatch: {e}")
+
+    def worker_sql_batch(self, sqls: list):
+        """Worker half of gang batch serving: plan every member of the
+        broadcast window (same plan cache, same literal hoisting), stack
+        their parameter vectors, and run the SAME width-bucketed batched
+        program the coordinator is dispatching concurrently."""
+        from greengage_tpu.exec.executor import BatchFallback
+
+        planned = consts = outs = ek = None
+        pvecs = []
+        for i, q in enumerate(sqls):
+            stmt = parse(q)[0]
+            p, c, o, k = self._cached_plan(stmt)
+            pv = (c or {}).get("@params@")
+            if pv is None:
+                raise BatchFallback(
+                    "window member did not parameterize on the worker")
+            if i == 0:
+                # the window's shared program compiles from the FIRST
+                # member's bound plan, mirroring the coordinator's window
+                planned, consts, outs, ek = p, c, o, k
+            pvecs.append(pv)
+        self.executor.run_batch(planned, consts, outs, ek, pvecs)
+
+    def _mh_spill_parity(self, mine: list, acks) -> None:
+        """Lockstep assertion for tiered-spill schedules: every worker
+        ships the pass/bucket schedule it actually ran in its completion
+        ack; divergence from the coordinator's means the gang's programs
+        could not have rendezvoused deterministically. Tier placement
+        (RAM vs disk) is deliberately absent from the schedule — it is
+        host-local and MUST NOT affect parity."""
+        for a in acks or []:
+            ws = a.get("spill_schedule") if isinstance(a, dict) else None
+            if ws is None:
+                continue
+            if list(ws) != list(mine):
+                raise QueryError(
+                    "spill-schedule parity violation: coordinator ran "
+                    f"{mine} but worker {a.get('process_id')} ran {ws}")
+
     def refresh(self) -> None:
         """Adopt the coordinator's committed catalog/manifest state from
-        the shared cluster directory (workers call this per statement)."""
+        the shared cluster directory (workers call this per statement).
+
+        The bound-plan cache is cleared only when the adopted state
+        actually CHANGED (catalog bytes or manifest version): paramized
+        generic plans carry the row estimates of the literals they were
+        first bound with, so a worker that re-binds every statement
+        while the coordinator serves its cache would compute a different
+        plan hash for every repeated shape with a new literal — the
+        lockstep verifier would reject its own gang. Keeping the cache
+        across unchanged refreshes makes both sides bind each shape
+        once, in the same broadcast order, with the same literals."""
         self.catalog = Catalog.load(self.path)
         self._load_extensions()
         self.store.catalog = self.catalog
         self.numsegments = self.catalog.segments.numsegments
         self.executor.catalog = self.catalog
-        self._select_cache.clear()
+        state = (self.store.manifest.snapshot().get("version", 0),
+                 self._catalog_fingerprint())
+        if state != getattr(self, "_refresh_state", None) or None in state:
+            self._select_cache.clear()
+            self._refresh_state = state
         self.store._invalidate_dicts_all()
+
+    def _catalog_fingerprint(self) -> str | None:
+        """Digest of the on-disk catalog (None when unreadable): ANALYZE
+        stats, index DDL, and partition changes all ride catalog.json
+        without bumping the manifest version, and each must invalidate
+        a worker's bound plans exactly like the coordinator's own clear
+        sites do."""
+        try:
+            with open(os.path.join(self.path, "catalog.json"), "rb") as f:
+                return hashlib.sha1(f.read()).hexdigest()
+        except OSError:
+            return None
 
     def _execute(self, stmt):
         if isinstance(stmt, (A.SelectStmt, A.UnionStmt)):
@@ -1404,8 +1607,13 @@ class Database:
                              "scalar_device_enabled"):
                 # planner selection / literal-hoisting / scalar-lowering
                 # changed: cached bound plans were produced under the
-                # other regime
+                # other regime. motion_pipeline_buckets needs no clear:
+                # binding never reads it — the executor's program cache
+                # keys on codegen_settings_sig and recompiles
                 self._select_cache.clear()
+            if stmt.name in ("motion_pipeline", "motion_pipeline_buckets"):
+                from greengage_tpu.planner import cost as _cost
+                _cost.set_motion_overlap(self._motion_overlap_factor())
             return "SET"
         if isinstance(stmt, A.ResourceGroupStmt):
             return self._resource_group(stmt)
@@ -2076,10 +2284,15 @@ class Database:
         return b
 
     def _batch_eligible(self, consts, aux) -> bool:
-        """May this SELECT ride the batched-serving path? Parameterized
-        single-host autocommit reads only: multihost stays lockstep,
-        external-table loads stay serial, and a statement inside an open
-        transaction must see its session's uncommitted state."""
+        """May this SELECT ride the batched-serving path from _select?
+        Parameterized single-host autocommit reads only: external-table
+        loads stay serial, and a statement inside an open transaction
+        must see its session's uncommitted state. A multihost
+        COORDINATOR batches too, but enrolls in _coordinator_sql BEFORE
+        the per-statement broadcast (_mh_batch_try) — by the time
+        _select runs there, the statement is already inside a classic
+        two-phase exchange the workers are parked in, so this gate stays
+        False under multihost."""
         if not bool(getattr(self.settings, "batch_serving_enabled", False)):
             return False
         if _overload.CONTROLLER.brownout_active():

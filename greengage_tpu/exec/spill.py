@@ -184,24 +184,45 @@ def _charge_spill(cols: dict, valids: dict, item: str) -> None:
 
 
 def _collect_passes(cols_spec, results):
-    """Concatenate per-pass Result columns on the host with shared
-    validity defaulting: -> (cols, valids) where valids[c] is None when
-    every pass reported the column all-valid."""
-    host_cols = {c.id: [] for c in cols_spec}
-    host_valids = {c.id: [] for c in cols_spec}
+    """Merge per-pass Result columns on the host with shared validity
+    defaulting: -> (cols, valids) where valids[c] is None when every pass
+    reported the column all-valid. The merged buffers are PREALLOCATED
+    from the pass row counts and filled in place — the old append-then-
+    np.concatenate pair transiently held a second full copy of the
+    workfile at the merge peak."""
+    per_pass = []                      # (rows, {id: (arr, valids|None)})
     any_invalid = {c.id: False for c in cols_spec}
+    total = 0
     for res in results:
+        data = {}
+        rows = 0
         for c in cols_spec:
-            host_cols[c.id].append(np.asarray(res.cols[c.id]))
+            a = np.asarray(res.cols[c.id])
+            rows = len(a)
             v = res.valids.get(c.id)
-            if v is None:
-                v = np.ones(len(res.cols[c.id]), dtype=bool)
-            else:
+            if v is not None:
+                v = np.asarray(v, bool)
                 any_invalid[c.id] = True
-            host_valids[c.id].append(np.asarray(v, bool))
-    cols = {c.id: np.concatenate(host_cols[c.id]) for c in cols_spec}
-    valids = {c.id: (np.concatenate(host_valids[c.id])
+            data[c.id] = (a, v)
+        per_pass.append((rows, data))
+        total += rows
+    dtypes = {}
+    for _rows, data in per_pass:
+        for cid, (a, _v) in data.items():
+            dtypes[cid] = (a.dtype if cid not in dtypes
+                           else np.result_type(dtypes[cid], a.dtype))
+    cols = {c.id: np.empty(total, dtype=dtypes.get(c.id, np.int64))
+            for c in cols_spec}
+    valids = {c.id: (np.ones(total, dtype=bool)
                      if any_invalid[c.id] else None) for c in cols_spec}
+    off = 0
+    for rows, data in per_pass:
+        for c in cols_spec:
+            a, v = data[c.id]
+            cols[c.id][off:off + rows] = a
+            if valids[c.id] is not None and v is not None:
+                valids[c.id][off:off + rows] = v
+        off += rows
     return cols, valids
 
 
@@ -303,14 +324,20 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
     # bring the pass program under the limit
     chosen, per_table, npasses, comp = _size_chunk_passes(
         executor, consts, pass_plan, candidates, limit_bytes)
+    # lockstep parity: the pass schedule every gang member must agree on
+    executor.note_spill_schedule(
+        "agg", passes=npasses,
+        chunks=[[t, c, n] for t, c, n in per_table])
 
-    # run the passes, collecting partial rows on the host (the workfile).
+    # run the passes, landing partial rows in the tiered workfile (host
+    # RAM, overflowing to compressed disk segments — exec/workfile.py).
     # While pass k's jitted program runs, a background thread warms pass
     # k+1's cold block reads into the block cache (exec/staging.py; all
     # passes share the same committed files, so after the budget-resident
     # first pass this is a cheap cache probe)
 
     from greengage_tpu.exec import staging as _staging
+    from greengage_tpu.exec import workfile as _workfile
 
     grids = [[(t, (i * c, (i + 1) * c)) for i in range(n)]
              for t, c, n in per_table]
@@ -319,85 +346,92 @@ def spill_run(executor, plan: Motion, consts, out_cols, raw: bool,
     combos = list(itertools.product(*grids))
     prefetcher = _staging.PassPrefetcher(
         executor, comp.input_spec, store.manifest.snapshot())
-    pass_results = []
+    wf = _workfile.SpillWorkfile(executor, partial_cols, "partials")
     try:
-        for i, combo in enumerate(combos):
-            # spill pass boundary = CHECK_FOR_INTERRUPTS (the cleaner's
-            # documented cancellation point; user cancels land here too)
-            interrupt.check_interrupts()
-            if i + 1 < len(combos):
-                prefetcher.kick()
-            with _trace.span("spill-pass", cat="spill", index=i,
-                             total=len(combos)):
-                pass_results.append(executor.run_single(
-                    pass_plan, consts, partial_cols, raw=True,
-                    scan_cap_override=caps,
-                    row_ranges=dict(combo), no_direct=True,
-                    instrument=instrument))
-    finally:
-        prefetcher.close()
-    aux_cols, aux_valids = _collect_passes(partial_cols, pass_results)
-    _charge_spill(aux_cols, aux_valids, "partials")
+        try:
+            for i, combo in enumerate(combos):
+                # spill pass boundary = CHECK_FOR_INTERRUPTS (the
+                # cleaner's documented cancellation point; user cancels
+                # land here too)
+                interrupt.check_interrupts()
+                if i + 1 < len(combos):
+                    prefetcher.kick()
+                with _trace.span("spill-pass", cat="spill", index=i,
+                                 total=len(combos)):
+                    wf.add(executor.run_single(
+                        pass_plan, consts, partial_cols, raw=True,
+                        scan_cap_override=caps,
+                        row_ranges=dict(combo), no_direct=True,
+                        instrument=instrument))
+        finally:
+            prefetcher.close()
+        aux_cols, aux_valids = wf.assemble()
 
-    # merge program: the original plan with the replace target swapped for
-    # a host input of the concatenated captured rows. Partial case: the
-    # partial itself is replaced (its states redistribute + final-merge
-    # above). Dedupe case: the subtree BELOW the dedupe's redistribute is
-    # replaced, so the union re-hashes (co-locating cross-pass duplicates)
-    # and the dedupe re-runs on device.
-    aux_name = "@spill:partials"
-    host_scan = Scan(aux_name, list(partial_cols))
-    host_scan.locus = (capture_agg.locus if capture_agg is replace_target
-                       else Locus.strewn(executor.nseg))
-    host_scan.est_rows = float(len(next(iter(aux_cols.values()), [])))
-    repl: Plan = host_scan
-    if add_motion:
-        key_cols = [ci for ci, _ in capture_agg.group_keys]
-        m = Motion(MotionKind.REDISTRIBUTE, host_scan,
-                   hash_exprs=[E.ColRef(ci.id, ci.type) for ci in key_cols])
-        m.locus = Locus.hashed(tuple(ci.id for ci in key_cols),
-                               executor.nseg)
-        m.est_rows = host_scan.est_rows
-        repl = m
-    node_map: dict = {}
-    merged = _replace_child(plan, replace_target, repl, node_map)
-    from greengage_tpu.exec.executor import AdmissionError
+        # merge program: the original plan with the replace target
+        # swapped for a host input of the merged captured rows. Partial
+        # case: the partial itself is replaced (its states redistribute +
+        # final-merge above). Dedupe case: the subtree BELOW the dedupe's
+        # redistribute is replaced, so the union re-hashes (co-locating
+        # cross-pass duplicates) and the dedupe re-runs on device.
+        aux_name = "@spill:partials"
+        host_scan = Scan(aux_name, list(partial_cols))
+        host_scan.locus = (capture_agg.locus
+                           if capture_agg is replace_target
+                           else Locus.strewn(executor.nseg))
+        host_scan.est_rows = float(len(next(iter(aux_cols.values()), [])))
+        repl: Plan = host_scan
+        if add_motion:
+            key_cols = [ci for ci, _ in capture_agg.group_keys]
+            m = Motion(MotionKind.REDISTRIBUTE, host_scan,
+                       hash_exprs=[E.ColRef(ci.id, ci.type)
+                                   for ci in key_cols])
+            m.locus = Locus.hashed(tuple(ci.id for ci in key_cols),
+                                   executor.nseg)
+            m.est_rows = host_scan.est_rows
+            repl = m
+        node_map: dict = {}
+        merged = _replace_child(plan, replace_target, repl, node_map)
+        from greengage_tpu.exec.executor import AdmissionError
 
-    try:
-        with _trace.span("spill-merge", cat="spill", passes=npasses):
-            res = executor.run_single(
-                merged, consts, out_cols, raw=raw,
-                aux_tables={aux_name: (aux_cols, aux_valids)},
-                no_direct=True, instrument=instrument)
-    except AdmissionError:
-        if capture_agg.aggs:          # partial-state merges never regress
-            raise
-        # recursive-merge level (execHHashagg.c batch recursion): the
-        # dedupe working set (~the full key domain for near-unique keys)
-        # exceeds HBM even after pass capture. Partition the captured
-        # keys BY KEY HASH into disjoint buckets — dedupe is exact per
-        # bucket, and the additive partial states above the dedupe sum
-        # exactly across buckets.
-        res, extra = _bucketed_dedupe_merge(
-            executor, merged, capture_agg, host_scan, aux_name, aux_cols,
-            aux_valids, consts, out_cols, raw, limit_bytes)
+        try:
+            with _trace.span("spill-merge", cat="spill", passes=npasses):
+                res = executor.run_single(
+                    merged, consts, out_cols, raw=raw,
+                    aux_tables={aux_name: (aux_cols, aux_valids)},
+                    no_direct=True, instrument=instrument)
+        except AdmissionError:
+            if capture_agg.aggs:      # partial-state merges never regress
+                raise
+            # recursive-merge level (execHHashagg.c batch recursion): the
+            # dedupe working set (~the full key domain for near-unique
+            # keys) exceeds HBM even after pass capture. Partition the
+            # captured keys BY KEY HASH into disjoint buckets — dedupe is
+            # exact per bucket, and the additive partial states above the
+            # dedupe sum exactly across buckets.
+            res, extra = _bucketed_dedupe_merge(
+                executor, merged, capture_agg, host_scan, aux_name,
+                aux_cols, aux_valids, consts, out_cols, raw, limit_bytes)
+            if instrument:
+                _merge_node_rows(res, wf.stats, node_map)
+            return res, npasses + extra
         if instrument:
-            _merge_node_rows(res, pass_results, node_map)
-        return res, npasses + extra
-    if instrument:
-        _merge_node_rows(res, pass_results, node_map)
-    return res, npasses
+            _merge_node_rows(res, wf.stats, node_map)
+        return res, npasses
+    finally:
+        wf.close()
 
 
-def _merge_node_rows(res, pass_results, node_map) -> None:
+def _merge_node_rows(res, pass_stats, node_map) -> None:
     """EXPLAIN ANALYZE accounting across spill passes: per-node row
-    counts from the pass programs (whose subtree nodes ARE the original
-    plan's objects) sum with the merge program's (clone ids remapped to
-    their originals), landing in the final Result's stats under the
-    ORIGINAL plan-node identities the session's describe() walk uses."""
+    counts from the pass programs' stats dicts (their subtree nodes ARE
+    the original plan's objects) sum with the merge program's (clone ids
+    remapped to their originals), landing in the final Result's stats
+    under the ORIGINAL plan-node identities the session's describe()
+    walk uses. ``pass_stats`` is a list of per-pass Result.stats dicts
+    (the tiered workfile retains stats, not whole Results)."""
     agg: dict = {}
-    for r in pass_results:
-        for nid, n in (((r.stats or {}).get("node_rows")) or {}).items():
+    for st in pass_stats:
+        for nid, n in (((st or {}).get("node_rows")) or {}).items():
             agg[nid] = agg.get(nid, 0) + n
     if isinstance(res.stats, dict):
         for nid, n in ((res.stats.get("node_rows")) or {}).items():
@@ -494,19 +528,30 @@ def _bucketed_dedupe_merge(executor, merged, dedupe, host_scan, aux_name,
             "per-bucket dedupe working set still exceeds the limit at 64 "
             "merge buckets")
     bucket = h % np.uint32(K)
+    executor.note_spill_schedule("dedupe", buckets=K)
 
-    bucket_results = []
-    for bkt in range(K):
-        interrupt.check_interrupts()   # merge-bucket boundary
+    # bucketed merge on the motion pipeline (exec/motionpipe.py): bucket
+    # k+1's host subset build overlaps bucket k's device program
+    from greengage_tpu.exec import motionpipe as _motionpipe
+
+    run_bkts = [b for b in range(K) if (bucket == b).any()]
+
+    def _bstage(bkt, _i):
         m = bucket == bkt
-        if not m.any():
-            continue
         sub_cols = {k: np.asarray(v)[m] for k, v in aux_cols.items()}
         sub_valids = {k: (np.asarray(v, bool)[m] if v is not None else None)
                       for k, v in aux_valids.items()}
-        bucket_results.append(executor.run_single(
+        return sub_cols, sub_valids
+
+    def _bcompute(staged, _bkt, _i):
+        sub_cols, sub_valids = staged
+        return executor.run_single(
             bucket_plan, consts, state_cols, raw=True,
-            aux_tables={aux_name: (sub_cols, sub_valids)}, no_direct=True))
+            aux_tables={aux_name: (sub_cols, sub_valids)}, no_direct=True)
+
+    bucket_results = _motionpipe.run_pipeline(
+        run_bkts, _bstage, _bcompute, settings=executor.settings,
+        label="dedupe")
     s_cols, s_valids = _collect_passes(state_cols, bucket_results)
     _charge_spill(s_cols, s_valids, "merge-buckets")
     aux2 = "@spill:partials2"
@@ -656,57 +701,62 @@ def spill_sort_run(executor, plan: Motion, consts, out_cols, raw: bool,
     npasses = -(-max_rows // chunk)
     if npasses > 256:
         raise NotSpillable(f"sort spill would need {npasses} passes (> 256)")
+    executor.note_spill_schedule("sort", passes=npasses,
+                                 chunks=[[cand, chunk, npasses]])
 
     from greengage_tpu.exec import staging as _staging
+    from greengage_tpu.exec import workfile as _workfile
 
     prefetcher = _staging.PassPrefetcher(
         executor, comp.input_spec, store.manifest.snapshot())
-    runs = []
+    wf = _workfile.SpillWorkfile(executor, out_cols, "sorted-runs")
     try:
-        for p in range(npasses):
-            interrupt.check_interrupts()   # sorted-run pass boundary
-            if p + 1 < npasses:
-                # warm the next sorted run's cold reads while this pass's
-                # device sort executes (same files, later row range)
-                prefetcher.kick()
-            with _trace.span("spill-pass", cat="spill", index=p,
-                             total=npasses):
-                res = executor.run_single(
-                    pass_plan, consts, out_cols, raw=raw,
-                    scan_cap_override={cand: chunk},
-                    row_ranges={cand: (p * chunk, (p + 1) * chunk)},
-                    no_direct=True, instrument=instrument)
-            runs.append(res)
+        try:
+            for p in range(npasses):
+                interrupt.check_interrupts()   # sorted-run pass boundary
+                if p + 1 < npasses:
+                    # warm the next sorted run's cold reads while this
+                    # pass's device sort executes (same files, later row
+                    # range)
+                    prefetcher.kick()
+                with _trace.span("spill-pass", cat="spill", index=p,
+                                 total=npasses):
+                    wf.add(executor.run_single(
+                        pass_plan, consts, out_cols, raw=raw,
+                        scan_cap_override={cand: chunk},
+                        row_ranges={cand: (p * chunk, (p + 1) * chunk)},
+                        no_direct=True, instrument=instrument))
+        finally:
+            prefetcher.close()
+
+        cols, valids = wf.assemble()
+
+        cols, valids = _host_lexsort(cols, valids, keyspec)
+        if limit_node is not None:
+            lo = limit_node.offset
+            hi = None if limit_node.limit is None else lo + limit_node.limit
+            cols = {k: v[lo:hi] for k, v in cols.items()}
+            valids = {k: (v[lo:hi] if v is not None else None)
+                      for k, v in valids.items()}
+
+        from greengage_tpu.exec.executor import Result
+
+        res = Result(columns=wf.columns, cols=cols, valids=valids,
+                     _order=list(wf.order),
+                     stats=dict(wf.base_stats or {}))
+        res.stats["spill_kind"] = "sort"
+        if instrument:
+            # per-node rows sum across the sorted-run passes; the pass
+            # plan's instrumented subtree IS the original plan's node
+            # objects (the Limit, dropped from passes, stays
+            # unannotated). Drop pass 0's counts inherited via base_stats
+            # first — _merge_node_rows would otherwise double-count that
+            # pass.
+            res.stats.pop("node_rows", None)
+            _merge_node_rows(res, wf.stats, {})
+        return res, npasses
     finally:
-        prefetcher.close()
-
-    cols, valids = _collect_passes(out_cols, runs)
-    _charge_spill(cols, valids, "sorted-runs")
-
-    cols, valids = _host_lexsort(cols, valids, keyspec)
-    if limit_node is not None:
-        lo = limit_node.offset
-        hi = None if limit_node.limit is None else lo + limit_node.limit
-        cols = {k: v[lo:hi] for k, v in cols.items()}
-        valids = {k: (v[lo:hi] if v is not None else None)
-                  for k, v in valids.items()}
-
-    from greengage_tpu.exec.executor import Result
-
-    base = runs[0]
-    res = Result(columns=base.columns, cols=cols, valids=valids,
-                 _order=list(base._order),
-                 stats=dict(base.stats or {}))
-    res.stats["spill_kind"] = "sort"
-    if instrument:
-        # per-node rows sum across the sorted-run passes; the pass plan's
-        # instrumented subtree IS the original plan's node objects (the
-        # Limit, dropped from passes, stays unannotated). Drop pass 0's
-        # counts inherited via base.stats first — _merge_node_rows would
-        # otherwise double-count that pass.
-        res.stats.pop("node_rows", None)
-        _merge_node_rows(res, runs, {})
-    return res, npasses
+        wf.close()
 
 
 def _window_spill_point(plan: Motion):
@@ -788,6 +838,7 @@ def spill_window_run(executor, plan: Motion, consts, out_cols, raw: bool,
                            "input columns")
 
     from greengage_tpu.exec import staging as _staging
+    from greengage_tpu.exec import workfile as _workfile
     from greengage_tpu.exec.compile import Compiler
     from greengage_tpu.exec.executor import effective_limit_bytes
 
@@ -806,29 +857,34 @@ def spill_window_run(executor, plan: Motion, consts, out_cols, raw: bool,
         raise NotSpillable("no partitionable table below the window")
     chosen, per_table, nchunks, comp = _size_chunk_passes(
         executor, consts, pass_plan, candidates, limit_bytes)
+    executor.note_spill_schedule(
+        "window-capture", passes=nchunks,
+        chunks=[[t, c, n] for t, c, n in per_table])
     grids = [[(t, (i * c, (i + 1) * c)) for i in range(n)]
              for t, c, n in per_table]
     caps = {t: c for t, c, _ in per_table}
     combos = list(itertools.product(*grids))
     prefetcher = _staging.PassPrefetcher(
         executor, comp.input_spec, store.manifest.snapshot())
-    pass_results = []
+    wf = _workfile.SpillWorkfile(executor, sub_cols, "window-input")
     try:
-        for i, combo in enumerate(combos):
-            interrupt.check_interrupts()   # spill pass boundary
-            if i + 1 < len(combos):
-                prefetcher.kick()
-            with _trace.span("spill-pass", cat="spill", index=i,
-                             total=len(combos), phase="capture"):
-                pass_results.append(executor.run_single(
-                    pass_plan, consts, sub_cols, raw=True,
-                    scan_cap_override=caps,
-                    row_ranges=dict(combo), no_direct=True,
-                    instrument=instrument))
+        try:
+            for i, combo in enumerate(combos):
+                interrupt.check_interrupts()   # spill pass boundary
+                if i + 1 < len(combos):
+                    prefetcher.kick()
+                with _trace.span("spill-pass", cat="spill", index=i,
+                                 total=len(combos), phase="capture"):
+                    wf.add(executor.run_single(
+                        pass_plan, consts, sub_cols, raw=True,
+                        scan_cap_override=caps,
+                        row_ranges=dict(combo), no_direct=True,
+                        instrument=instrument))
+        finally:
+            prefetcher.close()
+        aux_cols, aux_valids = wf.assemble()
     finally:
-        prefetcher.close()
-    aux_cols, aux_valids = _collect_passes(sub_cols, pass_results)
-    _charge_spill(aux_cols, aux_valids, "window-input")
+        wf.close()
 
     # ---- phase 2: window over PARTITION BY hash buckets --------------
     aux_name = "@spill:window"
@@ -885,22 +941,33 @@ def spill_window_run(executor, plan: Motion, consts, out_cols, raw: bool,
             "per-bucket window working set still exceeds the limit at 64 "
             "partition buckets")
     bucket = h % np.uint32(K)
+    executor.note_spill_schedule("window", buckets=K)
 
-    bucket_results = []
-    for bkt in range(K):
-        interrupt.check_interrupts()   # window bucket boundary
+    # bucketed window passes on the motion pipeline (exec/motionpipe.py):
+    # bucket k+1's host subset build + restage overlaps bucket k's device
+    # program. Bucket 0 always runs (result schema base).
+    from greengage_tpu.exec import motionpipe as _motionpipe
+
+    run_bkts = [b for b in range(K) if b == 0 or (bucket == b).any()]
+
+    def _bstage(bkt, _i):
         mk = bucket == bkt
-        if bkt > 0 and not mk.any():
-            continue    # bucket 0 always runs (result schema base)
         sub = {k: np.asarray(v)[mk] for k, v in aux_cols.items()}
         subv = {k: (np.asarray(v, bool)[mk] if v is not None else None)
                 for k, v in aux_valids.items()}
+        return sub, subv
+
+    def _bcompute(staged, bkt, _i):
+        sub, subv = staged
         with _trace.span("spill-pass", cat="spill", index=bkt, total=K,
                          phase="window"):
-            bucket_results.append(executor.run_single(
+            return executor.run_single(
                 bucket_plan, consts, out_cols, raw=raw,
                 aux_tables={aux_name: (sub, subv)}, no_direct=True,
-                instrument=instrument))
+                instrument=instrument)
+
+    bucket_results = _motionpipe.run_pipeline(
+        run_bkts, _bstage, _bcompute, settings=settings, label="window")
     cols, valids = _collect_passes(out_cols, bucket_results)
     _charge_spill(cols, valids, "window-output")
 
@@ -927,8 +994,8 @@ def spill_window_run(executor, plan: Motion, consts, out_cols, raw: bool,
         # bucket 0's counts inherited through base.stats first.
         res.stats.pop("node_rows", None)
         agg: dict = {}
-        for r in pass_results:
-            for nid, nr in (((r.stats or {}).get("node_rows")) or {}).items():
+        for st in wf.stats:
+            for nid, nr in (((st or {}).get("node_rows")) or {}).items():
                 agg[nid] = agg.get(nid, 0) + nr
         for r in bucket_results:
             for nid, nr in (((r.stats or {}).get("node_rows")) or {}).items():

@@ -1,0 +1,321 @@
+"""Tiered spill workfile: host RAM -> compressed disk segments.
+
+The reference's workfile manager (workfile_mgr.c) spills hash batches to
+disk files; our spill (exec/spill.py) kept every captured pass as live
+numpy arrays — host RAM was the only workfile tier, so a statement whose
+captured passes exceeded host memory died on host OOM instead of
+degrading to disk bandwidth. This module makes the workfile explicit and
+tiered:
+
+  - every captured pass lands in the HOST-RAM tier, byte-accounted to
+    the statement's 'spill' owner (runtime/memaccount.py) and the
+    ``spill_tier_ram_bytes`` gauge;
+  - once a statement's retained passes exceed ``spill_host_limit_mb``
+    the COLDEST passes (earliest captured = last merged) demote to one
+    compressed segment file each under ``spill_dir`` via the native
+    codec (storage/native.py frames, CRC-checked on read), moving their
+    bytes to the ``spill_tier_disk_bytes`` gauge;
+  - ``assemble()`` merges every pass into ONE preallocated buffer per
+    column (single peak — the old append-then-concatenate transiently
+    held 2x the workfile), promoting disk passes back to RAM on the
+    motion pipeline (exec/motionpipe.py) so pass k+1's read+decode
+    overlaps pass k's buffer fill: merge time tends to
+    max(decode I/O, fill bandwidth) rather than their sum.
+
+Files are named ``gg-spill-<pid>-<seq>-<token>.wf`` and deleted as each
+pass promotes (and unconditionally at ``close()``); ``sweep_orphans``
+removes files whose owning process is dead — Database init calls it on
+the coordinator so a kill mid-pass never leaks spill segments.
+
+Tier decisions are HOST-LOCAL and invisible to the pass/bucket schedule
+(which stays a pure function of compiled estimates + settings), so a
+multihost gang's lockstep schedules are unaffected by how much host RAM
+each process happens to have.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import os
+import re
+import threading
+
+import numpy as np
+
+from greengage_tpu.runtime import memaccount
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+
+_FILE_RE = re.compile(r"^gg-spill-(\d+)-\d+-[0-9a-f]+\.wf$")
+_seq = itertools.count(1)
+
+# process-wide tier totals behind the spill_tier_{ram,disk}_bytes gauges
+# (multiple concurrent spilling statements share them)
+_tier_mu = threading.Lock()
+_tier_ram = 0
+_tier_disk = 0
+
+
+def _tier_add(ram: int = 0, disk: int = 0) -> None:
+    global _tier_ram, _tier_disk
+    with _tier_mu:
+        _tier_ram = max(_tier_ram + int(ram), 0)
+        _tier_disk = max(_tier_disk + int(disk), 0)
+        counters.set("spill_tier_ram_bytes", _tier_ram)
+        counters.set("spill_tier_disk_bytes", _tier_disk)
+
+
+def spill_dir_of(settings, store) -> str:
+    d = str(getattr(settings, "spill_dir", "") or "")
+    return d if d else os.path.join(store.root, "spill")
+
+
+def sweep_orphans(directory: str) -> int:
+    """Remove spill segment files owned by DEAD processes (a kill mid-pass
+    leaves them behind; close() handles every live-process path). Returns
+    the number removed; never raises — recovery must not fail on a
+    half-written orphan."""
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)          # signal 0: existence probe only
+            continue                 # owner is alive — not an orphan
+        except OSError as e:
+            if e.errno != errno.ESRCH:
+                continue             # EPERM: alive under another uid
+        try:
+            os.unlink(os.path.join(directory, name))
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        counters.inc("spill_orphan_sweep_total", removed)
+    return removed
+
+
+class _Pass:
+    __slots__ = ("rows", "data", "path", "index", "ram_bytes", "disk_bytes")
+    # data: {col id: (array, valids | None)} while RAM-resident; None when
+    # demoted. index: [(col id, dtype str, has_valids)] in frame order.
+
+
+class SpillWorkfile:
+    """One statement's captured passes for one spill phase. Single-owner:
+    the statement thread adds/assembles/closes; only assemble()'s
+    promotion stage callable runs off-thread (on disjoint passes)."""
+
+    def __init__(self, executor, cols_spec, item: str):
+        self.cols_spec = list(cols_spec)
+        self.item = item
+        self.settings = executor.settings
+        self.dir = spill_dir_of(executor.settings, executor.store)
+        limit_mb = int(getattr(executor.settings, "spill_host_limit_mb",
+                               512) or 0)
+        # 0 = RAM-only (the pre-tiered behavior: never touch disk)
+        self.limit_bytes = limit_mb << 20 if limit_mb > 0 else None
+        self.compresslevel = int(getattr(executor.settings,
+                                         "default_compresslevel", 1))
+        self._passes: list[_Pass] = []
+        self._ram_bytes = 0
+        self.stats: list = []        # per-pass Result.stats (EXPLAIN ANALYZE)
+        # result-metadata donor fields from the FIRST captured pass
+        self.columns = None
+        self.order = None
+        self.base_stats = None
+        self._any_invalid = {c.id: False for c in self.cols_spec}
+        self._closed = False
+
+    # ---- capture -------------------------------------------------------
+    def add(self, res) -> None:
+        """Capture one pass Result: columns move to host arrays in the RAM
+        tier (the device handles drop with the Result), metadata and stats
+        are retained, and the coldest passes demote once the statement's
+        RAM tier exceeds its budget."""
+        faults.check("spill_capture")
+        p = _Pass()
+        p.data = {}
+        p.path = None
+        p.index = []
+        p.disk_bytes = 0
+        nb = 0
+        rows = 0
+        for c in self.cols_spec:
+            a = np.asarray(res.cols[c.id])
+            rows = len(a)
+            v = res.valids.get(c.id)
+            if v is not None:
+                v = np.asarray(v, bool)
+                self._any_invalid[c.id] = True
+                nb += int(v.nbytes)
+            nb += int(a.nbytes)
+            p.data[c.id] = (a, v)
+            p.index.append((c.id, a.dtype.str, v is not None))
+        p.rows = rows
+        p.ram_bytes = nb
+        self._passes.append(p)
+        self.stats.append(res.stats)
+        if self.columns is None:
+            self.columns = res.columns
+            self.order = list(getattr(res, "_order", []) or [])
+            self.base_stats = dict(res.stats or {})
+        self._ram_bytes += nb
+        memaccount.charge("spill", nb, item=self.item)
+        _tier_add(ram=nb)
+        if self.limit_bytes is not None:
+            self._demote_over(self.limit_bytes)
+
+    def _demote_over(self, limit: int) -> None:
+        """Demote coldest-first (earliest captured) until the RAM tier
+        fits; the pass being captured right now stays resident."""
+        for p in self._passes[:-1]:
+            if self._ram_bytes <= limit:
+                return
+            if p.data is not None:
+                self._demote(p)
+        # all older passes are on disk: demote the newest too if the tier
+        # still overflows (one pass bigger than the whole budget)
+        if self._ram_bytes > limit and self._passes \
+                and self._passes[-1].data is not None:
+            self._demote(self._passes[-1])
+
+    def _demote(self, p: _Pass) -> None:
+        from greengage_tpu.storage import native
+
+        os.makedirs(self.dir, exist_ok=True)
+        name = (f"gg-spill-{os.getpid()}-{next(_seq)}-"
+                f"{os.urandom(4).hex()}.wf")
+        path = os.path.join(self.dir, name)
+        nbytes = 0
+        with open(path, "wb") as f:
+            for cid, _dt, has_v in p.index:
+                a, v = p.data[cid]
+                frame = native.block_encode(
+                    np.ascontiguousarray(a), len(a),
+                    level=self.compresslevel)
+                f.write(frame)
+                nbytes += len(frame)
+                if has_v:
+                    frame = native.block_encode(
+                        np.ascontiguousarray(v).view(np.uint8), len(v),
+                        level=self.compresslevel)
+                    f.write(frame)
+                    nbytes += len(frame)
+            f.flush()
+        p.path = path
+        p.disk_bytes = nbytes
+        p.data = None
+        self._ram_bytes -= p.ram_bytes
+        memaccount.charge("spill", -p.ram_bytes, item=self.item)
+        _tier_add(ram=-p.ram_bytes, disk=nbytes)
+        p.ram_bytes = 0
+        counters.inc("spill_demote_total")
+
+    def _promote(self, p: _Pass) -> dict:
+        """Read one demoted pass back: -> {col id: (array, valids|None)}.
+        CRC verification rides the codec (CorruptionError on a torn
+        frame)."""
+        from greengage_tpu.storage import native
+
+        with open(p.path, "rb") as f:
+            buf = f.read()
+        out = {}
+        off = 0
+        for cid, dt, has_v in p.index:
+            raw, _n, used = native.block_decode(buf[off:])
+            off += used
+            a = np.frombuffer(raw, dtype=np.dtype(dt))
+            v = None
+            if has_v:
+                raw, _n, used = native.block_decode(buf[off:])
+                off += used
+                v = np.frombuffer(raw, dtype=np.uint8).astype(bool)
+            out[cid] = (a, v)
+        counters.inc("spill_promote_total")
+        return out
+
+    # ---- merge ---------------------------------------------------------
+    def assemble(self):
+        """Merge every pass into one preallocated buffer per column ->
+        (cols, valids), valids[c] None when every pass was all-valid.
+        Single-peak: each pass's tier bytes release as its rows land in
+        the merged buffer. Disk passes promote on the motion pipeline so
+        pass k+1's read+decode overlaps pass k's fill."""
+        from greengage_tpu.exec import motionpipe
+
+        total = sum(p.rows for p in self._passes)
+        dtypes = {}
+        for p in self._passes:
+            for cid, dt, _hv in p.index:
+                d = np.dtype(dt)
+                dtypes[cid] = (d if cid not in dtypes
+                               else np.result_type(dtypes[cid], d))
+        cols = {c.id: np.empty(total, dtype=dtypes.get(c.id, np.int64))
+                for c in self.cols_spec}
+        valids = {c.id: (np.ones(total, dtype=bool)
+                         if self._any_invalid[c.id] else None)
+                  for c in self.cols_spec}
+        offsets = []
+        off = 0
+        for p in self._passes:
+            offsets.append(off)
+            off += p.rows
+
+        def stage(p, _i):
+            return p.data if p.data is not None else self._promote(p)
+
+        def fill(data, p, i):
+            lo = offsets[i]
+            hi = lo + p.rows
+            for cid in cols:
+                a, v = data[cid]
+                cols[cid][lo:hi] = a
+                if valids[cid] is not None and v is not None:
+                    valids[cid][lo:hi] = v
+            self._release(p)
+            return None
+
+        motionpipe.run_pipeline(self._passes, stage, fill,
+                                settings=self.settings, label="workfile")
+        self._passes = []
+        nb = sum(int(a.nbytes) for a in cols.values())
+        nb += sum(int(v.nbytes) for v in valids.values() if v is not None)
+        memaccount.charge("spill", nb, item=self.item)
+        return cols, valids
+
+    def _release(self, p: _Pass) -> None:
+        if p.data is not None:
+            p.data = None
+            self._ram_bytes -= p.ram_bytes
+            memaccount.charge("spill", -p.ram_bytes, item=self.item)
+            _tier_add(ram=-p.ram_bytes)
+            p.ram_bytes = 0
+        if p.path is not None:
+            try:
+                os.unlink(p.path)
+            except OSError:
+                pass
+            _tier_add(disk=-p.disk_bytes)
+            p.path = None
+            p.disk_bytes = 0
+
+    def close(self) -> None:
+        """Release every retained pass (idempotent): uncharge RAM-tier
+        bytes, delete disk segments. Runs in the spill paths' finally so
+        an error (or cancellation) mid-schedule leaks nothing."""
+        if self._closed:
+            return
+        self._closed = True
+        for p in self._passes:
+            self._release(p)
+        self._passes = []

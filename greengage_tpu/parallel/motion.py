@@ -54,18 +54,51 @@ def _bucketize(arrs: dict, present, dest, nseg: int, capacity: int):
     return out, pbuf, overflow
 
 
-def redistribute(arrs: dict, present, dest, nseg: int, capacity: int):
+def _exchange(a, capacity: int, nbuckets: int):
+    """One array's all_to_all, optionally split into ``nbuckets``
+    independent sub-exchanges over capacity/nbuckets-row slices.
+
+    The split is row-order IDENTICAL to the monolithic exchange: bucket j
+    carries rows [j*sub, (j+1)*sub) of every destination's slot range, and
+    the stack/reshape below restores received position
+    [src * capacity + j * sub + r]. Its point is the device timeline —
+    XLA schedules the j+1 exchange's sends while the j exchange's receives
+    are still draining into dependents, extending the host-side pipelined
+    motion (exec/motionpipe.py) past the host/ICI boundary.
+    """
+    if nbuckets <= 1:
+        return lax.all_to_all(a, SEG_AXIS, split_axis=0, concat_axis=0,
+                              tiled=True)
+    nseg = a.shape[0] // capacity
+    sub = capacity // nbuckets
+    rest = a.shape[1:]
+    parts = a.reshape((nseg, nbuckets, sub) + rest)
+    outs = []
+    for j in range(nbuckets):
+        r = lax.all_to_all(
+            parts[:, j].reshape((nseg * sub,) + rest),
+            SEG_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        outs.append(r.reshape((nseg, sub) + rest))
+    return jnp.stack(outs, axis=1).reshape((nseg * capacity,) + rest)
+
+
+def redistribute(arrs: dict, present, dest, nseg: int, capacity: int,
+                 nbuckets: int = 1):
     """All-to-all exchange by per-row destination segment.
 
     -> (received arrs [nseg*capacity], received present, overflow scalar).
     The received layout: chunk j holds rows sent by segment j.
+    ``nbuckets > 1`` (motion_pipeline_buckets) splits the exchange into
+    that many sub-exchanges — identical rows, pipelined transfers.
     """
+    if nbuckets > 1 and capacity % nbuckets:
+        nbuckets = 1               # guard: only even splits preserve slots
     buckets, pbuf, overflow = _bucketize(arrs, present, dest, nseg, capacity)
     recv = {
-        name: lax.all_to_all(a, SEG_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        name: _exchange(a, capacity, nbuckets)
         for name, a in buckets.items()
     }
-    precv = lax.all_to_all(pbuf, SEG_AXIS, split_axis=0, concat_axis=0, tiled=True)
+    precv = _exchange(pbuf, capacity, nbuckets)
     # surface every segment's overflow everywhere (dispatcher error check)
     overflow = lax.pmax(overflow.astype(jnp.int32), SEG_AXIS) > 0
     return recv, precv, overflow

@@ -746,6 +746,54 @@ def _serve_one(db, ch) -> bool:
         except Exception as e:
             ch.ack(False, f"{type(e).__name__}: {e}")
         return True
+    if op == "sql_batch":
+        # one batched serving window (exec/batchserve.py): same two-phase
+        # contract as a classic statement — verify the window's plan hash
+        # (every member shares the shape; the first member's hash stands
+        # for the window), ack readiness, park for 'go', then run the
+        # batched program CONCURRENTLY with the coordinator's dispatch
+        faults.check("worker_ack")
+        sqls = msg.get("sqls") or []
+        try:
+            db.refresh()
+            want = msg.get("plan_hash")
+            if want and sqls:
+                got = db.plan_hash(sqls[0])
+                if got != want:
+                    raise RuntimeError(
+                        f"plan-hash mismatch: coordinator {want} vs "
+                        f"worker {got} — nondeterministic planning would "
+                        "desync the batched collectives")
+        except FaultError:
+            raise
+        except Exception as e:
+            ch.ack(False, f"{type(e).__name__}: {e}")
+            return True
+        ch.ack(True)
+        nxt = ch.recv(_worker_idle_timeout(db))   # gg:ok(interrupts)
+        if nxt.get("op") == "stop":
+            return False
+        if nxt.get("op") != "go":
+            return True        # coordinator skipped the window
+        from greengage_tpu.runtime.trace import TRACES
+
+        tr, _ = TRACES.enter(
+            None, sqls[0] if sqls else "batch",
+            enabled=bool(getattr(db.settings, "trace_enabled", True)))
+        try:
+            db.worker_sql_batch(sqls)
+        except Exception as e:
+            # incl. BatchFallback: the coordinator maps a not-ok
+            # completion ack to its own fallback, and the members'
+            # serial re-runs arrive as classic sql ops
+            TRACES.exit(tr)
+            ch.ack(False, f"{type(e).__name__}: {e}")
+            return True
+        spans = tr.export(limit=512) if tr is not None else None
+        TRACES.exit(tr)
+        faults.check("worker_ack")
+        ch.ack(True, spans=spans, process_id=db.multihost.process_id)
+        return True
     if op != "sql":
         return True
     # phase 1: refresh + plan + verify, ack readiness. A FaultError from
@@ -786,6 +834,10 @@ def _serve_one(db, ch) -> bool:
     tr, _ = TRACES.enter(
         None, msg["sql"],
         enabled=bool(getattr(db.settings, "trace_enabled", True)))
+    # record the spill pass/bucket schedule this side actually runs: it
+    # ships in the completion ack and the coordinator asserts it matches
+    # its own (exec/session._mh_spill_parity — lockstep verification)
+    db.executor.begin_spill_schedule()
     try:
         db.worker_sql(msg["sql"])
     except Exception as e:
@@ -797,5 +849,6 @@ def _serve_one(db, ch) -> bool:
     spans = tr.export(limit=512) if tr is not None else None
     TRACES.exit(tr)
     faults.check("worker_ack")
-    ch.ack(True, spans=spans, process_id=db.multihost.process_id)
+    ch.ack(True, spans=spans, process_id=db.multihost.process_id,
+           spill_schedule=db.executor.collect_spill_schedule())
     return True

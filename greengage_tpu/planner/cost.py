@@ -72,6 +72,27 @@ def current_calibration() -> dict:
 
 set_calibration(None)   # establish NS_GATHER_ROW .. NS_HOST_CALL globals
 
+# Pipelined-motion overlap credit on the redistribute branch of
+# motion_cost: with motion_pipeline on, the sub-exchange schedule
+# (parallel/motion.py _exchange) and the host bucket pipeline
+# (exec/motionpipe.py) hide part of each exchange behind neighboring
+# compute, so the planner should not price a redistribute as if the
+# device sat idle for the full transfer. Installed by the session from
+# the motion_pipeline* GUCs (same process-global pattern as
+# set_calibration — the SET broadcast keeps a multihost gang in
+# lockstep). 1.0 = no credit (pipeline off / single bucket).
+MOTION_PIPELINE_OVERLAP = 1.0
+
+
+def set_motion_overlap(factor) -> None:
+    """Install the redistribute overlap credit (0 < factor <= 1)."""
+    global MOTION_PIPELINE_OVERLAP
+    try:
+        f = float(factor)
+    except (TypeError, ValueError):
+        f = 1.0
+    MOTION_PIPELINE_OVERLAP = min(max(f, 0.25), 1.0)
+
 
 def _value_of(e):
     """Estimation value of a comparison operand: a literal's value, or a
@@ -241,7 +262,7 @@ def motion_cost(kind: str, rows: float, width: float, nseg: int) -> float:
         return rows * width * NS_ICI_BYTE * (s - 1) / s
     if kind == "gather":
         return NS_HOST_CALL + rows * width * NS_HOST_BYTE
-    return (rows / s) * width * NS_ICI_BYTE
+    return (rows / s) * width * NS_ICI_BYTE * MOTION_PIPELINE_OVERLAP
 
 
 def stream_cost(rows: float, width: float, nseg: int = 1) -> float:

@@ -66,6 +66,14 @@ FAULT_POINTS = frozenset({
     # the buffer is drained and before its intent commit (the mid-stream
     # kill window)
     "intent_stage", "intent_resolve", "ingest_flush",
+    # data-movement pipeline (exec/motionpipe.py, exec/workfile.py):
+    # motion_bucket fires inside every bucket's stage span — a 'sleep'
+    # injection widens stage(k+1) across compute(k) so the overlap test
+    # asserts pipelining from span timestamps, not wall-clock luck;
+    # spill_capture fires as each spill pass lands in the tiered
+    # workfile — an 'error' injection mid-schedule proves the disk tier's
+    # segment files are swept by the capture path's finally
+    "motion_bucket", "spill_capture",
 })
 
 

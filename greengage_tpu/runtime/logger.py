@@ -50,6 +50,10 @@ GAUGE_NAMES = (
     # streaming ingest plane (runtime/ingest.py): live stream sessions
     # and rows currently buffered host-side across them
     "ingest_active_streams", "ingest_buffered_rows",
+    # tiered spill workfile (exec/workfile.py): bytes currently retained
+    # in each tier across all spilling statements — host-RAM captured
+    # passes vs compressed disk segments awaiting promotion
+    "spill_tier_ram_bytes", "spill_tier_disk_bytes",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -123,6 +127,13 @@ COUNTER_NAMES = (
     "manifest_intent_swept_total",
     "ingest_batches_total", "ingest_rows_total", "ingest_shed_total",
     "ingest_resume_dedup_total",
+    # data-movement pipeline (exec/motionpipe.py, exec/workfile.py):
+    # realized stage(k+1) x compute(k) overlap milliseconds across
+    # bucketed schedules, tiered-workfile passes demoted to / promoted
+    # from the disk tier, and dead-process spill segments swept at
+    # recovery
+    "motion_overlap_ms", "spill_demote_total", "spill_promote_total",
+    "spill_orphan_sweep_total",
 )
 
 HISTOGRAM_NAMES = (

@@ -1,0 +1,258 @@
+"""Data-movement-optimal execution (ISSUE 18, docs/PERF.md "Data
+movement"): pipelined bucket schedules (exec/motionpipe.py), the tiered
+host-RAM -> disk spill workfile (exec/workfile.py), and the bucketed
+redistribute split (parallel/motion.py).
+
+The contract under test:
+  (a) bucketed redistribute — motion_pipeline_buckets splits the
+      compiled exchange into sub-exchanges with row-order-identical
+      results (the serial baseline and the cost-model-only
+      motion_pipeline=off path agree too);
+  (b) pipelining — bucket k+1's STAGE span overlaps bucket k's COMPUTE
+      span, asserted from trace timestamps (a sleep fault on the
+      motion_bucket point widens staging so the overlap is
+      deterministic, not wall-clock luck), and the realized overlap
+      lands in the motion_overlap_ms counter;
+  (c) disk tier — a spill whose captured passes exceed spill_host_limit_mb
+      by >4x completes oracle-equal via compressed segment files
+      (demote + promote counters move, nothing is left on disk);
+  (d) cleanup — an error mid-capture leaks no segment files (the spill
+      paths' finally closes the workfile), and Database init sweeps
+      segments orphaned by a killed process.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import greengage_tpu
+from greengage_tpu.exec import workfile as workfile_mod
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+from greengage_tpu.runtime.trace import TRACES
+
+
+@pytest.fixture(scope="module")
+def db(devices8):
+    d = greengage_tpu.connect(numsegments=4)
+    d.sql("create table dim (pk int, grp int) distributed by (pk)")
+    d.sql("insert into dim values " + ",".join(
+        f"({i},{i % 11})" for i in range(1, 501)))
+    d.sql("create table big (k int, fk int, v int) distributed by (k)")
+    n = 400_000
+    rng = np.random.default_rng(18)
+    d.load_table("big", {"k": np.arange(n),
+                         "fk": rng.integers(1, 501, n),
+                         "v": rng.integers(0, 100, n)})
+    d.sql("analyze")
+    yield d
+    faults.reset("motion_bucket")
+    faults.reset("spill_capture")
+
+
+Q = ("select grp, count(*), sum(v) from big join dim on big.fk = dim.pk "
+     "group by grp order by grp")
+# full-width sort: the captured runs are raw rows (~9 MB of int64
+# columns), so a 1 MB host tier must overflow to disk many times over
+QS = "select k, fk, v from big order by v, k limit 5"
+
+
+def _spill_files(directory):
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return [n for n in names if workfile_mod._FILE_RE.match(n)]
+
+
+# ---------------------------------------------------------------------
+# (a) bucketed redistribute oracle equality
+# ---------------------------------------------------------------------
+def test_bucketed_redistribute_matches_serial(db):
+    """Splitting the compiled redistribute into 4 sub-exchanges (a new
+    codegen signature -> a recompile) must be row-order identical, and
+    motion_pipeline=off (cost model only, same program) must agree."""
+    want = db.sql(Q).rows()
+    db.sql("set motion_pipeline_buckets = 4")
+    try:
+        assert db.sql(Q).rows() == want
+    finally:
+        db.sql("set motion_pipeline_buckets = 1")
+    db.sql("set motion_pipeline = off")
+    try:
+        assert db.sql(Q).rows() == want
+    finally:
+        db.sql("set motion_pipeline = on")
+
+
+# ---------------------------------------------------------------------
+# (b) stage(k+1) overlaps compute(k), from span timestamps
+# ---------------------------------------------------------------------
+def test_stage_overlaps_compute_trace_asserted(db):
+    """The bucketed dedupe merge runs on the bucket pipeline: while the
+    statement thread runs bucket k's DEVICE program, the stager builds
+    bucket k+1's host subset. The sleep fault inside every motion-stage
+    span holds each stage open 50 ms, so compute(k) — a multi-ms XLA
+    dispatch — must land INSIDE stage(k+1)'s window; asserted on
+    [ts, ts+dur] intersection in the statement trace, which shares one
+    clock across both threads."""
+    q = "select count(distinct k) from big"
+    db.sql(q)   # warm the spill-free program
+    db.sql("set vmem_protect_limit_mb = 1")
+    faults.inject("motion_bucket", "sleep", sleep_s=0.05, occurrences=-1)
+    c0 = counters.snapshot()
+    tr = None
+    try:
+        r = db.sql(q)
+        tr = TRACES.last()   # before the finally's SET becomes "last"
+        assert r.rows() == [(400_000,)]
+        assert r.stats.get("spill_merge_buckets", 0) >= 2, r.stats
+    finally:
+        faults.reset("motion_bucket")
+        db.sql("set vmem_protect_limit_mb = 12288")
+    d = counters.since(c0)
+    assert d.get("motion_overlap_ms", 0) >= 1, d
+
+    stages = tr.find_spans("motion-stage")
+    computes = tr.find_spans("motion-compute")
+    assert stages and computes, [s["name"] for s in tr.export()]
+    overlapped = False
+    for c in computes:
+        for s in stages:
+            if s["args"].get("label") != c["args"].get("label"):
+                continue
+            if s["args"].get("index") != c["args"].get("index") + 1:
+                continue
+            c_end = c["ts"] + (c["dur"] or 0.0)
+            s_end = s["ts"] + (s["dur"] or 0.0)
+            if s["ts"] < c_end and s_end > c["ts"]:
+                overlapped = True
+    assert overlapped, \
+        "no stage(k+1) span overlapped its compute(k) span"
+
+
+# ---------------------------------------------------------------------
+# (c) disk tier: >4x the host budget, oracle-equal, nothing left behind
+# ---------------------------------------------------------------------
+def test_disk_tier_spill_oracle_equal(db, tmp_path):
+    """spill_host_limit_mb=1 puts every multi-MB captured pass (the
+    workfile here is well over 4x the budget) through demote -> segment
+    file -> promote-on-merge; the rows must match the in-memory run
+    exactly and the statement must delete every segment it wrote."""
+    sdir = str(tmp_path / "spill")
+    want = db.sql(QS).rows()
+    db.sql(f"set spill_dir to '{sdir}'")
+    db.sql("set spill_host_limit_mb = 1")
+    db.sql("set vmem_protect_limit_mb = 1")
+    c0 = counters.snapshot()
+    try:
+        r = db.sql(QS)
+        assert r.stats.get("spill_kind") == "sort", r.stats
+        assert r.stats.get("spill_passes", 0) >= 2, r.stats
+        assert r.rows() == want
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+        db.sql("set spill_host_limit_mb = 512")
+        db.sql("set spill_dir to ''")
+    d = counters.since(c0)
+    assert d.get("spill_demote_total", 0) >= 1, d
+    assert d.get("spill_promote_total", 0) >= 1, d
+    assert _spill_files(sdir) == [], "statement leaked spill segments"
+    assert counters.get("spill_tier_disk_bytes") == 0
+
+
+def test_ram_only_mode_never_touches_disk(db, tmp_path):
+    """spill_host_limit_mb=0 is the pre-tiered behavior: the RAM tier
+    has no budget to overflow, so no segment file is ever written."""
+    sdir = str(tmp_path / "spill0")
+    want = db.sql(QS).rows()
+    db.sql(f"set spill_dir to '{sdir}'")
+    db.sql("set spill_host_limit_mb = 0")
+    db.sql("set vmem_protect_limit_mb = 1")
+    c0 = counters.snapshot()
+    try:
+        assert db.sql(QS).rows() == want
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+        db.sql("set spill_host_limit_mb = 512")
+        db.sql("set spill_dir to ''")
+    assert counters.since(c0).get("spill_demote_total", 0) == 0
+    assert not os.path.isdir(sdir) or _spill_files(sdir) == []
+
+
+# ---------------------------------------------------------------------
+# (d) cleanup: error mid-capture + orphan sweep
+# ---------------------------------------------------------------------
+def test_error_mid_capture_leaks_no_segments(db, tmp_path):
+    """Early passes demote to disk (1 MB budget), then the spill_capture
+    fault kills pass 4's capture: the statement fails with segments on
+    disk, but the spill path's finally closes the workfile and unlinks
+    every one of them."""
+    sdir = str(tmp_path / "spillerr")
+    db.sql(f"set spill_dir to '{sdir}'")
+    db.sql("set spill_host_limit_mb = 1")
+    db.sql("set vmem_protect_limit_mb = 1")
+    faults.inject("spill_capture", "error", start_after=3, occurrences=1)
+    c0 = counters.snapshot()
+    try:
+        with pytest.raises(Exception, match="fault injected"):
+            db.sql(QS)
+    finally:
+        faults.reset("spill_capture")
+        db.sql("set vmem_protect_limit_mb = 12288")
+        db.sql("set spill_host_limit_mb = 512")
+        db.sql("set spill_dir to ''")
+    # the premise held: segment files existed when the capture died
+    assert counters.since(c0).get("spill_demote_total", 0) >= 1
+    assert _spill_files(sdir) == [], "failed statement leaked segments"
+    assert counters.get("spill_tier_disk_bytes") == 0
+    # the engine still serves (and still spills) after the failure
+    db.sql("set vmem_protect_limit_mb = 1")
+    try:
+        assert db.sql(QS).stats.get("spill_passes", 0) >= 2
+    finally:
+        db.sql("set vmem_protect_limit_mb = 12288")
+
+
+def test_sweep_orphans_removes_only_dead_owners(tmp_path):
+    d = str(tmp_path)
+    # a genuinely dead pid: a subprocess that has already exited
+    dead = int(subprocess.run(
+        [sys.executable, "-c", "import os; print(os.getpid())"],
+        capture_output=True, text=True, check=True).stdout)
+    orphan = os.path.join(d, f"gg-spill-{dead}-1-deadbeef.wf")
+    live = os.path.join(d, f"gg-spill-{os.getpid()}-2-deadbeef.wf")
+    other = os.path.join(d, "not-a-spill-file.wf")
+    for p in (orphan, live, other):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    c0 = counters.snapshot()
+    assert workfile_mod.sweep_orphans(d) == 1
+    assert not os.path.exists(orphan)
+    assert os.path.exists(live) and os.path.exists(other)
+    assert counters.since(c0).get("spill_orphan_sweep_total", 0) == 1
+
+
+def test_connect_sweeps_orphans_at_init(tmp_path, devices8):
+    """A kill mid-pass leaves segments behind; the next coordinator
+    Database over the same cluster removes them at init."""
+    path = str(tmp_path / "cluster")
+    d1 = greengage_tpu.connect(path, numsegments=4)
+    sdir = workfile_mod.spill_dir_of(d1.settings, d1.store)
+    d1.close()
+    dead = int(subprocess.run(
+        [sys.executable, "-c", "import os; print(os.getpid())"],
+        capture_output=True, text=True, check=True).stdout)
+    os.makedirs(sdir, exist_ok=True)
+    orphan = os.path.join(sdir, f"gg-spill-{dead}-7-cafef00d.wf")
+    with open(orphan, "wb") as f:
+        f.write(b"orphaned segment")
+    d2 = greengage_tpu.connect(path, numsegments=4)
+    try:
+        assert not os.path.exists(orphan), \
+            "Database init did not sweep the orphaned segment"
+    finally:
+        d2.close()

@@ -1250,3 +1250,113 @@ def test_reform_rejoin_chaos_cycles(devices8, tmp_path):
         g1.close()
         g2.close()
         ch.close()
+
+
+# ---------------------------------------------------------------------------
+# multihost serving parity (ISSUE 18): a 2-process gang batch-serves
+# concurrent same-shape statements through ONE broadcast window per
+# dispatch — members_total > dispatch_total proves the amortization
+# happened on the gang, not just on a single host
+# ---------------------------------------------------------------------------
+
+COORD_BATCH_SCRIPT = r"""
+import json, os, sys, threading
+port, cport, path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.environ["GGTPU_REPO"])
+from greengage_tpu.parallel.multihost import init_multihost
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
+import greengage_tpu
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
+db = greengage_tpu.connect(path, multihost=mh)
+out = {}
+db.sql("create table t (k int, a int, v int) distributed by (k)")
+db.sql("insert into t values " + ",".join(
+    f"({i},{i},{i % 7})" for i in range(3000)))
+db.sql("analyze")
+def q(i):
+    return f"select count(*), sum(v) from t where a > {i}"
+# serial oracle BEFORE batching turns on (classic lockstep dispatch)
+oracle = {i: [[int(x) for x in row] for row in db.sql(q(i)).rows()]
+          for i in range(8)}
+db.sql("set batch_serving_enabled = on")
+db.sql("set batch_window_ms = 150")
+db.sql(q(100))   # warm: plan cache + the width-1 bucket via the gang path
+# hold the first dispatch on the "device" so a real multi-member window
+# accumulates behind it (both processes sleep in their concurrent dispatch)
+faults.inject("batch_dispatch", "sleep", sleep_s=0.4, occurrences=1)
+c0 = counters.snapshot()
+results, errors = {}, {}
+def member(i):
+    try:
+        results[i] = [[int(x) for x in row] for row in db.sql(q(i)).rows()]
+    except Exception as e:
+        errors[i] = repr(e)
+ts = [threading.Thread(target=member, args=(i,)) for i in range(8)]
+for t in ts:
+    t.start()
+for t in ts:
+    t.join(timeout=120)
+d = counters.since(c0)
+out["alive"] = sum(1 for t in ts if t.is_alive())
+out["errors"] = errors
+out["mismatch"] = [i for i in range(8) if results.get(i) != oracle[i]]
+out["members"] = d.get("batch_members_total", 0)
+out["dispatch"] = d.get("batch_dispatch_total", 0)
+out["fallback"] = d.get("batch_fallback_total", 0)
+# post-canary lockstep sanity: the gang still serves classic statements
+r = db.sql("select count(*) from t")
+out["post"] = int(r.rows()[0][0])
+out["post_segments"] = r.stats.get("segments")
+mh.channel.close()
+print("RESULT:" + json.dumps(out), flush=True)
+"""
+
+
+def test_two_process_gang_batch_serving_canary(tmp_path):
+    port, cport = _free_port(), _free_port()
+    path = str(tmp_path / "cluster")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    })
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
+         "-d", path, "--coordinator", f"127.0.0.1:{port}",
+         "--control-port", str(cport), "--num-processes", "2",
+         "--process-id", "1", "--no-distributed"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    coord = subprocess.Popen(
+        [sys.executable, "-c", COORD_BATCH_SCRIPT, str(port), str(cport),
+         path],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        cout, _ = coord.communicate(timeout=480)
+        wout, _ = worker.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        coord.kill()
+        worker.kill()
+        cout = coord.stdout.read() if coord.stdout else ""
+        wout = worker.stdout.read() if worker.stdout else ""
+        raise AssertionError(
+            f"batch canary timeout\ncoordinator:\n{cout}\nworker:\n{wout}")
+    assert coord.returncode == 0, f"coordinator:\n{cout}\nworker:\n{wout}"
+    res = [ln for ln in cout.splitlines() if ln.startswith("RESULT:")]
+    assert res, f"coordinator:\n{cout}\nworker:\n{wout}"
+    out = json.loads(res[0][len("RESULT:"):])
+    assert out["alive"] == 0, out
+    assert out["errors"] == {}, out
+    assert out["mismatch"] == [], out
+    # the canary property: the gang amortized members across dispatches
+    assert out["members"] > out["dispatch"], out
+    assert out["members"] >= 8, out
+    assert out["fallback"] == 0, out
+    # and classic lockstep service survived the batched windows
+    assert out["post"] == 3000, out
+    assert out["post_segments"] == 8, out
