@@ -123,6 +123,15 @@ THREAD_ROLES: dict[str, Role] = {
         spawns=(("runtime/fts.py", "loop"),),
         entries=(("runtime/fts.py", "", "loop"),),
     ),
+    "standby-watch": Role(
+        "standby-watch",
+        "coordinator-failover watcher daemon (runtime/standby.py "
+        "StandbyWatcher): pulls the primary's commit tail into the "
+        "standby, tracks the liveness beat, and fences + promotes when "
+        "the primary is silent past standby_promote_deadline_s",
+        spawns=(("runtime/standby.py", "loop"),),
+        entries=(("runtime/standby.py", "StandbyWatcher", "loop"),),
+    ),
     "heartbeat": Role(
         "heartbeat",
         "multihost idle ping/pong heartbeat over the coordinator "
@@ -169,6 +178,7 @@ ROLE_NAME_PREFIXES: tuple = (
     ("gg-gpfdist", "ingest"),
     ("gg-ingest-flush", "ingest"),
     ("fts-prober", "fts"),
+    ("gg-standby-watch", "standby-watch"),
     ("mh-heartbeat", "heartbeat"),
     ("mh-rejoin-accept", "rejoin"),
 )

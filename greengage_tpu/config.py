@@ -160,6 +160,21 @@ class Settings:
     # redial the kept listener before adopting whoever arrived.
     mh_reform_enabled: bool = True
     mh_reform_deadline_s: float = 10.0
+    # coordinator failover (docs/ROBUSTNESS.md "Coordinator failover"):
+    # mh_coordinator_addrs is the ordered "host:port,host:port" list a
+    # worker's CoordinatorLost redial walks — first the address it was
+    # launched against, then the standby's listener — so a promoted
+    # standby adopts the surviving gang without any process restart
+    # (empty = redial the launch address only, the legacy behavior).
+    # The standby watcher (`gg standby --watch`) pull-syncs the primary's
+    # commit tail every standby_watch_interval_s and auto-promotes once
+    # the primary's liveness beat has been silent past
+    # standby_promote_deadline_s (the gp_fts_probe_timeout analog for the
+    # coordinator itself; promotion fences the old primary first, so a
+    # paused-not-dead coordinator cannot split-brain).
+    mh_coordinator_addrs: str = ""
+    standby_promote_deadline_s: float = 15.0
+    standby_watch_interval_s: float = 1.0
     # per-table delta manifests (storage/manifest.py): fold the delta
     # backlog into the root snapshot once it reaches this many commits
     # (the checkpoint_segments analog); 0 folds on every commit

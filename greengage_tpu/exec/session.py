@@ -202,6 +202,18 @@ class Database:
         if not is_worker:
             # topology gauge (asserted by the reform tests; `gg ps` shows it)
             _counters.set("mh_topology_version", self.catalog.segments.version)
+            # coordinator liveness beat (runtime/standby.py): stamp at
+            # init so a registered standby's watcher sees this primary
+            # alive before its first commit; the post-commit hook and the
+            # FTS prober cadence keep it fresh thereafter
+            from greengage_tpu.runtime import standby as _standby
+
+            if _standby.registered_standby(self.path) is not None:
+                _standby.primary_beat(self.path,
+                                      self.catalog.segments.version)
+                # the probe cadence re-stamps the beat while idle, so an
+                # idle-but-alive primary never looks dead to the watcher
+                self.fts.start()
         from greengage_tpu.runtime.logger import ClusterLog
 
         # elog/syslogger analog: CSV logs under <cluster>/log (mined by
@@ -623,7 +635,9 @@ class Database:
         segs = self.catalog.segments
         if self.multihost is None or self.multihost.channel is None \
                 or not self.multihost.is_coordinator:
-            return {"state": "local", "topology_version": segs.version}
+            out = {"state": "local", "topology_version": segs.version}
+            self._mh_state_standby(out)
+            return out
         ch = self.multihost.channel
         if getattr(self, "_mh_degraded", None):
             state = "degraded"
@@ -637,7 +651,25 @@ class Database:
                                   if hasattr(ch, "active_ids") else None)}
         if getattr(self, "_mh_degraded", None):
             out["reason"] = self._mh_degraded
+        self._mh_state_standby(out)
         return out
+
+    def _mh_state_standby(self, out: dict) -> None:
+        """Attach the registered standby's replication health (path, lag
+        in commits, cumulative ship failures) so `gg ps` / the status
+        frame surface a silently-failing sync instead of hiding it."""
+        from greengage_tpu.runtime import standby as _standby
+        from greengage_tpu.runtime.logger import counters as _c
+
+        sb = _standby.registered_standby(self.path)
+        if sb is None:
+            return
+        out["standby"] = {
+            "path": sb,
+            "lag_commits": _standby.lag(self.path),
+            "sync_fail_total": int(_c.snapshot().get(
+                "standby_sync_fail_total", 0)),
+        }
 
     def _mh_distributed_active(self) -> bool:
         """True when a jax.distributed data plane is live: its global mesh
@@ -1751,8 +1783,12 @@ class Database:
                     self.path, self.store)
             except Exception as e:
                 self.log.error("archive", f"archiving failed: {e}")
-        # standby master (gpinitstandby): ship the committed coordinator
-        # state; a failing sync logs and never fails the write
+        # standby master (gpinitstandby): ship the committed tail; a
+        # failing sync logs, counts, and widens the lag gauge — but never
+        # fails the write (async-standby semantics). The liveness beat is
+        # stamped either way so the watcher distinguishes "primary alive
+        # but shipping fails" (lag grows, no promotion) from "primary
+        # silent" (promotion after standby_promote_deadline_s).
         from greengage_tpu.runtime import standby as _standby
 
         sb = _standby.registered_standby(self.path)
@@ -1761,6 +1797,10 @@ class Database:
                 _standby.sync(self.path, sb)
             except Exception as e:
                 self.log.error("standby", f"standby sync failed: {e}")
+                _standby.note_sync_failure(self.path)
+            _standby.primary_beat(self.path,
+                                  self.catalog.segments.version)
+            self.fts.start()    # idempotent: idle-cadence beat coverage
         if self.replicator is None:
             return
         if self.settings.mirror_sync:

@@ -25,6 +25,7 @@ import signal
 import sys
 import tarfile
 import tempfile
+import threading
 import time
 
 
@@ -159,6 +160,94 @@ def cmd_activatestandby(args):
     st = standby.activate(args.standby, args.data)
     print(f"standby activated (manifest v{st.get('synced_version', '?')}); "
           f"connect to {args.standby}")
+    return 0
+
+
+def cmd_standby(args):
+    """Coordinator-failover control plane (docs/ROBUSTNESS.md "Coordinator
+    failover"): default prints the standby's sync status and replication
+    lag; --watch runs the heartbeat watcher that auto-promotes on primary
+    silence; --promote fences the old primary and promotes immediately;
+    --unfence clears a fence after a recovered primary has been verified
+    (manual escape hatch — never automatic)."""
+    from greengage_tpu.runtime import standby
+
+    if args.unfence:
+        owner = standby.fenced(args.unfence)
+        if owner is None:
+            print(f"no fence at {args.unfence}")
+            return 0
+        standby.clear_fence(args.unfence)
+        print(f"fence cleared at {args.unfence} "
+              f"(was held by {owner.get('standby', '?')})")
+        return 0
+    if not args.standby:
+        print("error: -s/--standby is required (or --unfence CLUSTER)",
+              file=sys.stderr)
+        return 1
+    if args.promote:
+        st = standby.promote(args.standby, args.data, reason="operator")
+        promoted = st.get("promoted") or {}
+        print(f"standby promoted (manifest v{st.get('synced_version', '?')}, "
+              f"topology v{promoted.get('topology_version', '?')}); "
+              f"connect to {args.standby}")
+        return 0
+    if args.watch:
+        from greengage_tpu.config import Settings
+
+        s = Settings()
+        # cadence GUCs ride the cluster's settings.json (standby copy
+        # first, primary's as fallback — they are synced post-commit)
+        st0 = standby.status(args.standby)
+        for root in (args.standby, st0.get("primary")):
+            sp = os.path.join(root, "settings.json") if root else None
+            if sp and os.path.exists(sp):
+                try:
+                    with open(sp) as f:
+                        for k, v in json.load(f).items():
+                            try:
+                                s.set(k, v)
+                            except ValueError:
+                                pass
+                except (OSError, ValueError):
+                    pass
+                break
+        interval = args.interval if args.interval is not None \
+            else s.standby_watch_interval_s
+        deadline = args.deadline if args.deadline is not None \
+            else s.standby_promote_deadline_s
+        done = threading.Event()
+        w = standby.StandbyWatcher(
+            args.standby, interval_s=interval, deadline_s=deadline,
+            data_path=args.data, on_promote=lambda st: done.set())
+        print(f"watching primary from {args.standby} "
+              f"(interval {interval:g}s, promote deadline {deadline:g}s)")
+        w.start()
+        try:
+            while not done.wait(timeout=0.5):
+                pass
+            print(f"primary silent past {deadline:g}s — standby promoted; "
+                  f"connect to {args.standby}")
+        except KeyboardInterrupt:
+            print("watch stopped")
+        finally:
+            w.stop()
+        return 0
+    st = standby.status(args.standby)
+    print(f"standby: {args.standby}")
+    print(f"  role: {st.get('role', '?')}  synced to manifest "
+          f"v{st.get('synced_version', '?')}")
+    primary = st.get("primary")
+    if primary and st.get("role") == "standby":
+        lag = standby.lag(primary)
+        age = standby.beat_age(primary)
+        beat = "never" if age == float("inf") else f"{age:.1f}s ago"
+        print(f"  primary: {primary}  lag: {lag} commit(s)  "
+              f"last beat: {beat}")
+        owner = standby.fenced(primary)
+        if owner is not None:
+            print(f"  FENCED by {owner.get('standby', '?')} "
+                  f"({owner.get('reason', '?')})")
     return 0
 
 
@@ -853,6 +942,13 @@ def cmd_ps(args):
                   f" stage-pool {pipe.get('staging_pool_queue_depth', 0)}")
         print(f"cluster: {cl.get('state', '?')}  "
               f"topology v{cl.get('topology_version', '?')}{gang}{pq}")
+        # standby replication health (docs/ROBUSTNESS.md "Coordinator
+        # failover"): a growing lag means promotion would lose commits
+        sb = cl.get("standby") or {}
+        if sb:
+            print(f"standby: {sb.get('path', '?')}  "
+                  f"lag {sb.get('lag_commits', '?')} commit(s)  "
+                  f"sync failures {sb.get('sync_fail_total', 0)}")
     # overload state (docs/ROBUSTNESS.md "Overload protection"): a
     # browned-out engine is serving degraded on purpose — say so before
     # anyone reads the statement list as a performance bug
@@ -1347,6 +1443,25 @@ def main(argv=None):
                    help="surviving data directory to link (defaults to the "
                         "primary's if still reachable)")
     p.set_defaults(fn=cmd_activatestandby)
+
+    p = sub.add_parser("standby")   # failover control plane
+    p.add_argument("-s", "--standby", default=None,
+                   help="standby coordinator directory")
+    p.add_argument("--watch", action="store_true",
+                   help="heartbeat the primary; auto-promote on silence")
+    p.add_argument("--promote", action="store_true",
+                   help="fence the primary and promote immediately")
+    p.add_argument("--interval", type=float, default=None,
+                   help="watch poll interval (default: standby_watch_interval_s)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="promote after this many seconds of primary "
+                        "silence (default: standby_promote_deadline_s)")
+    p.add_argument("--data", default=None,
+                   help="surviving data directory to link on promotion")
+    p.add_argument("--unfence", default=None, metavar="CLUSTER",
+                   help="clear a promotion fence on CLUSTER (operator "
+                        "escape hatch after verifying the old primary)")
+    p.set_defaults(fn=cmd_standby)
 
     p = sub.add_parser("replicate")
     p.add_argument("-d", "--dir", required=True)

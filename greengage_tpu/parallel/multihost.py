@@ -49,6 +49,7 @@ import subprocess
 
 from greengage_tpu.runtime import interrupt
 from greengage_tpu.runtime.faultinject import FaultError, faults
+from greengage_tpu.runtime.logger import counters
 from greengage_tpu.runtime.retry import (Deadline, RetryPolicy,
                                          TRANSIENT_ERRORS)
 
@@ -556,11 +557,47 @@ class WorkerChannel:
         self._connect_deadline = connect_deadline
         self._dial(rejoin=False)
 
-    def _dial(self, rejoin: bool) -> None:
-        limit = _limit(self.settings,
-                       self._connect_deadline
-                       if self._connect_deadline is not None
-                       else "mh_connect_deadline")
+    @staticmethod
+    def parse_addrs(spec: str) -> list:
+        """'host:port,host:port' -> [(host, port)], order preserved;
+        malformed entries are dropped (a worker must never crash on a
+        broadcast GUC value)."""
+        out = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, _, port_s = part.rpartition(":")
+            try:
+                hp = (host or "127.0.0.1", int(port_s))
+            except ValueError:
+                continue
+            if hp not in out:
+                out.append(hp)
+        return out
+
+    def candidate_addrs(self) -> list:
+        """Ordered redial candidates: the CURRENT coordinator address
+        first (gang re-formation rejoins the same kept listener), then
+        every mh_coordinator_addrs entry in its declared order — the
+        standby listener(s) a promoted coordinator answers on."""
+        cands = [(self.host, self.port)]
+        spec = (getattr(self.settings, "mh_coordinator_addrs", "")
+                if self.settings is not None else "")
+        for hp in self.parse_addrs(spec):
+            if hp not in cands:
+                cands.append(hp)
+        return cands
+
+    def _dial(self, rejoin: bool, host: str | None = None,
+              port: int | None = None, limit: float | None = None) -> None:
+        host = self.host if host is None else host
+        port = self.port if port is None else port
+        if limit is None:
+            limit = _limit(self.settings,
+                           self._connect_deadline
+                           if self._connect_deadline is not None
+                           else "mh_connect_deadline")
         # at STARTUP a refused connect means the coordinator's listener is
         # not up yet — retry. At REJOIN the listener predates us (quiesce
         # keeps it open), so refused means the coordinator process itself
@@ -572,11 +609,11 @@ class WorkerChannel:
                           retryable=retryable)
         try:
             self._sock = pol.call(lambda: socket.create_connection(
-                (self.host, self.port), timeout=min(10.0, limit)))
+                (host, port), timeout=min(10.0, limit)))
         except OSError as e:
             raise ConnectionError(
-                f"cannot reach coordinator within {limit:.0f}s "
-                f"mh_connect_deadline: {e}")
+                f"cannot reach coordinator at {host}:{port} within "
+                f"{limit:.0f}s mh_connect_deadline: {e}")
         self._sock.settimeout(None)
         self._f = self._sock.makefile("rwb")
         self._f.write((json.dumps(
@@ -614,13 +651,32 @@ class WorkerChannel:
 
     def reconnect(self) -> bool:
         """Bounded re-dial + hello after a lost coordinator connection
-        (the gang-rejoin dial). False once mh_connect_deadline is spent."""
+        (the gang-rejoin dial), walking the ordered candidate list: the
+        current address first (gang re-formation), then each
+        mh_coordinator_addrs entry — landing on a DIFFERENT address is a
+        re-home to a promoted standby (mh_rehome_total). False once
+        every candidate has burned its share of mh_connect_deadline:
+        all addresses dead."""
         self.close()
-        try:
-            self._dial(rejoin=True)
+        cands = self.candidate_addrs()
+        limit = _limit(self.settings,
+                       self._connect_deadline
+                       if self._connect_deadline is not None
+                       else "mh_connect_deadline")
+        per = max(0.5, limit / max(1, len(cands)))
+        for host, port in cands:
+            try:
+                self._dial(rejoin=True, host=host, port=port, limit=per)
+            except (ConnectionError, OSError):
+                continue
+            if (host, port) != (self.host, self.port):
+                counters.inc("mh_rehome_total")
+                print(f"worker {self.process_id}: re-homed to promoted "
+                      f"coordinator {host}:{port}",
+                      file=sys.stderr, flush=True)
+                self.host, self.port = host, port
             return True
-        except (ConnectionError, OSError):
-            return False
+        return False
 
     def close(self):
         for obj in (getattr(self, "_f", None), getattr(self, "_sock", None)):
@@ -663,8 +719,11 @@ def worker_loop(db) -> None:
                   f"connection lost: {e}; attempting rejoin",
                   file=sys.stderr, flush=True)
             if not ch.reconnect():
-                print(f"worker {db.multihost.process_id}: coordinator "
-                      "unreachable within mh_connect_deadline — exiting",
+                addrs = ", ".join(f"{h}:{p}"
+                                  for h, p in ch.candidate_addrs())
+                print(f"worker {db.multihost.process_id}: no coordinator "
+                      f"reachable at [{addrs}] within "
+                      "mh_connect_deadline — exiting",
                       file=sys.stderr, flush=True)
                 return
             print(f"worker {db.multihost.process_id}: reconnected; "

@@ -74,6 +74,17 @@ FAULT_POINTS = frozenset({
     # workfile — an 'error' injection mid-schedule proves the disk tier's
     # segment files are swept by the capture path's finally
     "motion_bucket", "spill_capture",
+    # coordinator failover (runtime/standby.py, storage/manifest.py):
+    # standby_ship fires at the top of every tail sync — an 'error'
+    # injection is a ship failure (lag grows, standby_sync_fail_total
+    # counts), a 'sleep' widens the window between a primary commit and
+    # its ship; coordinator_fence fires inside the fence check at every
+    # manifest commit point — a 'sleep' parks a stale primary's commit
+    # across a promotion so the split-brain race is deterministic;
+    # standby_promote fires at the head of promote(), before the fence is
+    # written — occurrence/start_after targeting pins any crash window in
+    # the detect -> fence -> sync -> activate -> recover state machine
+    "standby_ship", "coordinator_fence", "standby_promote",
 })
 
 

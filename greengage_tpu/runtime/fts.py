@@ -54,6 +54,16 @@ class FtsProber:
                     Replicator(self.store, self.config).refresh_sync_state()
                 self.config.mark_down(entry.content)
         self.probe_count += 1
+        # coordinator liveness beat (runtime/standby.py): the probe
+        # cadence keeps the beat fresh BETWEEN commits, so an idle-but-
+        # alive primary is never mistaken for a dead one by the standby
+        # watcher — the coordinator heartbeats itself the way it probes
+        # its segments
+        if self.store is not None:
+            from greengage_tpu.runtime import standby as _standby
+
+            if _standby.registered_standby(self.store.root) is not None:
+                _standby.primary_beat(self.store.root, self.config.version)
         if self.config.version != before:
             # dispatch consumes the FTS version (mesh re-formation, cached
             # topology invalidation): keep the gauge current on promotion

@@ -54,6 +54,10 @@ GAUGE_NAMES = (
     # in each tier across all spilling statements — host-RAM captured
     # passes vs compressed disk segments awaiting promotion
     "spill_tier_ram_bytes", "spill_tier_disk_bytes",
+    # coordinator failover (runtime/standby.py): committed versions on
+    # the primary not yet shipped to the registered standby — 0 while
+    # the tail sync keeps up, grows while shipping fails
+    "standby_lag_commits",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -134,6 +138,14 @@ COUNTER_NAMES = (
     # recovery
     "motion_overlap_ms", "spill_demote_total", "spill_promote_total",
     "spill_orphan_sweep_total",
+    # coordinator failover (runtime/standby.py, parallel/multihost.py):
+    # standby tail-sync ship failures (files the post-commit/watcher sync
+    # could NOT ship — the formerly-silent OSError swallow), standby
+    # promotions (watcher-automatic or `gg standby --promote`), and
+    # workers re-homed to a non-launch coordinator address after
+    # CoordinatorLost (the redial walked mh_coordinator_addrs and landed
+    # on the promoted standby)
+    "standby_sync_fail_total", "standby_promote_total", "mh_rehome_total",
 )
 
 HISTOGRAM_NAMES = (

@@ -91,6 +91,20 @@ _WATCH_FATAL_ERRNOS = frozenset({
 _WATCH_TRANSIENT_LIMIT = 5
 
 
+def _is_failover_error(e: BaseException) -> bool:
+    """Coordinator-failover causes deserving the typed retryable 57P01
+    frame: a stale fenced coordinator's refused commit, or the gang's
+    coordinator channel dying out from under a dispatched statement.
+    One causal hop is checked too — the session wraps commit errors."""
+    from greengage_tpu.parallel.multihost import CoordinatorLost
+    from greengage_tpu.storage.manifest import CoordinatorFenced
+
+    kinds = (CoordinatorFenced, CoordinatorLost)
+    if isinstance(e, kinds):
+        return True
+    return isinstance(e.__cause__ or e.__context__, kinds)
+
+
 def _watch_tick(sock) -> str:
     """One disconnect-watch poll of the client socket. Returns:
     ``eof``   — the peer closed (or our fd is gone): the statement has
@@ -503,7 +517,21 @@ class SqlServer:
                                     "sqlstate": "53300",
                                     "retryable": True}
                         except Exception as e:  # per-statement isolation
-                            resp = {"ok": False, "error": f"{e}"}
+                            if _is_failover_error(e):
+                                # coordinator failover (docs/ROBUSTNESS.md
+                                # "Coordinator failover"): the statement
+                                # died because this coordinator was fenced
+                                # by a promoted standby or lost its gang
+                                # mid-failover — typed + retryable, the
+                                # SQLSTATE 57P01 admin-shutdown analog;
+                                # the client retries against the promoted
+                                # coordinator's address
+                                resp = {"ok": False, "error": f"{e}",
+                                        "code": "coordinator_failover",
+                                        "sqlstate": "57P01",
+                                        "retryable": True}
+                            else:
+                                resp = {"ok": False, "error": f"{e}"}
                         try:
                             self.wfile.write(
                                 (json.dumps(resp) + "\n").encode())
@@ -616,7 +644,7 @@ class SqlServer:
                         if k.startswith(("mh_", "manifest_", "batch_",
                                          "server_", "connections_",
                                          "admission_", "brownout",
-                                         "frames_"))}
+                                         "frames_", "standby_"))}
                     st["counters"].update({
                         k: v for k, v in _c.snapshot().items()
                         if k.startswith("ingest_")})

@@ -1,31 +1,65 @@
 """Master standby — the gpinitstandby / gpactivatestandby analog
-(reference: gpMgmt/bin/gpinitstandby:1, gpactivatestandby:1).
+(reference: gpMgmt/bin/gpinitstandby:1, gpactivatestandby:1), grown into
+the automatic coordinator-failover plane (docs/ROBUSTNESS.md
+"Coordinator failover").
 
 The coordinator's durable state is small and file-shaped: catalog.json
-(schemas/topology/stats), manifest.json (the distributed commit record),
+(schemas/topology/stats), manifest.json + commits.log + deltas/ +
+intents/ (the distributed commit record, storage/manifest.py),
 append-only dictionary files, and calibration.json. A standby is a
-directory holding a continuously-synced copy of exactly that state:
-``init_standby`` seeds it, every committed write ships the new
-manifest+catalog (``sync``, called from the session's post-commit hook,
-like WAL shipping to the standby master), and ``activate`` promotes the
-copy to a servable cluster directory — pointed at the surviving segment
-data trees, which mirrors (runtime/replication.py) protect separately.
-A failing sync logs and never fails the write (async-standby semantics);
-``gg state`` surfaces the lag."""
+directory holding a continuously-tailed RAW copy of exactly that state:
+``init_standby`` seeds it, every committed write ships the tail from the
+session's post-commit hook (``sync``, like WAL shipping to the standby
+master), and the watcher daemon (``gg standby --watch``) pull-syncs on a
+cadence and auto-promotes when the primary's liveness beat goes silent.
+
+Ship order inside one sync (the WAL commit-point-last rule):
+dictionaries -> commits.log tail -> delta files -> intent mirror ->
+calibration/catalog -> the RAW root manifest.json LAST. The root is
+shipped raw (NOT the composed snapshot): the root carries delta_seqs /
+intent_seqs / log_pos, so root + shipped log + shipped delta files
+compose on the standby to exactly the primary's committed state, and —
+critically — the promoted standby's ``recover()`` sees honest in-doubt
+evidence (staged-but-uncommitted claims and intent markers roll back
+there exactly as they would on a restarted primary). Shipping a composed
+root next to a raw log would double-apply every logged commit.
+
+A failing sync logs, counts (``standby_sync_fail_total``), widens the
+``standby_lag_commits`` gauge, and never fails the write (async-standby
+semantics). Promotion is fence-first: the standby links an exclusive
+``coordinator.fence`` claim into the PRIMARY cluster dir before touching
+anything else, and every manifest commit point re-verifies it — a
+paused-not-dead primary wakes to CoordinatorFenced, never split-brain.
+"""
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import tempfile
+import threading
+import time
+
+from greengage_tpu.runtime.faultinject import faults
+from greengage_tpu.runtime.logger import counters
 
 MARKER = "standby.json"
 PRIMARY_MARKER = "standby_registered.json"
+# liveness beat the primary stamps (Database init, every post-commit,
+# the FTS prober cadence); the standby watcher reads its age
+BEAT = "coordinator.alive"
+# the promotion fence: an exclusive hard-link claim the promoting
+# standby places in the PRIMARY cluster dir (the atomic-token
+# discipline storage/manifest.py uses for delta claims); the old
+# primary re-verifies it inside every locked commit point
+FENCE = "coordinator.fence"
 
 # manifest.json LAST: it is the commit record — if the sync dies midway,
-# the standby's manifest must never be newer than the dictionaries it
-# references (the WAL commit-point-last rule)
-_META_FILES = ("calibration.json", "catalog.json", "manifest.json")
+# the standby's root must never be newer than the log/deltas/dictionaries
+# it references (the WAL commit-point-last rule)
+_META_FILES = ("settings.json", "calibration.json", "catalog.json",
+               "manifest.json")
 
 
 def _copy_file(src: str, dst: str) -> None:
@@ -34,81 +68,176 @@ def _copy_file(src: str, dst: str) -> None:
     _atomic_copy(src, dst)
 
 
-def _sync_meta(cluster_path: str, standby_path: str) -> None:
-    # dictionaries first (append-only: re-copy only the ones that grew)
+def _write_json(dir_path: str, final_path: str, obj: dict,
+                fsync: bool = True) -> None:
+    fd, tmp = tempfile.mkstemp(dir=dir_path, prefix=".standby")
+    with os.fdopen(fd, "w") as f:
+        json.dump(obj, f, indent=1)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, final_path)
+
+
+# ---- raw tail shipping -------------------------------------------------
+
+def _sync_dicts(cluster_path: str, standby_path: str) -> int:
+    """Append-only dictionary files: re-copy only the ones that grew.
+    Returns the number of files that FAILED to ship."""
+    fails = 0
     data = os.path.join(cluster_path, "data")
-    if os.path.isdir(data):
-        for tdir in os.listdir(data):
-            src_dir = os.path.join(data, tdir)
-            if not os.path.isdir(src_dir):
+    if not os.path.isdir(data):
+        return 0
+    for tdir in os.listdir(data):
+        src_dir = os.path.join(data, tdir)
+        if not os.path.isdir(src_dir):
+            continue
+        for fn in os.listdir(src_dir):
+            if not fn.startswith("dict_"):
                 continue
-            for fn in os.listdir(src_dir):
-                if not fn.startswith("dict_"):
-                    continue
-                src = os.path.join(src_dir, fn)
-                dst = os.path.join(standby_path, "data", tdir, fn)
-                try:
-                    if (not os.path.exists(dst)
-                            or os.path.getsize(dst) != os.path.getsize(src)):
-                        _copy_file(src, dst)
-                except OSError:
-                    pass
+            src = os.path.join(src_dir, fn)
+            dst = os.path.join(standby_path, "data", tdir, fn)
+            try:
+                if (not os.path.exists(dst)
+                        or os.path.getsize(dst) != os.path.getsize(src)):
+                    _copy_file(src, dst)
+            except OSError:
+                fails += 1
+    return fails
+
+
+def _sync_log_tail(cluster_path: str, standby_path: str,
+                   marker: dict) -> None:
+    """Ship the commits.log tail incrementally. ``marker['log_offset']``
+    is the shipped-byte watermark; the primary's log only ever appends
+    during a process lifetime (recover()'s compaction truncate runs at
+    exclusive-open startup only), so a shrink means the primary
+    restarted-and-compacted and the whole log is recopied. A tail read
+    that catches a torn in-flight append is safe: the byte watermark
+    advances exactly past what was shipped, so the remainder of the line
+    arrives on the next sync and the standby's composed state simply
+    lags one commit (torn tails end the committed prefix)."""
+    src = os.path.join(cluster_path, "commits.log")
+    dst = os.path.join(standby_path, "commits.log")
+    try:
+        src_size = os.path.getsize(src)
+    except OSError:
+        src_size = 0
+    shipped = int(marker.get("log_offset", 0))
+    try:
+        dst_size = os.path.getsize(dst)
+    except OSError:
+        dst_size = 0
+    if src_size < shipped or dst_size != shipped:
+        # primary compacted (restart recovery) or the standby copy
+        # diverged from the watermark: recopy from byte zero
+        shipped = 0
+        try:
+            os.remove(dst)
+        except OSError:
+            pass
+    if src_size > shipped:
+        with open(src, "rb") as f:
+            f.seek(shipped)
+            tail = f.read(src_size - shipped)
+        fd = os.open(dst, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, tail)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        shipped += len(tail)
+    marker["log_offset"] = shipped
+
+
+def _sync_dir_mirror(src_dir: str, dst_dir: str, suffix: str) -> int:
+    """Mirror a manifest side-directory (deltas/, intents/): copy files
+    that are new or size-changed, remove files the primary no longer has
+    (folded deltas GC'd, intents resolved or swept — mirroring the
+    deletes keeps the standby's in-doubt evidence honest). Returns the
+    number of files that FAILED to ship."""
+    fails = 0
+    try:
+        src_names = {fn for fn in os.listdir(src_dir) if fn.endswith(suffix)}
+    except OSError:
+        src_names = set()
+    os.makedirs(dst_dir, exist_ok=True)
+    try:
+        dst_names = {fn for fn in os.listdir(dst_dir) if fn.endswith(suffix)}
+    except OSError:
+        dst_names = set()
+    for fn in src_names:
+        src = os.path.join(src_dir, fn)
+        dst = os.path.join(dst_dir, fn)
+        try:
+            if (fn not in dst_names
+                    or os.path.getsize(dst) != os.path.getsize(src)):
+                _copy_file(src, dst)
+        except OSError:
+            fails += 1
+    for fn in dst_names - src_names:
+        try:
+            os.remove(os.path.join(dst_dir, fn))
+        except OSError:
+            pass
+    return fails
+
+
+def _sync_meta(cluster_path: str, standby_path: str, marker: dict) -> None:
+    """One raw tail ship, commit-point (root) last. Per-file dictionary /
+    delta / intent failures are counted and skipped (best-effort, the
+    next sync retries); log-tail and root failures PROPAGATE — the
+    caller counts them and the lag gauge grows."""
+    fails = _sync_dicts(cluster_path, standby_path)
+    _sync_log_tail(cluster_path, standby_path, marker)
+    fails += _sync_dir_mirror(os.path.join(cluster_path, "deltas"),
+                              os.path.join(standby_path, "deltas"),
+                              ".delta")
+    fails += _sync_dir_mirror(os.path.join(cluster_path, "intents"),
+                              os.path.join(standby_path, "intents"),
+                              ".intent")
+    if fails:
+        counters.inc("standby_sync_fail_total", fails)
     for fn in _META_FILES:
         src = os.path.join(cluster_path, fn)
-        if fn == "manifest.json":
-            # ship the COMPOSED snapshot (root + committed per-table
-            # deltas), not the raw root file: an activated standby opens a
-            # plain root and must not lose delta commits folded only on
-            # the primary (storage/manifest.py)
-            _write_composed_manifest(cluster_path, standby_path)
-        elif os.path.exists(src):
+        if os.path.exists(src):
             _copy_file(src, os.path.join(standby_path, fn))
 
 
 _MANIFESTS: dict = {}
+_MANIFESTS_LOCK = threading.Lock()
 
 
-def _composed_snapshot(cluster_path: str) -> dict:
-    """Composed (root + committed deltas) snapshot for a cluster dir. The
-    Manifest instance is reused across syncs so its file-signature memo
-    serves the hot path — every post-commit standby sync would otherwise
-    re-read the log plus one file per unfolded delta."""
+def _primary_manifest(cluster_path: str):
+    """Memoized Manifest for a primary dir: its compose memo serves the
+    per-commit version probe (every post-commit sync asks the effective
+    version; re-opening would re-read the log each time). Locked: the
+    watcher daemon, ingest flusher, and statement threads all probe."""
     from greengage_tpu.storage.manifest import Manifest
 
-    m = _MANIFESTS.get(cluster_path)
-    if m is None:
-        if len(_MANIFESTS) > 8:
-            _MANIFESTS.clear()      # tests churn many tmp cluster dirs
-        m = _MANIFESTS[cluster_path] = Manifest(cluster_path)
-    return m.snapshot()
+    with _MANIFESTS_LOCK:
+        m = _MANIFESTS.get(cluster_path)
+        if m is None:
+            if len(_MANIFESTS) > 8:
+                _MANIFESTS.clear()  # tests churn many tmp cluster dirs
+            m = _MANIFESTS[cluster_path] = Manifest(cluster_path)
+        return m
 
 
-def _write_composed_manifest(cluster_path: str, standby_path: str) -> None:
-    snap = _composed_snapshot(cluster_path)
-    if not os.path.exists(os.path.join(cluster_path, "manifest.json")) \
-            and not snap.get("version"):
-        return
-
-    fd, tmp = tempfile.mkstemp(dir=standby_path, prefix=".manifest")
-    with os.fdopen(fd, "w") as f:
-        json.dump(snap, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(standby_path, "manifest.json"))
+def _primary_version(cluster_path: str) -> int:
+    return int(_primary_manifest(cluster_path).version())
 
 
 def init_standby(cluster_path: str, standby_path: str) -> dict:
-    """Seed the standby with the coordinator's current metadata and
-    register it on the primary so every future commit syncs."""
+    """Seed the standby with the coordinator's current state and
+    register it on the primary so every future commit ships the tail."""
     if os.path.abspath(standby_path) == os.path.abspath(cluster_path):
         raise ValueError("standby path must differ from the cluster path")
     os.makedirs(standby_path, exist_ok=True)
-    _sync_meta(cluster_path, standby_path)
-    version = _composed_snapshot(cluster_path).get("version", 0)
     marker = {"role": "standby", "primary": os.path.abspath(cluster_path),
-              "synced_version": version}
-    with open(os.path.join(standby_path, MARKER), "w") as f:
-        json.dump(marker, f, indent=1)
+              "synced_version": _primary_version(cluster_path)}
+    _sync_meta(cluster_path, standby_path, marker)
+    _write_json(standby_path, os.path.join(standby_path, MARKER), marker)
     with open(os.path.join(cluster_path, PRIMARY_MARKER), "w") as f:
         json.dump({"standby_path": os.path.abspath(standby_path)}, f)
     return marker
@@ -125,8 +254,21 @@ def registered_standby(cluster_path: str) -> str | None:
         return None
 
 
+def _sync_lock(standby_path: str) -> int:
+    """Exclusive standby-side ship lock: the primary's push-sync, the
+    watcher's pull-sync, and promotion all mutate the same standby files
+    from different processes — the flock serializes whole ships so the
+    byte-watermark tail append never interleaves with a recopy (and
+    promotion's recover() never races a queued push). Raises OSError
+    when the standby dir itself is gone: the loud-failure contract."""
+    fd = os.open(os.path.join(standby_path, ".sync.lock"),
+                 os.O_CREAT | os.O_RDWR, 0o644)
+    fcntl.flock(fd, fcntl.LOCK_EX)
+    return fd
+
+
 def sync(cluster_path: str, standby_path: str) -> int:
-    """Ship the newest committed state; -> synced manifest version.
+    """Ship the newest committed tail; -> synced manifest version.
 
     Fenced two ways: the target must still carry its standby marker (a
     dead/unmounted standby directory must FAIL the sync loudly, not be
@@ -134,6 +276,14 @@ def sync(cluster_path: str, standby_path: str) -> int:
     and a target whose marker says 'activated' is a PROMOTED coordinator
     — overwriting it would be split-brain data loss, exactly the state a
     partitioned old primary would create."""
+    fd = _sync_lock(standby_path)
+    try:
+        return _sync_locked(cluster_path, standby_path)
+    finally:
+        os.close(fd)
+
+
+def _sync_locked(cluster_path: str, standby_path: str) -> int:
     mp = os.path.join(standby_path, MARKER)
     try:
         with open(mp) as f:
@@ -147,19 +297,123 @@ def sync(cluster_path: str, standby_path: str) -> int:
             f"standby at {standby_path} was ACTIVATED; refusing to "
             "overwrite a promoted coordinator (split-brain fence) — "
             "remove this primary's standby registration")
-    _sync_meta(cluster_path, standby_path)
-    with open(os.path.join(standby_path, "manifest.json")) as f:
-        version = json.load(f).get("version", 0)
+    faults.check("standby_ship")
+    # version BEFORE the ship: everything at/below it is covered by the
+    # copies that follow, so the watermark is conservative under
+    # concurrent commits
+    version = _primary_version(cluster_path)
+    _sync_meta(cluster_path, standby_path, marker)
     marker["synced_version"] = version
-    with open(mp, "w") as f:
-        json.dump(marker, f, indent=1)
+    _write_json(standby_path, mp, marker, fsync=False)
+    counters.set("standby_lag_commits", 0)
     return version
+
+
+def lag(cluster_path: str) -> int:
+    """Committed-version distance between the primary and its registered
+    standby's last successful ship (0 when none is registered)."""
+    sb = registered_standby(cluster_path)
+    if sb is None:
+        return 0
+    try:
+        synced = int(status(sb).get("synced_version", 0))
+    except (OSError, ValueError):
+        synced = 0      # standby marker unreadable: the whole tail lags
+    try:
+        return max(0, _primary_version(cluster_path) - synced)
+    except Exception:
+        return 0
+
+
+def note_sync_failure(cluster_path: str) -> None:
+    """Account one failed ship: count it and refresh the lag gauge (the
+    formerly-silent OSError swallow, now a first-class signal)."""
+    counters.inc("standby_sync_fail_total")
+    counters.set("standby_lag_commits", lag(cluster_path))
 
 
 def status(standby_path: str) -> dict:
     with open(os.path.join(standby_path, MARKER)) as f:
         return json.load(f)
 
+
+# ---- primary liveness beat ---------------------------------------------
+
+def primary_beat(cluster_path: str, topology_version: int = 0) -> None:
+    """Stamp the coordinator liveness beat the standby watcher reads.
+    Best-effort: a missed stamp only ages the file, and the watcher
+    tolerates staleness up to standby_promote_deadline_s."""
+    try:
+        fd, tmp = tempfile.mkstemp(dir=cluster_path, prefix=".beat")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"pid": os.getpid(), "ts": time.time(),
+                       "topology_version": int(topology_version)}, f)
+        os.replace(tmp, os.path.join(cluster_path, BEAT))
+    except OSError:
+        pass
+
+
+def beat_age(cluster_path: str) -> float:
+    """Seconds since the primary last stamped its beat (inf = never)."""
+    try:
+        with open(os.path.join(cluster_path, BEAT)) as f:
+            ts = float(json.load(f).get("ts", 0.0))
+    except (OSError, ValueError):
+        return float("inf")
+    return max(0.0, time.time() - ts)
+
+
+# ---- the promotion fence -----------------------------------------------
+
+def write_fence(cluster_path: str, standby_path: str,
+                reason: str = "promotion") -> dict:
+    """Place the exclusive promotion claim in the PRIMARY cluster dir.
+    The hard link is the CAS (two racing standbys cannot both fence);
+    re-fencing by the same standby is idempotent. Every manifest commit
+    point re-verifies this file, so a paused-not-dead primary's next
+    commit raises CoordinatorFenced instead of forking the lineage."""
+    data = {"standby": os.path.abspath(standby_path), "reason": reason,
+            "ts": time.time(), "pid": os.getpid()}
+    path = os.path.join(cluster_path, FENCE)
+    fd, tmp = tempfile.mkstemp(dir=cluster_path, prefix=".fence")
+    with os.fdopen(fd, "w") as f:
+        json.dump(data, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        os.remove(tmp)
+        cur = fenced(cluster_path) or {}
+        if cur.get("standby") == data["standby"]:
+            return cur
+        raise RuntimeError(
+            f"cluster at {cluster_path} is already fenced by "
+            f"{cur.get('standby')!r} — two standbys raced; this one "
+            "must NOT promote")
+    os.remove(tmp)
+    return data
+
+
+def fenced(cluster_path: str) -> dict | None:
+    """The fence claim if this cluster dir has been fenced, else None."""
+    try:
+        with open(os.path.join(cluster_path, FENCE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_fence(cluster_path: str) -> None:
+    """Operator escape hatch (`gg standby --unfence` after re-initing a
+    demoted primary as the new standby)."""
+    try:
+        os.remove(os.path.join(cluster_path, FENCE))
+    except OSError:
+        pass
+
+
+# ---- activation & promotion --------------------------------------------
 
 def activate(standby_path: str, data_path: str | None = None) -> dict:
     """Promote the standby to a servable cluster directory
@@ -196,11 +450,162 @@ def activate(standby_path: str, data_path: str | None = None) -> dict:
                     if not os.path.exists(d2):
                         os.symlink(os.path.abspath(os.path.join(src, fn)), d2)
     st["role"] = "activated"
-    with open(os.path.join(standby_path, MARKER), "w") as f:
-        json.dump(st, f, indent=1)
+    _write_json(standby_path, os.path.join(standby_path, MARKER), st)
     # the promoted coordinator must not keep syncing to itself
     try:
         os.remove(os.path.join(standby_path, PRIMARY_MARKER))
     except OSError:
         pass
     return st
+
+
+def _bump_topology_version(standby_path: str) -> int:
+    """Advance the promoted catalog's segment-config version so every
+    cached dispatch topology (workers included) re-reads the cluster
+    state — the FTS-version bump the reference performs on promotion."""
+    cat = os.path.join(standby_path, "catalog.json")
+    try:
+        with open(cat) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    seg = data.get("segments")
+    if not isinstance(seg, dict):
+        return 0
+    seg["version"] = int(seg.get("version", 0)) + 1
+    _write_json(standby_path, cat, data)
+    return seg["version"]
+
+
+def promote(standby_path: str, data_path: str | None = None,
+            reason: str = "primary-silent") -> dict:
+    """The automatic-failover promotion state machine: fence -> final
+    tail pull -> activate -> recover -> topology bump. Idempotent once
+    activated. Fence FIRST: from that point the old primary's next
+    commit raises CoordinatorFenced, so the pull that follows ships the
+    FINAL committed tail (cluster files outlive the dead process) and
+    nothing can land behind the promotion's back. ``recover()`` then
+    resolves the in-doubt evidence honestly — staged delta claims and
+    unresolved write-intents roll back, durable merge lines survive —
+    exactly the startup contract a restarted primary gets."""
+    lock_fd = _sync_lock(standby_path)
+    try:
+        st = status(standby_path)
+        if st.get("role") == "activated":
+            return st
+        faults.check("standby_promote")
+        primary = st.get("primary", "")
+        if primary and os.path.isdir(primary):
+            write_fence(primary, standby_path, reason)
+            try:
+                _sync_locked(primary, standby_path)
+            except Exception:
+                # the last-shipped state is still a consistent commit
+                # prefix (root-last ordering); promote from it rather
+                # than refuse
+                counters.inc("standby_sync_fail_total")
+            # the common failover shape: the coordinator PROCESS died,
+            # the segment data trees survived — adopt them by default
+            if data_path is None:
+                pd = os.path.join(primary, "data")
+                if os.path.isdir(pd):
+                    data_path = pd
+        st = activate(standby_path, data_path)
+        from greengage_tpu.storage.manifest import Manifest
+
+        Manifest(standby_path).recover()
+        topo = _bump_topology_version(standby_path)
+        counters.inc("standby_promote_total")
+        counters.set("standby_lag_commits", 0)
+        st["promoted"] = {"reason": reason, "ts": time.time(),
+                          "topology_version": topo}
+        _write_json(standby_path, os.path.join(standby_path, MARKER), st)
+        return st
+    finally:
+        os.close(lock_fd)
+
+
+# ---- the watcher daemon (`gg standby --watch`) -------------------------
+
+class StandbyWatcher:
+    """Standby-side failover daemon, the FtsProber of the coordinator
+    itself: each poll pull-syncs the primary's commit tail (push from
+    the post-commit hook + this pull keeps lag bounded even when the
+    primary's push path fails) and reads the liveness beat; once the
+    primary has been silent past ``deadline_s`` it runs ``promote()``.
+    A beat-less primary (older build, beat file unlinked) gets one full
+    deadline window measured from watcher start before it counts as
+    silent."""
+
+    def __init__(self, standby_path: str, interval_s: float = 1.0,
+                 deadline_s: float = 15.0, data_path: str | None = None,
+                 on_promote=None):
+        self.standby_path = standby_path
+        self.interval_s = max(0.01, float(interval_s))
+        self.deadline_s = float(deadline_s)
+        self.data_path = data_path
+        self.on_promote = on_promote
+        self.promoted: dict | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._started = 0.0
+
+    def poll_once(self) -> bool:
+        """One watch step; -> True once the standby is promoted."""
+        if not self._started:
+            self._started = time.time()
+        st = status(self.standby_path)
+        if st.get("role") == "activated":
+            self.promoted = st
+            return True
+        primary = st.get("primary", "")
+        try:
+            sync(primary, self.standby_path)
+        except Exception:
+            note_sync_failure(primary)
+        silent = min(beat_age(primary), time.time() - self._started)
+        if silent >= self.deadline_s:
+            self.promoted = promote(
+                self.standby_path, self.data_path,
+                reason=f"primary silent {silent:.1f}s "
+                       f"(deadline {self.deadline_s:.1f}s)")
+            if self.on_promote is not None:
+                self.on_promote(self.promoted)
+            return True
+        return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._started = time.time()
+
+        def loop() -> None:
+            from greengage_tpu.runtime.retry import backoff_delays
+
+            delays = None
+            while not self._stop.is_set():
+                try:
+                    if self.poll_once():
+                        return
+                    delays = None
+                    wait = self.interval_s
+                except Exception:
+                    # transient watch errors (primary dir flapping) back
+                    # off instead of spinning; the next good poll resets
+                    if delays is None:
+                        delays = backoff_delays(base=self.interval_s,
+                                                cap=self.interval_s * 8,
+                                                jitter=0.25)
+                    wait = next(delays)
+                if self._stop.wait(wait):
+                    return
+
+        self._thread = threading.Thread(target=loop, name="gg-standby-watch",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -102,6 +102,16 @@ class ManifestError(RuntimeError):
     or the archive (docs/ROBUSTNESS.md)."""
 
 
+class CoordinatorFenced(RuntimeError):
+    """This cluster directory has been FENCED by a promoting standby
+    (runtime/standby.py write_fence): a paused-not-dead primary woke up
+    after its standby took over. Every commit path re-verifies the fence
+    at its atomic commit point, so the stale primary cannot fork the
+    lineage — the statement dies typed and retryable (SQLSTATE 57P01
+    analog: admin/failover shutdown; retry against the promoted
+    coordinator's address)."""
+
+
 class Manifest:
     def __init__(self, root: str):
         self.root = root
@@ -181,6 +191,26 @@ class Manifest:
             return (st.st_ino, st.st_size, st.st_mtime_ns)
         except OSError:
             return None
+
+    def _check_fence(self) -> None:
+        """Refuse to commit into a fenced cluster dir. Called inside every
+        locked/flocked commit point (atomic with the commit, like the
+        intent-token re-check), so a standby promotion that lands between
+        a writer's prepare and its commit turns the stale primary's
+        commit into a clean typed failure instead of split-brain."""
+        faults.check("coordinator_fence")
+        fp = os.path.join(self.root, "coordinator.fence")
+        if not os.path.exists(fp):
+            return
+        try:
+            with open(fp) as f:
+                owner = json.load(f).get("standby", "?")
+        except (OSError, ValueError):
+            owner = "?"
+        raise CoordinatorFenced(
+            f"cluster at {self.root} was fenced by promoted standby "
+            f"{owner!r}: this coordinator is stale and must not commit — "
+            "retry against the promoted coordinator")
 
     # ---- delta plumbing ------------------------------------------------
     def _delta_path(self, table: str, seq: int) -> str:
@@ -457,6 +487,11 @@ class Manifest:
                 raise RuntimeError(
                     f"write-write conflict: root advanced to v{cur} before "
                     f"staged v{version} could commit")
+            try:
+                self._check_fence()
+            except BaseException:
+                os.remove(tmp)
+                raise
             os.replace(tmp, self.path)
         with self._delta_lock:
             self._delta_cache.clear()
@@ -595,6 +630,10 @@ class Manifest:
                                 f"write-write conflict on table {table!r}: "
                                 "an intent merge landed during this "
                                 "transaction's commit window")
+                # promotion fence, atomic with the append: a standby that
+                # fenced this dir strictly before this point keeps the
+                # line out of the log entirely (split-brain invariant)
+                self._check_fence()
                 os.write(fd, line)
                 os.fsync(fd)
             finally:
@@ -684,6 +723,8 @@ class Manifest:
                         f"{handle['table']}.{handle['txid']} expired "
                         "before commit (removed by GC, recovery, or "
                         "DROP TABLE)")
+                # promotion fence, atomic with the append (see commit_delta)
+                self._check_fence()
                 os.write(fd, line)
                 os.fsync(fd)
             finally:
