@@ -915,6 +915,80 @@ def microbench_motion_pipeline() -> None:
         shutil.rmtree(path, ignore_errors=True)
 
 
+def microbench_feedback() -> None:
+    """Closed measurement loop (docs/PERF.md "Self-tuning"): a statement
+    whose row estimate is ~3x wrong runs cold (priced off the bad
+    estimate), the reconcile pass promotes a calibration, and the SECOND
+    execution plans and admits against ground truth. Prints the standard
+    one-line JSON:
+
+        {"metric": "feedback_mem_err_pct_warm", "value": N, "unit":
+         "pct", "vs_baseline": <cold err / warm err>, ...receipts...}
+
+    Env: GGTPU_MB_ROWS (default 100000), GGTPU_MB_SEGS (4)."""
+    os.environ.setdefault("GGTPU_BENCH_PLATFORM", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax  # noqa: F401  (platform pinning below)
+
+    _apply_platform_override()
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import greengage_tpu
+    from greengage_tpu.runtime.logger import counters
+
+    rows = int(os.environ.get("GGTPU_MB_ROWS", "100000"))
+    nseg = int(os.environ.get("GGTPU_MB_SEGS", "4"))
+    path = tempfile.mkdtemp(prefix="ggtpu_feedback_mb_")
+    try:
+        db = greengage_tpu.connect(path, numsegments=nseg)
+        db.sql("create table t (k int, b int, v double precision) "
+               "distributed by (k)")
+        rng = np.random.default_rng(7)
+        # b in [0, 7): `where b >= 0` passes EVERYTHING but the default
+        # selectivity prices it at ~1/3 — the canonical 3x underestimate
+        db.load_table("t", {
+            "k": np.arange(rows, dtype=np.int32),
+            "b": (np.arange(rows) % 7).astype(np.int32),
+            "v": rng.random(rows)})
+        q = "select count(*), sum(v) from t where b >= 0"
+        c0 = counters.snapshot()
+        t0 = time.monotonic()
+        db.sql(q)
+        cold_ms = (time.monotonic() - t0) * 1e3
+        cold_err = abs(int(counters.get("mem_est_error_pct")))
+        t0 = time.monotonic()
+        db.sql(q)
+        warm_ms = (time.monotonic() - t0) * 1e3
+        warm_err = abs(int(counters.get("mem_est_error_pct")))
+        d = counters.since(c0)
+        rep = db.feedback.report()
+        line = {
+            "metric": "feedback_mem_err_pct_warm",
+            "value": warm_err,
+            "unit": "pct",
+            "vs_baseline": round(cold_err / max(warm_err, 1), 2),
+            "cold_mem_err_pct": cold_err,
+            "warm_mem_err_pct": warm_err,
+            "corrections_applied": d.get("feedback_applied_total", 0),
+            "calibration_gen": rep["gen"],
+            "pending": rep["pending"],
+            "admission_measured": d.get("admission_measured_total", 0),
+            "admission_estimated": d.get("admission_estimated_total", 0),
+            "cold_stmt_ms": round(cold_ms, 1),
+            "warm_stmt_ms": round(warm_ms, 1),
+            "rows": rows, "segments": nseg,
+        }
+        print(json.dumps(line), flush=True)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+
+
 def microbench(name: str) -> None:
     fn = globals().get("microbench_" + name)
     if fn is None:
@@ -1491,6 +1565,35 @@ def run_child():
         detail["motion_pipeline"] = md
     except Exception as e:
         detail["motion_pipeline"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # self-tuning rider (ISSUE 20): the same Q1 shape run twice through
+    # the closed loop — on silicon the second execution should admit by
+    # MEASURED footprint (live HBM allocator stats), and the est-vs-actual
+    # admission error gauge should collapse; receipts land next to the
+    # CPU microbench numbers
+    try:
+        log("=== feedback rider ===")
+        from greengage_tpu.runtime.logger import counters as _fc
+
+        qf = ("select l_returnflag, count(*), sum(l_quantity) "
+              "from lineitem where l_quantity >= 0 group by l_returnflag")
+        c0 = _fc.snapshot()
+        db.sql(qf)
+        cold_err = abs(int(_fc.get("mem_est_error_pct")))
+        t0 = time.monotonic()
+        r2 = db.sql(qf)
+        fd = _fc.since(c0)
+        detail["feedback"] = {
+            "warm_stmt_ms": round((time.monotonic() - t0) * 1e3, 1),
+            "cold_mem_err_pct": cold_err,
+            "warm_mem_err_pct": abs(int(_fc.get("mem_est_error_pct"))),
+            "admitted_by": r2.stats.get("mem", {}).get("admitted_by"),
+            "corrections_applied": fd.get("feedback_applied_total", 0),
+            "admission_measured": fd.get("admission_measured_total", 0),
+            "calibration_gen": db.feedback.report()["gen"],
+        }
+    except Exception as e:
+        detail["feedback"] = {"error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps(detail, indent=None), file=sys.stderr, flush=True)
     if "q1" not in QUERIES:

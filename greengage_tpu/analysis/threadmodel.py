@@ -229,6 +229,9 @@ SHARED_CLASSES: dict[str, str] = {
                          "its motion stager, under the pipeline's "
                          "condition lock",
     "_OrderTable":       "lockdebug's own global table",
+    "FeedbackStore":     "calibration scales read at plan time by every "
+                         "statement thread, written by reconcile after "
+                         "execution and by the serve loop's adopt()",
 }
 
 # Attribute name -> class name: receiver typing the race walk cannot
@@ -246,6 +249,8 @@ RECEIVER_TYPES: dict[str, str] = {
     "_hp_cache": "BlockCache",
     "_rawcode_cache": "BlockCache",
     "_rawprefix_cache": "BlockCache",
+    # Database.feedback / Executor.feedback (planner/feedback.py store)
+    "feedback": "FeedbackStore",
 }
 
 

@@ -72,6 +72,18 @@ class Settings:
     # estimate once the executable is warm (only when the backend reports
     # real temps — CPU reports none, so estimates keep governing there)
     mem_accounting_enabled: bool = True
+    # feedback-driven cost calibration (planner/feedback.py): reconcile
+    # per-node actual rows + measured executable bytes against planner
+    # estimates after every execution, and apply the learned per-digest
+    # row-scale corrections at plan time (bounded EWMA; a promotion
+    # bumps the calibration version so the shape re-plans). Off =
+    # estimates stay static (the store still reports via gg checkperf).
+    cost_feedback: bool = True
+    # hysteresis band around an applied correction: the EWMA candidate
+    # must drift by more than this FACTOR before it re-applies (and
+    # re-plans the shapes using it) — estimate noise inside the band
+    # never invalidates cached plans
+    cost_feedback_hysteresis: float = 1.5
     # on a device RESOURCE_EXHAUSTED the statement demotes to the spill
     # path once (the workfile fallback) before surfacing the typed
     # OutOfDeviceMemory; off = fail fast with the forensics dump only

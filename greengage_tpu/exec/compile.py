@@ -139,6 +139,11 @@ class CompileResult:
     # (width, 1) per slot and every output/flag/metric carries a leading
     # (width,) axis the executor demuxes per member. 0 = classic program.
     batch_width: int = 0
+    # feedback-store key this program reports measured bytes under
+    # (batched programs qualify the statement key with the width bucket,
+    # since est_bytes/measured bytes are width-scaled); set by the
+    # executor at prepare time, read at dispatch
+    fb_key: str | None = None
 
 
 class Compiler:
@@ -860,6 +865,22 @@ class Compiler:
     def _compile_node(self, plan: Plan):
         fn = getattr(self, "_c_" + type(plan).__name__.lower())(plan)
         if not self.instrument:
+            # always-on row counters on Filter outputs: selectivity is the
+            # estimate the planner gets most wrong, and one jnp.sum per
+            # Filter is cheap enough to leave on for every normal run so
+            # the feedback store sees actuals without EXPLAIN ANALYZE
+            if isinstance(plan, Filter):
+                mid = f"nrows_{len(self.metrics)}"
+                self.metrics.append(mid)
+                self.node_rows[mid] = id(plan)
+
+                def counted_f(ctx):
+                    b = fn(ctx)
+                    ctx["metrics"].append(
+                        (mid, jnp.sum(b.selection().astype(jnp.int64))))
+                    return b
+
+                return counted_f
             return fn
         # per-node output row counter (the INSTRUMENT_CDB / explain_gp.c
         # per-operator Instrumentation analog): one cheap reduction per node

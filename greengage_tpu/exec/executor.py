@@ -194,6 +194,12 @@ class Executor:
         self.nseg = nseg
         self.settings = settings
         self.multihost = multihost    # parallel.multihost.MultihostRuntime
+        # planner/feedback.py store, wired by the owning Database: gives
+        # admission a persisted measured footprint and cap hints for
+        # shapes this PROCESS has never dispatched (restart / standby
+        # promotion). Single-host only at every read site — feedback
+        # state is per-process and must not steer lockstep branches.
+        self.feedback = None
         # staged device inputs live in the store's byte-accounted LRU
         # registry (storage/blockcache.py): bounded within a manifest
         # version, evicted by recency against scan_cache_limit_mb
@@ -328,6 +334,12 @@ class Executor:
                 self._cap_hints.move_to_end(cache_key)
             fused_disabled = cache_key is not None \
                 and cache_key in self._fused_failed
+        if not hints and cache_key is not None and self.multihost is None \
+                and self.feedback is not None:
+            # persisted cap hints (feedback store): a restarted process
+            # sizes overflow-capable capacities right on its FIRST
+            # dispatch instead of re-discovering them via overflow-retry
+            hints = dict(self.feedback.caps(cache_key))
         cap_overrides: dict = dict(hints)
         pack_disabled: set = set()
         TRACKER.enter()   # nested spill passes share the statement entry
@@ -458,7 +470,8 @@ class Executor:
             # reports real temps, else the compile-time estimate
             # (_admission_bytes) — four PRs of capacity bucketing finally
             # admit against ground truth on silicon
-            admit_bytes, admit_measured = self._admission_bytes(comp)
+            admit_bytes, admit_measured = self._admission_bytes(
+                comp, cache_key)
             if limit and admit_bytes > limit:
                 if deferred:
                     raise QueryError(
@@ -541,6 +554,22 @@ class Executor:
             # it — zero re-analysis), and record the device owner on the
             # statement's account before the allocator commits to it
             self._ensure_mem_analysis(comp, inputs)
+            if self.multihost is None and self.feedback is not None \
+                    and cache_key is not None and comp.mem_analysis:
+                _matot = (comp.mem_analysis["temp_bytes"]
+                          + comp.mem_analysis.get("argument_bytes", 0)
+                          + comp.mem_analysis.get("output_bytes", 0))
+                # warm-shape calibration gauge: once the feedback store
+                # predicts this shape's footprint (second execution on),
+                # report the error of the PREDICTION, not of the planner
+                # estimate — this is what collapses toward 0 warm
+                _pred = self.feedback.measured_bytes(cache_key)
+                if _pred:
+                    counters.set("mem_est_error_pct", int(round(
+                        100.0 * (_matot - _pred) / _pred)))
+                self.feedback.note_measured(
+                    cache_key, _matot,
+                    comp.est_bytes * self._segments_per_device())
             _acct = memaccount.ACCOUNTS.current()
             if _acct is not None:
                 _acct.set_device(comp.mem_analysis, comp.est_bytes)
@@ -636,6 +665,10 @@ class Executor:
                                 rec[nid] = _pow2(need + max(need // 16, 64))
                         while len(self._cap_hints) > 512:
                             self._cap_hints.popitem(last=False)
+                    if self.multihost is None and self.feedback is not None:
+                        # mirror into the feedback store so a restarted
+                        # process inherits the sizing (see run() seeding)
+                        self.feedback.note_caps(cache_key, dict(rec))
                 if deferred:
                     # parallel retrieve cursor: the program already ran and
                     # every segment's shard is on the host — finalization
@@ -690,7 +723,13 @@ class Executor:
                                     else int(np.sum(v)) if k.startswith("nrows_")
                                     else int(np.max(v)))
                                 for k, v in metrics.items()},
-                    "node_rows": {comp.node_rows[k]: int(np.sum(v))
+                    # nrows_* metrics are already psum-reduced on device
+                    # under multihost (every process holds the cluster
+                    # total replicated), so host-side summing there would
+                    # over-count by the process count
+                    "node_rows": {comp.node_rows[k]:
+                                  (int(v.flat[0]) if self.multihost
+                                   else int(np.sum(v)))
                                   for k, v in metrics.items()
                                   if k in comp.node_rows},
                     # measured memory accounting (docs/OBSERVABILITY.md):
@@ -860,7 +899,11 @@ class Executor:
         # measured footprint of a warm bucket takes over once the AOT
         # analysis ran — PR-10's ground truth bounding the batch width
         limit = effective_limit_bytes(self.settings)
-        admit_bytes, _measured = self._admission_bytes(comp)
+        if cache_key is not None:
+            # width-bucket-qualified feedback key: est/measured bytes are
+            # width-scaled, so each bucket calibrates independently
+            comp.fb_key = f"{cache_key}@w{bucket}"
+        admit_bytes, _measured = self._admission_bytes(comp, comp.fb_key)
         if limit and admit_bytes > limit:
             raise BatchFallback(
                 f"batched program would hold ~{admit_bytes >> 20} MB "
@@ -891,6 +934,14 @@ class Executor:
         thread with NO statement context, so a member's cancellation can
         never abort its batch-mates (members are masked at demux)."""
         self._ensure_mem_analysis(comp, inputs)
+        if comp.fb_key is not None and self.multihost is None \
+                and self.feedback is not None and comp.mem_analysis:
+            self.feedback.note_measured(
+                comp.fb_key,
+                comp.mem_analysis["temp_bytes"]
+                + comp.mem_analysis.get("argument_bytes", 0)
+                + comp.mem_analysis.get("output_bytes", 0),
+                comp.est_bytes * self._segments_per_device())
         with _trace.span("dispatch", cat="device",
                          batch_width=comp.batch_width,
                          est_bytes=comp.est_bytes):
@@ -1015,14 +1066,18 @@ class Executor:
                 counters.set("mem_est_error_pct", int(round(
                     100.0 * (total - est_dev) / est_dev)))
 
-    def _admission_bytes(self, comp: CompileResult) -> tuple[int, bool]:
+    def _admission_bytes(self, comp: CompileResult,
+                         cache_key=None) -> tuple[int, bool]:
         """Bytes the admission check and runaway ledger charge for this
         program -> (bytes, measured?). Prefers the measured per-segment
         executable footprint once the executable is warm AND the backend
         has a real device allocator (memory_stats() reports one — TPU/
-        GPU). The CPU backend's memory_analysis covers host buffers that
-        no HBM limit governs, so estimates keep governing there — and the
-        vmem GUC semantics the spill tests pin stay estimate-driven."""
+        GPU); falls back to the feedback store's persisted measurement of
+        the same statement shape when THIS process hasn't analyzed it yet
+        (restart, standby promotion). The CPU backend's memory_analysis
+        covers host buffers that no HBM limit governs, so estimates keep
+        governing there — and the vmem GUC semantics the spill tests pin
+        stay estimate-driven."""
         ma = comp.mem_analysis
         # multihost NEVER prefers measured bytes: comp.mem_analysis is
         # per-process state (one worker's transient AOT failure would
@@ -1042,7 +1097,24 @@ class Executor:
                         + ma.get("output_bytes", 0)) \
                 // self._segments_per_device()
             if measured > 0:
+                counters.inc("admission_measured_total")
                 return measured, True
+        if ma is None and cache_key is not None and self.multihost is None \
+                and self.feedback is not None \
+                and bool(getattr(self.settings,
+                                 "mem_accounting_enabled", True)) \
+                and memaccount.device_memory_stats() is not None:
+            # a prior execution (possibly an earlier PROCESS — the store
+            # persists beside the catalog) measured this shape: a cold
+            # program still admits against ground truth
+            mtot = self.feedback.measured_bytes(cache_key)
+            if mtot:
+                per_seg = int(mtot) // self._segments_per_device()
+                if per_seg > 0:
+                    counters.inc("admission_measured_total")
+                    counters.inc("admission_measured_feedback_total")
+                    return per_seg, True
+        counters.inc("admission_estimated_total")
         return comp.est_bytes, False
 
     def _segments_per_device(self) -> int:

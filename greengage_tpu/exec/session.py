@@ -131,6 +131,18 @@ class Database:
         # always (re)install — an uncalibrated cluster opened after a
         # calibrated one in the same process must get the defaults back
         _cost.set_calibration(cal)
+        # feedback-driven cost calibration (planner/feedback.py): the
+        # per-plan-digest store of observed actuals vs estimates,
+        # persisted beside the catalog (and shipped by the standby meta
+        # sync). Workers read the shared file but never write it — only
+        # the coordinator persists, and workers adopt the coordinator's
+        # applied scales from each statement broadcast instead of
+        # reconciling locally (lockstep planning, parallel/multihost.py)
+        from greengage_tpu.planner.feedback import FeedbackStore
+
+        self.feedback = FeedbackStore(os.path.join(path, "feedback.json"),
+                                      persist=not is_worker,
+                                      settings=self.settings)
         # planner overlap credit for pipelined motion (same process-global
         # pattern; recomputed on SET motion_pipeline*)
         _cost.set_motion_overlap(self._motion_overlap_factor())
@@ -159,6 +171,10 @@ class Database:
         self.executor = Executor(self.catalog, self.store, self.mesh,
                                  numsegments, self.settings,
                                  multihost=multihost)
+        # measured admission: the executor prefers the store's measured
+        # per-shape footprint and persisted capacity hints once a shape
+        # is warm (exec/executor.py _admission_bytes / run)
+        self.executor.feedback = self.feedback
         if not is_worker:
             # spill segments whose owning process died mid-pass (tiered
             # workfile; live paths clean up in their own finally)
@@ -1161,11 +1177,17 @@ class Database:
                 _tr = _TRACES.current()
                 _disp = (_tr.begin("dispatch", cat="multihost")
                          if _tr is not None else None)
+                _comp_acks = None
                 try:
                     with self._admission():
                         with ch.exchange():
+                            # calibration rides the dispatch frame: the
+                            # workers adopt OUR applied scales before
+                            # re-planning, so corrected estimates never
+                            # break the plan-hash lockstep invariant
                             ch.send({"op": "sql", "sql": text,
-                                     "plan_hash": self.plan_hash(stmt)})
+                                     "plan_hash": self.plan_hash(stmt),
+                                     "fb": self.feedback.wire_payload()})
                             try:
                                 ch.collect_acks(deadline="mh_ready_deadline",
                                                 phase="readiness")
@@ -1198,6 +1220,7 @@ class Database:
                                     _acks = ch.collect_acks(
                                         deadline="mh_ack_deadline",
                                         phase="completion")
+                                    _comp_acks = _acks
                                     if _disp is not None:
                                         _trace.graft_acks(_tr, _acks, _disp)
                                     if _sched is not None:
@@ -1235,6 +1258,11 @@ class Database:
                 finally:
                     if _disp is not None:
                         _tr.end(_disp)
+                # cluster-wide runaway verdict (the multihost
+                # runaway_cleaner, VERDICT missing #7): one decision from
+                # the AGGREGATED gang watermarks, enforced at the
+                # statement completion boundary — raises RunawayCancelled
+                self._mh_runaway_check(_comp_acks)
             else:
                 if isinstance(stmt, A.SetStmt):
                     # settings steer MESH decisions (spill passes, retry
@@ -1364,7 +1392,8 @@ class Database:
         try:
             with ch.exchange():
                 ch.send({"op": "sql_batch", "sqls": list(sqls),
-                         "plan_hash": plan_hash})
+                         "plan_hash": plan_hash,
+                         "fb": self.feedback.wire_payload()})
                 try:
                     ch.collect_acks(deadline="mh_ready_deadline",
                                     phase="readiness")
@@ -1435,6 +1464,56 @@ class Database:
                 raise QueryError(
                     "spill-schedule parity violation: coordinator ran "
                     f"{mine} but worker {a.get('process_id')} ran {ws}")
+
+    def _mh_runaway_check(self, acks) -> None:
+        """Cluster-wide runaway verdict (the multihost runaway_cleaner):
+        workers ship their HBM watermark in every completion ack (riding
+        the span-shipping path), the coordinator adds its own device
+        peak, and ONE decision covers the gang — when the aggregate
+        crosses the red zone of vmem_global_limit_mb, cancellation
+        broadcasts through every process's interrupt registry and the
+        statement surfaces a typed RunawayCancelled to the client.
+        Enforcement lands at the completion boundary: an XLA program
+        cannot be preempted mid-flight, so the boundary after the gang's
+        acks is the cluster's CHECK_FOR_INTERRUPTS."""
+        limit = int(getattr(self.settings, "vmem_global_limit_mb", 0)) << 20
+        if not limit or not acks:
+            return
+        from greengage_tpu.parallel.multihost import _hbm_watermark
+
+        total = _hbm_watermark(self)   # the coordinator's own peak
+        for a in acks:
+            if isinstance(a, dict):
+                total += int(a.get("hbm", 0) or 0)
+        red = int(limit * float(getattr(self.settings,
+                                        "runaway_red_zone", 0.9)))
+        if total <= red:
+            return
+        reason = (f"cluster HBM watermark {total >> 20} MB above the "
+                  f"red zone ({red >> 20} MB of vmem_global_limit_mb="
+                  f"{limit >> 20} MB)")
+        from greengage_tpu.runtime.faultinject import faults
+
+        # 'skip' on this point suppresses the worker broadcast (verdict
+        # still enforced locally) — the gang test's partial-failure probe
+        if not faults.check("runaway_broadcast"):
+            try:
+                self.multihost.channel.broadcast(
+                    {"op": "runaway", "reason": reason},
+                    deadline="mh_ready_deadline", phase="runaway")
+            except Exception:
+                # a dead/hung worker must not shield the verdict; the
+                # next statement's dispatch handles gang re-formation
+                pass
+        _counters.inc("statements_cancelled_runaway")
+        ctx = _INTERRUPTS.current()
+        if ctx is not None:
+            ctx.cancel("runaway", reason)
+            ctx.check()
+        # no statement context (internal caller): raise the typed error
+        from greengage_tpu.runtime.runaway import RunawayCancelled
+
+        raise RunawayCancelled(reason)
 
     def refresh(self) -> None:
         """Adopt the coordinator's committed catalog/manifest state from
@@ -1636,7 +1715,7 @@ class Database:
                 # them now, not at their timeout
                 self.resgroups.kick()
             if stmt.name in ("optimizer", "plan_cache_params",
-                             "scalar_device_enabled"):
+                             "scalar_device_enabled", "cost_feedback"):
                 # planner selection / literal-hoisting / scalar-lowering
                 # changed: cached bound plans were produced under the
                 # other regime. motion_pipeline_buckets needs no clear:
@@ -1940,7 +2019,10 @@ class Database:
         with _trace.span("bind", cat="plan"):
             logical, outs = binder.bind_select(stmt)
         planned = plan_query(logical, self.catalog, self.store, self.numsegments,
-                             force_multi_join=force_multi_join)
+                             force_multi_join=force_multi_join,
+                             feedback=(self.feedback if bool(getattr(
+                                 self.settings, "cost_feedback", True))
+                                 else None))
         if self.settings.plan_validate:
             # checkPlan-before-dispatch (analysis/plancheck.py): a plan
             # violating a Motion/locality/prune invariant dies HERE with a
@@ -2247,7 +2329,11 @@ class Database:
         if pv is not None:
             info["params"] = len(pv.values)
         key_sig = sig if sig is not None else repr(stmt)
-        key = (key_sig, version)
+        # the calibration version joins the key: a feedback promotion
+        # touching this shape's digests bumps it, so a re-calibrated
+        # shape re-plans instead of serving the stale bound plan
+        fbv = self.feedback.version_for(key_sig)
+        key = (key_sig, version, fbv)
         cache = self._select_cache
         if not force_multi_join:
             hit = cache.get(key)
@@ -2255,7 +2341,8 @@ class Database:
                 # this shape previously fell back to a value-pinned plan
                 # (binder cannot parameterize it): look it up under the
                 # full repr so the fallback is paid once, not per call
-                fbk = (repr(stmt), version)
+                fbk = (repr(stmt), version,
+                       self.feedback.version_for(repr(stmt)))
                 fb = cache.get(fbk)
                 if fb is not None and fb[4] is None:
                     key, hit = fbk, fb
@@ -2291,11 +2378,14 @@ class Database:
             self._paramize_fallback.add(key_sig)
             ptypes = None
             key_sig = repr(stmt)
-            key = (key_sig, version)
+            key = (key_sig, version, self.feedback.version_for(key_sig))
             with _trace.span("plan", cat="plan", fallback=True):
                 planned, consts, outs = self._plan(
                     stmt, force_multi_join=force_multi_join)
         ek = key_sig + ("#multi" if force_multi_join else "")
+        # register the shape -> digest dependency set so a promotion on
+        # any digest this plan uses bumps version_for(key_sig)
+        self.feedback.note_shape(key_sig, planned)
         cache[key] = (planned, consts, outs, ek, ptypes)
         try:
             cache.move_to_end(key)
@@ -2395,7 +2485,7 @@ class Database:
                 if res is not None:
                     if isinstance(res.stats, dict):
                         res.stats["plan_cache"] = dict(pc_info)
-                    self._record_stats(res)
+                    self._record_stats(res, planned, exec_key)
                     return res
             try:
                 # executor adds the manifest version; the bare statement
@@ -2405,7 +2495,7 @@ class Database:
                                         aux_tables=aux or None)
                 if isinstance(res.stats, dict):
                     res.stats["plan_cache"] = dict(pc_info)
-                self._record_stats(res)
+                self._record_stats(res, planned, exec_key)
                 return res
             except QueryError as e:
                 if "duplicate keys" not in str(e):
@@ -2420,10 +2510,10 @@ class Database:
                                         aux_tables=aux or None)
                 if isinstance(res.stats, dict):
                     res.stats["plan_cache"] = dict(self._plan_cache_info)
-                self._record_stats(res)
+                self._record_stats(res, planned, exec_key)
                 return res
 
-    def _record_stats(self, res) -> None:
+    def _record_stats(self, res, planned=None, exec_key=None) -> None:
         self.stat_activity.append({
             "ts": time.time(),
             "wall_ms": res.wall_ms,
@@ -2432,6 +2522,39 @@ class Database:
         })
         if len(self.stat_activity) > 200:
             del self.stat_activity[0]
+        if planned is not None and exec_key is not None:
+            self._feedback_reconcile(planned, exec_key, res)
+
+    def _feedback_reconcile(self, planned, exec_key: str, res) -> None:
+        """Close the measurement loop after one execution: per-node
+        actual rows (always-on filter counters + instrumented runs) and
+        the exact ``rows_out`` reconcile against the planner's
+        ``est_rows`` per structural digest; the AOT-measured executable
+        bytes reconcile against ``est_bytes`` per shape. Coordinator /
+        single-host only: workers adopt the coordinator's applied
+        scales from the statement broadcast instead (identical inputs
+        would yield identical updates, but the asymmetric rows_out of a
+        gathered result must not desync lockstep planning)."""
+        if not bool(getattr(self.settings, "cost_feedback", True)):
+            return
+        if self.multihost is not None and not self.multihost.is_coordinator:
+            return
+        stats = res.stats if isinstance(res.stats, dict) else {}
+        if stats.get("batched"):
+            # batched members share one program; per-member node
+            # attribution is masked at demux — skip (the classic runs
+            # of the shape feed the loop)
+            return
+        key_sig = exec_key[:-6] if exec_key.endswith("#multi") else exec_key
+        mem = stats.get("mem") or {}
+        measured = mem.get("measured") or {}
+        measured_total = (measured.get("temp_bytes", 0)
+                          + measured.get("argument_bytes", 0)
+                          + measured.get("output_bytes", 0)) or None
+        self.feedback.reconcile(
+            key_sig, planned, len(res), stats.get("node_rows"),
+            measured_bytes=measured_total,
+            est_bytes=mem.get("est_bytes"))
 
     def _explain(self, stmt: A.ExplainStmt):
         if not isinstance(stmt.query, (A.SelectStmt, A.UnionStmt)):
@@ -2464,6 +2587,10 @@ class Database:
             # Motion nodes additionally report the bytes they moved
             res = self.executor.run(planned, consts, outs, instrument=True,
                                     aux_tables=aux or None)
+            # instrumented runs carry actual rows for EVERY operator —
+            # the richest feedback the loop gets (joins/aggregates that
+            # normal runs only observe at the root)
+            self._feedback_reconcile(planned, _ek, res)
             s = res.stats or {}
             annot = self._analyze_annotations(planned, s)
             text = describe(planned, annot=annot)
@@ -3729,6 +3856,12 @@ class Database:
         # stop frame (workers distinguish this from a coordinator crash)
         try:
             self.ingest.stop()   # drain-or-abort open streams first
+        except Exception:
+            pass
+        try:
+            # calibration state survives restart (promotion already kept
+            # hot state: reconcile saves on every applied correction)
+            self.feedback.save()
         except Exception:
             pass
         try:

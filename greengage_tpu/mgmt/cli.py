@@ -321,10 +321,53 @@ def cmd_analyzedb(args):
     return 0
 
 
+def _print_feedback_report(rep: dict) -> None:
+    print(f"self-tuning: calibration generation {rep['gen']}, "
+          f"{rep['digests']} digest(s) tracked, {rep['pending']} pending")
+    if rep.get("scales"):
+        print(f"  applied row scales: {rep['scales']}")
+    shapes = rep.get("shapes") or []
+    if shapes:
+        print(f"  {'shape':<18}{'runs':>5} {'rows err%':>10} "
+              f"{'bytes err%':>11}  statement")
+        for s in sorted(shapes, key=lambda x: -x.get("runs", 0)):
+            rerr = s.get("rows_err_pct")
+            berr = s.get("bytes_err_pct")
+            print(f"  {s['shape']:<18}{s.get('runs', 0):>5} "
+                  f"{('%.1f' % rerr) if rerr is not None else '-':>10} "
+                  f"{('%.1f' % berr) if berr is not None else '-':>11}  "
+                  f"{(s.get('sql') or '')[:60]}")
+
+
+def cmd_checkperf_feedback(args) -> int:
+    """The self-tuning half of `gg checkperf`: per-plan-digest
+    est-vs-actual error (rows + bytes), `--apply` commits every pending
+    calibration candidate, `--reset` clears the store."""
+    db = _open(args.dir)
+    try:
+        fb = db.feedback
+        if getattr(args, "reset", False):
+            fb.reset()
+            print("feedback store cleared")
+            return 0
+        if getattr(args, "apply", False) \
+                and not getattr(args, "device", False):
+            n = fb.apply_pending()
+            print(f"applied {n} pending correction(s)")
+        _print_feedback_report(fb.report())
+        return 0
+    finally:
+        db.close()
+
+
 def cmd_checkperf(args):
     """gpcheckperf analog: micro-benchmark the cluster's hardware paths —
     data-dir disk bandwidth, host memory bandwidth, device HBM bandwidth,
-    and the mesh collective (ICI) path."""
+    and the mesh collective (ICI) path — plus the self-tuning loop's
+    est-vs-actual report (`--feedback` for the report alone)."""
+
+    if getattr(args, "feedback", False) or getattr(args, "reset", False):
+        return cmd_checkperf_feedback(args)
 
     import numpy as np
 
@@ -1490,8 +1533,14 @@ def main(argv=None):
                    help="measure planner cost-model primitives on the "
                         "live backend")
     p.add_argument("--apply", action="store_true",
-                   help="persist measurements to <dir>/calibration.json "
-                        "(loaded by every future connect)")
+                   help="with --device: persist measurements to "
+                        "<dir>/calibration.json; with --feedback: commit "
+                        "every pending self-tuning correction")
+    p.add_argument("--feedback", action="store_true",
+                   help="print only the self-tuning est-vs-actual report "
+                        "(planner/feedback.py store)")
+    p.add_argument("--reset", action="store_true",
+                   help="clear the self-tuning feedback store")
     p.set_defaults(fn=cmd_checkperf)
 
     p = sub.add_parser("load")        # gpload analog

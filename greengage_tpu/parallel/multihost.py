@@ -740,6 +740,22 @@ def _worker_idle_timeout(db) -> float | None:
                10.0 * float(db.settings.mh_heartbeat_interval))
 
 
+def _hbm_watermark(db) -> int:
+    """Peak device bytes this process has observed, shipped in completion
+    acks so the coordinator can drive ONE cluster-wide runaway verdict
+    from the gang's aggregated watermarks. The mh_hbm_watermark fault
+    point ('skip' type) substitutes a synthetic over-limit value so the
+    gang test forces a verdict without a real multi-GB allocation."""
+    if faults.check("mh_hbm_watermark"):
+        return 1 << 40
+    from greengage_tpu.runtime import memaccount
+
+    st = memaccount.device_memory_stats()
+    if st is None:
+        return 0
+    return int(st.get("peak_bytes_in_use", 0) or 0)
+
+
 def _serve_one(db, ch) -> bool:
     """Handle one control frame; False = clean stop."""
     # worker process main loop: no statement registry on this side (the
@@ -805,6 +821,17 @@ def _serve_one(db, ch) -> bool:
         except Exception as e:
             ch.ack(False, f"{type(e).__name__}: {e}")
         return True
+    if op == "runaway":
+        # cluster-wide runaway verdict: the coordinator aggregated the
+        # gang's HBM watermarks past the red zone and broadcast the kill.
+        # Cancel whatever runs here through the interrupt registry (same
+        # flag the single-host cleaner trips) and count it.
+        counters.inc("statements_cancelled_runaway")
+        interrupt.REGISTRY.cancel_all(
+            "runaway", msg.get("reason")
+            or "canceled by the runaway cleaner (cluster verdict)")
+        ch.ack(True)
+        return True
     if op == "sql_batch":
         # one batched serving window (exec/batchserve.py): same two-phase
         # contract as a classic statement — verify the window's plan hash
@@ -815,6 +842,9 @@ def _serve_one(db, ch) -> bool:
         sqls = msg.get("sqls") or []
         try:
             db.refresh()
+            # adopt the coordinator's applied calibration BEFORE planning:
+            # plan hashes must match, and est_rows feed the plan text
+            db.feedback.adopt(msg.get("fb"))
             want = msg.get("plan_hash")
             if want and sqls:
                 got = db.plan_hash(sqls[0])
@@ -851,7 +881,8 @@ def _serve_one(db, ch) -> bool:
         spans = tr.export(limit=512) if tr is not None else None
         TRACES.exit(tr)
         faults.check("worker_ack")
-        ch.ack(True, spans=spans, process_id=db.multihost.process_id)
+        ch.ack(True, spans=spans, process_id=db.multihost.process_id,
+               hbm=_hbm_watermark(db))
         return True
     if op != "sql":
         return True
@@ -861,6 +892,10 @@ def _serve_one(db, ch) -> bool:
     faults.check("worker_ack")
     try:
         db.refresh()
+        # adopt the coordinator's applied calibration BEFORE the plan-hash
+        # check: corrected est_rows appear in describe(), so both sides
+        # must plan from identical scales (JSON floats round-trip exactly)
+        db.feedback.adopt(msg.get("fb"))
         want = msg.get("plan_hash")
         if want:
             # plan_hash raises if this worker cannot re-plan — that
@@ -909,5 +944,6 @@ def _serve_one(db, ch) -> bool:
     TRACES.exit(tr)
     faults.check("worker_ack")
     ch.ack(True, spans=spans, process_id=db.multihost.process_id,
-           spill_schedule=db.executor.collect_spill_schedule())
+           spill_schedule=db.executor.collect_spill_schedule(),
+           hbm=_hbm_watermark(db))
     return True

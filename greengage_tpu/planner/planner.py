@@ -76,11 +76,17 @@ def _year_prune(lhs, rhs, op, by_id) -> list[tuple] | None:
 
 
 class Planner:
-    def __init__(self, catalog, store, numsegments: int, force_multi_join: bool = False):
+    def __init__(self, catalog, store, numsegments: int,
+                 force_multi_join: bool = False, feedback=None):
         self.catalog = catalog
         self.store = store
         self.nseg = numsegments
         self.force_multi_join = force_multi_join
+        # feedback-driven row-scale corrections (planner/feedback.py):
+        # None when cost_feedback is off or no store is wired in — the
+        # session passes its FeedbackStore so observed actuals correct
+        # est_rows per structural node digest
+        self.feedback = feedback
 
     # ------------------------------------------------------------------
     def plan(self, node: Plan) -> Plan:
@@ -100,7 +106,18 @@ class Planner:
 
     def _rec(self, node: Plan) -> Plan:
         m = getattr(self, "_plan_" + type(node).__name__.lower())
-        return m(node)
+        out = m(node)
+        if self.feedback is not None and isinstance(
+                out, (Filter, Join, Aggregate)):
+            # measured-traffic correction: scale the freshly computed
+            # estimate by the digest's applied feedback scale BEFORE the
+            # parent reads it, so motion choice, capacity sizing, and
+            # admission all see corrected cardinalities. This is also
+            # what supersedes a ParamRef.est_value seed: the populating
+            # statement's literals seed the selectivity once, observed
+            # actuals correct it forever after.
+            out.est_rows = self.feedback.corrected_rows(out)
+        return out
 
     # ------------------------------------------------------------------
     def _plan_scan(self, node: Scan) -> Plan:
@@ -1009,5 +1026,6 @@ def _scan_covers(plan: Plan, ids: set) -> bool:
 
 
 def plan_query(root: Plan, catalog, store, numsegments: int,
-               force_multi_join: bool = False) -> Plan:
-    return Planner(catalog, store, numsegments, force_multi_join).plan(root)
+               force_multi_join: bool = False, feedback=None) -> Plan:
+    return Planner(catalog, store, numsegments, force_multi_join,
+                   feedback=feedback).plan(root)

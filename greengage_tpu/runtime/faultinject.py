@@ -85,6 +85,17 @@ FAULT_POINTS = frozenset({
     # written — occurrence/start_after targeting pins any crash window in
     # the detect -> fence -> sync -> activate -> recover state machine
     "standby_ship", "coordinator_fence", "standby_promote",
+    # self-tuning loop (planner/feedback.py, exec/session.py):
+    # feedback_apply fires before a calibration candidate is promoted to
+    # an applied scale — 'skip' holds every correction pending (checkperf
+    # --apply commits them), 'error' probes the reconcile path's
+    # isolation from the statement; runaway_broadcast fires before the
+    # coordinator ships the cluster runaway verdict to the gang — 'skip'
+    # enforces locally only (partial-failure probe); mh_hbm_watermark
+    # fires in the worker's completion-ack watermark read — 'skip'
+    # substitutes a synthetic over-limit value so the gang test forces a
+    # cluster verdict without a real multi-GB allocation
+    "feedback_apply", "runaway_broadcast", "mh_hbm_watermark",
 })
 
 

@@ -126,6 +126,13 @@ class StatementContext:
             "shutdown": "canceling statement due to server shutdown",
             "runaway": "canceled by the runaway cleaner",
         }.get(cause, "statement cancelled")
+        if cause == "runaway":
+            # typed subclass so clients distinguish a runaway kill (their
+            # statement held too much HBM) from a plain cancel; deferred
+            # import — runaway.py imports this module at load
+            from greengage_tpu.runtime.runaway import RunawayCancelled
+
+            raise RunawayCancelled(msg)
         raise StatementCancelled(msg, cause)
 
     # ---- wait integration (resource queue etc.) ----------------------

@@ -58,6 +58,11 @@ GAUGE_NAMES = (
     # the primary not yet shipped to the registered standby — 0 while
     # the tail sync keeps up, grows while shipping fails
     "standby_lag_commits",
+    # self-tuning loop (planner/feedback.py): generation of the applied
+    # calibration — joins the bound-plan cache key, so a bump means every
+    # affected shape re-plans; workers track the coordinator's via the
+    # dispatch-frame payload
+    "calibration_version",
 )
 
 # Declared metric catalog — the source of truth `gg check`
@@ -146,6 +151,14 @@ COUNTER_NAMES = (
     # CoordinatorLost (the redial walked mh_coordinator_addrs and landed
     # on the promoted standby)
     "standby_sync_fail_total", "standby_promote_total", "mh_rehome_total",
+    # self-tuning loop (planner/feedback.py, exec/executor.py):
+    # calibration corrections promoted into applied scales, and how each
+    # admission verdict was priced — measured footprint (live AOT
+    # analysis OR the feedback store's persisted measurement; the
+    # _feedback variant counts the persisted subset) vs planner estimate
+    "feedback_applied_total",
+    "admission_measured_total", "admission_measured_feedback_total",
+    "admission_estimated_total",
 )
 
 HISTOGRAM_NAMES = (

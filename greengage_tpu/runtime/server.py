@@ -609,6 +609,19 @@ class SqlServer:
                     from greengage_tpu.runtime import memaccount
 
                     return {"ok": True, "mem": memaccount.report(outer.db)}
+                if op == "checkperf":
+                    # the self-tuning surface (`gg checkperf --feedback`
+                    # against a live server): per-plan-digest
+                    # est-vs-actual error, with apply/reset sub-ops
+                    fb = outer.db.feedback
+                    if req.get("reset"):
+                        fb.reset()
+                        return {"ok": True, "reset": True}
+                    out = {"ok": True}
+                    if req.get("apply"):
+                        out["applied"] = fb.apply_pending()
+                    out["feedback"] = fb.report()
+                    return out
                 if op == "trace":
                     from greengage_tpu.runtime.trace import TRACES, to_chrome
 

@@ -58,8 +58,8 @@ FENCE = "coordinator.fence"
 # manifest.json LAST: it is the commit record — if the sync dies midway,
 # the standby's root must never be newer than the log/deltas/dictionaries
 # it references (the WAL commit-point-last rule)
-_META_FILES = ("settings.json", "calibration.json", "catalog.json",
-               "manifest.json")
+_META_FILES = ("settings.json", "calibration.json", "feedback.json",
+               "catalog.json", "manifest.json")
 
 
 def _copy_file(src: str, dst: str) -> None:

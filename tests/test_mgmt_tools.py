@@ -408,3 +408,61 @@ def test_check_list_smoke(capsys):
                  "threads", "races"):
         assert name in out, out
     assert "finding(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# gg checkperf --feedback (the self-tuning loop's operator surface; the
+# calibration behavior matrix lives in test_feedback.py — this keeps the
+# COMMAND and the server frame wired)
+# ---------------------------------------------------------------------------
+
+def test_checkperf_feedback_report_and_reset(clu, tmp_path, capsys):
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table cp (a int, b int) distributed by (a)")
+    db.sql("insert into cp values " +
+           ",".join(f"({i},{i % 7})" for i in range(500)))
+    db.sql("select count(*) from cp where b >= 0")   # 3x-wrong estimate
+    db.sql("select count(*) from cp where b >= 0")
+    db.close()
+    assert run_cli("checkperf", "-d", clu, "--feedback") == 0
+    out = capsys.readouterr().out
+    assert "self-tuning: calibration generation" in out
+    assert "applied row scales" in out               # the promotion shows
+    assert "rows err%" in out
+    # --apply is a no-op when nothing is pending, but must be wired
+    assert run_cli("checkperf", "-d", clu, "--feedback", "--apply") == 0
+    assert "applied 0 pending correction(s)" in capsys.readouterr().out
+    # --reset clears the store
+    assert run_cli("checkperf", "-d", clu, "--reset") == 0
+    assert "feedback store cleared" in capsys.readouterr().out
+    assert run_cli("checkperf", "-d", clu, "--feedback") == 0
+    assert "0 digest(s) tracked" in capsys.readouterr().out
+
+
+def test_checkperf_server_frame(clu, tmp_path):
+    from greengage_tpu.runtime.server import SqlClient, SqlServer
+
+    db = greengage_tpu.connect(path=clu)
+    db.sql("create table cp (a int, b int) distributed by (a)")
+    db.sql("insert into cp values " +
+           ",".join(f"({i},{i % 7})" for i in range(500)))
+    db.sql("select count(*) from cp where b >= 0")
+    sock = str(tmp_path / "cp.sock")
+    srv = SqlServer(db, sock)
+    srv.start()
+    try:
+        c = SqlClient(sock)
+        try:
+            st = c.op({"op": "checkperf"})
+            assert st["ok"]
+            assert st["feedback"]["gen"] >= 1
+            assert st["feedback"]["shapes"]
+            ap = c.op({"op": "checkperf", "apply": True})
+            assert ap["ok"] and ap["applied"] == 0
+            rs = c.op({"op": "checkperf", "reset": True})
+            assert rs["ok"] and rs["reset"] is True
+            assert c.op({"op": "checkperf"})["feedback"]["digests"] == 0
+        finally:
+            c.close()
+    finally:
+        srv.stop()

@@ -1360,3 +1360,95 @@ def test_two_process_gang_batch_serving_canary(tmp_path):
     # and classic lockstep service survived the batched windows
     assert out["post"] == 3000, out
     assert out["post_segments"] == 8, out
+
+
+# ---------------------------------------------------------------------------
+# cluster-wide runaway enforcement: aggregated HBM watermarks, one verdict
+# ---------------------------------------------------------------------------
+
+COORD_RUNAWAY_SCRIPT = r"""
+import json, os, sys
+port, cport, path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["GGTPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.environ["GGTPU_REPO"])
+from greengage_tpu.parallel.multihost import init_multihost
+mh = init_multihost(f"127.0.0.1:{port}", 2, 0, cport, distributed=False)
+import greengage_tpu
+from greengage_tpu.runtime.logger import counters
+from greengage_tpu.runtime.runaway import RunawayCancelled
+db = greengage_tpu.connect(path, multihost=mh)
+out = {}
+db.sql("create table f (k bigint, g int, v int) distributed by (k)")
+db.sql("insert into f values " + ",".join(
+    f"({i}, {i % 13}, {i % 7})" for i in range(2000)))
+db.sql("analyze")
+r = db.sql("select g, count(*) from f group by g order by g")
+out["healthy_groups"] = len(r.rows())
+# arm a synthetic 1 TB HBM watermark on every WORKER's completion ack
+# (the coordinator's own peak stays honest), then set the global ceiling
+db.cluster_inject_fault("mh_hbm_watermark", type="skip", occurrences=-1)
+db.sql("set vmem_global_limit_mb = 64")
+try:
+    db.sql("select g, count(*), sum(v) from f group by g order by g")
+    out["cancelled"] = False
+except RunawayCancelled as e:
+    out["cancelled"] = True
+    out["reason"] = str(e)
+except Exception as e:                          # noqa: BLE001
+    out["cancelled"] = "wrong-type:" + type(e).__name__ + ":" + str(e)
+out["coord_runaway_ctr"] = counters.get("statements_cancelled_runaway")
+# disarm: the verdict killed the STATEMENT, not the gang
+db.cluster_inject_fault("mh_hbm_watermark", type="skip", reset=True)
+db.sql("set vmem_global_limit_mb = 0")
+r = db.sql("select count(*) from f")
+out["after"] = int(r.rows()[0][0])
+mh.channel.close()
+print("RESULT:" + json.dumps(out), flush=True)
+"""
+
+
+def test_cluster_runaway_aggregated_watermark_cancels_gangwide(tmp_path):
+    """PR-20 acceptance: a multihost runaway is detected from AGGREGATED
+    worker HBM watermarks (no worker is individually over), the
+    cancellation broadcasts to the whole gang, and the client sees a
+    typed RunawayCancelled — then the next statement serves normally."""
+    port, cport = _free_port(), _free_port()
+    path = str(tmp_path / "cluster")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "GGTPU_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "GGTPU_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    })
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "greengage_tpu.mgmt.cli", "worker",
+         "-d", path, "--coordinator", f"127.0.0.1:{port}",
+         "--control-port", str(cport), "--num-processes", "2",
+         "--process-id", "1", "--no-distributed"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    coord = subprocess.Popen(
+        [sys.executable, "-c", COORD_RUNAWAY_SCRIPT, str(port), str(cport),
+         path],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        cout, _ = coord.communicate(timeout=480)
+        wout, _ = worker.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        coord.kill()
+        worker.kill()
+        cout = coord.stdout.read() if coord.stdout else ""
+        wout = worker.stdout.read() if worker.stdout else ""
+        raise AssertionError(
+            f"runaway gang timeout\ncoordinator:\n{cout}\nworker:\n{wout}")
+    assert coord.returncode == 0, f"coordinator:\n{cout}\nworker:\n{wout}"
+    res = [ln for ln in cout.splitlines() if ln.startswith("RESULT:")]
+    assert res, f"coordinator:\n{cout}\nworker:\n{wout}"
+    out = json.loads(res[0][len("RESULT:"):])
+    assert out["healthy_groups"] == 13
+    assert out["cancelled"] is True, out
+    assert "red zone" in out["reason"]
+    assert out["coord_runaway_ctr"] >= 1
+    assert out["after"] == 2000           # the gang outlived the verdict
